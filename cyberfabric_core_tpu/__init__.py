@@ -1,0 +1,15 @@
+"""cyberfabric_core_tpu — a TPU-native platform with the capabilities of cyberfabric/cyberfabric-core.
+
+Two tiers, mirroring the reference's "thin host / heavy substrate" split
+(reference: apps/hyperspot-server + libs/modkit):
+
+- **Platform substrate** (`modkit/`, `gateway/`, `modules/`): module runtime with phased
+  lifecycle, typed ClientHub DI, layered config, hardened API gateway, multi-tenant
+  security, GTS type registry — the re-creation of the reference's Rust ModKit.
+- **TPU tier** (`models/`, `ops/`, `parallel/`, `runtime/`): JAX/XLA/Pallas model
+  definitions, sharded inference engine, paged KV cache, continuous batching — the real
+  implementation of the reference's spec-only GenAI modules (llm-gateway,
+  model-registry, serverless-runtime, file-storage, credstore).
+"""
+
+__version__ = "0.1.0"

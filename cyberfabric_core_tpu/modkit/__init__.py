@@ -1,0 +1,50 @@
+"""ModKit — the module runtime (reference: libs/modkit/src/).
+
+Public surface re-exports, mirroring `libs/modkit/src/lib.rs`.
+"""
+
+from .cancellation import CancellationToken
+from .contracts import (
+    ApiGatewayCapability,
+    DatabaseCapability,
+    GrpcServiceCapability,
+    Module,
+    RestApiCapability,
+    RunnableCapability,
+    SystemCapability,
+)
+from .client_hub import ClientHub, ClientScope
+from .config import AppConfig, ConfigError
+from .context import ModuleCtx
+from .errors import Problem, ProblemError, declare_errors
+from .lifecycle import ReadySignal, Status, WithLifecycle
+from .registry import ModuleRegistry, module, clear_registrations
+from .runtime import HostRuntime, RunOptions, Runner
+
+__all__ = [
+    "ApiGatewayCapability",
+    "AppConfig",
+    "CancellationToken",
+    "ClientHub",
+    "ClientScope",
+    "ConfigError",
+    "DatabaseCapability",
+    "GrpcServiceCapability",
+    "HostRuntime",
+    "Module",
+    "ModuleCtx",
+    "ModuleRegistry",
+    "Problem",
+    "ProblemError",
+    "ReadySignal",
+    "RestApiCapability",
+    "RunOptions",
+    "Runner",
+    "RunnableCapability",
+    "Status",
+    "SystemCapability",
+    "WithLifecycle",
+    "clear_registrations",
+    "declare_errors",
+    "module",
+]

@@ -1,0 +1,81 @@
+"""Cooperative cancellation (reference: tokio_util::sync::CancellationToken as used in
+libs/modkit/src/bootstrap/run.rs:53-59 — one root token, children per module).
+
+An asyncio-native token: awaitable, supports child tokens (cancelling the parent
+cancels all children, never the reverse), and synchronous callbacks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional
+
+
+class CancellationToken:
+    """Hierarchical cancellation token.
+
+    - ``cancel()`` is idempotent and propagates to children.
+    - ``cancelled()`` returns an awaitable that resolves once cancelled.
+    - ``is_cancelled`` is a cheap synchronous check for hot loops.
+    """
+
+    __slots__ = ("_event", "_children", "_callbacks", "_parent")
+
+    def __init__(self, parent: Optional["CancellationToken"] = None) -> None:
+        self._event = asyncio.Event()
+        self._children: list[CancellationToken] = []
+        self._callbacks: list[Callable[[], None]] = []
+        self._parent = parent
+        if parent is not None:
+            parent._children.append(self)
+            if parent.is_cancelled:
+                self.cancel()
+
+    @property
+    def is_cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def child_token(self) -> "CancellationToken":
+        return CancellationToken(parent=self)
+
+    def cancel(self) -> None:
+        if self._event.is_set():
+            return
+        self._event.set()
+        for cb in self._callbacks:
+            try:
+                cb()
+            except Exception:  # callbacks must never break cancellation fan-out
+                pass
+        for child in self._children:
+            child.cancel()
+
+    def on_cancel(self, cb: Callable[[], None]) -> None:
+        """Register a synchronous callback; fires immediately if already cancelled."""
+        if self.is_cancelled:
+            cb()
+        else:
+            self._callbacks.append(cb)
+
+    async def cancelled(self) -> None:
+        await self._event.wait()
+
+    async def run_until_cancelled(self, coro) -> object | None:
+        """Run ``coro``; if this token fires first, cancel it and return None."""
+        task = asyncio.ensure_future(coro)
+        waiter = asyncio.ensure_future(self._event.wait())
+        try:
+            done, _ = await asyncio.wait(
+                {task, waiter}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if task in done:
+                return task.result()
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+            return None
+        finally:
+            if not waiter.done():
+                waiter.cancel()

@@ -1,0 +1,85 @@
+"""ClientHub — type-safe dependency injection between modules.
+
+Reference: libs/modkit/src/client_hub.rs (TypeKey at :23, `ClientScope::gts_id` at :57,
+scoped maps at :113-120). Modules call each other through hub-resolved trait objects;
+transport (in-process vs out-of-process) is invisible to the caller
+(docs/ARCHITECTURE_MANIFEST.md:130-137).
+
+Python rendition: keys are the *interface class object* (the ABC the client
+implements), optionally qualified by a :class:`ClientScope` — used by the
+gateway+plugins pattern where a plugin instance is keyed by its GTS instance id
+(client_hub.rs:57-62). The hub doubles as the mock seam for tests: "just register a
+mock under the same trait type" (client_hub.rs:16).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional, Type, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclass(frozen=True)
+class ClientScope:
+    """Scope qualifier for plugin clients; `gts_id` matches ClientScope::gts_id."""
+
+    gts_id: str
+
+    @classmethod
+    def for_gts_id(cls, gts_id: str) -> "ClientScope":
+        return cls(gts_id=gts_id)
+
+
+class ClientNotFound(LookupError):
+    def __init__(self, api_type: type, scope: Optional[ClientScope]) -> None:
+        where = f" (scope {scope.gts_id})" if scope else ""
+        super().__init__(
+            f"no client registered for {api_type.__module__}.{api_type.__qualname__}{where}"
+        )
+        self.api_type = api_type
+        self.scope = scope
+
+
+class ClientHub:
+    """Register/fetch ``impl`` objects by interface class, optionally scoped."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._clients: dict[tuple[type, Optional[ClientScope]], object] = {}
+
+    def register(
+        self, api_type: Type[T], impl: T, scope: Optional[ClientScope] = None
+    ) -> None:
+        if not isinstance(impl, api_type):
+            raise TypeError(
+                f"{type(impl).__name__} does not implement {api_type.__name__}"
+            )
+        with self._lock:
+            self._clients[(api_type, scope)] = impl
+
+    def get(self, api_type: Type[T], scope: Optional[ClientScope] = None) -> T:
+        with self._lock:
+            impl = self._clients.get((api_type, scope))
+        if impl is None:
+            raise ClientNotFound(api_type, scope)
+        return impl  # type: ignore[return-value]
+
+    def try_get(self, api_type: Type[T], scope: Optional[ClientScope] = None) -> Optional[T]:
+        with self._lock:
+            return self._clients.get((api_type, scope))  # type: ignore[return-value]
+
+    def contains(self, api_type: type, scope: Optional[ClientScope] = None) -> bool:
+        with self._lock:
+            return (api_type, scope) in self._clients
+
+    def scoped_instances(self, api_type: type) -> dict[str, object]:
+        """All registered scoped impls of ``api_type`` keyed by gts_id — used by
+        plugin selectors (libs/modkit/src/plugins/mod.rs:14-70)."""
+        with self._lock:
+            return {
+                key[1].gts_id: impl
+                for key, impl in self._clients.items()
+                if key[0] is api_type and key[1] is not None
+            }

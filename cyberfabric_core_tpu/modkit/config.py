@@ -1,0 +1,187 @@
+"""Layered configuration: defaults → YAML → ``APP__*`` env → CLI overrides.
+
+Reference: figment layering in libs/modkit/src/bootstrap/config/mod.rs:25-75 and
+apps/hyperspot-server/src/main.rs:70-74. Conventions reproduced:
+
+- global sections (``server``, ``database``, ``logging``, ``tracing``) plus typed
+  per-module sections ``modules.<name>.{config, database, runtime}``;
+- env override paths use double underscores: ``APP__MODULES__api_gateway__CONFIG__BIND_ADDR``
+  (SURVEY §8.6; testing/docker/docker-compose.yml:27-29) — path segments are matched
+  case-insensitively against existing keys;
+- ``${VAR}`` env-var expansion and ``~`` home expansion inside string values;
+- unknown fields inside a module entry are rejected (deny-unknown-fields,
+  bootstrap/config/mod.rs:27).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Mapping, Optional
+
+import yaml
+
+_ENV_PREFIX = "APP__"
+_VAR_RE = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)\}")
+
+#: Allowed keys of a ``modules.<name>`` entry (ModuleConfig in config/mod.rs:25-75).
+_MODULE_ENTRY_KEYS = {"config", "database", "runtime", "enabled"}
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _expand(value: Any) -> Any:
+    if isinstance(value, str):
+        expanded = _VAR_RE.sub(lambda m: os.environ.get(m.group(1), ""), value)
+        if expanded.startswith("~"):
+            expanded = os.path.expanduser(expanded)
+        return expanded
+    if isinstance(value, dict):
+        return {k: _expand(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_expand(v) for v in value]
+    return value
+
+
+def _deep_merge(base: dict, overlay: Mapping) -> dict:
+    out = dict(base)
+    for k, v in overlay.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, Mapping):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v) if isinstance(v, (dict, list)) else v
+    return out
+
+
+def _coerce_env_value(raw: str) -> Any:
+    """YAML-parse env values so ``true``/``8086``/``[a,b]`` become typed."""
+    try:
+        return yaml.safe_load(raw)
+    except yaml.YAMLError:
+        return raw
+
+
+def _apply_env_overrides(tree: dict, environ: Mapping[str, str]) -> dict:
+    out = copy.deepcopy(tree)
+    for name, raw in environ.items():
+        if not name.startswith(_ENV_PREFIX):
+            continue
+        path = name[len(_ENV_PREFIX):].split("__")
+        node = out
+        for i, seg in enumerate(path):
+            # match existing keys case-insensitively, else create lowercase
+            match = next((k for k in node if isinstance(k, str) and k.lower() == seg.lower()), None)
+            key = match if match is not None else seg.lower()
+            if i == len(path) - 1:
+                node[key] = _coerce_env_value(raw)
+            else:
+                nxt = node.get(key)
+                if not isinstance(nxt, dict):
+                    nxt = {}
+                    node[key] = nxt
+                node = nxt
+    return out
+
+
+_DEFAULTS: dict[str, Any] = {
+    "server": {"home_dir": "~/.tpu-fabric"},
+    "database": {},
+    "logging": {"level": "info", "modules": {}},
+    "tracing": {"enabled": False, "exporter": "none", "sample_ratio": 1.0},
+    "modules": {},
+}
+
+
+@dataclass
+class AppConfig:
+    """The merged application config tree plus typed accessors."""
+
+    tree: dict[str, Any] = field(default_factory=lambda: copy.deepcopy(_DEFAULTS))
+    source_path: Optional[Path] = None
+
+    @classmethod
+    def load_or_default(
+        cls,
+        path: Optional[str | Path] = None,
+        *,
+        cli_overrides: Optional[Mapping[str, Any]] = None,
+        environ: Optional[Mapping[str, str]] = None,
+    ) -> "AppConfig":
+        """Layer defaults → YAML file → APP__* env → CLI mapping.
+
+        Reference: AppConfig::load_or_default (apps/hyperspot-server/src/main.rs:73).
+        """
+        tree = copy.deepcopy(_DEFAULTS)
+        src: Optional[Path] = None
+        if path is not None:
+            src = Path(path)
+            if not src.exists():
+                raise ConfigError(f"config file not found: {src}")
+            loaded = yaml.safe_load(src.read_text()) or {}
+            if not isinstance(loaded, dict):
+                raise ConfigError(f"config root must be a mapping: {src}")
+            tree = _deep_merge(tree, loaded)
+        tree = _apply_env_overrides(tree, environ if environ is not None else os.environ)
+        if cli_overrides:
+            tree = _deep_merge(tree, cli_overrides)
+        tree = _expand(tree)
+        cfg = cls(tree=tree, source_path=src)
+        cfg._validate()
+        return cfg
+
+    def _validate(self) -> None:
+        modules = self.tree.get("modules") or {}
+        if not isinstance(modules, dict):
+            raise ConfigError("`modules` must be a mapping")
+        for name, entry in modules.items():
+            if entry is None:
+                continue
+            if not isinstance(entry, dict):
+                raise ConfigError(f"modules.{name} must be a mapping")
+            unknown = set(entry) - _MODULE_ENTRY_KEYS
+            if unknown:
+                raise ConfigError(
+                    f"modules.{name}: unknown fields {sorted(unknown)} "
+                    f"(allowed: {sorted(_MODULE_ENTRY_KEYS)})"
+                )
+
+    # Accessors ---------------------------------------------------------------
+    def section(self, name: str, default: Any = None) -> Any:
+        return self.tree.get(name, default if default is not None else {})
+
+    def module_names(self) -> list[str]:
+        return list((self.tree.get("modules") or {}).keys())
+
+    def module_entry(self, name: str) -> dict[str, Any]:
+        entry = (self.tree.get("modules") or {}).get(name) or {}
+        return entry
+
+    def module_config(self, name: str) -> dict[str, Any]:
+        """The ``modules.<name>.config`` section (ModuleCtx::config, context.rs:238)."""
+        return self.module_entry(name).get("config") or {}
+
+    def module_enabled(self, name: str) -> bool:
+        return bool(self.module_entry(name).get("enabled", True))
+
+    def home_dir(self) -> Path:
+        return Path(os.path.expanduser(self.tree.get("server", {}).get("home_dir", "~/.tpu-fabric")))
+
+    def dump_effective(self, redact: bool = True) -> dict[str, Any]:
+        """Effective-config dump with secret redaction
+        (reference: bootstrap/config/dump.rs; flags main.rs:32-46)."""
+        def scrub(node: Any, key_hint: str = "") -> Any:
+            secretish = any(s in key_hint.lower() for s in ("secret", "token", "password", "key", "credential"))
+            if isinstance(node, dict):
+                return {k: scrub(v, str(k)) for k, v in node.items()}
+            if isinstance(node, list):
+                return [scrub(v, key_hint) for v in node]
+            if redact and secretish and isinstance(node, str) and node:
+                return "***REDACTED***"
+            return node
+
+        return scrub(copy.deepcopy(self.tree))

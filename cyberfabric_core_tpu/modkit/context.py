@@ -1,0 +1,56 @@
+"""ModuleCtx — what every module receives at init.
+
+Reference: libs/modkit/src/context.rs (`module_name` :128, `instance_id` :138,
+`client_hub` :151, `cancellation_token` :157, `db`/`db_required` :181/:202,
+``config::<T>()`` :238 deserializing the module's ``modules.<name>.config`` section).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Optional, Type, TypeVar
+
+from .cancellation import CancellationToken
+from .client_hub import ClientHub
+from .config import AppConfig
+
+if TYPE_CHECKING:
+    from .db import Database
+
+T = TypeVar("T")
+
+
+@dataclass
+class ModuleCtx:
+    module_name: str
+    app_config: AppConfig
+    client_hub: ClientHub
+    cancellation_token: CancellationToken
+    instance_id: str = field(default_factory=lambda: str(uuid.uuid4()))
+    db: Optional["Database"] = None
+    #: host-level shared objects (set for system modules during pre_init)
+    system: dict[str, Any] = field(default_factory=dict)
+
+    def raw_config(self) -> dict[str, Any]:
+        """The module's raw ``modules.<name>.config`` mapping (context.rs:245)."""
+        return self.app_config.module_config(self.module_name)
+
+    def config(self, model: Type[T]) -> T:
+        """Deserialize the module config section into a typed model (context.rs:238).
+
+        ``model`` may be a pydantic BaseModel subclass or a dataclass-like callable
+        accepting keyword arguments. Defaults apply when the section is absent.
+        """
+        raw = self.raw_config()
+        try:
+            if hasattr(model, "model_validate"):  # pydantic v2
+                return model.model_validate(raw)  # type: ignore[attr-defined]
+            return model(**raw)
+        except Exception as e:
+            raise ValueError(f"modules.{self.module_name}.config invalid: {e}") from e
+
+    def db_required(self) -> "Database":
+        if self.db is None:
+            raise RuntimeError(f"module {self.module_name} requires a database but none configured")
+        return self.db

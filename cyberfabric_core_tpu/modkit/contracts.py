@@ -1,0 +1,134 @@
+"""Module contracts — the capability traits modules implement.
+
+Reference: libs/modkit/src/contracts.rs:12-145 (`Module::init`,
+`DatabaseCapability::migrations`, `RestApiCapability::register_rest`,
+`ApiGatewayCapability::{rest_prepare,rest_finalize}`, `RunnableCapability::{start,stop}`,
+`SystemCapability::{pre_init,post_init}`, `GrpcServiceCapability`).
+
+Python rendition: abstract base classes checked structurally by the registry. A module
+class subclasses :class:`Module` and any number of capability mixins; the ``@module``
+decorator (registry.py) records which capabilities are declared and asserts the class
+actually implements them (the moral equivalent of the macro's compile-time assertions,
+libs/modkit-macros/src/lib.rs:516-560).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import TYPE_CHECKING, Any, Sequence
+
+if TYPE_CHECKING:
+    from .context import ModuleCtx
+    from .lifecycle import ReadySignal
+
+
+class Module(abc.ABC):
+    """Base contract: every module wires its services in ``init``.
+
+    Reference: `Module::init` (libs/modkit/src/contracts.rs:37).
+    """
+
+    @abc.abstractmethod
+    async def init(self, ctx: "ModuleCtx") -> None:
+        """Resolve dependencies from the ClientHub, build domain services, register
+        this module's own clients into the hub."""
+
+
+class DatabaseCapability(abc.ABC):
+    """Module owns a database and ships migrations.
+
+    Reference: `DatabaseCapability::migrations` (contracts.rs:58).
+    """
+
+    @abc.abstractmethod
+    def migrations(self) -> Sequence["Migration"]:
+        ...
+
+
+class Migration:
+    """A single versioned migration: ``version`` orders execution, ``apply`` receives a
+    raw sqlite connection (the only sanctioned raw-SQL surface — reference policy
+    libs/modkit-db/src/advisory_locks.rs:6-9)."""
+
+    def __init__(self, version: str, apply) -> None:
+        self.version = version
+        self.apply = apply
+
+
+class RestApiCapability(abc.ABC):
+    """Module contributes REST routes to the (single) gateway host.
+
+    Reference: `RestApiCapability::register_rest` (contracts.rs:74).
+    """
+
+    @abc.abstractmethod
+    def register_rest(self, ctx: "ModuleCtx", router: Any, openapi: Any) -> None:
+        ...
+
+
+class ApiGatewayCapability(abc.ABC):
+    """The REST host itself — exactly one per process (enforced in
+    runtime.py, mirroring host_runtime.rs:369-383).
+
+    Reference: `ApiGatewayCapability::{rest_prepare, rest_finalize}` (contracts.rs:90-101).
+    """
+
+    @abc.abstractmethod
+    def rest_prepare(self, ctx: "ModuleCtx") -> tuple[Any, Any]:
+        """Return ``(router, openapi_registry)`` handed to each RestApiCapability."""
+
+    @abc.abstractmethod
+    def rest_finalize(self, ctx: "ModuleCtx", router: Any, openapi: Any) -> None:
+        """Apply the middleware stack and store the finished router."""
+
+
+class RunnableCapability(abc.ABC):
+    """Module runs background work between start and stop.
+
+    Reference: `RunnableCapability::{start, stop}` (contracts.rs:113-125).
+    """
+
+    @abc.abstractmethod
+    async def start(self, ctx: "ModuleCtx", ready: "ReadySignal") -> None:
+        ...
+
+    @abc.abstractmethod
+    async def stop(self, ctx: "ModuleCtx") -> None:
+        ...
+
+
+class SystemCapability(abc.ABC):
+    """System (control-plane) modules get pre/post init hooks around the normal
+    phases. Reference: `SystemCapability::{pre_init, post_init}` (contracts.rs:132-145).
+    """
+
+    async def pre_init(self, ctx: "ModuleCtx") -> None:  # noqa: B027
+        pass
+
+    async def post_init(self, ctx: "ModuleCtx") -> None:  # noqa: B027
+        pass
+
+
+class GrpcServiceCapability(abc.ABC):
+    """Module exposes a gRPC service hosted by the grpc-hub.
+
+    Reference: `GrpcServiceCapability` (contracts.rs:105-111); collected into a
+    GrpcInstallerStore during `run_grpc_phase` (host_runtime.rs:449-516).
+    """
+
+    @abc.abstractmethod
+    def register_grpc(self, ctx: "ModuleCtx", server: Any) -> None:
+        ...
+
+
+#: Capability tag names accepted by the ``@module(capabilities=[...])`` decorator —
+#: mirrors the macro's Capability enum {db, rest, rest_host, stateful, system, grpc}
+#: (libs/modkit-macros/src/lib.rs:28-47).
+CAPABILITY_CLASSES: dict[str, type] = {
+    "db": DatabaseCapability,
+    "rest": RestApiCapability,
+    "rest_host": ApiGatewayCapability,
+    "stateful": RunnableCapability,
+    "system": SystemCapability,
+    "grpc": GrpcServiceCapability,
+}

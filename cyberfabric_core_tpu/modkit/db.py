@@ -1,0 +1,444 @@
+"""Database layer: per-module isolated stores, migration runner, and the secure ORM.
+
+Reference: libs/modkit-db/src/ — `DbManager::from_figment` (manager.rs: per-module
+isolated connections derived from global server templates), migration runner
+(migration_runner.rs), and the **secure ORM**: `SecureConn`/`SecureTx`
+(secure/secure_conn.rs:1-70) which refuses unscoped queries by construction — there is
+no raw-connection accessor; every query is automatically constrained by the caller's
+tenant scope. Entities opt in via ScopableEntity with four dimension columns
+(secure/entity_traits.rs:99-150). Migrations are the only sanctioned raw-SQL surface
+(advisory_locks.rs:6-9).
+
+Backend: sqlite3 (stdlib) with WAL + pragmas tuned per sqlite/pragmas.rs. The
+reference's PG/MySQL matrix is out of scope for a single-process TPU host; the
+Database API is backend-neutral so another engine can slot in.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import uuid
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Optional, Sequence
+
+from .contracts import Migration
+from .odata import (
+    ODataError,
+    OrderField,
+    Page,
+    PageInfo,
+    clamp_limit,
+    decode_cursor,
+    encode_cursor,
+    parse_filter,
+    parse_orderby,
+    short_filter_hash,
+    to_sql,
+)
+from .security import AccessScope, Dimension, SecurityContext
+
+
+class ScopeViolation(PermissionError):
+    """Raised when a query/mutation would escape the caller's access scope."""
+
+
+@dataclass(frozen=True)
+class ScopableEntity:
+    """Declarative table description with the four scoping dimension columns
+    (entity_traits.rs:99-150: tenant_col, resource_col, owner_col, type_col;
+    `#[secure(unrestricted)]` → ``unrestricted=True`` exempts global tables).
+
+    ``field_map`` maps exposed (OData) field names → column names and doubles as the
+    column allowlist (`resolve_property`).
+    """
+
+    table: str
+    field_map: dict[str, str]
+    primary_key: str = "id"
+    tenant_col: Optional[str] = "tenant_id"
+    resource_col: Optional[str] = None
+    owner_col: Optional[str] = None
+    type_col: Optional[str] = None
+    unrestricted: bool = False
+    json_cols: tuple[str, ...] = ()
+
+    def dimension_col(self, dim: Dimension) -> Optional[str]:
+        return {
+            Dimension.TENANT: self.tenant_col,
+            Dimension.RESOURCE: self.resource_col,
+            Dimension.OWNER: self.owner_col,
+            Dimension.TYPE: self.type_col,
+        }[dim]
+
+
+class Database:
+    """One isolated store (per module). Thread-safe via a single lock — the TPU host
+    is asyncio-single-threaded; sqlite serializes anyway."""
+
+    def __init__(self, path: str | Path) -> None:
+        self._path = str(path)
+        # autocommit mode; transactions are managed explicitly (BEGIN/COMMIT) so that
+        # DDL inside migrations is actually transactional (sqlite3's legacy implicit
+        # transactions auto-commit DDL, which would break migration rollback)
+        self._conn = sqlite3.connect(self._path, check_same_thread=False, isolation_level=None)
+        self._conn.row_factory = sqlite3.Row
+        self._lock = threading.RLock()
+        with self._lock:
+            cur = self._conn.cursor()
+            # sqlite/pragmas.rs parity: WAL for concurrent readers, NORMAL sync
+            if self._path != ":memory:":
+                cur.execute("PRAGMA journal_mode=WAL")
+            cur.execute("PRAGMA synchronous=NORMAL")
+            cur.execute("PRAGMA foreign_keys=ON")
+            self._conn.commit()
+
+    # ------------------------------------------------------------------ migrations
+    def run_migrations(self, migrations: Sequence[Migration]) -> int:
+        """Apply pending migrations in version order inside a transaction; records
+        them in ``_schema_migrations`` (migration_runner.rs)."""
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(
+                "CREATE TABLE IF NOT EXISTS _schema_migrations ("
+                "version TEXT PRIMARY KEY, applied_at TEXT NOT NULL DEFAULT (datetime('now')))"
+            )
+            applied = {r["version"] for r in cur.execute("SELECT version FROM _schema_migrations")}
+            count = 0
+            for mig in sorted(migrations, key=lambda m: m.version):
+                if mig.version in applied:
+                    continue
+                cur.execute("BEGIN")
+                try:
+                    mig.apply(self._conn)
+                    cur.execute("INSERT INTO _schema_migrations(version) VALUES (?)", (mig.version,))
+                    cur.execute("COMMIT")
+                    count += 1
+                except Exception:
+                    cur.execute("ROLLBACK")
+                    raise
+            return count
+
+    def applied_migrations(self) -> list[str]:
+        with self._lock:
+            try:
+                rows = self._conn.execute(
+                    "SELECT version FROM _schema_migrations ORDER BY version"
+                ).fetchall()
+            except sqlite3.OperationalError:
+                return []
+            return [r["version"] for r in rows]
+
+    # ------------------------------------------------------------------ secure access
+    def secure(self, ctx: SecurityContext, entity: ScopableEntity) -> "SecureConn":
+        """The only query surface — scoped by construction (secure_conn.rs:5-12)."""
+        return SecureConn(self, ctx, entity)
+
+    def raw_for_migrations(self) -> sqlite3.Connection:
+        """Escape hatch for migration authors ONLY (advisory_locks.rs:6-9)."""
+        return self._conn
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+class SecureConn:
+    """Tenant-scoped query interface for one entity.
+
+    Every SELECT/UPDATE/DELETE gets the caller's scope predicates appended; INSERTs
+    are checked field-wise against the scope. Mirrors SecureConn auto-applying
+    ScopeFilters as SQL WHERE clauses (secure/secure_conn.rs, pep/enforcer.rs).
+    """
+
+    def __init__(self, db: Database, ctx: SecurityContext, entity: ScopableEntity) -> None:
+        self._db = db
+        self._ctx = ctx
+        self._entity = entity
+
+    # ------------------------------------------------------------------ scope SQL
+    def _scope_clause(self) -> tuple[str, list[Any]]:
+        ent, scope = self._entity, self._effective_scope()
+        if ent.unrestricted or scope.unrestricted:
+            return "1=1", []
+        clauses: list[str] = []
+        params: list[Any] = []
+        for f in scope.filters:
+            col = ent.dimension_col(f.dimension)
+            if col is None:
+                continue  # entity doesn't model this dimension
+            if not f.values:
+                return "0=1", []  # deny-all
+            clauses.append(f"{col} IN ({','.join('?' for _ in f.values)})")
+            params.extend(f.values)
+        if not clauses:
+            # An entity with a tenant column but a scope that constrains nothing
+            # must still be tenant-scoped — refuse rather than leak.
+            if ent.tenant_col is not None:
+                raise ScopeViolation(
+                    f"scope for {ent.table} has no applicable filters; refusing unscoped query"
+                )
+            return "1=1", []
+        return " AND ".join(clauses), params
+
+    def _effective_scope(self) -> AccessScope:
+        return self._ctx.effective_scope()
+
+    def _check_insert_scope(self, values: dict[str, Any]) -> None:
+        ent, scope = self._entity, self._effective_scope()
+        if ent.unrestricted or scope.unrestricted:
+            return
+        for f in scope.filters:
+            col = ent.dimension_col(f.dimension)
+            if col is None:
+                continue
+            if col in values and not f.allows(str(values[col])):
+                raise ScopeViolation(
+                    f"insert into {ent.table}: {col}={values[col]!r} outside caller scope"
+                )
+            if col not in values and f.dimension == Dimension.TENANT:
+                # default the tenant column from the caller — never trust omission
+                values[col] = self._ctx.tenant_id
+
+    def _check_columns(self, cols: Any) -> None:
+        """Column-name allowlist — field_map values are the only legal columns
+        (ScopableEntity.resolve_property semantics); guards every query surface,
+        not just select()."""
+        allowed = set(self._entity.field_map.values())
+        bad = [c for c in cols if c not in allowed]
+        if bad:
+            raise ODataError(f"unknown column(s) {bad!r} for {self._entity.table}")
+
+    # ------------------------------------------------------------------ serialization
+    def _encode(self, values: dict[str, Any]) -> dict[str, Any]:
+        out = {}
+        for k, v in values.items():
+            if k in self._entity.json_cols and v is not None:
+                out[k] = json.dumps(v, separators=(",", ":"))
+            elif isinstance(v, bool):
+                out[k] = int(v)
+            else:
+                out[k] = v
+        return out
+
+    def _decode(self, row: sqlite3.Row) -> dict[str, Any]:
+        out = dict(row)
+        for k in self._entity.json_cols:
+            if out.get(k) is not None:
+                try:
+                    out[k] = json.loads(out[k])
+                except (TypeError, json.JSONDecodeError):
+                    pass
+        return out
+
+    # ------------------------------------------------------------------ CRUD
+    def insert(self, values: dict[str, Any]) -> dict[str, Any]:
+        values = dict(values)
+        ent = self._entity
+        if ent.primary_key not in values:
+            values[ent.primary_key] = str(uuid.uuid4())
+        self._check_insert_scope(values)
+        self._check_columns(values)
+        enc = self._encode(values)
+        cols = ", ".join(enc)
+        marks = ", ".join("?" for _ in enc)
+        with self._db._lock:
+            self._db._conn.execute(
+                f"INSERT INTO {ent.table} ({cols}) VALUES ({marks})", list(enc.values())
+            )
+            self._db._conn.commit()
+        return values
+
+    def get(self, pk: Any) -> Optional[dict[str, Any]]:
+        ent = self._entity
+        scope_sql, scope_params = self._scope_clause()
+        with self._db._lock:
+            row = self._db._conn.execute(
+                f"SELECT * FROM {ent.table} WHERE {ent.primary_key} = ? AND {scope_sql}",
+                [pk, *scope_params],
+            ).fetchone()
+        return self._decode(row) if row else None
+
+    def find_one(self, where: dict[str, Any]) -> Optional[dict[str, Any]]:
+        rows = self.select(where=where, limit=1)
+        return rows[0] if rows else None
+
+    def select(
+        self,
+        where: Optional[dict[str, Any]] = None,
+        order_by: Optional[str] = None,
+        limit: Optional[int] = None,
+        descending: bool = False,
+    ) -> list[dict[str, Any]]:
+        ent = self._entity
+        scope_sql, params = self._scope_clause()
+        sql = f"SELECT * FROM {ent.table} WHERE {scope_sql}"
+        for col, val in (where or {}).items():
+            if col not in ent.field_map.values():
+                raise ODataError(f"unknown column {col!r}")
+            if val is None:
+                sql += f" AND {col} IS NULL"
+            else:
+                sql += f" AND {col} = ?"
+                params.append(int(val) if isinstance(val, bool) else val)
+        if order_by:
+            if order_by not in ent.field_map.values():
+                raise ODataError(f"unknown column {order_by!r}")
+            sql += f" ORDER BY {order_by} {'DESC' if descending else 'ASC'}"
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        with self._db._lock:
+            rows = self._db._conn.execute(sql, params).fetchall()
+        return [self._decode(r) for r in rows]
+
+    def update(self, pk: Any, changes: dict[str, Any]) -> bool:
+        ent = self._entity
+        if not changes:
+            return False
+        self._check_columns(changes)
+        for f in self._effective_scope().filters:
+            col = ent.dimension_col(f.dimension)
+            if col and col in changes and not f.allows(str(changes[col])):
+                raise ScopeViolation(f"update would move row outside caller scope ({col})")
+        enc = self._encode(dict(changes))
+        sets = ", ".join(f"{c} = ?" for c in enc)
+        scope_sql, scope_params = self._scope_clause()
+        with self._db._lock:
+            cur = self._db._conn.execute(
+                f"UPDATE {ent.table} SET {sets} WHERE {ent.primary_key} = ? AND {scope_sql}",
+                [*enc.values(), pk, *scope_params],
+            )
+            self._db._conn.commit()
+        return cur.rowcount > 0
+
+    def delete(self, pk: Any) -> bool:
+        ent = self._entity
+        scope_sql, scope_params = self._scope_clause()
+        with self._db._lock:
+            cur = self._db._conn.execute(
+                f"DELETE FROM {ent.table} WHERE {ent.primary_key} = ? AND {scope_sql}",
+                [pk, *scope_params],
+            )
+            self._db._conn.commit()
+        return cur.rowcount > 0
+
+    def count(self, where: Optional[dict[str, Any]] = None) -> int:
+        ent = self._entity
+        self._check_columns(where or {})
+        scope_sql, params = self._scope_clause()
+        sql = f"SELECT COUNT(*) AS n FROM {ent.table} WHERE {scope_sql}"
+        for col, val in (where or {}).items():
+            sql += f" AND {col} = ?"
+            params.append(val)
+        with self._db._lock:
+            return self._db._conn.execute(sql, params).fetchone()["n"]
+
+    # ------------------------------------------------------------------ OData listing
+    def list_odata(
+        self,
+        filter_text: Optional[str] = None,
+        orderby_text: Optional[str] = None,
+        limit: Optional[int] = None,
+        cursor: Optional[str] = None,
+    ) -> Page:
+        """Cursor-paginated OData listing (odata/pager.rs + modkit-sdk/src/pager.rs).
+
+        Keyset pagination over (orderby columns..., primary key) with the cursor bound
+        to a filter hash.
+        """
+        ent = self._entity
+        lim = clamp_limit(limit)
+        scope_sql, params = self._scope_clause()
+        where_parts = [scope_sql]
+
+        if filter_text:
+            ast = parse_filter(filter_text)
+            fsql, fparams = to_sql(ast, ent.field_map)
+            where_parts.append(fsql)
+            params.extend(fparams)
+
+        order: tuple[OrderField, ...]
+        if orderby_text:
+            order = parse_orderby(orderby_text)
+            for of in order:
+                if of.field not in ent.field_map:
+                    raise ODataError(f"unknown orderby field {of.field!r}")
+        else:
+            order = ()
+        # stable tiebreaker: primary key always last
+        order_cols = [(ent.field_map[of.field], of.descending) for of in order]
+        order_cols.append((ent.primary_key, False))
+
+        fhash = short_filter_hash(filter_text, orderby_text)
+        if cursor:
+            key_vals = decode_cursor(cursor, fhash)
+            if len(key_vals) != len(order_cols):
+                raise ODataError("cursor key arity mismatch")
+            # row-value comparison for keyset pagination (mixed asc/desc → expand)
+            conds, cparams = _keyset_predicate(order_cols, key_vals)
+            where_parts.append(conds)
+            params.extend(cparams)
+
+        order_sql = ", ".join(f"{c} {'DESC' if d else 'ASC'}" for c, d in order_cols)
+        sql = (
+            f"SELECT * FROM {ent.table} WHERE {' AND '.join(where_parts)} "
+            f"ORDER BY {order_sql} LIMIT {lim + 1}"
+        )
+        with self._db._lock:
+            rows = self._db._conn.execute(sql, params).fetchall()
+        items = [self._decode(r) for r in rows[:lim]]
+        has_more = len(rows) > lim
+        next_cursor = None
+        if has_more and items:
+            last = rows[lim - 1]
+            next_cursor = encode_cursor([last[c] for c, _ in order_cols], fhash)
+        return Page(items=items, page_info=PageInfo(next_cursor=next_cursor, limit=lim))
+
+
+def _keyset_predicate(order_cols: list[tuple[str, bool]], key_vals: list[Any]) -> tuple[str, list[Any]]:
+    """(a,b,c) > (x,y,z) expanded for mixed asc/desc ordering."""
+    clauses: list[str] = []
+    params: list[Any] = []
+    for i in range(len(order_cols)):
+        ands: list[str] = []
+        for j in range(i):
+            ands.append(f"{order_cols[j][0]} = ?")
+            params.append(key_vals[j])
+        col, desc = order_cols[i]
+        ands.append(f"{col} {'<' if desc else '>'} ?")
+        params.append(key_vals[i])
+        clauses.append("(" + " AND ".join(ands) + ")")
+    return "(" + " OR ".join(clauses) + ")", params
+
+
+class DbManager:
+    """Per-module isolated databases under ``<home_dir>/db/<module>.sqlite``
+    (manager.rs: per-module isolation policy). ``:memory:`` for tests/--mock."""
+
+    def __init__(self, home_dir: Optional[Path] = None, in_memory: bool = False) -> None:
+        self._home = home_dir
+        self._in_memory = in_memory or home_dir is None
+        self._dbs: dict[str, Database] = {}
+        self._lock = threading.Lock()
+
+    def db_for_module(self, module_name: str) -> Database:
+        with self._lock:
+            db = self._dbs.get(module_name)
+            if db is None:
+                if self._in_memory:
+                    db = Database(":memory:")
+                else:
+                    assert self._home is not None
+                    dbdir = self._home / "db"
+                    dbdir.mkdir(parents=True, exist_ok=True)
+                    db = Database(dbdir / f"{module_name}.sqlite")
+                self._dbs[module_name] = db
+            return db
+
+    def close_all(self) -> None:
+        with self._lock:
+            for db in self._dbs.values():
+                db.close()
+            self._dbs.clear()

@@ -1,0 +1,129 @@
+"""Problem — RFC-9457 error responses + compile-time-ish error catalogs.
+
+Reference: libs/modkit-errors/src/problem.rs (Problem type),
+libs/modkit-errors-macro/src/lib.rs:11-17 (`declare_errors!` builds typed error-code
+enums from JSON catalogs), libs/modkit/src/api/problem.rs:1-98 and
+api/error_layer.rs (error-mapping middleware). Wire convention per the serverless ADR
+(ADR_DOMAIN_MODEL_AND_APIS.md:2536-2556): `application/problem+json` with ``type`` =
+GTS error id, ``code``, ``trace_id``, optional ``errors[]`` field list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class Problem:
+    """An RFC-9457 problem document."""
+
+    status: int
+    title: str
+    code: str = "internal_error"
+    type: str = "about:blank"
+    detail: Optional[str] = None
+    instance: Optional[str] = None
+    trace_id: Optional[str] = None
+    errors: list[dict[str, Any]] = field(default_factory=list)
+    extensions: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        doc: dict[str, Any] = {
+            "type": self.type,
+            "status": self.status,
+            "title": self.title,
+            "code": self.code,
+        }
+        if self.detail is not None:
+            doc["detail"] = self.detail
+        if self.instance is not None:
+            doc["instance"] = self.instance
+        if self.trace_id is not None:
+            doc["trace_id"] = self.trace_id
+        if self.errors:
+            doc["errors"] = self.errors
+        doc.update(self.extensions)
+        return doc
+
+    CONTENT_TYPE = "application/problem+json"
+
+
+class ProblemError(Exception):
+    """Raise anywhere below the gateway; the error-mapping middleware renders it."""
+
+    def __init__(self, problem: Problem) -> None:
+        super().__init__(f"{problem.status} {problem.code}: {problem.title}")
+        self.problem = problem
+
+    # Convenience constructors for the common cases -------------------------------
+    @classmethod
+    def bad_request(cls, detail: str, code: str = "bad_request") -> "ProblemError":
+        return cls(Problem(status=400, title="Bad Request", code=code, detail=detail))
+
+    @classmethod
+    def unauthorized(cls, detail: str = "authentication required") -> "ProblemError":
+        return cls(Problem(status=401, title="Unauthorized", code="unauthorized", detail=detail))
+
+    @classmethod
+    def forbidden(cls, detail: str = "access denied") -> "ProblemError":
+        return cls(Problem(status=403, title="Forbidden", code="forbidden", detail=detail))
+
+    @classmethod
+    def not_found(cls, detail: str, code: str = "not_found") -> "ProblemError":
+        return cls(Problem(status=404, title="Not Found", code=code, detail=detail))
+
+    @classmethod
+    def conflict(cls, detail: str, code: str = "conflict") -> "ProblemError":
+        return cls(Problem(status=409, title="Conflict", code=code, detail=detail))
+
+    @classmethod
+    def unprocessable(cls, detail: str, errors: list[dict[str, Any]] | None = None,
+                      code: str = "validation_failed") -> "ProblemError":
+        return cls(Problem(status=422, title="Unprocessable Entity", code=code,
+                           detail=detail, errors=errors or []))
+
+    @classmethod
+    def too_many_requests(cls, detail: str = "rate limit exceeded") -> "ProblemError":
+        return cls(Problem(status=429, title="Too Many Requests",
+                           code="rate_limited", detail=detail))
+
+    @classmethod
+    def service_unavailable(cls, detail: str, code: str = "unavailable") -> "ProblemError":
+        return cls(Problem(status=503, title="Service Unavailable", code=code, detail=detail))
+
+    @classmethod
+    def internal(cls, detail: str = "internal error") -> "ProblemError":
+        return cls(Problem(status=500, title="Internal Server Error",
+                           code="internal_error", detail=detail))
+
+
+class ErrorCatalog:
+    """A named set of error codes → Problem factories, built by :func:`declare_errors`.
+
+    Each entry: ``code -> {status, title, gts_type}``. Calling ``catalog.raise_(code,
+    detail=...)`` raises the mapped ProblemError; ``catalog.problem(code)`` returns the
+    Problem. Mirrors the JSON-catalog → typed-enum generation of declare_errors!.
+    """
+
+    def __init__(self, namespace: str, entries: dict[str, dict[str, Any]]) -> None:
+        self.namespace = namespace
+        self.entries = entries
+
+    def problem(self, code: str, detail: Optional[str] = None, **ext: Any) -> Problem:
+        spec = self.entries[code]
+        return Problem(
+            status=spec["status"],
+            title=spec["title"],
+            code=code,
+            type=spec.get("gts_type", f"gts://gts.x.{self.namespace}.err.{code}.v1~"),
+            detail=detail,
+            extensions=ext,
+        )
+
+    def error(self, code: str, detail: Optional[str] = None, **ext: Any) -> ProblemError:
+        return ProblemError(self.problem(code, detail, **ext))
+
+
+def declare_errors(namespace: str, entries: dict[str, dict[str, Any]]) -> ErrorCatalog:
+    return ErrorCatalog(namespace, entries)

@@ -1,0 +1,126 @@
+"""Background-task lifecycle with ready signaling and an atomic status machine.
+
+Reference: libs/modkit/src/lifecycle.rs (Status {Stopped,Starting,Running,Stopping} at
+:32-38, `WithLifecycle`, `ReadySignal`, `Runnable`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+from typing import Awaitable, Callable, Optional
+
+from .cancellation import CancellationToken
+
+logger = logging.getLogger(__name__)
+
+
+class Status(enum.Enum):
+    STOPPED = "stopped"
+    STARTING = "starting"
+    RUNNING = "running"
+    STOPPING = "stopping"
+    FAILED = "failed"
+
+
+class ReadySignal:
+    """One-shot signal a runnable fires once it is serving (e.g. socket bound)."""
+
+    def __init__(self) -> None:
+        self._event = asyncio.Event()
+        self._error: Optional[BaseException] = None
+
+    def notify_ready(self) -> None:
+        self._event.set()
+
+    def notify_failed(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    async def wait(self, timeout: Optional[float] = None) -> None:
+        await asyncio.wait_for(self._event.wait(), timeout)
+        if self._error is not None:
+            raise self._error
+
+    @property
+    def is_ready(self) -> bool:
+        return self._event.is_set() and self._error is None
+
+
+RunFn = Callable[[CancellationToken, ReadySignal], Awaitable[None]]
+
+
+class WithLifecycle:
+    """Wrap an async ``run(cancel, ready)`` function into a start/stop lifecycle.
+
+    `start` spawns the task and waits for the ready signal; `stop` cancels the child
+    token and awaits task exit with a grace period (mirroring WithLifecycle in
+    lifecycle.rs and the macro's `lifecycle(entry = ...)` wiring,
+    libs/modkit-macros/src/lib.rs:480+).
+    """
+
+    def __init__(self, name: str, run_fn: RunFn, *, ready_timeout: float = 30.0,
+                 stop_grace: float = 10.0) -> None:
+        self.name = name
+        self._run_fn = run_fn
+        self._ready_timeout = ready_timeout
+        self._stop_grace = stop_grace
+        self._status = Status.STOPPED
+        self._task: Optional[asyncio.Task] = None
+        self._token: Optional[CancellationToken] = None
+
+    @property
+    def status(self) -> Status:
+        return self._status
+
+    async def start(self, parent_token: CancellationToken) -> None:
+        if self._status not in (Status.STOPPED, Status.FAILED):
+            raise RuntimeError(f"{self.name}: start() while {self._status}")
+        self._status = Status.STARTING
+        self._token = parent_token.child_token()
+        ready = ReadySignal()
+
+        async def runner() -> None:
+            try:
+                await self._run_fn(self._token, ready)
+                self._status = Status.STOPPED
+                # a run_fn that returns cleanly without signaling counts as ready:
+                # short one-shot jobs must not hang start() for the full timeout
+                ready.notify_ready()
+            except asyncio.CancelledError:
+                self._status = Status.STOPPED
+                raise
+            except BaseException as e:  # noqa: BLE001
+                logger.exception("%s: lifecycle task failed", self.name)
+                self._status = Status.FAILED
+                ready.notify_failed(e)
+
+        self._task = asyncio.ensure_future(runner())
+        try:
+            await ready.wait(self._ready_timeout)
+        except asyncio.TimeoutError:
+            self._status = Status.FAILED
+            self._token.cancel()
+            raise RuntimeError(f"{self.name}: not ready within {self._ready_timeout}s")
+        self._status = Status.RUNNING
+
+    async def stop(self) -> None:
+        if self._task is None:
+            self._status = Status.STOPPED
+            return
+        self._status = Status.STOPPING
+        assert self._token is not None
+        self._token.cancel()
+        try:
+            await asyncio.wait_for(asyncio.shield(self._task), self._stop_grace)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        except Exception:
+            pass
+        self._status = Status.STOPPED
+        self._task = None

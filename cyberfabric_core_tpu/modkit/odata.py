@@ -1,0 +1,322 @@
+"""OData ``$filter`` / ``$orderby`` subset: AST, parser, SQL translation, cursors.
+
+Reference: libs/modkit-odata/src/ (ast::Expr lib.rs:17-60, QueryBuilder builder.rs,
+`short_filter_hash` pagination.rs, Page/PageInfo page.rs:5-16). Supported operators per
+the platform convention (serverless ADR:2558-2577): eq, ne, lt, le, gt, ge, in, and,
+or, not; parentheses; string/number/bool/null literals. Limit default 25, max 200.
+
+Cursor pagination: opaque base64 cursors binding (last-seen key values, order spec,
+filter hash) so a cursor is invalidated when the filter changes.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+DEFAULT_LIMIT = 25
+MAX_LIMIT = 200
+
+
+class ODataError(ValueError):
+    pass
+
+
+# ----------------------------------------------------------------------------- AST
+@dataclass(frozen=True)
+class Comparison:
+    field: str
+    op: str  # eq ne lt le gt ge
+    value: Any
+
+
+@dataclass(frozen=True)
+class InList:
+    field: str
+    values: tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class And:
+    left: Any
+    right: Any
+
+
+@dataclass(frozen=True)
+class Or:
+    left: Any
+    right: Any
+
+
+@dataclass(frozen=True)
+class Not:
+    inner: Any
+
+
+# ----------------------------------------------------------------------------- lexer
+_TOKEN_RE = re.compile(
+    r"""\s*(?:
+        (?P<lparen>\()|(?P<rparen>\))|(?P<comma>,)|
+        (?P<string>'(?:[^']|'')*')|
+        (?P<number>-?\d+(?:\.\d+)?)|
+        (?P<word>[A-Za-z_][A-Za-z0-9_./]*)
+    )""",
+    re.VERBOSE,
+)
+
+_KEYWORDS = {"and", "or", "not", "in", "eq", "ne", "lt", "le", "gt", "ge", "true", "false", "null"}
+
+
+def _lex(text: str) -> list[tuple[str, Any]]:
+    tokens: list[tuple[str, Any]] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if not m or m.end() == pos:
+            if text[pos:].strip() == "":
+                break
+            raise ODataError(f"unexpected character at {pos}: {text[pos:pos+10]!r}")
+        pos = m.end()
+        if m.lastgroup == "string":
+            raw = m.group("string")[1:-1].replace("''", "'")
+            tokens.append(("lit", raw))
+        elif m.lastgroup == "number":
+            raw = m.group("number")
+            tokens.append(("lit", float(raw) if "." in raw else int(raw)))
+        elif m.lastgroup == "word":
+            w = m.group("word")
+            lw = w.lower()
+            if lw in ("true", "false"):
+                tokens.append(("lit", lw == "true"))
+            elif lw == "null":
+                tokens.append(("lit", None))
+            elif lw in _KEYWORDS:
+                tokens.append((lw, w))
+            else:
+                tokens.append(("ident", w))
+        else:
+            tokens.append((m.lastgroup, m.group()))  # type: ignore[arg-type]
+    return tokens
+
+
+class _Parser:
+    """Recursive descent: or_expr → and_expr → unary → primary."""
+
+    def __init__(self, tokens: list[tuple[str, Any]]) -> None:
+        self.tokens = tokens
+        self.i = 0
+
+    def peek(self) -> Optional[tuple[str, Any]]:
+        return self.tokens[self.i] if self.i < len(self.tokens) else None
+
+    def next(self) -> tuple[str, Any]:
+        tok = self.peek()
+        if tok is None:
+            raise ODataError("unexpected end of filter")
+        self.i += 1
+        return tok
+
+    def expect(self, kind: str) -> tuple[str, Any]:
+        tok = self.next()
+        if tok[0] != kind:
+            raise ODataError(f"expected {kind}, got {tok[1]!r}")
+        return tok
+
+    def parse(self) -> Any:
+        expr = self.or_expr()
+        if self.peek() is not None:
+            raise ODataError(f"trailing tokens after expression: {self.peek()[1]!r}")
+        return expr
+
+    def or_expr(self) -> Any:
+        left = self.and_expr()
+        while self.peek() and self.peek()[0] == "or":
+            self.next()
+            left = Or(left, self.and_expr())
+        return left
+
+    def and_expr(self) -> Any:
+        left = self.unary()
+        while self.peek() and self.peek()[0] == "and":
+            self.next()
+            left = And(left, self.unary())
+        return left
+
+    def unary(self) -> Any:
+        tok = self.peek()
+        if tok and tok[0] == "not":
+            self.next()
+            return Not(self.unary())
+        return self.primary()
+
+    def primary(self) -> Any:
+        tok = self.next()
+        if tok[0] == "lparen":
+            inner = self.or_expr()
+            self.expect("rparen")
+            return inner
+        if tok[0] != "ident":
+            raise ODataError(f"expected field name, got {tok[1]!r}")
+        fieldname = tok[1]
+        op_tok = self.next()
+        if op_tok[0] == "in":
+            self.expect("lparen")
+            values: list[Any] = []
+            while True:
+                lit = self.next()
+                if lit[0] != "lit":
+                    raise ODataError(f"expected literal in in-list, got {lit[1]!r}")
+                values.append(lit[1])
+                sep = self.next()
+                if sep[0] == "rparen":
+                    break
+                if sep[0] != "comma":
+                    raise ODataError(f"expected ',' or ')', got {sep[1]!r}")
+            return InList(fieldname, tuple(values))
+        if op_tok[0] not in ("eq", "ne", "lt", "le", "gt", "ge"):
+            raise ODataError(f"unknown operator {op_tok[1]!r}")
+        lit = self.next()
+        if lit[0] != "lit":
+            raise ODataError(f"expected literal, got {lit[1]!r}")
+        return Comparison(fieldname, op_tok[0], lit[1])
+
+
+def parse_filter(text: str) -> Any:
+    """Parse a ``$filter`` expression into the AST, or raise ODataError."""
+    if not text or not text.strip():
+        raise ODataError("empty filter")
+    return _Parser(_lex(text)).parse()
+
+
+# ----------------------------------------------------------------------------- orderby
+@dataclass(frozen=True)
+class OrderField:
+    field: str
+    descending: bool = False
+
+
+def parse_orderby(text: str) -> tuple[OrderField, ...]:
+    out: list[OrderField] = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        pieces = part.split()
+        if len(pieces) > 2 or (len(pieces) == 2 and pieces[1].lower() not in ("asc", "desc")):
+            raise ODataError(f"bad orderby term: {part!r}")
+        if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", pieces[0]):
+            raise ODataError(f"bad orderby field: {pieces[0]!r}")
+        out.append(OrderField(pieces[0], len(pieces) == 2 and pieces[1].lower() == "desc"))
+    if not out:
+        raise ODataError("empty orderby")
+    return tuple(out)
+
+
+# ----------------------------------------------------------------------------- SQL
+_SQL_OPS = {"eq": "=", "ne": "!=", "lt": "<", "le": "<=", "gt": ">", "ge": ">="}
+
+
+def to_sql(expr: Any, field_map: dict[str, str]) -> tuple[str, list[Any]]:
+    """Translate the AST to a parameterized SQL predicate.
+
+    ``field_map`` maps exposed field names → column names (the schema/field mapping
+    layer of modkit-odata); unknown fields are rejected — this is the injection guard.
+    """
+
+    def col(name: str) -> str:
+        if name not in field_map:
+            raise ODataError(f"unknown field: {name!r}")
+        return field_map[name]
+
+    params: list[Any] = []
+
+    def walk(node: Any) -> str:
+        if isinstance(node, Comparison):
+            if node.value is None:
+                if node.op == "eq":
+                    return f"{col(node.field)} IS NULL"
+                if node.op == "ne":
+                    return f"{col(node.field)} IS NOT NULL"
+                raise ODataError("null only supports eq/ne")
+            params.append(node.value)
+            return f"{col(node.field)} {_SQL_OPS[node.op]} ?"
+        if isinstance(node, InList):
+            if not node.values:
+                return "0=1"
+            params.extend(node.values)
+            marks = ",".join("?" for _ in node.values)
+            return f"{col(node.field)} IN ({marks})"
+        if isinstance(node, And):
+            return f"({walk(node.left)} AND {walk(node.right)})"
+        if isinstance(node, Or):
+            return f"({walk(node.left)} OR {walk(node.right)})"
+        if isinstance(node, Not):
+            return f"(NOT {walk(node.inner)})"
+        raise ODataError(f"bad AST node: {node!r}")
+
+    return walk(expr), params
+
+
+# ----------------------------------------------------------------------------- cursors
+def short_filter_hash(filter_text: Optional[str], orderby_text: Optional[str]) -> str:
+    """Stable short hash binding a cursor to its filter+order
+    (modkit-odata/src/pagination.rs)."""
+    h = hashlib.sha256()
+    h.update((filter_text or "").encode())
+    h.update(b"\x00")
+    h.update((orderby_text or "").encode())
+    return h.hexdigest()[:12]
+
+
+@dataclass
+class PageInfo:
+    next_cursor: Optional[str] = None
+    #: reserved for backward paging (wire parity with Page<T>, page.rs:5-16);
+    #: always None until backward keyset predicates are implemented
+    prev_cursor: Optional[str] = None
+    limit: int = DEFAULT_LIMIT
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"next_cursor": self.next_cursor, "prev_cursor": self.prev_cursor,
+                "limit": self.limit}
+
+
+@dataclass
+class Page:
+    """`Page<T>` (libs/modkit-odata/src/page.rs:5-16)."""
+
+    items: list[Any]
+    page_info: PageInfo = field(default_factory=PageInfo)
+
+    def to_dict(self) -> dict[str, Any]:
+        items = [it.to_dict() if hasattr(it, "to_dict") else it for it in self.items]
+        return {"items": items, "page_info": self.page_info.to_dict()}
+
+
+def encode_cursor(last_key: Sequence[Any], filter_hash: str) -> str:
+    payload = {"k": list(last_key), "f": filter_hash}
+    return base64.urlsafe_b64encode(json.dumps(payload, separators=(",", ":")).encode()).decode().rstrip("=")
+
+
+def decode_cursor(cursor: str, expected_filter_hash: str) -> list[Any]:
+    try:
+        padded = cursor + "=" * (-len(cursor) % 4)
+        payload = json.loads(base64.urlsafe_b64decode(padded.encode()).decode())
+        key, fhash = payload["k"], payload["f"]
+    except Exception as e:
+        raise ODataError(f"malformed cursor: {e}") from e
+    if fhash != expected_filter_hash:
+        raise ODataError("cursor does not match current filter/order (stale cursor)")
+    return key
+
+
+def clamp_limit(limit: Optional[int]) -> int:
+    if limit is None:
+        return DEFAULT_LIMIT
+    if limit < 1:
+        raise ODataError("limit must be >= 1")
+    return min(limit, MAX_LIMIT)

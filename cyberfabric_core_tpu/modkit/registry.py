@@ -1,0 +1,161 @@
+"""Module registry — declarative registration + dependency-ordered assembly.
+
+Reference: libs/modkit/src/registry.rs (inventory-based auto-discovery at :260,
+`discover_and_build` at :310, topo assembly at :577) and the ``#[modkit::module]``
+macro (libs/modkit-macros/src/lib.rs:480: name, deps, capabilities, ctor).
+
+Python rendition: the :func:`module` class decorator registers a *registration record*
+into a process-global list (the `inventory::collect!` equivalent);
+:meth:`ModuleRegistry.discover_and_build` instantiates enabled modules and topologically
+sorts them by declared deps, failing on cycles and unknown capability declarations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from graphlib import CycleError, TopologicalSorter
+from typing import Callable, Iterable, Optional, Sequence
+
+from .contracts import CAPABILITY_CLASSES, Module
+
+
+@dataclass
+class Registration:
+    name: str
+    cls: type
+    deps: tuple[str, ...]
+    capabilities: tuple[str, ...]
+    ctor: Optional[Callable[[], Module]] = None
+
+
+_REGISTRATIONS: list[Registration] = []
+
+
+def module(
+    *,
+    name: str,
+    deps: Sequence[str] = (),
+    capabilities: Sequence[str] = (),
+    ctor: Optional[Callable[[], Module]] = None,
+) -> Callable[[type], type]:
+    """Class decorator equivalent of ``#[modkit::module(...)]``.
+
+    Asserts at decoration time that the class subclasses :class:`Module` and each
+    declared capability ABC (the macro's compile-time assertions,
+    modkit-macros/src/lib.rs:516-560).
+    """
+
+    unknown = [c for c in capabilities if c not in CAPABILITY_CLASSES]
+    if unknown:
+        raise ValueError(f"module {name}: unknown capabilities {unknown}")
+
+    def decorate(cls: type) -> type:
+        if not issubclass(cls, Module):
+            raise TypeError(f"module {name}: {cls.__name__} must subclass Module")
+        for cap in capabilities:
+            if not issubclass(cls, CAPABILITY_CLASSES[cap]):
+                raise TypeError(
+                    f"module {name}: declared capability '{cap}' but {cls.__name__} "
+                    f"does not subclass {CAPABILITY_CLASSES[cap].__name__}"
+                )
+        cls.MODULE_NAME = name  # type: ignore[attr-defined]
+        _REGISTRATIONS.append(
+            Registration(
+                name=name,
+                cls=cls,
+                deps=tuple(deps),
+                capabilities=tuple(capabilities),
+                ctor=ctor,
+            )
+        )
+        return cls
+
+    return decorate
+
+
+def clear_registrations() -> None:
+    """Test hook: reset the global registration inventory."""
+    _REGISTRATIONS.clear()
+
+
+def registrations() -> list[Registration]:
+    return list(_REGISTRATIONS)
+
+
+@dataclass
+class ModuleEntry:
+    registration: Registration
+    instance: Module
+
+    @property
+    def name(self) -> str:
+        return self.registration.name
+
+    def has_capability(self, tag: str) -> bool:
+        return tag in self.registration.capabilities
+
+
+@dataclass
+class ModuleRegistry:
+    """Instantiated modules in topological (dependency-first) order."""
+
+    entries: list[ModuleEntry] = field(default_factory=list)
+
+    @classmethod
+    def discover_and_build(
+        cls,
+        *,
+        enabled: Optional[Iterable[str]] = None,
+        extra: Sequence[Registration] = (),
+    ) -> "ModuleRegistry":
+        """Instantiate registered modules, topo-sorted by deps.
+
+        ``enabled``: if given, restrict to these module names (plus their transitive
+        deps — a missing dep is an error, mirroring registry.rs assembly :577).
+        """
+        regs = {r.name: r for r in list(_REGISTRATIONS) + list(extra)}
+        if enabled is not None:
+            want: set[str] = set()
+
+            def add(n: str) -> None:
+                if n in want:
+                    return
+                if n not in regs:
+                    raise LookupError(f"module '{n}' is not registered")
+                want.add(n)
+                for d in regs[n].deps:
+                    add(d)
+
+            for n in enabled:
+                add(n)
+            regs = {n: r for n, r in regs.items() if n in want}
+
+        graph = {}
+        for name, reg in regs.items():
+            missing = [d for d in reg.deps if d not in regs]
+            if missing:
+                raise LookupError(f"module '{name}' depends on unregistered {missing}")
+            graph[name] = set(reg.deps)
+        try:
+            order = list(TopologicalSorter(graph).static_order())
+        except CycleError as e:
+            raise ValueError(f"module dependency cycle: {e.args[1]}") from e
+
+        entries = []
+        for name in order:
+            reg = regs[name]
+            instance = reg.ctor() if reg.ctor else reg.cls()
+            entries.append(ModuleEntry(registration=reg, instance=instance))
+        return cls(entries=entries)
+
+    def with_capability(self, tag: str) -> list[ModuleEntry]:
+        return [e for e in self.entries if e.has_capability(tag)]
+
+    def get(self, name: str) -> ModuleEntry:
+        for e in self.entries:
+            if e.name == name:
+                return e
+        raise LookupError(f"module '{name}' not in registry")
+
+    def names(self) -> list[str]:
+        return [e.name for e in self.entries]

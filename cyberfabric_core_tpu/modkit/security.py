@@ -1,0 +1,156 @@
+"""Multi-tenant security primitives.
+
+Reference: libs/modkit-security/src/ — `SecurityContext` (context.rs:23-40: subject,
+tenant, token scopes, redacted bearer token), `AccessScope`/`ScopeFilter`/`ScopeValue`
+(access_scope.rs:10-19) — the predicate model consumed by the secure ORM, and the PEP
+that compiles PDP constraints into filters
+(modules/system/authz-resolver/authz-resolver-sdk/src/pep/{compiler,enforcer}.rs).
+
+Four scoping dimensions (SURVEY §8.10): tenant, resource, owner, type.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+
+class SecretString:
+    """Redacted-on-display secret holder (libs/modkit-utils/src/secret_string.rs)."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value: str) -> None:
+        self._value = value
+
+    def expose(self) -> str:
+        return self._value
+
+    def __repr__(self) -> str:  # never leak in logs
+        return "SecretString(***REDACTED***)"
+
+    __str__ = __repr__
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, SecretString) and other._value == self._value
+
+    def __hash__(self) -> int:
+        return hash(self._value)
+
+
+class Dimension(str, enum.Enum):
+    """The four scoping dimensions of ScopableEntity (entity_traits.rs:99-150)."""
+
+    TENANT = "tenant"
+    RESOURCE = "resource"
+    OWNER = "owner"
+    TYPE = "type"
+
+
+@dataclass(frozen=True)
+class ScopeFilter:
+    """One predicate: dimension must be in ``values`` (empty = deny all)."""
+
+    dimension: Dimension
+    values: tuple[str, ...]
+
+    def allows(self, value: Optional[str]) -> bool:
+        return value is not None and value in self.values
+
+
+@dataclass(frozen=True)
+class AccessScope:
+    """A conjunction of scope filters; ``unrestricted`` bypasses all scoping
+    (the `#[secure(unrestricted)]` escape hatch, entity_traits.rs:89-108)."""
+
+    filters: tuple[ScopeFilter, ...] = ()
+    unrestricted: bool = False
+
+    @classmethod
+    def for_tenants(cls, tenant_ids: Sequence[str]) -> "AccessScope":
+        return cls(filters=(ScopeFilter(Dimension.TENANT, tuple(tenant_ids)),))
+
+    @classmethod
+    def unrestricted_scope(cls) -> "AccessScope":
+        return cls(unrestricted=True)
+
+    def filter_for(self, dim: Dimension) -> Optional[ScopeFilter]:
+        for f in self.filters:
+            if f.dimension == dim:
+                return f
+        return None
+
+    def merged_with(self, other: "AccessScope") -> "AccessScope":
+        """Intersection semantics: the PEP narrows, never widens."""
+        if self.unrestricted:
+            return other
+        if other.unrestricted:
+            return self
+        by_dim: dict[Dimension, ScopeFilter] = {f.dimension: f for f in self.filters}
+        for f in other.filters:
+            if f.dimension in by_dim:
+                vals = tuple(v for v in f.values if v in by_dim[f.dimension].values)
+                by_dim[f.dimension] = ScopeFilter(f.dimension, vals)
+            else:
+                by_dim[f.dimension] = f
+        return AccessScope(filters=tuple(by_dim.values()))
+
+
+class SecurityContextError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class SecurityContext:
+    """Authenticated caller identity flowing through every request
+    (modkit-security/src/context.rs:23-40). Built by the authn middleware, consumed
+    by domain services and the secure ORM; every domain API takes it first
+    (serverless ADR:3476 — "tenant scoping is in the signature").
+    """
+
+    subject: str
+    tenant_id: str
+    token_scopes: tuple[str, ...] = ()
+    roles: tuple[str, ...] = ()
+    bearer_token: Optional[SecretString] = None
+    claims: dict[str, Any] = field(default_factory=dict)
+    access_scope: AccessScope = field(default_factory=AccessScope)
+    trace_id: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if not self.subject:
+            raise SecurityContextError("subject must not be empty")
+        if not self.tenant_id:
+            raise SecurityContextError("tenant_id must not be empty")
+
+    @classmethod
+    def anonymous(cls, tenant_id: str = "default") -> "SecurityContext":
+        """Dev-mode context (`auth_disabled: true` parity, config/quickstart.yaml:108)."""
+        return cls(
+            subject="anonymous",
+            tenant_id=tenant_id,
+            access_scope=AccessScope.for_tenants([tenant_id]),
+        )
+
+    @classmethod
+    def system(cls) -> "SecurityContext":
+        """Unrestricted context for internal control-plane operations."""
+        return cls(
+            subject="system",
+            tenant_id="system",
+            access_scope=AccessScope.unrestricted_scope(),
+        )
+
+    def effective_scope(self) -> AccessScope:
+        """Tenant filter implied by identity, intersected with PDP constraints."""
+        if self.access_scope.unrestricted:
+            return self.access_scope
+        base = AccessScope.for_tenants([self.tenant_id])
+        return base.merged_with(self.access_scope)
+
+    def has_scope(self, scope: str) -> bool:
+        return scope in self.token_scopes
+
+    def has_role(self, role: str) -> bool:
+        return role in self.roles

@@ -1,0 +1,131 @@
+"""Typed broadcast → SSE streams with keep-alive and lag-drop semantics.
+
+Reference: libs/modkit/src/http/sse.rs (`SseBroadcaster` :14, `subscribe_stream` :33,
+`wrap_stream_as_sse` :38 — tokio broadcast channel; slow subscribers drop lagged
+messages rather than back-pressuring the producer). SSE wire framing per the
+llm-gateway contract: ``data: <json>\\n\\n`` terminated by ``data: [DONE]\\n\\n``
+(modules/llm-gateway/docs/DESIGN.md:289-311).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, AsyncIterator, Optional
+
+
+def format_sse_event(data: str, *, event: Optional[str] = None, id: Optional[str] = None) -> bytes:
+    lines = []
+    if id is not None:
+        lines.append(f"id: {id}")
+    if event is not None:
+        lines.append(f"event: {event}")
+    for chunk in data.split("\n"):
+        lines.append(f"data: {chunk}")
+    return ("\n".join(lines) + "\n\n").encode()
+
+
+def format_sse_json(obj: Any, **kw: Any) -> bytes:
+    return format_sse_event(json.dumps(obj, separators=(",", ":")), **kw)
+
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+class _Subscription:
+    def __init__(self, maxsize: int) -> None:
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+        self.lagged = 0
+
+
+class SseBroadcaster:
+    """Fan a stream of typed events out to any number of SSE subscribers.
+
+    Slow subscribers lose oldest events (lag-drop) instead of blocking the producer —
+    the tokio `broadcast` semantics the reference relies on.
+    """
+
+    def __init__(self, *, capacity: int = 256, keepalive_secs: float = 15.0) -> None:
+        self._capacity = capacity
+        self._keepalive = keepalive_secs
+        self._subs: set[_Subscription] = set()
+        self._closed = False
+
+    @property
+    def subscriber_count(self) -> int:
+        return len(self._subs)
+
+    def send(self, event: Any) -> None:
+        if self._closed:
+            return  # late sends must not displace the _CLOSE sentinel
+        for sub in list(self._subs):
+            try:
+                sub.queue.put_nowait(event)
+            except asyncio.QueueFull:
+                try:
+                    sub.queue.get_nowait()  # drop oldest, count the lag
+                    sub.lagged += 1
+                    sub.queue.put_nowait(event)
+                except asyncio.QueueEmpty:
+                    pass
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for sub in list(self._subs):
+            # evict-then-enqueue: the sentinel must always land, even on a full
+            # (lagging) subscriber, or that subscriber hangs forever
+            while True:
+                try:
+                    sub.queue.put_nowait(_CLOSE)
+                    break
+                except asyncio.QueueFull:
+                    try:
+                        sub.queue.get_nowait()
+                        sub.lagged += 1
+                    except asyncio.QueueEmpty:
+                        pass
+
+    async def subscribe(self) -> AsyncIterator[Any]:
+        """Async iterator of events; ends when the broadcaster closes."""
+        sub = _Subscription(self._capacity)
+        self._subs.add(sub)
+        try:
+            if self._closed:
+                return
+            while True:
+                event = await sub.queue.get()
+                if event is _CLOSE:
+                    return
+                yield event
+        finally:
+            self._subs.discard(sub)
+
+    async def sse_stream(self, *, as_json: bool = True) -> AsyncIterator[bytes]:
+        """Subscribe and yield SSE-framed bytes, emitting `: keep-alive` comments when
+        idle for ``keepalive_secs``."""
+        sub = _Subscription(self._capacity)
+        self._subs.add(sub)
+        try:
+            if self._closed:
+                return
+            while True:
+                try:
+                    event = await asyncio.wait_for(sub.queue.get(), self._keepalive)
+                except asyncio.TimeoutError:
+                    yield b": keep-alive\n\n"
+                    continue
+                if event is _CLOSE:
+                    return
+                if isinstance(event, (bytes, bytearray)):
+                    yield bytes(event)
+                elif as_json and not isinstance(event, str):
+                    yield format_sse_json(event)
+                else:
+                    yield format_sse_event(str(event))
+        finally:
+            self._subs.discard(sub)
+
+
+_CLOSE = object()

@@ -1,0 +1,33 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh BEFORE jax imports.
+
+Mirrors the reference's testcontainers strategy (SURVEY §4.3) — multi-device behavior
+is tested without fixed TPU infra by forcing XLA's host platform to expose 8 virtual
+devices; sharding/collective code paths compile and execute for real.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def client_hub():
+    from cyberfabric_core_tpu.modkit import ClientHub
+
+    return ClientHub()
+
+
+@pytest.fixture()
+def fresh_registry():
+    """Isolate module registrations per test."""
+    from cyberfabric_core_tpu.modkit import registry as reg
+
+    saved = list(reg._REGISTRATIONS)
+    reg._REGISTRATIONS.clear()
+    yield reg
+    reg._REGISTRATIONS[:] = saved

@@ -1,0 +1,75 @@
+"""Config layering tests (reference: libs/modkit/src/bootstrap/config, figment layers)."""
+
+import pytest
+
+from cyberfabric_core_tpu.modkit.config import AppConfig, ConfigError
+
+
+def test_defaults():
+    cfg = AppConfig.load_or_default(environ={})
+    assert cfg.section("logging")["level"] == "info"
+    assert cfg.module_names() == []
+
+
+def test_yaml_layer(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text(
+        """
+server: {home_dir: /tmp/fab}
+modules:
+  api_gateway:
+    config: {bind_addr: "127.0.0.1:8086"}
+  llm_gateway:
+    config: {default_model: tiny}
+    enabled: true
+"""
+    )
+    cfg = AppConfig.load_or_default(p, environ={})
+    assert cfg.module_config("api_gateway")["bind_addr"] == "127.0.0.1:8086"
+    assert cfg.module_enabled("llm_gateway")
+
+
+def test_env_overrides_yaml(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("modules:\n  api_gateway:\n    config: {bind_addr: '1.1.1.1:1', max_rps: 10}\n")
+    env = {"APP__MODULES__api_gateway__CONFIG__BIND_ADDR": "0.0.0.0:8086"}
+    cfg = AppConfig.load_or_default(p, environ=env)
+    # SURVEY §8.6 convention: APP__ double-underscore path, case-insensitive match
+    assert cfg.module_config("api_gateway")["bind_addr"] == "0.0.0.0:8086"
+    assert cfg.module_config("api_gateway")["max_rps"] == 10
+
+
+def test_env_value_coercion():
+    env = {"APP__TRACING__ENABLED": "true", "APP__TRACING__SAMPLE_RATIO": "0.25"}
+    cfg = AppConfig.load_or_default(environ=env)
+    assert cfg.section("tracing")["enabled"] is True
+    assert cfg.section("tracing")["sample_ratio"] == 0.25
+
+
+def test_cli_overrides_env(tmp_path):
+    env = {"APP__LOGGING__LEVEL": "warn"}
+    cfg = AppConfig.load_or_default(environ=env, cli_overrides={"logging": {"level": "debug"}})
+    assert cfg.section("logging")["level"] == "debug"
+
+
+def test_var_expansion(tmp_path, monkeypatch):
+    monkeypatch.setenv("MY_SECRET_DIR", "/var/secrets")
+    p = tmp_path / "c.yaml"
+    p.write_text("server: {home_dir: '${MY_SECRET_DIR}/fab'}\n")
+    cfg = AppConfig.load_or_default(p, environ={})
+    assert cfg.tree["server"]["home_dir"] == "/var/secrets/fab"
+
+
+def test_unknown_module_field_rejected(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("modules:\n  foo:\n    cofnig: {}\n")  # typo'd key
+    with pytest.raises(ConfigError, match="unknown fields"):
+        AppConfig.load_or_default(p, environ={})
+
+
+def test_effective_dump_redacts():
+    cfg = AppConfig.load_or_default(
+        environ={}, cli_overrides={"modules": {"credstore": {"config": {"master_key": "s3cr3t"}}}}
+    )
+    dump = cfg.dump_effective()
+    assert dump["modules"]["credstore"]["config"]["master_key"] == "***REDACTED***"

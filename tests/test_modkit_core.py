@@ -1,0 +1,293 @@
+"""Core runtime tests (reference analogue: libs/modkit/src/runtime/tests.rs)."""
+
+import asyncio
+
+import pytest
+
+from cyberfabric_core_tpu.modkit import (
+    CancellationToken,
+    ClientHub,
+    ClientScope,
+    Module,
+    ModuleRegistry,
+    ReadySignal,
+    RunnableCapability,
+    RunOptions,
+    SystemCapability,
+    WithLifecycle,
+    module,
+)
+from cyberfabric_core_tpu.modkit.client_hub import ClientNotFound
+from cyberfabric_core_tpu.modkit.runtime import HostRuntime
+
+
+# ---------------------------------------------------------------- cancellation
+def test_cancellation_token_hierarchy():
+    async def go():
+        root = CancellationToken()
+        child = root.child_token()
+        grandchild = child.child_token()
+        fired = []
+        grandchild.on_cancel(lambda: fired.append("gc"))
+        root.cancel()
+        assert child.is_cancelled and grandchild.is_cancelled
+        assert fired == ["gc"]
+        # child cancel does NOT propagate upward
+        root2 = CancellationToken()
+        c2 = root2.child_token()
+        c2.cancel()
+        assert not root2.is_cancelled
+
+    asyncio.run(go())
+
+
+def test_run_until_cancelled():
+    async def go():
+        token = CancellationToken()
+
+        async def forever():
+            await asyncio.sleep(100)
+
+        async def canceller():
+            await asyncio.sleep(0.01)
+            token.cancel()
+
+        asyncio.ensure_future(canceller())
+        result = await token.run_until_cancelled(forever())
+        assert result is None
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------- client hub
+class GreeterApi:
+    def greet(self) -> str:
+        raise NotImplementedError
+
+
+class EnglishGreeter(GreeterApi):
+    def greet(self) -> str:
+        return "hello"
+
+
+def test_client_hub_roundtrip(client_hub: ClientHub):
+    impl = EnglishGreeter()
+    client_hub.register(GreeterApi, impl)
+    assert client_hub.get(GreeterApi) is impl
+    with pytest.raises(ClientNotFound):
+        client_hub.get(RunnableCapability)  # type: ignore[arg-type]
+
+
+def test_client_hub_scoped(client_hub: ClientHub):
+    a, b = EnglishGreeter(), EnglishGreeter()
+    client_hub.register(GreeterApi, a, ClientScope.for_gts_id("gts://x.a.v1~inst1"))
+    client_hub.register(GreeterApi, b, ClientScope.for_gts_id("gts://x.a.v1~inst2"))
+    assert client_hub.get(GreeterApi, ClientScope.for_gts_id("gts://x.a.v1~inst2")) is b
+    assert set(client_hub.scoped_instances(GreeterApi)) == {
+        "gts://x.a.v1~inst1",
+        "gts://x.a.v1~inst2",
+    }
+
+
+def test_client_hub_type_check(client_hub: ClientHub):
+    with pytest.raises(TypeError):
+        client_hub.register(GreeterApi, object())  # type: ignore[arg-type]
+
+
+# ---------------------------------------------------------------- registry
+def test_registry_topo_order(fresh_registry):
+    order = []
+
+    @module(name="a", deps=["b"])
+    class A(Module):
+        async def init(self, ctx):
+            order.append("a")
+
+    @module(name="b", deps=["c"])
+    class B(Module):
+        async def init(self, ctx):
+            order.append("b")
+
+    @module(name="c")
+    class C(Module):
+        async def init(self, ctx):
+            order.append("c")
+
+    reg = ModuleRegistry.discover_and_build()
+    assert reg.names().index("c") < reg.names().index("b") < reg.names().index("a")
+
+
+def test_registry_cycle_detection(fresh_registry):
+    @module(name="x", deps=["y"])
+    class X(Module):
+        async def init(self, ctx):
+            pass
+
+    @module(name="y", deps=["x"])
+    class Y(Module):
+        async def init(self, ctx):
+            pass
+
+    with pytest.raises(ValueError, match="cycle"):
+        ModuleRegistry.discover_and_build()
+
+
+def test_registry_missing_dep(fresh_registry):
+    @module(name="lonely", deps=["ghost"])
+    class Lonely(Module):
+        async def init(self, ctx):
+            pass
+
+    with pytest.raises(LookupError):
+        ModuleRegistry.discover_and_build()
+
+
+def test_capability_declaration_enforced(fresh_registry):
+    with pytest.raises(TypeError, match="does not subclass"):
+
+        @module(name="bad", capabilities=["stateful"])
+        class Bad(Module):  # claims stateful but doesn't implement it
+            async def init(self, ctx):
+                pass
+
+
+def test_enabled_subset_pulls_deps(fresh_registry):
+    @module(name="base")
+    class Base(Module):
+        async def init(self, ctx):
+            pass
+
+    @module(name="feat", deps=["base"])
+    class Feat(Module):
+        async def init(self, ctx):
+            pass
+
+    @module(name="unrelated")
+    class Unrelated(Module):
+        async def init(self, ctx):
+            pass
+
+    reg = ModuleRegistry.discover_and_build(enabled=["feat"])
+    assert set(reg.names()) == {"base", "feat"}
+
+
+# ---------------------------------------------------------------- lifecycle
+def test_with_lifecycle_start_stop():
+    async def go():
+        log = []
+
+        async def run(token, ready):
+            log.append("started")
+            ready.notify_ready()
+            await token.cancelled()
+            log.append("stopped")
+
+        lc = WithLifecycle("svc", run)
+        root = CancellationToken()
+        await lc.start(root)
+        assert lc.status.value == "running"
+        await lc.stop()
+        assert lc.status.value == "stopped"
+        assert log == ["started", "stopped"]
+
+    asyncio.run(go())
+
+
+def test_lifecycle_failure_propagates():
+    async def go():
+        async def run(token, ready):
+            raise RuntimeError("boom")
+
+        lc = WithLifecycle("bad", run)
+        with pytest.raises(RuntimeError, match="boom"):
+            await lc.start(CancellationToken())
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------- host runtime phases
+def test_host_runtime_phase_ordering(fresh_registry):
+    from cyberfabric_core_tpu.modkit.config import AppConfig
+
+    events = []
+
+    @module(name="sys", capabilities=["system", "stateful"])
+    class Sys(Module, SystemCapability, RunnableCapability):
+        async def init(self, ctx):
+            events.append("sys.init")
+
+        async def pre_init(self, ctx):
+            events.append("sys.pre_init")
+
+        async def post_init(self, ctx):
+            events.append("sys.post_init")
+
+        async def start(self, ctx, ready: ReadySignal):
+            events.append("sys.start")
+            ready.notify_ready()
+
+        async def stop(self, ctx):
+            events.append("sys.stop")
+
+    @module(name="app", deps=["sys"], capabilities=["stateful"])
+    class App(Module, RunnableCapability):
+        async def init(self, ctx):
+            events.append("app.init")
+
+        async def start(self, ctx, ready: ReadySignal):
+            events.append("app.start")
+            ready.notify_ready()
+
+        async def stop(self, ctx):
+            events.append("app.stop")
+
+    async def go():
+        reg = ModuleRegistry.discover_and_build()
+        opts = RunOptions(config=AppConfig(), registry=reg)
+        rt = HostRuntime(opts)
+        await rt.run_setup_phases()
+        rt.root_token.cancel()
+        await rt.run_stop_phase()
+
+    asyncio.run(go())
+    assert events == [
+        "sys.pre_init",
+        "sys.init",
+        "app.init",
+        "sys.post_init",
+        "sys.start",   # system modules start first
+        "app.start",
+        "app.stop",    # stop in reverse order
+        "sys.stop",
+    ]
+
+
+def test_exactly_one_rest_host(fresh_registry):
+    from cyberfabric_core_tpu.modkit.config import AppConfig
+    from cyberfabric_core_tpu.modkit.contracts import ApiGatewayCapability
+
+    class HostBase(Module, ApiGatewayCapability):
+        async def init(self, ctx):
+            pass
+
+        def rest_prepare(self, ctx):
+            return object(), object()
+
+        def rest_finalize(self, ctx, router, openapi):
+            pass
+
+    @module(name="host1", capabilities=["rest_host"])
+    class H1(HostBase):
+        pass
+
+    @module(name="host2", capabilities=["rest_host"])
+    class H2(HostBase):
+        pass
+
+    async def go():
+        reg = ModuleRegistry.discover_and_build()
+        rt = HostRuntime(RunOptions(config=AppConfig(), registry=reg))
+        with pytest.raises(RuntimeError, match="exactly one rest_host"):
+            await rt.run_rest_phase()
+
+    asyncio.run(go())

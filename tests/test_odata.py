@@ -1,0 +1,143 @@
+"""OData parser + pagination tests (reference: libs/modkit-odata/src/tests.rs, 385 LoC;
+fuzz targets fuzz_odata_{cursor,filter,orderby}.rs)."""
+
+import pytest
+
+from cyberfabric_core_tpu.modkit.contracts import Migration
+from cyberfabric_core_tpu.modkit.db import Database, ScopableEntity
+from cyberfabric_core_tpu.modkit.odata import (
+    Comparison,
+    InList,
+    And,
+    Or,
+    Not,
+    ODataError,
+    clamp_limit,
+    decode_cursor,
+    encode_cursor,
+    parse_filter,
+    parse_orderby,
+    short_filter_hash,
+    to_sql,
+)
+from cyberfabric_core_tpu.modkit.security import SecurityContext
+
+FM = {"name": "name", "age": "age", "city": "city"}
+
+
+def test_parse_simple_comparison():
+    ast = parse_filter("name eq 'bob'")
+    assert ast == Comparison("name", "eq", "bob")
+
+
+def test_parse_precedence():
+    ast = parse_filter("age gt 5 and age lt 10 or name eq 'x'")
+    assert isinstance(ast, Or)
+    assert isinstance(ast.left, And)
+
+
+def test_parse_parens_and_not():
+    ast = parse_filter("not (age ge 21)")
+    assert isinstance(ast, Not)
+    assert ast.inner == Comparison("age", "ge", 21)
+
+
+def test_parse_in_list():
+    ast = parse_filter("city in ('nyc', 'sf')")
+    assert ast == InList("city", ("nyc", "sf"))
+
+
+def test_parse_escaped_quote():
+    ast = parse_filter("name eq 'o''brien'")
+    assert ast.value == "o'brien"
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["", "name", "name eq", "name zz 1", "age eq 1 and", "(age eq 1", "name eq 'x' garbage",
+     "in (1,2)", "name in ()", "name in (1,", "' or 1=1 --"],
+)
+def test_parse_rejects_garbage(bad):
+    with pytest.raises(ODataError):
+        parse_filter(bad)
+
+
+def test_to_sql_parameterized():
+    sql, params = to_sql(parse_filter("name eq 'bob' and age gt 3"), FM)
+    assert sql == "(name = ? AND age > ?)"
+    assert params == ["bob", 3]
+
+
+def test_to_sql_unknown_field_rejected():
+    with pytest.raises(ODataError, match="unknown field"):
+        to_sql(parse_filter("evil eq 1"), FM)
+
+
+def test_null_handling():
+    sql, params = to_sql(parse_filter("city eq null"), FM)
+    assert sql == "city IS NULL" and params == []
+
+
+def test_orderby():
+    assert parse_orderby("name, age desc") == (
+        __import__("cyberfabric_core_tpu.modkit.odata", fromlist=["OrderField"]).OrderField("name", False),
+        __import__("cyberfabric_core_tpu.modkit.odata", fromlist=["OrderField"]).OrderField("age", True),
+    )
+    with pytest.raises(ODataError):
+        parse_orderby("name evil")
+
+
+def test_cursor_roundtrip_and_filter_binding():
+    fh = short_filter_hash("age gt 3", "name")
+    cur = encode_cursor(["bob", "id9"], fh)
+    assert decode_cursor(cur, fh) == ["bob", "id9"]
+    with pytest.raises(ODataError, match="stale"):
+        decode_cursor(cur, short_filter_hash("age gt 4", "name"))
+
+
+def test_cursor_malformed():
+    with pytest.raises(ODataError):
+        decode_cursor("!!!not-base64!!!", "x")
+
+
+def test_clamp_limit():
+    assert clamp_limit(None) == 25
+    assert clamp_limit(500) == 200
+    with pytest.raises(ODataError):
+        clamp_limit(0)
+
+
+# ------------------------------------------------------- end-to-end keyset paging
+PEOPLE = ScopableEntity(
+    table="people",
+    field_map={"id": "id", "tenant_id": "tenant_id", "name": "name", "age": "age"},
+)
+
+
+def test_list_odata_paging():
+    db = Database(":memory:")
+    db.run_migrations([
+        Migration("0001", lambda c: c.execute(
+            "CREATE TABLE people (id TEXT PRIMARY KEY, tenant_id TEXT, name TEXT, age INT)"))
+    ])
+    ctx = SecurityContext(subject="u", tenant_id="t1")
+    conn = db.secure(ctx, PEOPLE)
+    for i in range(30):
+        conn.insert({"id": f"id{i:02d}", "name": f"p{i % 7}", "age": i})
+
+    page1 = conn.list_odata(filter_text="age lt 25", orderby_text="age desc", limit=10)
+    assert len(page1.items) == 10
+    assert page1.items[0]["age"] == 24
+    assert page1.page_info.next_cursor
+
+    page2 = conn.list_odata(filter_text="age lt 25", orderby_text="age desc",
+                            limit=10, cursor=page1.page_info.next_cursor)
+    assert page2.items[0]["age"] == 14
+    # no overlap, no gaps
+    seen = {r["id"] for r in page1.items} | {r["id"] for r in page2.items}
+    assert len(seen) == 20
+
+    page3 = conn.list_odata(filter_text="age lt 25", orderby_text="age desc",
+                            limit=10, cursor=page2.page_info.next_cursor)
+    assert len(page3.items) == 5
+    assert page3.page_info.next_cursor is None

@@ -1,0 +1,130 @@
+"""Regression tests for review findings on the modkit core layer."""
+
+import asyncio
+
+import pytest
+
+from cyberfabric_core_tpu.modkit import CancellationToken, WithLifecycle
+from cyberfabric_core_tpu.modkit.contracts import Migration
+from cyberfabric_core_tpu.modkit.db import Database, ScopableEntity
+from cyberfabric_core_tpu.modkit.odata import ODataError
+from cyberfabric_core_tpu.modkit.security import SecurityContext
+from cyberfabric_core_tpu.modkit.sse import SseBroadcaster
+
+NOTES = ScopableEntity(
+    table="notes",
+    field_map={"id": "id", "tenant_id": "tenant_id", "title": "title"},
+)
+
+
+@pytest.fixture()
+def db():
+    d = Database(":memory:")
+    d.run_migrations([
+        Migration("0001", lambda c: c.execute(
+            "CREATE TABLE notes (id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, title TEXT)"))
+    ])
+    return d
+
+
+def ctx():
+    return SecurityContext(subject="u", tenant_id="t1")
+
+
+def test_insert_rejects_unknown_columns(db):
+    """Column names are allowlisted on every surface, not just select()."""
+    conn = db.secure(ctx(), NOTES)
+    with pytest.raises(ODataError, match="unknown column"):
+        conn.insert({"title": "x", "body, tenant_id": "('y','t2')--"})
+    with pytest.raises(ODataError, match="unknown column"):
+        conn.update("someid", {"title = title--": "x"})
+    with pytest.raises(ODataError, match="unknown column"):
+        conn.count(where={"1=1; --": 1})
+
+
+def test_failed_migration_rolls_back_ddl(db):
+    """DDL inside a failing migration must not persist (explicit BEGIN/ROLLBACK)."""
+
+    def bad(conn):
+        conn.execute("CREATE TABLE half_done (id TEXT)")
+        raise RuntimeError("second statement failed")
+
+    with pytest.raises(RuntimeError):
+        db.run_migrations([Migration("0002_bad", bad)])
+    # the half-created table must be gone, and the migration not recorded
+    import sqlite3
+    with pytest.raises(sqlite3.OperationalError):
+        db.raw_for_migrations().execute("SELECT * FROM half_done")
+    assert "0002_bad" not in db.applied_migrations()
+    # a fixed retry under the same version applies cleanly
+    db.run_migrations([Migration("0002_bad", lambda c: c.execute("CREATE TABLE half_done (id TEXT)"))])
+    assert "0002_bad" in db.applied_migrations()
+
+
+def test_lifecycle_oneshot_run_fn_completes_start():
+    """A run_fn that returns without calling notify_ready must not hang start()."""
+
+    async def go():
+        async def oneshot(token, ready):
+            return  # never touches ready
+
+        lc = WithLifecycle("oneshot", oneshot, ready_timeout=2.0)
+        await asyncio.wait_for(lc.start(CancellationToken()), timeout=1.0)
+
+    asyncio.run(go())
+
+
+def test_sse_close_reaches_lagging_subscriber():
+    """close() must land the sentinel even on a full queue; late sends can't evict it."""
+
+    async def go():
+        b = SseBroadcaster(capacity=4, keepalive_secs=0.05)
+        received = []
+
+        async def consume():
+            async for ev in b.subscribe():
+                received.append(ev)
+                await asyncio.sleep(0)  # slow-ish consumer
+
+        task = asyncio.ensure_future(consume())
+        await asyncio.sleep(0)  # let it subscribe
+        for i in range(20):  # overflow the queue
+            b.send(i)
+        b.close()
+        b.send("late")  # post-close send must be dropped, not displace _CLOSE
+        await asyncio.wait_for(task, timeout=2.0)
+        assert "late" not in received
+
+    asyncio.run(go())
+
+
+def test_host_runtime_failed_start_tears_down(fresh_registry):
+    """A module that never becomes ready is cancelled and stopped, not leaked."""
+    from cyberfabric_core_tpu.modkit import Module, ReadySignal, RunnableCapability, module
+    from cyberfabric_core_tpu.modkit.config import AppConfig
+    from cyberfabric_core_tpu.modkit.registry import ModuleRegistry
+    from cyberfabric_core_tpu.modkit.runtime import HostRuntime, RunOptions
+
+    events = []
+
+    @module(name="neverready", capabilities=["stateful"])
+    class NeverReady(Module, RunnableCapability):
+        async def init(self, ctx):
+            pass
+
+        async def start(self, ctx, ready: ReadySignal):
+            events.append("started-bg")
+            ready.notify_failed(RuntimeError("refuses to be ready"))
+
+        async def stop(self, ctx):
+            events.append("stopped")
+
+    async def go():
+        reg = ModuleRegistry.discover_and_build()
+        rt = HostRuntime(RunOptions(config=AppConfig(), registry=reg))
+        with pytest.raises(RuntimeError, match="refuses"):
+            await rt.run_setup_phases()
+        assert rt.ctx_for(reg.get("neverready")).cancellation_token.is_cancelled
+
+    asyncio.run(go())
+    assert events == ["started-bg", "stopped"]

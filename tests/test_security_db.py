@@ -1,0 +1,126 @@
+"""Tenant-scoping + secure ORM tests.
+
+Reference analogue: users-info tenant-isolation suites
+(examples/modkit/users-info/.../tests_tenant_scoping.rs,
+tests_pdp_deny.rs) — these define what "tenant isolation works" means (SURVEY §8.9).
+"""
+
+import pytest
+
+from cyberfabric_core_tpu.modkit.contracts import Migration
+from cyberfabric_core_tpu.modkit.db import Database, DbManager, ScopableEntity, ScopeViolation
+from cyberfabric_core_tpu.modkit.security import (
+    AccessScope,
+    Dimension,
+    ScopeFilter,
+    SecretString,
+    SecurityContext,
+)
+
+NOTES = ScopableEntity(
+    table="notes",
+    field_map={"id": "id", "tenant_id": "tenant_id", "owner_id": "owner_id",
+               "title": "title", "body": "body", "meta": "meta"},
+    owner_col="owner_id",
+    json_cols=("meta",),
+)
+
+MIGRATIONS = [
+    Migration(
+        "0001_notes",
+        lambda conn: conn.execute(
+            "CREATE TABLE notes (id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, "
+            "owner_id TEXT, title TEXT, body TEXT, meta TEXT)"
+        ),
+    )
+]
+
+
+@pytest.fixture()
+def db():
+    d = Database(":memory:")
+    d.run_migrations(MIGRATIONS)
+    return d
+
+
+def ctx(tenant: str, **kw) -> SecurityContext:
+    return SecurityContext(subject=f"user@{tenant}", tenant_id=tenant, **kw)
+
+
+def test_migrations_idempotent(db):
+    assert db.run_migrations(MIGRATIONS) == 0
+    assert db.applied_migrations() == ["0001_notes"]
+
+
+def test_insert_defaults_tenant(db):
+    conn = db.secure(ctx("t1"), NOTES)
+    row = conn.insert({"title": "hello"})
+    assert row["tenant_id"] == "t1"
+    assert conn.get(row["id"])["title"] == "hello"
+
+
+def test_tenant_isolation_on_read(db):
+    a, b = db.secure(ctx("t1"), NOTES), db.secure(ctx("t2"), NOTES)
+    row = a.insert({"title": "private"})
+    assert b.get(row["id"]) is None
+    assert a.get(row["id"]) is not None
+    assert b.count() == 0 and a.count() == 1
+
+
+def test_tenant_isolation_on_update_delete(db):
+    a, b = db.secure(ctx("t1"), NOTES), db.secure(ctx("t2"), NOTES)
+    row = a.insert({"title": "x"})
+    assert b.update(row["id"], {"title": "pwned"}) is False
+    assert b.delete(row["id"]) is False
+    assert a.get(row["id"])["title"] == "x"
+    assert a.delete(row["id"]) is True
+
+
+def test_cross_tenant_insert_rejected(db):
+    conn = db.secure(ctx("t1"), NOTES)
+    with pytest.raises(ScopeViolation):
+        conn.insert({"title": "sneaky", "tenant_id": "t2"})
+
+
+def test_scope_narrowing_pdp(db):
+    """PDP constraints narrow, never widen (pep/enforcer.rs semantics)."""
+    wide = ctx("t1")
+    narrowed = SecurityContext(
+        subject="user@t1",
+        tenant_id="t1",
+        access_scope=AccessScope(
+            filters=(ScopeFilter(Dimension.OWNER, ("alice",)),)
+        ),
+    )
+    db.secure(wide, NOTES).insert({"title": "a", "owner_id": "alice"})
+    db.secure(wide, NOTES).insert({"title": "b", "owner_id": "bob"})
+    rows = db.secure(narrowed, NOTES).select()
+    assert [r["owner_id"] for r in rows] == ["alice"]
+
+
+def test_unrestricted_system_context(db):
+    db.secure(ctx("t1"), NOTES).insert({"title": "a"})
+    db.secure(ctx("t2"), NOTES).insert({"title": "b"})
+    sys_conn = db.secure(SecurityContext.system(), NOTES)
+    assert sys_conn.count() == 2
+
+
+def test_json_roundtrip(db):
+    conn = db.secure(ctx("t1"), NOTES)
+    row = conn.insert({"title": "j", "meta": {"tags": ["x", "y"], "n": 3}})
+    got = conn.get(row["id"])
+    assert got["meta"] == {"tags": ["x", "y"], "n": 3}
+
+
+def test_db_manager_isolation(tmp_path):
+    mgr = DbManager(home_dir=tmp_path)
+    d1, d2 = mgr.db_for_module("m1"), mgr.db_for_module("m2")
+    assert d1 is not d2
+    assert (tmp_path / "db" / "m1.sqlite").exists()
+    mgr.close_all()
+
+
+def test_secret_string_redaction():
+    s = SecretString("hunter2")
+    assert "hunter2" not in repr(s) and "hunter2" not in str(s)
+    assert s.expose() == "hunter2"
