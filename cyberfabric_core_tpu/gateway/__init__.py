@@ -1,0 +1,14 @@
+"""API gateway — the single REST host (reference: modules/system/api-gateway/)."""
+
+from .router import OperationSpec, RestRouter, AuthPolicy
+from .openapi import OpenApiRegistry
+from .module import ApiGatewayModule, GatewayConfig
+
+__all__ = [
+    "ApiGatewayModule",
+    "AuthPolicy",
+    "GatewayConfig",
+    "OpenApiRegistry",
+    "OperationSpec",
+    "RestRouter",
+]

@@ -1,0 +1,315 @@
+"""The gateway middleware stack — 12 layers, in the reference's documented order.
+
+Reference: docs/MODULES.md:664-677 and api-gateway/src/module.rs:162-341:
+  1 RequestID → 2 Trace → 3 Timeout → 4 BodyLimit → 5 CORS → 6 MIME validation
+  → 7 RateLimit (RPS bucket + in-flight semaphore) → 8 error mapping (RFC-9457)
+  → 9 Auth (token → SecurityContext) → 10 policy injection → 11 License validation
+  → 12 Router/handler.
+
+Implemented as aiohttp middlewares; the per-route pieces (MIME/rate/auth/license)
+look up the matched OperationSpec which the routing layer attaches to the request.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from typing import Any, Awaitable, Callable, Optional
+
+from aiohttp import web
+
+from ..modkit.errors import Problem, ProblemError
+from ..modkit.security import SecurityContext
+from ..modkit.telemetry import Tracer
+from .router import AuthPolicy, OperationSpec, RateLimitSpec
+
+REQUEST_ID_HEADER = "x-request-id"
+#: endpoints served by the gateway itself, always public (module.rs /docs,
+#: /openapi.json, /health, /healthz)
+BUILTIN_PUBLIC_PATHS = frozenset({"/health", "/healthz", "/openapi.json", "/docs"})
+SPEC_KEY = web.AppKey("operation_spec", object)
+SECURITY_CONTEXT_KEY = "security_context"
+REQUEST_ID_KEY = "request_id"
+
+
+class AuthnApi:
+    """Inbound authn contract resolved from the ClientHub
+    (authn-resolver SDK: modules/system/authn-resolver/authn-resolver-sdk)."""
+
+    async def authenticate(self, bearer_token: Optional[str], request_meta: dict[str, Any]) -> SecurityContext:
+        raise NotImplementedError
+
+
+class LicenseApi:
+    """License validation contract (api-gateway/src/middleware/license_validation.rs)."""
+
+    async def check_feature(self, ctx: SecurityContext, feature: str) -> bool:
+        raise NotImplementedError
+
+
+class AuthzApi:
+    """PDP contract: returns (possibly narrowed) access scope for a request
+    (modules/system/authz-resolver)."""
+
+    async def authorize(self, ctx: SecurityContext, operation_id: str) -> SecurityContext:
+        return ctx
+
+
+class _TokenBucket:
+    def __init__(self, rps: float, burst: int) -> None:
+        self.rate = rps
+        self.capacity = float(max(burst, 1))
+        self.tokens = self.capacity
+        self.last = time.monotonic()
+
+    def try_acquire(self) -> bool:
+        now = time.monotonic()
+        self.tokens = min(self.capacity, self.tokens + (now - self.last) * self.rate)
+        self.last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class RateLimiterMap:
+    """Per-route limiter state (RateLimiterMap::from_specs, middleware/rate_limit.rs)."""
+
+    def __init__(self) -> None:
+        self._buckets: dict[str, _TokenBucket] = {}
+        self._semaphores: dict[str, asyncio.Semaphore] = {}
+
+    def for_spec(self, spec: OperationSpec) -> tuple[Optional[_TokenBucket], Optional[asyncio.Semaphore]]:
+        rl = spec.rate_limit
+        if rl is None:
+            return None, None
+        key = f"{spec.method} {spec.path}"
+        if key not in self._buckets:
+            self._buckets[key] = _TokenBucket(rl.rps, rl.burst)
+            self._semaphores[key] = asyncio.Semaphore(rl.max_in_flight)
+        return self._buckets[key], self._semaphores[key]
+
+
+def _problem_response(problem: Problem, request_id: Optional[str] = None) -> web.Response:
+    if request_id and problem.trace_id is None:
+        problem.trace_id = request_id
+    return web.json_response(
+        problem.to_dict(), status=problem.status, content_type=Problem.CONTENT_TYPE
+    )
+
+
+def build_middlewares(
+    *,
+    tracer: Tracer,
+    timeout_secs: float = 30.0,
+    max_body_bytes: int = 64 * 1024 * 1024,
+    cors_allow_origin: Optional[str] = None,
+    auth_disabled: bool = False,
+    default_tenant: str = "default",
+    authn: Optional[AuthnApi] = None,
+    authz: Optional[AuthzApi] = None,
+    license_api: Optional[LicenseApi] = None,
+    limiter: Optional[RateLimiterMap] = None,
+) -> list:
+    limiter = limiter or RateLimiterMap()
+
+    @web.middleware
+    async def request_id_mw(request: web.Request, handler):
+        # layer 1: SetRequestId/PropagateRequestId (module.rs:331-336)
+        rid = request.headers.get(REQUEST_ID_HEADER) or uuid.uuid4().hex
+        request[REQUEST_ID_KEY] = rid
+        resp = await handler(request)
+        resp.headers[REQUEST_ID_HEADER] = rid
+        return resp
+
+    @web.middleware
+    async def trace_mw(request: web.Request, handler):
+        # layer 2: TraceLayer span with method/uri/request_id (module.rs:276-281)
+        with tracer.span(
+            f"http {request.method} {request.path}",
+            traceparent=request.headers.get("traceparent"),
+            method=request.method,
+            path=request.path,
+            request_id=request.get(REQUEST_ID_KEY),
+        ) as span:
+            request["trace_id"] = span.trace_id
+            resp = await handler(request)
+            span.set_attribute("status", resp.status)
+            return resp
+
+    @web.middleware
+    async def timeout_mw(request: web.Request, handler):
+        # layer 3: TimeoutLayer, 30s default (module.rs:265). SSE streams exempt —
+        # the timeout guards handler completion, and streaming handlers return
+        # a prepared StreamResponse quickly or not at all.
+        spec: Optional[OperationSpec] = request.get("spec")
+        if spec is not None and spec.sse:
+            return await handler(request)
+        try:
+            return await asyncio.wait_for(handler(request), timeout_secs)
+        except asyncio.TimeoutError:
+            return _problem_response(
+                Problem(status=504, title="Gateway Timeout", code="timeout",
+                        detail=f"request exceeded {timeout_secs}s"),
+                request.get(REQUEST_ID_KEY),
+            )
+
+    @web.middleware
+    async def body_limit_mw(request: web.Request, handler):
+        # layer 4: RequestBodyLimitLayer (module.rs:261)
+        cl = request.content_length
+        if cl is not None and cl > max_body_bytes:
+            return _problem_response(
+                Problem(status=413, title="Payload Too Large", code="body_too_large",
+                        detail=f"body exceeds {max_body_bytes} bytes"),
+                request.get(REQUEST_ID_KEY),
+            )
+        return await handler(request)
+
+    @web.middleware
+    async def cors_mw(request: web.Request, handler):
+        # layer 5: CORS (optional; cors.rs)
+        if cors_allow_origin is None:
+            return await handler(request)
+        if request.method == "OPTIONS":
+            resp = web.Response(status=204)
+        else:
+            resp = await handler(request)
+        resp.headers["Access-Control-Allow-Origin"] = cors_allow_origin
+        resp.headers["Access-Control-Allow-Methods"] = "GET,POST,PUT,PATCH,DELETE,OPTIONS"
+        resp.headers["Access-Control-Allow-Headers"] = "authorization,content-type,x-request-id"
+        return resp
+
+    @web.middleware
+    async def mime_mw(request: web.Request, handler):
+        # layer 6: per-route MIME validation (middleware/mime_validation.rs)
+        spec: Optional[OperationSpec] = request.get("spec")
+        if (
+            spec is not None
+            and request.method in ("POST", "PUT", "PATCH")
+            and request.content_length
+        ):
+            ctype = (request.content_type or "").lower()
+            if spec.accepted_mime and not any(
+                ctype == m or (m.endswith("/*") and ctype.startswith(m[:-1]))
+                for m in spec.accepted_mime
+            ):
+                return _problem_response(
+                    Problem(status=415, title="Unsupported Media Type",
+                            code="unsupported_media_type",
+                            detail=f"expected one of {list(spec.accepted_mime)}, got {ctype!r}"),
+                    request.get(REQUEST_ID_KEY),
+                )
+        return await handler(request)
+
+    @web.middleware
+    async def rate_limit_mw(request: web.Request, handler):
+        # layer 7: RPS bucket + in-flight semaphore (middleware/rate_limit.rs)
+        spec: Optional[OperationSpec] = request.get("spec")
+        if spec is None:
+            return await handler(request)
+        bucket, sem = limiter.for_spec(spec)
+        if bucket is not None and not bucket.try_acquire():
+            return _problem_response(
+                Problem(status=429, title="Too Many Requests", code="rate_limited",
+                        detail="per-route rate limit exceeded"),
+                request.get(REQUEST_ID_KEY),
+            )
+        if sem is not None:
+            if sem.locked():
+                return _problem_response(
+                    Problem(status=429, title="Too Many Requests", code="too_many_in_flight",
+                            detail="per-route in-flight limit reached"),
+                    request.get(REQUEST_ID_KEY),
+                )
+            async with sem:
+                return await handler(request)
+        return await handler(request)
+
+    @web.middleware
+    async def error_mapping_mw(request: web.Request, handler):
+        # layer 8: error mapping → RFC-9457 (libs/modkit/src/api/error_layer.rs)
+        try:
+            return await handler(request)
+        except ProblemError as e:
+            return _problem_response(e.problem, request.get(REQUEST_ID_KEY))
+        except web.HTTPException:
+            raise
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            import logging
+            logging.getLogger("gateway").exception("unhandled error in %s", request.path)
+            return _problem_response(
+                Problem(status=500, title="Internal Server Error", code="internal_error"),
+                request.get(REQUEST_ID_KEY),
+            )
+
+    @web.middleware
+    async def auth_mw(request: web.Request, handler):
+        # layer 9: route policy → token verify → SecurityContext (middleware/auth.rs:83-127)
+        spec: Optional[OperationSpec] = request.get("spec")
+        if spec is None:
+            # fail CLOSED: only the builtin public endpoints may run without a
+            # matched OperationSpec (auth.rs public-route matchers :31,120-127);
+            # anything else without a spec is a routing bug or a 404 probe
+            if request.path in BUILTIN_PUBLIC_PATHS or auth_disabled:
+                return await handler(request)
+            raise ProblemError.unauthorized("no route policy for this path")
+        if spec.auth == AuthPolicy.PUBLIC:
+            request[SECURITY_CONTEXT_KEY] = SecurityContext.anonymous(default_tenant)
+            return await handler(request)
+        if auth_disabled:
+            # dev-mode parity: auth_disabled: true (quickstart.yaml:108)
+            request[SECURITY_CONTEXT_KEY] = SecurityContext.anonymous(default_tenant)
+            return await handler(request)
+        authz_header = request.headers.get("Authorization", "")
+        token = authz_header[7:] if authz_header.lower().startswith("bearer ") else None
+        if authn is None:
+            raise ProblemError.unauthorized("no authn resolver configured")
+        sec_ctx = await authn.authenticate(
+            token, {"path": request.path, "method": request.method,
+                    "tenant_header": request.headers.get("x-tenant-id")}
+        )
+        missing = [s for s in spec.required_scopes if not sec_ctx.has_scope(s)]
+        if missing:
+            raise ProblemError.forbidden(f"missing required scopes: {missing}")
+        request[SECURITY_CONTEXT_KEY] = sec_ctx
+        return await handler(request)
+
+    @web.middleware
+    async def policy_mw(request: web.Request, handler):
+        # layer 10: policy-engine (PDP) injection (module.rs:213)
+        spec: Optional[OperationSpec] = request.get("spec")
+        sec_ctx: Optional[SecurityContext] = request.get(SECURITY_CONTEXT_KEY)
+        if spec is not None and sec_ctx is not None and authz is not None:
+            request[SECURITY_CONTEXT_KEY] = await authz.authorize(sec_ctx, spec.operation_id)
+        return await handler(request)
+
+    @web.middleware
+    async def license_mw(request: web.Request, handler):
+        # layer 11: license validation per OperationSpec (middleware/license_validation.rs)
+        spec: Optional[OperationSpec] = request.get("spec")
+        if spec is not None and spec.license_feature is not None:
+            sec_ctx = request.get(SECURITY_CONTEXT_KEY)
+            if license_api is None or not await license_api.check_feature(sec_ctx, spec.license_feature):
+                raise ProblemError(
+                    Problem(status=403, title="Forbidden", code="license_required",
+                            detail=f"feature '{spec.license_feature}' is not licensed"))
+        return await handler(request)
+
+    # outermost → innermost; aiohttp applies the list in order around the handler
+    return [
+        request_id_mw,
+        trace_mw,
+        timeout_mw,
+        body_limit_mw,
+        cors_mw,
+        mime_mw,
+        rate_limit_mw,
+        error_mapping_mw,
+        auth_mw,
+        policy_mw,
+        license_mw,
+    ]

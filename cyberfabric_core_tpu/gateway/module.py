@@ -1,0 +1,201 @@
+"""The api-gateway module — the single REST host.
+
+Reference: modules/system/api-gateway/src/module.rs — builds the router, applies the
+12-layer middleware stack (:162), serves (:410-430), implements rest_prepare/
+rest_finalize (:565/:582), hosts /docs, /openapi.json, /health, /healthz.
+
+aiohttp is the hyper/axum analogue here: the low-level HTTP engine. Everything the
+reference's gateway adds on top (middleware order, route specs, OpenAPI, problem
+responses, SSE) is this package's code.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from aiohttp import web
+
+from ..modkit import Module, ReadySignal
+from ..modkit.contracts import ApiGatewayCapability, RunnableCapability, SystemCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.registry import module
+from ..modkit.telemetry import Tracer
+from .middleware import (
+    SECURITY_CONTEXT_KEY,
+    AuthnApi,
+    AuthzApi,
+    LicenseApi,
+    RateLimiterMap,
+    build_middlewares,
+)
+from .openapi import OpenApiRegistry
+from .router import OperationSpec, RateLimitSpec, RestRouter
+
+
+class HealthApi:
+    """Detailed health provider contract; module-orchestrator registers the real one."""
+
+    async def health(self) -> dict[str, Any]:
+        return {"status": "ok"}
+
+
+@dataclass
+class GatewayConfig:
+    bind_addr: str = "127.0.0.1:8086"
+    timeout_secs: float = 30.0
+    max_body_bytes: int = 64 * 1024 * 1024
+    cors_allow_origin: Optional[str] = None
+    auth_disabled: bool = False
+    default_tenant: str = "default"
+    # default operating envelope (config/quickstart.yaml:99-106)
+    default_rps: float = 1000.0
+    default_burst: int = 200
+    default_in_flight: int = 64
+
+
+@module(name="api_gateway", capabilities=["rest_host", "stateful", "system"])
+class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemCapability):
+    def __init__(self) -> None:
+        self.config = GatewayConfig()
+        self.tracer = Tracer()
+        self.app: Optional[web.Application] = None
+        self.router_specs: list[OperationSpec] = []
+        self.openapi_doc: dict[str, Any] = {}
+        self._runner: Optional[web.AppRunner] = None
+        self._site: Optional[web.TCPSite] = None
+        self.bound_port: Optional[int] = None
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        raw = ctx.raw_config()
+        self.config = GatewayConfig(**raw) if raw else GatewayConfig()
+        self._hub = ctx.client_hub
+
+    # ------------------------------------------------------------- rest host
+    def rest_prepare(self, ctx: ModuleCtx) -> tuple[RestRouter, OpenApiRegistry]:
+        return RestRouter(), OpenApiRegistry()
+
+    def rest_finalize(self, ctx: ModuleCtx, router: RestRouter, openapi: OpenApiRegistry) -> None:
+        cfg = self.config
+        hub = ctx.client_hub
+        self.router_specs = list(router.operations)
+        self.openapi_doc = openapi.build(router)
+
+        spec_by_key: dict[tuple[str, str], OperationSpec] = {}
+        app_routes: list[web.RouteDef] = []
+        for spec in router.operations:
+            if spec.rate_limit is None:
+                spec.rate_limit = RateLimitSpec(
+                    rps=cfg.default_rps, burst=cfg.default_burst,
+                    max_in_flight=cfg.default_in_flight,
+                )
+            spec_by_key[(spec.method, spec.path)] = spec
+            app_routes.append(
+                web.route(spec.method, spec.path, _wrap_handler(spec))
+            )
+
+        @web.middleware
+        async def spec_attach_mw(request: web.Request, handler):
+            # layer 0: attach the matched OperationSpec so per-route middlewares
+            # (timeout/MIME/rate/auth/license) can consult it
+            resource = request.match_info.route.resource
+            canonical = resource.canonical if resource is not None else None
+            if canonical is not None:
+                request["spec"] = spec_by_key.get((request.method, canonical))
+            return await handler(request)
+
+        middlewares = [spec_attach_mw] + build_middlewares(
+            tracer=self.tracer,
+            timeout_secs=cfg.timeout_secs,
+            max_body_bytes=cfg.max_body_bytes,
+            cors_allow_origin=cfg.cors_allow_origin,
+            auth_disabled=cfg.auth_disabled,
+            default_tenant=cfg.default_tenant,
+            authn=hub.try_get(AuthnApi),
+            authz=hub.try_get(AuthzApi),
+            license_api=hub.try_get(LicenseApi),
+            limiter=RateLimiterMap(),
+        )
+
+        app = web.Application(middlewares=middlewares, client_max_size=cfg.max_body_bytes)
+        app.add_routes(app_routes)
+        app.router.add_get("/openapi.json", self._serve_openapi)
+        app.router.add_get("/health", self._serve_health)
+        app.router.add_get("/healthz", self._serve_healthz)
+        app.router.add_get("/docs", self._serve_docs)
+        self.app = app
+
+    # ------------------------------------------------------------- builtin endpoints
+    async def _serve_openapi(self, request: web.Request) -> web.Response:
+        return web.json_response(self.openapi_doc)
+
+    async def _serve_health(self, request: web.Request) -> web.Response:
+        provider = self._hub.try_get(HealthApi) if hasattr(self, "_hub") else None
+        detail = await provider.health() if provider else {"status": "ok"}
+        return web.json_response(detail)
+
+    async def _serve_healthz(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def _serve_docs(self, request: web.Request) -> web.Response:
+        # offline-friendly minimal docs page (reference embeds UI assets)
+        rows = "".join(
+            f"<tr><td><code>{s.method}</code></td><td><code>{s.path}</code></td>"
+            f"<td>{s.summary}</td><td>{s.auth.value}</td></tr>"
+            for s in sorted(self.router_specs, key=lambda s: (s.path, s.method))
+        )
+        html = (
+            "<html><head><title>tpu-fabric API</title></head><body>"
+            "<h1>tpu-fabric API</h1>"
+            '<p>Full spec: <a href="/openapi.json">/openapi.json</a></p>'
+            f"<table border=1 cellpadding=4><tr><th>Method</th><th>Path</th>"
+            f"<th>Summary</th><th>Auth</th></tr>{rows}</table></body></html>"
+        )
+        return web.Response(text=html, content_type="text/html")
+
+    # ------------------------------------------------------------- runnable
+    async def start(self, ctx: ModuleCtx, ready: ReadySignal) -> None:
+        if self.app is None:
+            raise RuntimeError("rest_finalize was not called before start")
+        host, _, port = self.config.bind_addr.rpartition(":")
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, host or "127.0.0.1", int(port))
+        await self._site.start()
+        # resolve the actual bound port (supports port 0 in tests)
+        server = self._site._server  # noqa: SLF001 — aiohttp exposes no public accessor
+        if server and server.sockets:
+            self.bound_port = server.sockets[0].getsockname()[1]
+        ready.notify_ready()
+
+    async def stop(self, ctx: ModuleCtx) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+            self._site = None
+
+
+def _wrap_handler(spec: OperationSpec):
+    """Adapt a module handler to aiohttp: dict/list → JSON, Response passes through.
+
+    Handlers receive the aiohttp request; SecurityContext is at
+    ``request['security_context']`` (the request-extensions pattern, auth.rs:127).
+    """
+
+    async def handler(request: web.Request) -> web.StreamResponse:
+        result = await spec.handler(request)
+        if isinstance(result, web.StreamResponse):
+            return result
+        if isinstance(result, (dict, list)):
+            return web.json_response(result)
+        if result is None:
+            return web.Response(status=204)
+        if isinstance(result, tuple) and len(result) == 2:
+            body, status = result
+            return web.json_response(body, status=status)
+        return web.Response(text=str(result))
+
+    handler.__name__ = spec.operation_id
+    return handler

@@ -1,0 +1,80 @@
+"""OpenAPI registry — collects operation specs + schemas into /openapi.json.
+
+Reference: libs/modkit/src/api/openapi_registry.rs (OpenApiRegistryImpl, 670 LoC) and
+the CI contract gate that diffs generated specs (.github/workflows/api_contracts.yml).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .router import AuthPolicy, OperationSpec, RestRouter
+
+
+class OpenApiRegistry:
+    def __init__(self, title: str = "tpu-fabric", version: str = "0.1.0") -> None:
+        self.title = title
+        self.version = version
+        self.schemas: dict[str, dict] = {}
+
+    def register_schema(self, name: str, schema: dict) -> dict:
+        """Register a named component schema; returns a $ref stub."""
+        self.schemas[name] = schema
+        return {"$ref": f"#/components/schemas/{name}"}
+
+    def build(self, router: RestRouter) -> dict[str, Any]:
+        paths: dict[str, dict] = {}
+        for op in sorted(router.operations, key=lambda o: (o.path, o.method)):
+            entry: dict[str, Any] = {
+                "operationId": op.operation_id,
+                "summary": op.summary,
+                "tags": list(op.tags) or ([op.module] if op.module else []),
+                "responses": self._responses(op),
+            }
+            if op.description:
+                entry["description"] = op.description
+            if op.path_params:
+                entry["parameters"] = [
+                    {"name": p, "in": "path", "required": True, "schema": {"type": "string"}}
+                    for p in op.path_params
+                ]
+            if op.request_schema is not None:
+                entry["requestBody"] = {
+                    "required": True,
+                    "content": {m: {"schema": op.request_schema} for m in op.accepted_mime},
+                }
+            if op.auth == AuthPolicy.REQUIRED:
+                entry["security"] = [{"bearerAuth": list(op.required_scopes)}]
+            paths.setdefault(op.path, {})[op.method.lower()] = entry
+        return {
+            "openapi": "3.0.3",
+            "info": {"title": self.title, "version": self.version},
+            "paths": paths,
+            "components": {
+                "schemas": self.schemas,
+                "securitySchemes": {
+                    "bearerAuth": {"type": "http", "scheme": "bearer", "bearerFormat": "JWT"}
+                },
+            },
+        }
+
+    def _responses(self, op: OperationSpec) -> dict[str, Any]:
+        if op.sse:
+            ok = {
+                "description": "SSE stream; `data: <json>` events terminated by `data: [DONE]`",
+                "content": {"text/event-stream": {"schema": {"type": "string"}}},
+            }
+        elif op.response_schema is not None:
+            ok = {
+                "description": op.response_description,
+                "content": {"application/json": {"schema": op.response_schema}},
+            }
+        else:
+            ok = {"description": op.response_description}
+        return {
+            "200": ok,
+            "default": {
+                "description": "Error (RFC-9457)",
+                "content": {"application/problem+json": {"schema": {"type": "object"}}},
+            },
+        }

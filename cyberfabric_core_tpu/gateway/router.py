@@ -1,0 +1,165 @@
+"""OperationBuilder-style route registration.
+
+Reference: libs/modkit/src/api/operation_builder.rs (2,138 LoC type-state builder
+that makes handler/auth/response declarations mandatory before a route can be
+registered). Python rendition: a fluent builder whose ``register()`` validates the
+same invariants at startup time — a route missing a handler or an auth declaration
+is a boot failure, not a latent 500.
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Optional
+
+Handler = Callable[..., Awaitable[Any]]
+
+_PATH_PARAM_RE = re.compile(r"\{([A-Za-z_][A-Za-z0-9_]*)\}")
+
+
+class AuthPolicy(enum.Enum):
+    """Route auth policy (api-gateway/src/middleware/auth.rs:31 public-route
+    matchers vs required-auth default)."""
+
+    PUBLIC = "public"
+    REQUIRED = "required"
+
+
+@dataclass
+class RateLimitSpec:
+    """Per-route RPS bucket + in-flight semaphore
+    (api-gateway/src/middleware/rate_limit.rs; defaults quickstart.yaml:99-106)."""
+
+    rps: float = 1000.0
+    burst: int = 200
+    max_in_flight: int = 64
+
+
+@dataclass
+class OperationSpec:
+    """Everything the gateway needs to serve + document one operation."""
+
+    method: str
+    path: str
+    handler: Handler
+    operation_id: str
+    summary: str = ""
+    description: str = ""
+    tags: tuple[str, ...] = ()
+    auth: AuthPolicy = AuthPolicy.REQUIRED
+    required_scopes: tuple[str, ...] = ()
+    license_feature: Optional[str] = None
+    rate_limit: Optional[RateLimitSpec] = None
+    accepted_mime: tuple[str, ...] = ("application/json",)
+    request_schema: Optional[dict] = None
+    response_schema: Optional[dict] = None
+    response_description: str = "OK"
+    sse: bool = False
+    module: str = ""
+
+    @property
+    def path_params(self) -> list[str]:
+        return _PATH_PARAM_RE.findall(self.path)
+
+
+class OperationBuilder:
+    """Fluent builder; ``register()`` enforces completeness (the type-state
+    equivalent: handler and an explicit auth choice are mandatory)."""
+
+    def __init__(self, router: "RestRouter", method: str, path: str, module: str) -> None:
+        self._router = router
+        self._kw: dict[str, Any] = {
+            "method": method.upper(),
+            "path": path,
+            "module": module,
+            "handler": None,
+            "operation_id": None,
+            "auth": None,
+        }
+
+    def operation_id(self, op_id: str) -> "OperationBuilder":
+        self._kw["operation_id"] = op_id
+        return self
+
+    def summary(self, text: str) -> "OperationBuilder":
+        self._kw["summary"] = text
+        return self
+
+    def description(self, text: str) -> "OperationBuilder":
+        self._kw["description"] = text
+        return self
+
+    def tags(self, *tags: str) -> "OperationBuilder":
+        self._kw["tags"] = tags
+        return self
+
+    def public(self) -> "OperationBuilder":
+        self._kw["auth"] = AuthPolicy.PUBLIC
+        return self
+
+    def auth_required(self, *scopes: str) -> "OperationBuilder":
+        self._kw["auth"] = AuthPolicy.REQUIRED
+        self._kw["required_scopes"] = scopes
+        return self
+
+    def license_feature(self, feature: str) -> "OperationBuilder":
+        self._kw["license_feature"] = feature
+        return self
+
+    def rate_limit(self, rps: float, burst: int = 200, max_in_flight: int = 64) -> "OperationBuilder":
+        self._kw["rate_limit"] = RateLimitSpec(rps=rps, burst=burst, max_in_flight=max_in_flight)
+        return self
+
+    def accepts(self, *mime: str) -> "OperationBuilder":
+        self._kw["accepted_mime"] = mime
+        return self
+
+    def request_schema(self, schema: dict) -> "OperationBuilder":
+        self._kw["request_schema"] = schema
+        return self
+
+    def response_schema(self, schema: dict, description: str = "OK") -> "OperationBuilder":
+        self._kw["response_schema"] = schema
+        self._kw["response_description"] = description
+        return self
+
+    def sse_response(self) -> "OperationBuilder":
+        self._kw["sse"] = True
+        return self
+
+    def handler(self, fn: Handler) -> "OperationBuilder":
+        self._kw["handler"] = fn
+        return self
+
+    def register(self) -> OperationSpec:
+        missing = [k for k in ("handler", "auth") if self._kw[k] is None]
+        if missing:
+            raise ValueError(
+                f"operation {self._kw['method']} {self._kw['path']}: missing {missing} "
+                "(handler and an explicit auth declaration are mandatory)"
+            )
+        if self._kw["operation_id"] is None:
+            slug = re.sub(r"[^a-zA-Z0-9]+", "_", self._kw["path"]).strip("_")
+            self._kw["operation_id"] = f"{self._kw['method'].lower()}_{slug}"
+        spec = OperationSpec(**{k: v for k, v in self._kw.items() if v is not None or k in ("request_schema", "response_schema", "license_feature")})
+        self._router.add(spec)
+        return spec
+
+
+class RestRouter:
+    """Collects OperationSpecs from all modules during the rest phase."""
+
+    def __init__(self) -> None:
+        self.operations: list[OperationSpec] = []
+
+    def operation(self, method: str, path: str, *, module: str = "") -> OperationBuilder:
+        return OperationBuilder(self, method, path, module)
+
+    def add(self, spec: OperationSpec) -> None:
+        for existing in self.operations:
+            if existing.method == spec.method and existing.path == spec.path:
+                raise ValueError(f"duplicate route {spec.method} {spec.path} "
+                                 f"({existing.module} vs {spec.module})")
+        self.operations.append(spec)

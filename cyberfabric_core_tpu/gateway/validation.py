@@ -1,0 +1,54 @@
+"""Request-body JSON Schema validation helpers.
+
+Reference: llm-gateway validates every request against its GTS JSON Schemas
+(modules/llm-gateway/docs/DESIGN.md:130-174); errors surface as RFC-9457 422s with a
+field list (serverless ADR:2536-2556).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import jsonschema
+from aiohttp import web
+
+from ..modkit.errors import ProblemError
+
+# Keyed by id(schema) but holding a strong reference to the schema itself, so a
+# GC'd dict's id can never be reused while its validator is cached. Bounded: route
+# schemas are static; a runaway dynamic-schema caller trips the reset.
+_VALIDATOR_CACHE: dict[int, tuple[dict, jsonschema.Draft202012Validator]] = {}
+_VALIDATOR_CACHE_MAX = 1024
+
+
+def validate_against(schema: dict, payload: Any) -> None:
+    """Validate payload; raises ProblemError(422) with an errors[] field list."""
+    entry = _VALIDATOR_CACHE.get(id(schema))
+    if entry is not None and entry[0] is schema:
+        validator = entry[1]
+    else:
+        validator = jsonschema.Draft202012Validator(schema)
+        if len(_VALIDATOR_CACHE) >= _VALIDATOR_CACHE_MAX:
+            _VALIDATOR_CACHE.clear()
+        _VALIDATOR_CACHE[id(schema)] = (schema, validator)
+    errors = sorted(validator.iter_errors(payload), key=lambda e: list(e.absolute_path))
+    if errors:
+        raise ProblemError.unprocessable(
+            "request body failed schema validation",
+            errors=[
+                {"field": "/".join(str(p) for p in e.absolute_path) or "<root>",
+                 "message": e.message[:300]}
+                for e in errors[:16]
+            ],
+        )
+
+
+async def read_json(request: web.Request, schema: Optional[dict] = None) -> Any:
+    try:
+        payload = await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise ProblemError.bad_request(f"malformed JSON body: {e}", code="malformed_json")
+    if schema is not None:
+        validate_against(schema, payload)
+    return payload

@@ -1,0 +1,11 @@
+"""Model tier — JAX functional model definitions for the BASELINE architectures.
+
+The reference has no in-repo model code (SURVEY §0: inference is delegated to
+external providers); this tier is the real implementation of what model-registry's
+PRD only specifies (managed local models, safetensors format, architectures —
+modules/model-registry/docs/PRD.md:200-224).
+"""
+
+from .configs import MODEL_CONFIGS, ModelConfig, get_config
+
+__all__ = ["MODEL_CONFIGS", "ModelConfig", "get_config"]

@@ -1,0 +1,111 @@
+"""BERT encoder family (bge-base-en embeddings) — functional JAX forward.
+
+BASELINE config #3: "file-parser embedding worker: bge-base-en batch-encode 10k docs
+on TPU". Same TPU-first structure as the decoder: stacked layers + lax.scan, bf16
+matmuls with f32 accumulation, static shapes (pad to bucket lengths).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import encoder_attention
+from ..ops.norms import layer_norm
+from .configs import ModelConfig
+
+Params = dict[str, Any]
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    H, I, V, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
+    k = iter(jax.random.split(key, 16))
+
+    def w(rng, *shape):
+        scale = 0.02
+        return (jax.random.normal(rng, shape, jnp.float32) * scale).astype(dtype)
+
+    def zeros(*shape):
+        return jnp.zeros(shape, dtype)
+
+    def ones(*shape):
+        return jnp.ones(shape, dtype)
+
+    return {
+        "word_embed": w(next(k), V, H),
+        "pos_embed": w(next(k), cfg.max_position, H),
+        "type_embed": w(next(k), cfg.type_vocab_size, H),
+        "embed_ln_w": ones(H), "embed_ln_b": zeros(H),
+        "layers": {
+            "wq": w(next(k), L, H, H), "bq": zeros(L, H),
+            "wk": w(next(k), L, H, H), "bk": zeros(L, H),
+            "wv": w(next(k), L, H, H), "bv": zeros(L, H),
+            "wo": w(next(k), L, H, H), "bo": zeros(L, H),
+            "attn_ln_w": ones(L, H), "attn_ln_b": zeros(L, H),
+            "ffn_in": w(next(k), L, H, I), "ffn_in_b": zeros(L, I),
+            "ffn_out": w(next(k), L, I, H), "ffn_out_b": zeros(L, H),
+            "ffn_ln_w": ones(L, H), "ffn_ln_b": zeros(L, H),
+        },
+    }
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,       # [B, T] int32
+    attention_mask: jnp.ndarray,  # [B, T] 1=token 0=pad
+) -> jnp.ndarray:
+    """Returns token-level hidden states [B, T, H]."""
+    B, T = input_ids.shape
+    Hh, D = cfg.num_heads, cfg.head_dim
+    pos = jnp.arange(T, dtype=jnp.int32)[None, :]
+    h = (
+        params["word_embed"][input_ids]
+        + params["pos_embed"][pos]
+        + params["type_embed"][jnp.zeros_like(input_ids)]
+    )
+    h = layer_norm(h, params["embed_ln_w"], params["embed_ln_b"], cfg.layer_norm_eps)
+
+    def layer_body(h, lp):
+        def proj(w, b):
+            return (jnp.einsum("bth,hd->btd", h, w, preferred_element_type=jnp.float32)
+                    + b.astype(jnp.float32)).astype(h.dtype)
+
+        q = proj(lp["wq"], lp["bq"]).reshape(B, T, Hh, D)
+        k = proj(lp["wk"], lp["bk"]).reshape(B, T, Hh, D)
+        v = proj(lp["wv"], lp["bv"]).reshape(B, T, Hh, D)
+        attn = encoder_attention(q, k, v, attention_mask).reshape(B, T, Hh * D)
+        attn_out = (jnp.einsum("btd,dh->bth", attn, lp["wo"],
+                               preferred_element_type=jnp.float32)
+                    + lp["bo"].astype(jnp.float32)).astype(h.dtype)
+        h = layer_norm(h + attn_out, lp["attn_ln_w"], lp["attn_ln_b"], cfg.layer_norm_eps)
+
+        ffn = jnp.einsum("bth,hi->bti", h, lp["ffn_in"],
+                         preferred_element_type=jnp.float32) + lp["ffn_in_b"].astype(jnp.float32)
+        ffn = jax.nn.gelu(ffn, approximate=False).astype(h.dtype)
+        ffn_out = (jnp.einsum("bti,ih->bth", ffn, lp["ffn_out"],
+                              preferred_element_type=jnp.float32)
+                   + lp["ffn_out_b"].astype(jnp.float32)).astype(h.dtype)
+        h = layer_norm(h + ffn_out, lp["ffn_ln_w"], lp["ffn_ln_b"], cfg.layer_norm_eps)
+        return h, None
+
+    h, _ = jax.lax.scan(layer_body, h, params["layers"])
+    return h
+
+
+def embed_pooled(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """bge-style sentence embedding: CLS token, L2-normalized. [B, H] f32."""
+    h = forward(params, cfg, input_ids, attention_mask)
+    if cfg.pooling == "mean":
+        maskf = attention_mask[:, :, None].astype(jnp.float32)
+        pooled = (h.astype(jnp.float32) * maskf).sum(1) / jnp.maximum(maskf.sum(1), 1.0)
+    else:  # cls
+        pooled = h[:, 0, :].astype(jnp.float32)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)

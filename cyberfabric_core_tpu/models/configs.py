@@ -1,0 +1,96 @@
+"""Architecture configs for the BASELINE model set.
+
+BASELINE.json configs name Llama-3-8B/70B, Mistral-7B, Phi-3-mini, bge-base-en;
+model-registry PRD:200-224 requires architecture/size/format metadata for managed
+local models. All decoder models here are the llama family (RMSNorm + RoPE + GQA +
+SwiGLU); family differences are config-driven, not code-forked — one TPU-optimized
+forward serves them all.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    architecture: str  # "llama" (decoder family) | "bert" (encoder family)
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    max_position: int = 8192
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    sliding_window: Optional[int] = None  # Mistral-style SWA
+    attention_bias: bool = False
+    # bert-family extras
+    layer_norm_eps: float = 1e-12
+    type_vocab_size: int = 2
+    pooling: str = "cls"  # bge uses CLS pooling + L2 norm
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    def param_count(self) -> int:
+        """Approximate parameter count (for HBM budgeting)."""
+        h, i, v, l = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
+        attn = h * (self.num_heads * self.head_dim) + 2 * h * (self.num_kv_heads * self.head_dim) \
+            + (self.num_heads * self.head_dim) * h
+        mlp = 3 * h * i
+        emb = v * h * (1 if self.tie_embeddings else 2)
+        return l * (attn + mlp + 2 * h) + emb + h
+
+
+MODEL_CONFIGS: dict[str, ModelConfig] = {
+    # testing config: tiny shapes, CPU-fast, same code paths
+    "tiny-llama": ModelConfig(
+        name="tiny-llama", architecture="llama", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_position=256, rope_theta=10000.0,
+    ),
+    "llama-3-8b": ModelConfig(
+        name="llama-3-8b", architecture="llama", vocab_size=128256, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        head_dim=128, max_position=8192, rope_theta=500000.0,
+    ),
+    "llama-3-70b": ModelConfig(
+        name="llama-3-70b", architecture="llama", vocab_size=128256, hidden_size=8192,
+        intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
+        head_dim=128, max_position=8192, rope_theta=500000.0,
+    ),
+    "mistral-7b": ModelConfig(
+        name="mistral-7b", architecture="llama", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        head_dim=128, max_position=8192, rope_theta=10000.0, sliding_window=4096,
+    ),
+    "phi-3-mini": ModelConfig(
+        name="phi-3-mini", architecture="llama", vocab_size=32064, hidden_size=3072,
+        intermediate_size=8192, num_layers=32, num_heads=32, num_kv_heads=32,
+        head_dim=96, max_position=4096, rope_theta=10000.0,
+    ),
+    "bge-base-en": ModelConfig(
+        name="bge-base-en", architecture="bert", vocab_size=30522, hidden_size=768,
+        intermediate_size=3072, num_layers=12, num_heads=12, num_kv_heads=12,
+        head_dim=64, max_position=512, rope_theta=0.0,
+    ),
+    "tiny-bert": ModelConfig(
+        name="tiny-bert", architecture="bert", vocab_size=384, hidden_size=32,
+        intermediate_size=64, num_layers=2, num_heads=2, num_kv_heads=2, head_dim=16,
+        max_position=128, rope_theta=0.0,
+    ),
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    try:
+        return MODEL_CONFIGS[name]
+    except KeyError:
+        raise KeyError(f"unknown model config {name!r}; known: {sorted(MODEL_CONFIGS)}")

@@ -1,0 +1,148 @@
+"""The llama decoder family (Llama-3, Mistral, Phi-3): functional JAX forward.
+
+TPU-first design choices:
+- **Stacked layer parameters + lax.scan** over layers: one compiled layer body
+  regardless of depth (compile time O(1) in num_layers, and XLA pipelines the scan).
+- **Dense KV cache [L, B, S, Hkv, D]** with per-row insert offsets via vmapped
+  dynamic_update_slice (a scatter XLA handles natively); static S keeps every shape
+  compile-time constant.
+- **bf16 weights/activations, f32 softmax/norm statistics**, einsum contractions
+  with preferred_element_type=f32 so the MXU accumulates in f32.
+- Forward returns hidden states; the LM head is applied separately so prefill can
+  gather the single last-token hidden state before touching the [H, 128k] head
+  matmul (vocab matmul on all T prefill positions would be pure waste).
+
+Weight names follow our own tree; runtime/weights.py maps HF safetensors names onto
+it (reference requirement: model-registry PRD.md:200-224 — managed models,
+safetensors format).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention_with_cache
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope, rope_frequencies
+from .configs import ModelConfig
+
+Params = dict[str, Any]
+KVCache = tuple[jnp.ndarray, jnp.ndarray]  # (k, v): [L, B, S, Hkv, D]
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random-init parameters at model shape (bench/synthetic-weight path)."""
+    H, I, V, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
+    Dq, Dkv = cfg.num_heads * cfg.head_dim, cfg.num_kv_heads * cfg.head_dim
+    k = iter(jax.random.split(key, 12))
+
+    def w(rng, *shape):
+        scale = 1.0 / jnp.sqrt(shape[-2] if len(shape) > 1 else shape[-1])
+        return (jax.random.normal(rng, shape, jnp.float32) * scale).astype(dtype)
+
+    params: Params = {
+        "embed": w(next(k), V, H),
+        "final_norm": jnp.ones((H,), dtype),
+        "layers": {
+            "attn_norm": jnp.ones((L, H), dtype),
+            "wq": w(next(k), L, H, Dq),
+            "wk": w(next(k), L, H, Dkv),
+            "wv": w(next(k), L, H, Dkv),
+            "wo": w(next(k), L, Dq, H),
+            "mlp_norm": jnp.ones((L, H), dtype),
+            "gate": w(next(k), L, H, I),
+            "up": w(next(k), L, H, I),
+            "down": w(next(k), L, I, H),
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(next(k), H, V)
+    return params
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_seq: int, dtype=jnp.bfloat16) -> KVCache:
+    shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _insert_kv(cache_l: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray) -> jnp.ndarray:
+    """Write new [B, T, Hkv, D] into cache_l [B, S, Hkv, D] at per-row offset."""
+    return jax.vmap(
+        lambda c, n, s: jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
+    )(cache_l, new, start)
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,    # [B, T] int32
+    positions: jnp.ndarray,    # [B, T] int32 absolute positions
+    cache: KVCache,
+    cache_start: jnp.ndarray,  # [B] int32 — write offset (current valid length)
+    rope_tables: tuple[jnp.ndarray, jnp.ndarray],
+) -> tuple[jnp.ndarray, KVCache]:
+    """One forward pass (prefill T>1 or decode T=1). Returns (hidden [B,T,H], cache)."""
+    cos_t, sin_t = rope_tables
+    B, T = input_ids.shape
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    h = params["embed"][input_ids]  # [B, T, H] gather
+    kv_len_after = cache_start + T  # valid cache length after this step's insert
+
+    def layer_body(h, xs):
+        lp, k_cache_l, v_cache_l = xs
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        q = jnp.einsum("bth,hd->btd", x, lp["wq"],
+                       preferred_element_type=jnp.float32).astype(h.dtype)
+        kproj = jnp.einsum("bth,hd->btd", x, lp["wk"],
+                           preferred_element_type=jnp.float32).astype(h.dtype)
+        vproj = jnp.einsum("bth,hd->btd", x, lp["wv"],
+                           preferred_element_type=jnp.float32).astype(h.dtype)
+        q = q.reshape(B, T, Hq, D)
+        kproj = kproj.reshape(B, T, Hkv, D)
+        vproj = vproj.reshape(B, T, Hkv, D)
+        q = apply_rope(q, positions, cos_t, sin_t)
+        kproj = apply_rope(kproj, positions, cos_t, sin_t)
+
+        k_cache_l = _insert_kv(k_cache_l, kproj, cache_start)
+        v_cache_l = _insert_kv(v_cache_l, vproj, cache_start)
+
+        attn = attention_with_cache(
+            q, k_cache_l, v_cache_l, positions, kv_len_after,
+            sliding_window=cfg.sliding_window,
+        )
+        attn = attn.reshape(B, T, Hq * D)
+        h = h + jnp.einsum("btd,dh->bth", attn, lp["wo"],
+                           preferred_element_type=jnp.float32).astype(h.dtype)
+
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        gate = jnp.einsum("bth,hi->bti", x, lp["gate"],
+                          preferred_element_type=jnp.float32)
+        up = jnp.einsum("bth,hi->bti", x, lp["up"],
+                        preferred_element_type=jnp.float32)
+        act = (jax.nn.silu(gate) * up).astype(h.dtype)
+        h = h + jnp.einsum("bti,ih->bth", act, lp["down"],
+                           preferred_element_type=jnp.float32).astype(h.dtype)
+        return h, (k_cache_l, v_cache_l)
+
+    k_cache, v_cache = cache
+    h, (k_cache, v_cache) = jax.lax.scan(
+        layer_body, h, (params["layers"], k_cache, v_cache)
+    )
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    return h, (k_cache, v_cache)
+
+
+def lm_head_logits(params: Params, cfg: ModelConfig, hidden: jnp.ndarray) -> jnp.ndarray:
+    """hidden [B, H] (or [B, T, H]) → logits in f32."""
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.einsum("...h,hv->...v", hidden, head, preferred_element_type=jnp.float32)
+
+
+def gather_last_hidden(hidden: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """hidden [B, T, H], lengths [B] → [B, H] at index lengths-1 per row."""
+    idx = jnp.maximum(lengths - 1, 0)
+    return jnp.take_along_axis(hidden, idx[:, None, None], axis=1)[:, 0, :]

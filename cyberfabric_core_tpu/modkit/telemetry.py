@@ -1,0 +1,136 @@
+"""Tracing: spans, W3C trace-context propagation, and profiler hooks.
+
+Reference: libs/modkit/src/telemetry/init.rs (OTel tracing init, samplers, OTLP
+exporters), tower-http TraceLayer per request
+(modules/system/api-gateway/src/module.rs:276-281), W3C propagation.
+
+TPU build: host spans carry request_id/trace_id through the middleware stack and are
+exported to structured logs (an OTLP exporter can be slotted in later — the exporter
+interface is one method). Device-side profiling hooks into `jax.profiler` when
+enabled. Includes the throttled-log helper (telemetry/throttled_log.rs).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import random
+import re
+import time
+import uuid
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+logger = logging.getLogger("telemetry")
+
+_TRACEPARENT_RE = re.compile(r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "current_span", default=None
+)
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str] = None
+    attributes: dict[str, Any] = field(default_factory=dict)
+    start_ns: int = field(default_factory=time.monotonic_ns)
+    status: str = "ok"
+    sampled: bool = True
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+
+class SpanExporter:
+    """Export finished spans; default sink is the structured log stream."""
+
+    def export(self, span: Span, duration_ms: float) -> None:
+        logger.debug(
+            "span %s trace=%s dur=%.2fms status=%s %s",
+            span.name, span.trace_id, duration_ms, span.status, span.attributes,
+        )
+
+
+class Tracer:
+    """Sampling tracer (parent-based ratio sampler parity, telemetry/config.rs)."""
+
+    def __init__(self, *, enabled: bool = True, sample_ratio: float = 1.0,
+                 exporter: Optional[SpanExporter] = None) -> None:
+        self.enabled = enabled
+        self.sample_ratio = sample_ratio
+        self.exporter = exporter or SpanExporter()
+
+    @contextmanager
+    def span(self, name: str, *, traceparent: Optional[str] = None,
+             **attributes: Any) -> Iterator[Span]:
+        parent = _current_span.get()
+        trace_id, parent_id = None, None
+        if traceparent:
+            m = _TRACEPARENT_RE.match(traceparent.strip())
+            if m:
+                trace_id, parent_id = m.group(1), m.group(2)
+        if trace_id is None and parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        if trace_id is None:
+            trace_id = uuid.uuid4().hex
+        # parent-based sampling: children inherit the parent's decision; only
+        # root spans roll the dice, so an unsampled trace emits nothing at all
+        sampled = parent.sampled if parent is not None else (random.random() < self.sample_ratio)
+        span = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=uuid.uuid4().hex[:16],
+            parent_id=parent_id,
+            attributes=dict(attributes),
+            sampled=sampled,
+        )
+        token = _current_span.set(span)
+        try:
+            yield span
+        except BaseException:
+            span.status = "error"
+            raise
+        finally:
+            _current_span.reset(token)
+            if self.enabled and span.sampled:
+                self.exporter.export(span, (time.monotonic_ns() - span.start_ns) / 1e6)
+
+    @staticmethod
+    def current() -> Optional[Span]:
+        return _current_span.get()
+
+
+class ThrottledLog:
+    """Log at most once per ``interval`` seconds per key (throttled_log.rs)."""
+
+    def __init__(self, interval: float = 5.0) -> None:
+        self.interval = interval
+        self._last: dict[str, float] = {}
+
+    def should_log(self, key: str) -> bool:
+        now = time.monotonic()
+        if now - self._last.get(key, -1e9) >= self.interval:
+            self._last[key] = now
+            return True
+        return False
+
+
+@contextmanager
+def device_profile(name: str, enabled: bool = False, logdir: str = "/tmp/jax-trace"):
+    """Wrap a device-side region in a jax.profiler trace when enabled."""
+    if not enabled:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(logdir):
+        with jax.profiler.TraceAnnotation(name):
+            yield

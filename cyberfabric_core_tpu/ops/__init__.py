@@ -1,0 +1,21 @@
+"""Core ops: norms, rotary embeddings, attention, sampling.
+
+Written for the MXU/XLA: bf16 matmuls with f32 accumulation, no data-dependent
+Python control flow, static shapes everywhere so XLA can tile onto the systolic
+array. Pallas kernels (flash/paged attention) live beside the jnp reference
+implementations and are selected by capability.
+"""
+
+from .norms import rms_norm, layer_norm
+from .rope import apply_rope, rope_frequencies
+from .attention import attention_with_cache
+from .sampling import sample_token
+
+__all__ = [
+    "apply_rope",
+    "attention_with_cache",
+    "layer_norm",
+    "rms_norm",
+    "rope_frequencies",
+    "sample_token",
+]

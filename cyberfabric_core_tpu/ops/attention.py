@@ -1,0 +1,75 @@
+"""Attention over a dense KV cache: GQA, causal, length-masked.
+
+The jnp reference path: einsum-built so XLA maps the contractions onto the MXU and
+fuses the mask/softmax chain. Grouped-query structure is expressed by reshaping q to
+[B, T, Hkv, G, D] and contracting against k/v at [B, S, Hkv, D] — no materialized
+kv-head repetition (that would multiply HBM traffic by the group size).
+
+A Pallas flash kernel (ops/flash_attention.py) takes over for long-sequence prefill;
+this file is the semantics reference and the decode workhorse (decode is
+bandwidth-bound on the cache read; flash tiling buys nothing at T=1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def attention_with_cache(
+    q: jnp.ndarray,           # [B, T, Hq, D] — current-step queries
+    k_cache: jnp.ndarray,     # [B, S, Hkv, D] — cache AFTER inserting current k
+    v_cache: jnp.ndarray,     # [B, S, Hkv, D]
+    q_positions: jnp.ndarray,  # [B, T] int32 — absolute position of each query
+    kv_len: jnp.ndarray,      # [B] int32 — valid cache length per sequence
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Returns [B, T, Hq, D]. Causal: query at position p attends cache slots
+    s <= p; slots >= kv_len are masked (padding); optional sliding window keeps
+    s > p - window (Mistral SWA)."""
+    B, T, Hq, D = q.shape
+    S = k_cache.shape[1]
+    Hkv = k_cache.shape[2]
+    G = Hq // Hkv
+
+    qg = q.reshape(B, T, Hkv, G, D)
+    # scores: [B, Hkv, G, T, S] — f32 accumulation on the MXU
+    scores = jnp.einsum("bthgd,bshd->bhgts", qg, k_cache,
+                        preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / jnp.sqrt(D).astype(jnp.float32))
+
+    slot = jnp.arange(S, dtype=jnp.int32)
+    # causal: slot s visible to query at position p iff s <= p
+    causal = slot[None, None, :] <= q_positions[:, :, None]          # [B, T, S]
+    valid = slot[None, None, :] < kv_len[:, None, None]              # [B, T, S]
+    mask = causal & valid
+    if sliding_window is not None:
+        mask = mask & (slot[None, None, :] > q_positions[:, :, None] - sliding_window)
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgts,bshd->bthgd", probs.astype(v_cache.dtype), v_cache,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, T, Hq, D).astype(q.dtype)
+
+
+def encoder_attention(
+    q: jnp.ndarray,           # [B, T, H, D]
+    k: jnp.ndarray,           # [B, T, H, D]
+    v: jnp.ndarray,           # [B, T, H, D]
+    attention_mask: jnp.ndarray,  # [B, T] 1=token, 0=pad
+) -> jnp.ndarray:
+    """Bidirectional attention for the BERT/bge encoder family."""
+    D = q.shape[-1]
+    scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / jnp.sqrt(D).astype(jnp.float32))
+    mask = attention_mask[:, None, None, :].astype(bool)
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)

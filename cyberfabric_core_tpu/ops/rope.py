@@ -1,0 +1,33 @@
+"""Rotary position embeddings (RoPE), precomputed-table style.
+
+Frequencies are computed once per model load and indexed by position inside jit —
+no per-step trig on the hot path, and gather-by-position keeps decode shapes static.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(head_dim: int, max_position: int, theta: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (cos, sin) tables of shape [max_position, head_dim//2] in f32."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    pos = np.arange(max_position, dtype=np.float64)
+    angles = np.outer(pos, inv_freq)  # [P, D/2]
+    return jnp.asarray(np.cos(angles), jnp.float32), jnp.asarray(np.sin(angles), jnp.float32)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               cos_table: jnp.ndarray, sin_table: jnp.ndarray) -> jnp.ndarray:
+    """Rotate q or k. x: [B, T, H, D]; positions: [B, T] int32.
+
+    Uses the HF-llama "rotate_half" convention (first/second half pairing) so
+    safetensors weights load without permutation.
+    """
+    cos = cos_table[positions][:, :, None, :]  # [B, T, 1, D/2]
+    sin = sin_table[positions][:, :, None, :]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

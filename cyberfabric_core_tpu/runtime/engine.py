@@ -1,0 +1,317 @@
+"""InferenceEngine — jitted prefill/decode over a persistent device-resident KV cache.
+
+The decode loop is the true hot loop (SURVEY §7 "hard parts"): one device step per
+output token across the whole batch. Design:
+
+- prefill and decode are separate jitted computations; the KV cache is **donated**
+  on every call so XLA updates it in place (no per-token cache copy in HBM);
+- prefill pads to bucket lengths (powers of two) so a handful of compiled programs
+  serve all prompt lengths — no dynamic shapes, no recompiles in steady state;
+- the LM head runs on the gathered last-token hidden state only;
+- sampling happens on-device inside the decode step ([B] temperature/top-p/top-k
+  runtime scalars, one fused program), the host only reads back one [B] int32 per
+  step — and the readback of step t overlaps the dispatch of step t+1
+  (jax dispatches asynchronously; we fetch t's tokens after enqueueing t+1).
+
+Reference anchors: this implements the llm-gateway "local worker" the specs left
+abstract (DESIGN.md:317-346); TP sharding for multi-chip lives in parallel/ and is
+applied by sharding the same param tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import ModelConfig, get_config
+from ..models import llama
+from ..ops.rope import rope_frequencies
+from ..ops.sampling import sample_token
+
+
+@dataclass
+class SamplingParams:
+    """Per-request decode parameters (llm-gateway request schema surface)."""
+
+    max_tokens: int = 128
+    temperature: float = 0.0  # 0 → greedy
+    top_p: float = 1.0
+    top_k: int = 0
+    stop_token_ids: tuple[int, ...] = ()
+    seed: Optional[int] = None
+
+
+@dataclass
+class EngineConfig:
+    model: str = "tiny-llama"
+    max_seq_len: int = 256
+    max_batch: int = 4
+    dtype: str = "bfloat16"
+    prefill_buckets: tuple[int, ...] = ()  # default: powers of 2 up to max_seq_len
+    donate_cache: bool = True
+    #: model-level end-of-sequence ids (from the tokenizer/checkpoint config);
+    #: per-request stop_token_ids extend these. No implicit guessing.
+    eos_token_ids: tuple[int, ...] = ()
+
+    def buckets(self) -> tuple[int, ...]:
+        if self.prefill_buckets:
+            return self.prefill_buckets
+        out, b = [], 16
+        while b < self.max_seq_len:
+            out.append(b)
+            b *= 2
+        out.append(self.max_seq_len)
+        return tuple(out)
+
+
+@dataclass
+class GenerationResult:
+    token_ids: list[int]
+    finish_reason: str  # stop | length
+    prompt_tokens: int
+    completion_tokens: int
+    ttft_ms: float = 0.0
+    total_ms: float = 0.0
+
+
+@dataclass
+class StepEvent:
+    """One emitted token for one active request slot."""
+
+    request_index: int
+    token_id: int
+    finished: Optional[str] = None  # stop|length when this is the final token
+
+
+class InferenceEngine:
+    """Batch-synchronous engine: prefill a batch, then lockstep decode.
+
+    The continuous-batching scheduler (runtime/scheduler.py) drives the same jitted
+    computations with slot-level admission; this class is the direct path used by
+    single-shot generation and the benchmarks.
+    """
+
+    def __init__(
+        self,
+        config: EngineConfig,
+        model_config: Optional[ModelConfig] = None,
+        params: Optional[Any] = None,
+        seed: int = 0,
+    ) -> None:
+        self.config = config
+        self.model_config = model_config or get_config(config.model)
+        if self.model_config.architecture != "llama":
+            raise ValueError(f"InferenceEngine drives decoder models, got {self.model_config.architecture}")
+        self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.dtype(config.dtype)
+        if params is None:
+            params = llama.init_params(self.model_config, jax.random.PRNGKey(seed), self.dtype)
+        self.params = params
+        self.rope_tables = rope_frequencies(
+            self.model_config.head_dim,
+            max(self.model_config.max_position, config.max_seq_len),
+            self.model_config.rope_theta,
+        )
+        self._rng = jax.random.PRNGKey(seed)
+        self._compiled_prefill: dict[tuple[int, int], Callable] = {}
+        self._decode_fn = self._build_decode()
+        self.last_prefill_compile_s: float = 0.0
+
+    # ------------------------------------------------------------------ jit builders
+    def _build_prefill(self) -> Callable:
+        cfg = self.model_config
+
+        def prefill(params, input_ids, lengths, cache, rope):
+            B, T = input_ids.shape
+            positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+            start = jnp.zeros((B,), jnp.int32)
+            hidden, cache = llama.forward(params, cfg, input_ids, positions, cache, start, rope)
+            last_h = llama.gather_last_hidden(hidden, lengths)
+            logits = llama.lm_head_logits(params, cfg, last_h)  # [B, V] f32
+            return logits, cache
+
+        return jax.jit(prefill, donate_argnums=(3,) if self.config.donate_cache else ())
+
+    def _build_decode(self) -> Callable:
+        cfg = self.model_config
+
+        def decode(params, cache, last_tokens, lengths, rng, temperature, top_p, top_k, rope):
+            B = last_tokens.shape[0]
+            positions = lengths[:, None]  # write/attend position = current length
+            hidden, cache = llama.forward(
+                params, cfg, last_tokens[:, None], positions, cache, lengths, rope
+            )
+            logits = llama.lm_head_logits(params, cfg, hidden[:, 0, :])
+            rng, sub = jax.random.split(rng)
+            next_tokens = sample_token(logits, sub, temperature, top_p, top_k)
+            return next_tokens, cache, rng
+
+        return jax.jit(decode, donate_argnums=(1,) if self.config.donate_cache else ())
+
+    def _prefill_for(self, batch: int, bucket: int) -> Callable:
+        key = (batch, bucket)
+        fn = self._compiled_prefill.get(key)
+        if fn is None:
+            fn = self._build_prefill()
+            self._compiled_prefill[key] = fn
+        return fn
+
+    def _bucket_for(self, length: int) -> int:
+        # strict: at least one cache slot must remain for the first decode write,
+        # or dynamic_update_slice would clamp and corrupt the last KV entry
+        if length >= self.config.max_seq_len:
+            raise ValueError(
+                f"prompt length {length} leaves no decode room (max_seq_len "
+                f"{self.config.max_seq_len}; prompts must be strictly shorter)"
+            )
+        for b in self.config.buckets():
+            if length <= b:
+                return b
+        raise AssertionError("unreachable: buckets() always covers max_seq_len")
+
+    # ------------------------------------------------------------------ generation
+    def generate(
+        self,
+        prompts: list[list[int]],
+        sampling: SamplingParams | list[SamplingParams],
+        *,
+        on_token: Optional[Callable[[StepEvent], None]] = None,
+    ) -> list[GenerationResult]:
+        """Lockstep batched generation. Emits StepEvents as tokens are produced."""
+        events = self.generate_stream(prompts, sampling)
+        results: dict[int, GenerationResult] = {}
+        collected: dict[int, list[int]] = {i: [] for i in range(len(prompts))}
+        meta: dict[int, dict] = {}
+        for ev in events:
+            collected[ev.request_index].append(ev.token_id)
+            if on_token:
+                on_token(ev)
+            if ev.finished:
+                meta[ev.request_index] = {"finish": ev.finished}
+        # generate_stream attaches timing on self._last_timing
+        timing = self._last_timing
+        for i, prompt in enumerate(prompts):
+            toks = collected[i]
+            fin = meta.get(i, {}).get("finish", "length")
+            if fin == "stop" and toks:
+                toks = toks[:-1]  # drop the stop token from visible output
+            results[i] = GenerationResult(
+                token_ids=toks,
+                finish_reason=fin,
+                prompt_tokens=len(prompt),
+                completion_tokens=len(toks),
+                ttft_ms=timing["ttft_ms"],
+                total_ms=timing["total_ms"],
+            )
+        return [results[i] for i in range(len(prompts))]
+
+    def generate_stream(
+        self,
+        prompts: list[list[int]],
+        sampling: SamplingParams | list[SamplingParams],
+    ) -> Iterator[StepEvent]:
+        """Yields StepEvents; the decode dispatch of step t+1 overlaps the host
+        readback of step t."""
+        B = len(prompts)
+        if B == 0:
+            self._last_timing = {"ttft_ms": 0.0, "total_ms": 0.0}
+            return
+        if B > self.config.max_batch:
+            raise ValueError(f"batch {B} exceeds max_batch {self.config.max_batch}")
+        per_req = sampling if isinstance(sampling, list) else [sampling] * B
+        t_start = time.monotonic()
+
+        lengths_list = [len(p) for p in prompts]
+        max_len = max(lengths_list)
+        bucket = self._bucket_for(max_len)
+        ids = np.zeros((B, bucket), np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, : len(p)] = p
+        lengths = jnp.asarray(lengths_list, jnp.int32)
+
+        cache = llama.init_cache(self.model_config, B, self.config.max_seq_len, self.dtype)
+        prefill = self._prefill_for(B, bucket)
+        c0 = time.monotonic()
+        logits, cache = prefill(self.params, jnp.asarray(ids), lengths, cache, self.rope_tables)
+        first = np.asarray(jnp.argmax(logits, axis=-1), np.int32)  # greedy first token...
+        self.last_prefill_compile_s = time.monotonic() - c0
+
+        # ...unless sampling is requested: resample first token on-device for parity
+        temperature = jnp.asarray([s.temperature for s in per_req], jnp.float32)
+        top_p = jnp.asarray([s.top_p for s in per_req], jnp.float32)
+        top_k = jnp.asarray([s.top_k for s in per_req], jnp.int32)
+        if any(s.temperature > 0 for s in per_req):
+            self._rng, sub = jax.random.split(self._rng)
+            first = np.asarray(
+                sample_token(logits, sub, temperature, top_p, top_k), np.int32
+            )
+        ttft_ms = (time.monotonic() - t_start) * 1000.0
+
+        stops = [set(s.stop_token_ids) | set(self.config.eos_token_ids) for s in per_req]
+        max_new = [s.max_tokens for s in per_req]
+        done = [False] * B
+        emitted = [0] * B
+
+        def classify(i: int, tok: int) -> Optional[str]:
+            if tok in stops[i]:
+                return "stop"
+            if emitted[i] >= max_new[i]:
+                return "length"
+            return None
+
+        cur = first
+        lengths_np = np.asarray(lengths_list, np.int32)
+        step_lengths = jnp.asarray(lengths_np)
+        last_tokens = jnp.asarray(cur)
+
+        # emit first tokens
+        for i in range(B):
+            emitted[i] += 1
+            fin = classify(i, int(cur[i]))
+            done[i] = fin is not None
+            yield StepEvent(i, int(cur[i]), fin)
+
+        steps = 0
+        max_steps = max(max_new) if max_new else 0
+        while not all(done) and steps < max_steps + 1:
+            next_dev, cache, self._rng = self._decode_fn(
+                self.params, cache, last_tokens, step_lengths, self._rng,
+                temperature, top_p, top_k, self.rope_tables,
+            )
+            lengths_np = lengths_np + 1
+            step_lengths = step_lengths + 1
+            last_tokens = next_dev
+            cur = np.asarray(next_dev, np.int32)  # sync point: one [B] readback
+            steps += 1
+            # cache capacity after this token: if the NEXT write would overflow,
+            # finish every still-active row on this event (single event per token)
+            capacity_exhausted = bool(np.any(lengths_np + 1 >= self.config.max_seq_len))
+            for i in range(B):
+                if done[i]:
+                    continue
+                emitted[i] += 1
+                fin = classify(i, int(cur[i]))
+                if fin is None and capacity_exhausted:
+                    fin = "length"
+                done[i] = fin is not None
+                yield StepEvent(i, int(cur[i]), fin)
+            if capacity_exhausted:
+                break
+
+        self._last_timing = {
+            "ttft_ms": ttft_ms,
+            "total_ms": (time.monotonic() - t_start) * 1000.0,
+        }
+
+    # ------------------------------------------------------------------ warmup
+    def warmup(self, lengths: tuple[int, ...] = ()) -> None:
+        """Pre-compile prefill buckets + decode so first requests aren't 20-40s."""
+        for bucket in lengths or (self.config.buckets()[0],):
+            prompt = [1] * min(bucket, 8)
+            self.generate([prompt], SamplingParams(max_tokens=2))

@@ -1,0 +1,97 @@
+"""Tokenization: HF `tokenizers` files when present, byte-level fallback otherwise.
+
+The byte tokenizer keeps every code path (encode → device → decode → SSE) real in
+airgapped/test environments: ids 0-2 are pad/bos/eos, byte b maps to 3+b.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    pad_id: int
+    bos_id: int
+    eos_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+    _OFFSET = 3
+
+    def __init__(self, vocab_size: int = 512) -> None:
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str) -> list[int]:
+        return [self.bos_id] + [self._OFFSET + b for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        # ids beyond the byte range (vocab slack above 258, e.g. random-weight
+        # sampling) decode to the replacement character instead of crashing
+        data = bytes(
+            (i - self._OFFSET) if i - self._OFFSET < 256 else 0x3F  # '?'
+            for i in ids
+            if i >= self._OFFSET
+        )
+        return data.decode("utf-8", errors="replace")
+
+
+class HfTokenizer:
+    """Wraps a `tokenizers` Tokenizer loaded from tokenizer.json."""
+
+    def __init__(self, path: Path) -> None:
+        from tokenizers import Tokenizer as _Tok
+
+        self._tok = _Tok.from_file(str(path))
+        self.pad_id = self._special("<|pad|>", "<pad>", default=0)
+        self.bos_id = self._special("<|begin_of_text|>", "<s>", "<|startoftext|>", default=1)
+        self.eos_id = self._special("<|end_of_text|>", "</s>", "<|eot_id|>", default=2)
+
+    def _special(self, *names: str, default: int) -> int:
+        for n in names:
+            tid = self._tok.token_to_id(n)
+            if tid is not None:
+                return tid
+        return default
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text).ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def load_tokenizer(model_dir: Optional[str | Path], vocab_size: int = 512) -> Tokenizer:
+    """tokenizer.json in ``model_dir`` → HfTokenizer; else byte fallback."""
+    if model_dir is not None:
+        p = Path(model_dir) / "tokenizer.json"
+        if p.exists():
+            return HfTokenizer(p)
+    return ByteTokenizer(vocab_size)
+
+
+def render_chat(messages: list[dict], model_family: str = "llama") -> str:
+    """Messages → prompt text. Content is ALWAYS an array of parts per the wire
+    contract (core/message.v1.schema.json — SURVEY §8.1); text parts are joined."""
+
+    def text_of(content) -> str:
+        if isinstance(content, str):
+            return content
+        return "".join(p.get("text", "") for p in content if p.get("type", "text") == "text")
+
+    if model_family == "llama":
+        out = []
+        for m in messages:
+            out.append(f"<|start_header_id|>{m['role']}<|end_header_id|>\n\n{text_of(m['content'])}<|eot_id|>")
+        out.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        return "".join(out)
+    # generic fallback
+    lines = [f"{m['role']}: {text_of(m['content'])}" for m in messages]
+    lines.append("assistant:")
+    return "\n".join(lines)

@@ -1,0 +1,153 @@
+"""Weight loading: safetensors → (sharded) device buffers.
+
+Implements the model-registry PRD's managed-model requirements for real
+(modules/model-registry/docs/PRD.md:200-224: managed/architecture/size_bytes/format
+incl. `safetensors`) and BASELINE config #5 (sharded TP load): tensors are read
+per-shard from the safetensors files and placed directly onto devices with their
+target NamedSharding — the host never materializes the full model when a mesh is
+given (each process reads only what its devices need; jax.device_put with a sharding
+uploads per-device slices).
+
+HF llama checkpoint names → our stacked-layer tree. Stacking is done host-side per
+parameter group with numpy, then device_put once per group.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.configs import ModelConfig
+
+# our tree leaf → (HF name template, transpose?) ; {i} = layer index
+_LLAMA_MAP: dict[str, tuple[str, bool]] = {
+    "embed": ("model.embed_tokens.weight", False),
+    "final_norm": ("model.norm.weight", False),
+    "lm_head": ("lm_head.weight", True),
+    "layers.attn_norm": ("model.layers.{i}.input_layernorm.weight", False),
+    "layers.wq": ("model.layers.{i}.self_attn.q_proj.weight", True),
+    "layers.wk": ("model.layers.{i}.self_attn.k_proj.weight", True),
+    "layers.wv": ("model.layers.{i}.self_attn.v_proj.weight", True),
+    "layers.wo": ("model.layers.{i}.self_attn.o_proj.weight", True),
+    "layers.mlp_norm": ("model.layers.{i}.post_attention_layernorm.weight", False),
+    "layers.gate": ("model.layers.{i}.mlp.gate_proj.weight", True),
+    "layers.up": ("model.layers.{i}.mlp.up_proj.weight", True),
+    "layers.down": ("model.layers.{i}.mlp.down_proj.weight", True),
+}
+
+
+class SafetensorsIndex:
+    """Maps tensor name → (file, slice accessor) across sharded safetensors files."""
+
+    def __init__(self, model_dir: Path) -> None:
+        from safetensors import safe_open
+
+        self._safe_open = safe_open
+        self.model_dir = Path(model_dir)
+        self.name_to_file: dict[str, Path] = {}
+        index_file = self.model_dir / "model.safetensors.index.json"
+        if index_file.exists():
+            index = json.loads(index_file.read_text())
+            for name, fname in index["weight_map"].items():
+                self.name_to_file[name] = self.model_dir / fname
+        else:
+            for f in sorted(self.model_dir.glob("*.safetensors")):
+                with safe_open(str(f), framework="numpy") as sf:
+                    for name in sf.keys():
+                        self.name_to_file[name] = f
+
+    def load(self, name: str) -> np.ndarray:
+        f = self.name_to_file.get(name)
+        if f is None:
+            raise KeyError(f"tensor {name!r} not found in {self.model_dir}")
+        with self._safe_open(str(f), framework="numpy") as sf:
+            return sf.get_tensor(name)
+
+    def has(self, name: str) -> bool:
+        return name in self.name_to_file
+
+
+def load_llama_params(
+    model_dir: str | Path,
+    cfg: ModelConfig,
+    dtype=jnp.bfloat16,
+    shardings: Optional[dict[str, Any]] = None,
+    progress: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Load a HF llama-family safetensors checkpoint into our param tree.
+
+    ``shardings``: optional map of tree paths ("layers.wq", "embed", ...) →
+    jax.sharding.Sharding; tensors go straight to their sharded placement.
+    """
+    idx = SafetensorsIndex(Path(model_dir))
+    shardings = shardings or {}
+
+    def put(path: str, arr: np.ndarray):
+        if progress:
+            progress(path)
+        target = arr.astype(np.float32).astype(dtype) if arr.dtype != np.dtype("bfloat16") else arr
+        sharding = shardings.get(path)
+        if sharding is not None:
+            return jax.device_put(jnp.asarray(target), sharding)
+        return jnp.asarray(target)
+
+    params: dict[str, Any] = {"layers": {}}
+    for leaf, (tmpl, transpose) in _LLAMA_MAP.items():
+        if leaf == "lm_head":
+            if cfg.tie_embeddings or not idx.has(tmpl):
+                continue
+        if "{i}" not in tmpl:
+            t = idx.load(tmpl)
+            params_leaf = t.T if transpose else t
+            _set(params, leaf, put(leaf, params_leaf))
+        else:
+            stack = []
+            for i in range(cfg.num_layers):
+                t = idx.load(tmpl.format(i=i))
+                stack.append(t.T if transpose else t)
+            _set(params, leaf, put(leaf, np.stack(stack)))
+    return params
+
+
+def _set(tree: dict, dotted: str, value: Any) -> None:
+    parts = dotted.split(".")
+    node = tree
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def save_llama_params(params: dict, cfg: ModelConfig, out_dir: str | Path) -> Path:
+    """Write our tree back to HF-layout safetensors (round-trip/testing support)."""
+    from safetensors.numpy import save_file
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    tensors: dict[str, np.ndarray] = {}
+    for leaf, (tmpl, transpose) in _LLAMA_MAP.items():
+        node: Any = params
+        try:
+            for p in leaf.split("."):
+                node = node[p]
+        except KeyError:
+            continue
+        arr = np.asarray(jax.device_get(node)).astype(np.float32)
+        if "{i}" not in tmpl:
+            tensors[tmpl] = arr.T if transpose else arr
+        else:
+            for i in range(cfg.num_layers):
+                t = arr[i]
+                tensors[tmpl.format(i=i)] = t.T if transpose else t
+    path = out_dir / "model.safetensors"
+    save_file(tensors, str(path))
+    return path
+
+
+def checkpoint_size_bytes(model_dir: str | Path) -> int:
+    return sum(f.stat().st_size for f in Path(model_dir).glob("*.safetensors"))

@@ -7,10 +7,19 @@ devices; sharding/collective code paths compile and execute for real.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# The runtime environment pins JAX_PLATFORMS=axon (real TPU) and its sitecustomize
+# imports jax at interpreter start, so env vars are already consumed by the time this
+# conftest runs. jax.config.update after import is the reliable override; XLA_FLAGS
+# still applies because no backend has been initialized yet.
+if not os.environ.get("RUN_TPU_TESTS"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.devices()[0].platform == "cpu", "tests must run on the virtual CPU mesh"
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,171 @@
+"""Model + engine tests on tiny shapes (CPU backend, same code paths as TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cyberfabric_core_tpu.models import get_config
+from cyberfabric_core_tpu.models import bert, llama
+from cyberfabric_core_tpu.ops.rope import rope_frequencies
+from cyberfabric_core_tpu.ops.sampling import sample_token
+from cyberfabric_core_tpu.runtime import EngineConfig, InferenceEngine, SamplingParams
+
+CFG = get_config("tiny-llama")
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def rope():
+    return rope_frequencies(CFG.head_dim, CFG.max_position, CFG.rope_theta)
+
+
+def test_forward_shapes(tiny_params, rope):
+    B, T = 2, 8
+    cache = llama.init_cache(CFG, B, 32, jnp.float32)
+    ids = jnp.zeros((B, T), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T)).astype(jnp.int32)
+    h, (k, v) = llama.forward(tiny_params, CFG, ids, pos, cache,
+                              jnp.zeros((B,), jnp.int32), rope)
+    assert h.shape == (B, T, CFG.hidden_size)
+    assert k.shape == (CFG.num_layers, B, 32, CFG.num_kv_heads, CFG.head_dim)
+    logits = llama.lm_head_logits(tiny_params, CFG, h[:, -1, :])
+    assert logits.shape == (B, CFG.vocab_size) and logits.dtype == jnp.float32
+
+
+def test_incremental_decode_matches_full_prefill(tiny_params, rope):
+    """The KV-cache decode path must produce the same logits as a full forward —
+    the core correctness invariant of the cache machinery."""
+    T = 10
+    key = jax.random.PRNGKey(1)
+    ids = jax.random.randint(key, (1, T), 0, CFG.vocab_size)
+
+    # full prefill of all T tokens
+    cache_full = llama.init_cache(CFG, 1, 32, jnp.float32)
+    pos = jnp.arange(T, dtype=jnp.int32)[None, :]
+    h_full, _ = llama.forward(tiny_params, CFG, ids, pos, cache_full,
+                              jnp.zeros((1,), jnp.int32), rope)
+    logits_full = llama.lm_head_logits(tiny_params, CFG, h_full[0, -1])
+
+    # prefill T-1 then decode the final token incrementally
+    cache = llama.init_cache(CFG, 1, 32, jnp.float32)
+    h_pre, cache = llama.forward(tiny_params, CFG, ids[:, : T - 1], pos[:, : T - 1],
+                                 cache, jnp.zeros((1,), jnp.int32), rope)
+    h_dec, cache = llama.forward(tiny_params, CFG, ids[:, T - 1:], pos[:, T - 1:],
+                                 cache, jnp.asarray([T - 1], jnp.int32), rope)
+    logits_inc = llama.lm_head_logits(tiny_params, CFG, h_dec[0, -1])
+
+    np.testing.assert_allclose(np.asarray(logits_full), np.asarray(logits_inc),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ragged_batch_isolation(tiny_params, rope):
+    """Rows in a padded batch must not contaminate each other."""
+    p1 = [5, 6, 7]
+    cache1 = llama.init_cache(CFG, 1, 32, jnp.float32)
+    pos1 = jnp.arange(3, dtype=jnp.int32)[None, :]
+    h1, _ = llama.forward(tiny_params, CFG, jnp.asarray([p1]), pos1, cache1,
+                          jnp.zeros((1,), jnp.int32), rope)
+    solo = llama.lm_head_logits(tiny_params, CFG, h1[0, 2])
+
+    # same prompt padded inside a 2-row batch with a longer neighbor
+    ids = jnp.asarray([[5, 6, 7, 0, 0, 0], [9, 8, 7, 6, 5, 4]], jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(6)[None, :], (2, 6)).astype(jnp.int32)
+    cache = llama.init_cache(CFG, 2, 32, jnp.float32)
+    h, _ = llama.forward(tiny_params, CFG, ids, pos, cache,
+                         jnp.zeros((2,), jnp.int32), rope)
+    batched = llama.lm_head_logits(tiny_params, CFG, llama.gather_last_hidden(
+        h, jnp.asarray([3, 6], jnp.int32))[0])
+    np.testing.assert_allclose(np.asarray(solo), np.asarray(batched), rtol=2e-4, atol=2e-4)
+
+
+def test_sliding_window_masks_distant_tokens(tiny_params, rope):
+    """Mistral-style SWA: with window w, tokens further than w back are invisible."""
+    import dataclasses
+
+    cfg_swa = dataclasses.replace(CFG, sliding_window=4)
+    T = 12
+    ids = jax.random.randint(jax.random.PRNGKey(2), (1, T), 3, CFG.vocab_size)
+    pos = jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    def last_logits(cfg, token_prefix):
+        cache = llama.init_cache(cfg, 1, 32, jnp.float32)
+        h, _ = llama.forward(tiny_params, cfg, token_prefix, pos, cache,
+                             jnp.zeros((1,), jnp.int32), rope)
+        return llama.lm_head_logits(tiny_params, cfg, h[0, -1])
+
+    base = last_logits(cfg_swa, ids)
+    # perturb a token OUTSIDE the window of the last position (pos 2 << 11-4)
+    ids_perturbed = ids.at[0, 2].set((ids[0, 2] + 1) % CFG.vocab_size)
+    swa = last_logits(cfg_swa, ids_perturbed)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(swa), rtol=1e-5, atol=1e-5)
+    # sanity: without the window the same perturbation DOES change the logits
+    full = last_logits(CFG, ids_perturbed)
+    assert not np.allclose(np.asarray(base), np.asarray(full), rtol=1e-3, atol=1e-3)
+
+
+def test_sampling_greedy_and_temperature():
+    logits = jnp.asarray([[0.0, 5.0, 1.0, 2.0]] * 3, jnp.float32)
+    toks = sample_token(logits, jax.random.PRNGKey(0),
+                        jnp.zeros((3,)), jnp.ones((3,)), jnp.zeros((3,), jnp.int32))
+    assert list(np.asarray(toks)) == [1, 1, 1]
+    # top_k=1 sampling == greedy regardless of temperature
+    toks = sample_token(logits, jax.random.PRNGKey(1),
+                        jnp.ones((3,)) * 2.0, jnp.ones((3,)), jnp.ones((3,), jnp.int32))
+    assert list(np.asarray(toks)) == [1, 1, 1]
+    # top_p tiny keeps only the argmax
+    toks = sample_token(logits, jax.random.PRNGKey(2),
+                        jnp.ones((3,)), jnp.asarray([1e-6] * 3), jnp.zeros((3,), jnp.int32))
+    assert list(np.asarray(toks)) == [1, 1, 1]
+
+
+def test_engine_generate_deterministic():
+    eng = InferenceEngine(EngineConfig(model="tiny-llama", max_seq_len=64, max_batch=2))
+    out = eng.generate([[1, 5, 9]], SamplingParams(max_tokens=8))
+    assert len(out) == 1
+    r = out[0]
+    assert r.completion_tokens <= 8 and r.prompt_tokens == 3
+    assert r.finish_reason in ("stop", "length")
+    # deterministic under greedy
+    out2 = eng.generate([[1, 5, 9]], SamplingParams(max_tokens=8))
+    assert out2[0].token_ids == r.token_ids
+
+
+def test_engine_batch_matches_single():
+    """Lockstep batching must not change greedy results vs solo runs."""
+    eng = InferenceEngine(EngineConfig(model="tiny-llama", max_seq_len=64, max_batch=3))
+    solo = [eng.generate([p], SamplingParams(max_tokens=6))[0].token_ids
+            for p in ([1, 5], [1, 7, 9, 11], [1])]
+    batched = eng.generate([[1, 5], [1, 7, 9, 11], [1]], SamplingParams(max_tokens=6))
+    assert [r.token_ids for r in batched] == solo
+
+
+def test_engine_stop_tokens():
+    eng = InferenceEngine(EngineConfig(model="tiny-llama", max_seq_len=64))
+    base = eng.generate([[1, 5, 9]], SamplingParams(max_tokens=8))[0]
+    assert len(base.token_ids) >= 2
+    stop_at = base.token_ids[1]
+    r = eng.generate([[1, 5, 9]], SamplingParams(max_tokens=8, stop_token_ids=(stop_at,)))[0]
+    assert r.finish_reason == "stop"
+    assert r.token_ids == base.token_ids[:1]
+
+
+def test_bert_embeddings():
+    cfg = get_config("tiny-bert")
+    params = bert.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    ids = jnp.asarray([[5, 6, 7, 0], [5, 6, 7, 9]], jnp.int32)
+    mask = jnp.asarray([[1, 1, 1, 0], [1, 1, 1, 1]], jnp.int32)
+    emb = bert.embed_pooled(params, cfg, ids, mask)
+    assert emb.shape == (2, cfg.hidden_size)
+    norms = np.linalg.norm(np.asarray(emb), axis=-1)
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-5)
+    # padding must not affect the CLS embedding of the padded row... it can, via
+    # attention normalization? No: masked positions contribute zero weight.
+    ids2 = jnp.asarray([[5, 6, 7, 3]], jnp.int32)
+    mask2 = jnp.asarray([[1, 1, 1, 0]], jnp.int32)
+    emb2 = bert.embed_pooled(params, cfg, ids2, mask2)
+    np.testing.assert_allclose(np.asarray(emb[0]), np.asarray(emb2[0]), rtol=1e-5, atol=1e-5)
