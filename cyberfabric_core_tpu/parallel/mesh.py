@@ -1,0 +1,55 @@
+"""Device mesh construction.
+
+Axis conventions (the scaling-book recipe: pick a mesh, annotate shardings, let XLA
+insert collectives):
+
+- ``dp``  — data parallel: independent request replicas (BASELINE config: DP request
+  fan-out across pod slices).
+- ``tp``  — tensor parallel: attention heads / MLP columns sharded over ICI
+  (BASELINE config #5: Llama-3-70B across v5e-8).
+- ``sp``  — sequence parallel: ring attention over the sequence axis (long context).
+
+On multi-slice systems the mesh should be built with dp outermost so dp crosses DCN
+and tp/sp ride ICI (collective locality).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.dp * self.tp * self.sp
+
+    @classmethod
+    def for_devices(cls, n: int, tp: int | None = None) -> "MeshConfig":
+        """Default layout: all devices tensor-parallel unless told otherwise."""
+        if tp is None:
+            return cls(dp=1, tp=n, sp=1)
+        assert n % tp == 0, f"{n} devices not divisible by tp={tp}"
+        return cls(dp=n // tp, tp=tp, sp=1)
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def build_mesh(config: MeshConfig, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if config.total != len(devices):
+        raise ValueError(
+            f"mesh {config} needs {config.total} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(config.dp, config.tp, config.sp)
+    return Mesh(arr, axis_names=("dp", "tp", "sp"))

@@ -1,0 +1,75 @@
+"""GSPMD sharding rules for the llama family.
+
+Megatron-style tensor parallelism expressed as NamedShardings; XLA GSPMD inserts the
+collectives (one all-reduce after the attention output projection, one after the MLP
+down projection — riding ICI on a TPU mesh):
+
+- wq/wk/wv: column-parallel (head dim sharded on ``tp``)
+- wo:       row-parallel (input dim sharded on ``tp``)
+- gate/up:  column-parallel; down: row-parallel
+- lm_head:  vocab-sharded; embed + norms replicated
+- KV cache: kv-head axis on ``tp``, batch axis on ``dp``
+
+Stacked-layer leading dim (L) is never sharded. num_kv_heads must divide by tp for
+the cache sharding (8 kv heads → tp≤8 for Llama-3/Mistral; the 70B across v5e-8 is
+exactly tp=8).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.configs import ModelConfig
+
+
+def llama_param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
+    """Tree of NamedShardings matching models/llama.init_params structure."""
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    tree = {
+        "embed": ns(None, None),          # replicated: gather is tiny, avoid a
+                                          # vocab all-gather on every step
+        "final_norm": ns(None),
+        "layers": {
+            "attn_norm": ns(None, None),
+            "wq": ns(None, None, "tp"),
+            "wk": ns(None, None, "tp"),
+            "wv": ns(None, None, "tp"),
+            "wo": ns(None, "tp", None),
+            "mlp_norm": ns(None, None),
+            "gate": ns(None, None, "tp"),
+            "up": ns(None, None, "tp"),
+            "down": ns(None, "tp", None),
+        },
+    }
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = ns(None, "tp")  # vocab-sharded head
+    return tree
+
+
+def llama_cache_sharding(mesh: Mesh) -> NamedSharding:
+    """KV cache [L, B, S, Hkv, D]: batch on dp, kv heads on tp."""
+    return NamedSharding(mesh, P(None, "dp", None, "tp", None))
+
+
+def input_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
+    """Activations entering jit: token ids/positions [B, T] on dp, lengths [B]."""
+    return {
+        "ids": NamedSharding(mesh, P("dp", None)),
+        "lengths": NamedSharding(mesh, P("dp")),
+        "replicated": NamedSharding(mesh, P()),
+    }
+
+
+def apply_shardings(params: Any, shardings: Any):
+    """device_put a param tree onto its shardings (host-side staging path)."""
+    import jax
+
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, s), params, shardings,
+        is_leaf=lambda x: not isinstance(x, dict),
+    )

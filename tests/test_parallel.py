@@ -1,0 +1,81 @@
+"""Sharded inference tests on the virtual 8-device CPU mesh.
+
+The invariant that matters: TP/DP-sharded execution produces the SAME tokens as
+single-device execution (sharding is an implementation detail, not a semantics
+change).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cyberfabric_core_tpu.models import get_config, llama
+from cyberfabric_core_tpu.ops.rope import rope_frequencies
+from cyberfabric_core_tpu.parallel import (
+    MeshConfig,
+    build_mesh,
+    llama_cache_sharding,
+    llama_param_shardings,
+)
+from cyberfabric_core_tpu.parallel.sharding import apply_shardings
+
+CFG = get_config("tiny-llama")
+
+
+def test_mesh_shapes():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    mesh = build_mesh(MeshConfig(dp=2, tp=2, sp=2))
+    assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2}
+    with pytest.raises(ValueError, match="needs"):
+        build_mesh(MeshConfig(dp=3, tp=1))
+
+
+def test_mesh_config_for_devices():
+    assert MeshConfig.for_devices(8) == MeshConfig(dp=1, tp=8)
+    assert MeshConfig.for_devices(8, tp=4) == MeshConfig(dp=2, tp=4)
+    with pytest.raises(AssertionError):
+        MeshConfig.for_devices(8, tp=3)
+
+
+def _run_prefill(params, cache_sharding=None, mesh=None):
+    T = 6
+    ids = jax.random.randint(jax.random.PRNGKey(7), (2, T), 0, CFG.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(T)[None, :], (2, T)).astype(jnp.int32)
+    rope = rope_frequencies(CFG.head_dim, CFG.max_position, CFG.rope_theta)
+    cache = llama.init_cache(CFG, 2, 16, jnp.float32)
+    if cache_sharding is not None:
+        cache = jax.tree.map(lambda c: jax.device_put(c, cache_sharding), cache)
+
+    @jax.jit
+    def step(params, ids, cache):
+        h, cache = llama.forward(params, CFG, ids, pos, cache,
+                                 jnp.zeros((2,), jnp.int32), rope)
+        return llama.lm_head_logits(params, CFG, h[:, -1, :]), cache
+
+    logits, cache = step(params, ids, cache)
+    return np.asarray(logits)
+
+
+def test_tp_sharded_matches_single_device():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+    baseline = _run_prefill(params)
+
+    # tiny-llama: 2 kv heads → tp ∈ {1,2}; batch 2 → dp ∈ {1,2}; spare devices
+    # sit on the (unused-by-these-specs) sp axis and hold replicas
+    mesh = build_mesh(MeshConfig(dp=2, tp=2, sp=2))
+    sharded_params = apply_shardings(params, llama_param_shardings(CFG, mesh))
+    out = _run_prefill(sharded_params, llama_cache_sharding(mesh), mesh)
+    np.testing.assert_allclose(baseline, out, rtol=1e-4, atol=1e-4)
+
+
+def test_tp8_full_mesh():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+    baseline = _run_prefill(params)
+    mesh = build_mesh(MeshConfig(dp=1, tp=8))
+    # tiny-llama has 2 kv heads; tp=8 > kv heads would shard heads unevenly —
+    # cache sharding uses tp over Hkv=2, which divides only for tp in {1,2}.
+    # Param shardings still apply (columns divide); use dense replicated cache.
+    sharded_params = apply_shardings(params, llama_param_shardings(CFG, mesh))
+    out = _run_prefill(sharded_params)
+    np.testing.assert_allclose(baseline, out, rtol=1e-4, atol=1e-4)
