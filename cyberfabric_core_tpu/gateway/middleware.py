@@ -192,7 +192,8 @@ def build_middlewares(
         ):
             ctype = (request.content_type or "").lower()
             if spec.accepted_mime and not any(
-                ctype == m or (m.endswith("/*") and ctype.startswith(m[:-1]))
+                m == "*/*" or ctype == m
+                or (m.endswith("/*") and ctype.startswith(m[:-1]))
                 for m in spec.accepted_mime
             ):
                 return _problem_response(

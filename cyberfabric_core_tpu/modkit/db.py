@@ -113,11 +113,19 @@ class Database:
                 cur.execute("BEGIN")
                 try:
                     mig.apply(self._conn)
+                    # NOTE: executescript() would implicitly COMMIT and break
+                    # atomicity — migrations must use conn.execute() statements
+                    if not self._conn.in_transaction:
+                        raise RuntimeError(
+                            f"migration {mig.version} committed implicitly "
+                            "(executescript?); use individual execute() calls"
+                        )
                     cur.execute("INSERT INTO _schema_migrations(version) VALUES (?)", (mig.version,))
                     cur.execute("COMMIT")
                     count += 1
                 except Exception:
-                    cur.execute("ROLLBACK")
+                    if self._conn.in_transaction:
+                        cur.execute("ROLLBACK")
                     raise
             return count
 

@@ -1,0 +1,22 @@
+"""Service modules (reference: modules/ — system modules + GenAI modules).
+
+Importing this package registers every module with the global registry (the
+`inventory` pattern); hosts pick which to enable via config
+(apps/hyperspot-server/src/registered_modules.rs analogue).
+"""
+
+from ..gateway.module import ApiGatewayModule  # noqa: F401
+from .model_registry import ModelRegistryModule  # noqa: F401
+from .llm_gateway.module import LlmGatewayModule  # noqa: F401
+from .file_storage import FileStorageModule  # noqa: F401
+from .credstore import CredStoreModule  # noqa: F401
+from .types_registry import TypesRegistryModule  # noqa: F401
+from .resolvers import (  # noqa: F401
+    AuthnResolverModule,
+    AuthzResolverModule,
+    TenantResolverModule,
+)
+from .serverless_runtime import ServerlessRuntimeModule  # noqa: F401
+from .file_parser import FileParserModule  # noqa: F401
+from .nodes_registry import NodesRegistryModule  # noqa: F401
+from .module_orchestrator import ModuleOrchestratorModule  # noqa: F401

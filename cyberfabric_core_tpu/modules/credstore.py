@@ -1,0 +1,166 @@
+"""credstore — gateway + plugins secret store.
+
+Reference (spec-only): modules/credstore/docs/DESIGN.md:45-166 — sharing modes
+private/tenant/shared; the gateway does hierarchical walk-up resolution via
+tenant-resolver; plugins are dumb per-tenant KV. Plugin here: sqlite-backed KV
+(the "OS keychain"/VendorA analogues slot in behind the same PluginApi).
+Secret values are redacted in logs via SecretString discipline.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+from aiohttp import web
+
+from ..modkit import Module, module
+from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.db import ScopableEntity
+from ..modkit.errors import ProblemError
+from ..modkit.security import SecurityContext
+from ..gateway.middleware import SECURITY_CONTEXT_KEY
+from ..gateway.validation import read_json
+from .sdk import CredStoreApi, TenantResolverApi
+
+SECRETS = ScopableEntity(
+    table="secrets",
+    field_map={"id": "id", "tenant_id": "tenant_id", "key": "key",
+               "value": "value", "sharing": "sharing"},
+)
+
+_MIGRATIONS = [
+    Migration("0001_secrets", lambda c: c.execute(
+        "CREATE TABLE secrets (id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, "
+        "key TEXT NOT NULL, value TEXT NOT NULL, sharing TEXT DEFAULT 'private', "
+        "UNIQUE (tenant_id, key))"
+    )),
+]
+
+_SHARING_MODES = ("private", "tenant", "shared")
+
+
+class CredStorePluginApi(abc.ABC):
+    """Dumb per-tenant KV plugin contract (DESIGN.md: plugins hold no hierarchy
+    logic — resolution lives in the gateway)."""
+
+    @abc.abstractmethod
+    def get(self, tenant_id: str, key: str) -> Optional[tuple[str, str]]:
+        """Returns (value, sharing) or None."""
+
+    @abc.abstractmethod
+    def put(self, tenant_id: str, key: str, value: str, sharing: str) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, tenant_id: str, key: str) -> bool: ...
+
+
+class SqliteCredPlugin(CredStorePluginApi):
+    def __init__(self, ctx: ModuleCtx) -> None:
+        self._db = ctx.db_required()
+
+    def _conn(self, tenant_id: str):
+        return self._db.secure(
+            SecurityContext(subject="credstore", tenant_id=tenant_id), SECRETS)
+
+    def get(self, tenant_id: str, key: str) -> Optional[tuple[str, str]]:
+        row = self._conn(tenant_id).find_one({"key": key})
+        return (row["value"], row["sharing"]) if row else None
+
+    def put(self, tenant_id: str, key: str, value: str, sharing: str) -> None:
+        conn = self._conn(tenant_id)
+        existing = conn.find_one({"key": key})
+        if existing:
+            conn.update(existing["id"], {"value": value, "sharing": sharing})
+        else:
+            conn.insert({"key": key, "value": value, "sharing": sharing})
+
+    def delete(self, tenant_id: str, key: str) -> bool:
+        conn = self._conn(tenant_id)
+        row = conn.find_one({"key": key})
+        return conn.delete(row["id"]) if row else False
+
+
+class CredStoreGateway(CredStoreApi):
+    """Hierarchical resolution: own tenant first (any mode), then ancestors —
+    where only 'tenant'-shared (subtree) and 'shared' secrets are visible."""
+
+    def __init__(self, plugin: CredStorePluginApi,
+                 tenants: Optional[TenantResolverApi]) -> None:
+        self._plugin = plugin
+        self._tenants = tenants
+
+    async def get_secret(self, ctx: SecurityContext, key: str) -> Optional[str]:
+        hit = self._plugin.get(ctx.tenant_id, key)
+        if hit is not None:
+            return hit[0]
+        chain = (await self._tenants.walk_up(ctx.tenant_id))[1:] if self._tenants else []
+        for ancestor in chain:
+            hit = self._plugin.get(ancestor, key)
+            if hit is not None and hit[1] in ("tenant", "shared"):
+                return hit[0]
+        return None
+
+    async def put_secret(self, ctx: SecurityContext, key: str, value: str,
+                         sharing: str = "private") -> None:
+        if sharing not in _SHARING_MODES:
+            raise ProblemError.bad_request(
+                f"sharing must be one of {_SHARING_MODES}", code="bad_sharing_mode")
+        self._plugin.put(ctx.tenant_id, key, value, sharing)
+
+    async def delete_secret(self, ctx: SecurityContext, key: str) -> bool:
+        return self._plugin.delete(ctx.tenant_id, key)
+
+
+@module(name="credstore", deps=["tenant_resolver"], capabilities=["db", "rest"])
+class CredStoreModule(Module, DatabaseCapability, RestApiCapability):
+    def __init__(self) -> None:
+        self.gateway: Optional[CredStoreGateway] = None
+
+    def migrations(self):
+        return _MIGRATIONS
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        plugin = SqliteCredPlugin(ctx)
+        tenants = ctx.client_hub.try_get(TenantResolverApi)
+        self.gateway = CredStoreGateway(plugin, tenants)
+        ctx.client_hub.register(CredStoreApi, self.gateway)
+        ctx.client_hub.register(CredStorePluginApi, plugin)
+
+    def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
+        gw = self.gateway
+        assert gw is not None
+
+        async def put_secret(request: web.Request):
+            body = await read_json(request, {
+                "type": "object", "required": ["value"],
+                "properties": {"value": {"type": "string"},
+                               "sharing": {"enum": list(_SHARING_MODES)}},
+                "additionalProperties": False})
+            await gw.put_secret(request[SECURITY_CONTEXT_KEY],
+                                request.match_info["key"], body["value"],
+                                body.get("sharing", "private"))
+            return None
+
+        async def get_secret(request: web.Request):
+            value = await gw.get_secret(request[SECURITY_CONTEXT_KEY],
+                                        request.match_info["key"])
+            if value is None:
+                raise ProblemError.not_found("secret not found", code="secret_not_found")
+            return {"key": request.match_info["key"], "value": value}
+
+        async def delete_secret(request: web.Request):
+            deleted = await gw.delete_secret(request[SECURITY_CONTEXT_KEY],
+                                             request.match_info["key"])
+            if not deleted:
+                raise ProblemError.not_found("secret not found", code="secret_not_found")
+            return None
+
+        m = "credstore"
+        router.operation("PUT", "/v1/credstore/secrets/{key}", module=m).auth_required() \
+            .summary("Store a secret").handler(put_secret).register()
+        router.operation("GET", "/v1/credstore/secrets/{key}", module=m).auth_required() \
+            .summary("Resolve a secret (hierarchical walk-up)").handler(get_secret).register()
+        router.operation("DELETE", "/v1/credstore/secrets/{key}", module=m).auth_required() \
+            .summary("Delete a secret").handler(delete_secret).register()

@@ -1,0 +1,250 @@
+"""file-parser — document parsing to a markdown IR.
+
+Reference (implemented there): modules/file-parser — 8 parser backends → markdown
+IR, size limits, path-traversal-safe local parsing rooted at allowed_local_base_dir
+(src/module.rs:62-86; tests/path_traversal_tests.rs), REST upload/parse-local/info.
+
+Backends here: plain text, markdown (passthrough), HTML (stdlib parser → markdown),
+CSV (→ table), JSON (→ fenced block), plus a stub for unknown types. PDF/DOCX/XLSX
+backends slot into PARSERS when their libs are present (gated, not assumed).
+The IR + renderer mirror domain/{ir,markdown}.rs: a list of typed blocks.
+"""
+
+from __future__ import annotations
+
+import csv
+import html.parser
+import io
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+from aiohttp import web
+
+from ..modkit import Module, module
+from ..modkit.contracts import RestApiCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.errors import ProblemError
+from ..gateway.middleware import SECURITY_CONTEXT_KEY
+from ..gateway.validation import read_json
+
+
+# ------------------------------------------------------------------ IR
+@dataclass
+class Block:
+    kind: str  # heading | paragraph | code | table | list
+    text: str = ""
+    level: int = 0
+    rows: list[list[str]] = field(default_factory=list)
+    items: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Document:
+    blocks: list[Block] = field(default_factory=list)
+    title: Optional[str] = None
+
+    def to_markdown(self) -> str:
+        out: list[str] = []
+        for b in self.blocks:
+            if b.kind == "heading":
+                out.append("#" * max(1, min(b.level, 6)) + " " + b.text)
+            elif b.kind == "paragraph":
+                out.append(b.text)
+            elif b.kind == "code":
+                out.append(f"```\n{b.text}\n```")
+            elif b.kind == "list":
+                out.append("\n".join(f"- {i}" for i in b.items))
+            elif b.kind == "table" and b.rows:
+                header, *rest = b.rows
+                out.append(" | ".join(header))
+                out.append(" | ".join("---" for _ in header))
+                out.extend(" | ".join(r) for r in rest)
+        return "\n\n".join(x for x in out if x)
+
+
+# ------------------------------------------------------------------ parsers
+def parse_plain_text(data: bytes) -> Document:
+    text = data.decode("utf-8", errors="replace")
+    blocks = [Block("paragraph", p.strip()) for p in text.split("\n\n") if p.strip()]
+    return Document(blocks=blocks)
+
+
+def parse_markdown(data: bytes) -> Document:
+    return Document(blocks=[Block("paragraph", data.decode("utf-8", errors="replace"))])
+
+
+class _HtmlToIr(html.parser.HTMLParser):
+    _HEADINGS = {f"h{i}": i for i in range(1, 7)}
+    _SKIP = {"script", "style", "head"}
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.doc = Document()
+        self._buf: list[str] = []
+        self._heading: Optional[int] = None
+        self._skip_depth = 0
+        self._in_li = False
+        self._items: list[str] = []
+
+    def handle_starttag(self, tag, attrs):
+        if tag in self._SKIP:
+            self._skip_depth += 1
+        elif tag in self._HEADINGS:
+            self._flush()
+            self._heading = self._HEADINGS[tag]
+        elif tag == "li":
+            self._in_li = True
+            self._buf = []
+        elif tag in ("p", "div", "br", "tr"):
+            self._flush()
+
+    def handle_endtag(self, tag):
+        if tag in self._SKIP:
+            self._skip_depth = max(0, self._skip_depth - 1)
+        elif tag in self._HEADINGS:
+            text = " ".join("".join(self._buf).split())
+            if text:
+                self.doc.blocks.append(Block("heading", text, level=self._heading or 1))
+                if self.doc.title is None and (self._heading or 1) == 1:
+                    self.doc.title = text
+            self._buf, self._heading = [], None
+        elif tag == "li":
+            text = " ".join("".join(self._buf).split())
+            if text:
+                self._items.append(text)
+            self._buf, self._in_li = [], False
+        elif tag in ("ul", "ol"):
+            if self._items:
+                self.doc.blocks.append(Block("list", items=list(self._items)))
+                self._items = []
+        elif tag in ("p", "div"):
+            self._flush()
+
+    def handle_data(self, data):
+        if not self._skip_depth:
+            self._buf.append(data)
+
+    def _flush(self) -> None:
+        if self._heading is not None or self._in_li:
+            return
+        text = " ".join("".join(self._buf).split())
+        if text:
+            self.doc.blocks.append(Block("paragraph", text))
+        self._buf = []
+
+
+def parse_html(data: bytes) -> Document:
+    p = _HtmlToIr()
+    p.feed(data.decode("utf-8", errors="replace"))
+    p._flush()
+    return p.doc
+
+
+def parse_csv(data: bytes) -> Document:
+    rows = list(csv.reader(io.StringIO(data.decode("utf-8", errors="replace"))))
+    return Document(blocks=[Block("table", rows=[[c for c in r] for r in rows if r])])
+
+
+def parse_json_doc(data: bytes) -> Document:
+    try:
+        obj = json.loads(data)
+    except json.JSONDecodeError as e:
+        raise ProblemError.unprocessable(f"invalid JSON document: {e}", code="parse_failed")
+    return Document(blocks=[Block("code", json.dumps(obj, indent=2)[:100_000])])
+
+
+def parse_stub(data: bytes) -> Document:
+    return Document(blocks=[Block("paragraph",
+                                  f"[unsupported content: {len(data)} bytes]")])
+
+
+PARSERS: dict[str, Callable[[bytes], Document]] = {
+    "text/plain": parse_plain_text,
+    "text/markdown": parse_markdown,
+    "text/html": parse_html,
+    "text/csv": parse_csv,
+    "application/json": parse_json_doc,
+}
+
+_EXT_MIME = {".txt": "text/plain", ".md": "text/markdown", ".html": "text/html",
+             ".htm": "text/html", ".csv": "text/csv", ".json": "application/json"}
+
+
+class FileParserService:
+    def __init__(self, allowed_local_base_dir: Optional[Path],
+                 max_file_size_bytes: int) -> None:
+        self.base_dir = allowed_local_base_dir
+        self.max_size = max_file_size_bytes
+
+    def parse_bytes(self, data: bytes, mime: str) -> tuple[Document, str]:
+        if len(data) > self.max_size:
+            raise ProblemError.bad_request(
+                f"file exceeds max_file_size_bytes={self.max_size}")
+        parser = PARSERS.get(mime.split(";")[0].strip().lower(), parse_stub)
+        return parser(data), mime
+
+    def parse_local(self, path_str: str) -> tuple[Document, str]:
+        """Path-traversal-safe local parse (module.rs:62-86 defense)."""
+        if self.base_dir is None:
+            raise ProblemError.forbidden("local parsing is not enabled")
+        base = self.base_dir.resolve()
+        target = Path(path_str)
+        resolved = (base / target if not target.is_absolute() else target).resolve()
+        if not str(resolved).startswith(str(base) + "/") and resolved != base:
+            raise ProblemError.forbidden("path escapes allowed_local_base_dir",
+                                         )
+        if not resolved.is_file():
+            raise ProblemError.not_found(f"no such file: {path_str}", code="file_not_found")
+        mime = _EXT_MIME.get(resolved.suffix.lower(), "application/octet-stream")
+        return self.parse_bytes(resolved.read_bytes(), mime)
+
+
+@module(name="file_parser", capabilities=["rest"])
+class FileParserModule(Module, RestApiCapability):
+    def __init__(self) -> None:
+        self.service: Optional[FileParserService] = None
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        cfg = ctx.raw_config()
+        base = cfg.get("allowed_local_base_dir")
+        self.service = FileParserService(
+            Path(base) if base else None,
+            int(cfg.get("max_file_size_bytes", 16 * 1024 * 1024)),
+        )
+
+    def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
+        svc = self.service
+        assert svc is not None
+
+        async def upload_parse(request: web.Request):
+            data = await request.read()
+            doc, mime = svc.parse_bytes(
+                data, request.content_type or "application/octet-stream")
+            return {"markdown": doc.to_markdown(), "title": doc.title,
+                    "mime_type": mime, "blocks": len(doc.blocks)}
+
+        async def parse_local(request: web.Request):
+            body = await read_json(request, {
+                "type": "object", "required": ["path"],
+                "properties": {"path": {"type": "string"}},
+                "additionalProperties": False})
+            doc, mime = svc.parse_local(body["path"])
+            return {"markdown": doc.to_markdown(), "title": doc.title,
+                    "mime_type": mime, "blocks": len(doc.blocks)}
+
+        async def info(request: web.Request):
+            return {"supported_mime_types": sorted(PARSERS),
+                    "max_file_size_bytes": svc.max_size,
+                    "local_parsing": svc.base_dir is not None}
+
+        m = "file_parser"
+        router.operation("POST", "/v1/file-parser/parse", module=m).auth_required() \
+            .accepts("*/*").summary("Parse an uploaded document to markdown") \
+            .handler(upload_parse).register()
+        router.operation("POST", "/v1/file-parser/parse-local", module=m).auth_required() \
+            .summary("Parse a file under allowed_local_base_dir") \
+            .handler(parse_local).register()
+        router.operation("GET", "/v1/file-parser/info", module=m).auth_required() \
+            .summary("Parser capabilities").handler(info).register()

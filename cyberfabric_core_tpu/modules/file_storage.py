@@ -1,0 +1,133 @@
+"""file-storage — media store for the LLM gateway.
+
+Reference (spec-only): modules/file-storage/docs/PRD.md:45-133 — store generated
+content → URL, fetch by URL (streaming), metadata without content. Local-FS
+backend, tenant-partitioned directories, content under ``files/{tenant}/{id}``.
+"""
+
+from __future__ import annotations
+
+import mimetypes
+import uuid
+from pathlib import Path
+from typing import Optional
+
+from aiohttp import web
+
+from ..modkit import Module, module
+from ..modkit.contracts import RestApiCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.errors import ProblemError
+from ..modkit.security import SecurityContext
+from ..gateway.middleware import SECURITY_CONTEXT_KEY
+from .sdk import FileStorageApi, StoredFile
+
+_URL_PREFIX = "/v1/files/"
+
+
+class LocalFileStorage(FileStorageApi):
+    def __init__(self, root: Path, max_bytes: int = 64 * 1024 * 1024) -> None:
+        self.root = root
+        self.max_bytes = max_bytes
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _dir_for(self, tenant_id: str) -> Path:
+        d = self.root / tenant_id
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def _path_for(self, ctx: SecurityContext, url: str) -> Path:
+        if not url.startswith(_URL_PREFIX):
+            raise ProblemError.bad_request(f"not a file-storage url: {url}")
+        file_id = url[len(_URL_PREFIX):]
+        if "/" in file_id or ".." in file_id or not file_id:
+            raise ProblemError.bad_request("malformed file id")
+        path = self._dir_for(ctx.tenant_id) / file_id
+        if not path.exists():
+            raise ProblemError.not_found(f"file {file_id} not found", code="file_not_found")
+        return path
+
+    async def store(self, ctx: SecurityContext, data: bytes, mime_type: str,
+                    filename: Optional[str] = None) -> StoredFile:
+        if len(data) > self.max_bytes:
+            raise ProblemError.bad_request(f"file exceeds {self.max_bytes} bytes")
+        ext = mimetypes.guess_extension(mime_type) or ""
+        file_id = f"{uuid.uuid4().hex}{ext}"
+        path = self._dir_for(ctx.tenant_id) / file_id
+        path.write_bytes(data)
+        meta = path.with_suffix(path.suffix + ".meta")
+        meta.write_text(f"{mime_type}\n{filename or ''}\n")
+        return StoredFile(file_id=file_id, url=f"{_URL_PREFIX}{file_id}",
+                          size_bytes=len(data), mime_type=mime_type, filename=filename)
+
+    async def fetch(self, ctx: SecurityContext, url: str) -> bytes:
+        return self._path_for(ctx, url).read_bytes()
+
+    async def metadata(self, ctx: SecurityContext, url: str) -> StoredFile:
+        path = self._path_for(ctx, url)
+        meta = path.with_suffix(path.suffix + ".meta")
+        mime, filename = "application/octet-stream", None
+        if meta.exists():
+            lines = meta.read_text().splitlines()
+            mime = lines[0] if lines else mime
+            filename = lines[1] or None if len(lines) > 1 else None
+        return StoredFile(file_id=path.name, url=url, size_bytes=path.stat().st_size,
+                          mime_type=mime, filename=filename)
+
+
+@module(name="file_storage", capabilities=["rest"])
+class FileStorageModule(Module, RestApiCapability):
+    def __init__(self) -> None:
+        self.storage: Optional[LocalFileStorage] = None
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        cfg = ctx.raw_config()
+        root = Path(cfg.get("root") or (ctx.app_config.home_dir() / "files"))
+        self.storage = LocalFileStorage(root, int(cfg.get("max_bytes", 64 * 1024 * 1024)))
+        ctx.client_hub.register(FileStorageApi, self.storage)
+
+    def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
+        storage = self.storage
+        assert storage is not None
+
+        async def upload(request: web.Request):
+            data = await request.read()
+            sf = await storage.store(
+                request[SECURITY_CONTEXT_KEY], data,
+                request.content_type or "application/octet-stream",
+                request.headers.get("x-filename"),
+            )
+            return sf.__dict__, 201
+
+        async def download(request: web.Request):
+            sctx = request[SECURITY_CONTEXT_KEY]
+            url = f"{_URL_PREFIX}{request.match_info['file_id']}"
+            meta = await storage.metadata(sctx, url)
+            data = await storage.fetch(sctx, url)
+            return web.Response(body=data, content_type=meta.mime_type)
+
+        async def head(request: web.Request):
+            url = f"{_URL_PREFIX}{request.match_info['file_id']}"
+            meta = await storage.metadata(request[SECURITY_CONTEXT_KEY], url)
+            return meta.__dict__
+
+        async def delete(request: web.Request):
+            sctx = request[SECURITY_CONTEXT_KEY]
+            url = f"{_URL_PREFIX}{request.match_info['file_id']}"
+            path = storage._path_for(sctx, url)
+            path.unlink()
+            meta = path.with_suffix(path.suffix + ".meta")
+            if meta.exists():
+                meta.unlink()
+            return None
+
+        m = "file_storage"
+        router.operation("POST", "/v1/files", module=m).auth_required() \
+            .accepts("*/*").summary("Store content, returns a file URL") \
+            .handler(upload).register()
+        router.operation("GET", "/v1/files/{file_id}", module=m).auth_required() \
+            .summary("Fetch file content").handler(download).register()
+        router.operation("GET", "/v1/files/{file_id}/metadata", module=m).auth_required() \
+            .summary("File metadata without content").handler(head).register()
+        router.operation("DELETE", "/v1/files/{file_id}", module=m).auth_required() \
+            .summary("Delete a file").handler(delete).register()

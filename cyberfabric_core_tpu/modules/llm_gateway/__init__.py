@@ -1,0 +1,10 @@
+"""llm-gateway — unified LLM access with a native TPU local worker.
+
+Reference (spec-only): modules/llm-gateway/docs/{PRD.md,DESIGN.md} + 31 GTS JSON
+Schemas. This package implements the spec for real with the TPU engine as the
+provider backend.
+"""
+
+from .module import LlmGatewayModule
+
+__all__ = ["LlmGatewayModule"]

@@ -1,0 +1,382 @@
+"""llm-gateway module — the REST surface + application layer.
+
+Implements the chat-completion flow of DESIGN.md:348-367 for real:
+validate (GTS schemas) → rate/budget hooks → provider resolution via model-registry
+(exists/approval, fallback ranking DESIGN.md:323-346) → local TPU worker →
+stream normalization to the StreamChunk SSE contract with `data: [DONE]`
+(DESIGN.md:289-311) → TTFT + total timeouts with fallback chains (DESIGN.md:680-741)
+→ usage reporting.
+
+Endpoints (DESIGN.md:262-271): POST /v1/chat/completions, POST /v1/embeddings,
+POST/GET/DELETE /v1/jobs, POST/GET /v1/batches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import json
+import uuid
+from typing import Any, AsyncIterator, Optional
+
+from aiohttp import web
+
+from ...modkit import Module, module
+from ...modkit.contracts import RestApiCapability, RunnableCapability
+from ...modkit.context import ModuleCtx
+from ...modkit.errors import Problem, ProblemError
+from ...modkit.lifecycle import ReadySignal
+from ...modkit.security import SecurityContext
+from ...modkit.sse import SSE_DONE, format_sse_json
+from ...gateway.middleware import SECURITY_CONTEXT_KEY
+from ...gateway.validation import read_json, validate_against
+from ..sdk import ChatStreamChunk, LlmWorkerApi, ModelInfo, ModelRegistryApi
+from . import schemas
+from .worker import LocalTpuWorker
+
+
+class UsageTracker:
+    """Per-tenant token accounting + budget check hook (DESIGN.md:820-855)."""
+
+    def __init__(self, budgets: Optional[dict[str, int]] = None) -> None:
+        self._usage: dict[str, dict[str, int]] = {}
+        self._budgets = budgets or {}
+
+    def check_budget(self, ctx: SecurityContext) -> None:
+        budget = self._budgets.get(ctx.tenant_id)
+        if budget is None:
+            return
+        used = self._usage.get(ctx.tenant_id, {}).get("total_tokens", 0)
+        if used >= budget:
+            raise ProblemError(Problem(
+                status=429, title="Too Many Requests", code="budget_exceeded",
+                detail=f"tenant token budget {budget} exhausted ({used} used)"))
+
+    def report(self, ctx: SecurityContext, usage: dict[str, int]) -> None:
+        entry = self._usage.setdefault(
+            ctx.tenant_id, {"input_tokens": 0, "output_tokens": 0, "total_tokens": 0,
+                            "requests": 0})
+        entry["input_tokens"] += usage.get("input_tokens", 0)
+        entry["output_tokens"] += usage.get("output_tokens", 0)
+        entry["total_tokens"] += usage.get("input_tokens", 0) + usage.get("output_tokens", 0)
+        entry["requests"] += 1
+
+    def snapshot(self, ctx: SecurityContext) -> dict[str, int]:
+        return dict(self._usage.get(ctx.tenant_id, {}))
+
+
+class JobStore:
+    """Async jobs in memory (DESIGN.md:884-889 allows distributed cache; a restart
+    loses pending jobs, matching the stateless-module ADR-0001)."""
+
+    def __init__(self) -> None:
+        self.jobs: dict[str, dict[str, Any]] = {}
+
+    def _evict_expired(self) -> None:
+        now = datetime.datetime.now(datetime.timezone.utc).isoformat()
+        expired = [jid for jid, j in self.jobs.items()
+                   if j.get("expires_at", "") < now
+                   and j["status"] not in ("pending", "running")]
+        for jid in expired:
+            del self.jobs[jid]
+
+    def create(self, ctx: SecurityContext, request: dict) -> dict:
+        self._evict_expired()
+        job_id = f"job-{uuid.uuid4().hex[:20]}"
+        now = datetime.datetime.now(datetime.timezone.utc)
+        job = {
+            "id": job_id, "tenant_id": ctx.tenant_id, "status": "pending",
+            "request": request, "result": None, "error": None,
+            "created_at": now.isoformat(),
+            "expires_at": (now + datetime.timedelta(hours=24)).isoformat(),
+        }
+        self.jobs[job_id] = job
+        return job
+
+    def get(self, ctx: SecurityContext, job_id: str) -> dict:
+        job = self.jobs.get(job_id)
+        if job is None or job["tenant_id"] != ctx.tenant_id:
+            raise ProblemError.not_found(f"job {job_id} not found", code="job_not_found")
+        return job
+
+    def public_view(self, job: dict) -> dict:
+        return {k: v for k, v in job.items()
+                if k != "tenant_id" and not k.startswith("_") and v is not None}
+
+
+@module(name="llm_gateway", deps=["model_registry"], capabilities=["rest", "stateful"])
+class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
+    def __init__(self) -> None:
+        self.worker: Optional[LlmWorkerApi] = None
+        self.registry: Optional[ModelRegistryApi] = None
+        self.usage = UsageTracker()
+        self.jobs = JobStore()
+        self.ttft_timeout_s = 120.0
+        self.total_timeout_s = 600.0
+        self._job_tasks: set[asyncio.Task] = set()
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        cfg = ctx.raw_config()
+        self.registry = ctx.client_hub.get(ModelRegistryApi)
+        # allow a pre-registered worker (test seam per client_hub.rs:16)
+        self.worker = ctx.client_hub.try_get(LlmWorkerApi)
+        if self.worker is None:
+            self.worker = LocalTpuWorker(cfg.get("worker", {}))
+            ctx.client_hub.register(LlmWorkerApi, self.worker)
+        self.usage = UsageTracker(cfg.get("budgets"))
+        self.ttft_timeout_s = float(cfg.get("ttft_timeout_s", 120.0))
+        self.total_timeout_s = float(cfg.get("total_timeout_s", 600.0))
+
+    async def start(self, ctx: ModuleCtx, ready: ReadySignal) -> None:
+        ready.notify_ready()
+
+    async def stop(self, ctx: ModuleCtx) -> None:
+        for t in list(self._job_tasks):
+            t.cancel()
+
+    # ------------------------------------------------------------- application layer
+    async def _resolve_with_fallback(
+        self, ctx: SecurityContext, body: dict
+    ) -> list[tuple[bool, ModelInfo]]:
+        """Primary + fallback chain as (is_primary, model) pairs; resolution
+        errors are skipped so a dead primary still falls through
+        (DESIGN.md:323-346)."""
+        assert self.registry is not None
+        names = [body["model"]]
+        fb = body.get("fallback") or {}
+        names += [n for n in fb.get("models", []) if n not in names]
+        max_attempts = int(fb.get("max_attempts", len(names)))
+        resolved: list[tuple[bool, ModelInfo]] = []
+        errors: list[str] = []
+        for pos, name in enumerate(names[:max_attempts]):
+            try:
+                resolved.append((pos == 0, await self.registry.resolve(ctx, name)))
+            except ProblemError as e:
+                errors.append(f"{name}: {e.problem.detail or e.problem.title}")
+        if not resolved:
+            raise ProblemError.not_found(
+                "no usable model in request chain: " + "; ".join(errors),
+                code="model_not_found",
+            )
+        return resolved
+
+    async def _chat_once(
+        self, ctx: SecurityContext, model: ModelInfo, body: dict
+    ) -> AsyncIterator[ChatStreamChunk]:
+        """One model attempt with TTFT + total timeout enforcement
+        (DESIGN.md:706-741)."""
+        assert self.worker is not None
+        agen = self.worker.chat_stream(model, body["messages"], body)
+        deadline = asyncio.get_event_loop().time() + self.total_timeout_s
+        first = True
+        while True:
+            timeout = self.ttft_timeout_s if first else max(
+                0.05, deadline - asyncio.get_event_loop().time())
+            try:
+                chunk = await asyncio.wait_for(agen.__anext__(), timeout)
+            except StopAsyncIteration:
+                return
+            except asyncio.TimeoutError:
+                await agen.aclose()
+                raise ProblemError(Problem(
+                    status=504, title="Gateway Timeout",
+                    code="ttft_timeout" if first else "total_timeout",
+                    detail=f"model {model.canonical_id} "
+                           f"{'TTFT' if first else 'total'} timeout"))
+            first = False
+            yield chunk
+
+    # ------------------------------------------------------------- REST handlers
+    async def handle_chat(self, request: web.Request):
+        body = await read_json(request, schemas.REQUEST)
+        ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
+        self.usage.check_budget(ctx)
+        models = await self._resolve_with_fallback(ctx, body)
+
+        if body.get("async"):
+            job = self.jobs.create(ctx, body)
+            self._spawn_job(ctx, job, models)
+            return self.jobs.public_view(job), 202
+        if body.get("stream"):
+            return await self._stream_response(request, ctx, body, models)
+        return await self._sync_response(ctx, body, models)
+
+    async def _sync_response(self, ctx: SecurityContext, body: dict,
+                             models: list[tuple[bool, ModelInfo]]) -> dict:
+        last_err: Optional[ProblemError] = None
+        for is_primary, model in models:
+            pieces: list[str] = []
+            usage = {"input_tokens": 0, "output_tokens": 0}
+            finish = "stop"
+            try:
+                async for chunk in self._chat_once(ctx, model, body):
+                    if chunk.text:
+                        pieces.append(chunk.text)
+                    if chunk.finish_reason:
+                        finish = chunk.finish_reason
+                        usage = chunk.usage or usage
+                cost = self._cost(model, usage)
+                if cost is not None:
+                    usage["cost_estimate"] = cost
+                self.usage.report(ctx, usage)
+                resp = {
+                    "content": [{"type": "text", "text": "".join(pieces)}],
+                    "usage": usage,
+                    "model_used": model.canonical_id,
+                    "fallback_used": not is_primary,
+                    "finish_reason": finish,
+                }
+                validate_against(schemas.RESPONSE, resp)
+                return resp
+            except ProblemError as e:
+                last_err = e
+                continue
+        assert last_err is not None
+        raise last_err
+
+    async def _stream_response(self, request: web.Request, ctx: SecurityContext,
+                               body: dict,
+                               models: list[tuple[bool, ModelInfo]]) -> web.StreamResponse:
+        """SSE per the chunk contract: role-bearing first delta, content deltas,
+        final chunk with finish_reason + usage, then data: [DONE]."""
+        resp: Optional[web.StreamResponse] = None
+        completion_id = f"chatcmpl-{uuid.uuid4().hex[:20]}"
+        last_err: Optional[ProblemError] = None
+        for is_primary, model in models:
+            try:
+                agen = self._chat_once(ctx, model, body)
+                first_chunk = await agen.__anext__()
+            except StopAsyncIteration:
+                continue
+            except ProblemError as e:
+                last_err = e
+                continue  # fallback BEFORE the stream starts; after TTFT we're committed
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Model-Used": model.canonical_id,
+            })
+            await resp.prepare(request)
+
+            async def send(payload: dict) -> None:
+                validate_against(schemas.STREAM_CHUNK, payload)
+                await resp.write(format_sse_json(payload))
+
+            role_sent = False
+
+            async def emit(chunk: ChatStreamChunk) -> None:
+                nonlocal role_sent
+                delta: dict[str, Any] = {}
+                if not role_sent:
+                    delta["role"] = "assistant"
+                    role_sent = True
+                if chunk.text:
+                    delta["content"] = chunk.text
+                payload: dict[str, Any] = {
+                    "id": completion_id, "model": model.canonical_id, "delta": delta,
+                }
+                if chunk.finish_reason:
+                    payload["finish_reason"] = chunk.finish_reason
+                    usage = dict(chunk.usage or {})
+                    cost = self._cost(model, usage)
+                    if cost is not None:
+                        usage["cost_estimate"] = cost
+                    payload["usage"] = usage
+                    self.usage.report(ctx, usage)
+                await send(payload)
+
+            try:
+                await emit(first_chunk)
+                async for chunk in agen:
+                    await emit(chunk)
+            except ProblemError as e:
+                # mid-stream failure: emit a terminal error event (can't re-status)
+                await resp.write(format_sse_json(
+                    {"error": e.problem.to_dict()}, event="error"))
+            await resp.write(SSE_DONE)
+            await resp.write_eof()
+            return resp
+        raise last_err or ProblemError.service_unavailable("no model produced a stream")
+
+    def _spawn_job(self, ctx: SecurityContext, job: dict,
+                   models: list[tuple[bool, ModelInfo]]) -> None:
+        async def run() -> None:
+            job["status"] = "running"
+            try:
+                result = await self._sync_response(ctx, job["request"], models)
+                job["status"], job["result"] = "completed", result
+            except asyncio.CancelledError:
+                job["status"] = "cancelled"
+                raise
+            except ProblemError as e:
+                job["status"], job["error"] = "failed", e.problem.to_dict()
+            except Exception as e:  # noqa: BLE001
+                job["status"], job["error"] = "failed", {"detail": str(e)}
+
+        task = asyncio.ensure_future(run())
+        job["_task"] = task
+        self._job_tasks.add(task)
+        task.add_done_callback(self._job_tasks.discard)
+
+    async def handle_get_job(self, request: web.Request):
+        ctx = request[SECURITY_CONTEXT_KEY]
+        job = self.jobs.get(ctx, request.match_info["job_id"])
+        return self.jobs.public_view(job)
+
+    async def handle_cancel_job(self, request: web.Request):
+        ctx = request[SECURITY_CONTEXT_KEY]
+        job = self.jobs.get(ctx, request.match_info["job_id"])
+        task: Optional[asyncio.Task] = job.get("_task")
+        if job["status"] in ("pending", "running") and task is not None:
+            task.cancel()
+            job["status"] = "cancelled"
+        return self.jobs.public_view(job)
+
+    async def handle_embeddings(self, request: web.Request):
+        body = await read_json(request, schemas.EMBEDDING_REQUEST)
+        ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
+        self.usage.check_budget(ctx)
+        assert self.registry is not None and self.worker is not None
+        model = await self.registry.resolve(ctx, body["model"])
+        inputs = body["input"] if isinstance(body["input"], list) else [body["input"]]
+        vectors = await self.worker.embed(model, inputs, body)
+        usage = {"input_tokens": sum(len(t.split()) for t in inputs), "output_tokens": 0}
+        self.usage.report(ctx, usage)
+        data = [{"index": i, "embedding": v} for i, v in enumerate(vectors)]
+        return {"data": data, "model": model.canonical_id, "usage": usage}
+
+    async def handle_usage(self, request: web.Request):
+        ctx = request[SECURITY_CONTEXT_KEY]
+        return {"tenant_id": ctx.tenant_id, "usage": self.usage.snapshot(ctx)}
+
+    @staticmethod
+    def _cost(model: ModelInfo, usage: dict[str, int]) -> Optional[float]:
+        if not model.cost:
+            return None
+        cin = model.cost.get("input_per_1k", 0.0) * usage.get("input_tokens", 0) / 1000.0
+        cout = model.cost.get("output_per_1k", 0.0) * usage.get("output_tokens", 0) / 1000.0
+        return round(cin + cout, 8)
+
+    # ------------------------------------------------------------- registration
+    def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
+        m = "llm_gateway"
+        openapi.register_schema("LlmRequest", schemas.REQUEST)
+        openapi.register_schema("LlmResponse", schemas.RESPONSE)
+        openapi.register_schema("StreamChunk", schemas.STREAM_CHUNK)
+        openapi.register_schema("EmbeddingRequest", schemas.EMBEDDING_REQUEST)
+        openapi.register_schema("Job", schemas.JOB)
+
+        router.operation("POST", "/v1/chat/completions", module=m).auth_required() \
+            .summary("Chat completion (sync, SSE stream, or async job)") \
+            .request_schema(schemas.REQUEST).response_schema(schemas.RESPONSE) \
+            .sse_response().handler(self.handle_chat).register()
+        router.operation("POST", "/v1/embeddings", module=m).auth_required() \
+            .summary("Text embeddings").request_schema(schemas.EMBEDDING_REQUEST) \
+            .handler(self.handle_embeddings).register()
+        router.operation("GET", "/v1/jobs/{job_id}", module=m).auth_required() \
+            .summary("Async job status/result").response_schema(schemas.JOB) \
+            .handler(self.handle_get_job).register()
+        router.operation("DELETE", "/v1/jobs/{job_id}", module=m).auth_required() \
+            .summary("Cancel an async job").handler(self.handle_cancel_job).register()
+        router.operation("GET", "/v1/usage", module=m).auth_required() \
+            .summary("Tenant usage counters").handler(self.handle_usage).register()

@@ -1,0 +1,334 @@
+"""LocalTpuWorker — the llm-gateway provider backend running on the TPU engine.
+
+This is the piece the reference delegates to external HTTP providers
+(DESIGN.md:317-346 "Provider Adapter → OAGW call"); here it is a native local
+worker: prefill/decode as XLA computations, with request-level **dynamic batching**
+— concurrent chat requests landing within a small window are fused into one
+lockstep device batch (BASELINE config #2's mechanism).
+
+Asyncio↔device bridging: jitted steps block, so each engine's batch runs on a
+dedicated thread; tokens cross back via call_soon_threadsafe into per-request
+asyncio queues.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, AsyncIterator, Optional
+
+from ...modkit.errors import ProblemError
+from ...runtime.engine import EngineConfig, InferenceEngine, SamplingParams, StepEvent
+from ...runtime.tokenizer import ByteTokenizer, Tokenizer, load_tokenizer, render_chat
+from ..sdk import ChatStreamChunk, LlmWorkerApi, ModelInfo
+
+logger = logging.getLogger("llm_worker")
+
+_STREAM_END = object()
+
+
+@dataclass
+class _Request:
+    prompt_ids: list[int]
+    sampling: SamplingParams
+    queue: asyncio.Queue
+    stop_strings: tuple[str, ...] = ()
+
+
+@dataclass
+class _EngineEntry:
+    engine: InferenceEngine
+    tokenizer: Tokenizer
+    batcher: "_DynamicBatcher"
+    model_family: str = "llama"
+
+
+class _DynamicBatcher:
+    """Collect requests for up to ``window_ms``, run them as one device batch."""
+
+    def __init__(self, engine: InferenceEngine, executor: ThreadPoolExecutor,
+                 window_ms: float = 4.0) -> None:
+        self._engine = engine
+        self._executor = executor
+        self._window = window_ms / 1000.0
+        self._pending: list[_Request] = []
+        self._wakeup = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    def ensure_running(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._run())
+
+    async def submit(self, req: _Request) -> None:
+        self._pending.append(req)
+        self._wakeup.set()
+        self.ensure_running()
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wakeup.set()
+        if self._task is not None:
+            await self._task
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._closed:
+            if not self._pending:
+                self._wakeup.clear()
+                try:
+                    await asyncio.wait_for(self._wakeup.wait(), timeout=5.0)
+                except asyncio.TimeoutError:
+                    if not self._pending:
+                        return  # idle exit; resurrected on next submit
+                continue
+            await asyncio.sleep(self._window)  # batching window
+            batch = self._pending[: self._engine.config.max_batch]
+            del self._pending[: len(batch)]
+            await loop.run_in_executor(self._executor, self._drive, loop, batch)
+
+    def _drive(self, loop: asyncio.AbstractEventLoop, batch: list[_Request]) -> None:
+        """Thread context: run the blocking lockstep generation. Errors must be
+        enqueued BEFORE the end sentinel or consumers would break on the sentinel
+        and report an empty 200 instead of the failure."""
+        prompts = [r.prompt_ids for r in batch]
+        samplings = [r.sampling for r in batch]
+        try:
+            for ev in self._engine.generate_stream(prompts, samplings):
+                req = batch[ev.request_index]
+                loop.call_soon_threadsafe(req.queue.put_nowait, ev)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("batch generation failed")
+            for req in batch:
+                loop.call_soon_threadsafe(req.queue.put_nowait, e)
+        finally:
+            for req in batch:
+                loop.call_soon_threadsafe(req.queue.put_nowait, _STREAM_END)
+
+
+class LocalTpuWorker(LlmWorkerApi):
+    """Engine pool keyed by canonical model id; engines build lazily from
+    ModelInfo.engine_options (+ checkpoint when managed)."""
+
+    def __init__(self, worker_config: Optional[dict[str, Any]] = None) -> None:
+        self._config = worker_config or {}
+        self._entries: dict[str, _EngineEntry] = {}
+        self._entry_locks: dict[str, asyncio.Lock] = {}
+        self._executor = ThreadPoolExecutor(
+            max_workers=int(self._config.get("max_engine_threads", 4)),
+            thread_name_prefix="tpu-worker",
+        )
+        self._started_at = time.monotonic()
+        self._requests_served = 0
+        self._tokens_out = 0
+
+    # ------------------------------------------------------------------ engines
+    async def _entry_for(self, model: ModelInfo) -> _EngineEntry:
+        key = model.canonical_id
+        entry = self._entries.get(key)
+        if entry is not None:
+            return entry
+        lock = self._entry_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                return entry
+            loop = asyncio.get_running_loop()
+            entry = await loop.run_in_executor(self._executor, self._build_entry, model)
+            self._entries[key] = entry
+            return entry
+
+    def _build_entry(self, model: ModelInfo) -> _EngineEntry:
+        opts = dict(model.engine_options or {})
+        arch_config = opts.pop("model_config", None) or model.provider_model_id
+        eng_cfg = EngineConfig(
+            model=arch_config,
+            max_seq_len=int(opts.pop("max_seq_len", 2048)),
+            max_batch=int(opts.pop("max_batch", 8)),
+            dtype=opts.pop("dtype", "bfloat16"),
+            eos_token_ids=tuple(opts.pop("eos_token_ids", ()) or ()),
+        )
+        params = None
+        tokenizer: Tokenizer
+        if model.checkpoint_path and Path(model.checkpoint_path).exists():
+            from ...models import get_config
+            from ...runtime.weights import load_llama_params
+
+            cfg = get_config(arch_config)
+            params = load_llama_params(model.checkpoint_path, cfg)
+            tokenizer = load_tokenizer(model.checkpoint_path)
+        else:
+            # synthetic weights (airgapped/dev): byte tokenizer over model vocab
+            from ...models import get_config
+
+            tokenizer = ByteTokenizer(get_config(arch_config).vocab_size)
+            if not eng_cfg.eos_token_ids:
+                eng_cfg = EngineConfig(**{**eng_cfg.__dict__,
+                                          "eos_token_ids": (tokenizer.eos_id,)})
+        engine = InferenceEngine(eng_cfg)
+        if params is not None:
+            engine.params = params
+        logger.info("engine ready for %s (%s, max_seq=%d)", model.canonical_id,
+                    arch_config, eng_cfg.max_seq_len)
+        return _EngineEntry(
+            engine=engine,
+            tokenizer=tokenizer,
+            batcher=_DynamicBatcher(
+                engine, self._executor,
+                window_ms=float(self._config.get("batch_window_ms", 4.0)),
+            ),
+        )
+
+    # ------------------------------------------------------------------ chat
+    async def chat_stream(
+        self, model: ModelInfo, messages: list[dict], params: dict
+    ) -> AsyncIterator[ChatStreamChunk]:
+        entry = await self._entry_for(model)
+        prompt = render_chat(messages, entry.model_family)
+        prompt_ids = entry.tokenizer.encode(prompt)
+        limits_max = int(model.limits.get("max_output_tokens", 1024)) if model.limits else 1024
+        sampling = SamplingParams(
+            max_tokens=min(int(params.get("max_tokens", 256)), limits_max),
+            temperature=float(params.get("temperature", 0.0)),
+            top_p=float(params.get("top_p", 1.0)),
+            top_k=int(params.get("top_k", 0)),
+        )
+        max_input = int(model.limits.get("max_input_tokens", 0)) if model.limits else 0
+        if max_input and len(prompt_ids) > max_input:
+            raise ProblemError.unprocessable(
+                f"prompt of {len(prompt_ids)} tokens exceeds model limit {max_input}",
+                code="context_length_exceeded",
+            )
+        if len(prompt_ids) >= entry.engine.config.max_seq_len:
+            raise ProblemError.unprocessable(
+                f"prompt of {len(prompt_ids)} tokens exceeds engine window "
+                f"{entry.engine.config.max_seq_len}",
+                code="context_length_exceeded",
+            )
+
+        request_id = f"chat-{uuid.uuid4().hex[:20]}"
+        queue: asyncio.Queue = asyncio.Queue()
+        req = _Request(
+            prompt_ids=prompt_ids,
+            sampling=sampling,
+            queue=queue,
+            stop_strings=tuple(params.get("stop", ()) or ()),
+        )
+        await entry.batcher.submit(req)
+
+        # incremental streaming detokenizer: decode only the unstable tail (tokens
+        # whose text may still change via BPE/utf-8 merges), flushing it into
+        # stable_text once it decodes cleanly — O(n) total, not O(n^2)
+        tail_ids: list[int] = []
+        stable_text = ""
+        sent_text = ""
+        stop_hit = False
+        n_tokens = 0
+        max_stop_len = max((len(s) for s in req.stop_strings), default=0)
+        while True:
+            item = await queue.get()
+            if item is _STREAM_END:
+                break
+            if isinstance(item, Exception):
+                raise ProblemError.internal(f"generation failed: {item}")
+            ev: StepEvent = item
+            n_tokens += 1
+            if ev.finished != "stop":
+                tail_ids.append(ev.token_id)
+            tail_text = entry.tokenizer.decode(tail_ids)
+            if tail_text and not tail_text.endswith("�") and len(tail_ids) >= 8:
+                stable_text += tail_text
+                tail_ids = []
+                tail_text = ""
+            full_text = stable_text + tail_text
+            delta = full_text[len(sent_text):]
+            # stop-string scan over the recent window only
+            if req.stop_strings and not stop_hit:
+                window_start = max(0, len(sent_text) - max_stop_len)
+                window = full_text[window_start:]
+                hit_rel = min((window.find(s) for s in req.stop_strings
+                               if window.find(s) >= 0), default=-1)
+                if hit_rel >= 0:
+                    delta = full_text[len(sent_text):window_start + hit_rel]
+                    stop_hit = True
+            if delta:
+                sent_text += delta
+                yield ChatStreamChunk(request_id=request_id, text=delta,
+                                      token_id=ev.token_id)
+            if ev.finished or stop_hit:
+                self._requests_served += 1
+                self._tokens_out += n_tokens
+                usage = {"input_tokens": len(prompt_ids), "output_tokens": n_tokens}
+                reason = "stop" if (stop_hit or ev.finished == "stop") else (ev.finished or "stop")
+                yield ChatStreamChunk(request_id=request_id, finish_reason=reason,
+                                      usage=usage)
+                if stop_hit and not ev.finished:
+                    # drain remaining events of this request without emitting
+                    while True:
+                        tail = await queue.get()
+                        if tail is _STREAM_END or (
+                            isinstance(tail, StepEvent) and tail.finished
+                        ):
+                            break
+                return
+
+    # ------------------------------------------------------------------ embeddings
+    async def embed(self, model: ModelInfo, inputs: list[str], params: dict) -> list[list[float]]:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor, self._embed_blocking, model, inputs, params
+        )
+
+    def _embed_blocking(self, model: ModelInfo, inputs: list[str], params: dict) -> list[list[float]]:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ...models import bert, get_config
+
+        key = f"embed::{model.canonical_id}"
+        entry = self._entries.get(key)
+        if entry is None:
+            cfg = get_config(dict(model.engine_options or {}).get("model_config")
+                             or model.provider_model_id)
+            params_tree = bert.init_params(cfg, jax.random.PRNGKey(0))
+            tokenizer = (load_tokenizer(model.checkpoint_path, cfg.vocab_size)
+                         if model.checkpoint_path else ByteTokenizer(cfg.vocab_size))
+            fwd = jax.jit(lambda p, ids, mask: bert.embed_pooled(p, cfg, ids, mask))
+            entry = _EngineEntry(engine=None, tokenizer=tokenizer, batcher=None)  # type: ignore[arg-type]
+            entry.embed_fn = (fwd, params_tree, cfg)  # type: ignore[attr-defined]
+            self._entries[key] = entry
+        fwd, params_tree, cfg = entry.embed_fn  # type: ignore[attr-defined]
+
+        max_len = min(cfg.max_position, 128)
+        out: list[list[float]] = []
+        # bucket to fixed batch 8 to bound compile count
+        for i in range(0, len(inputs), 8):
+            chunk = inputs[i:i + 8]
+            ids = np.zeros((8, max_len), np.int32)
+            mask = np.zeros((8, max_len), np.int32)
+            for j, text in enumerate(chunk):
+                toks = entry.tokenizer.encode(text)[:max_len]
+                ids[j, : len(toks)] = toks
+                mask[j, : len(toks)] = 1
+            emb = np.asarray(fwd(params_tree, jnp.asarray(ids), jnp.asarray(mask)))
+            out.extend(emb[: len(chunk)].astype(float).tolist())
+        return out
+
+    # ------------------------------------------------------------------ health
+    async def health(self) -> dict[str, Any]:
+        import jax
+
+        return {
+            "status": "ok",
+            "devices": [str(d) for d in jax.devices()],
+            "loaded_models": sorted(self._entries),
+            "requests_served": self._requests_served,
+            "tokens_out": self._tokens_out,
+            "uptime_s": round(time.monotonic() - self._started_at, 1),
+        }

@@ -1,0 +1,296 @@
+"""model-registry — tenant-scoped model catalog, implemented for real.
+
+Reference (spec-only): modules/model-registry/docs/PRD.md — Provider (:179-190),
+Model with canonical id `{provider_slug}::{provider_model_id}`, capability flags,
+limits, cost, lifecycle, **infrastructure fields for local LLMs** managed/
+architecture/size_bytes/format incl. safetensors (:200-224), ModelApproval state
+machine (:242-253), alias resolution chain (:298-306), <10ms p99 resolution (:50).
+
+Resolution is served from an in-memory read-through cache over the sqlite store so
+the p99 bar is trivially met; writes invalidate.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from ..modkit import Module, module
+from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.db import ScopableEntity
+from ..modkit.errors import ProblemError
+from ..modkit.security import SecurityContext
+from .sdk import ModelInfo, ModelRegistryApi
+
+MODELS = ScopableEntity(
+    table="models",
+    field_map={
+        "id": "id", "tenant_id": "tenant_id", "provider_slug": "provider_slug",
+        "provider_model_id": "provider_model_id", "canonical_id": "canonical_id",
+        "display_name": "display_name", "capabilities": "capabilities",
+        "limits": "limits", "cost": "cost", "lifecycle_status": "lifecycle_status",
+        "approval_state": "approval_state", "managed": "managed",
+        "architecture": "architecture", "size_bytes": "size_bytes",
+        "format": "format", "checkpoint_path": "checkpoint_path",
+        "engine_options": "engine_options", "created_at": "created_at",
+    },
+    json_cols=("capabilities", "limits", "cost", "engine_options"),
+)
+
+ALIASES = ScopableEntity(
+    table="aliases",
+    field_map={"id": "id", "tenant_id": "tenant_id", "alias": "alias",
+               "target": "target"},
+)
+
+#: ModelApproval state machine (PRD.md:242-253)
+_APPROVAL_TRANSITIONS: dict[str, set[str]] = {
+    "pending": {"approved", "rejected"},
+    "approved": {"revoked"},
+    "rejected": {"pending"},
+    "revoked": {"pending"},
+}
+
+def _migrate_0001(c):
+    c.execute(
+        "CREATE TABLE models ("
+        "id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, "
+        "provider_slug TEXT NOT NULL, provider_model_id TEXT NOT NULL, "
+        "canonical_id TEXT NOT NULL, display_name TEXT DEFAULT '', "
+        "capabilities TEXT, limits TEXT, cost TEXT, "
+        "lifecycle_status TEXT DEFAULT 'active', "
+        "approval_state TEXT DEFAULT 'pending', "
+        "managed INTEGER DEFAULT 0, architecture TEXT, size_bytes INTEGER, "
+        "format TEXT, checkpoint_path TEXT, engine_options TEXT, "
+        "created_at TEXT DEFAULT (datetime('now')), "
+        "UNIQUE (tenant_id, canonical_id))"
+    )
+    c.execute("CREATE INDEX idx_models_canonical ON models (tenant_id, canonical_id)")
+    c.execute(
+        "CREATE TABLE aliases ("
+        "id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, "
+        "alias TEXT NOT NULL, target TEXT NOT NULL, "
+        "UNIQUE (tenant_id, alias))"
+    )
+
+
+_MIGRATIONS = [Migration("0001_models", _migrate_0001)]
+
+
+class ModelRegistryService(ModelRegistryApi):
+    def __init__(self, ctx: ModuleCtx) -> None:
+        self._ctx = ctx
+        self._db = ctx.db_required()
+        # read-through resolution cache: (tenant, name) -> (ModelInfo, expiry)
+        self._cache: dict[tuple[str, str], tuple[ModelInfo, float]] = {}
+        self._cache_ttl = 5.0
+
+    # ------------------------------------------------------------- write side
+    def register_model(self, ctx: SecurityContext, spec: dict[str, Any]) -> ModelInfo:
+        required = ("provider_slug", "provider_model_id")
+        missing = [k for k in required if not spec.get(k)]
+        if missing:
+            raise ProblemError.bad_request(f"missing fields: {missing}")
+        canonical = f"{spec['provider_slug']}::{spec['provider_model_id']}"
+        row = {
+            "provider_slug": spec["provider_slug"],
+            "provider_model_id": spec["provider_model_id"],
+            "canonical_id": canonical,
+            "display_name": spec.get("display_name", canonical),
+            "capabilities": spec.get("capabilities", {}),
+            "limits": spec.get("limits", {}),
+            "cost": spec.get("cost", {}),
+            "lifecycle_status": spec.get("lifecycle_status", "active"),
+            "approval_state": spec.get("approval_state", "pending"),
+            "managed": bool(spec.get("managed", False)),
+            "architecture": spec.get("architecture"),
+            "size_bytes": spec.get("size_bytes"),
+            "format": spec.get("format"),
+            "checkpoint_path": spec.get("checkpoint_path"),
+            "engine_options": spec.get("engine_options", {}),
+        }
+        conn = self._db.secure(ctx, MODELS)
+        if conn.find_one({"canonical_id": canonical}):
+            raise ProblemError.conflict(f"model {canonical} already registered")
+        created = conn.insert(row)
+        self._invalidate(ctx.tenant_id)
+        return self._to_info(created)
+
+    def set_approval(self, ctx: SecurityContext, canonical_id: str, new_state: str) -> ModelInfo:
+        conn = self._db.secure(ctx, MODELS)
+        row = conn.find_one({"canonical_id": canonical_id})
+        if row is None:
+            raise ProblemError.not_found(f"model {canonical_id} not found")
+        cur = row["approval_state"]
+        if new_state not in _APPROVAL_TRANSITIONS.get(cur, set()):
+            raise ProblemError.conflict(
+                f"approval transition {cur} -> {new_state} not allowed "
+                f"(allowed: {sorted(_APPROVAL_TRANSITIONS.get(cur, set()))})",
+                code="invalid_transition",
+            )
+        conn.update(row["id"], {"approval_state": new_state})
+        self._invalidate(ctx.tenant_id)
+        row["approval_state"] = new_state
+        return self._to_info(row)
+
+    def set_alias(self, ctx: SecurityContext, alias: str, target: str) -> None:
+        conn = self._db.secure(ctx, ALIASES)
+        existing = conn.find_one({"alias": alias})
+        if existing:
+            conn.update(existing["id"], {"target": target})
+        else:
+            conn.insert({"alias": alias, "target": target})
+        self._invalidate(ctx.tenant_id)
+
+    def _invalidate(self, tenant_id: str) -> None:
+        self._cache = {k: v for k, v in self._cache.items() if k[0] != tenant_id}
+
+    # ------------------------------------------------------------- read side
+    async def resolve(self, ctx: SecurityContext, name: str) -> ModelInfo:
+        key = (ctx.tenant_id, name)
+        hit = self._cache.get(key)
+        if hit and hit[1] > time.monotonic():
+            return hit[0]
+        info = self._resolve_uncached(ctx, name)
+        self._cache[key] = (info, time.monotonic() + self._cache_ttl)
+        return info
+
+    def _resolve_uncached(self, ctx: SecurityContext, name: str) -> ModelInfo:
+        alias_conn = self._db.secure(ctx, ALIASES)
+        conn = self._db.secure(ctx, MODELS)
+        # alias chain (PRD.md:298-306), cycle-guarded
+        seen: set[str] = set()
+        target = name
+        for _ in range(8):
+            if target in seen:
+                raise ProblemError.conflict(f"alias cycle at {target!r}", code="alias_cycle")
+            seen.add(target)
+            alias_row = alias_conn.find_one({"alias": target})
+            if alias_row is None:
+                break
+            target = alias_row["target"]
+        row = conn.find_one({"canonical_id": target})
+        if row is None:
+            # convenience: bare provider_model_id resolves if unambiguous
+            candidates = conn.select(where={"provider_model_id": target})
+            if len(candidates) == 1:
+                row = candidates[0]
+        if row is None:
+            raise ProblemError.not_found(f"model {name!r} not found", code="model_not_found")
+        if row["approval_state"] != "approved":
+            raise ProblemError.forbidden(
+                f"model {row['canonical_id']} is not approved "
+                f"(state: {row['approval_state']})"
+            )
+        if row["lifecycle_status"] in ("retired", "disabled"):
+            raise ProblemError.not_found(
+                f"model {row['canonical_id']} is {row['lifecycle_status']}")
+        return self._to_info(row)
+
+    async def list_models(self, ctx: SecurityContext, filter_text: Optional[str] = None,
+                          cursor: Optional[str] = None, limit: Optional[int] = None):
+        conn = self._db.secure(ctx, MODELS)
+        return conn.list_odata(filter_text=filter_text, orderby_text="canonical_id",
+                               cursor=cursor, limit=limit)
+
+    @staticmethod
+    def _to_info(row: dict[str, Any]) -> ModelInfo:
+        return ModelInfo(
+            canonical_id=row["canonical_id"],
+            provider_slug=row["provider_slug"],
+            provider_model_id=row["provider_model_id"],
+            display_name=row.get("display_name") or row["canonical_id"],
+            capabilities=row.get("capabilities") or {},
+            limits=row.get("limits") or {},
+            cost=row.get("cost") or {},
+            lifecycle_status=row.get("lifecycle_status", "active"),
+            approval_state=row.get("approval_state", "pending"),
+            managed=bool(row.get("managed")),
+            architecture=row.get("architecture"),
+            size_bytes=row.get("size_bytes"),
+            format=row.get("format"),
+            checkpoint_path=row.get("checkpoint_path"),
+            engine_options=row.get("engine_options") or {},
+        )
+
+
+@module(name="model_registry", capabilities=["db", "rest"])
+class ModelRegistryModule(Module, DatabaseCapability, RestApiCapability):
+    """Module wiring: seeds config-declared models at init (quickstart pattern)."""
+
+    def __init__(self) -> None:
+        self.service: Optional[ModelRegistryService] = None
+
+    def migrations(self):
+        return _MIGRATIONS
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        self.service = ModelRegistryService(ctx)
+        ctx.client_hub.register(ModelRegistryApi, self.service)
+        # seed models from modules.model_registry.config.models: [...]
+        seed_ctx = SecurityContext.anonymous(
+            ctx.raw_config().get("seed_tenant", "default"))
+        for spec in ctx.raw_config().get("models", []):
+            try:
+                self.service.register_model(seed_ctx, dict(spec))
+            except ProblemError as e:
+                if e.problem.status != 409:  # idempotent restarts
+                    raise
+        for alias, target in (ctx.raw_config().get("aliases") or {}).items():
+            self.service.set_alias(seed_ctx, alias, target)
+
+    def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
+        from aiohttp import web
+
+        from ..gateway.middleware import SECURITY_CONTEXT_KEY
+        from ..gateway.validation import read_json
+
+        svc = self.service
+        assert svc is not None
+
+        async def list_models(request: web.Request):
+            page = await svc.list_models(
+                request[SECURITY_CONTEXT_KEY],
+                filter_text=request.query.get("$filter"),
+                cursor=request.query.get("cursor"),
+                limit=int(request.query["limit"]) if "limit" in request.query else None,
+            )
+            return page.to_dict()
+
+        async def register_model(request: web.Request):
+            body = await read_json(request)
+            info = svc.register_model(request[SECURITY_CONTEXT_KEY], body)
+            return info.to_dict(), 201
+
+        async def get_model(request: web.Request):
+            name = request.match_info["name"]
+            info = await svc.resolve(request[SECURITY_CONTEXT_KEY], name)
+            return info.to_dict()
+
+        async def set_approval(request: web.Request):
+            body = await read_json(request, {"type": "object", "required": ["state"],
+                                             "properties": {"state": {"type": "string"}}})
+            info = svc.set_approval(request[SECURITY_CONTEXT_KEY],
+                                    request.match_info["name"], body["state"])
+            return info.to_dict()
+
+        async def set_alias(request: web.Request):
+            body = await read_json(request, {"type": "object",
+                                             "required": ["alias", "target"],
+                                             "properties": {"alias": {"type": "string"},
+                                                            "target": {"type": "string"}}})
+            svc.set_alias(request[SECURITY_CONTEXT_KEY], body["alias"], body["target"])
+            return None
+
+        m = "model_registry"
+        router.operation("GET", "/v1/model-registry/models", module=m).auth_required() \
+            .summary("List models (OData $filter, cursor paging)").handler(list_models).register()
+        router.operation("POST", "/v1/model-registry/models", module=m).auth_required() \
+            .summary("Register a model").handler(register_model).register()
+        router.operation("GET", "/v1/model-registry/models/{name}", module=m).auth_required() \
+            .summary("Resolve a model by canonical id or alias").handler(get_model).register()
+        router.operation("POST", "/v1/model-registry/models/{name}/approval", module=m) \
+            .auth_required().summary("Drive the approval state machine").handler(set_approval).register()
+        router.operation("POST", "/v1/model-registry/aliases", module=m).auth_required() \
+            .summary("Create/update an alias").handler(set_alias).register()

@@ -1,0 +1,88 @@
+"""module-orchestrator — module inventory, health aggregation, service directory.
+
+Reference: modules/system/module-orchestrator (+ the DirectoryService domain logic
+it hosts). Provides the detailed /health payload (module list + statuses + worker
+health) and a REST listing of modules — the `--list-modules` surface over HTTP.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from aiohttp import web
+
+from ..modkit import Module, module
+from ..modkit.contracts import RestApiCapability, SystemCapability
+from ..modkit.context import ModuleCtx
+from ..gateway.middleware import SECURITY_CONTEXT_KEY
+from ..gateway.module import HealthApi
+from .sdk import LlmWorkerApi
+
+
+class OrchestratorHealth(HealthApi):
+    def __init__(self, ctx: ModuleCtx) -> None:
+        self._ctx = ctx
+        self._started = time.time()
+
+    async def health(self) -> dict[str, Any]:
+        from ..modkit.registry import registrations
+
+        doc: dict[str, Any] = {
+            "status": "ok",
+            "uptime_s": round(time.time() - self._started, 1),
+            "instance_id": self._ctx.instance_id,
+            "modules": sorted(
+                {r.name for r in registrations()}
+                & set(self._ctx.app_config.module_names() or
+                      [r.name for r in registrations()])
+            ) or sorted({r.name for r in registrations()}),
+        }
+        worker = self._ctx.client_hub.try_get(LlmWorkerApi)
+        if worker is not None:
+            try:
+                doc["llm_worker"] = await worker.health()
+            except Exception as e:  # noqa: BLE001
+                doc["llm_worker"] = {"status": "error", "detail": str(e)}
+                doc["status"] = "degraded"
+        return doc
+
+
+@module(name="module_orchestrator", capabilities=["rest", "system"])
+class ModuleOrchestratorModule(Module, RestApiCapability, SystemCapability):
+    def __init__(self) -> None:
+        self._health: Optional[OrchestratorHealth] = None
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        self._health = OrchestratorHealth(ctx)
+        ctx.client_hub.register(HealthApi, self._health)
+
+    def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
+        health = self._health
+        assert health is not None
+
+        async def list_modules(request: web.Request):
+            from ..modkit.registry import registrations
+
+            enabled = set(ctx.app_config.module_names())
+            return {
+                "modules": [
+                    {
+                        "name": r.name,
+                        "deps": list(r.deps),
+                        "capabilities": list(r.capabilities),
+                        "enabled": not enabled or r.name in enabled,
+                    }
+                    for r in sorted(registrations(), key=lambda r: r.name)
+                ]
+            }
+
+        async def detailed_health(request: web.Request):
+            return await health.health()
+
+        m = "module_orchestrator"
+        router.operation("GET", "/v1/modules", module=m).auth_required() \
+            .summary("Module inventory with deps and capabilities") \
+            .handler(list_modules).register()
+        router.operation("GET", "/v1/system/health", module=m).auth_required() \
+            .summary("Detailed system health").handler(detailed_health).register()

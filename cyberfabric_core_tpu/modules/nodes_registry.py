@@ -1,0 +1,155 @@
+"""nodes-registry — inventory of serving nodes and their hardware capabilities.
+
+Reference: modules/system/nodes-registry (+ libs/modkit-node-info). Collectors here
+report the TPU-relevant facts: host/OS/CPU/memory plus **accelerator devices via
+JAX** (the reference's GpuInfo analogue is TpuInfo: device kind, HBM stats when
+available).
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import time
+import uuid
+from typing import Any, Optional
+
+from aiohttp import web
+
+from ..modkit import Module, module
+from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.db import ScopableEntity
+from ..modkit.errors import ProblemError
+from ..gateway.middleware import SECURITY_CONTEXT_KEY
+from ..gateway.validation import read_json
+
+NODES = ScopableEntity(
+    table="nodes",
+    field_map={"id": "id", "tenant_id": "tenant_id", "hostname": "hostname",
+               "sys_info": "sys_info", "accelerators": "accelerators",
+               "last_seen": "last_seen"},
+    json_cols=("sys_info", "accelerators"),
+)
+
+_MIGRATIONS = [
+    Migration("0001_nodes", lambda c: c.execute(
+        "CREATE TABLE nodes (id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, "
+        "hostname TEXT NOT NULL, sys_info TEXT, accelerators TEXT, "
+        "last_seen REAL, UNIQUE (tenant_id, hostname))"
+    )),
+]
+
+
+def collect_sys_info() -> dict[str, Any]:
+    """Host telemetry (modkit-node-info/src/model.rs NodeSysInfo analogue)."""
+    info: dict[str, Any] = {
+        "os": platform.system().lower(),
+        "os_version": platform.release(),
+        "arch": platform.machine(),
+        "python": platform.python_version(),
+        "cpu_count": os.cpu_count(),
+    }
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    info["memory_kb"] = int(line.split()[1])
+                    break
+    except OSError:
+        pass
+    return info
+
+
+def collect_accelerators() -> list[dict[str, Any]]:
+    """Accelerator inventory via JAX (the NVML-collector analogue for TPU)."""
+    try:
+        import jax
+
+        out = []
+        for d in jax.devices():
+            dev: dict[str, Any] = {
+                "id": d.id, "platform": d.platform, "kind": getattr(d, "device_kind", "?"),
+            }
+            try:
+                stats = d.memory_stats()
+                if stats:
+                    dev["hbm_bytes_limit"] = stats.get("bytes_limit")
+                    dev["hbm_bytes_in_use"] = stats.get("bytes_in_use")
+            except Exception:
+                pass
+            out.append(dev)
+        return out
+    except Exception:
+        return []
+
+
+@module(name="nodes_registry", capabilities=["db", "rest"])
+class NodesRegistryModule(Module, DatabaseCapability, RestApiCapability):
+    def __init__(self) -> None:
+        self._ctx: Optional[ModuleCtx] = None
+
+    def migrations(self):
+        return _MIGRATIONS
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        self._ctx = ctx
+        # self-register this host
+        from ..modkit.security import SecurityContext
+
+        conn = ctx.db_required().secure(SecurityContext.anonymous(
+            ctx.raw_config().get("tenant", "default")), NODES)
+        hostname = platform.node() or "localhost"
+        row = conn.find_one({"hostname": hostname})
+        payload = {
+            "hostname": hostname,
+            "sys_info": collect_sys_info(),
+            "accelerators": collect_accelerators(),
+            "last_seen": time.time(),
+        }
+        if row:
+            conn.update(row["id"], payload)
+        else:
+            conn.insert(payload)
+
+    def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
+        db = ctx.db_required()
+
+        async def list_nodes(request: web.Request):
+            conn = db.secure(request[SECURITY_CONTEXT_KEY], NODES)
+            return conn.list_odata(
+                filter_text=request.query.get("$filter"),
+                orderby_text=request.query.get("$orderby") or "hostname",
+                cursor=request.query.get("cursor"),
+            ).to_dict()
+
+        async def get_node(request: web.Request):
+            conn = db.secure(request[SECURITY_CONTEXT_KEY], NODES)
+            row = conn.get(request.match_info["node_id"])
+            if row is None:
+                raise ProblemError.not_found("node not found", code="node_not_found")
+            return row
+
+        async def heartbeat(request: web.Request):
+            conn = db.secure(request[SECURITY_CONTEXT_KEY], NODES)
+            body = await read_json(request, {
+                "type": "object", "required": ["hostname"],
+                "properties": {"hostname": {"type": "string"},
+                               "sys_info": {"type": "object"},
+                               "accelerators": {"type": "array"}},
+                "additionalProperties": False})
+            row = conn.find_one({"hostname": body["hostname"]})
+            payload = {**body, "last_seen": time.time()}
+            if row:
+                conn.update(row["id"], payload)
+                return {"id": row["id"], "status": "updated"}
+            created = conn.insert(payload)
+            return {"id": created["id"], "status": "registered"}, 201
+
+        m = "nodes_registry"
+        router.operation("GET", "/v1/nodes", module=m).auth_required() \
+            .summary("List registered nodes").handler(list_nodes).register()
+        router.operation("GET", "/v1/nodes/{node_id}", module=m).auth_required() \
+            .summary("Node detail incl. accelerators").handler(get_node).register()
+        router.operation("POST", "/v1/nodes/heartbeat", module=m).auth_required() \
+            .summary("Register/heartbeat a node").handler(heartbeat).register()

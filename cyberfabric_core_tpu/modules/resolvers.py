@@ -1,0 +1,151 @@
+"""Tenant / authn / authz resolver gateways with static plugins.
+
+Reference: modules/system/{tenant-resolver, authn-resolver, authz-resolver} —
+gateway+plugin pattern. Plugins implemented here:
+
+- **static tenant plugin**: config-defined tenant tree (config/quickstart.yaml:188-228
+  pattern); single-tenant mode when no tree given.
+- **static authn plugin**: modes ``accept_all`` (dev) and ``static`` (configured
+  token → identity map) (authn-resolver static plugin).
+- **static authz plugin**: role → scope-constraint rules compiled into AccessScope
+  narrowing (the SDK-side PEP, authz-resolver-sdk/src/pep/).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..modkit import Module, module
+from ..modkit.contracts import SystemCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.errors import ProblemError
+from ..modkit.security import AccessScope, Dimension, ScopeFilter, SecretString, SecurityContext
+from ..gateway.middleware import AuthnApi, AuthzApi
+from .sdk import TenantResolverApi
+
+
+class StaticTenantResolver(TenantResolverApi):
+    """Tenant tree from config: {tenant_id: {parent: ..}} or nested children."""
+
+    def __init__(self, tree: Optional[dict[str, Any]] = None,
+                 single_tenant: Optional[str] = None) -> None:
+        self._parent: dict[str, Optional[str]] = {}
+        self._children: dict[str, list[str]] = {}
+        if single_tenant is not None:
+            self._parent[single_tenant] = None
+        for tenant, spec in (tree or {}).items():
+            parent = (spec or {}).get("parent")
+            self._parent[tenant] = parent
+            if parent is not None:
+                self._children.setdefault(parent, []).append(tenant)
+
+    async def parent_of(self, tenant_id: str) -> Optional[str]:
+        return self._parent.get(tenant_id)
+
+    async def children_of(self, tenant_id: str) -> list[str]:
+        return sorted(self._children.get(tenant_id, []))
+
+    async def subtree_of(self, tenant_id: str) -> list[str]:
+        out = [tenant_id]
+        queue = list(self._children.get(tenant_id, []))
+        while queue:
+            t = queue.pop()
+            out.append(t)
+            queue.extend(self._children.get(t, []))
+        return sorted(out)
+
+    def knows(self, tenant_id: str) -> bool:
+        return tenant_id in self._parent
+
+
+class StaticAuthnResolver(AuthnApi):
+    """mode: accept_all → identity from headers/defaults; mode: static → token map
+    {token: {subject, tenant_id, scopes, roles}}."""
+
+    def __init__(self, mode: str = "accept_all", tokens: Optional[dict] = None,
+                 default_tenant: str = "default") -> None:
+        if mode not in ("accept_all", "static"):
+            raise ValueError(f"unknown authn mode {mode!r}")
+        self.mode = mode
+        self.tokens = tokens or {}
+        self.default_tenant = default_tenant
+
+    async def authenticate(self, bearer_token: Optional[str],
+                           request_meta: dict[str, Any]) -> SecurityContext:
+        if self.mode == "accept_all":
+            tenant = request_meta.get("tenant_header") or self.default_tenant
+            return SecurityContext(
+                subject="anonymous", tenant_id=tenant,
+                access_scope=AccessScope.for_tenants([tenant]),
+                bearer_token=SecretString(bearer_token) if bearer_token else None,
+            )
+        if not bearer_token:
+            raise ProblemError.unauthorized("missing bearer token")
+        entry = self.tokens.get(bearer_token)
+        if entry is None:
+            raise ProblemError.unauthorized("invalid token")
+        tenant = entry.get("tenant_id", self.default_tenant)
+        return SecurityContext(
+            subject=entry.get("subject", "user"),
+            tenant_id=tenant,
+            token_scopes=tuple(entry.get("scopes", ())),
+            roles=tuple(entry.get("roles", ())),
+            access_scope=AccessScope.for_tenants([tenant]),
+            bearer_token=SecretString(bearer_token),
+        )
+
+
+class StaticAuthzResolver(AuthzApi):
+    """PDP: per-role constraint rules narrow the access scope; the secure ORM
+    enforces the result (the PEP chain of SURVEY §8.10).
+
+    rules: {role: {"deny": [operation_id...], "owner_only": bool}}
+    """
+
+    def __init__(self, rules: Optional[dict[str, Any]] = None) -> None:
+        self.rules = rules or {}
+
+    async def authorize(self, ctx: SecurityContext, operation_id: str) -> SecurityContext:
+        import dataclasses
+
+        scope = ctx.access_scope
+        for role in ctx.roles or ("_default",):
+            rule = self.rules.get(role)
+            if rule is None:
+                continue
+            if operation_id in rule.get("deny", ()):
+                raise ProblemError.forbidden(
+                    f"role {role} denied operation {operation_id}")
+            if rule.get("owner_only"):
+                scope = scope.merged_with(AccessScope(
+                    filters=(ScopeFilter(Dimension.OWNER, (ctx.subject,)),)))
+        return dataclasses.replace(ctx, access_scope=scope)
+
+
+@module(name="tenant_resolver", capabilities=["system"])
+class TenantResolverModule(Module, SystemCapability):
+    async def init(self, ctx: ModuleCtx) -> None:
+        cfg = ctx.raw_config()
+        resolver = StaticTenantResolver(
+            tree=cfg.get("tenants"),
+            single_tenant=cfg.get("single_tenant", "default" if not cfg.get("tenants") else None),
+        )
+        ctx.client_hub.register(TenantResolverApi, resolver)
+
+
+@module(name="authn_resolver", capabilities=["system"])
+class AuthnResolverModule(Module, SystemCapability):
+    async def init(self, ctx: ModuleCtx) -> None:
+        cfg = ctx.raw_config()
+        resolver = StaticAuthnResolver(
+            mode=cfg.get("mode", "accept_all"),
+            tokens=cfg.get("tokens"),
+            default_tenant=cfg.get("default_tenant", "default"),
+        )
+        ctx.client_hub.register(AuthnApi, resolver)
+
+
+@module(name="authz_resolver", capabilities=["system"])
+class AuthzResolverModule(Module, SystemCapability):
+    async def init(self, ctx: ModuleCtx) -> None:
+        ctx.client_hub.register(AuthzApi, StaticAuthzResolver(ctx.raw_config().get("rules")))

@@ -1,0 +1,221 @@
+"""Module SDK contracts — the ClientHub-resolved trait objects modules call.
+
+Reference pattern: every module ships an SDK crate with a pure trait
+(docs/ARCHITECTURE_MANIFEST.md:130-137; dylint DE01 enforces contract purity). Here:
+one ABC per module, registered/fetched via ClientHub. All domain methods take the
+SecurityContext first (serverless ADR:3476 — tenant scoping is in the signature).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional, Sequence
+
+from ..modkit.security import SecurityContext
+
+
+# ----------------------------------------------------------------- model registry
+@dataclass
+class ModelInfo:
+    """A resolved model (model-registry PRD.md:200-224).
+
+    canonical_id = "{provider_slug}::{provider_model_id}" (PRD.md:204).
+    Infrastructure fields for managed local models: managed/architecture/
+    size_bytes/format (PRD.md:218-224).
+    """
+
+    canonical_id: str
+    provider_slug: str
+    provider_model_id: str
+    display_name: str = ""
+    capabilities: dict[str, bool] = field(default_factory=dict)  # tier-1 flags
+    limits: dict[str, Any] = field(default_factory=dict)          # tier-2
+    cost: dict[str, float] = field(default_factory=dict)          # per-1k tokens
+    lifecycle_status: str = "active"
+    approval_state: str = "approved"
+    managed: bool = False
+    architecture: Optional[str] = None
+    size_bytes: Optional[int] = None
+    format: Optional[str] = None          # "safetensors"
+    checkpoint_path: Optional[str] = None
+    engine_options: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        import dataclasses
+
+        return dataclasses.asdict(self)
+
+
+class ModelRegistryApi(abc.ABC):
+    @abc.abstractmethod
+    async def resolve(self, ctx: SecurityContext, name: str) -> ModelInfo:
+        """Resolve a model name or alias to a served model; raises ProblemError
+        404/403 per the PRD resolution chain (PRD.md:298-306)."""
+
+    @abc.abstractmethod
+    async def list_models(self, ctx: SecurityContext, filter_text: Optional[str] = None,
+                          cursor: Optional[str] = None, limit: Optional[int] = None) -> Any:
+        ...
+
+
+# ----------------------------------------------------------------- llm worker pool
+@dataclass
+class ChatStreamChunk:
+    """Internal stream unit between worker and llm-gateway API layer."""
+
+    request_id: str
+    text: str = ""
+    token_id: Optional[int] = None
+    finish_reason: Optional[str] = None
+    usage: Optional[dict[str, int]] = None
+
+
+class LlmWorkerApi(abc.ABC):
+    """The local-worker backend contract (the piece the reference spec delegates to
+    external providers, implemented here on TPU)."""
+
+    @abc.abstractmethod
+    async def chat_stream(
+        self, model: ModelInfo, messages: list[dict], params: dict
+    ) -> AsyncIterator[ChatStreamChunk]:
+        ...
+
+    @abc.abstractmethod
+    async def embed(self, model: ModelInfo, inputs: list[str], params: dict) -> list[list[float]]:
+        ...
+
+    @abc.abstractmethod
+    async def health(self) -> dict[str, Any]:
+        ...
+
+
+# ----------------------------------------------------------------- file storage
+@dataclass
+class StoredFile:
+    file_id: str
+    url: str
+    size_bytes: int
+    mime_type: str
+    filename: Optional[str] = None
+
+
+class FileStorageApi(abc.ABC):
+    """file-storage PRD.md:45-133: store content → URL, fetch by URL (streaming),
+    metadata without content."""
+
+    @abc.abstractmethod
+    async def store(self, ctx: SecurityContext, data: bytes, mime_type: str,
+                    filename: Optional[str] = None) -> StoredFile:
+        ...
+
+    @abc.abstractmethod
+    async def fetch(self, ctx: SecurityContext, url: str) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    async def metadata(self, ctx: SecurityContext, url: str) -> StoredFile:
+        ...
+
+
+# ----------------------------------------------------------------- credstore
+class CredStoreApi(abc.ABC):
+    """credstore DESIGN.md:45-166: gateway with hierarchical walk-up resolution;
+    sharing modes private/tenant/shared."""
+
+    @abc.abstractmethod
+    async def get_secret(self, ctx: SecurityContext, key: str) -> Optional[str]:
+        ...
+
+    @abc.abstractmethod
+    async def put_secret(self, ctx: SecurityContext, key: str, value: str,
+                         sharing: str = "private") -> None:
+        ...
+
+    @abc.abstractmethod
+    async def delete_secret(self, ctx: SecurityContext, key: str) -> bool:
+        ...
+
+
+# ----------------------------------------------------------------- tenant resolver
+class TenantResolverApi(abc.ABC):
+    """tenant-resolver SDK (modules/system/tenant-resolver): hierarchy queries."""
+
+    @abc.abstractmethod
+    async def parent_of(self, tenant_id: str) -> Optional[str]:
+        ...
+
+    @abc.abstractmethod
+    async def children_of(self, tenant_id: str) -> list[str]:
+        ...
+
+    @abc.abstractmethod
+    async def subtree_of(self, tenant_id: str) -> list[str]:
+        ...
+
+    async def walk_up(self, tenant_id: str) -> list[str]:
+        """tenant + ancestors to the root (credstore resolution order)."""
+        chain = [tenant_id]
+        cur = tenant_id
+        for _ in range(64):  # hierarchy depth guard
+            parent = await self.parent_of(cur)
+            if parent is None or parent in chain:
+                break
+            chain.append(parent)
+            cur = parent
+        return chain
+
+
+# ----------------------------------------------------------------- types registry
+@dataclass
+class GtsEntity:
+    """A registered GTS schema or instance
+    (types-registry-sdk/src/models.rs:29-60)."""
+
+    gts_id: str            # gts.vendor.pkg.ns.name.v1~[instance]
+    kind: str              # "schema" | "instance"
+    body: dict[str, Any] = field(default_factory=dict)
+    vendor: str = ""
+    description: str = ""
+
+
+class TypesRegistryApi(abc.ABC):
+    @abc.abstractmethod
+    async def register(self, ctx: SecurityContext, entity: GtsEntity) -> GtsEntity:
+        ...
+
+    @abc.abstractmethod
+    async def get(self, ctx: SecurityContext, gts_id: str) -> Optional[GtsEntity]:
+        ...
+
+    @abc.abstractmethod
+    async def query(self, ctx: SecurityContext, pattern: str) -> list[GtsEntity]:
+        """Wildcard queries, e.g. ``gts.x.llmgw.*``."""
+
+    @abc.abstractmethod
+    async def validate_instance(self, ctx: SecurityContext, schema_id: str,
+                                instance: dict) -> list[str]:
+        """Returns validation error strings (empty = valid)."""
+
+
+# ----------------------------------------------------------------- serverless
+class ServerlessApi(abc.ABC):
+    """ServerlessRuntime trait (serverless ADR:3419-3600) — narrowed to the
+    implemented surface; grows with the module."""
+
+    @abc.abstractmethod
+    async def register_entrypoint(self, ctx: SecurityContext, spec: dict) -> dict:
+        ...
+
+    @abc.abstractmethod
+    async def start_invocation(self, ctx: SecurityContext, request: dict) -> dict:
+        ...
+
+    @abc.abstractmethod
+    async def get_invocation(self, ctx: SecurityContext, invocation_id: str) -> dict:
+        ...
+
+    @abc.abstractmethod
+    async def control_invocation(self, ctx: SecurityContext, invocation_id: str,
+                                 action: str) -> dict:
+        ...

@@ -1,0 +1,656 @@
+"""serverless-runtime — durable functions & workflows scheduling TPU jobs.
+
+Reference (spec-only): modules/serverless-runtime/docs/{PRD.md,
+ADR_DOMAIN_MODEL_AND_APIS.md}. Implemented surface (ADR:3419-3600 trait +
+:2581-2656 REST):
+
+- unified **Entrypoint** model (kind function|workflow), versioned, status machine
+  draft → active → deprecated|disabled → archived (update_entrypoint_status
+  actions Deprecate/Disable/Enable/Activate/Archive, ADR:3446-3459)
+- sync/async invocation with idempotency-key **response cache** (key = owner scope
+  + entrypoint + version + idempotency_key, only when is_idempotent and
+  max_age_seconds > 0 — ADR:3529-3543), dry-run
+- retries with exponential backoff + dead-letter status, invocation **timeline**
+  events, control actions cancel|suspend|resume|retry|replay (ADR:3461-3474)
+- interval schedules with missed-run policies skip|catch_up (PRD schedules)
+
+Functions dispatch to the TPU worker pool (llm.chat / llm.embed) and to platform
+services (file.parse, echo, sleep) — this is how "serverless-runtime schedules TPU
+jobs" (BASELINE north star) is realized: a workflow step is a batched device job.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, Awaitable, Callable, Optional
+
+from aiohttp import web
+
+from ..modkit import Module, module
+from ..modkit.contracts import (
+    DatabaseCapability,
+    Migration,
+    RestApiCapability,
+    RunnableCapability,
+)
+from ..modkit.context import ModuleCtx
+from ..modkit.db import ScopableEntity
+from ..modkit.errors import ProblemError
+from ..modkit.lifecycle import ReadySignal
+from ..modkit.security import SecurityContext
+from ..gateway.middleware import SECURITY_CONTEXT_KEY
+from ..gateway.validation import read_json
+from .sdk import LlmWorkerApi, ModelRegistryApi, ServerlessApi
+
+ENTRYPOINTS = ScopableEntity(
+    table="entrypoints",
+    field_map={"id": "id", "tenant_id": "tenant_id", "name": "name",
+               "version": "version", "kind": "kind", "status": "status",
+               "definition": "definition", "is_idempotent": "is_idempotent",
+               "cache_max_age_seconds": "cache_max_age_seconds",
+               "retry_policy": "retry_policy", "created_at": "created_at"},
+    json_cols=("definition", "retry_policy"),
+)
+
+INVOCATIONS = ScopableEntity(
+    table="invocations",
+    field_map={"id": "id", "tenant_id": "tenant_id", "entrypoint_id": "entrypoint_id",
+               "entrypoint_name": "entrypoint_name", "version": "version",
+               "status": "status", "mode": "mode", "params": "params",
+               "result": "result", "error": "error", "attempt": "attempt",
+               "idempotency_key": "idempotency_key", "timeline": "timeline",
+               "created_at": "created_at", "updated_at": "updated_at"},
+    json_cols=("params", "result", "error", "timeline"),
+)
+
+SCHEDULES = ScopableEntity(
+    table="schedules",
+    field_map={"id": "id", "tenant_id": "tenant_id", "entrypoint_name": "entrypoint_name",
+               "every_seconds": "every_seconds", "params": "params",
+               "missed_run_policy": "missed_run_policy", "enabled": "enabled",
+               "next_fire_at": "next_fire_at", "last_fired_at": "last_fired_at"},
+    json_cols=("params",),
+)
+
+def _migrate_0001(c):
+    c.execute(
+        "CREATE TABLE entrypoints ("
+        "id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, name TEXT NOT NULL, "
+        "version INTEGER NOT NULL DEFAULT 1, kind TEXT NOT NULL, "
+        "status TEXT NOT NULL DEFAULT 'draft', definition TEXT NOT NULL, "
+        "is_idempotent INTEGER DEFAULT 0, cache_max_age_seconds INTEGER DEFAULT 0, "
+        "retry_policy TEXT, created_at TEXT DEFAULT (datetime('now')), "
+        "UNIQUE (tenant_id, name, version))"
+    )
+    c.execute(
+        "CREATE TABLE invocations ("
+        "id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, "
+        "entrypoint_id TEXT NOT NULL, entrypoint_name TEXT NOT NULL, "
+        "version INTEGER NOT NULL, status TEXT NOT NULL DEFAULT 'pending', "
+        "mode TEXT NOT NULL DEFAULT 'sync', params TEXT, result TEXT, error TEXT, "
+        "attempt INTEGER DEFAULT 1, idempotency_key TEXT, timeline TEXT, "
+        "created_at TEXT DEFAULT (datetime('now')), "
+        "updated_at TEXT DEFAULT (datetime('now')))"
+    )
+    c.execute("CREATE INDEX idx_inv_ep ON invocations (tenant_id, entrypoint_name)")
+    c.execute(
+        "CREATE TABLE schedules ("
+        "id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, "
+        "entrypoint_name TEXT NOT NULL, every_seconds REAL NOT NULL, "
+        "params TEXT, missed_run_policy TEXT DEFAULT 'skip', "
+        "enabled INTEGER DEFAULT 1, next_fire_at REAL, last_fired_at REAL)"
+    )
+
+
+_MIGRATIONS = [Migration("0001_serverless", _migrate_0001)]
+
+#: Entrypoint status machine (ADR update_entrypoint_status actions)
+_STATUS_ACTIONS: dict[str, tuple[str, str]] = {
+    # action -> (required current status(es) csv, new status)
+    "activate": ("draft,disabled", "active"),
+    "deprecate": ("active", "deprecated"),
+    "disable": ("active,deprecated", "disabled"),
+    "enable": ("disabled", "active"),
+    "archive": ("draft,active,deprecated,disabled", "archived"),
+}
+
+FunctionHandler = Callable[[SecurityContext, dict], Awaitable[Any]]
+
+
+class ServerlessService(ServerlessApi):
+    def __init__(self, ctx: ModuleCtx) -> None:
+        self._ctx = ctx
+        self._db = ctx.db_required()
+        self._functions: dict[str, FunctionHandler] = {}
+        self._tasks: dict[str, asyncio.Task] = {}
+        self._suspended: dict[str, asyncio.Event] = {}
+        self._response_cache: dict[str, tuple[float, dict]] = {}
+        self._register_builtins()
+
+    # ------------------------------------------------------------- functions
+    def register_function(self, name: str, handler: FunctionHandler) -> None:
+        self._functions[name] = handler
+
+    def _register_builtins(self) -> None:
+        hub = self._ctx.client_hub
+
+        async def echo(ctx: SecurityContext, params: dict) -> Any:
+            return params
+
+        async def sleep(ctx: SecurityContext, params: dict) -> Any:
+            await asyncio.sleep(float(params.get("seconds", 0.01)))
+            return {"slept": params.get("seconds", 0.01)}
+
+        async def fail(ctx: SecurityContext, params: dict) -> Any:
+            raise RuntimeError(params.get("message", "deliberate failure"))
+
+        async def llm_chat(ctx: SecurityContext, params: dict) -> Any:
+            registry = hub.get(ModelRegistryApi)
+            worker = hub.get(LlmWorkerApi)
+            model = await registry.resolve(ctx, params["model"])
+            pieces, usage = [], {}
+            async for chunk in worker.chat_stream(model, params["messages"], params):
+                if chunk.text:
+                    pieces.append(chunk.text)
+                if chunk.usage:
+                    usage = chunk.usage
+            return {"text": "".join(pieces), "usage": usage,
+                    "model_used": model.canonical_id}
+
+        async def llm_embed(ctx: SecurityContext, params: dict) -> Any:
+            registry = hub.get(ModelRegistryApi)
+            worker = hub.get(LlmWorkerApi)
+            model = await registry.resolve(ctx, params["model"])
+            vectors = await worker.embed(model, params["input"], params)
+            return {"vectors": vectors, "model_used": model.canonical_id}
+
+        self._functions.update({
+            "echo": echo, "sleep": sleep, "fail": fail,
+            "llm.chat": llm_chat, "llm.embed": llm_embed,
+        })
+
+    # ------------------------------------------------------------- entrypoints
+    async def register_entrypoint(self, ctx: SecurityContext, spec: dict) -> dict:
+        name, kind = spec.get("name"), spec.get("kind", "function")
+        definition = spec.get("definition") or {}
+        if not name:
+            raise ProblemError.bad_request("entrypoint name required")
+        if kind not in ("function", "workflow"):
+            raise ProblemError.bad_request("kind must be function|workflow")
+        if kind == "function":
+            fn = definition.get("function")
+            if fn not in self._functions:
+                raise ProblemError.unprocessable(
+                    f"unknown function {fn!r}; available: {sorted(self._functions)}",
+                    code="unknown_function")
+        else:
+            steps = definition.get("steps") or []
+            if not steps:
+                raise ProblemError.unprocessable("workflow needs steps",
+                                                 code="empty_workflow")
+            for s in steps:
+                if s.get("function") not in self._functions:
+                    raise ProblemError.unprocessable(
+                        f"step uses unknown function {s.get('function')!r}",
+                        code="unknown_function")
+        conn = self._db.secure(ctx, ENTRYPOINTS)
+        existing = conn.select(where={"name": name}, order_by="version", descending=True)
+        version = (existing[0]["version"] + 1) if existing else 1
+        # immutable-once-active: a new registration creates a NEW version
+        row = conn.insert({
+            "name": name, "version": version, "kind": kind,
+            "status": "draft", "definition": definition,
+            "is_idempotent": bool(spec.get("is_idempotent", False)),
+            "cache_max_age_seconds": int(spec.get("cache_max_age_seconds", 0)),
+            "retry_policy": spec.get("retry_policy") or {},
+        })
+        return self._ep_view(row)
+
+    async def update_entrypoint_status(self, ctx: SecurityContext, name: str,
+                                       action: str, version: Optional[int] = None) -> dict:
+        action = action.lower()
+        if action not in _STATUS_ACTIONS:
+            raise ProblemError.bad_request(
+                f"unknown action {action!r}; allowed: {sorted(_STATUS_ACTIONS)}")
+        allowed_csv, new_status = _STATUS_ACTIONS[action]
+        row = self._resolve_ep(ctx, name, version, any_status=True)
+        if row["status"] not in allowed_csv.split(","):
+            raise ProblemError.conflict(
+                f"cannot {action} from status {row['status']}", code="invalid_transition")
+        conn = self._db.secure(ctx, ENTRYPOINTS)
+        if action == "activate":
+            # only one active version per name
+            for other in conn.select(where={"name": name, "status": "active"}):
+                conn.update(other["id"], {"status": "deprecated"})
+        conn.update(row["id"], {"status": new_status})
+        row["status"] = new_status
+        return self._ep_view(row)
+
+    def _resolve_ep(self, ctx: SecurityContext, name: str,
+                    version: Optional[int] = None, any_status: bool = False) -> dict:
+        conn = self._db.secure(ctx, ENTRYPOINTS)
+        where: dict[str, Any] = {"name": name}
+        if version is not None:
+            where["version"] = version
+        rows = conn.select(where=where, order_by="version", descending=True)
+        if not any_status:
+            rows = [r for r in rows if r["status"] == "active"] or rows
+        if not rows:
+            raise ProblemError.not_found(f"entrypoint {name!r} not found",
+                                         code="entrypoint_not_found")
+        return rows[0]
+
+    def _ep_view(self, row: dict) -> dict:
+        return {k: row[k] for k in ("id", "name", "version", "kind", "status",
+                                    "definition", "is_idempotent",
+                                    "cache_max_age_seconds", "retry_policy")}
+
+    async def list_entrypoints(self, ctx: SecurityContext, **kw) -> Any:
+        return self._db.secure(ctx, ENTRYPOINTS).list_odata(
+            orderby_text="name", **kw)
+
+    # ------------------------------------------------------------- invocation
+    async def start_invocation(self, ctx: SecurityContext, request: dict) -> dict:
+        name = request.get("entrypoint") or request.get("entrypoint_id")
+        if not name:
+            raise ProblemError.bad_request("entrypoint required")
+        ep = self._resolve_ep(ctx, name, request.get("version"))
+        if ep["status"] not in ("active", "deprecated"):
+            raise ProblemError.conflict(
+                f"entrypoint {name} is {ep['status']}, not invocable",
+                code="not_invocable")
+        params = request.get("params") or {}
+        mode = request.get("mode", "sync")
+        dry_run = bool(request.get("dry_run"))
+        idem_key = request.get("idempotency_key")
+
+        if dry_run:
+            return {"record": None, "dry_run": True, "cached": False,
+                    "valid": True, "entrypoint": self._ep_view(ep)}
+
+        # response cache (ADR:3529-3543)
+        cache_key = None
+        if idem_key and ep["is_idempotent"] and ep["cache_max_age_seconds"] > 0:
+            cache_key = f"{ctx.tenant_id}:{ep['id']}:{ep['version']}:{idem_key}"
+            now = time.monotonic()
+            hit = self._response_cache.get(cache_key)
+            if hit and hit[0] > now:
+                return {"record": hit[1], "dry_run": False, "cached": True}
+            # evict expired entries so unique idempotency keys can't grow the
+            # cache without bound
+            if len(self._response_cache) > 512:
+                self._response_cache = {
+                    k: v for k, v in self._response_cache.items() if v[0] > now}
+
+        conn = self._db.secure(ctx, INVOCATIONS)
+        inv = conn.insert({
+            "entrypoint_id": ep["id"], "entrypoint_name": ep["name"],
+            "version": ep["version"], "status": "pending", "mode": mode,
+            "params": params, "attempt": 1, "idempotency_key": idem_key,
+            "timeline": [self._evt("created", f"mode={mode}")],
+        })
+
+        if mode == "async":
+            self._spawn(ctx, ep, inv)
+            return {"record": self._inv_view(inv), "dry_run": False, "cached": False}
+
+        record = await self._execute(ctx, ep, inv)
+        if cache_key and record["status"] == "completed":
+            self._response_cache[cache_key] = (
+                time.monotonic() + ep["cache_max_age_seconds"], record)
+        return {"record": record, "dry_run": False, "cached": False}
+
+    def _spawn(self, ctx: SecurityContext, ep: dict, inv: dict) -> None:
+        task = asyncio.ensure_future(self._execute(ctx, ep, inv))
+        self._tasks[inv["id"]] = task
+        task.add_done_callback(lambda t: self._tasks.pop(inv["id"], None))
+
+    async def _execute(self, ctx: SecurityContext, ep: dict, inv: dict) -> dict:
+        conn = self._db.secure(ctx, INVOCATIONS)
+        timeline = list(inv.get("timeline") or [])
+        retry = ep.get("retry_policy") or {}
+        max_attempts = int(retry.get("max_attempts", 1))
+        backoff = float(retry.get("backoff_seconds", 0.05))
+        multiplier = float(retry.get("backoff_multiplier", 2.0))
+        attempt = int(inv.get("attempt", 1))
+
+        def save(status: str, **fields: Any) -> None:
+            conn.update(inv["id"], {"status": status, "timeline": timeline,
+                                    "updated_at": _now(), **fields})
+            inv.update({"status": status, "timeline": list(timeline),
+                        "updated_at": _now(), **fields})
+
+        timeline.append(self._evt("started", f"attempt={attempt}"))
+        save("running", attempt=attempt)
+        while True:
+            try:
+                result = await self._run_definition(ctx, ep, inv["params"] or {},
+                                                    inv["id"], timeline)
+                timeline.append(self._evt("completed"))
+                save("completed", result=_jsonable(result))
+                return self._inv_view(inv)
+            except asyncio.CancelledError:
+                timeline.append(self._evt("cancelled"))
+                save("cancelled")
+                return self._inv_view(inv)
+            except _Suspended:
+                timeline.append(self._evt("suspended"))
+                save("suspended")
+                return self._inv_view(inv)
+            except Exception as e:  # noqa: BLE001
+                timeline.append(self._evt("attempt_failed", str(e)[:300]))
+                if attempt >= max_attempts:
+                    timeline.append(self._evt("dead_letter",
+                                              f"after {attempt} attempts"))
+                    save("failed", error={"detail": str(e)[:2000],
+                                          "attempts": attempt})
+                    return self._inv_view(inv)
+                delay = backoff * (multiplier ** (attempt - 1))
+                attempt += 1
+                timeline.append(self._evt("retry_scheduled", f"in {delay:.3f}s"))
+                save("pending", attempt=attempt)
+                await asyncio.sleep(delay)
+                timeline.append(self._evt("started", f"attempt={attempt}"))
+                save("running")
+
+    async def _run_definition(self, ctx: SecurityContext, ep: dict, params: dict,
+                              inv_id: str, timeline: list) -> Any:
+        definition = ep["definition"] or {}
+        if ep["kind"] == "function":
+            handler = self._functions[definition["function"]]
+            merged = {**(definition.get("params") or {}), **params}
+            return await handler(ctx, merged)
+        # workflow: sequential steps; ``$prev`` references the previous result;
+        # suspension honored between steps
+        prev: Any = None
+        results = []
+        for i, step in enumerate(definition.get("steps", [])):
+            gate = self._suspended.get(inv_id)
+            if gate is not None:
+                raise _Suspended()
+            handler = self._functions[step["function"]]
+            step_params = dict(step.get("params") or {})
+            for k, v in list(step_params.items()):
+                if v == "$prev":
+                    step_params[k] = prev
+            step_params.update(params if i == 0 else {})
+            timeline.append(self._evt("step_started", step.get("name", step["function"])))
+            prev = await handler(ctx, step_params)
+            results.append(_jsonable(prev))
+            timeline.append(self._evt("step_completed", step.get("name", step["function"])))
+        return {"steps": results, "output": _jsonable(prev)}
+
+    # ------------------------------------------------------------- visibility/control
+    async def get_invocation(self, ctx: SecurityContext, invocation_id: str) -> dict:
+        row = self._db.secure(ctx, INVOCATIONS).get(invocation_id)
+        if row is None:
+            raise ProblemError.not_found("invocation not found",
+                                         code="invocation_not_found")
+        return self._inv_view(row)
+
+    async def list_invocations(self, ctx: SecurityContext, **kw) -> Any:
+        return self._db.secure(ctx, INVOCATIONS).list_odata(
+            orderby_text="created_at desc", **kw)
+
+    async def control_invocation(self, ctx: SecurityContext, invocation_id: str,
+                                 action: str) -> dict:
+        action = action.lower()
+        row = await self.get_invocation(ctx, invocation_id)
+        conn = self._db.secure(ctx, INVOCATIONS)
+        task = self._tasks.get(invocation_id)
+        timeline = list(row.get("timeline") or [])
+
+        if action == "cancel":
+            if row["status"] in ("pending", "running", "suspended"):
+                if task:
+                    task.cancel()
+                timeline.append(self._evt("cancelled", "by control action"))
+                conn.update(invocation_id, {"status": "cancelled", "timeline": timeline})
+            return await self.get_invocation(ctx, invocation_id)
+        if action == "suspend":
+            if row["status"] not in ("pending", "running"):
+                raise ProblemError.conflict(f"cannot suspend from {row['status']}")
+            self._suspended[invocation_id] = asyncio.Event()
+            return await self.get_invocation(ctx, invocation_id)
+        if action == "resume":
+            if row["status"] != "suspended" and invocation_id not in self._suspended:
+                raise ProblemError.conflict(f"cannot resume from {row['status']}")
+            self._suspended.pop(invocation_id, None)
+            # only respawn when the original task actually parked at the gate
+            # (status persisted as suspended AND no live task) — resuming a
+            # still-running invocation must not start a second execution
+            if row["status"] == "suspended" and invocation_id not in self._tasks:
+                ep = self._resolve_ep(ctx, row["entrypoint_name"], row["version"],
+                                      any_status=True)
+                fresh = conn.get(invocation_id)
+                self._spawn(ctx, ep, fresh)
+            return await self.get_invocation(ctx, invocation_id)
+        if action in ("retry", "replay"):
+            if action == "retry" and row["status"] not in ("failed", "cancelled"):
+                raise ProblemError.conflict("retry requires failed/cancelled")
+            ep = self._resolve_ep(ctx, row["entrypoint_name"], row["version"],
+                                  any_status=True)
+            new_inv = conn.insert({
+                "entrypoint_id": row.get("entrypoint_id", ep["id"]),
+                "entrypoint_name": row["entrypoint_name"],
+                "version": row["version"], "status": "pending", "mode": "async",
+                "params": row.get("params"), "attempt": 1,
+                "timeline": [self._evt(action, f"of {invocation_id}")],
+            })
+            self._spawn(ctx, ep, new_inv)
+            return self._inv_view(new_inv)
+        raise ProblemError.bad_request(
+            f"unknown action {action!r} (cancel|suspend|resume|retry|replay)")
+
+    async def get_timeline(self, ctx: SecurityContext, invocation_id: str) -> list:
+        return (await self.get_invocation(ctx, invocation_id)).get("timeline") or []
+
+    # ------------------------------------------------------------- schedules
+    async def create_schedule(self, ctx: SecurityContext, spec: dict) -> dict:
+        self._resolve_ep(ctx, spec["entrypoint"])  # must exist
+        every = float(spec.get("every_seconds", 0))
+        if every < 0.05:
+            raise ProblemError.bad_request("every_seconds must be >= 0.05")
+        policy = spec.get("missed_run_policy", "skip")
+        if policy not in ("skip", "catch_up"):
+            raise ProblemError.bad_request("missed_run_policy must be skip|catch_up")
+        conn = self._db.secure(ctx, SCHEDULES)
+        return conn.insert({
+            "entrypoint_name": spec["entrypoint"], "every_seconds": every,
+            "params": spec.get("params") or {}, "missed_run_policy": policy,
+            "enabled": True, "next_fire_at": time.time() + every,
+        })
+
+    async def scheduler_tick(self) -> int:
+        """Fire due schedules; returns count fired. Driven by the module's
+        background loop (fire accuracy bar: within 1s — PRD.md:37; loop at 250ms)."""
+        sysctx = SecurityContext.system()
+        conn = self._db.secure(sysctx, SCHEDULES)
+        now = time.time()
+        fired = 0
+        for sched in conn.select(where={"enabled": True}):
+            if (sched.get("next_fire_at") or 0) > now:
+                continue
+            tenant_ctx = SecurityContext.anonymous(sched["tenant_id"])
+            missed = 0
+            nxt = sched["next_fire_at"] or now
+            while nxt <= now:
+                nxt += sched["every_seconds"]
+                missed += 1
+            runs = missed if sched["missed_run_policy"] == "catch_up" else 1
+            for _ in range(min(runs, 10)):  # catch-up burst cap
+                try:
+                    await self.start_invocation(tenant_ctx, {
+                        "entrypoint": sched["entrypoint_name"],
+                        "params": sched.get("params") or {}, "mode": "async"})
+                    fired += 1
+                except ProblemError:
+                    break
+            conn.update(sched["id"], {"next_fire_at": nxt, "last_fired_at": now})
+        return fired
+
+    # ------------------------------------------------------------- helpers
+    @staticmethod
+    def _evt(event: str, detail: str = "") -> dict:
+        return {"ts": _now(), "event": event, "detail": detail}
+
+    def _inv_view(self, row: dict) -> dict:
+        return {k: row.get(k) for k in (
+            "id", "entrypoint_name", "version", "status", "mode", "params",
+            "result", "error", "attempt", "timeline", "created_at", "updated_at")}
+
+
+class _Suspended(Exception):
+    pass
+
+
+def _now() -> str:
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def _jsonable(obj: Any) -> Any:
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return json.loads(json.dumps(obj, default=str))
+
+
+@module(name="serverless_runtime",
+        deps=["model_registry", "llm_gateway"],
+        capabilities=["db", "rest", "stateful"])
+class ServerlessRuntimeModule(Module, DatabaseCapability, RestApiCapability,
+                              RunnableCapability):
+    def __init__(self) -> None:
+        self.service: Optional[ServerlessService] = None
+        self._loop_task: Optional[asyncio.Task] = None
+
+    def migrations(self):
+        return _MIGRATIONS
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        self.service = ServerlessService(ctx)
+        ctx.client_hub.register(ServerlessApi, self.service)
+
+    async def start(self, ctx: ModuleCtx, ready: ReadySignal) -> None:
+        svc = self.service
+        assert svc is not None
+        token = ctx.cancellation_token
+
+        async def loop() -> None:
+            while not token.is_cancelled:
+                try:
+                    await svc.scheduler_tick()
+                except Exception:  # noqa: BLE001
+                    import logging
+
+                    logging.getLogger("serverless").exception("scheduler tick failed")
+                await asyncio.sleep(0.25)
+
+        self._loop_task = asyncio.ensure_future(loop())
+        ready.notify_ready()
+
+    async def stop(self, ctx: ModuleCtx) -> None:
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        for task in list(self.service._tasks.values() if self.service else []):
+            task.cancel()
+
+    # ------------------------------------------------------------- REST
+    def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
+        svc = self.service
+        assert svc is not None
+
+        async def create_ep(request: web.Request):
+            body = await read_json(request)
+            return await svc.register_entrypoint(request[SECURITY_CONTEXT_KEY], body), 201
+
+        async def list_eps(request: web.Request):
+            page = await svc.list_entrypoints(
+                request[SECURITY_CONTEXT_KEY],
+                filter_text=request.query.get("$filter"),
+                cursor=request.query.get("cursor"))
+            return page.to_dict()
+
+        async def ep_status(request: web.Request):
+            body = await read_json(request, {
+                "type": "object", "required": ["action"],
+                "properties": {"action": {"type": "string"},
+                               "version": {"type": "integer"}},
+                "additionalProperties": False})
+            return await svc.update_entrypoint_status(
+                request[SECURITY_CONTEXT_KEY], request.match_info["name"],
+                body["action"], body.get("version"))
+
+        async def invoke(request: web.Request):
+            body = await read_json(request)
+            out = await svc.start_invocation(request[SECURITY_CONTEXT_KEY], body)
+            status = 202 if body.get("mode") == "async" else 200
+            return out, status
+
+        async def get_inv(request: web.Request):
+            return await svc.get_invocation(request[SECURITY_CONTEXT_KEY],
+                                            request.match_info["inv_id"])
+
+        async def list_invs(request: web.Request):
+            page = await svc.list_invocations(
+                request[SECURITY_CONTEXT_KEY],
+                filter_text=request.query.get("$filter"),
+                cursor=request.query.get("cursor"))
+            return page.to_dict()
+
+        async def control(request: web.Request):
+            body = await read_json(request, {
+                "type": "object", "required": ["action"],
+                "properties": {"action": {"type": "string"}},
+                "additionalProperties": False})
+            return await svc.control_invocation(
+                request[SECURITY_CONTEXT_KEY], request.match_info["inv_id"],
+                body["action"])
+
+        async def timeline(request: web.Request):
+            return {"timeline": await svc.get_timeline(
+                request[SECURITY_CONTEXT_KEY], request.match_info["inv_id"])}
+
+        async def create_schedule(request: web.Request):
+            body = await read_json(request, {
+                "type": "object", "required": ["entrypoint", "every_seconds"],
+                "properties": {"entrypoint": {"type": "string"},
+                               "every_seconds": {"type": "number"},
+                               "params": {"type": "object"},
+                               "missed_run_policy": {"enum": ["skip", "catch_up"]}},
+                "additionalProperties": False})
+            return await svc.create_schedule(request[SECURITY_CONTEXT_KEY], body), 201
+
+        m = "serverless_runtime"
+        router.operation("POST", "/v1/serverless/entrypoints", module=m).auth_required() \
+            .summary("Register an entrypoint version (function or workflow)") \
+            .handler(create_ep).register()
+        router.operation("GET", "/v1/serverless/entrypoints", module=m).auth_required() \
+            .summary("List entrypoints").handler(list_eps).register()
+        router.operation("POST", "/v1/serverless/entrypoints/{name}/status", module=m) \
+            .auth_required().summary("activate|deprecate|disable|enable|archive") \
+            .handler(ep_status).register()
+        router.operation("POST", "/v1/serverless/invocations", module=m).auth_required() \
+            .summary("Invoke (sync/async, dry_run, idempotency_key)") \
+            .handler(invoke).register()
+        router.operation("GET", "/v1/serverless/invocations", module=m).auth_required() \
+            .summary("List invocations").handler(list_invs).register()
+        router.operation("GET", "/v1/serverless/invocations/{inv_id}", module=m) \
+            .auth_required().summary("Invocation record").handler(get_inv).register()
+        router.operation("POST", "/v1/serverless/invocations/{inv_id}/control", module=m) \
+            .auth_required().summary("cancel|suspend|resume|retry|replay") \
+            .handler(control).register()
+        router.operation("GET", "/v1/serverless/invocations/{inv_id}/timeline", module=m) \
+            .auth_required().summary("Invocation timeline events").handler(timeline).register()
+        router.operation("POST", "/v1/serverless/schedules", module=m).auth_required() \
+            .summary("Create an interval schedule").handler(create_schedule).register()

@@ -1,0 +1,198 @@
+"""types-registry — the GTS schema/instance store.
+
+Reference: modules/system/types-registry (implemented in Rust there) — register/
+validate/resolve versioned type ids (``gts.vendor.pkg.ns.name.v1~[instance]``),
+wildcard queries, deterministic UUIDv5 from the GTS id, ready-mode gating.
+GtsEntity shape per types-registry-sdk/src/models.rs:29-60.
+"""
+
+from __future__ import annotations
+
+import re
+import uuid
+from typing import Optional
+
+import jsonschema
+from aiohttp import web
+
+from ..modkit import Module, module
+from ..modkit.contracts import RestApiCapability, SystemCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.errors import ProblemError
+from ..modkit.security import SecurityContext
+from ..gateway.middleware import SECURITY_CONTEXT_KEY
+from ..gateway.validation import read_json
+from .sdk import GtsEntity, TypesRegistryApi
+
+#: gts.vendor.pkg.ns.name.v1~ with optional instance suffix
+_GTS_ID_RE = re.compile(
+    r"^gts\.(?P<vendor>[a-z0-9_]+)\.(?P<pkg>[a-z0-9_]+)\.(?P<ns>[a-z0-9_]+)"
+    r"\.(?P<name>[a-z0-9_]+)\.v(?P<ver>\d+)~(?P<instance>[A-Za-z0-9_.\-]*)$"
+)
+
+_GTS_NAMESPACE_UUID = uuid.UUID("6ba7b812-9dad-11d1-80b4-00c04fd430c8")  # uuid5 ns
+
+
+def gts_uuid(gts_id: str) -> str:
+    """Deterministic UUIDv5 from the GTS id (types-registry behavior)."""
+    return str(uuid.uuid5(_GTS_NAMESPACE_UUID, gts_id))
+
+
+def validate_gts_id(gts_id: str) -> re.Match:
+    m = _GTS_ID_RE.match(gts_id)
+    if m is None:
+        raise ProblemError.unprocessable(
+            f"malformed GTS id {gts_id!r} (expected gts.vendor.pkg.ns.name.vN~[instance])",
+            code="bad_gts_id",
+        )
+    return m
+
+
+class TypesRegistryService(TypesRegistryApi):
+    """In-memory repo (mirrors infra/storage/in_memory_repo.rs) with ready-mode
+    gating: queries before ready() raise 503 unless gating is disabled."""
+
+    def __init__(self, ready_mode: bool = False) -> None:
+        self._entities: dict[str, GtsEntity] = {}
+        self._ready = not ready_mode
+
+    def mark_ready(self) -> None:
+        self._ready = True
+
+    def _gate(self) -> None:
+        if not self._ready:
+            raise ProblemError.service_unavailable(
+                "types registry not ready", code="not_ready")
+
+    async def register(self, ctx: SecurityContext, entity: GtsEntity) -> GtsEntity:
+        m = validate_gts_id(entity.gts_id)
+        is_instance = bool(m.group("instance"))
+        if entity.kind not in ("schema", "instance"):
+            raise ProblemError.bad_request("kind must be schema|instance")
+        if entity.kind == "instance" and not is_instance:
+            raise ProblemError.bad_request(
+                "instance registration requires an instance suffix after '~'")
+        if entity.kind == "schema" and is_instance:
+            raise ProblemError.bad_request("schema ids must not carry an instance suffix")
+        if entity.kind == "schema":
+            try:
+                jsonschema.Draft202012Validator.check_schema(entity.body)
+            except jsonschema.SchemaError as e:
+                raise ProblemError.unprocessable(f"invalid JSON Schema: {e.message}",
+                                                 code="bad_schema")
+        if entity.kind == "instance":
+            base_id = entity.gts_id.split("~")[0] + "~"
+            schema = self._entities.get(base_id)
+            if schema is not None:
+                errors = await self.validate_instance(ctx, base_id, entity.body)
+                if errors:
+                    raise ProblemError.unprocessable(
+                        "instance does not validate against its schema",
+                        errors=[{"field": "body", "message": e} for e in errors[:8]],
+                        code="instance_invalid",
+                    )
+        if entity.gts_id in self._entities:
+            raise ProblemError.conflict(f"{entity.gts_id} already registered",
+                                        code="gts_exists")
+        self._entities[entity.gts_id] = entity
+        return entity
+
+    async def get(self, ctx: SecurityContext, gts_id: str) -> Optional[GtsEntity]:
+        self._gate()
+        return self._entities.get(gts_id)
+
+    async def query(self, ctx: SecurityContext, pattern: str) -> list[GtsEntity]:
+        self._gate()
+        regex = re.compile(
+            "^" + re.escape(pattern).replace(r"\*", "[^~]*") + ".*$")
+        return [e for gid, e in sorted(self._entities.items()) if regex.match(gid)]
+
+    async def validate_instance(self, ctx: SecurityContext, schema_id: str,
+                                instance: dict) -> list[str]:
+        schema = self._entities.get(schema_id)
+        if schema is None or schema.kind != "schema":
+            return [f"schema {schema_id} not registered"]
+        validator = jsonschema.Draft202012Validator(schema.body)
+        return [e.message for e in validator.iter_errors(instance)]
+
+
+@module(name="types_registry", capabilities=["rest", "system"])
+class TypesRegistryModule(Module, RestApiCapability, SystemCapability):
+    def __init__(self) -> None:
+        self.service = TypesRegistryService()
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        ctx.client_hub.register(TypesRegistryApi, self.service)
+        # seed base platform types (modules/system/types pattern: BaseModkitPluginV1)
+        base = GtsEntity(
+            gts_id="gts.x.modkit.plugins.base_plugin.v1~",
+            kind="schema",
+            vendor="x",
+            description="Base plugin registration envelope",
+            body={
+                "type": "object",
+                "required": ["id", "vendor", "priority"],
+                "properties": {
+                    "id": {"type": "string"},
+                    "vendor": {"type": "string"},
+                    "priority": {"type": "integer"},
+                    "properties": {"type": "object"},
+                },
+            },
+        )
+        sysctx = SecurityContext.system()
+        try:
+            await self.service.register(sysctx, base)
+        except ProblemError:
+            pass
+
+    async def post_init(self, ctx: ModuleCtx) -> None:
+        self.service.mark_ready()
+
+    def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
+        svc = self.service
+
+        async def register_type(request: web.Request):
+            body = await read_json(request, {
+                "type": "object", "required": ["gts_id", "kind", "body"],
+                "properties": {"gts_id": {"type": "string"},
+                               "kind": {"enum": ["schema", "instance"]},
+                               "body": {"type": "object"},
+                               "vendor": {"type": "string"},
+                               "description": {"type": "string"}},
+                "additionalProperties": False})
+            entity = await svc.register(request[SECURITY_CONTEXT_KEY], GtsEntity(**body))
+            return {"gts_id": entity.gts_id, "uuid": gts_uuid(entity.gts_id)}, 201
+
+        async def get_type(request: web.Request):
+            gts_id = request.query.get("id", "")
+            entity = await svc.get(request[SECURITY_CONTEXT_KEY], gts_id)
+            if entity is None:
+                raise ProblemError.not_found(f"{gts_id} not registered", code="gts_not_found")
+            return {"gts_id": entity.gts_id, "kind": entity.kind, "body": entity.body,
+                    "vendor": entity.vendor, "uuid": gts_uuid(entity.gts_id)}
+
+        async def query_types(request: web.Request):
+            pattern = request.query.get("pattern", "gts.*")
+            out = await svc.query(request[SECURITY_CONTEXT_KEY], pattern)
+            return {"items": [{"gts_id": e.gts_id, "kind": e.kind} for e in out]}
+
+        async def validate(request: web.Request):
+            body = await read_json(request, {
+                "type": "object", "required": ["schema_id", "instance"],
+                "properties": {"schema_id": {"type": "string"},
+                               "instance": {"type": "object"}},
+                "additionalProperties": False})
+            errors = await svc.validate_instance(
+                request[SECURITY_CONTEXT_KEY], body["schema_id"], body["instance"])
+            return {"valid": not errors, "errors": errors}
+
+        m = "types_registry"
+        router.operation("POST", "/v1/types", module=m).auth_required() \
+            .summary("Register a GTS schema or instance").handler(register_type).register()
+        router.operation("GET", "/v1/types/resolve", module=m).auth_required() \
+            .summary("Get a GTS entity by id (?id=)").handler(get_type).register()
+        router.operation("GET", "/v1/types", module=m).auth_required() \
+            .summary("Wildcard query (?pattern=gts.x.*)").handler(query_types).register()
+        router.operation("POST", "/v1/types/validate", module=m).auth_required() \
+            .summary("Validate an instance against a schema").handler(validate).register()

@@ -1,0 +1,110 @@
+"""hyperspot-server equivalent: the CLI entry point.
+
+Reference: apps/hyperspot-server/src/main.rs:23-64 — subcommands run|check|migrate,
+flags --print-config, --list-modules, --mock (in-memory DB).
+
+Usage:
+    python -m cyberfabric_core_tpu.server run --config config/quickstart.yaml
+    python -m cyberfabric_core_tpu.server check --config ...
+    python -m cyberfabric_core_tpu.server migrate --config ...
+    python -m cyberfabric_core_tpu.server run --print-config / --list-modules
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+from typing import Optional, Sequence
+
+from .modkit import AppConfig, ClientHub, ModuleRegistry, RunOptions
+from .modkit.db import DbManager
+from .modkit.runtime import HostRuntime, Runner
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-fabric-server",
+                                description="TPU-native modular service host")
+    p.add_argument("command", choices=["run", "check", "migrate"], nargs="?",
+                   default="run")
+    p.add_argument("--config", "-c", help="YAML config path")
+    p.add_argument("--mock", action="store_true",
+                   help="in-memory DBs (reference --mock parity)")
+    p.add_argument("--print-config", action="store_true",
+                   help="dump the effective (redacted) config and exit")
+    p.add_argument("--list-modules", action="store_true",
+                   help="list registered modules and exit")
+    p.add_argument("--log-level", default=None)
+    return p
+
+
+def _load_modules() -> None:
+    """Import side effects register every module (registered_modules.rs parity)."""
+    from . import modules  # noqa: F401
+
+
+def _setup_logging(config: AppConfig, override: Optional[str]) -> None:
+    level_name = override or config.section("logging").get("level", "info")
+    logging.basicConfig(
+        level=getattr(logging, str(level_name).upper(), logging.INFO),
+        format="%(asctime)s %(levelname)-7s %(name)s: %(message)s",
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    _load_modules()
+
+    try:
+        config = AppConfig.load_or_default(args.config)
+    except Exception as e:  # noqa: BLE001
+        print(f"config error: {e}", file=sys.stderr)
+        return 2
+    _setup_logging(config, args.log_level)
+
+    if args.print_config:
+        print(json.dumps(config.dump_effective(), indent=2))
+        return 0
+    if args.list_modules:
+        from .modkit.registry import registrations
+
+        enabled = config.module_names()
+        for reg in sorted(registrations(), key=lambda r: r.name):
+            mark = "*" if (not enabled or reg.name in enabled) else " "
+            print(f"{mark} {reg.name:<22} deps={list(reg.deps)} caps={list(reg.capabilities)}")
+        return 0
+
+    enabled = config.module_names() or None
+    registry = ModuleRegistry.discover_and_build(enabled=enabled)
+    db_manager = DbManager(home_dir=None if args.mock else config.home_dir(),
+                           in_memory=args.mock)
+    opts = RunOptions(config=config, registry=registry, client_hub=ClientHub(),
+                      db_manager=db_manager, install_signal_handlers=True)
+
+    if args.command == "check":
+        # validate: config parsed, modules resolvable, routes registrable
+        print(f"config OK ({len(registry.entries)} modules: "
+              f"{', '.join(registry.names())})")
+        return 0
+    if args.command == "migrate":
+        async def migrate() -> None:
+            await HostRuntime(opts).run_migration_phases()
+
+        asyncio.run(migrate())
+        print("migrations applied")
+        return 0
+
+    async def serve() -> None:
+        await Runner.run(opts)
+
+    try:
+        asyncio.run(serve())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,396 @@
+"""Black-box e2e over HTTP against the full server (SURVEY §4 tier-4 analogue:
+testing/e2e pytest suite). Boots every module with an in-memory DB on an
+ephemeral port; the tiny models run on the CPU backend.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+BASE_CONFIG = {
+    "modules": {
+        # auth_disabled stays False: requests flow through the accept_all authn
+        # resolver plugin, which takes the tenant from x-tenant-id (default acme)
+        "api_gateway": {"config": {"bind_addr": "127.0.0.1:0",
+                                   "timeout_secs": 30.0}},
+        "tenant_resolver": {"config": {"tenants": {
+            "root": {}, "acme": {"parent": "root"}, "acme-eu": {"parent": "acme"}}}},
+        "authn_resolver": {"config": {"mode": "accept_all", "default_tenant": "acme"}},
+        "authz_resolver": {},
+        "types_registry": {},
+        "module_orchestrator": {},
+        "nodes_registry": {},
+        "model_registry": {"config": {
+            "seed_tenant": "acme",
+            "models": [
+                {"provider_slug": "local", "provider_model_id": "tiny-llama",
+                 "approval_state": "approved", "managed": True,
+                 "architecture": "llama", "format": "safetensors",
+                 "capabilities": {"chat": True, "streaming": True},
+                 "limits": {"max_input_tokens": 200, "max_output_tokens": 64},
+                 "engine_options": {"model_config": "tiny-llama", "max_seq_len": 256,
+                                    "max_batch": 4}},
+                {"provider_slug": "local", "provider_model_id": "tiny-bert",
+                 "approval_state": "approved", "managed": True,
+                 "architecture": "bert",
+                 "capabilities": {"embeddings": True},
+                 "engine_options": {"model_config": "tiny-bert"}},
+                {"provider_slug": "local", "provider_model_id": "pending-model",
+                 "approval_state": "pending",
+                 "engine_options": {"model_config": "tiny-llama"}},
+            ],
+            "aliases": {"default-chat": "local::tiny-llama"},
+        }},
+        "llm_gateway": {"config": {"worker": {"batch_window_ms": 2}}},
+        "file_storage": {},
+        "credstore": {},
+        "file_parser": {},
+        "serverless_runtime": {},
+    }
+}
+
+
+@pytest.fixture(scope="module")
+def server():
+    """Boot the whole stack once for this test module."""
+    from cyberfabric_core_tpu.modkit import AppConfig, ClientHub, ModuleRegistry, RunOptions
+    from cyberfabric_core_tpu.modkit.db import DbManager
+    from cyberfabric_core_tpu.modkit.registry import _REGISTRATIONS
+    from cyberfabric_core_tpu.modkit.runtime import HostRuntime
+    import cyberfabric_core_tpu.modules  # noqa: F401 — registers everything
+
+    cfg = AppConfig.load_or_default(environ={}, cli_overrides=BASE_CONFIG)
+    registry = ModuleRegistry.discover_and_build(enabled=cfg.module_names())
+    opts = RunOptions(config=cfg, registry=registry, client_hub=ClientHub(),
+                      db_manager=DbManager(in_memory=True))
+    rt = HostRuntime(opts)
+
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(rt.run_setup_phases())
+    gw = registry.get("api_gateway").instance
+    yield loop, f"http://127.0.0.1:{gw.bound_port}"
+    rt.root_token.cancel()
+    loop.run_until_complete(rt.run_stop_phase())
+    loop.close()
+
+
+def req(server, method, path, **kw):
+    loop, base = server
+
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.request(method, base + path, **kw) as r:
+                raw = await r.read()
+                try:
+                    return r.status, json.loads(raw)
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    return r.status, raw
+
+    return loop.run_until_complete(go())
+
+
+# ---------------------------------------------------------------- chat (M1 slice)
+def test_chat_completion_sync(server):
+    status, body = req(server, "POST", "/v1/chat/completions", json={
+        "model": "default-chat",
+        "messages": [{"role": "user",
+                      "content": [{"type": "text", "text": "hello tpu"}]}],
+        "max_tokens": 8,
+    })
+    assert status == 200, body
+    assert body["model_used"] == "local::tiny-llama"
+    assert body["usage"]["input_tokens"] > 0
+    assert body["usage"]["output_tokens"] > 0
+    assert body["content"][0]["type"] == "text"
+    assert body["finish_reason"] in ("stop", "length")
+
+
+def test_chat_completion_sse_contract(server):
+    loop, base = server
+
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.post(base + "/v1/chat/completions", json={
+                "model": "local::tiny-llama",
+                "messages": [{"role": "user",
+                              "content": [{"type": "text", "text": "stream me"}]}],
+                "max_tokens": 6, "stream": True,
+            }) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                return (await r.read()).decode()
+
+    text = loop.run_until_complete(go())
+    frames = [f for f in text.split("\n\n") if f.startswith("data: ")]
+    assert frames[-1] == "data: [DONE]"  # DESIGN.md:293-311 terminator
+    chunks = [json.loads(f[6:]) for f in frames[:-1]]
+    assert chunks[0]["delta"].get("role") == "assistant"  # role only in first chunk
+    assert all("id" in c and "model" in c and "delta" in c for c in chunks)
+    final = chunks[-1]
+    assert final["finish_reason"] in ("stop", "length")
+    assert "usage" in final and final["usage"]["output_tokens"] > 0
+    assert all("usage" not in c for c in chunks[:-1])
+
+
+def test_chat_schema_validation_422(server):
+    # content as a bare string violates the parts-array contract (SURVEY §8.1)
+    status, body = req(server, "POST", "/v1/chat/completions", json={
+        "model": "x", "messages": [{"role": "user", "content": "bare string"}]})
+    assert status == 422
+    assert body["code"] == "validation_failed"
+
+
+def test_chat_unknown_model_404(server):
+    status, body = req(server, "POST", "/v1/chat/completions", json={
+        "model": "ghost", "messages": [{"role": "user",
+                                        "content": [{"type": "text", "text": "x"}]}]})
+    assert status == 404 and body["code"] == "model_not_found"
+
+
+def test_chat_unapproved_model_rejected_and_fallback_works(server):
+    # direct use of a pending model → 404/403 chain message
+    status, _ = req(server, "POST", "/v1/chat/completions", json={
+        "model": "local::pending-model",
+        "messages": [{"role": "user", "content": [{"type": "text", "text": "x"}]}]})
+    assert status == 404
+    # but with a fallback chain the request succeeds on the approved model
+    status, body = req(server, "POST", "/v1/chat/completions", json={
+        "model": "local::pending-model",
+        "fallback": {"models": ["local::tiny-llama"]},
+        "messages": [{"role": "user", "content": [{"type": "text", "text": "x"}]}],
+        "max_tokens": 4})
+    assert status == 200
+    assert body["model_used"] == "local::tiny-llama"
+    assert body["fallback_used"] is True
+
+
+def test_chat_async_job_lifecycle(server):
+    status, job = req(server, "POST", "/v1/chat/completions", json={
+        "model": "default-chat", "async": True,
+        "messages": [{"role": "user", "content": [{"type": "text", "text": "job"}]}],
+        "max_tokens": 4})
+    assert status == 202 and job["status"] in ("pending", "running")
+    loop, _ = server
+    for _ in range(100):
+        status, job = req(server, "GET", f"/v1/jobs/{job['id']}")
+        if job["status"] in ("completed", "failed"):
+            break
+        loop.run_until_complete(asyncio.sleep(0.05))
+    assert job["status"] == "completed", job
+    assert job["result"]["model_used"] == "local::tiny-llama"
+
+
+def test_embeddings(server):
+    status, body = req(server, "POST", "/v1/embeddings", json={
+        "model": "local::tiny-bert", "input": ["hello", "world"]})
+    assert status == 200, body
+    assert len(body["data"]) == 2
+    v = body["data"][0]["embedding"]
+    assert len(v) == 32  # tiny-bert hidden size
+    norm = sum(x * x for x in v) ** 0.5
+    assert abs(norm - 1.0) < 1e-3  # bge-style L2 normalization
+
+
+def test_usage_accounting(server):
+    status, body = req(server, "GET", "/v1/usage")
+    assert status == 200
+    assert body["usage"]["total_tokens"] > 0
+    assert body["usage"]["requests"] > 0
+
+
+# ---------------------------------------------------------------- model registry
+def test_model_registry_resolution_and_listing(server):
+    status, body = req(server, "GET", "/v1/model-registry/models/default-chat")
+    assert status == 200 and body["canonical_id"] == "local::tiny-llama"
+    status, body = req(server, "GET", "/v1/model-registry/models",
+                       params={"$filter": "approval_state eq 'approved'"})
+    assert status == 200
+    ids = [m["canonical_id"] for m in body["items"]]
+    assert "local::tiny-llama" in ids and "local::pending-model" not in ids
+
+
+def test_model_registry_approval_state_machine(server):
+    status, body = req(server, "POST",
+                       "/v1/model-registry/models/local::pending-model/approval",
+                       json={"state": "approved"})
+    assert status == 200 and body["approval_state"] == "approved"
+    # illegal transition approved -> rejected
+    status, body = req(server, "POST",
+                       "/v1/model-registry/models/local::pending-model/approval",
+                       json={"state": "rejected"})
+    assert status == 409 and body["code"] == "invalid_transition"
+    # revoke to restore the fixture state
+    status, _ = req(server, "POST",
+                    "/v1/model-registry/models/local::pending-model/approval",
+                    json={"state": "revoked"})
+    assert status == 200
+
+
+# ---------------------------------------------------------------- file storage
+def test_file_storage_roundtrip(server):
+    status, meta = req(server, "POST", "/v1/files", data=b"hello bytes",
+                       headers={"Content-Type": "text/plain", "x-filename": "a.txt"})
+    assert status == 201
+    status, content = req(server, "GET", meta["url"])
+    assert status == 200 and content == b"hello bytes"
+    status, info = req(server, "GET", meta["url"] + "/metadata")
+    assert status == 200 and info["size_bytes"] == 11
+    status, _ = req(server, "DELETE", meta["url"])
+    assert status == 204
+    status, _ = req(server, "GET", meta["url"])
+    assert status == 404
+
+
+# ---------------------------------------------------------------- credstore
+def test_credstore_walk_up_resolution(server):
+    # parent tenant stores a tenant-shared secret; child resolves it via walk-up.
+    # accept_all authn takes the tenant from x-tenant-id.
+    status, _ = req(server, "PUT", "/v1/credstore/secrets/api-key",
+                    json={"value": "parent-secret", "sharing": "tenant"},
+                    headers={"x-tenant-id": "acme"})
+    assert status == 204
+    status, body = req(server, "GET", "/v1/credstore/secrets/api-key",
+                       headers={"x-tenant-id": "acme-eu"})
+    assert status == 200 and body["value"] == "parent-secret"
+    # private secrets do NOT walk down
+    status, _ = req(server, "PUT", "/v1/credstore/secrets/private-key",
+                    json={"value": "locked", "sharing": "private"},
+                    headers={"x-tenant-id": "acme"})
+    status, body = req(server, "GET", "/v1/credstore/secrets/private-key",
+                       headers={"x-tenant-id": "acme-eu"})
+    assert status == 404
+
+
+# ---------------------------------------------------------------- types registry
+def test_types_registry_roundtrip(server):
+    status, body = req(server, "POST", "/v1/types", json={
+        "gts_id": "gts.acme.llm.tools.weather.v1~", "kind": "schema",
+        "body": {"type": "object", "required": ["city"],
+                 "properties": {"city": {"type": "string"}}}})
+    assert status == 201 and body["uuid"]
+    status, body = req(server, "POST", "/v1/types/validate", json={
+        "schema_id": "gts.acme.llm.tools.weather.v1~",
+        "instance": {"city": "berlin"}})
+    assert status == 200 and body["valid"] is True
+    status, body = req(server, "POST", "/v1/types/validate", json={
+        "schema_id": "gts.acme.llm.tools.weather.v1~", "instance": {}})
+    assert body["valid"] is False
+    status, body = req(server, "GET", "/v1/types", params={"pattern": "gts.acme.*"})
+    assert any(e["gts_id"].startswith("gts.acme") for e in body["items"])
+    # malformed GTS id rejected
+    status, body = req(server, "POST", "/v1/types", json={
+        "gts_id": "not-a-gts-id", "kind": "schema", "body": {}})
+    assert status == 422
+
+
+# ---------------------------------------------------------------- file parser
+def test_file_parser_html(server):
+    html = b"<html><body><h1>Title</h1><p>Hello <b>world</b></p><ul><li>a</li><li>b</li></ul></body></html>"
+    status, body = req(server, "POST", "/v1/file-parser/parse", data=html,
+                       headers={"Content-Type": "text/html"})
+    assert status == 200
+    md = body["markdown"]
+    assert "# Title" in md and "Hello world" in md and "- a" in md
+    assert body["title"] == "Title"
+
+
+# ---------------------------------------------------------------- serverless
+def test_serverless_full_lifecycle(server):
+    # register a workflow: chat → echo of the text
+    status, ep = req(server, "POST", "/v1/serverless/entrypoints", json={
+        "name": "summarize", "kind": "workflow",
+        "definition": {"steps": [
+            {"name": "gen", "function": "llm.chat",
+             "params": {"model": "default-chat", "max_tokens": 4,
+                        "messages": [{"role": "user",
+                                      "content": [{"type": "text", "text": "hi"}]}]}},
+            {"name": "wrap", "function": "echo", "params": {"payload": "$prev"}},
+        ]}})
+    assert status == 201 and ep["status"] == "draft"
+    # draft is not invocable
+    status, body = req(server, "POST", "/v1/serverless/invocations",
+                       json={"entrypoint": "summarize"})
+    assert status == 409
+    # activate, then invoke synchronously
+    status, ep = req(server, "POST", "/v1/serverless/entrypoints/summarize/status",
+                     json={"action": "activate"})
+    assert status == 200 and ep["status"] == "active"
+    status, out = req(server, "POST", "/v1/serverless/invocations",
+                      json={"entrypoint": "summarize"})
+    assert status == 200, out
+    rec = out["record"]
+    assert rec["status"] == "completed"
+    assert rec["result"]["output"]["payload"]["model_used"] == "local::tiny-llama"
+    events = [e["event"] for e in rec["timeline"]]
+    assert "step_started" in events and "completed" in events
+
+
+def test_serverless_retry_and_dead_letter(server):
+    status, _ = req(server, "POST", "/v1/serverless/entrypoints", json={
+        "name": "flaky", "kind": "function",
+        "definition": {"function": "fail"},
+        "retry_policy": {"max_attempts": 3, "backoff_seconds": 0.01}})
+    req(server, "POST", "/v1/serverless/entrypoints/flaky/status",
+        json={"action": "activate"})
+    status, out = req(server, "POST", "/v1/serverless/invocations",
+                      json={"entrypoint": "flaky"})
+    rec = out["record"]
+    assert rec["status"] == "failed" and rec["attempt"] == 3
+    events = [e["event"] for e in rec["timeline"]]
+    assert events.count("attempt_failed") == 3
+    assert "dead_letter" in events
+
+
+def test_serverless_idempotency_cache(server):
+    req(server, "POST", "/v1/serverless/entrypoints", json={
+        "name": "cached-echo", "kind": "function",
+        "definition": {"function": "echo"},
+        "is_idempotent": True, "cache_max_age_seconds": 60})
+    req(server, "POST", "/v1/serverless/entrypoints/cached-echo/status",
+        json={"action": "activate"})
+    status, first = req(server, "POST", "/v1/serverless/invocations",
+                        json={"entrypoint": "cached-echo",
+                              "params": {"x": 1}, "idempotency_key": "k1"})
+    assert first["cached"] is False
+    status, second = req(server, "POST", "/v1/serverless/invocations",
+                         json={"entrypoint": "cached-echo",
+                               "params": {"x": 1}, "idempotency_key": "k1"})
+    assert second["cached"] is True
+    assert second["record"]["id"] == first["record"]["id"]
+
+
+def test_serverless_schedule_fires(server):
+    loop, _ = server
+    req(server, "POST", "/v1/serverless/entrypoints", json={
+        "name": "tick", "kind": "function", "definition": {"function": "echo"}})
+    req(server, "POST", "/v1/serverless/entrypoints/tick/status",
+        json={"action": "activate"})
+    status, sched = req(server, "POST", "/v1/serverless/schedules",
+                        json={"entrypoint": "tick", "every_seconds": 0.3})
+    assert status == 201
+    loop.run_until_complete(asyncio.sleep(1.2))
+    status, body = req(server, "GET", "/v1/serverless/invocations",
+                       params={"$filter": "entrypoint_name eq 'tick'"})
+    assert len(body["items"]) >= 2  # fired at least twice in 1.2s
+
+
+# ---------------------------------------------------------------- platform
+def test_modules_inventory_and_health(server):
+    status, body = req(server, "GET", "/v1/modules")
+    names = {m["name"] for m in body["modules"]}
+    assert {"api_gateway", "llm_gateway", "model_registry",
+            "serverless_runtime"} <= names
+    status, health = req(server, "GET", "/v1/system/health")
+    assert status == 200 and health["status"] in ("ok", "degraded")
+    assert "llm_worker" in health
+
+
+def test_nodes_registry_self_registration(server):
+    status, body = req(server, "GET", "/v1/nodes",
+                       headers={"x-tenant-id": "default"})
+    assert status == 200
+    assert len(body["items"]) >= 1
+    node = body["items"][0]
+    assert node["sys_info"]["cpu_count"] >= 1
