@@ -40,8 +40,10 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     k = iter(jax.random.split(key, 12))
 
     def w(rng, *shape):
-        scale = 1.0 / jnp.sqrt(shape[-2] if len(shape) > 1 else shape[-1])
-        return (jax.random.normal(rng, shape, jnp.float32) * scale).astype(dtype)
+        # sample directly in the target dtype: a 70B-scale f32 intermediate would
+        # double peak HBM during init for no benefit at synthetic-weight quality
+        scale = jnp.asarray(1.0 / (shape[-2] if len(shape) > 1 else shape[-1]) ** 0.5, dtype)
+        return jax.random.normal(rng, shape, dtype) * scale
 
     params: Params = {
         "embed": w(next(k), V, H),

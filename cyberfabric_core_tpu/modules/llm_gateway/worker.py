@@ -152,6 +152,7 @@ class LocalTpuWorker(LlmWorkerApi):
             max_batch=int(opts.pop("max_batch", 8)),
             dtype=opts.pop("dtype", "bfloat16"),
             eos_token_ids=tuple(opts.pop("eos_token_ids", ()) or ()),
+            decode_chunk=int(opts.pop("decode_chunk", 8)),
         )
         params = None
         tokenizer: Tokenizer
@@ -237,9 +238,10 @@ class LocalTpuWorker(LlmWorkerApi):
             if isinstance(item, Exception):
                 raise ProblemError.internal(f"generation failed: {item}")
             ev: StepEvent = item
-            n_tokens += 1
-            if ev.finished != "stop":
-                tail_ids.append(ev.token_id)
+            if ev.token_id >= 0:
+                n_tokens += 1
+                if ev.finished != "stop":
+                    tail_ids.append(ev.token_id)
             tail_text = entry.tokenizer.decode(tail_ids)
             if tail_text and not tail_text.endswith("�") and len(tail_ids) >= 8:
                 stable_text += tail_text

@@ -18,28 +18,43 @@ def sample_token(
     top_p: jnp.ndarray,        # [B] f32; 1 → disabled
     top_k: jnp.ndarray,        # [B] int32; 0 → disabled
 ) -> jnp.ndarray:
-    """Returns [B] int32 sampled token ids. Greedy when temperature == 0."""
+    """Returns [B] int32 sampled token ids. Greedy when temperature == 0.
+
+    All-greedy batches take a sort-free fast path via lax.cond — the full-vocab
+    argsort is ~ms-scale at V=128k and would otherwise run every decode step.
+    """
     B, V = logits.shape
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    safe_t = jnp.where(temperature > 0, temperature, 1.0)
-    scaled = logits / safe_t[:, None]
+    def greedy_branch(operands):
+        logits, *_ = operands
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    sorted_idx = jnp.argsort(-scaled, axis=-1)                   # desc, one sort
-    sorted_logits = jnp.take_along_axis(scaled, sorted_idx, axis=-1)
-    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
-    cumsum = jnp.cumsum(probs_sorted, axis=-1)
+    def sample_branch(operands):
+        logits, key, temperature, top_p, top_k = operands
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        safe_t = jnp.where(temperature > 0, temperature, 1.0)
+        scaled = logits / safe_t[:, None]
 
-    # top-p: keep the smallest prefix with cumulative mass >= top_p
-    # (shift so the first token crossing the threshold is kept)
-    keep_p = (cumsum - probs_sorted) < top_p[:, None]
-    # top-k: keep the first k sorted entries (k==0 → all)
-    rank = jnp.arange(V, dtype=jnp.int32)[None, :]
-    keep_k = jnp.where(top_k[:, None] > 0, rank < top_k[:, None], True)
-    keep = keep_p & keep_k
-    keep = keep.at[:, 0].set(True)  # never mask every token
+        sorted_idx = jnp.argsort(-scaled, axis=-1)               # desc, one sort
+        sorted_logits = jnp.take_along_axis(scaled, sorted_idx, axis=-1)
+        probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+        cumsum = jnp.cumsum(probs_sorted, axis=-1)
 
-    masked_sorted = jnp.where(keep, sorted_logits, -jnp.inf)
-    choice_in_sorted = jax.random.categorical(key, masked_sorted, axis=-1)
-    sampled = jnp.take_along_axis(sorted_idx, choice_in_sorted[:, None], axis=1)[:, 0]
-    return jnp.where(temperature > 0, sampled.astype(jnp.int32), greedy)
+        # top-p: keep the smallest prefix with cumulative mass >= top_p
+        # (shift so the first token crossing the threshold is kept)
+        keep_p = (cumsum - probs_sorted) < top_p[:, None]
+        # top-k: keep the first k sorted entries (k==0 → all)
+        rank = jnp.arange(V, dtype=jnp.int32)[None, :]
+        keep_k = jnp.where(top_k[:, None] > 0, rank < top_k[:, None], True)
+        keep = keep_p & keep_k
+        keep = keep.at[:, 0].set(True)  # never mask every token
+
+        masked_sorted = jnp.where(keep, sorted_logits, -jnp.inf)
+        choice_in_sorted = jax.random.categorical(key, masked_sorted, axis=-1)
+        sampled = jnp.take_along_axis(sorted_idx, choice_in_sorted[:, None], axis=1)[:, 0]
+        return jnp.where(temperature > 0, sampled.astype(jnp.int32), greedy)
+
+    return jax.lax.cond(
+        jnp.all(temperature <= 0.0), greedy_branch, sample_branch,
+        (logits, key, temperature, top_p, top_k),
+    )

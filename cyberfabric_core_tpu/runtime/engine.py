@@ -8,10 +8,9 @@ output token across the whole batch. Design:
 - prefill pads to bucket lengths (powers of two) so a handful of compiled programs
   serve all prompt lengths — no dynamic shapes, no recompiles in steady state;
 - the LM head runs on the gathered last-token hidden state only;
-- sampling happens on-device inside the decode step ([B] temperature/top-p/top-k
-  runtime scalars, one fused program), the host only reads back one [B] int32 per
-  step — and the readback of step t overlaps the dispatch of step t+1
-  (jax dispatches asynchronously; we fetch t's tokens after enqueueing t+1).
+- sampling happens on-device ([B] temperature/top-p/top-k runtime scalars) with a
+  sort-free greedy fast path; decode fuses `decode_chunk` steps into one program
+  via lax.scan, so the host pays one dispatch + one [B, k] readback per k tokens.
 
 Reference anchors: this implements the llm-gateway "local worker" the specs left
 abstract (DESIGN.md:317-346); TP sharding for multi-chip lives in parallel/ and is
@@ -59,6 +58,10 @@ class EngineConfig:
     #: model-level end-of-sequence ids (from the tokenizer/checkpoint config);
     #: per-request stop_token_ids extend these. No implicit guessing.
     eos_token_ids: tuple[int, ...] = ()
+    #: decode steps fused into ONE device program via lax.scan. Each host→device
+    #: dispatch costs ~1-70ms depending on transport; fusing k steps amortizes it
+    #: k-fold. Tokens past a row's EOS within a chunk are discarded host-side.
+    decode_chunk: int = 8
 
     def buckets(self) -> tuple[int, ...]:
         if self.prefill_buckets:
@@ -125,34 +128,49 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------ jit builders
     def _build_prefill(self) -> Callable:
+        """Prefill + FIRST-token sampling in one program: the first token comes
+        back with the prefill readback instead of costing a second dispatch RTT
+        (TTFT = one round trip)."""
         cfg = self.model_config
 
-        def prefill(params, input_ids, lengths, cache, rope):
+        def prefill(params, input_ids, lengths, cache, rng, temperature, top_p, top_k, rope):
             B, T = input_ids.shape
             positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
             start = jnp.zeros((B,), jnp.int32)
             hidden, cache = llama.forward(params, cfg, input_ids, positions, cache, start, rope)
             last_h = llama.gather_last_hidden(hidden, lengths)
             logits = llama.lm_head_logits(params, cfg, last_h)  # [B, V] f32
-            return logits, cache
+            rng, sub = jax.random.split(rng)
+            first = sample_token(logits, sub, temperature, top_p, top_k)
+            return first, cache, rng
 
         return jax.jit(prefill, donate_argnums=(3,) if self.config.donate_cache else ())
 
     def _build_decode(self) -> Callable:
+        """k decode steps fused into one program: scan(step) with the cache as
+        carry — one dispatch, one [B, k] readback."""
         cfg = self.model_config
+        k_steps = max(1, self.config.decode_chunk)
 
-        def decode(params, cache, last_tokens, lengths, rng, temperature, top_p, top_k, rope):
-            B = last_tokens.shape[0]
-            positions = lengths[:, None]  # write/attend position = current length
-            hidden, cache = llama.forward(
-                params, cfg, last_tokens[:, None], positions, cache, lengths, rope
+        def decode_chunk(params, cache, last_tokens, lengths, rng,
+                         temperature, top_p, top_k, rope):
+            def step(carry, _):
+                cache, toks, lens, rng = carry
+                hidden, cache = llama.forward(
+                    params, cfg, toks[:, None], lens[:, None], cache, lens, rope
+                )
+                logits = llama.lm_head_logits(params, cfg, hidden[:, 0, :])
+                rng, sub = jax.random.split(rng)
+                next_toks = sample_token(logits, sub, temperature, top_p, top_k)
+                return (cache, next_toks, lens + 1, rng), next_toks
+
+            (cache, _, _, rng), toks = jax.lax.scan(
+                step, (cache, last_tokens, lengths, rng), None, length=k_steps
             )
-            logits = llama.lm_head_logits(params, cfg, hidden[:, 0, :])
-            rng, sub = jax.random.split(rng)
-            next_tokens = sample_token(logits, sub, temperature, top_p, top_k)
-            return next_tokens, cache, rng
+            return toks.T, cache, rng  # [B, k]
 
-        return jax.jit(decode, donate_argnums=(1,) if self.config.donate_cache else ())
+        return jax.jit(decode_chunk,
+                       donate_argnums=(1,) if self.config.donate_cache else ())
 
     def _prefill_for(self, batch: int, bucket: int) -> Callable:
         key = (batch, bucket)
@@ -189,7 +207,8 @@ class InferenceEngine:
         collected: dict[int, list[int]] = {i: [] for i in range(len(prompts))}
         meta: dict[int, dict] = {}
         for ev in events:
-            collected[ev.request_index].append(ev.token_id)
+            if ev.token_id >= 0:  # token-less finish events carry -1
+                collected[ev.request_index].append(ev.token_id)
             if on_token:
                 on_token(ev)
             if ev.finished:
@@ -216,8 +235,7 @@ class InferenceEngine:
         prompts: list[list[int]],
         sampling: SamplingParams | list[SamplingParams],
     ) -> Iterator[StepEvent]:
-        """Yields StepEvents; the decode dispatch of step t+1 overlaps the host
-        readback of step t."""
+        """Yields StepEvents, `decode_chunk` tokens per device round trip."""
         B = len(prompts)
         if B == 0:
             self._last_timing = {"ttft_ms": 0.0, "total_ms": 0.0}
@@ -235,22 +253,19 @@ class InferenceEngine:
             ids[i, : len(p)] = p
         lengths = jnp.asarray(lengths_list, jnp.int32)
 
-        cache = llama.init_cache(self.model_config, B, self.config.max_seq_len, self.dtype)
-        prefill = self._prefill_for(B, bucket)
-        c0 = time.monotonic()
-        logits, cache = prefill(self.params, jnp.asarray(ids), lengths, cache, self.rope_tables)
-        first = np.asarray(jnp.argmax(logits, axis=-1), np.int32)  # greedy first token...
-        self.last_prefill_compile_s = time.monotonic() - c0
-
-        # ...unless sampling is requested: resample first token on-device for parity
         temperature = jnp.asarray([s.temperature for s in per_req], jnp.float32)
         top_p = jnp.asarray([s.top_p for s in per_req], jnp.float32)
         top_k = jnp.asarray([s.top_k for s in per_req], jnp.int32)
-        if any(s.temperature > 0 for s in per_req):
-            self._rng, sub = jax.random.split(self._rng)
-            first = np.asarray(
-                sample_token(logits, sub, temperature, top_p, top_k), np.int32
-            )
+
+        cache = llama.init_cache(self.model_config, B, self.config.max_seq_len, self.dtype)
+        prefill = self._prefill_for(B, bucket)
+        c0 = time.monotonic()
+        first_dev, cache, self._rng = prefill(
+            self.params, jnp.asarray(ids), lengths, cache, self._rng,
+            temperature, top_p, top_k, self.rope_tables,
+        )
+        first = np.asarray(first_dev, np.int32)
+        self.last_prefill_compile_s = time.monotonic() - c0
         ttft_ms = (time.monotonic() - t_start) * 1000.0
 
         stops = [set(s.stop_token_ids) | set(self.config.eos_token_ids) for s in per_req]
@@ -268,7 +283,7 @@ class InferenceEngine:
         cur = first
         lengths_np = np.asarray(lengths_list, np.int32)
         step_lengths = jnp.asarray(lengths_np)
-        last_tokens = jnp.asarray(cur)
+        last_tokens = first_dev  # stays on device; no H2D round trip
 
         # emit first tokens
         for i in range(B):
@@ -277,32 +292,47 @@ class InferenceEngine:
             done[i] = fin is not None
             yield StepEvent(i, int(cur[i]), fin)
 
+        k_steps = max(1, self.config.decode_chunk)
         steps = 0
         max_steps = max(max_new) if max_new else 0
-        while not all(done) and steps < max_steps + 1:
-            next_dev, cache, self._rng = self._decode_fn(
+        while not all(done) and steps < max_steps:
+            # a chunk writes k cache slots starting at the current length; it must
+            # fit entirely (chunks are static-shaped — no partial dispatch)
+            if int(lengths_np.max()) + k_steps > self.config.max_seq_len:
+                break
+            chunk_dev, cache, self._rng = self._decode_fn(
                 self.params, cache, last_tokens, step_lengths, self._rng,
                 temperature, top_p, top_k, self.rope_tables,
             )
-            lengths_np = lengths_np + 1
-            step_lengths = step_lengths + 1
-            last_tokens = next_dev
-            cur = np.asarray(next_dev, np.int32)  # sync point: one [B] readback
-            steps += 1
-            # cache capacity after this token: if the NEXT write would overflow,
-            # finish every still-active row on this event (single event per token)
-            capacity_exhausted = bool(np.any(lengths_np + 1 >= self.config.max_seq_len))
-            for i in range(B):
-                if done[i]:
-                    continue
-                emitted[i] += 1
-                fin = classify(i, int(cur[i]))
-                if fin is None and capacity_exhausted:
-                    fin = "length"
-                done[i] = fin is not None
-                yield StepEvent(i, int(cur[i]), fin)
-            if capacity_exhausted:
-                break
+            lengths_np = lengths_np + k_steps
+            step_lengths = step_lengths + k_steps
+            last_tokens = chunk_dev[:, -1]
+            chunk = np.asarray(chunk_dev, np.int32)  # sync: one [B, k] readback
+            steps += k_steps
+            # after this chunk, can another one fit? if not, active rows finish
+            # with "length" on their final emitted token (single event per token)
+            last_dispatchable = (
+                int(lengths_np.max()) + k_steps > self.config.max_seq_len
+                or steps >= max_steps
+            )
+            for j in range(k_steps):
+                for i in range(B):
+                    if done[i]:
+                        continue
+                    emitted[i] += 1
+                    tok = int(chunk[i, j])
+                    fin = classify(i, tok)
+                    if fin is None and last_dispatchable and j == k_steps - 1:
+                        fin = "length"
+                    done[i] = fin is not None
+                    yield StepEvent(i, tok, fin)
+
+        # epilogue: rows still active (e.g. no chunk fit after prefill) get a
+        # token-less finish event so every stream terminates with a reason
+        for i in range(B):
+            if not done[i]:
+                done[i] = True
+                yield StepEvent(i, -1, "length")
 
         self._last_timing = {
             "ttft_ms": ttft_ms,
