@@ -138,6 +138,48 @@ def forward(
     return h, (k_cache, v_cache)
 
 
+def prefill_collect(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,   # [B, T]
+    lengths: jnp.ndarray,     # [B]
+    rope_tables: tuple[jnp.ndarray, jnp.ndarray],
+) -> tuple[jnp.ndarray, KVCache]:
+    """Prefill that RETURNS the new per-layer k/v instead of writing a cache.
+
+    The continuous-batching scheduler prefills one request at a time and scatters
+    the returned [L, B, T, Hkv, D] into its slot of the persistent pool with a
+    single donated dynamic_update_slice — prefill compute stays O(one request),
+    not O(pool size). Semantics identical to `forward` on a fresh cache of S=T.
+    """
+    B, T = input_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    cache = init_cache(cfg, B, T, params["embed"].dtype)
+    hidden, kv = forward(
+        params, cfg, input_ids, positions, cache,
+        jnp.zeros((B,), jnp.int32), rope_tables,
+    )
+    last_h = gather_last_hidden(hidden, lengths)
+    return last_h, kv
+
+
+def insert_slot_kv(
+    cache: KVCache,
+    new_kv: KVCache,          # [L, 1, T, Hkv, D]
+    slot: jnp.ndarray,        # scalar int32
+) -> KVCache:
+    """Scatter one request's prefilled kv into its pool slot (donate the pool —
+    XLA performs the update in place)."""
+    k_cache, v_cache = cache
+    k_new, v_new = new_kv
+    zero = jnp.zeros((), jnp.int32)
+    idx = (zero, slot.astype(jnp.int32), zero, zero, zero)
+    return (
+        jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype), idx),
+        jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype), idx),
+    )
+
+
 def lm_head_logits(params: Params, cfg: ModelConfig, hidden: jnp.ndarray) -> jnp.ndarray:
     """hidden [B, H] (or [B, T, H]) → logits in f32."""
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]

@@ -24,6 +24,7 @@ from typing import Any, AsyncIterator, Optional
 
 from ...modkit.errors import ProblemError
 from ...runtime.engine import EngineConfig, InferenceEngine, SamplingParams, StepEvent
+from ...runtime.scheduler import ContinuousBatchingEngine
 from ...runtime.tokenizer import ByteTokenizer, Tokenizer, load_tokenizer, render_chat
 from ..sdk import ChatStreamChunk, LlmWorkerApi, ModelInfo
 
@@ -42,10 +43,18 @@ class _Request:
 
 @dataclass
 class _EngineEntry:
-    engine: InferenceEngine
+    config: EngineConfig
     tokenizer: Tokenizer
-    batcher: "_DynamicBatcher"
+    engine: Optional[InferenceEngine] = None          # lockstep mode
+    batcher: Optional["_DynamicBatcher"] = None       # lockstep mode
+    scheduler: Optional[ContinuousBatchingEngine] = None  # continuous mode
     model_family: str = "llama"
+
+
+@dataclass
+class _EmbedEntry:
+    tokenizer: Tokenizer
+    embed_fn: Any = None  # (jitted fwd, params tree, model config)
 
 
 class _DynamicBatcher:
@@ -118,6 +127,7 @@ class LocalTpuWorker(LlmWorkerApi):
     def __init__(self, worker_config: Optional[dict[str, Any]] = None) -> None:
         self._config = worker_config or {}
         self._entries: dict[str, _EngineEntry] = {}
+        self._embed_entries: dict[str, _EmbedEntry] = {}
         self._entry_locks: dict[str, asyncio.Lock] = {}
         self._executor = ThreadPoolExecutor(
             max_workers=int(self._config.get("max_engine_threads", 4)),
@@ -171,12 +181,21 @@ class LocalTpuWorker(LlmWorkerApi):
             if not eng_cfg.eos_token_ids:
                 eng_cfg = EngineConfig(**{**eng_cfg.__dict__,
                                           "eos_token_ids": (tokenizer.eos_id,)})
+        mode = self._config.get("scheduler", "continuous")
+        if mode == "continuous":
+            scheduler = ContinuousBatchingEngine(eng_cfg, params=params)
+            logger.info("continuous engine ready for %s (%s, slots=%d, max_seq=%d)",
+                        model.canonical_id, arch_config, eng_cfg.max_batch,
+                        eng_cfg.max_seq_len)
+            return _EngineEntry(config=eng_cfg, tokenizer=tokenizer,
+                                scheduler=scheduler)
         engine = InferenceEngine(eng_cfg)
         if params is not None:
             engine.params = params
-        logger.info("engine ready for %s (%s, max_seq=%d)", model.canonical_id,
-                    arch_config, eng_cfg.max_seq_len)
+        logger.info("lockstep engine ready for %s (%s, max_seq=%d)",
+                    model.canonical_id, arch_config, eng_cfg.max_seq_len)
         return _EngineEntry(
+            config=eng_cfg,
             engine=engine,
             tokenizer=tokenizer,
             batcher=_DynamicBatcher(
@@ -205,10 +224,10 @@ class LocalTpuWorker(LlmWorkerApi):
                 f"prompt of {len(prompt_ids)} tokens exceeds model limit {max_input}",
                 code="context_length_exceeded",
             )
-        if len(prompt_ids) >= entry.engine.config.max_seq_len:
+        if len(prompt_ids) >= entry.config.max_seq_len:
             raise ProblemError.unprocessable(
                 f"prompt of {len(prompt_ids)} tokens exceeds engine window "
-                f"{entry.engine.config.max_seq_len}",
+                f"{entry.config.max_seq_len}",
                 code="context_length_exceeded",
             )
 
@@ -220,7 +239,16 @@ class LocalTpuWorker(LlmWorkerApi):
             queue=queue,
             stop_strings=tuple(params.get("stop", ()) or ()),
         )
-        await entry.batcher.submit(req)
+        if entry.scheduler is not None:
+            loop = asyncio.get_running_loop()
+            entry.scheduler.submit(
+                prompt_ids, sampling,
+                emit=lambda ev: loop.call_soon_threadsafe(queue.put_nowait, ev),
+                request_id=request_id,
+            )
+        else:
+            assert entry.batcher is not None
+            await entry.batcher.submit(req)
 
         # incremental streaming detokenizer: decode only the unstable tail (tokens
         # whose text may still change via BPE/utf-8 merges), flushing it into
@@ -238,6 +266,8 @@ class LocalTpuWorker(LlmWorkerApi):
             if isinstance(item, Exception):
                 raise ProblemError.internal(f"generation failed: {item}")
             ev: StepEvent = item
+            if ev.finished == "error":
+                raise ProblemError.internal("generation failed in scheduler")
             if ev.token_id >= 0:
                 n_tokens += 1
                 if ev.finished != "stop":
@@ -294,7 +324,7 @@ class LocalTpuWorker(LlmWorkerApi):
         from ...models import bert, get_config
 
         key = f"embed::{model.canonical_id}"
-        entry = self._entries.get(key)
+        entry = self._embed_entries.get(key)
         if entry is None:
             cfg = get_config(dict(model.engine_options or {}).get("model_config")
                              or model.provider_model_id)
@@ -302,10 +332,9 @@ class LocalTpuWorker(LlmWorkerApi):
             tokenizer = (load_tokenizer(model.checkpoint_path, cfg.vocab_size)
                          if model.checkpoint_path else ByteTokenizer(cfg.vocab_size))
             fwd = jax.jit(lambda p, ids, mask: bert.embed_pooled(p, cfg, ids, mask))
-            entry = _EngineEntry(engine=None, tokenizer=tokenizer, batcher=None)  # type: ignore[arg-type]
-            entry.embed_fn = (fwd, params_tree, cfg)  # type: ignore[attr-defined]
-            self._entries[key] = entry
-        fwd, params_tree, cfg = entry.embed_fn  # type: ignore[attr-defined]
+            entry = _EmbedEntry(tokenizer=tokenizer, embed_fn=(fwd, params_tree, cfg))
+            self._embed_entries[key] = entry
+        fwd, params_tree, cfg = entry.embed_fn
 
         max_len = min(cfg.max_position, 128)
         out: list[list[float]] = []
@@ -329,7 +358,9 @@ class LocalTpuWorker(LlmWorkerApi):
         return {
             "status": "ok",
             "devices": [str(d) for d in jax.devices()],
-            "loaded_models": sorted(self._entries),
+            "loaded_models": sorted(self._entries) + sorted(self._embed_entries),
+            "schedulers": {k: e.scheduler.stats() for k, e in self._entries.items()
+                           if e.scheduler is not None},
             "requests_served": self._requests_served,
             "tokens_out": self._tokens_out,
             "uptime_s": round(time.monotonic() - self._started_at, 1),

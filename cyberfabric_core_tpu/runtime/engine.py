@@ -73,6 +73,46 @@ class EngineConfig:
         out.append(self.max_seq_len)
         return tuple(out)
 
+    def bucket_for(self, length: int) -> int:
+        """Smallest prefill bucket covering ``length``; rejects prompts that
+        leave no decode room (a clamped first write would corrupt the cache)."""
+        if length >= self.max_seq_len:
+            raise ValueError(
+                f"prompt length {length} leaves no decode room (max_seq_len "
+                f"{self.max_seq_len}; prompts must be strictly shorter)"
+            )
+        for b in self.buckets():
+            if length <= b:
+                return b
+        raise AssertionError("unreachable: buckets() always covers max_seq_len")
+
+
+def build_decode_chunk_fn(model_config: ModelConfig, k_steps: int,
+                          rope_tables) -> Callable:
+    """The shared fused decode body: k (forward T=1 → lm_head → sample) steps
+    under one lax.scan. Both the lockstep engine and the continuous scheduler jit
+    this same function (with their own donation specs) so the decode semantics
+    can never diverge between them."""
+
+    def decode_chunk(params, k_cache, v_cache, last_tokens, lengths, rng,
+                     temperature, top_p, top_k):
+        def step(carry, _):
+            cache, toks, lens, rng = carry
+            hidden, cache = llama.forward(
+                params, model_config, toks[:, None], lens[:, None], cache, lens,
+                rope_tables)
+            logits = llama.lm_head_logits(params, model_config, hidden[:, 0, :])
+            rng, sub = jax.random.split(rng)
+            nxt = sample_token(logits, sub, temperature, top_p, top_k)
+            return (cache, nxt, lens + 1, rng), nxt
+
+        (cache, last, _, rng), toks = jax.lax.scan(
+            step, ((k_cache, v_cache), last_tokens, lengths, rng),
+            None, length=k_steps)
+        return toks.T, cache[0], cache[1], last, rng  # toks: [B, k]
+
+    return decode_chunk
+
 
 @dataclass
 class GenerationResult:
@@ -123,7 +163,8 @@ class InferenceEngine:
         )
         self._rng = jax.random.PRNGKey(seed)
         self._compiled_prefill: dict[tuple[int, int], Callable] = {}
-        self._decode_fn = self._build_decode()
+        self._decode_fn = self._build_decode(max(1, config.decode_chunk))
+        self._decode_tail_fn: Optional[Callable] = None  # k=1, built on demand
         self.last_prefill_compile_s: float = 0.0
 
     # ------------------------------------------------------------------ jit builders
@@ -146,31 +187,10 @@ class InferenceEngine:
 
         return jax.jit(prefill, donate_argnums=(3,) if self.config.donate_cache else ())
 
-    def _build_decode(self) -> Callable:
-        """k decode steps fused into one program: scan(step) with the cache as
-        carry — one dispatch, one [B, k] readback."""
-        cfg = self.model_config
-        k_steps = max(1, self.config.decode_chunk)
-
-        def decode_chunk(params, cache, last_tokens, lengths, rng,
-                         temperature, top_p, top_k, rope):
-            def step(carry, _):
-                cache, toks, lens, rng = carry
-                hidden, cache = llama.forward(
-                    params, cfg, toks[:, None], lens[:, None], cache, lens, rope
-                )
-                logits = llama.lm_head_logits(params, cfg, hidden[:, 0, :])
-                rng, sub = jax.random.split(rng)
-                next_toks = sample_token(logits, sub, temperature, top_p, top_k)
-                return (cache, next_toks, lens + 1, rng), next_toks
-
-            (cache, _, _, rng), toks = jax.lax.scan(
-                step, (cache, last_tokens, lengths, rng), None, length=k_steps
-            )
-            return toks.T, cache, rng  # [B, k]
-
-        return jax.jit(decode_chunk,
-                       donate_argnums=(1,) if self.config.donate_cache else ())
+    def _build_decode(self, k_steps: int) -> Callable:
+        """Jit the shared fused decode body (one dispatch, one [B, k] readback)."""
+        fn = build_decode_chunk_fn(self.model_config, k_steps, self.rope_tables)
+        return jax.jit(fn, donate_argnums=(1, 2) if self.config.donate_cache else ())
 
     def _prefill_for(self, batch: int, bucket: int) -> Callable:
         key = (batch, bucket)
@@ -181,17 +201,7 @@ class InferenceEngine:
         return fn
 
     def _bucket_for(self, length: int) -> int:
-        # strict: at least one cache slot must remain for the first decode write,
-        # or dynamic_update_slice would clamp and corrupt the last KV entry
-        if length >= self.config.max_seq_len:
-            raise ValueError(
-                f"prompt length {length} leaves no decode room (max_seq_len "
-                f"{self.config.max_seq_len}; prompts must be strictly shorter)"
-            )
-        for b in self.config.buckets():
-            if length <= b:
-                return b
-        raise AssertionError("unreachable: buckets() always covers max_seq_len")
+        return self.config.bucket_for(length)
 
     # ------------------------------------------------------------------ generation
     def generate(
@@ -295,40 +305,60 @@ class InferenceEngine:
         k_steps = max(1, self.config.decode_chunk)
         steps = 0
         max_steps = max(max_new) if max_new else 0
-        while not all(done) and steps < max_steps:
-            # a chunk writes k cache slots starting at the current length; it must
-            # fit entirely (chunks are static-shaped — no partial dispatch)
-            if int(lengths_np.max()) + k_steps > self.config.max_seq_len:
-                break
-            chunk_dev, cache, self._rng = self._decode_fn(
-                self.params, cache, last_tokens, step_lengths, self._rng,
-                temperature, top_p, top_k, self.rope_tables,
+
+        def run_chunk(fn, k):
+            nonlocal cache, last_tokens, lengths_np, step_lengths, steps
+            chunk_dev, kc, vc, last, self._rng = fn(
+                self.params, cache[0], cache[1], last_tokens, step_lengths,
+                self._rng, temperature, top_p, top_k,
             )
-            lengths_np = lengths_np + k_steps
-            step_lengths = step_lengths + k_steps
-            last_tokens = chunk_dev[:, -1]
-            chunk = np.asarray(chunk_dev, np.int32)  # sync: one [B, k] readback
-            steps += k_steps
-            # after this chunk, can another one fit? if not, active rows finish
-            # with "length" on their final emitted token (single event per token)
-            last_dispatchable = (
-                int(lengths_np.max()) + k_steps > self.config.max_seq_len
-                or steps >= max_steps
-            )
-            for j in range(k_steps):
+            cache = (kc, vc)
+            last_tokens = last
+            lengths_np = lengths_np + k
+            step_lengths = step_lengths + k
+            steps += k
+            return np.asarray(chunk_dev, np.int32)  # sync: one [B, k] readback
+
+        def emit_chunk(chunk, k, next_fits):
+            # rows that can't continue finish with "length" on their final
+            # emitted token (single event per token)
+            last_dispatchable = not next_fits or steps >= max_steps
+            for j in range(k):
                 for i in range(B):
                     if done[i]:
                         continue
                     emitted[i] += 1
                     tok = int(chunk[i, j])
                     fin = classify(i, tok)
-                    if fin is None and last_dispatchable and j == k_steps - 1:
+                    if fin is None and last_dispatchable and j == k - 1:
                         fin = "length"
                     done[i] = fin is not None
                     yield StepEvent(i, tok, fin)
 
-        # epilogue: rows still active (e.g. no chunk fit after prefill) get a
-        # token-less finish event so every stream terminates with a reason
+        while not all(done) and steps < max_steps:
+            # a chunk writes k cache slots from the current length; it must fit
+            # entirely (chunks are static-shaped — no partial dispatch)
+            if int(lengths_np.max()) + k_steps > self.config.max_seq_len:
+                break
+            chunk = run_chunk(self._decode_fn, k_steps)
+            next_fits = int(lengths_np.max()) + k_steps <= self.config.max_seq_len
+            # once full chunks stop fitting, the k=1 tail decoder continues below
+            tail_will_run = (not next_fits
+                             and int(lengths_np.max()) < self.config.max_seq_len)
+            yield from emit_chunk(chunk, k_steps, next_fits or tail_will_run)
+
+        # tail: single-step decode fills the last < decode_chunk slots of the
+        # window so near-capacity prompts still decode to the brim
+        while not all(done) and steps < max_steps \
+                and int(lengths_np.max()) < self.config.max_seq_len:
+            if self._decode_tail_fn is None:
+                self._decode_tail_fn = self._build_decode(1)
+            chunk = run_chunk(self._decode_tail_fn, 1)
+            next_fits = int(lengths_np.max()) < self.config.max_seq_len
+            yield from emit_chunk(chunk, 1, next_fits)
+
+        # epilogue: any still-active row gets a token-less finish event so every
+        # stream terminates with a reason
         for i in range(B):
             if not done[i]:
                 done[i] = True

@@ -1,0 +1,106 @@
+"""Continuous batching scheduler tests (CPU backend, tiny model).
+
+Key invariants: slot reuse mid-flight, greedy parity with the lockstep engine,
+no token corruption when requests join/leave, capacity finishing.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from cyberfabric_core_tpu.runtime.engine import EngineConfig, InferenceEngine, SamplingParams
+from cyberfabric_core_tpu.runtime.scheduler import ContinuousBatchingEngine
+
+
+@pytest.fixture(scope="module")
+def engines():
+    cfg = EngineConfig(model="tiny-llama", max_seq_len=96, max_batch=3,
+                       decode_chunk=4)
+    sched = ContinuousBatchingEngine(cfg, seed=0)
+    ref = InferenceEngine(cfg, seed=0)
+    # identical params (same seed/init path)
+    yield sched, ref
+    sched.shutdown()
+
+
+def run_request(sched, prompt, sampling, timeout=120.0):
+    q: "queue.Queue" = queue.Queue()
+    done = threading.Event()
+    tokens: list[int] = []
+    finish: list[str] = []
+
+    def emit(ev):
+        if ev.token_id >= 0:
+            tokens.append(ev.token_id)
+        if ev.finished:
+            finish.append(ev.finished)
+            done.set()
+
+    sched.submit(prompt, sampling, emit)
+    assert done.wait(timeout), "request did not finish"
+    return tokens, finish[0]
+
+
+def test_single_request_matches_lockstep(engines):
+    sched, ref = engines
+    prompt = [1, 5, 9, 13]
+    sampling = SamplingParams(max_tokens=10)
+    expected = ref.generate([prompt], sampling)[0]
+    tokens, finish = run_request(sched, prompt, sampling)
+    # lockstep result drops the stop token from visible output; scheduler emits
+    # raw tokens — compare modulo trailing stop token
+    if finish == "stop":
+        tokens = tokens[:-1]
+    assert tokens == expected.token_ids
+    assert finish == expected.finish_reason
+
+
+def test_concurrent_requests_and_slot_reuse(engines):
+    sched, ref = engines
+    prompts = [[1, 5], [1, 7, 9], [2, 4, 6, 8], [3], [9, 9, 1]]
+    sampling = SamplingParams(max_tokens=6)
+    expected = [ref.generate([p], sampling)[0].token_ids for p in prompts]
+
+    results: dict[int, list[int]] = {i: [] for i in range(len(prompts))}
+    finishes: dict[int, str] = {}
+    done = threading.Event()
+    lock = threading.Lock()
+
+    def mk_emit(i):
+        def emit(ev):
+            if ev.token_id >= 0:
+                results[i].append(ev.token_id)
+            if ev.finished:
+                with lock:
+                    finishes[i] = ev.finished
+                    if len(finishes) == len(prompts):
+                        done.set()
+        return emit
+
+    # submit 5 requests into 3 slots — forces mid-flight slot reuse
+    for i, p in enumerate(prompts):
+        sched.submit(p, sampling, mk_emit(i))
+    assert done.wait(180), f"finished only {len(finishes)}/{len(prompts)}"
+
+    for i in range(len(prompts)):
+        got = results[i][:-1] if finishes[i] == "stop" else results[i]
+        assert got == expected[i], f"request {i} diverged"
+
+
+def test_capacity_finish(engines):
+    sched, _ = engines
+    long_prompt = list(range(3, 88))  # 85 tokens in a 96 window, chunk 4
+    tokens, finish = run_request(sched, long_prompt,
+                                 SamplingParams(max_tokens=500))
+    assert finish == "length"
+    assert 1 <= len(tokens) <= 96 - 85
+
+
+def test_stats(engines):
+    sched, _ = engines
+    s = sched.stats()
+    assert s["requests_completed"] >= 7
+    assert s["tokens_emitted"] > 10
+    assert s["slots"] == 3
