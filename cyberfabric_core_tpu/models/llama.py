@@ -85,8 +85,14 @@ def forward(
     cache: KVCache,
     cache_start: jnp.ndarray,  # [B] int32 — write offset (current valid length)
     rope_tables: tuple[jnp.ndarray, jnp.ndarray],
+    use_flash: bool = False,
 ) -> tuple[jnp.ndarray, KVCache]:
-    """One forward pass (prefill T>1 or decode T=1). Returns (hidden [B,T,H], cache)."""
+    """One forward pass (prefill T>1 or decode T=1). Returns (hidden [B,T,H], cache).
+
+    ``use_flash`` routes attention through the Pallas flash kernel — ONLY valid
+    for fresh-cache prefill (cache_start all zero, cache S == T): the kernel
+    attends within the new tokens, not over cache history.
+    """
     cos_t, sin_t = rope_tables
     B, T = input_ids.shape
     Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -112,10 +118,19 @@ def forward(
         k_cache_l = _insert_kv(k_cache_l, kproj, cache_start)
         v_cache_l = _insert_kv(v_cache_l, vproj, cache_start)
 
-        attn = attention_with_cache(
-            q, k_cache_l, v_cache_l, positions, kv_len_after,
-            sliding_window=cfg.sliding_window,
-        )
+        if use_flash:
+            from ..ops.flash_attention import flash_self_attention
+
+            attn = flash_self_attention(
+                q, kproj, vproj, kv_len_after,
+                interpret=jax.devices()[0].platform != "tpu",
+                sliding_window=cfg.sliding_window,
+            )
+        else:
+            attn = attention_with_cache(
+                q, k_cache_l, v_cache_l, positions, kv_len_after,
+                sliding_window=cfg.sliding_window,
+            )
         attn = attn.reshape(B, T, Hq * D)
         h = h + jnp.einsum("btd,dh->bth", attn, lp["wo"],
                            preferred_element_type=jnp.float32).astype(h.dtype)
@@ -144,6 +159,7 @@ def prefill_collect(
     input_ids: jnp.ndarray,   # [B, T]
     lengths: jnp.ndarray,     # [B]
     rope_tables: tuple[jnp.ndarray, jnp.ndarray],
+    use_flash: bool = False,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Prefill that RETURNS the new per-layer k/v instead of writing a cache.
 
@@ -157,7 +173,7 @@ def prefill_collect(
     cache = init_cache(cfg, B, T, params["embed"].dtype)
     hidden, kv = forward(
         params, cfg, input_ids, positions, cache,
-        jnp.zeros((B,), jnp.int32), rope_tables,
+        jnp.zeros((B,), jnp.int32), rope_tables, use_flash=use_flash,
     )
     last_h = gather_last_hidden(hidden, lengths)
     return last_h, kv

@@ -1,0 +1,113 @@
+"""Pallas flash-attention kernel for prefill self-attention.
+
+Blockwise causal attention: the grid walks (batch, q-head, q-block); each program
+streams its kv head's keys/values once through VMEM, computes the [BLOCK_Q, S]
+score tile in f32 on the MXU, masks (causal + length), softmaxes, and contracts
+against V. GQA is expressed in the k/v index_map (q head h reads kv head h//G) —
+no materialized head repetition in HBM.
+
+Sized for prefill windows up to ~8k: per-program VMEM is
+  q (BQ×D) + k,v (S×D each, bf16) + scores (BQ×S f32)
+e.g. BQ=256, S=4096, D=128 → 0.06 + 2×1 + 4 MB ≈ 7 MB < 16 MB VMEM.
+Longer sequences go through ring attention (parallel/ring_attention.py), which
+shards S before this kernel sees it.
+
+Decode (T=1) stays on the jnp path — it is HBM-bound on the cache read and gains
+nothing from tiling. Falls back to interpret mode off-TPU so CPU tests exercise
+the same code.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_q: int, seq_len: int,
+                  sliding_window: int | None = None):
+    """One (batch, q_head, q_block) program. Refs:
+    len_ref: [1] int32 in SMEM — valid length for this batch row
+    q_ref:   [block_q, D]; k_ref/v_ref: [S, D]; o_ref: [block_q, D]
+    """
+    qi = pl.program_id(2)
+    q = q_ref[0, 0]  # [BQ, D] (leading block dims are 1)
+    k = k_ref[0, 0]  # [S, D]
+    v = v_ref[0, 0]
+
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [BQ, S]
+    scores = scores * (1.0 / (q.shape[-1] ** 0.5))
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, seq_len), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, seq_len), 1)
+    valid_len = len_ref[0]
+    mask = (k_pos <= q_pos) & (k_pos < valid_len)
+    if sliding_window is not None:
+        mask = mask & (k_pos > q_pos - sliding_window)
+    scores = jnp.where(mask, scores, _NEG_INF)
+
+    # f32 softmax; rows past the valid length are garbage but harmlessly finite
+    m = jnp.max(scores, axis=1, keepdims=True)
+    p = jnp.exp(scores - m)
+    denom = jnp.sum(p, axis=1, keepdims=True)
+    p = p / jnp.maximum(denom, 1e-30)
+
+    o_ref[0, 0] = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret", "sliding_window"))
+def flash_self_attention(
+    q: jnp.ndarray,        # [B, T, Hq, D]
+    k: jnp.ndarray,        # [B, T, Hkv, D]
+    v: jnp.ndarray,        # [B, T, Hkv, D]
+    lengths: jnp.ndarray,  # [B] int32 valid lengths
+    block_q: int = 256,
+    interpret: bool = False,
+    sliding_window: int | None = None,
+) -> jnp.ndarray:
+    """Causal self-attention over a full prompt (prefill; no cache history)."""
+    B, T, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    bq = min(block_q, T)
+    assert T % bq == 0, f"seq len {T} must divide by block_q {bq}"
+
+    # layout: heads-major so each program reads a contiguous [T, D] tile
+    qh = q.transpose(0, 2, 1, 3)  # [B, Hq, T, D]
+    kh = k.transpose(0, 2, 1, 3)  # [B, Hkv, T, D]
+    vh = v.transpose(0, 2, 1, 3)
+
+    grid = (B, Hq, T // bq)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, block_q=bq, seq_len=T,
+                          sliding_window=sliding_window),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, h, i: (b,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h // G, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h // G, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, T, D), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), qh, kh, vh)
+    return out.transpose(0, 2, 1, 3)  # back to [B, T, Hq, D]
+
+
+def flash_available() -> bool:
+    return jax.devices()[0].platform == "tpu"

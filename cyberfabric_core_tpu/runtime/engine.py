@@ -62,6 +62,15 @@ class EngineConfig:
     #: dispatch costs ~1-70ms depending on transport; fusing k steps amortizes it
     #: k-fold. Tokens past a row's EOS within a chunk are discarded host-side.
     decode_chunk: int = 8
+    #: Pallas flash kernel for prefill attention. None = auto (on for TPU).
+    use_flash: Optional[bool] = None
+
+    def resolve_use_flash(self) -> bool:
+        if self.use_flash is not None:
+            return self.use_flash
+        import jax
+
+        return jax.devices()[0].platform == "tpu"
 
     def buckets(self) -> tuple[int, ...]:
         if self.prefill_buckets:

@@ -119,8 +119,11 @@ class ContinuousBatchingEngine:
         cfg = self.model_config
         k_steps = max(1, self.config.decode_chunk)
 
+        use_flash = self.config.resolve_use_flash()
+
         def prefill(params, ids, lengths, rng, temp, top_p, top_k, rope):
-            last_h, kv = llama.prefill_collect(params, cfg, ids, lengths, rope)
+            last_h, kv = llama.prefill_collect(params, cfg, ids, lengths, rope,
+                                               use_flash=use_flash)
             logits = llama.lm_head_logits(params, cfg, last_h)
             rng, sub = jax.random.split(rng)
             first = sample_token(logits, sub, temp, top_p, top_k)

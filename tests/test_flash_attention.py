@@ -1,0 +1,33 @@
+"""Flash-attention kernel vs jnp reference (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cyberfabric_core_tpu.ops.attention import attention_with_cache
+from cyberfabric_core_tpu.ops.flash_attention import flash_self_attention
+
+
+@pytest.mark.parametrize("B,T,Hq,Hkv,D,block_q", [
+    (2, 64, 4, 2, 32, 32),
+    (1, 128, 8, 8, 16, 64),   # MHA (G=1)
+    (2, 32, 4, 1, 16, 32),    # extreme GQA
+])
+def test_flash_matches_reference(B, T, Hq, Hkv, D, block_q):
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, T, Hq, D), jnp.float32)
+    k = jax.random.normal(kk, (B, T, Hkv, D), jnp.float32)
+    v = jax.random.normal(kv, (B, T, Hkv, D), jnp.float32)
+    lengths = jnp.asarray([T, max(1, T - 13)][:B], jnp.int32)
+
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T)).astype(jnp.int32)
+    ref = attention_with_cache(q, k, v, positions, lengths)
+    out = flash_self_attention(q, k, v, lengths, block_q=block_q, interpret=True)
+
+    # only positions < length are meaningful
+    for b in range(B):
+        L = int(lengths[b])
+        np.testing.assert_allclose(
+            np.asarray(out[b, :L]), np.asarray(ref[b, :L]), rtol=2e-5, atol=2e-5)
