@@ -1,0 +1,163 @@
+"""Out-of-process module runtime: spawn/supervise + child bootstrap.
+
+Reference:
+- libs/modkit/src/backends/local.rs:58-134 — LocalProcessBackend: spawn child,
+  SIGTERM → grace → force-kill, stdout/stderr log forwarding;
+- libs/modkit/src/bootstrap/oop.rs:28-43 — run_oop_with_options: child loads its
+  rendered config from MODKIT_MODULE_CONFIG, registers with the Directory,
+  heartbeats, deregisters on shutdown;
+- env consts (host_runtime.rs:56-59): MODKIT_MODULE_CONFIG, MODKIT_DIRECTORY_ENDPOINT.
+
+Children are `python -m cyberfabric_core_tpu.modkit.oop <module_name>` processes:
+they build the named module, serve its gRPC services on an ephemeral port, and
+announce themselves in the Directory — consumers in the host process resolve the
+endpoint and dial directly (call stack SURVEY §3.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+from dataclasses import dataclass
+from typing import Optional
+
+from .cancellation import CancellationToken
+from .transport_grpc import DirectoryClient, JsonGrpcServer
+
+logger = logging.getLogger("oop")
+
+ENV_MODULE_CONFIG = "MODKIT_MODULE_CONFIG"
+ENV_DIRECTORY_ENDPOINT = "MODKIT_DIRECTORY_ENDPOINT"
+
+
+@dataclass
+class OopProcess:
+    module_name: str
+    process: asyncio.subprocess.Process
+    log_task: Optional[asyncio.Task] = None
+
+
+class LocalProcessBackend:
+    """Spawn and supervise OoP module processes."""
+
+    def __init__(self, *, stop_grace_s: float = 5.0) -> None:
+        self.stop_grace_s = stop_grace_s
+        self.processes: list[OopProcess] = []
+
+    async def spawn(self, module_name: str, directory_endpoint: str,
+                    module_config: Optional[dict] = None,
+                    extra_env: Optional[dict] = None) -> OopProcess:
+        env = dict(os.environ)
+        env[ENV_MODULE_CONFIG] = json.dumps(module_config or {})
+        env[ENV_DIRECTORY_ENDPOINT] = directory_endpoint
+        env.update(extra_env or {})
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "cyberfabric_core_tpu.modkit.oop", module_name,
+            env=env,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+        )
+
+        async def forward_logs() -> None:
+            # log forwarder (backends/log_forwarder.rs): child lines -> host log
+            assert proc.stdout is not None
+            async for line in proc.stdout:
+                logger.info("[oop:%s] %s", module_name, line.decode().rstrip())
+
+        entry = OopProcess(module_name, proc, asyncio.ensure_future(forward_logs()))
+        self.processes.append(entry)
+        logger.info("spawned oop module %s (pid %d)", module_name, proc.pid)
+        return entry
+
+    async def stop_all(self) -> None:
+        """SIGTERM → grace → SIGKILL, reverse spawn order (local.rs:58-134)."""
+        for entry in reversed(self.processes):
+            proc = entry.process
+            if proc.returncode is None:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    await asyncio.wait_for(proc.wait(), self.stop_grace_s)
+                except asyncio.TimeoutError:
+                    logger.warning("oop %s ignored SIGTERM; killing", entry.module_name)
+                    proc.kill()
+                    await proc.wait()
+            if entry.log_task is not None:
+                entry.log_task.cancel()
+        self.processes.clear()
+
+
+async def run_oop_module(module_name: str) -> None:
+    """Child-side bootstrap (run_oop_with_options parity).
+
+    Builds the module, lets it register gRPC services, serves them, registers in
+    the Directory, heartbeats until SIGTERM, then deregisters.
+    """
+    logging.basicConfig(level=logging.INFO,
+                       format=f"%(levelname)-7s {module_name}: %(message)s")
+    config = json.loads(os.environ.get(ENV_MODULE_CONFIG, "{}"))
+    directory_endpoint = os.environ[ENV_DIRECTORY_ENDPOINT]
+
+    from .client_hub import ClientHub
+    from .config import AppConfig
+    from .context import ModuleCtx
+    from .registry import ModuleRegistry
+
+    # import module definitions (inventory side effects)
+    import cyberfabric_core_tpu.modules  # noqa: F401
+
+    token = CancellationToken()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, token.cancel)
+
+    registry = ModuleRegistry.discover_and_build(enabled=[module_name])
+    app_config = AppConfig.load_or_default(
+        cli_overrides={"modules": {module_name: {"config": config}}})
+    hub = ClientHub()
+    server = JsonGrpcServer()
+
+    target = registry.get(module_name)
+    ctx = ModuleCtx(module_name=module_name, app_config=app_config,
+                    client_hub=hub, cancellation_token=token)
+    await target.instance.init(ctx)
+    if hasattr(target.instance, "register_grpc"):
+        target.instance.register_grpc(ctx, server)
+
+    port = await server.start("127.0.0.1:0")
+    endpoint = f"127.0.0.1:{port}"
+    directory = DirectoryClient(directory_endpoint)
+    instance_id = await directory.register(
+        service_name=f"module.{module_name}", endpoint=endpoint,
+        module_name=module_name)
+    logger.info("oop %s serving at %s (instance %s)", module_name, endpoint, instance_id)
+
+    try:
+        while not token.is_cancelled:
+            await token.run_until_cancelled(asyncio.sleep(3.0))
+            if token.is_cancelled:
+                break
+            await directory.heartbeat(instance_id)
+    finally:
+        try:
+            await directory.deregister(instance_id)
+        except Exception:  # noqa: BLE001 — the hub may already be gone
+            pass
+        await directory.close()
+        await server.stop()
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print("usage: python -m cyberfabric_core_tpu.modkit.oop <module_name>",
+              file=sys.stderr)
+        return 2
+    asyncio.run(run_oop_module(sys.argv[1]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -132,9 +132,20 @@ class HostRuntime:
         host.instance.rest_finalize(self.ctx_for(host), router, openapi)
 
     async def run_grpc_phase(self) -> None:
+        """Collect gRPC installers; in-process modules install into the hub's
+        server right away, OoP-configured ones install in their own process."""
+        try:
+            from .transport_grpc import JsonGrpcServer
+
+            server = self.hub.try_get(JsonGrpcServer)
+        except ImportError:  # grpc not available in this environment
+            server = None
         for entry in self.registry.with_capability("grpc"):
             assert isinstance(entry.instance, GrpcServiceCapability)
             self.grpc_installers.append((entry.name, entry.instance))
+            is_oop = self.config.module_entry(entry.name).get("runtime") == "oop"
+            if server is not None and not is_oop:
+                entry.instance.register_grpc(self.ctx_for(entry), server)
 
     async def run_start_phase(self) -> None:
         """Start runnables, system modules first (host_runtime.rs:521)."""
@@ -168,8 +179,37 @@ class HostRuntime:
         except Exception:
             logger.exception("module %s failed to stop after failed start", entry.name)
 
+    async def run_oop_spawn_phase(self) -> None:
+        """Spawn modules configured with ``runtime: oop`` as child processes
+        (host_runtime.rs:577; the process boundary is crossed here). Requires the
+        grpc_hub module for directory registration."""
+        oop_modules = [
+            name for name in self.config.module_names()
+            if (self.config.module_entry(name).get("runtime") == "oop")
+        ]
+        if not oop_modules:
+            return
+        from .oop import LocalProcessBackend
+
+        endpoint = None
+        for entry in self.registry.entries:
+            if entry.name == "grpc_hub":
+                endpoint = getattr(entry.instance, "endpoint", None)
+        if endpoint is None:
+            raise RuntimeError(
+                f"modules {oop_modules} configured runtime=oop but grpc_hub is "
+                "not running (no directory endpoint)")
+        self.oop_backend = LocalProcessBackend()
+        for name in oop_modules:
+            await self.oop_backend.spawn(
+                name, endpoint, module_config=self.config.module_config(name))
+
     async def run_stop_phase(self) -> None:
-        """Stop in reverse start order (host_runtime.rs:563)."""
+        """Stop in reverse start order; OoP children first (host_runtime.rs:563)."""
+        backend = getattr(self, "oop_backend", None)
+        if backend is not None:
+            await backend.stop_all()
+            self.oop_backend = None
         for entry in reversed(self._started):
             assert isinstance(entry.instance, RunnableCapability)
             try:
@@ -188,6 +228,7 @@ class HostRuntime:
         await self.run_rest_phase()
         await self.run_grpc_phase()
         await self.run_start_phase()
+        await self.run_oop_spawn_phase()
 
     async def run_module_phases(self) -> None:
         """Full lifecycle: setup → wait for cancellation → stop

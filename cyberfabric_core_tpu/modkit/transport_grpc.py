@@ -1,0 +1,275 @@
+"""gRPC transport utilities — the modkit-transport-grpc equivalent.
+
+Reference: libs/modkit-transport-grpc/src/ (connect_with_stack client.rs:180,
+connect_with_retry :239, rpc retry layer rpc_retry.rs, tracing interceptors) and
+proto/directory/v1/directory.proto (DirectoryService: Register/Deregister/
+Heartbeat/ResolveGrpcService/ListInstances).
+
+Wire format: JSON-over-gRPC with dynamically registered generic method handlers
+(no protoc codegen in this environment — grpc_tools is absent; the method
+*surface* mirrors the reference proto 1:1 and payloads are schema-checked
+JSON, so swapping in protobuf stubs later is a serializer change, not an API
+change). All servers/clients are grpc.aio (asyncio-native, matching the host).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Optional
+
+import grpc
+from grpc import aio as grpc_aio
+
+logger = logging.getLogger("transport_grpc")
+
+Handler = Callable[[dict], Awaitable[dict]]
+
+
+def _ser(obj: dict) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+def _de(data: bytes) -> dict:
+    return json.loads(data.decode()) if data else {}
+
+
+class JsonGrpcServer:
+    """grpc.aio server hosting JSON-unary services registered at runtime."""
+
+    def __init__(self) -> None:
+        self._services: dict[str, dict[str, Handler]] = {}
+        self._server: Optional[grpc_aio.Server] = None
+        self.bound_port: Optional[int] = None
+
+    def add_service(self, service_name: str, methods: dict[str, Handler]) -> None:
+        self._services.setdefault(service_name, {}).update(methods)
+
+    def _build(self) -> grpc_aio.Server:
+        server = grpc_aio.server()
+        for service_name, methods in self._services.items():
+            handlers = {}
+            for method_name, fn in methods.items():
+                async def unary(request: bytes, context, _fn=fn) -> bytes:
+                    try:
+                        return _ser(await _fn(_de(request)))
+                    except KeyError as e:
+                        await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+                    except ValueError as e:
+                        await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                    except Exception as e:  # noqa: BLE001
+                        logger.exception("rpc %s/%s failed", service_name, method_name)
+                        await context.abort(grpc.StatusCode.INTERNAL, str(e)[:300])
+
+                handlers[method_name] = grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                )
+            server.add_generic_rpc_handlers(
+                (grpc.method_handlers_generic_handler(service_name, handlers),)
+            )
+        return server
+
+    async def start(self, bind_addr: str = "127.0.0.1:0") -> int:
+        self._server = self._build()
+        self.bound_port = self._server.add_insecure_port(bind_addr)
+        if self.bound_port == 0:
+            raise RuntimeError(f"failed to bind gRPC on {bind_addr}")
+        await self._server.start()
+        return self.bound_port
+
+    async def stop(self, grace: float = 3.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None
+
+
+@dataclass
+class GrpcClientConfig:
+    """Connect/call policy (GrpcClientConfig, client.rs:30-113)."""
+
+    connect_timeout_s: float = 5.0
+    call_timeout_s: float = 30.0
+    max_retries: int = 3
+    retry_backoff_s: float = 0.1
+    backoff_multiplier: float = 2.0
+
+
+class JsonGrpcClient:
+    """Channel + unary-call helper with retry/backoff (connect_with_retry,
+    rpc_retry.rs semantics: retry UNAVAILABLE/DEADLINE_EXCEEDED with backoff)."""
+
+    _RETRYABLE = {grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED}
+
+    def __init__(self, target: str, config: Optional[GrpcClientConfig] = None) -> None:
+        self.target = target
+        self.config = config or GrpcClientConfig()
+        self._channel: Optional[grpc_aio.Channel] = None
+
+    async def _ensure_channel(self) -> grpc_aio.Channel:
+        if self._channel is None:
+            self._channel = grpc_aio.insecure_channel(self.target)
+        return self._channel
+
+    async def call(self, service: str, method: str, payload: dict) -> dict:
+        channel = await self._ensure_channel()
+        rpc = channel.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        delay = self.config.retry_backoff_s
+        last: Optional[grpc_aio.AioRpcError] = None
+        for attempt in range(self.config.max_retries + 1):
+            try:
+                resp = await rpc(_ser(payload), timeout=self.config.call_timeout_s)
+                return _de(resp)
+            except grpc_aio.AioRpcError as e:
+                if e.code() not in self._RETRYABLE or attempt == self.config.max_retries:
+                    raise
+                last = e
+                await asyncio.sleep(delay)
+                delay *= self.config.backoff_multiplier
+        raise last  # pragma: no cover
+
+    async def close(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None
+
+
+# --------------------------------------------------------------------- directory
+DIRECTORY_SERVICE = "directory.v1.DirectoryService"
+
+
+@dataclass
+class ServiceInstance:
+    """RegisterInstanceInfo/ServiceEndpoint analogue (libs/modkit/src/directory.rs)."""
+
+    instance_id: str
+    service_name: str
+    endpoint: str               # host:port
+    module_name: str = ""
+    registered_at: float = field(default_factory=time.time)
+    last_heartbeat: float = field(default_factory=time.time)
+
+    def to_dict(self) -> dict:
+        return {
+            "instance_id": self.instance_id, "service_name": self.service_name,
+            "endpoint": self.endpoint, "module_name": self.module_name,
+        }
+
+
+class DirectoryService:
+    """Service-discovery state machine: register/resolve/heartbeat/deregister,
+    stale-instance eviction (heartbeat TTL)."""
+
+    def __init__(self, heartbeat_ttl_s: float = 15.0) -> None:
+        self.ttl = heartbeat_ttl_s
+        self._instances: dict[str, ServiceInstance] = {}
+
+    # domain ops ----------------------------------------------------------
+    def register(self, info: dict) -> dict:
+        instance_id = info.get("instance_id") or str(uuid.uuid4())
+        inst = ServiceInstance(
+            instance_id=instance_id,
+            service_name=info["service_name"],
+            endpoint=info["endpoint"],
+            module_name=info.get("module_name", ""),
+        )
+        self._instances[instance_id] = inst
+        logger.info("directory: registered %s at %s", inst.service_name, inst.endpoint)
+        return {"instance_id": instance_id}
+
+    def deregister(self, instance_id: str) -> bool:
+        return self._instances.pop(instance_id, None) is not None
+
+    def heartbeat(self, instance_id: str) -> bool:
+        inst = self._instances.get(instance_id)
+        if inst is None:
+            return False
+        inst.last_heartbeat = time.time()
+        return True
+
+    def resolve(self, service_name: str) -> Optional[ServiceInstance]:
+        cutoff = time.time() - self.ttl
+        alive = [i for i in self._instances.values()
+                 if i.service_name == service_name and i.last_heartbeat >= cutoff]
+        return alive[0] if alive else None
+
+    def list_instances(self) -> list[ServiceInstance]:
+        return list(self._instances.values())
+
+    def evict_stale(self) -> int:
+        cutoff = time.time() - self.ttl
+        stale = [k for k, v in self._instances.items() if v.last_heartbeat < cutoff]
+        for k in stale:
+            inst = self._instances.pop(k)
+            logger.warning("directory: evicted stale %s (%s)",
+                           inst.service_name, inst.endpoint)
+        return len(stale)
+
+    # rpc handlers (proto surface parity) ---------------------------------
+    def rpc_handlers(self) -> dict[str, Handler]:
+        async def register(req: dict) -> dict:
+            return self.register(req)
+
+        async def deregister(req: dict) -> dict:
+            return {"ok": self.deregister(req["instance_id"])}
+
+        async def heartbeat(req: dict) -> dict:
+            return {"ok": self.heartbeat(req["instance_id"])}
+
+        async def resolve(req: dict) -> dict:
+            inst = self.resolve(req["service_name"])
+            if inst is None:
+                raise KeyError(f"no live instance of {req['service_name']}")
+            return inst.to_dict()
+
+        async def list_instances(req: dict) -> dict:
+            return {"instances": [i.to_dict() for i in self.list_instances()]}
+
+        return {
+            "RegisterInstance": register,
+            "DeregisterInstance": deregister,
+            "Heartbeat": heartbeat,
+            "ResolveGrpcService": resolve,
+            "ListInstances": list_instances,
+        }
+
+
+class DirectoryClient:
+    """gRPC-side directory client (the LocalDirectoryClient counterpart is the
+    DirectoryService object itself, used in-process)."""
+
+    def __init__(self, endpoint: str) -> None:
+        self._client = JsonGrpcClient(endpoint)
+
+    async def register(self, service_name: str, endpoint: str,
+                       module_name: str = "", instance_id: Optional[str] = None) -> str:
+        resp = await self._client.call(DIRECTORY_SERVICE, "RegisterInstance", {
+            "service_name": service_name, "endpoint": endpoint,
+            "module_name": module_name, "instance_id": instance_id})
+        return resp["instance_id"]
+
+    async def deregister(self, instance_id: str) -> bool:
+        resp = await self._client.call(DIRECTORY_SERVICE, "DeregisterInstance",
+                                       {"instance_id": instance_id})
+        return resp["ok"]
+
+    async def heartbeat(self, instance_id: str) -> bool:
+        resp = await self._client.call(DIRECTORY_SERVICE, "Heartbeat",
+                                       {"instance_id": instance_id})
+        return resp["ok"]
+
+    async def resolve(self, service_name: str) -> dict:
+        return await self._client.call(DIRECTORY_SERVICE, "ResolveGrpcService",
+                                       {"service_name": service_name})
+
+    async def close(self) -> None:
+        await self._client.close()

@@ -20,3 +20,5 @@ from .serverless_runtime import ServerlessRuntimeModule  # noqa: F401
 from .file_parser import FileParserModule  # noqa: F401
 from .nodes_registry import NodesRegistryModule  # noqa: F401
 from .module_orchestrator import ModuleOrchestratorModule  # noqa: F401
+from .grpc_hub import GrpcHubModule  # noqa: F401
+from .calculator import CalculatorModule  # noqa: F401

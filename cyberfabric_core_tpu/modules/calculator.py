@@ -1,0 +1,84 @@
+"""Calculator — the OoP pattern exemplar.
+
+Reference: examples/oop-modules/calculator (a module with a gRPC service + an OoP
+binary, and a gateway module consuming it via ClientHub; SURVEY §2.5). This module
+can run in-process (local client registered directly) or out-of-process (spawned
+via LocalProcessBackend; the host resolves its endpoint through the Directory and
+talks JSON-gRPC) — the consumer can't tell the difference, which is the whole
+ClientHub transparency contract (ARCHITECTURE_MANIFEST.md:130-137).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+from ..modkit import Module, module
+from ..modkit.contracts import GrpcServiceCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.transport_grpc import DirectoryService, JsonGrpcClient
+
+CALCULATOR_SERVICE = "module.calculator"
+
+
+class CalculatorApi(abc.ABC):
+    @abc.abstractmethod
+    async def add(self, a: float, b: float) -> float: ...
+
+    @abc.abstractmethod
+    async def mul(self, a: float, b: float) -> float: ...
+
+
+class LocalCalculator(CalculatorApi):
+    async def add(self, a: float, b: float) -> float:
+        return a + b
+
+    async def mul(self, a: float, b: float) -> float:
+        return a * b
+
+
+class GrpcCalculatorClient(CalculatorApi):
+    """SDK-style gRPC client (the wiring.rs pattern): resolves the service
+    endpoint through the directory lazily, then dials it directly."""
+
+    def __init__(self, directory: DirectoryService) -> None:
+        self._directory = directory
+        self._client: Optional[JsonGrpcClient] = None
+
+    async def _ensure(self) -> JsonGrpcClient:
+        if self._client is None:
+            inst = self._directory.resolve(CALCULATOR_SERVICE)
+            if inst is None:
+                raise ConnectionError(f"no live instance of {CALCULATOR_SERVICE}")
+            self._client = JsonGrpcClient(inst.endpoint)
+        return self._client
+
+    async def add(self, a: float, b: float) -> float:
+        client = await self._ensure()
+        return (await client.call(CALCULATOR_SERVICE, "Add", {"a": a, "b": b}))["result"]
+
+    async def mul(self, a: float, b: float) -> float:
+        client = await self._ensure()
+        return (await client.call(CALCULATOR_SERVICE, "Mul", {"a": a, "b": b}))["result"]
+
+
+@module(name="calculator", capabilities=["grpc"])
+class CalculatorModule(Module, GrpcServiceCapability):
+    def __init__(self) -> None:
+        self.service = LocalCalculator()
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        # in-process mode: register the local client directly
+        if ctx.app_config.module_entry("calculator").get("runtime") != "oop":
+            ctx.client_hub.register(CalculatorApi, self.service)
+
+    def register_grpc(self, ctx: ModuleCtx, server: Any) -> None:
+        svc = self.service
+
+        async def add(req: dict) -> dict:
+            return {"result": await svc.add(float(req["a"]), float(req["b"]))}
+
+        async def mul(req: dict) -> dict:
+            return {"result": await svc.mul(float(req["a"]), float(req["b"]))}
+
+        server.add_service(CALCULATOR_SERVICE, {"Add": add, "Mul": mul})

@@ -1,0 +1,69 @@
+"""grpc-hub — the single gRPC host: one server for all modules' services, hosting
+the DirectoryService.
+
+Reference: modules/system/grpc-hub/src/module.rs (GrpcHubConfig :36-56, exactly one
+tonic Server per process, directory deregistration on shutdown :277-299) +
+run_grpc_phase collecting GrpcServiceCapability installers
+(host_runtime.rs:449-516).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Optional
+
+from ..modkit import Module, ReadySignal, module
+from ..modkit.contracts import RunnableCapability, SystemCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.transport_grpc import (
+    DIRECTORY_SERVICE,
+    DirectoryService,
+    JsonGrpcServer,
+)
+
+
+@dataclass
+class GrpcHubConfig:
+    bind_addr: str = "127.0.0.1:0"
+    heartbeat_ttl_s: float = 15.0
+    eviction_interval_s: float = 5.0
+
+
+@module(name="grpc_hub", capabilities=["system", "stateful"])
+class GrpcHubModule(Module, SystemCapability, RunnableCapability):
+    def __init__(self) -> None:
+        self.server = JsonGrpcServer()
+        self.directory = DirectoryService()
+        self.config = GrpcHubConfig()
+        self.bound_port: Optional[int] = None
+        self._evict_task: Optional[asyncio.Task] = None
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        raw = ctx.raw_config()
+        self.config = GrpcHubConfig(**raw) if raw else GrpcHubConfig()
+        self.directory.ttl = self.config.heartbeat_ttl_s
+        self.server.add_service(DIRECTORY_SERVICE, self.directory.rpc_handlers())
+        # expose for other modules: in-process directory + service registration
+        ctx.client_hub.register(DirectoryService, self.directory)
+        ctx.client_hub.register(JsonGrpcServer, self.server)
+
+    async def start(self, ctx: ModuleCtx, ready: ReadySignal) -> None:
+        self.bound_port = await self.server.start(self.config.bind_addr)
+        # OoP children find the directory through this endpoint
+        host = self.config.bind_addr.rsplit(":", 1)[0] or "127.0.0.1"
+        self.endpoint = f"{host}:{self.bound_port}"
+        ctx.system["directory_endpoint"] = self.endpoint
+
+        async def evict_loop() -> None:
+            while not ctx.cancellation_token.is_cancelled:
+                await asyncio.sleep(self.config.eviction_interval_s)
+                self.directory.evict_stale()
+
+        self._evict_task = asyncio.ensure_future(evict_loop())
+        ready.notify_ready()
+
+    async def stop(self, ctx: ModuleCtx) -> None:
+        if self._evict_task is not None:
+            self._evict_task.cancel()
+        await self.server.stop()

@@ -1,0 +1,173 @@
+"""gRPC hub, DirectoryService, and real out-of-process module tests.
+
+Reference analogue: libs/modkit/src/bootstrap/oop_tests.rs (807 LoC) + the
+calculator OoP example. The OoP test spawns a REAL child python process,
+exercises discovery + heartbeat + RPC + SIGTERM shutdown end-to-end.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from cyberfabric_core_tpu.modkit.transport_grpc import (
+    DIRECTORY_SERVICE,
+    DirectoryClient,
+    DirectoryService,
+    JsonGrpcClient,
+    JsonGrpcServer,
+)
+
+
+def test_directory_state_machine():
+    d = DirectoryService(heartbeat_ttl_s=0.2)
+    iid = d.register({"service_name": "svc.a", "endpoint": "127.0.0.1:1"})["instance_id"]
+    assert d.resolve("svc.a").endpoint == "127.0.0.1:1"
+    assert d.resolve("svc.missing") is None
+    assert d.heartbeat(iid)
+    # stale eviction after TTL
+    time.sleep(0.25)
+    assert d.resolve("svc.a") is None  # resolve filters stale
+    assert d.evict_stale() == 1
+    assert not d.heartbeat(iid)
+    assert not d.deregister(iid)
+
+
+def test_json_grpc_roundtrip_and_errors():
+    async def go():
+        server = JsonGrpcServer()
+
+        async def echo(req):
+            return {"echo": req}
+
+        async def explode(req):
+            raise RuntimeError("kaboom")
+
+        async def missing(req):
+            raise KeyError("nothing here")
+
+        server.add_service("test.Svc", {"Echo": echo, "Explode": explode,
+                                        "Missing": missing})
+        port = await server.start("127.0.0.1:0")
+        client = JsonGrpcClient(f"127.0.0.1:{port}")
+        try:
+            out = await client.call("test.Svc", "Echo", {"x": 1})
+            assert out == {"echo": {"x": 1}}
+            import grpc
+
+            with pytest.raises(grpc.aio.AioRpcError) as e:
+                await client.call("test.Svc", "Explode", {})
+            assert e.value.code() == grpc.StatusCode.INTERNAL
+            with pytest.raises(grpc.aio.AioRpcError) as e:
+                await client.call("test.Svc", "Missing", {})
+            assert e.value.code() == grpc.StatusCode.NOT_FOUND
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(go())
+
+
+def test_grpc_client_retries_unavailable():
+    async def go():
+        from cyberfabric_core_tpu.modkit.transport_grpc import GrpcClientConfig
+
+        # nothing listening: UNAVAILABLE, retried, then raised
+        client = JsonGrpcClient("127.0.0.1:1", GrpcClientConfig(
+            max_retries=2, retry_backoff_s=0.01, call_timeout_s=0.5))
+        import grpc
+
+        t0 = time.monotonic()
+        with pytest.raises(grpc.aio.AioRpcError):
+            await client.call("x.Y", "Z", {})
+        assert time.monotonic() - t0 >= 0.02  # at least two backoffs
+        await client.close()
+
+    asyncio.run(go())
+
+
+def test_oop_module_end_to_end():
+    """Spawn the calculator as a REAL child process; call it over gRPC via
+    directory resolution; verify heartbeat + graceful shutdown + deregistration."""
+
+    async def go():
+        from cyberfabric_core_tpu.modkit.oop import LocalProcessBackend
+        from cyberfabric_core_tpu.modules.calculator import (
+            CALCULATOR_SERVICE,
+            GrpcCalculatorClient,
+        )
+
+        # host side: hub server with directory
+        directory = DirectoryService(heartbeat_ttl_s=10.0)
+        server = JsonGrpcServer()
+        server.add_service(DIRECTORY_SERVICE, directory.rpc_handlers())
+        port = await server.start("127.0.0.1:0")
+
+        backend = LocalProcessBackend(stop_grace_s=5.0)
+        env = dict(PYTHONPATH=f"/root/repo:{os.environ.get('PYTHONPATH', '')}")
+        env["JAX_PLATFORMS"] = "cpu"
+        await backend.spawn("calculator", f"127.0.0.1:{port}", extra_env=env)
+
+        # wait for registration (child boots python + registers)
+        for _ in range(100):
+            if directory.resolve(CALCULATOR_SERVICE) is not None:
+                break
+            await asyncio.sleep(0.2)
+        inst = directory.resolve(CALCULATOR_SERVICE)
+        assert inst is not None, "child never registered"
+
+        client = GrpcCalculatorClient(directory)
+        assert await client.add(2, 3) == 5.0
+        assert await client.mul(4, 2.5) == 10.0
+
+        # graceful shutdown: SIGTERM -> child deregisters before exiting
+        await backend.stop_all()
+        for _ in range(50):
+            if directory.resolve(CALCULATOR_SERVICE) is None:
+                break
+            await asyncio.sleep(0.1)
+        assert directory.resolve(CALCULATOR_SERVICE) is None, "child did not deregister"
+        await server.stop()
+
+    asyncio.run(go())
+
+
+def test_host_runtime_spawns_oop_module():
+    """Full host: grpc_hub + calculator with runtime: oop — the host spawns the
+    child in the oop phase and tears it down in the stop phase."""
+
+    async def go():
+        import os
+
+        from cyberfabric_core_tpu.modkit import AppConfig, ClientHub, ModuleRegistry, RunOptions
+        from cyberfabric_core_tpu.modkit.runtime import HostRuntime
+        from cyberfabric_core_tpu.modules.calculator import (
+            CALCULATOR_SERVICE,
+            GrpcCalculatorClient,
+        )
+        import cyberfabric_core_tpu.modules  # noqa: F401
+
+        os.environ.setdefault("PYTHONPATH", "/root/repo")
+        cfg = AppConfig.load_or_default(environ={}, cli_overrides={"modules": {
+            "grpc_hub": {},
+            "calculator": {"runtime": "oop"},
+        }})
+        registry = ModuleRegistry.discover_and_build(enabled=["grpc_hub", "calculator"])
+        rt = HostRuntime(RunOptions(config=cfg, registry=registry,
+                                    client_hub=ClientHub()))
+        await rt.run_setup_phases()
+        try:
+            directory = rt.registry.get("grpc_hub").instance.directory
+            for _ in range(100):
+                if directory.resolve(CALCULATOR_SERVICE) is not None:
+                    break
+                await asyncio.sleep(0.2)
+            assert directory.resolve(CALCULATOR_SERVICE) is not None
+            client = GrpcCalculatorClient(directory)
+            assert await client.add(20, 22) == 42.0
+        finally:
+            rt.root_token.cancel()
+            await rt.run_stop_phase()
+
+    asyncio.run(go())
