@@ -255,7 +255,10 @@ def build_middlewares(
             # fail CLOSED: only the builtin public endpoints may run without a
             # matched OperationSpec (auth.rs public-route matchers :31,120-127);
             # anything else without a spec is a routing bug or a 404 probe
-            if request.path in BUILTIN_PUBLIC_PATHS or auth_disabled:
+            if request.path in BUILTIN_PUBLIC_PATHS:
+                return await handler(request)
+            if auth_disabled:
+                request[SECURITY_CONTEXT_KEY] = SecurityContext.anonymous(default_tenant)
                 return await handler(request)
             raise ProblemError.unauthorized("no route policy for this path")
         if spec.auth == AuthPolicy.PUBLIC:

@@ -83,6 +83,8 @@ class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemC
         self.router_specs = list(router.operations)
         self.openapi_doc = openapi.build(router)
 
+        import re as _re
+
         spec_by_key: dict[tuple[str, str], OperationSpec] = {}
         app_routes: list[web.RouteDef] = []
         for spec in router.operations:
@@ -91,7 +93,9 @@ class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemC
                     rps=cfg.default_rps, burst=cfg.default_burst,
                     max_in_flight=cfg.default_in_flight,
                 )
-            spec_by_key[(spec.method, spec.path)] = spec
+            # aiohttp's canonical form strips regex qualifiers: {tail:.*} -> {tail}
+            canonical = _re.sub(r"\{(\w+):[^}]*\}", r"{\1}", spec.path)
+            spec_by_key[(spec.method, canonical)] = spec
             app_routes.append(
                 web.route(spec.method, spec.path, _wrap_handler(spec))
             )

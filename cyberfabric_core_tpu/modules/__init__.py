@@ -22,3 +22,4 @@ from .nodes_registry import NodesRegistryModule  # noqa: F401
 from .module_orchestrator import ModuleOrchestratorModule  # noqa: F401
 from .grpc_hub import GrpcHubModule  # noqa: F401
 from .calculator import CalculatorModule  # noqa: F401
+from .oagw import OagwModule  # noqa: F401

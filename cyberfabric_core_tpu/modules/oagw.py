@@ -1,0 +1,345 @@
+"""OAGW — Outbound API Gateway: control plane + data-plane proxy.
+
+Reference: modules/system/oagw/ (15.6k LoC — the largest system module) with the
+CP/DP trait split (docs/adr-component-architecture.md:28-56):
+
+- **control plane**: tenant-scoped upstream + route CRUD (sqlite via SecureConn);
+  upstream auth references credstore secrets, never inline values;
+- **data plane**: proxy with route resolution, credential injection, header
+  hygiene (hop-by-hop + inbound auth stripped), per-upstream **token-bucket rate
+  limiting** (<1 ms check budget — adr-rate-limiting.md:22-52) and a classic
+  **circuit breaker** CLOSED →(failures)→ OPEN →(timeout)→ HALF-OPEN, OPEN
+  rejecting with 503 CircuitBreakerOpen (adr-circuit-breaker.md:34-49);
+  streaming passthrough (SSE included);
+- **SSE parser** for provider-side streams (oagw-sdk/src/sse/parse.rs:1-60).
+
+The same breaker/limiter machinery guards TPU workers (SURVEY §8.8).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional
+
+import aiohttp
+from aiohttp import web
+
+from ..modkit import Module, module
+from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.db import ScopableEntity
+from ..modkit.errors import Problem, ProblemError
+from ..modkit.security import SecurityContext
+from ..gateway.middleware import SECURITY_CONTEXT_KEY
+from ..gateway.validation import read_json
+from .sdk import CredStoreApi
+
+UPSTREAMS = ScopableEntity(
+    table="upstreams",
+    field_map={"id": "id", "tenant_id": "tenant_id", "slug": "slug",
+               "base_url": "base_url", "auth": "auth", "rate_limit": "rate_limit",
+               "circuit_breaker": "circuit_breaker", "enabled": "enabled"},
+    json_cols=("auth", "rate_limit", "circuit_breaker"),
+)
+
+_MIGRATIONS = [
+    Migration("0001_oagw", lambda c: c.execute(
+        "CREATE TABLE upstreams (id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, "
+        "slug TEXT NOT NULL, base_url TEXT NOT NULL, auth TEXT, rate_limit TEXT, "
+        "circuit_breaker TEXT, enabled INTEGER DEFAULT 1, "
+        "UNIQUE (tenant_id, slug))"
+    )),
+]
+
+#: hop-by-hop + inbound-auth headers never forwarded (header hygiene,
+#: infra/proxy/headers.rs)
+_STRIP_REQUEST_HEADERS = {
+    "host", "connection", "keep-alive", "proxy-authenticate",
+    "proxy-authorization", "te", "trailer", "transfer-encoding", "upgrade",
+    "authorization", "cookie", "x-request-id", "content-length",
+}
+_STRIP_RESPONSE_HEADERS = {
+    "connection", "keep-alive", "transfer-encoding", "content-encoding",
+    "content-length", "trailer", "upgrade",
+}
+
+
+class CircuitBreaker:
+    """CLOSED →(failure_threshold)→ OPEN →(open_timeout)→ HALF-OPEN →(probe)."""
+
+    def __init__(self, failure_threshold: int = 5, open_timeout_s: float = 30.0,
+                 half_open_max_probes: int = 1) -> None:
+        self.failure_threshold = failure_threshold
+        self.open_timeout_s = open_timeout_s
+        self.half_open_max_probes = half_open_max_probes
+        self.state = "closed"
+        self.failures = 0
+        self.opened_at = 0.0
+        self._probes = 0
+
+    def allow(self) -> bool:
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            if time.monotonic() - self.opened_at >= self.open_timeout_s:
+                self.state = "half_open"
+                self._probes = 0
+            else:
+                return False
+        if self.state == "half_open":
+            if self._probes < self.half_open_max_probes:
+                self._probes += 1
+                return True
+            return False
+        return True
+
+    def record_success(self) -> None:
+        self.state = "closed"
+        self.failures = 0
+
+    def record_failure(self) -> None:
+        if self.state == "half_open":
+            self._trip()
+            return
+        self.failures += 1
+        if self.failures >= self.failure_threshold:
+            self._trip()
+
+    def _trip(self) -> None:
+        self.state = "open"
+        self.opened_at = time.monotonic()
+
+
+class _TokenBucket:
+    def __init__(self, rps: float, burst: int) -> None:
+        self.rate, self.capacity = rps, float(max(1, burst))
+        self.tokens, self.last = self.capacity, time.monotonic()
+
+    def try_acquire(self) -> bool:
+        now = time.monotonic()
+        self.tokens = min(self.capacity, self.tokens + (now - self.last) * self.rate)
+        self.last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+def parse_sse_stream(chunks: AsyncIterator[bytes]) -> AsyncIterator[dict]:
+    """Incremental SSE parser (oagw-sdk/src/sse/parse.rs:1-60): yields
+    {event?, data, id?} dicts; handles multi-line data and CRLF."""
+
+    async def gen():
+        buf = b""
+        async for chunk in chunks:
+            buf += chunk
+            while b"\n\n" in buf or b"\r\n\r\n" in buf:
+                sep = b"\r\n\r\n" if b"\r\n\r\n" in buf.split(b"\n\n")[0] else b"\n\n"
+                frame, buf = buf.split(sep, 1)
+                event: dict[str, Any] = {}
+                data_lines = []
+                for line in frame.replace(b"\r\n", b"\n").split(b"\n"):
+                    if line.startswith(b":"):
+                        continue  # comment/keep-alive
+                    if b":" in line:
+                        k, v = line.split(b":", 1)
+                        v = v[1:] if v.startswith(b" ") else v
+                    else:
+                        k, v = line, b""
+                    k = k.decode()
+                    if k == "data":
+                        data_lines.append(v.decode())
+                    elif k in ("event", "id"):
+                        event[k] = v.decode()
+                if data_lines:
+                    event["data"] = "\n".join(data_lines)
+                if event:
+                    yield event
+
+    return gen()
+
+
+class OagwService:
+    def __init__(self, ctx: ModuleCtx) -> None:
+        self._db = ctx.db_required()
+        self._credstore: Optional[CredStoreApi] = ctx.client_hub.try_get(CredStoreApi)
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._buckets: dict[str, _TokenBucket] = {}
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=120, connect=10))
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    # ------------------------------------------------------------ control plane
+    def create_upstream(self, ctx: SecurityContext, spec: dict) -> dict:
+        if not spec.get("slug") or not spec.get("base_url"):
+            raise ProblemError.bad_request("slug and base_url required")
+        if not spec["base_url"].startswith(("http://", "https://")):
+            raise ProblemError.bad_request("base_url must be http(s)")
+        auth = spec.get("auth") or {}
+        if auth and auth.get("type") not in ("bearer", "header"):
+            raise ProblemError.bad_request("auth.type must be bearer|header")
+        if auth and not auth.get("secret_ref"):
+            raise ProblemError.bad_request(
+                "auth.secret_ref (credstore key) required — inline secrets are not accepted")
+        conn = self._db.secure(ctx, UPSTREAMS)
+        if conn.find_one({"slug": spec["slug"]}):
+            raise ProblemError.conflict(f"upstream {spec['slug']} exists")
+        return conn.insert({
+            "slug": spec["slug"], "base_url": spec["base_url"].rstrip("/"),
+            "auth": auth, "rate_limit": spec.get("rate_limit") or {},
+            "circuit_breaker": spec.get("circuit_breaker") or {}, "enabled": True,
+        })
+
+    def list_upstreams(self, ctx: SecurityContext) -> list[dict]:
+        rows = self._db.secure(ctx, UPSTREAMS).select(order_by="slug")
+        return [{**r, "breaker_state": self._breaker_for(ctx, r).state} for r in rows]
+
+    def delete_upstream(self, ctx: SecurityContext, slug: str) -> bool:
+        conn = self._db.secure(ctx, UPSTREAMS)
+        row = conn.find_one({"slug": slug})
+        return conn.delete(row["id"]) if row else False
+
+    def _get_upstream(self, ctx: SecurityContext, slug: str) -> dict:
+        row = self._db.secure(ctx, UPSTREAMS).find_one({"slug": slug})
+        if row is None or not row.get("enabled"):
+            raise ProblemError.not_found(f"upstream {slug!r} not found",
+                                         code="upstream_not_found")
+        return row
+
+    def _breaker_for(self, ctx: SecurityContext, upstream: dict) -> CircuitBreaker:
+        key = f"{ctx.tenant_id}:{upstream['slug']}"
+        breaker = self._breakers.get(key)
+        if breaker is None:
+            cb = upstream.get("circuit_breaker") or {}
+            breaker = CircuitBreaker(
+                failure_threshold=int(cb.get("failure_threshold", 5)),
+                open_timeout_s=float(cb.get("open_timeout_s", 30.0)))
+            self._breakers[key] = breaker
+        return breaker
+
+    # ------------------------------------------------------------ data plane
+    async def proxy(self, request: web.Request, ctx: SecurityContext,
+                    slug: str, tail: str) -> web.StreamResponse:
+        upstream = self._get_upstream(ctx, slug)
+        key = f"{ctx.tenant_id}:{slug}"
+
+        rl = upstream.get("rate_limit") or {}
+        if rl:
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = self._buckets[key] = _TokenBucket(
+                    float(rl.get("rps", 10)), int(rl.get("burst", 20)))
+            if not bucket.try_acquire():
+                raise ProblemError.too_many_requests(f"upstream {slug} rate limit")
+
+        breaker = self._breaker_for(ctx, upstream)
+        if not breaker.allow():
+            raise ProblemError(Problem(
+                status=503, title="Service Unavailable", code="CircuitBreakerOpen",
+                detail=f"circuit breaker open for upstream {slug}"))
+
+        # header hygiene + credential injection
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in _STRIP_REQUEST_HEADERS}
+        auth = upstream.get("auth") or {}
+        if auth:
+            secret = None
+            if self._credstore is not None:
+                secret = await self._credstore.get_secret(ctx, auth["secret_ref"])
+            if secret is None:
+                raise ProblemError(Problem(
+                    status=502, title="Bad Gateway", code="credential_missing",
+                    detail=f"secret {auth['secret_ref']!r} not found in credstore"))
+            if auth["type"] == "bearer":
+                headers["Authorization"] = f"Bearer {secret}"
+            else:
+                headers[auth.get("header_name", "X-Api-Key")] = secret
+
+        url = f"{upstream['base_url']}/{tail.lstrip('/')}" if tail else upstream["base_url"]
+        if request.query_string:
+            url += f"?{request.query_string}"
+        body = await request.read() if request.can_read_body else None
+
+        session = await self.session()
+        try:
+            async with session.request(request.method, url, headers=headers,
+                                       data=body) as resp:
+                if resp.status >= 500:
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()
+                out_headers = {k: v for k, v in resp.headers.items()
+                               if k.lower() not in _STRIP_RESPONSE_HEADERS}
+                out = web.StreamResponse(status=resp.status, headers=out_headers)
+                await out.prepare(request)
+                async for chunk in resp.content.iter_chunked(16 * 1024):
+                    await out.write(chunk)  # streaming passthrough (SSE included)
+                await out.write_eof()
+                return out
+        except aiohttp.ClientError as e:
+            breaker.record_failure()
+            raise ProblemError(Problem(
+                status=502, title="Bad Gateway", code="upstream_error",
+                detail=f"upstream {slug}: {e}"))
+
+
+@module(name="oagw", deps=["credstore"], capabilities=["db", "rest"])
+class OagwModule(Module, DatabaseCapability, RestApiCapability):
+    def __init__(self) -> None:
+        self.service: Optional[OagwService] = None
+
+    def migrations(self):
+        return _MIGRATIONS
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        self.service = OagwService(ctx)
+        ctx.client_hub.register(OagwService, self.service)
+
+    def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
+        svc = self.service
+        assert svc is not None
+
+        async def create_upstream(request: web.Request):
+            body = await read_json(request)
+            row = svc.create_upstream(request[SECURITY_CONTEXT_KEY], body)
+            return {k: v for k, v in row.items() if k != "tenant_id"}, 201
+
+        async def list_upstreams(request: web.Request):
+            rows = svc.list_upstreams(request[SECURITY_CONTEXT_KEY])
+            return {"items": [{k: v for k, v in r.items() if k != "tenant_id"}
+                              for r in rows]}
+
+        async def delete_upstream(request: web.Request):
+            if not svc.delete_upstream(request[SECURITY_CONTEXT_KEY],
+                                       request.match_info["slug"]):
+                raise ProblemError.not_found("upstream not found")
+            return None
+
+        async def proxy(request: web.Request):
+            return await svc.proxy(
+                request, request[SECURITY_CONTEXT_KEY],
+                request.match_info["slug"], request.match_info.get("tail", ""))
+
+        m = "oagw"
+        router.operation("POST", "/v1/oagw/upstreams", module=m).auth_required() \
+            .summary("Register an upstream (auth via credstore secret_ref)") \
+            .handler(create_upstream).register()
+        router.operation("GET", "/v1/oagw/upstreams", module=m).auth_required() \
+            .summary("List upstreams with breaker state").handler(list_upstreams).register()
+        router.operation("DELETE", "/v1/oagw/upstreams/{slug}", module=m).auth_required() \
+            .summary("Delete an upstream").handler(delete_upstream).register()
+        for method in ("GET", "POST", "PUT", "PATCH", "DELETE"):
+            router.operation(method, "/v1/oagw/proxy/{slug}/{tail:.*}", module=m) \
+                .auth_required().accepts("*/*") \
+                .summary(f"Data-plane proxy ({method})").sse_response() \
+                .handler(proxy).register()
