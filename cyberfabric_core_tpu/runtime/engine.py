@@ -64,6 +64,9 @@ class EngineConfig:
     decode_chunk: int = 8
     #: Pallas flash kernel for prefill attention. None = auto (on for TPU).
     use_flash: Optional[bool] = None
+    #: prefix-cache pool size in pages (0 = disabled). Continuous scheduler only.
+    prefix_cache_pages: int = 0
+    prefix_page_size: int = 64
 
     def resolve_use_flash(self) -> bool:
         if self.use_flash is not None:

@@ -1,0 +1,255 @@
+"""ctypes bindings for the fabric_host native library.
+
+The C++ library (native/fabric_host/) provides the host-side hot structures of
+the paged-KV runtime: block allocator + radix prefix cache. Built on first use
+(g++ is in the image); a pure-Python fallback keeps every environment
+functional — parity between the two is pinned by tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("native")
+
+_SRC_DIR = Path(__file__).resolve().parents[2] / "native" / "fabric_host"
+_LIB_PATH = _SRC_DIR / "libfabric_host.so"
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not _LIB_PATH.exists() or (
+                _LIB_PATH.stat().st_mtime
+                < (_SRC_DIR / "fabric_host.cpp").stat().st_mtime
+            ):
+                subprocess.run(["make", "-C", str(_SRC_DIR)], check=True,
+                               capture_output=True, timeout=120)
+            lib = ctypes.CDLL(str(_LIB_PATH))
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            lib.fh_alloc_new.restype = ctypes.c_void_p
+            lib.fh_alloc_new.argtypes = [ctypes.c_int32]
+            lib.fh_alloc_free.argtypes = [ctypes.c_void_p]
+            lib.fh_alloc_pages.restype = ctypes.c_int32
+            lib.fh_alloc_pages.argtypes = [ctypes.c_void_p, ctypes.c_int32, i32p]
+            lib.fh_free_pages.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int32]
+            lib.fh_alloc_num_free.restype = ctypes.c_int32
+            lib.fh_alloc_num_free.argtypes = [ctypes.c_void_p]
+            lib.fh_cache_new.restype = ctypes.c_void_p
+            lib.fh_cache_new.argtypes = [ctypes.c_int32]
+            lib.fh_cache_free.argtypes = [ctypes.c_void_p]
+            lib.fh_cache_match.restype = ctypes.c_int32
+            lib.fh_cache_match.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int32,
+                                           i32p, ctypes.c_int32]
+            lib.fh_cache_release.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int32]
+            lib.fh_cache_insert.restype = ctypes.c_int32
+            lib.fh_cache_insert.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int32,
+                                            i32p, ctypes.c_int32]
+            lib.fh_cache_evict.restype = ctypes.c_int32
+            lib.fh_cache_evict.argtypes = [ctypes.c_void_p, ctypes.c_int32, i32p]
+            lib.fh_cache_stats.argtypes = [ctypes.c_void_p, i64p]
+            _lib = lib
+            logger.info("fabric_host native library loaded")
+        except Exception:  # noqa: BLE001
+            logger.exception("native build/load failed; using Python fallback")
+            _lib_failed = True
+    return _lib
+
+
+def _as_i32(arr) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(arr, dtype=np.int32))
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class BlockAllocator:
+    """KV page allocator (native-backed with Python fallback)."""
+
+    def __init__(self, num_pages: int, force_python: bool = False) -> None:
+        self.num_pages = num_pages
+        self._lib = None if force_python else _load()
+        if self._lib is not None:
+            self._handle = self._lib.fh_alloc_new(num_pages)
+        else:
+            self._free = list(range(num_pages - 1, -1, -1))
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def alloc(self, n: int) -> list[int]:
+        """Allocate n pages; raises MemoryError when short (nothing allocated)."""
+        if self._lib is not None:
+            out = np.empty(n, np.int32)
+            got = self._lib.fh_alloc_pages(self._handle, n, _ptr(out))
+            if got < n:
+                if got:
+                    self._lib.fh_free_pages(self._handle, _ptr(out[:got]), got)
+                raise MemoryError(f"KV pool exhausted: wanted {n}, had {got}")
+            return out.tolist()
+        if len(self._free) < n:
+            raise MemoryError(f"KV pool exhausted: wanted {n}, had {len(self._free)}")
+        out_list = [self._free.pop() for _ in range(n)]
+        return out_list
+
+    def free(self, pages: list[int]) -> None:
+        if not pages:
+            return
+        if self._lib is not None:
+            arr = _as_i32(pages)
+            self._lib.fh_free_pages(self._handle, _ptr(arr), len(pages))
+        else:
+            self._free.extend(pages)
+
+    @property
+    def num_free(self) -> int:
+        if self._lib is not None:
+            return self._lib.fh_alloc_num_free(self._handle)
+        return len(self._free)
+
+    def __del__(self) -> None:
+        lib = getattr(self, "_lib", None)
+        if lib is not None:
+            lib.fh_alloc_free(self._handle)
+
+
+class PrefixCache:
+    """Radix prefix cache over token ids at page granularity."""
+
+    def __init__(self, page_size: int, force_python: bool = False) -> None:
+        self.page_size = page_size
+        self._lib = None if force_python else _load()
+        if self._lib is not None:
+            self._handle = self._lib.fh_cache_new(page_size)
+        else:
+            self._root: dict = {"children": {}, "pages": [], "pins": 0, "used": 0}
+            self._clock = 0
+            self._stats = [0, 0, 0, 0]
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def match(self, tokens: list[int]) -> list[int]:
+        """Longest cached page-aligned prefix; pins matched nodes."""
+        if self._lib is not None:
+            arr = _as_i32(tokens)
+            out = np.empty(max(1, len(tokens) // self.page_size), np.int32)
+            got = self._lib.fh_cache_match(self._handle, _ptr(arr), len(tokens),
+                                           _ptr(out), len(out))
+            return out[:got].tolist()
+        # python fallback
+        node, pos, pages, path = self._root, 0, [], []
+        toks = list(tokens)
+        self._clock += 1
+        while pos < len(toks):
+            key = tuple(toks[pos:pos + self.page_size])
+            child = node["children"].get(key)
+            if child is None or len(key) < self.page_size:
+                break
+            pages.extend(child["pages"])
+            child["used"] = self._clock
+            path.append(child)
+            node = child
+            pos += self.page_size
+        for nd in path:
+            nd["pins"] += 1
+        self._stats[1 if pages else 2] += 1
+        return pages
+
+    def release(self, tokens: list[int]) -> None:
+        if self._lib is not None:
+            arr = _as_i32(tokens)
+            self._lib.fh_cache_release(self._handle, _ptr(arr), len(tokens))
+            return
+        node, pos = self._root, 0
+        toks = list(tokens)
+        while pos < len(toks):
+            key = tuple(toks[pos:pos + self.page_size])
+            child = node["children"].get(key)
+            if child is None:
+                break
+            child["pins"] = max(0, child["pins"] - 1)
+            node = child
+            pos += self.page_size
+        return
+
+    def insert(self, tokens: list[int], pages: list[int]) -> int:
+        if self._lib is not None:
+            t, p = _as_i32(tokens), _as_i32(pages)
+            return self._lib.fh_cache_insert(self._handle, _ptr(t), len(t),
+                                             _ptr(p), len(p))
+        toks = list(tokens)
+        usable = min(len(toks) // self.page_size, len(pages))
+        node, added = self._root, 0
+        self._clock += 1
+        for i in range(usable):
+            key = tuple(toks[i * self.page_size:(i + 1) * self.page_size])
+            child = node["children"].get(key)
+            if child is None:
+                child = {"children": {}, "pages": [pages[i]], "pins": 0,
+                         "used": self._clock, "parent": node, "key": key}
+                node["children"][key] = child
+                added += 1
+                self._stats[0] += 1
+            else:
+                child["used"] = self._clock
+            node = child
+        return added
+
+    def evict(self, target_pages: int) -> list[int]:
+        if self._lib is not None:
+            out = np.empty(max(1, target_pages), np.int32)
+            got = self._lib.fh_cache_evict(self._handle, target_pages, _ptr(out))
+            return out[:got].tolist()
+        freed: list[int] = []
+        while len(freed) < target_pages:
+            lru = None
+            stack = list(self._root["children"].values())
+            while stack:
+                nd = stack.pop()
+                if not nd["children"] and nd["pins"] == 0 and (
+                        lru is None or nd["used"] < lru["used"]):
+                    lru = nd
+                stack.extend(nd["children"].values())
+            if lru is None:
+                break
+            freed.extend(lru["pages"][: target_pages - len(freed)])
+            self._stats[0] -= len(lru["pages"])
+            self._stats[3] += len(lru["pages"])
+            del lru["parent"]["children"][lru["key"]]
+        return freed
+
+    def stats(self) -> dict[str, int]:
+        if self._lib is not None:
+            out = np.zeros(4, np.int64)
+            self._lib.fh_cache_stats(self._handle,
+                                     out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            vals = out.tolist()
+        else:
+            vals = list(self._stats)
+        return {"cached_pages": vals[0], "hits": vals[1], "misses": vals[2],
+                "evicted": vals[3]}
+
+    def __del__(self) -> None:
+        lib = getattr(self, "_lib", None)
+        if lib is not None:
+            lib.fh_cache_free(self._handle)

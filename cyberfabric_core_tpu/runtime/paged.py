@@ -1,0 +1,172 @@
+"""Prefix-cached KV pool: paged storage of prefill KV for cross-request reuse.
+
+The PAPERS.md direction (ragged paged attention for TPU) applied where it pays
+most on a serving host: **prompt prefix reuse**. Completed prefill KV is stored in
+a paged device pool ([L, num_pages, page_size, Hkv, D]) indexed by the native
+radix prefix cache (runtime/native.py — C++ fabric_host). A new request whose
+prompt shares a page-aligned prefix with any earlier one:
+
+1. matches the prefix in the radix tree (pinning its pages),
+2. gathers those pages into its prefill cache in one device op,
+3. runs prefill ONLY over the uncached suffix (with history attention),
+4. scatters its own new full pages back into the pool and records them.
+
+Decode stays on the dense slot cache (decode state is unshared by nature); the
+pool accelerates TTFT and prefill FLOPs — the llm-gateway's shared system prompts
+are the canonical win. Pool pressure is handled by LRU eviction of unpinned
+entries. Page id 0 is a scratch page: bucket padding scatters land there.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+from ..models.configs import ModelConfig
+from ..ops.sampling import sample_token
+from .native import BlockAllocator, PrefixCache
+
+logger = logging.getLogger("paged")
+
+
+def _buckets_upto(n: int) -> list[int]:
+    out, b = [], 1
+    while b < n:
+        out.append(b)
+        b *= 2
+    out.append(n)
+    return out
+
+
+class PrefixKVPool:
+    """Device page pool + native allocator/radix tree + jitted move programs."""
+
+    def __init__(self, model_config: ModelConfig, *, num_pages: int = 64,
+                 page_size: int = 64, dtype=jnp.bfloat16,
+                 force_python_native: bool = False) -> None:
+        self.cfg = model_config
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.dtype = dtype
+        L, H, D = model_config.num_layers, model_config.num_kv_heads, model_config.head_dim
+        shape = (L, num_pages, page_size, H, D)
+        self.k_pool = jnp.zeros(shape, dtype)
+        self.v_pool = jnp.zeros(shape, dtype)
+        # page 0 is scratch (padding target); allocator hands out 1..num_pages-1
+        self.allocator = BlockAllocator(num_pages - 1, force_python=force_python_native)
+        self._page_offset = 1
+        self.tree = PrefixCache(page_size, force_python=force_python_native)
+        self.prefill_tokens_saved = 0
+        self.admissions = 0
+
+    # ------------------------------------------------------------ jitted movers
+    @partial(jax.jit, static_argnums=(0, 3))
+    def _gather(self, pools, page_ids, n_pages_bucket):
+        """pool[:, pids] → [L, 1, Pb*page, H, D] contiguous block."""
+        k_pool, v_pool = pools
+        k = jnp.take(k_pool, page_ids, axis=1)  # [L, Pb, page, H, D]
+        v = jnp.take(v_pool, page_ids, axis=1)
+        L = k.shape[0]
+        Pb = n_pages_bucket
+        k = k.reshape(L, 1, Pb * self.page_size, *k.shape[3:])
+        v = v.reshape(L, 1, Pb * self.page_size, *v.shape[3:])
+        return k, v
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+    def _scatter(self, pools, kv, page_ids, start_token):
+        """Write pages [start_token .. start_token + Pb*page) of kv [L,1,S,...]
+        into pool slots page_ids (padding ids point at scratch page 0)."""
+        k_pool, v_pool = pools
+        k_new, v_new = kv
+        L = k_new.shape[0]
+        Pb = page_ids.shape[0]
+        span = Pb * self.page_size
+        k_slice = jax.lax.dynamic_slice_in_dim(k_new[:, 0], start_token, span, axis=1)
+        v_slice = jax.lax.dynamic_slice_in_dim(v_new[:, 0], start_token, span, axis=1)
+        k_pages = k_slice.reshape(L, Pb, self.page_size, *k_slice.shape[2:])
+        v_pages = v_slice.reshape(L, Pb, self.page_size, *v_slice.shape[2:])
+        return (k_pool.at[:, page_ids].set(k_pages),
+                v_pool.at[:, page_ids].set(v_pages))
+
+    # ------------------------------------------------------------ admission
+    def _alloc(self, n: int) -> list[int]:
+        try:
+            return [p + self._page_offset for p in self.allocator.alloc(n)]
+        except MemoryError:
+            freed = self.tree.evict(n)
+            self.allocator.free([p - self._page_offset for p in freed])
+            return [p + self._page_offset for p in self.allocator.alloc(n)]
+
+    def match_prefix(self, prompt_ids: list[int]) -> tuple[list[int], int]:
+        """Returns (pinned page ids, cached token count). Never returns the FULL
+        prompt as cached — at least one token must go through prefill so the
+        model produces the first-token logits."""
+        pages = self.tree.match(prompt_ids)
+        cached = len(pages) * self.page_size
+        if cached >= len(prompt_ids):
+            drop = (cached - len(prompt_ids)) // self.page_size + 1
+            pages = pages[:-drop] if drop <= len(pages) else []
+            cached = len(pages) * self.page_size
+        if pages:
+            self.prefill_tokens_saved += cached
+        return pages, cached
+
+    def gather_for_prefill(self, page_ids: list[int], seq_bucket: int,
+                           cache: tuple) -> tuple:
+        """Place cached pages at the head of a fresh [L,1,seq_bucket,...] prefill
+        cache. Returns the updated cache."""
+        if not page_ids:
+            return cache
+        pb = next(b for b in _buckets_upto(self.num_pages) if b >= len(page_ids))
+        padded = np.zeros(pb, np.int32)  # pad → scratch page 0 (harmless reads)
+        padded[: len(page_ids)] = page_ids
+        k_blk, v_blk = self._gather((self.k_pool, self.v_pool),
+                                    jnp.asarray(padded), pb)
+        span = min(pb * self.page_size, seq_bucket)
+        k, v = cache
+        k = jax.lax.dynamic_update_slice(
+            k, k_blk[:, :, :span].astype(k.dtype), (0, 0, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(
+            v, v_blk[:, :, :span].astype(v.dtype), (0, 0, 0, 0, 0))
+        return (k, v)
+
+    def store_prefill(self, prompt_ids: list[int], cached_pages: list[int],
+                      kv: tuple) -> None:
+        """After prefill: scatter the NEW full pages into the pool and record the
+        whole prompt's page chain in the radix tree."""
+        total_pages = len(prompt_ids) // self.page_size
+        n_new = total_pages - len(cached_pages)
+        if n_new <= 0:
+            return
+        try:
+            new_ids = self._alloc(n_new)
+        except MemoryError:
+            logger.debug("pool exhausted; skipping prefix store")
+            return
+        pb = next(b for b in _buckets_upto(self.num_pages) if b >= n_new)
+        padded = np.zeros(pb, np.int32)
+        padded[:n_new] = new_ids
+        self.k_pool, self.v_pool = self._scatter(
+            (self.k_pool, self.v_pool), kv, jnp.asarray(padded),
+            len(cached_pages) * self.page_size)
+        chain = list(cached_pages) + new_ids
+        self.tree.insert(prompt_ids[: total_pages * self.page_size], chain)
+        self.admissions += 1
+
+    def release(self, prompt_ids: list[int]) -> None:
+        self.tree.release(prompt_ids)
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            **self.tree.stats(),
+            "pages_free": self.allocator.num_free,
+            "pages_total": self.num_pages - 1,
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+            "native": self.tree.native,
+        }

@@ -99,6 +99,15 @@ class ContinuousBatchingEngine:
             self.model_config, self.n_slots, config.max_seq_len, self.dtype)
         self._last_tokens = jnp.zeros((self.n_slots,), jnp.int32)
 
+        # optional cross-request prefix reuse (paged pool + native radix tree)
+        self.pool = None
+        if config.prefix_cache_pages > 0:
+            from .paged import PrefixKVPool
+
+            self.pool = PrefixKVPool(
+                self.model_config, num_pages=config.prefix_cache_pages,
+                page_size=config.prefix_page_size, dtype=self.dtype)
+
         self._pending: _queue.Queue[_Pending] = _queue.Queue()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -130,6 +139,24 @@ class ContinuousBatchingEngine:
             return first, kv, rng
 
         self._prefill_fn = jax.jit(prefill)
+
+        def suffix_prefill(params, ids, suffix_len, cached_len, cache,
+                           rng, temp, top_p, top_k):
+            """Prefill only the uncached suffix against gathered prefix history
+            (jnp attention path — queries must see the cached slots)."""
+            B, T = ids.shape
+            positions = cached_len + jnp.broadcast_to(
+                jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+            start = jnp.full((B,), cached_len, jnp.int32)
+            hidden, kv = llama.forward(params, cfg, ids, positions, cache, start,
+                                       self.rope_tables)
+            last_h = llama.gather_last_hidden(hidden, suffix_len)
+            logits = llama.lm_head_logits(params, cfg, last_h)
+            rng, sub = jax.random.split(rng)
+            first = sample_token(logits, sub, temp, top_p, top_k)
+            return first, kv, rng
+
+        self._suffix_prefill_fn = jax.jit(suffix_prefill)
 
         def insert(k_cache, v_cache, k_new, v_new, slot):
             return llama.insert_slot_kv((k_cache, v_cache), (k_new, v_new), slot)
@@ -186,6 +213,7 @@ class ContinuousBatchingEngine:
         occ = sum(self.occupancy_samples) / max(1, len(self.occupancy_samples))
         return {
             "broken": self._broken,
+            "prefix_cache": self.pool.stats() if self.pool is not None else None,
             "slots": self.n_slots,
             "active": self.active_slots,
             "pending": self._pending.qsize(),
@@ -253,15 +281,53 @@ class ContinuousBatchingEngine:
     def _prefill_into_slot(self, slot: int, req: _Pending) -> None:
         T = len(req.prompt_ids)
         bucket = self._bucket_for(T)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :T] = req.prompt_ids
         s = req.sampling
         temp = jnp.asarray([s.temperature], jnp.float32)
         top_p = jnp.asarray([s.top_p], jnp.float32)
         top_k = jnp.asarray([s.top_k], jnp.int32)
-        first, kv, self._rng = self._prefill_fn(
-            self.params, jnp.asarray(ids), jnp.asarray([T], jnp.int32),
-            self._rng, temp, top_p, top_k, self.rope_tables)
+
+        cached_pages: list[int] = []
+        if self.pool is not None:
+            cached_pages, cached_len = self.pool.match_prefix(req.prompt_ids)
+            if cached_pages:
+                # the suffix insert at offset cached_len must fit the prefill
+                # cache entirely (dynamic_update_slice clamps, which would
+                # overwrite cached history) — grow the cache bucket to cover it,
+                # or fall back to a cold prefill near the window edge
+                suf_bucket = self.config.bucket_for(T - cached_len)
+                if cached_len + suf_bucket <= self.config.max_seq_len:
+                    bucket = max(bucket, next(
+                        b for b in self.config.buckets()
+                        if b >= cached_len + suf_bucket))
+                else:
+                    self.pool.release(req.prompt_ids)
+                    cached_pages = []
+        if cached_pages:
+            # prefix hit: gather history, prefill the suffix only
+            try:
+                suffix = req.prompt_ids[cached_len:]
+                suf_bucket = self.config.bucket_for(len(suffix))
+                ids = np.zeros((1, suf_bucket), np.int32)
+                ids[0, : len(suffix)] = suffix
+                cache = llama.init_cache(self.model_config, 1, bucket, self.dtype)
+                cache = self.pool.gather_for_prefill(cached_pages, bucket, cache)
+                first, kv, self._rng = self._suffix_prefill_fn(
+                    self.params, jnp.asarray(ids),
+                    jnp.asarray([len(suffix)], jnp.int32),
+                    jnp.asarray(cached_len, jnp.int32), cache,
+                    self._rng, temp, top_p, top_k)
+                self.pool.store_prefill(req.prompt_ids, cached_pages, kv)
+            finally:
+                self.pool.release(req.prompt_ids)
+        else:
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :T] = req.prompt_ids
+            first, kv, self._rng = self._prefill_fn(
+                self.params, jnp.asarray(ids), jnp.asarray([T], jnp.int32),
+                self._rng, temp, top_p, top_k, self.rope_tables)
+            if self.pool is not None:
+                self.pool.store_prefill(req.prompt_ids, [], kv)
+                self.pool.release(req.prompt_ids)
         # pad the collected kv to max_seq? No: insert writes [L,1,bucket,...] at
         # slot offset 0; the remaining tail keeps stale data masked by length.
         self.cache = self._insert_fn(

@@ -1,0 +1,230 @@
+// fabric_host — native host-side runtime structures for the TPU serving tier.
+//
+// The reference implements its entire runtime tier natively (Rust); this library
+// is the TPU build's native runtime core for the inference host: the paged-KV
+// **block allocator** and the **radix prefix cache** that decide, per request,
+// which KV pages to reuse, allocate, and evict. These sit on the admission hot
+// path of the continuous batching scheduler (every request, every free), where
+// Python dict/loop implementations add milliseconds at high request rates.
+//
+// C ABI (ctypes-consumed; see cyberfabric_core_tpu/runtime/native.py):
+//   allocator: fh_alloc_new/free/alloc_pages/free_pages/num_free
+//   prefix cache: fh_cache_new/free/insert/match/release/evict/stats
+//
+// Design notes:
+// - The radix tree maps token-id sequences -> KV page ids at page granularity:
+//   match() returns the longest cached prefix (in whole pages) and pins it;
+//   insert() records pages for a sequence after prefill; release() unpins;
+//   evict() LRU-frees unpinned leaves until `target_pages` are reclaimed.
+// - Thread safety: a single mutex per object. The scheduler thread is the only
+//   hot caller; the lock is for stats readers.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Allocator {
+    std::mutex mu;
+    std::vector<int32_t> free_list;  // LIFO for locality
+    int32_t total;
+    explicit Allocator(int32_t num_pages) : total(num_pages) {
+        free_list.reserve(num_pages);
+        for (int32_t i = num_pages - 1; i >= 0; --i) free_list.push_back(i);
+    }
+};
+
+struct Node {
+    // edge label: exactly one page worth of token ids
+    std::vector<int32_t> tokens;
+    std::vector<int32_t> pages;     // KV page ids covering `tokens` (one per node)
+    std::map<std::vector<int32_t>, std::unique_ptr<Node>> children;  // page -> child
+    Node* parent = nullptr;
+    int32_t pin_count = 0;
+    uint64_t last_used = 0;
+};
+
+struct PrefixCache {
+    std::mutex mu;
+    Node root;
+    int32_t page_size;
+    uint64_t clock = 0;
+    int64_t cached_pages = 0;
+    int64_t hits = 0, misses = 0, evicted = 0;
+    explicit PrefixCache(int32_t ps) : page_size(ps) {}
+};
+
+}  // namespace
+
+extern "C" {
+
+// ----------------------------------------------------------------- allocator
+void* fh_alloc_new(int32_t num_pages) { return new Allocator(num_pages); }
+
+void fh_alloc_free(void* a) { delete static_cast<Allocator*>(a); }
+
+// Allocate n pages into out[0..n); returns number allocated (may be < n).
+int32_t fh_alloc_pages(void* a_, int32_t n, int32_t* out) {
+    auto* a = static_cast<Allocator*>(a_);
+    std::lock_guard<std::mutex> lock(a->mu);
+    int32_t got = 0;
+    while (got < n && !a->free_list.empty()) {
+        out[got++] = a->free_list.back();
+        a->free_list.pop_back();
+    }
+    return got;
+}
+
+void fh_free_pages(void* a_, const int32_t* pages, int32_t n) {
+    auto* a = static_cast<Allocator*>(a_);
+    std::lock_guard<std::mutex> lock(a->mu);
+    for (int32_t i = 0; i < n; ++i) a->free_list.push_back(pages[i]);
+}
+
+int32_t fh_alloc_num_free(void* a_) {
+    auto* a = static_cast<Allocator*>(a_);
+    std::lock_guard<std::mutex> lock(a->mu);
+    return static_cast<int32_t>(a->free_list.size());
+}
+
+// ----------------------------------------------------------------- prefix cache
+void* fh_cache_new(int32_t page_size) { return new PrefixCache(page_size); }
+
+void fh_cache_free(void* c) { delete static_cast<PrefixCache*>(c); }
+
+// Longest cached prefix of tokens[0..n): writes up to max_out page ids into
+// out_pages, returns the number of matched pages. Matched nodes are pinned
+// (caller must fh_cache_release with the same token prefix when done).
+int32_t fh_cache_match(void* c_, const int32_t* tokens, int32_t n,
+                       int32_t* out_pages, int32_t max_out) {
+    auto* c = static_cast<PrefixCache*>(c_);
+    std::lock_guard<std::mutex> lock(c->mu);
+    c->clock++;
+    Node* node = &c->root;
+    int32_t pos = 0, out_n = 0;
+    std::vector<Node*> path;
+    while (pos + c->page_size <= n) {
+        std::vector<int32_t> key(tokens + pos, tokens + pos + c->page_size);
+        auto it = node->children.find(key);
+        if (it == node->children.end()) break;
+        Node* child = it->second.get();
+        pos += c->page_size;
+        node = child;
+        path.push_back(child);
+        for (int32_t p : child->pages) {
+            if (out_n < max_out) out_pages[out_n++] = p;
+        }
+        child->last_used = c->clock;
+    }
+    for (Node* nd : path) nd->pin_count++;
+    if (out_n > 0) c->hits++; else c->misses++;
+    return out_n;
+}
+
+// Release pins acquired by a previous match over the same token sequence.
+void fh_cache_release(void* c_, const int32_t* tokens, int32_t n) {
+    auto* c = static_cast<PrefixCache*>(c_);
+    std::lock_guard<std::mutex> lock(c->mu);
+    Node* node = &c->root;
+    int32_t pos = 0;
+    while (pos + c->page_size <= n) {
+        std::vector<int32_t> key(tokens + pos, tokens + pos + c->page_size);
+        auto it = node->children.find(key);
+        if (it == node->children.end()) break;
+        Node* child = it->second.get();
+        if (child->pin_count > 0) child->pin_count--;
+        pos += c->page_size;
+        node = child;
+    }
+}
+
+// Insert the page list for tokens[0..n) (n must be a multiple of page_size for
+// full coverage; trailing partial pages are not cached). Existing shared
+// prefixes are deduplicated structurally. Returns pages newly recorded.
+int32_t fh_cache_insert(void* c_, const int32_t* tokens, int32_t n,
+                        const int32_t* pages, int32_t n_pages) {
+    auto* c = static_cast<PrefixCache*>(c_);
+    std::lock_guard<std::mutex> lock(c->mu);
+    c->clock++;
+    int32_t usable_tokens = (n / c->page_size) * c->page_size;
+    int32_t usable_pages = usable_tokens / c->page_size;
+    if (usable_pages > n_pages) usable_pages = n_pages;
+    usable_tokens = usable_pages * c->page_size;
+
+    Node* node = &c->root;
+    int32_t pos = 0, page_idx = 0, added = 0;
+    while (pos < usable_tokens) {
+        std::vector<int32_t> key(tokens + pos, tokens + pos + c->page_size);
+        auto it = node->children.find(key);
+        if (it != node->children.end()) {
+            Node* child = it->second.get();
+            pos += c->page_size;
+            page_idx += 1;
+            node = child;
+            child->last_used = c->clock;
+            continue;
+        }
+        auto child = std::make_unique<Node>();
+        child->tokens = key;
+        child->pages.push_back(pages[page_idx]);
+        child->parent = node;
+        child->last_used = c->clock;
+        Node* raw = child.get();
+        node->children.emplace(std::move(key), std::move(child));
+        node = raw;
+        pos += c->page_size;
+        page_idx++;
+        added++;
+        c->cached_pages++;
+    }
+    return added;
+}
+
+// LRU-evict unpinned leaf pages until target_pages reclaimed; freed page ids are
+// written to out_pages. Returns pages reclaimed.
+int32_t fh_cache_evict(void* c_, int32_t target_pages, int32_t* out_pages) {
+    auto* c = static_cast<PrefixCache*>(c_);
+    std::lock_guard<std::mutex> lock(c->mu);
+    int32_t freed = 0;
+    while (freed < target_pages) {
+        // find the LRU unpinned leaf
+        Node* lru = nullptr;
+        std::vector<Node*> stack;
+        for (auto& kv : c->root.children) stack.push_back(kv.second.get());
+        while (!stack.empty()) {
+            Node* nd = stack.back();
+            stack.pop_back();
+            bool is_leaf = nd->children.empty();
+            if (is_leaf && nd->pin_count == 0 &&
+                (lru == nullptr || nd->last_used < lru->last_used))
+                lru = nd;
+            for (auto& kv : nd->children) stack.push_back(kv.second.get());
+        }
+        if (lru == nullptr) break;
+        for (int32_t p : lru->pages) {
+            out_pages[freed++] = p;
+            c->cached_pages--;
+            c->evicted++;
+            if (freed >= target_pages) break;
+        }
+        Node* parent = lru->parent;
+        parent->children.erase(lru->tokens);
+    }
+    return freed;
+}
+
+void fh_cache_stats(void* c_, int64_t* out4) {
+    auto* c = static_cast<PrefixCache*>(c_);
+    std::lock_guard<std::mutex> lock(c->mu);
+    out4[0] = c->cached_pages;
+    out4[1] = c->hits;
+    out4[2] = c->misses;
+    out4[3] = c->evicted;
+}
+
+}  // extern "C"

@@ -1,0 +1,68 @@
+"""Prefix-cached KV pool: identical outputs with reuse, real prefill savings."""
+
+import queue
+import threading
+
+import pytest
+
+from cyberfabric_core_tpu.runtime.engine import EngineConfig, SamplingParams
+from cyberfabric_core_tpu.runtime.scheduler import ContinuousBatchingEngine
+
+
+def run_request(sched, prompt, sampling, timeout=120.0):
+    done = threading.Event()
+    tokens: list[int] = []
+    finish: list[str] = []
+
+    def emit(ev):
+        if ev.token_id >= 0:
+            tokens.append(ev.token_id)
+        if ev.finished:
+            finish.append(ev.finished)
+            done.set()
+
+    sched.submit(prompt, sampling, emit)
+    assert done.wait(timeout)
+    return tokens, finish[0]
+
+
+@pytest.fixture(scope="module")
+def scheds():
+    base = dict(model="tiny-llama", max_seq_len=96, max_batch=2, decode_chunk=4)
+    with_cache = ContinuousBatchingEngine(
+        EngineConfig(**base, prefix_cache_pages=32, prefix_page_size=4), seed=0)
+    without = ContinuousBatchingEngine(EngineConfig(**base), seed=0)
+    yield with_cache, without
+    with_cache.shutdown()
+    without.shutdown()
+
+
+def test_prefix_reuse_matches_cold_path(scheds):
+    cached, plain = scheds
+    system_prompt = list(range(10, 30))  # 20 tokens -> 5 full pages of 4
+    sampling = SamplingParams(max_tokens=6)
+
+    queries = [system_prompt + [40 + i] for i in range(4)]
+    expected = [run_request(plain, q, sampling) for q in queries]
+
+    got = [run_request(cached, q, sampling) for q in queries]
+    assert got == expected, "prefix-cached results diverge from cold prefill"
+
+    stats = cached.pool.stats()
+    assert stats["hits"] >= 3, stats            # requests 2..4 hit the prefix
+    assert stats["prefill_tokens_saved"] >= 3 * 20
+    assert stats["cached_pages"] > 0
+
+
+def test_prefix_pool_eviction_under_pressure(scheds):
+    cached, _ = scheds
+    sampling = SamplingParams(max_tokens=2)
+    # flood with distinct prompts to exceed the 31 usable pages
+    for i in range(12):
+        prompt = [100 + i] * 16  # 4 pages each
+        run_request(cached, prompt, sampling)
+    stats = cached.pool.stats()
+    assert stats["evicted"] > 0 or stats["pages_free"] >= 0  # no crash, bounded
+    # previously cached prefix still (or again) serves correctly
+    tokens, fin = run_request(cached, [100] * 16 + [7], sampling)
+    assert len(tokens) >= 1
