@@ -111,6 +111,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         self.registry: Optional[ModelRegistryApi] = None
         self.usage = UsageTracker()
         self.jobs = JobStore()
+        self.batches: dict[str, dict] = {}
         self.ttft_timeout_s = 120.0
         self.total_timeout_s = 600.0
         self._job_tasks: set[asyncio.Task] = set()
@@ -332,6 +333,66 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
             job["status"] = "cancelled"
         return self.jobs.public_view(job)
 
+    async def handle_create_batch(self, request: web.Request):
+        """Batch API (async/batch.v1 + batch_request.v1): items run concurrently
+        against the worker (bounded), per-item results/errors recorded."""
+        body = await read_json(request, {
+            "type": "object", "required": ["requests"],
+            "properties": {"requests": {
+                "type": "array", "minItems": 1, "maxItems": 128,
+                "items": {"type": "object",
+                          "required": ["custom_id", "request"],
+                          "properties": {"custom_id": {"type": "string"},
+                                         "request": schemas.REQUEST},
+                          "additionalProperties": False}}},
+            "additionalProperties": False})
+        ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
+        self.usage.check_budget(ctx)
+        batch_id = f"batch-{uuid.uuid4().hex[:20]}"
+        batch = {
+            "id": batch_id, "tenant_id": ctx.tenant_id, "status": "pending",
+            "requests": [{"custom_id": it["custom_id"], "request": it["request"],
+                          "result": None, "error": None}
+                         for it in body["requests"]],
+            "created_at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        }
+        self.batches[batch_id] = batch
+
+        async def run() -> None:
+            batch["status"] = "in_progress"
+            sem = asyncio.Semaphore(8)
+
+            async def one(item: dict) -> None:
+                async with sem:
+                    try:
+                        models = await self._resolve_with_fallback(ctx, item["request"])
+                        item["result"] = await self._sync_response(
+                            ctx, item["request"], models)
+                    except ProblemError as e:
+                        item["error"] = e.problem.to_dict()
+                    except Exception as e:  # noqa: BLE001
+                        item["error"] = {"detail": str(e)[:500]}
+
+            await asyncio.gather(*(one(it) for it in batch["requests"]))
+            failed = sum(1 for it in batch["requests"] if it["error"])
+            batch["status"] = "failed" if failed == len(batch["requests"]) else "completed"
+
+        task = asyncio.ensure_future(run())
+        self._job_tasks.add(task)
+        task.add_done_callback(self._job_tasks.discard)
+        return self._batch_view(batch), 202
+
+    async def handle_get_batch(self, request: web.Request):
+        ctx = request[SECURITY_CONTEXT_KEY]
+        batch = self.batches.get(request.match_info["batch_id"])
+        if batch is None or batch["tenant_id"] != ctx.tenant_id:
+            raise ProblemError.not_found("batch not found", code="batch_not_found")
+        return self._batch_view(batch)
+
+    @staticmethod
+    def _batch_view(batch: dict) -> dict:
+        return {k: v for k, v in batch.items() if k != "tenant_id"}
+
     async def handle_embeddings(self, request: web.Request):
         body = await read_json(request, schemas.EMBEDDING_REQUEST)
         ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
@@ -380,3 +441,10 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
             .summary("Cancel an async job").handler(self.handle_cancel_job).register()
         router.operation("GET", "/v1/usage", module=m).auth_required() \
             .summary("Tenant usage counters").handler(self.handle_usage).register()
+        openapi.register_schema("Batch", schemas.BATCH)
+        router.operation("POST", "/v1/batches", module=m).auth_required() \
+            .summary("Submit a request batch").response_schema(schemas.BATCH) \
+            .handler(self.handle_create_batch).register()
+        router.operation("GET", "/v1/batches/{batch_id}", module=m).auth_required() \
+            .summary("Batch status + per-item results").response_schema(schemas.BATCH) \
+            .handler(self.handle_get_batch).register()

@@ -138,12 +138,15 @@ def save_llama_params(params: dict, cfg: ModelConfig, out_dir: str | Path) -> Pa
         except KeyError:
             continue
         arr = np.asarray(jax.device_get(node)).astype(np.float32)
+        # safetensors serializes the raw buffer: transposed views MUST be made
+        # contiguous or the file silently holds the untransposed layout
         if "{i}" not in tmpl:
-            tensors[tmpl] = arr.T if transpose else arr
+            tensors[tmpl] = np.ascontiguousarray(arr.T) if transpose else arr
         else:
             for i in range(cfg.num_layers):
                 t = arr[i]
-                tensors[tmpl.format(i=i)] = t.T if transpose else t
+                tensors[tmpl.format(i=i)] = (
+                    np.ascontiguousarray(t.T) if transpose else np.ascontiguousarray(t))
     path = out_dir / "model.safetensors"
     save_file(tensors, str(path))
     return path

@@ -394,3 +394,28 @@ def test_nodes_registry_self_registration(server):
     assert len(body["items"]) >= 1
     node = body["items"][0]
     assert node["sys_info"]["cpu_count"] >= 1
+
+
+def test_batches_api(server):
+    loop, _ = server
+    status, batch = req(server, "POST", "/v1/batches", json={
+        "requests": [
+            {"custom_id": "a", "request": {
+                "model": "default-chat", "max_tokens": 4,
+                "messages": [{"role": "user",
+                              "content": [{"type": "text", "text": "one"}]}]}},
+            {"custom_id": "b", "request": {
+                "model": "ghost-model",
+                "messages": [{"role": "user",
+                              "content": [{"type": "text", "text": "two"}]}]}},
+        ]})
+    assert status == 202 and batch["status"] in ("pending", "in_progress")
+    for _ in range(200):
+        status, batch = req(server, "GET", f"/v1/batches/{batch['id']}")
+        if batch["status"] in ("completed", "failed"):
+            break
+        loop.run_until_complete(asyncio.sleep(0.05))
+    assert batch["status"] == "completed"  # partial failure != batch failure
+    by_id = {it["custom_id"]: it for it in batch["requests"]}
+    assert by_id["a"]["result"]["model_used"] == "local::tiny-llama"
+    assert by_id["b"]["error"]["code"] == "model_not_found"
