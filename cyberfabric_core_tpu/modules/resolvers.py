@@ -58,6 +58,49 @@ class StaticTenantResolver(TenantResolverApi):
         return tenant_id in self._parent
 
 
+class JwtAuthnResolver(AuthnApi):
+    """mode: jwt — real token validation (modkit-auth parity): HS256/RS256
+    signatures, exp/nbf/iss/aud, configurable claims mapping.
+
+    config: {keys: {kid: {alg, secret|public_key_pem}}, issuer, audience,
+    tenant_claim (default "tenant_id"), scopes_claim ("scope", space-separated
+    or list), roles_claim ("roles"), default_tenant}.
+    """
+
+    def __init__(self, cfg: dict) -> None:
+        from ..modkit.jwt import JwtValidator
+
+        self.validator = JwtValidator.from_config(cfg)
+        self.tenant_claim = cfg.get("tenant_claim", "tenant_id")
+        self.scopes_claim = cfg.get("scopes_claim", "scope")
+        self.roles_claim = cfg.get("roles_claim", "roles")
+        self.default_tenant = cfg.get("default_tenant", "default")
+
+    async def authenticate(self, bearer_token: Optional[str],
+                           request_meta: dict[str, Any]) -> SecurityContext:
+        from ..modkit.jwt import JwtError
+
+        if not bearer_token:
+            raise ProblemError.unauthorized("missing bearer token")
+        try:
+            claims = self.validator.validate(bearer_token)
+        except JwtError as e:
+            raise ProblemError.unauthorized(f"invalid token: {e}")
+        tenant = str(claims.get(self.tenant_claim) or self.default_tenant)
+        scopes_raw = claims.get(self.scopes_claim, ())
+        scopes = tuple(scopes_raw.split() if isinstance(scopes_raw, str) else scopes_raw)
+        roles = tuple(claims.get(self.roles_claim, ()) or ())
+        return SecurityContext(
+            subject=str(claims.get("sub", "unknown")),
+            tenant_id=tenant,
+            token_scopes=scopes,
+            roles=roles,
+            access_scope=AccessScope.for_tenants([tenant]),
+            bearer_token=SecretString(bearer_token),
+            claims=claims,
+        )
+
+
 class StaticAuthnResolver(AuthnApi):
     """mode: accept_all → identity from headers/defaults; mode: static → token map
     {token: {subject, tenant_id, scopes, roles}}."""
@@ -137,11 +180,15 @@ class TenantResolverModule(Module, SystemCapability):
 class AuthnResolverModule(Module, SystemCapability):
     async def init(self, ctx: ModuleCtx) -> None:
         cfg = ctx.raw_config()
-        resolver = StaticAuthnResolver(
-            mode=cfg.get("mode", "accept_all"),
-            tokens=cfg.get("tokens"),
-            default_tenant=cfg.get("default_tenant", "default"),
-        )
+        mode = cfg.get("mode", "accept_all")
+        if mode == "jwt":
+            resolver: AuthnApi = JwtAuthnResolver(cfg)
+        else:
+            resolver = StaticAuthnResolver(
+                mode=mode,
+                tokens=cfg.get("tokens"),
+                default_tenant=cfg.get("default_tenant", "default"),
+            )
         ctx.client_hub.register(AuthnApi, resolver)
 
 

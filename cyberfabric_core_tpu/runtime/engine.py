@@ -181,13 +181,16 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------ jit builders
     def _build_prefill(self) -> Callable:
-        """Prefill + FIRST-token sampling in one program: the first token comes
-        back with the prefill readback instead of costing a second dispatch RTT
-        (TTFT = one round trip)."""
+        """Prefill + FIRST-token sampling in one program, with the KV cache
+        CREATED inside the program: TTFT costs exactly one dispatch round trip
+        (no separate zeros-allocation dispatch per request)."""
         cfg = self.model_config
+        max_seq = self.config.max_seq_len
+        dtype = self.dtype
 
-        def prefill(params, input_ids, lengths, cache, rng, temperature, top_p, top_k, rope):
+        def prefill(params, input_ids, lengths, rng, temperature, top_p, top_k, rope):
             B, T = input_ids.shape
+            cache = llama.init_cache(cfg, B, max_seq, dtype)
             positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
             start = jnp.zeros((B,), jnp.int32)
             hidden, cache = llama.forward(params, cfg, input_ids, positions, cache, start, rope)
@@ -197,7 +200,7 @@ class InferenceEngine:
             first = sample_token(logits, sub, temperature, top_p, top_k)
             return first, cache, rng
 
-        return jax.jit(prefill, donate_argnums=(3,) if self.config.donate_cache else ())
+        return jax.jit(prefill)
 
     def _build_decode(self, k_steps: int) -> Callable:
         """Jit the shared fused decode body (one dispatch, one [B, k] readback)."""
@@ -279,11 +282,10 @@ class InferenceEngine:
         top_p = jnp.asarray([s.top_p for s in per_req], jnp.float32)
         top_k = jnp.asarray([s.top_k for s in per_req], jnp.int32)
 
-        cache = llama.init_cache(self.model_config, B, self.config.max_seq_len, self.dtype)
         prefill = self._prefill_for(B, bucket)
         c0 = time.monotonic()
         first_dev, cache, self._rng = prefill(
-            self.params, jnp.asarray(ids), lengths, cache, self._rng,
+            self.params, jnp.asarray(ids), lengths, self._rng,
             temperature, top_p, top_k, self.rope_tables,
         )
         first = np.asarray(first_dev, np.int32)

@@ -46,11 +46,12 @@ def _load_modules() -> None:
 
 
 def _setup_logging(config: AppConfig, override: Optional[str]) -> None:
-    level_name = override or config.section("logging").get("level", "info")
-    logging.basicConfig(
-        level=getattr(logging, str(level_name).upper(), logging.INFO),
-        format="%(asctime)s %(levelname)-7s %(name)s: %(message)s",
-    )
+    from .modkit.logging_host import init_logging_unified
+
+    section = dict(config.section("logging"))
+    if override:
+        section["level"] = override
+    init_logging_unified(section, home_dir=config.home_dir())
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
