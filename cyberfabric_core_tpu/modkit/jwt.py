@@ -53,6 +53,16 @@ class JwtKey:
     alg: str                       # HS256 | RS256
     secret: Optional[str] = None   # HS256
     public_key_pem: Optional[str] = None  # RS256
+    _public_key: Any = None        # parsed once, lazily (per-request PEM parsing
+                                   # would sit on the auth hot path)
+
+    def public_key(self):
+        if self._public_key is None and self.public_key_pem:
+            from cryptography.hazmat.primitives import serialization
+
+            self._public_key = serialization.load_pem_public_key(
+                self.public_key_pem.encode())
+        return self._public_key
 
 
 @dataclass
@@ -91,11 +101,11 @@ class JwtValidator:
         elif alg == "RS256":
             if not key.public_key_pem:
                 raise JwtError("RS256 key has no public_key_pem")
-            from cryptography.hazmat.primitives import hashes, serialization
+            from cryptography.hazmat.primitives import hashes
             from cryptography.hazmat.primitives.asymmetric import padding
             from cryptography.exceptions import InvalidSignature
 
-            pub = serialization.load_pem_public_key(key.public_key_pem.encode())
+            pub = key.public_key()
             try:
                 pub.verify(sig, signing_input, padding.PKCS1v15(), hashes.SHA256())
             except InvalidSignature as e:
@@ -118,9 +128,16 @@ class JwtValidator:
                                _b64url_decode(s_raw))
 
         now = time.time()
-        if "exp" in claims and now > float(claims["exp"]) + self.leeway_s:
+
+        def numeric(name: str) -> float:
+            try:
+                return float(claims[name])
+            except (TypeError, ValueError) as e:
+                raise JwtError(f"claim {name!r} is not numeric") from e
+
+        if "exp" in claims and now > numeric("exp") + self.leeway_s:
             raise JwtError("token expired")
-        if "nbf" in claims and now < float(claims["nbf"]) - self.leeway_s:
+        if "nbf" in claims and now < numeric("nbf") - self.leeway_s:
             raise JwtError("token not yet valid")
         if self.issuer is not None and claims.get("iss") != self.issuer:
             raise JwtError(f"issuer mismatch: {claims.get('iss')!r}")

@@ -26,15 +26,12 @@ from typing import Any, Optional
 _FORMAT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
 
 
-def init_logging_unified(config: dict[str, Any],
-                         home_dir: Optional[Path] = None) -> None:
+def init_logging_unified(config: dict[str, Any]) -> None:
     root_level = getattr(logging, str(config.get("level", "info")).upper(),
                          logging.INFO)
     logging.basicConfig(level=root_level, format=_FORMAT)
 
     log_dir = config.get("dir")
-    if log_dir is None and home_dir is not None and config.get("to_files"):
-        log_dir = home_dir / "logs"
     if log_dir is not None:
         log_dir = Path(log_dir).expanduser()
         log_dir.mkdir(parents=True, exist_ok=True)

@@ -87,9 +87,18 @@ class JwtAuthnResolver(AuthnApi):
         except JwtError as e:
             raise ProblemError.unauthorized(f"invalid token: {e}")
         tenant = str(claims.get(self.tenant_claim) or self.default_tenant)
-        scopes_raw = claims.get(self.scopes_claim, ())
-        scopes = tuple(scopes_raw.split() if isinstance(scopes_raw, str) else scopes_raw)
-        roles = tuple(claims.get(self.roles_claim, ()) or ())
+
+        def as_str_tuple(value: Any) -> tuple[str, ...]:
+            # tolerate the IdP claim zoo: null, space-separated string, single
+            # string, list, or anything else (ignored) — never crash to a 500
+            if isinstance(value, str):
+                return tuple(value.split())
+            if isinstance(value, (list, tuple)):
+                return tuple(str(v) for v in value)
+            return ()
+
+        scopes = as_str_tuple(claims.get(self.scopes_claim))
+        roles = as_str_tuple(claims.get(self.roles_claim))
         return SecurityContext(
             subject=str(claims.get("sub", "unknown")),
             tenant_id=tenant,

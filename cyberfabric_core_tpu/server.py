@@ -51,7 +51,7 @@ def _setup_logging(config: AppConfig, override: Optional[str]) -> None:
     section = dict(config.section("logging"))
     if override:
         section["level"] = override
-    init_logging_unified(section, home_dir=config.home_dir())
+    init_logging_unified(section)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

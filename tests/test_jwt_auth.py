@@ -149,3 +149,28 @@ def test_jwt_mode_over_http(fresh_registry):
             await rt.run_stop_phase()
 
     asyncio.run(go())
+
+
+def test_claim_shape_tolerance():
+    """IdP claim zoo: null scope, string roles, numeric junk — never a crash."""
+    import asyncio
+
+    from cyberfabric_core_tpu.modules.resolvers import JwtAuthnResolver
+
+    r = JwtAuthnResolver({**KEYS})
+
+    async def auth(**over):
+        return await r.authenticate(make_token(**over), {})
+
+    sc = asyncio.run(auth(scope=None, roles="admin"))
+    assert sc.token_scopes == () and sc.roles == ("admin",)
+    sc = asyncio.run(auth(scope=42, roles=7))
+    assert sc.token_scopes == () and sc.roles == ()
+    sc = asyncio.run(auth(roles=["a", "b"]))
+    assert sc.roles == ("a", "b")
+
+
+def test_non_numeric_exp_is_401_shape():
+    v = JwtValidator.from_config(KEYS)
+    with pytest.raises(JwtError, match="not numeric"):
+        v.validate(make_token(exp="2026-07-28T00:00:00Z"))
