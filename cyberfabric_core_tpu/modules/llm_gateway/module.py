@@ -19,6 +19,7 @@ import json
 import uuid
 from typing import Any, AsyncIterator, Optional
 
+import aiohttp
 from aiohttp import web
 
 from ...modkit import Module, module
@@ -406,6 +407,54 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         data = [{"index": i, "embedding": v} for i, v in enumerate(vectors)]
         return {"data": data, "model": model.canonical_id, "usage": usage}
 
+    async def handle_realtime(self, request: web.Request):
+        """WS /realtime (DESIGN.md:262-271): bidirectional session — client sends
+        `{type: "chat.create", request: {...}}` frames, server streams
+        `{type: "token", ...}` / `{type: "done", usage}` / `{type: "error"}`
+        events. Text modality now; the audio frames of the spec slot into the
+        same session protocol."""
+        ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
+        ws = web.WebSocketResponse(heartbeat=20.0)
+        await ws.prepare(request)
+        async for msg in ws:
+            if msg.type != aiohttp.WSMsgType.TEXT:
+                continue
+            try:
+                frame = json.loads(msg.data)
+            except json.JSONDecodeError:
+                await ws.send_json({"type": "error",
+                                    "error": {"code": "malformed_json"}})
+                continue
+            if frame.get("type") == "session.close":
+                break
+            if frame.get("type") != "chat.create":
+                await ws.send_json({"type": "error", "error": {
+                    "code": "unknown_frame_type",
+                    "detail": f"{frame.get('type')!r}"}})
+                continue
+            body = frame.get("request") or {}
+            event_id = frame.get("id") or f"rt-{uuid.uuid4().hex[:12]}"
+            try:
+                validate_against(schemas.REQUEST, body)
+                self.usage.check_budget(ctx)
+                models = await self._resolve_with_fallback(ctx, body)
+                _, model = models[0]
+                async for chunk in self._chat_once(ctx, model, body):
+                    if chunk.text:
+                        await ws.send_json({"type": "token", "id": event_id,
+                                            "content": chunk.text})
+                    if chunk.finish_reason:
+                        usage = dict(chunk.usage or {})
+                        self.usage.report(ctx, usage)
+                        await ws.send_json({
+                            "type": "done", "id": event_id,
+                            "finish_reason": chunk.finish_reason,
+                            "usage": usage, "model_used": model.canonical_id})
+            except ProblemError as e:
+                await ws.send_json({"type": "error", "id": event_id,
+                                    "error": e.problem.to_dict()})
+        return ws
+
     async def handle_usage(self, request: web.Request):
         ctx = request[SECURITY_CONTEXT_KEY]
         return {"tenant_id": ctx.tenant_id, "usage": self.usage.snapshot(ctx)}
@@ -448,3 +497,6 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         router.operation("GET", "/v1/batches/{batch_id}", module=m).auth_required() \
             .summary("Batch status + per-item results").response_schema(schemas.BATCH) \
             .handler(self.handle_get_batch).register()
+        router.operation("GET", "/v1/realtime", module=m).auth_required() \
+            .summary("Realtime WebSocket session (chat.create -> token/done events)") \
+            .sse_response().handler(self.handle_realtime).register()

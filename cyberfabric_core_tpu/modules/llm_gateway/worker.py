@@ -49,6 +49,15 @@ class _EngineEntry:
     batcher: Optional["_DynamicBatcher"] = None       # lockstep mode
     scheduler: Optional[ContinuousBatchingEngine] = None  # continuous mode
     model_family: str = "llama"
+    last_used: float = 0.0
+    est_bytes: int = 0
+
+    @property
+    def idle(self) -> bool:
+        if self.scheduler is not None:
+            return self.scheduler.active_slots == 0 and \
+                self.scheduler._pending.qsize() == 0
+        return True
 
 
 @dataclass
@@ -142,16 +151,87 @@ class LocalTpuWorker(LlmWorkerApi):
         key = model.canonical_id
         entry = self._entries.get(key)
         if entry is not None:
+            entry.last_used = time.monotonic()
             return entry
         lock = self._entry_locks.setdefault(key, asyncio.Lock())
         async with lock:
             entry = self._entries.get(key)
             if entry is not None:
+                entry.last_used = time.monotonic()
                 return entry
             loop = asyncio.get_running_loop()
+            self._maybe_evict_for(model)
             entry = await loop.run_in_executor(self._executor, self._build_entry, model)
+            entry.last_used = time.monotonic()
+            entry.est_bytes = self._estimate_bytes(model)
             self._entries[key] = entry
             return entry
+
+    # -------------------------------------------------------- model hot-swap
+    def _estimate_bytes(self, model: ModelInfo) -> int:
+        from ...models import get_config
+
+        opts = dict(model.engine_options or {})
+        arch = opts.get("model_config") or model.provider_model_id
+        try:
+            cfg = get_config(arch)
+        except KeyError:
+            return 0
+        weights = cfg.param_count() * 2  # bf16
+        max_seq = int(opts.get("max_seq", opts.get("max_seq_len", 2048)))
+        slots = int(opts.get("max_batch", 8))
+        cache = (cfg.num_layers * slots * max_seq * cfg.num_kv_heads
+                 * cfg.head_dim * 2 * 2)
+        return weights + cache
+
+    def _hbm_budget(self) -> Optional[int]:
+        """Usable accelerator memory. Prefer live device stats; some PJRT
+        plugins (axon) return None from memory_stats — fall back to a
+        configured/default budget with self-accounting."""
+        import jax
+
+        dev = jax.devices()[0]
+        if dev.platform == "cpu":
+            return None  # tests: count-capped eviction only
+        try:
+            stats = dev.memory_stats() or {}
+            return int(stats["bytes_limit"])
+        except Exception:  # noqa: BLE001
+            pass
+        return int(self._config.get("hbm_bytes", 16 * 1024**3))
+
+    def _maybe_evict_for(self, model: ModelInfo) -> None:
+        """Model hot-swap on a shared chip (BASELINE config #4): evict idle
+        least-recently-used engines until the incoming model's estimated
+        footprint fits (HBM-aware on TPU, count-capped everywhere)."""
+        max_models = int(self._config.get("max_loaded_models", 0))
+        need = self._estimate_bytes(model)
+
+        def must_evict() -> bool:
+            if max_models and len(self._entries) >= max_models:
+                return True
+            budget = self._hbm_budget()
+            if budget is not None and need:
+                headroom = float(self._config.get("hbm_headroom_frac", 0.1))
+                in_use = sum(e.est_bytes for e in self._entries.values())
+                return in_use + need > budget * (1.0 - headroom)
+            return False
+
+        while self._entries and must_evict():
+            idle = [(k, e) for k, e in self._entries.items() if e.idle]
+            if not idle:
+                logger.warning("hot-swap needed but no idle engine to evict")
+                return
+            victim_key, victim = min(idle, key=lambda kv: kv[1].last_used)
+            logger.info("hot-swap: evicting engine %s (idle %.1fs)", victim_key,
+                        time.monotonic() - victim.last_used)
+            if victim.scheduler is not None:
+                victim.scheduler.shutdown(timeout=5.0)
+            del self._entries[victim_key]
+            del victim
+            import gc
+
+            gc.collect()
 
     def _build_entry(self, model: ModelInfo) -> _EngineEntry:
         opts = dict(model.engine_options or {})

@@ -419,3 +419,33 @@ def test_batches_api(server):
     by_id = {it["custom_id"]: it for it in batch["requests"]}
     assert by_id["a"]["result"]["model_used"] == "local::tiny-llama"
     assert by_id["b"]["error"]["code"] == "model_not_found"
+
+
+def test_realtime_websocket(server):
+    loop, base = server
+
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.ws_connect(base + "/v1/realtime") as ws:
+                await ws.send_json({"type": "chat.create", "id": "r1", "request": {
+                    "model": "default-chat", "max_tokens": 4,
+                    "messages": [{"role": "user",
+                                  "content": [{"type": "text", "text": "hi"}]}]}})
+                events = []
+                async for msg in ws:
+                    ev = json.loads(msg.data)
+                    events.append(ev)
+                    if ev["type"] in ("done", "error"):
+                        break
+                # unknown frame type gets an error event, session stays open
+                await ws.send_json({"type": "bogus"})
+                err = json.loads((await ws.receive()).data)
+                await ws.send_json({"type": "session.close"})
+                return events, err
+
+    events, err = loop.run_until_complete(go())
+    assert events[-1]["type"] == "done"
+    assert events[-1]["model_used"] == "local::tiny-llama"
+    assert events[-1]["usage"]["output_tokens"] > 0
+    assert any(e["type"] == "token" for e in events)
+    assert err["type"] == "error" and err["error"]["code"] == "unknown_frame_type"

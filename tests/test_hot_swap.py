@@ -1,0 +1,44 @@
+"""Worker model hot-swap: LRU eviction of idle engines (BASELINE config #4
+mechanism, count-capped on CPU; HBM-budget-driven on TPU)."""
+
+import asyncio
+
+from cyberfabric_core_tpu.modules.llm_gateway.worker import LocalTpuWorker
+from cyberfabric_core_tpu.modules.sdk import ModelInfo
+
+
+def mk_model(name: str) -> ModelInfo:
+    return ModelInfo(canonical_id=f"local::{name}", provider_slug="local",
+                     provider_model_id=name,
+                     engine_options={"model_config": "tiny-llama",
+                                     "max_seq_len": 256, "max_batch": 2,
+                                     "decode_chunk": 4})
+
+
+async def one_chat(worker, model):
+    out = []
+    async for chunk in worker.chat_stream(
+            model, [{"role": "user", "content": [{"type": "text", "text": "x"}]}],
+            {"max_tokens": 3}):
+        if chunk.text:
+            out.append(chunk.text)
+        if chunk.finish_reason:
+            return out
+
+
+def test_lru_eviction_on_model_cap():
+    async def go():
+        worker = LocalTpuWorker({"max_loaded_models": 2})
+        a, b, c = mk_model("model-a"), mk_model("model-b"), mk_model("model-c")
+        await one_chat(worker, a)
+        await one_chat(worker, b)
+        assert set(worker._entries) == {"local::model-a", "local::model-b"}
+        # loading C must evict A (least recently used)
+        await one_chat(worker, c)
+        assert set(worker._entries) == {"local::model-b", "local::model-c"}
+        # A still serveable after re-load (evicts B, the now-LRU)
+        result = await one_chat(worker, a)
+        assert result is not None
+        assert set(worker._entries) == {"local::model-c", "local::model-a"}
+
+    asyncio.run(go())
