@@ -1,0 +1,59 @@
+"""API-contract regression gate.
+
+Reference: CI generates openapi.json from a running server and diffs with
+oasdiff to block breaking changes (.github/workflows/api_contracts.yml:57-77).
+Here: the committed golden route list is the contract; removing or changing a
+route fails, additions require updating the golden (a reviewed act).
+"""
+
+import json
+from pathlib import Path
+
+GOLDEN = Path(__file__).parent / "golden" / "api_routes.json"
+
+
+def _current_routes():
+    from cyberfabric_core_tpu.modkit import AppConfig, ModuleRegistry
+    from cyberfabric_core_tpu.modkit.runtime import HostRuntime, RunOptions
+    from cyberfabric_core_tpu.modkit.db import DbManager
+    import cyberfabric_core_tpu.modules  # noqa: F401
+
+    import asyncio
+
+    async def collect():
+        cfg = AppConfig.load_or_default(environ={}, cli_overrides={"modules": {
+            name: {} for name in (
+                "api_gateway", "tenant_resolver", "authn_resolver",
+                "authz_resolver", "types_registry", "module_orchestrator",
+                "nodes_registry", "model_registry", "llm_gateway",
+                "file_storage", "credstore", "file_parser",
+                "serverless_runtime", "oagw")}})
+        registry = ModuleRegistry.discover_and_build(enabled=cfg.module_names())
+        rt = HostRuntime(RunOptions(config=cfg, registry=registry,
+                                    db_manager=DbManager(in_memory=True)))
+        await rt.run_pre_init_phase()
+        await rt.run_db_phase()
+        await rt.run_init_phase()
+        await rt.run_post_init_phase()
+        await rt.run_rest_phase()
+        gw = registry.get("api_gateway").instance
+        return sorted(f"{s.method} {s.path}" for s in gw.router_specs)
+
+    return asyncio.new_event_loop().run_until_complete(collect())
+
+
+def test_api_contract_no_breaking_changes():
+    current = _current_routes()
+    if not GOLDEN.exists():
+        GOLDEN.parent.mkdir(exist_ok=True)
+        GOLDEN.write_text(json.dumps(current, indent=1))
+        raise AssertionError("golden api_routes.json created — commit it")
+    golden = json.loads(GOLDEN.read_text())
+    removed = sorted(set(golden) - set(current))
+    assert not removed, (
+        f"BREAKING API change — routes removed: {removed}\n"
+        "If intentional, update tests/golden/api_routes.json deliberately.")
+    added = sorted(set(current) - set(golden))
+    assert not added, (
+        f"new routes not in the contract golden: {added}\n"
+        "Add them to tests/golden/api_routes.json (a reviewed change).")
