@@ -1,0 +1,125 @@
+"""External provider adapter — non-managed models routed through OAGW.
+
+Reference flow (DESIGN.md:348-367): "Provider Adapter translate → OAGW call
+(credential injection, circuit breaking)". Managed models run on the local TPU
+worker; models whose registry entry is NOT managed resolve to an OAGW upstream
+named by their provider_slug and speak the OpenAI-compatible dialect:
+
+- request translation: our parts-array messages → flat content strings
+- response normalization: provider SSE chunks → ChatStreamChunk stream
+- resilience: OAGW's data plane supplies credential injection, rate limiting,
+  and the circuit breaker; this adapter only translates.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, AsyncIterator, Optional
+
+import aiohttp
+
+from ...modkit.errors import Problem, ProblemError
+from ...modkit.security import SecurityContext
+from ..oagw import OagwService, parse_sse_stream
+from ..sdk import ChatStreamChunk, ModelInfo
+
+logger = logging.getLogger("llm_external")
+
+
+def to_openai_request(messages: list[dict], params: dict, model_id: str) -> dict:
+    """Parts-array messages → OpenAI-style flat messages."""
+    flat = []
+    for m in messages:
+        content = m["content"]
+        if isinstance(content, list):
+            text = "".join(p.get("text", "") for p in content
+                           if p.get("type", "text") == "text")
+        else:
+            text = str(content)
+        flat.append({"role": m["role"], "content": text})
+    body: dict[str, Any] = {"model": model_id, "messages": flat, "stream": True,
+                            "stream_options": {"include_usage": True}}
+    for key in ("max_tokens", "temperature", "top_p", "stop", "seed"):
+        if key in params:
+            body[key] = params[key]
+    return body
+
+
+class ExternalProviderAdapter:
+    """Streams a chat completion from an external provider via the OAGW
+    data plane's upstream client (breaker + credentials + rate limit)."""
+
+    def __init__(self, oagw: OagwService) -> None:
+        self._oagw = oagw
+
+    async def chat_stream(
+        self, ctx: SecurityContext, model: ModelInfo, messages: list[dict],
+        params: dict,
+    ) -> AsyncIterator[ChatStreamChunk]:
+        upstream = self._oagw._get_upstream(ctx, model.provider_slug)
+        breaker = self._oagw._breaker_for(ctx, upstream)
+        if not breaker.allow():
+            raise ProblemError(Problem(
+                status=503, title="Service Unavailable", code="CircuitBreakerOpen",
+                detail=f"provider {model.provider_slug} circuit open"))
+
+        headers = {"Content-Type": "application/json"}
+        auth = upstream.get("auth") or {}
+        if auth and self._oagw._credstore is not None:
+            secret = await self._oagw._credstore.get_secret(ctx, auth["secret_ref"])
+            if secret is None:
+                raise ProblemError(Problem(
+                    status=502, title="Bad Gateway", code="credential_missing",
+                    detail=f"secret {auth['secret_ref']!r} not in credstore"))
+            if auth["type"] == "bearer":
+                headers["Authorization"] = f"Bearer {secret}"
+            else:
+                headers[auth.get("header_name", "X-Api-Key")] = secret
+
+        body = to_openai_request(messages, params, model.provider_model_id)
+        url = f"{upstream['base_url']}/chat/completions"
+        session = await self._oagw.session()
+        request_id = f"ext-{model.provider_slug}"
+        n_out = 0
+        try:
+            async with session.post(url, json=body, headers=headers) as resp:
+                if resp.status >= 400:
+                    if resp.status >= 500:
+                        breaker.record_failure()
+                    detail = (await resp.text())[:300]
+                    raise ProblemError(Problem(
+                        status=502, title="Bad Gateway", code="provider_error",
+                        detail=f"provider returned {resp.status}: {detail}"))
+                usage: Optional[dict] = None
+                finish: Optional[str] = None
+                async for event in parse_sse_stream(resp.content.iter_chunked(8192)):
+                    data = event.get("data", "")
+                    if data == "[DONE]":
+                        break
+                    try:
+                        chunk = json.loads(data)
+                    except json.JSONDecodeError:
+                        continue
+                    if chunk.get("usage"):
+                        usage = {
+                            "input_tokens": chunk["usage"].get("prompt_tokens", 0),
+                            "output_tokens": chunk["usage"].get("completion_tokens", 0),
+                        }
+                    for choice in chunk.get("choices", []):
+                        delta = choice.get("delta") or {}
+                        text = delta.get("content")
+                        if text:
+                            n_out += 1
+                            yield ChatStreamChunk(request_id=request_id, text=text)
+                        if choice.get("finish_reason"):
+                            finish = choice["finish_reason"]
+                breaker.record_success()
+                yield ChatStreamChunk(
+                    request_id=request_id, finish_reason=finish or "stop",
+                    usage=usage or {"input_tokens": 0, "output_tokens": n_out})
+        except aiohttp.ClientError as e:
+            breaker.record_failure()
+            raise ProblemError(Problem(
+                status=502, title="Bad Gateway", code="provider_unreachable",
+                detail=f"provider {model.provider_slug}: {e}"))

@@ -115,6 +115,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         self.batches: dict[str, dict] = {}
         self.ttft_timeout_s = 120.0
         self.total_timeout_s = 600.0
+        self._external = None
         self._job_tasks: set[asyncio.Task] = set()
 
     async def init(self, ctx: ModuleCtx) -> None:
@@ -128,6 +129,8 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         self.usage = UsageTracker(cfg.get("budgets"))
         self.ttft_timeout_s = float(cfg.get("ttft_timeout_s", 120.0))
         self.total_timeout_s = float(cfg.get("total_timeout_s", 600.0))
+        self._hub = ctx.client_hub  # external adapter resolves lazily (oagw may
+        #                             init after this module — no dep ordering)
 
     async def start(self, ctx: ModuleCtx, ready: ReadySignal) -> None:
         ready.notify_ready()
@@ -135,6 +138,16 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
     async def stop(self, ctx: ModuleCtx) -> None:
         for t in list(self._job_tasks):
             t.cancel()
+
+    def _get_external(self):
+        if self._external is None and getattr(self, "_hub", None) is not None:
+            from ..oagw import OagwService
+            from .external import ExternalProviderAdapter
+
+            oagw = self._hub.try_get(OagwService)
+            if oagw is not None:
+                self._external = ExternalProviderAdapter(oagw)
+        return self._external
 
     # ------------------------------------------------------------- application layer
     async def _resolve_with_fallback(
@@ -166,9 +179,14 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         self, ctx: SecurityContext, model: ModelInfo, body: dict
     ) -> AsyncIterator[ChatStreamChunk]:
         """One model attempt with TTFT + total timeout enforcement
-        (DESIGN.md:706-741)."""
+        (DESIGN.md:706-741). Managed models run on the local TPU worker;
+        external ones route through the OAGW provider adapter."""
         assert self.worker is not None
-        agen = self.worker.chat_stream(model, body["messages"], body)
+        external = None if model.managed else self._get_external()
+        if external is None:
+            agen = self.worker.chat_stream(model, body["messages"], body)
+        else:
+            agen = external.chat_stream(ctx, model, body["messages"], body)
         deadline = asyncio.get_event_loop().time() + self.total_timeout_s
         first = True
         while True:
