@@ -211,6 +211,14 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         body = await read_json(request, schemas.REQUEST)
         ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
         self.usage.check_budget(ctx)
+        if body.get("tools"):
+            # UC-010 step 3: resolve all three tool encodings (references via
+            # the types registry) BEFORE provider dispatch
+            from ..sdk import TypesRegistryApi
+            from .tools import normalize_tools
+
+            body["_resolved_tools"] = await normalize_tools(
+                ctx, body["tools"], self._hub.try_get(TypesRegistryApi))
         models = await self._resolve_with_fallback(ctx, body)
 
         if body.get("async"):
@@ -239,13 +247,30 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
                 if cost is not None:
                     usage["cost_estimate"] = cost
                 self.usage.report(ctx, usage)
+                text = "".join(pieces)
                 resp = {
-                    "content": [{"type": "text", "text": "".join(pieces)}],
                     "usage": usage,
                     "model_used": model.canonical_id,
                     "fallback_used": not is_primary,
                     "finish_reason": finish,
                 }
+                tool_calls = None
+                if body.get("_resolved_tools"):
+                    from .tools import build_tool_calls_response, extract_tool_call
+
+                    call = extract_tool_call(text)
+                    if call is not None:
+                        tool_calls = build_tool_calls_response(
+                            call, body["_resolved_tools"])
+                if tool_calls is not None:
+                    resp["tool_calls"] = tool_calls
+                    resp["finish_reason"] = "tool_calls"
+                else:
+                    if body.get("response_schema"):
+                        from .tools import validate_structured_output
+
+                        validate_structured_output(text, body["response_schema"])
+                    resp["content"] = [{"type": "text", "text": text}]
                 validate_against(schemas.RESPONSE, resp)
                 return resp
             except ProblemError as e:

@@ -1,0 +1,137 @@
+"""Tool calling + structured output — the application-layer mechanics.
+
+Reference: llm-gateway PRD UC-010 (tool calling; step 3 resolves tool_reference
+schemas through the Types Registry) and UC-011 (structured output with schema
+validation). Three tool encodings (SURVEY §8.1 tools/): reference / inline GTS /
+unified — all normalized to {name, description, parameters} before reaching a
+provider.
+
+Local-worker convention: the model signals a tool call by emitting a JSON object
+`{"tool_call": {"name": ..., "arguments": {...}}}` in its output; the gateway
+parses it, validates arguments against the tool's parameter schema, and finishes
+with `tool_calls` — the wire shape of core/response.v1.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Any, Optional
+
+import jsonschema
+
+from ...modkit.errors import ProblemError
+from ...modkit.security import SecurityContext
+from ..sdk import TypesRegistryApi
+
+
+async def normalize_tools(
+    ctx: SecurityContext,
+    tools: list[dict],
+    types_registry: Optional[TypesRegistryApi],
+) -> list[dict[str, Any]]:
+    """All three encodings → [{name, description, parameters}]. Unresolvable
+    references are a 422 (UC-010: fail before provider dispatch)."""
+    normalized: list[dict[str, Any]] = []
+    for tool in tools:
+        kind = tool.get("type")
+        if kind == "unified":
+            normalized.append({"name": tool["name"],
+                               "description": tool.get("description", ""),
+                               "parameters": tool.get("parameters", {"type": "object"})})
+        elif kind == "inline_gts":
+            schema = tool["schema"]
+            name = schema.get("title") or schema.get("$id", "tool").split(".")[-1]
+            normalized.append({"name": name,
+                               "description": schema.get("description", ""),
+                               "parameters": schema})
+        elif kind == "reference":
+            if types_registry is None:
+                raise ProblemError.unprocessable(
+                    "tool_reference requires the types registry",
+                    code="tool_resolution_failed")
+            entity = await types_registry.get(ctx, tool["schema_id"])
+            if entity is None:
+                raise ProblemError.unprocessable(
+                    f"tool schema {tool['schema_id']!r} not registered",
+                    code="tool_resolution_failed")
+            normalized.append({
+                "name": entity.body.get("title") or tool["schema_id"].split(".")[-2],
+                "description": entity.description or entity.body.get("description", ""),
+                "parameters": entity.body})
+        else:
+            raise ProblemError.unprocessable(f"unknown tool type {kind!r}",
+                                             code="bad_tool")
+    return normalized
+
+
+def render_tools_preamble(tools: list[dict[str, Any]]) -> str:
+    """System-prompt preamble describing available tools and the call syntax."""
+    lines = ["You can call tools. To call one, reply ONLY with JSON of the form "
+             '{"tool_call": {"name": "<tool>", "arguments": {...}}}.',
+             "Available tools:"]
+    for t in tools:
+        lines.append(f"- {t['name']}: {t['description']} "
+                     f"parameters={json.dumps(t['parameters'], separators=(',', ':'))}")
+    return "\n".join(lines)
+
+
+def extract_tool_call(text: str) -> Optional[dict[str, Any]]:
+    """Find the first `{"tool_call": ...}` JSON object in the output."""
+    idx = text.find('{"tool_call"')
+    if idx < 0:
+        idx = text.find('{ "tool_call"')
+    if idx < 0:
+        return None
+    decoder = json.JSONDecoder()
+    try:
+        obj, _ = decoder.raw_decode(text[idx:])
+    except json.JSONDecodeError:
+        return None
+    call = obj.get("tool_call")
+    if not isinstance(call, dict) or "name" not in call:
+        return None
+    return call
+
+
+def build_tool_calls_response(
+    call: dict[str, Any], tools: list[dict[str, Any]]
+) -> list[dict[str, Any]]:
+    """Validate the call against its tool's parameter schema; wire-shape it."""
+    by_name = {t["name"]: t for t in tools}
+    tool = by_name.get(call["name"])
+    if tool is None:
+        raise ProblemError.unprocessable(
+            f"model called unknown tool {call['name']!r}",
+            code="unknown_tool_called")
+    args = call.get("arguments", {})
+    validator = jsonschema.Draft202012Validator(tool["parameters"])
+    errors = [e.message for e in validator.iter_errors(args)]
+    if errors:
+        raise ProblemError.unprocessable(
+            f"tool arguments failed schema validation: {errors[:3]}",
+            code="tool_arguments_invalid")
+    return [{
+        "index": 0,
+        "id": f"call-{uuid.uuid4().hex[:12]}",
+        "function": {"name": call["name"],
+                     "arguments": json.dumps(args, separators=(",", ":"))},
+    }]
+
+
+def validate_structured_output(text: str, response_schema: dict) -> dict[str, Any]:
+    """UC-011: the final text must be JSON conforming to response_schema."""
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ProblemError.unprocessable(
+            f"structured output is not valid JSON: {e}",
+            code="structured_output_invalid")
+    validator = jsonschema.Draft202012Validator(response_schema)
+    errors = [e.message for e in validator.iter_errors(obj)]
+    if errors:
+        raise ProblemError.unprocessable(
+            "structured output failed schema validation",
+            errors=[{"field": "output", "message": m} for m in errors[:8]],
+            code="structured_output_invalid")
+    return obj

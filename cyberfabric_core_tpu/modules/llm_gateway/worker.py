@@ -289,6 +289,13 @@ class LocalTpuWorker(LlmWorkerApi):
         self, model: ModelInfo, messages: list[dict], params: dict
     ) -> AsyncIterator[ChatStreamChunk]:
         entry = await self._entry_for(model)
+        if params.get("_resolved_tools"):
+            from .tools import render_tools_preamble
+
+            preamble = {"role": "system", "content": [{
+                "type": "text",
+                "text": render_tools_preamble(params["_resolved_tools"])}]}
+            messages = [preamble] + list(messages)
         prompt = render_chat(messages, entry.model_family)
         prompt_ids = entry.tokenizer.encode(prompt)
         limits_max = int(model.limits.get("max_output_tokens", 1024)) if model.limits else 1024

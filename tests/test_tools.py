@@ -1,0 +1,192 @@
+"""Tool calling + structured output (UC-010/011) with a scripted fake worker."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from cyberfabric_core_tpu.modkit.errors import ProblemError
+from cyberfabric_core_tpu.modkit.security import SecurityContext
+from cyberfabric_core_tpu.modules.llm_gateway.tools import (
+    build_tool_calls_response,
+    extract_tool_call,
+    normalize_tools,
+    validate_structured_output,
+)
+
+WEATHER_PARAMS = {"type": "object", "required": ["city"],
+                  "properties": {"city": {"type": "string"}},
+                  "title": "get_weather", "description": "Look up weather"}
+
+
+def test_normalize_three_encodings():
+    async def go():
+        from cyberfabric_core_tpu.modules.sdk import GtsEntity
+        from cyberfabric_core_tpu.modules.types_registry import TypesRegistryService
+
+        ctx = SecurityContext.anonymous()
+        types = TypesRegistryService()
+        await types.register(SecurityContext.system(), GtsEntity(
+            gts_id="gts.acme.llm.tools.weather.v1~", kind="schema",
+            description="Look up weather", body=WEATHER_PARAMS))
+        tools = await normalize_tools(ctx, [
+            {"type": "unified", "name": "add", "description": "adds",
+             "parameters": {"type": "object"}},
+            {"type": "inline_gts", "schema": WEATHER_PARAMS},
+            {"type": "reference", "schema_id": "gts.acme.llm.tools.weather.v1~"},
+        ], types)
+        assert [t["name"] for t in tools] == ["add", "get_weather", "get_weather"]
+        # unresolvable reference → 422
+        with pytest.raises(ProblemError) as e:
+            await normalize_tools(ctx, [{"type": "reference",
+                                         "schema_id": "gts.x.y.z.ghost.v1~"}], types)
+        assert e.value.problem.status == 422
+
+    asyncio.run(go())
+
+
+def test_extract_and_validate_tool_call():
+    text = 'Thinking... {"tool_call": {"name": "get_weather", "arguments": {"city": "berlin"}}} done'
+    call = extract_tool_call(text)
+    assert call == {"name": "get_weather", "arguments": {"city": "berlin"}}
+    tools = [{"name": "get_weather", "description": "", "parameters": WEATHER_PARAMS}]
+    tc = build_tool_calls_response(call, tools)
+    assert tc[0]["function"]["name"] == "get_weather"
+    assert json.loads(tc[0]["function"]["arguments"]) == {"city": "berlin"}
+    # bad arguments rejected against the schema
+    with pytest.raises(ProblemError) as e:
+        build_tool_calls_response({"name": "get_weather", "arguments": {}}, tools)
+    assert e.value.problem.extensions.get("code") or e.value.problem.code == "tool_arguments_invalid"
+    # unknown tool rejected
+    with pytest.raises(ProblemError):
+        build_tool_calls_response({"name": "rm_rf", "arguments": {}}, tools)
+    assert extract_tool_call("no tools here") is None
+    assert extract_tool_call('{"tool_call": "not-an-object"}') is None
+
+
+def test_structured_output_validation():
+    schema = {"type": "object", "required": ["answer"],
+              "properties": {"answer": {"type": "integer"}}}
+    assert validate_structured_output('{"answer": 42}', schema) == {"answer": 42}
+    with pytest.raises(ProblemError) as e:
+        validate_structured_output("plain prose", schema)
+    assert "not valid JSON" in e.value.problem.detail
+    with pytest.raises(ProblemError) as e:
+        validate_structured_output('{"answer": "forty-two"}', schema)
+    assert e.value.problem.code == "structured_output_invalid"
+
+
+@pytest.fixture()
+def scripted_stack(fresh_registry):
+    """Gateway + llm_gateway with a scripted fake worker (the ClientHub seam)."""
+    from cyberfabric_core_tpu.modkit import AppConfig, ClientHub, ModuleRegistry, RunOptions
+    from cyberfabric_core_tpu.modkit.db import DbManager
+    from cyberfabric_core_tpu.modkit.registry import Registration
+    from cyberfabric_core_tpu.modkit.runtime import HostRuntime
+    from cyberfabric_core_tpu.gateway.module import ApiGatewayModule
+    from cyberfabric_core_tpu.modules.llm_gateway.module import LlmGatewayModule
+    from cyberfabric_core_tpu.modules.model_registry import ModelRegistryModule
+    from cyberfabric_core_tpu.modules.sdk import ChatStreamChunk, LlmWorkerApi
+
+    fresh_registry._REGISTRATIONS.clear()
+    regs = [
+        Registration("api_gateway", ApiGatewayModule, (),
+                     ("rest_host", "stateful", "system")),
+        Registration("model_registry", ModelRegistryModule, (), ("db", "rest")),
+        Registration("llm_gateway", LlmGatewayModule, ("model_registry",),
+                     ("rest", "stateful")),
+    ]
+
+    script = {"text": "hello"}
+
+    class FakeWorker(LlmWorkerApi):
+        async def chat_stream(self, model, messages, params):
+            self.last_messages = messages
+            yield ChatStreamChunk(request_id="fake", text=script["text"])
+            yield ChatStreamChunk(request_id="fake", finish_reason="stop",
+                                  usage={"input_tokens": 3, "output_tokens": 2})
+
+        async def embed(self, model, inputs, params):
+            return [[0.0]]
+
+        async def health(self):
+            return {"status": "ok"}
+
+    worker = FakeWorker()
+
+    async def boot():
+        hub = ClientHub()
+        hub.register(LlmWorkerApi, worker)  # pre-registered seam (client_hub.rs:16)
+        cfg = AppConfig.load_or_default(environ={}, cli_overrides={"modules": {
+            "api_gateway": {"config": {"bind_addr": "127.0.0.1:0",
+                                       "auth_disabled": True}},
+            "model_registry": {"config": {"seed_tenant": "default", "models": [
+                {"provider_slug": "fake", "provider_model_id": "m1",
+                 "approval_state": "approved", "managed": True}]}},
+            "llm_gateway": {},
+        }})
+        registry = ModuleRegistry.discover_and_build(extra=regs)
+        rt = HostRuntime(RunOptions(config=cfg, registry=registry, client_hub=hub,
+                                    db_manager=DbManager(in_memory=True)))
+        await rt.run_setup_phases()
+        return rt, f"http://127.0.0.1:{registry.get('api_gateway').instance.bound_port}"
+
+    loop = asyncio.new_event_loop()
+    rt, base = loop.run_until_complete(boot())
+    yield loop, base, script, worker
+    rt.root_token.cancel()
+    loop.run_until_complete(rt.run_stop_phase())
+    loop.close()
+
+
+def _chat(loop, base, body):
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                return r.status, json.loads(await r.read())
+
+    return loop.run_until_complete(go())
+
+
+def test_tool_call_end_to_end(scripted_stack):
+    loop, base, script, worker = scripted_stack
+    script["text"] = '{"tool_call": {"name": "get_weather", "arguments": {"city": "oslo"}}}'
+    status, body = _chat(loop, base, {
+        "model": "fake::m1",
+        "messages": [{"role": "user", "content": [{"type": "text",
+                                                   "text": "weather in oslo?"}]}],
+        "tools": [{"type": "unified", "name": "get_weather",
+                   "description": "Look up weather",
+                   "parameters": WEATHER_PARAMS}]})
+    assert status == 200, body
+    assert body["finish_reason"] == "tool_calls"
+    assert body["tool_calls"][0]["function"]["name"] == "get_weather"
+    assert json.loads(body["tool_calls"][0]["function"]["arguments"]) == {"city": "oslo"}
+    assert "content" not in body
+
+
+def test_tools_preamble_rendering():
+    """LocalTpuWorker injects the tool preamble; verify the rendered shape."""
+    from cyberfabric_core_tpu.modules.llm_gateway.tools import render_tools_preamble
+
+    text = render_tools_preamble([
+        {"name": "get_weather", "description": "Look up weather",
+         "parameters": WEATHER_PARAMS}])
+    assert '"tool_call"' in text and "get_weather" in text and "city" in text
+
+
+def test_structured_output_end_to_end(scripted_stack):
+    loop, base, script, _ = scripted_stack
+    schema = {"type": "object", "required": ["answer"],
+              "properties": {"answer": {"type": "integer"}}}
+    script["text"] = '{"answer": 7}'
+    status, body = _chat(loop, base, {
+        "model": "fake::m1", "response_schema": schema,
+        "messages": [{"role": "user", "content": [{"type": "text", "text": "q"}]}]})
+    assert status == 200 and body["content"][0]["text"] == '{"answer": 7}'
+    script["text"] = "not json at all"
+    status, body = _chat(loop, base, {
+        "model": "fake::m1", "response_schema": schema,
+        "messages": [{"role": "user", "content": [{"type": "text", "text": "q"}]}]})
+    assert status == 422 and body["code"] == "structured_output_invalid"
