@@ -105,7 +105,26 @@ def _migrate_0001(c):
     )
 
 
-_MIGRATIONS = [Migration("0001_serverless", _migrate_0001)]
+def _migrate_0002(c):
+    c.execute(
+        "CREATE TABLE triggers ("
+        "id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, "
+        "topic TEXT NOT NULL, entrypoint_name TEXT NOT NULL, "
+        "params TEXT, enabled INTEGER DEFAULT 1)"
+    )
+    c.execute("CREATE INDEX idx_triggers_topic ON triggers (tenant_id, topic)")
+
+
+_MIGRATIONS = [Migration("0001_serverless", _migrate_0001),
+               Migration("0002_triggers", _migrate_0002)]
+
+TRIGGERS = ScopableEntity(
+    table="triggers",
+    field_map={"id": "id", "tenant_id": "tenant_id", "topic": "topic",
+               "entrypoint_name": "entrypoint_name", "params": "params",
+               "enabled": "enabled"},
+    json_cols=("params",),
+)
 
 #: Entrypoint status machine (ADR update_entrypoint_status actions)
 _STATUS_ACTIONS: dict[str, tuple[str, str]] = {
@@ -364,10 +383,14 @@ class ServerlessService(ServerlessApi):
             merged = {**(definition.get("params") or {}), **params}
             return await handler(ctx, merged)
         # workflow: sequential steps; ``$prev`` references the previous result;
-        # suspension honored between steps
+        # suspension honored between steps; a step failure runs COMPENSATIONS of
+        # completed steps in reverse order (saga semantics, serverless PRD:
+        # compensation/saga + CompensationContext)
         prev: Any = None
         results = []
-        for i, step in enumerate(definition.get("steps", [])):
+        completed: list[tuple[dict, Any]] = []  # (step def, its result)
+        steps = definition.get("steps", [])
+        for i, step in enumerate(steps):
             gate = self._suspended.get(inv_id)
             if gate is not None:
                 raise _Suspended()
@@ -377,11 +400,66 @@ class ServerlessService(ServerlessApi):
                 if v == "$prev":
                     step_params[k] = prev
             step_params.update(params if i == 0 else {})
-            timeline.append(self._evt("step_started", step.get("name", step["function"])))
-            prev = await handler(ctx, step_params)
+            name = step.get("name", step["function"])
+            timeline.append(self._evt("step_started", name))
+            try:
+                prev = await handler(ctx, step_params)
+            except Exception as e:  # noqa: BLE001 — trigger the saga rollback
+                timeline.append(self._evt("step_failed", f"{name}: {e}"[:300]))
+                await self._compensate(ctx, completed, timeline)
+                raise
             results.append(_jsonable(prev))
-            timeline.append(self._evt("step_completed", step.get("name", step["function"])))
+            completed.append((step, prev))
+            timeline.append(self._evt("step_completed", name))
         return {"steps": results, "output": _jsonable(prev)}
+
+    async def _compensate(self, ctx: SecurityContext,
+                          completed: list[tuple[dict, Any]], timeline: list) -> None:
+        """Run each completed step's compensation in reverse order. The
+        CompensationContext: the original step result is available as $result."""
+        for step, result in reversed(completed):
+            comp = step.get("compensate")
+            if not comp:
+                continue
+            name = comp.get("name", f"compensate:{step.get('name', step['function'])}")
+            handler = self._functions.get(comp.get("function"))
+            if handler is None:
+                timeline.append(self._evt("compensation_skipped",
+                                          f"{name}: unknown function"))
+                continue
+            comp_params = dict(comp.get("params") or {})
+            for k, v in list(comp_params.items()):
+                if v == "$result":
+                    comp_params[k] = result
+            timeline.append(self._evt("compensation_started", name))
+            try:
+                await handler(ctx, comp_params)
+                timeline.append(self._evt("compensation_completed", name))
+            except Exception as e:  # noqa: BLE001 — best-effort rollback
+                timeline.append(self._evt("compensation_failed", f"{name}: {e}"[:300]))
+
+    # ------------------------------------------------------------- event triggers
+    async def create_trigger(self, ctx: SecurityContext, spec: dict) -> dict:
+        self._resolve_ep(ctx, spec["entrypoint"])  # must exist
+        if not spec.get("topic"):
+            raise ProblemError.bad_request("topic required")
+        return self._db.secure(ctx, TRIGGERS).insert({
+            "topic": spec["topic"], "entrypoint_name": spec["entrypoint"],
+            "params": spec.get("params") or {}, "enabled": True})
+
+    async def publish_event(self, ctx: SecurityContext, topic: str,
+                            payload: dict) -> list[str]:
+        """Fire all enabled triggers on the topic as async invocations; the
+        event payload is available to the entrypoint as params['event']."""
+        fired: list[str] = []
+        conn = self._db.secure(ctx, TRIGGERS)
+        for trig in conn.select(where={"topic": topic, "enabled": True}):
+            out = await self.start_invocation(ctx, {
+                "entrypoint": trig["entrypoint_name"], "mode": "async",
+                "params": {**(trig.get("params") or {}), "event": payload}})
+            if out.get("record"):
+                fired.append(out["record"]["id"])
+        return fired
 
     # ------------------------------------------------------------- visibility/control
     async def get_invocation(self, ctx: SecurityContext, invocation_id: str) -> dict:
@@ -654,3 +732,27 @@ class ServerlessRuntimeModule(Module, DatabaseCapability, RestApiCapability,
             .auth_required().summary("Invocation timeline events").handler(timeline).register()
         router.operation("POST", "/v1/serverless/schedules", module=m).auth_required() \
             .summary("Create an interval schedule").handler(create_schedule).register()
+
+        async def create_trigger(request: web.Request):
+            body = await read_json(request, {
+                "type": "object", "required": ["entrypoint", "topic"],
+                "properties": {"entrypoint": {"type": "string"},
+                               "topic": {"type": "string"},
+                               "params": {"type": "object"}},
+                "additionalProperties": False})
+            return await svc.create_trigger(request[SECURITY_CONTEXT_KEY], body), 201
+
+        async def publish(request: web.Request):
+            body = await read_json(request, {
+                "type": "object", "required": ["topic"],
+                "properties": {"topic": {"type": "string"},
+                               "payload": {"type": "object"}},
+                "additionalProperties": False})
+            fired = await svc.publish_event(request[SECURITY_CONTEXT_KEY],
+                                            body["topic"], body.get("payload") or {})
+            return {"fired_invocations": fired}, 202
+
+        router.operation("POST", "/v1/serverless/triggers", module=m).auth_required() \
+            .summary("Bind an event topic to an entrypoint").handler(create_trigger).register()
+        router.operation("POST", "/v1/serverless/events", module=m).auth_required() \
+            .summary("Publish an event (fires bound triggers)").handler(publish).register()

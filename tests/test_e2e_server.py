@@ -449,3 +449,54 @@ def test_realtime_websocket(server):
     assert events[-1]["usage"]["output_tokens"] > 0
     assert any(e["type"] == "token" for e in events)
     assert err["type"] == "error" and err["error"]["code"] == "unknown_frame_type"
+
+
+def test_serverless_saga_compensation(server):
+    loop, _ = server
+    # workflow: step1 echo (with compensation), step2 fails -> step1 compensated
+    status, _ = req(server, "POST", "/v1/serverless/entrypoints", json={
+        "name": "saga", "kind": "workflow",
+        "definition": {"steps": [
+            {"name": "reserve", "function": "echo", "params": {"res": "r1"},
+             "compensate": {"function": "echo", "params": {"undo": "$result"}}},
+            {"name": "charge", "function": "fail"},
+        ]}})
+    req(server, "POST", "/v1/serverless/entrypoints/saga/status",
+        json={"action": "activate"})
+    status, out = req(server, "POST", "/v1/serverless/invocations",
+                      json={"entrypoint": "saga"})
+    rec = out["record"]
+    assert rec["status"] == "failed"
+    events = [e["event"] for e in rec["timeline"]]
+    assert "step_failed" in events
+    i_fail = events.index("step_failed")
+    assert "compensation_started" in events[i_fail:]
+    assert "compensation_completed" in events[i_fail:]
+
+
+def test_serverless_event_triggers(server):
+    loop, _ = server
+    req(server, "POST", "/v1/serverless/entrypoints", json={
+        "name": "on-upload", "kind": "function", "definition": {"function": "echo"}})
+    req(server, "POST", "/v1/serverless/entrypoints/on-upload/status",
+        json={"action": "activate"})
+    status, trig = req(server, "POST", "/v1/serverless/triggers", json={
+        "entrypoint": "on-upload", "topic": "file.uploaded",
+        "params": {"source": "trigger"}})
+    assert status == 201
+    status, out = req(server, "POST", "/v1/serverless/events", json={
+        "topic": "file.uploaded", "payload": {"file_id": "f1"}})
+    assert status == 202 and len(out["fired_invocations"]) == 1
+    inv_id = out["fired_invocations"][0]
+    for _ in range(100):
+        status, rec = req(server, "GET", f"/v1/serverless/invocations/{inv_id}")
+        if rec["status"] in ("completed", "failed"):
+            break
+        loop.run_until_complete(asyncio.sleep(0.05))
+    assert rec["status"] == "completed"
+    assert rec["result"]["event"] == {"file_id": "f1"}
+    assert rec["result"]["source"] == "trigger"
+    # publishing on an unbound topic fires nothing
+    status, out = req(server, "POST", "/v1/serverless/events",
+                      json={"topic": "nobody.listens"})
+    assert out["fired_invocations"] == []
