@@ -45,7 +45,29 @@ def pick_model(devices) -> tuple[str, int]:
     return "tiny-llama", get_config("tiny-llama").param_count() * 2
 
 
+def _arm_watchdog(seconds: float) -> None:
+    """The tunneled device can wedge (stale relay claim) and hang every device
+    op; the bench must emit its one JSON line regardless."""
+    import os
+    import threading
+
+    def fire() -> None:
+        print(json.dumps({
+            "metric": "bench watchdog: device unreachable/wedged",
+            "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+            "error": f"no result within {seconds:.0f}s — TPU transport hung",
+        }), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+
+
 def main() -> int:
+    import os
+
+    _arm_watchdog(float(os.environ.get("BENCH_WATCHDOG_S", "540")))
     import jax
 
     devices = jax.devices()
