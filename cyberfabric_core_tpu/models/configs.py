@@ -30,6 +30,9 @@ class ModelConfig:
     tie_embeddings: bool = False
     sliding_window: Optional[int] = None  # Mistral-style SWA
     attention_bias: bool = False
+    # mixture-of-experts (0 = dense MLP)
+    num_experts: int = 0
+    experts_per_token: int = 2
     # bert-family extras
     layer_norm_eps: float = 1e-12
     type_vocab_size: int = 2
@@ -75,6 +78,17 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         name="phi-3-mini", architecture="llama", vocab_size=32064, hidden_size=3072,
         intermediate_size=8192, num_layers=32, num_heads=32, num_kv_heads=32,
         head_dim=96, max_position=4096, rope_theta=10000.0,
+    ),
+    "tiny-moe": ModelConfig(
+        name="tiny-moe", architecture="llama", vocab_size=512, hidden_size=64,
+        intermediate_size=96, num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_position=256, rope_theta=10000.0, num_experts=4, experts_per_token=2,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b", architecture="llama", vocab_size=32000,
+        hidden_size=4096, intermediate_size=14336, num_layers=32, num_heads=32,
+        num_kv_heads=8, head_dim=128, max_position=8192, rope_theta=1000000.0,
+        num_experts=8, experts_per_token=2,
     ),
     "bge-base-en": ModelConfig(
         name="bge-base-en", architecture="bert", vocab_size=30522, hidden_size=768,

@@ -45,20 +45,32 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         scale = jnp.asarray(1.0 / (shape[-2] if len(shape) > 1 else shape[-1]) ** 0.5, dtype)
         return jax.random.normal(rng, shape, dtype) * scale
 
-    params: Params = {
-        "embed": w(next(k), V, H),
-        "final_norm": jnp.ones((H,), dtype),
-        "layers": {
-            "attn_norm": jnp.ones((L, H), dtype),
-            "wq": w(next(k), L, H, Dq),
-            "wk": w(next(k), L, H, Dkv),
-            "wv": w(next(k), L, H, Dkv),
-            "wo": w(next(k), L, Dq, H),
-            "mlp_norm": jnp.ones((L, H), dtype),
+    layers: dict[str, jnp.ndarray] = {
+        "attn_norm": jnp.ones((L, H), dtype),
+        "wq": w(next(k), L, H, Dq),
+        "wk": w(next(k), L, H, Dkv),
+        "wv": w(next(k), L, H, Dkv),
+        "wo": w(next(k), L, Dq, H),
+        "mlp_norm": jnp.ones((L, H), dtype),
+    }
+    if cfg.num_experts > 0:
+        E = cfg.num_experts
+        layers.update({
+            "router": w(next(k), L, H, E),
+            "moe_gate": w(next(k), L, E, H, I),
+            "moe_up": w(next(k), L, E, H, I),
+            "moe_down": w(next(k), L, E, I, H),
+        })
+    else:
+        layers.update({
             "gate": w(next(k), L, H, I),
             "up": w(next(k), L, H, I),
             "down": w(next(k), L, I, H),
-        },
+        })
+    params: Params = {
+        "embed": w(next(k), V, H),
+        "final_norm": jnp.ones((H,), dtype),
+        "layers": layers,
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = w(next(k), H, V)
@@ -75,6 +87,35 @@ def _insert_kv(cache_l: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray) -> jn
     return jax.vmap(
         lambda c, n, s: jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
     )(cache_l, new, start)
+
+
+def _moe_mlp(x: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
+    """Mixtral-style sparse MoE MLP (top-k routing, softmax over selected).
+
+    TPU formulation: all experts compute densely and combine under the top-k
+    gate mask — static shapes, no scatter, and with expert weights sharded over
+    the ``ep`` mesh axis each device computes only its local experts' einsums
+    while XLA inserts one all-reduce for the combine. (Block-sparse grouped
+    matmuls are the round-2 optimization; routing/combine semantics are final.)
+    """
+    E, K = cfg.num_experts, cfg.experts_per_token
+    router_logits = jnp.einsum("bth,he->bte", x, lp["router"],
+                               preferred_element_type=jnp.float32)
+    # top-k gate: softmax over the selected experts only (Mixtral semantics)
+    top_vals, _ = jax.lax.top_k(router_logits, K)  # [B, T, K]
+    threshold = top_vals[..., K - 1:K]
+    mask = router_logits >= threshold
+    masked_logits = jnp.where(mask, router_logits, -1e30)
+    weights = jax.nn.softmax(masked_logits, axis=-1)  # [B, T, E], zeros off-topk
+
+    gate = jnp.einsum("bth,ehi->btei", x, lp["moe_gate"],
+                      preferred_element_type=jnp.float32)
+    up = jnp.einsum("bth,ehi->btei", x, lp["moe_up"],
+                    preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    expert_out = jnp.einsum("btei,eih->bteh", act, lp["moe_down"],
+                            preferred_element_type=jnp.float32)
+    return jnp.einsum("bteh,bte->bth", expert_out, weights.astype(jnp.float32))
 
 
 def forward(
@@ -136,13 +177,16 @@ def forward(
                            preferred_element_type=jnp.float32).astype(h.dtype)
 
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        gate = jnp.einsum("bth,hi->bti", x, lp["gate"],
-                          preferred_element_type=jnp.float32)
-        up = jnp.einsum("bth,hi->bti", x, lp["up"],
-                        preferred_element_type=jnp.float32)
-        act = (jax.nn.silu(gate) * up).astype(h.dtype)
-        h = h + jnp.einsum("bti,ih->bth", act, lp["down"],
-                           preferred_element_type=jnp.float32).astype(h.dtype)
+        if cfg.num_experts > 0:
+            h = h + _moe_mlp(x, lp, cfg).astype(h.dtype)
+        else:
+            gate = jnp.einsum("bth,hi->bti", x, lp["gate"],
+                              preferred_element_type=jnp.float32)
+            up = jnp.einsum("bth,hi->bti", x, lp["up"],
+                            preferred_element_type=jnp.float32)
+            act = (jax.nn.silu(gate) * up).astype(h.dtype)
+            h = h + jnp.einsum("bti,ih->bth", act, lp["down"],
+                               preferred_element_type=jnp.float32).astype(h.dtype)
         return h, (k_cache_l, v_cache_l)
 
     k_cache, v_cache = cache

@@ -8,6 +8,8 @@ insert collectives):
 - ``tp``  — tensor parallel: attention heads / MLP columns sharded over ICI
   (BASELINE config #5: Llama-3-70B across v5e-8).
 - ``sp``  — sequence parallel: ring attention over the sequence axis (long context).
+- ``ep``  — expert parallel: MoE expert weights sharded across devices; the
+  top-k combine is XLA's all-reduce.
 
 On multi-slice systems the mesh should be built with dp outermost so dp crosses DCN
 and tp/sp ride ICI (collective locality).
@@ -27,10 +29,11 @@ class MeshConfig:
     dp: int = 1
     tp: int = 1
     sp: int = 1
+    ep: int = 1  # expert parallel (MoE experts sharded over this axis)
 
     @property
     def total(self) -> int:
-        return self.dp * self.tp * self.sp
+        return self.dp * self.tp * self.sp * self.ep
 
     @classmethod
     def for_devices(cls, n: int, tp: int | None = None) -> "MeshConfig":
@@ -51,5 +54,5 @@ def build_mesh(config: MeshConfig, devices=None) -> Mesh:
         raise ValueError(
             f"mesh {config} needs {config.total} devices, have {len(devices)}"
         )
-    arr = np.asarray(devices).reshape(config.dp, config.tp, config.sp)
-    return Mesh(arr, axis_names=("dp", "tp", "sp"))
+    arr = np.asarray(devices).reshape(config.dp, config.tp, config.sp, config.ep)
+    return Mesh(arr, axis_names=("dp", "tp", "sp", "ep"))

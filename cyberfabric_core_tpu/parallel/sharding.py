@@ -48,6 +48,17 @@ def llama_param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
     }
     if not cfg.tie_embeddings:
         tree["lm_head"] = ns(None, "tp")  # vocab-sharded head
+    if cfg.num_experts > 0:
+        # expert parallelism: the expert dim shards over ep; each device computes
+        # its local experts, the weighted combine is one all-reduce over ep
+        tree["layers"].update({
+            "router": ns(None, None, None),
+            "moe_gate": ns(None, "ep", None, "tp"),
+            "moe_up": ns(None, "ep", None, "tp"),
+            "moe_down": ns(None, "ep", "tp", None),
+        })
+        for dense_key in ("gate", "up", "down"):
+            tree["layers"].pop(dense_key, None)
     return tree
 
 
