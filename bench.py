@@ -27,8 +27,10 @@ def log(msg: str) -> None:
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
-def pick_model(devices) -> tuple[str, int]:
-    """Largest llama-family BASELINE model fitting the chip's free HBM."""
+def pick_model(devices) -> tuple[str, str, int]:
+    """The BASELINE headline model at the best precision the chip fits:
+    Llama-3-8B bf16 if HBM allows, else Llama-3-8B W8 (8.1 GB — the north-star
+    model on one v5e chip), else smaller configs."""
     from cyberfabric_core_tpu.models import get_config
 
     try:
@@ -37,12 +39,14 @@ def pick_model(devices) -> tuple[str, int]:
     except Exception:
         limit = 16 * 1024**3
     budget = int(limit * 0.82)  # leave room for cache + activations + fragmentation
-    for name in ("llama-3-8b", "mistral-7b", "phi-3-mini"):
+    candidates = [("llama-3-8b", "none", 2), ("llama-3-8b", "int8", 1),
+                  ("mistral-7b", "none", 2), ("phi-3-mini", "none", 2)]
+    for name, quant, bytes_per in candidates:
         cfg = get_config(name)
-        need = cfg.param_count() * 2  # bf16
+        need = cfg.param_count() * bytes_per
         if need < budget:
-            return name, need
-    return "tiny-llama", get_config("tiny-llama").param_count() * 2
+            return name, quant, need
+    return "tiny-llama", "none", get_config("tiny-llama").param_count() * 2
 
 
 def _arm_watchdog(seconds: float) -> None:
@@ -77,16 +81,16 @@ def main() -> int:
     from cyberfabric_core_tpu.runtime import EngineConfig, InferenceEngine, SamplingParams
 
     if on_tpu:
-        model_name, need = pick_model(devices)
+        model_name, quant, need = pick_model(devices)
     else:
-        model_name, need = "tiny-llama", 0
-    log(f"model: {model_name} (~{need/1e9:.1f} GB weights bf16)")
+        model_name, quant, need = "tiny-llama", "none", 0
+    log(f"model: {model_name} quant={quant} (~{need/1e9:.1f} GB weights)")
 
     max_seq = 1024 if on_tpu else 128
     prompt_len = 128 if on_tpu else 16
     gen_tokens = 256 if on_tpu else 16
     cfg = EngineConfig(model=model_name, max_seq_len=max_seq, max_batch=1,
-                       decode_chunk=64 if on_tpu else 4)
+                       decode_chunk=64 if on_tpu else 4, quantization=quant)
 
     t0 = time.monotonic()
     engine = InferenceEngine(cfg, seed=0)
@@ -130,9 +134,10 @@ def main() -> int:
     tps = float(np.median(rates))
     log(f"decode tokens/sec: median={tps:.1f} all={['%.1f' % r for r in rates]}")
 
+    precision = "int8-weights" if quant == "int8" else "bf16"
     result = {
         "metric": f"{model_name} greedy decode tokens/sec/chip "
-                  f"({'TPU v5e-1' if on_tpu else 'cpu-dev'}, bf16, bs=1, "
+                  f"({'TPU v5e-1' if on_tpu else 'cpu-dev'}, {precision}, bs=1, "
                   f"prompt {prompt_len}, synthetic weights)",
         "value": round(tps, 2),
         "unit": "tokens/sec/chip",

@@ -33,6 +33,26 @@ Params = dict[str, Any]
 KVCache = tuple[jnp.ndarray, jnp.ndarray]  # (k, v): [L, B, S, Hkv, D]
 
 
+def _wmat(w, dtype):
+    """Weight leaf → (matrix, out-channel scale or None). Quantized leaves are
+    {"q": int8, "s": f32} (runtime/quant.py); the convert sits inside the dot
+    operand so XLA fuses it and streams int8 from HBM."""
+    if isinstance(w, dict):
+        return w["q"].astype(dtype), w["s"]
+    return w, None
+
+
+def _scaled(y: jnp.ndarray, scale) -> jnp.ndarray:
+    return y if scale is None else y * scale
+
+
+def embed_lookup(embed, ids: jnp.ndarray, dtype) -> jnp.ndarray:
+    if isinstance(embed, dict):  # {"qe","se"}: int8 rows with per-row scales
+        rows = embed["qe"][ids].astype(jnp.float32) * embed["se"][ids][..., None]
+        return rows.astype(dtype)
+    return embed[ids]
+
+
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     """Random-init parameters at model shape (bench/synthetic-weight path)."""
     H, I, V, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
@@ -108,13 +128,16 @@ def _moe_mlp(x: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
     masked_logits = jnp.where(mask, router_logits, -1e30)
     weights = jax.nn.softmax(masked_logits, axis=-1)  # [B, T, E], zeros off-topk
 
-    gate = jnp.einsum("bth,ehi->btei", x, lp["moe_gate"],
-                      preferred_element_type=jnp.float32)
-    up = jnp.einsum("bth,ehi->btei", x, lp["moe_up"],
-                    preferred_element_type=jnp.float32)
+    g_m, g_s = _wmat(lp["moe_gate"], x.dtype)
+    u_m, u_s = _wmat(lp["moe_up"], x.dtype)
+    d_m, d_s = _wmat(lp["moe_down"], x.dtype)
+    gate = _scaled(jnp.einsum("bth,ehi->btei", x, g_m,
+                   preferred_element_type=jnp.float32), g_s)
+    up = _scaled(jnp.einsum("bth,ehi->btei", x, u_m,
+                 preferred_element_type=jnp.float32), u_s)
     act = (jax.nn.silu(gate) * up).astype(x.dtype)
-    expert_out = jnp.einsum("btei,eih->bteh", act, lp["moe_down"],
-                            preferred_element_type=jnp.float32)
+    expert_out = _scaled(jnp.einsum("btei,eih->bteh", act, d_m,
+                         preferred_element_type=jnp.float32), d_s)
     return jnp.einsum("bteh,bte->bth", expert_out, weights.astype(jnp.float32))
 
 
@@ -138,18 +161,22 @@ def forward(
     B, T = input_ids.shape
     Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
-    h = params["embed"][input_ids]  # [B, T, H] gather
+    h = embed_lookup(params["embed"], input_ids,
+                     params["final_norm"].dtype)  # [B, T, H] gather
     kv_len_after = cache_start + T  # valid cache length after this step's insert
 
     def layer_body(h, xs):
         lp, k_cache_l, v_cache_l = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("bth,hd->btd", x, lp["wq"],
-                       preferred_element_type=jnp.float32).astype(h.dtype)
-        kproj = jnp.einsum("bth,hd->btd", x, lp["wk"],
-                           preferred_element_type=jnp.float32).astype(h.dtype)
-        vproj = jnp.einsum("bth,hd->btd", x, lp["wv"],
-                           preferred_element_type=jnp.float32).astype(h.dtype)
+        wq_m, wq_s = _wmat(lp["wq"], h.dtype)
+        wk_m, wk_s = _wmat(lp["wk"], h.dtype)
+        wv_m, wv_s = _wmat(lp["wv"], h.dtype)
+        q = _scaled(jnp.einsum("bth,hd->btd", x, wq_m,
+                    preferred_element_type=jnp.float32), wq_s).astype(h.dtype)
+        kproj = _scaled(jnp.einsum("bth,hd->btd", x, wk_m,
+                        preferred_element_type=jnp.float32), wk_s).astype(h.dtype)
+        vproj = _scaled(jnp.einsum("bth,hd->btd", x, wv_m,
+                        preferred_element_type=jnp.float32), wv_s).astype(h.dtype)
         q = q.reshape(B, T, Hq, D)
         kproj = kproj.reshape(B, T, Hkv, D)
         vproj = vproj.reshape(B, T, Hkv, D)
@@ -173,20 +200,24 @@ def forward(
                 sliding_window=cfg.sliding_window,
             )
         attn = attn.reshape(B, T, Hq * D)
-        h = h + jnp.einsum("btd,dh->bth", attn, lp["wo"],
-                           preferred_element_type=jnp.float32).astype(h.dtype)
+        wo_m, wo_s = _wmat(lp["wo"], h.dtype)
+        h = h + _scaled(jnp.einsum("btd,dh->bth", attn, wo_m,
+                        preferred_element_type=jnp.float32), wo_s).astype(h.dtype)
 
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.num_experts > 0:
             h = h + _moe_mlp(x, lp, cfg).astype(h.dtype)
         else:
-            gate = jnp.einsum("bth,hi->bti", x, lp["gate"],
-                              preferred_element_type=jnp.float32)
-            up = jnp.einsum("bth,hi->bti", x, lp["up"],
-                            preferred_element_type=jnp.float32)
+            g_m, g_s = _wmat(lp["gate"], h.dtype)
+            u_m, u_s = _wmat(lp["up"], h.dtype)
+            d_m, d_s = _wmat(lp["down"], h.dtype)
+            gate = _scaled(jnp.einsum("bth,hi->bti", x, g_m,
+                           preferred_element_type=jnp.float32), g_s)
+            up = _scaled(jnp.einsum("bth,hi->bti", x, u_m,
+                         preferred_element_type=jnp.float32), u_s)
             act = (jax.nn.silu(gate) * up).astype(h.dtype)
-            h = h + jnp.einsum("bti,ih->bth", act, lp["down"],
-                               preferred_element_type=jnp.float32).astype(h.dtype)
+            h = h + _scaled(jnp.einsum("bti,ih->bth", act, d_m,
+                            preferred_element_type=jnp.float32), d_s).astype(h.dtype)
         return h, (k_cache_l, v_cache_l)
 
     k_cache, v_cache = cache
@@ -242,7 +273,17 @@ def insert_slot_kv(
 
 def lm_head_logits(params: Params, cfg: ModelConfig, hidden: jnp.ndarray) -> jnp.ndarray:
     """hidden [B, H] (or [B, T, H]) → logits in f32."""
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    head = params["embed"] if cfg.tie_embeddings else params["lm_head"]
+    if isinstance(head, dict):
+        if "qe" in head:  # tied quantized embed: rows [V, H] with per-row scales
+            logits = jnp.einsum("...h,vh->...v", hidden, head["qe"].astype(hidden.dtype),
+                                preferred_element_type=jnp.float32)
+            return logits * head["se"]
+        logits = jnp.einsum("...h,hv->...v", hidden, head["q"].astype(hidden.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits * head["s"]
+    if cfg.tie_embeddings:
+        head = head.T
     return jnp.einsum("...h,hv->...v", hidden, head, preferred_element_type=jnp.float32)
 
 

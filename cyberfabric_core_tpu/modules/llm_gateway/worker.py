@@ -243,6 +243,7 @@ class LocalTpuWorker(LlmWorkerApi):
             dtype=opts.pop("dtype", "bfloat16"),
             eos_token_ids=tuple(opts.pop("eos_token_ids", ()) or ()),
             decode_chunk=int(opts.pop("decode_chunk", 8)),
+            quantization=opts.pop("quantization", "none"),
         )
         params = None
         tokenizer: Tokenizer

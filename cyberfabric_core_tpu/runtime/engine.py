@@ -67,6 +67,8 @@ class EngineConfig:
     #: prefix-cache pool size in pages (0 = disabled). Continuous scheduler only.
     prefix_cache_pages: int = 0
     prefix_page_size: int = 64
+    #: weight-only quantization: "none" | "int8" (halves HBM + decode traffic)
+    quantization: str = "none"
 
     def resolve_use_flash(self) -> bool:
         if self.use_flash is not None:
@@ -166,7 +168,18 @@ class InferenceEngine:
             raise ValueError(f"InferenceEngine drives decoder models, got {self.model_config.architecture}")
         self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.dtype(config.dtype)
         if params is None:
-            params = llama.init_params(self.model_config, jax.random.PRNGKey(seed), self.dtype)
+            if config.quantization == "int8":
+                from .quant import init_params_quantized
+
+                params = init_params_quantized(
+                    self.model_config, jax.random.PRNGKey(seed), self.dtype)
+            else:
+                params = llama.init_params(
+                    self.model_config, jax.random.PRNGKey(seed), self.dtype)
+        elif config.quantization == "int8":
+            from .quant import quantize_llama_params
+
+            params = quantize_llama_params(params)
         self.params = params
         self.rope_tables = rope_frequencies(
             self.model_config.head_dim,

@@ -1,0 +1,118 @@
+"""Weight-only int8 quantization.
+
+Purpose: HBM. Decode throughput is weight-bandwidth-bound and a v5e chip holds
+16 GB — Llama-3-8B bf16 (16.1 GB) doesn't fit one chip, W8 (8.1 GB) does, and
+every decode step reads half the bytes. Symmetric per-output-channel scales; the
+int8→bf16 convert sits inside the dot's operand so XLA fuses it into the matmul
+read (weights stream from HBM as int8). Norm weights stay bf16 (tiny, and their
+statistics are precision-sensitive).
+
+Quantized leaf representation: {"q": int8 [..., in, out], "s": f32 [..., out]}
+(leading stacked-layer/expert dims preserved). models/llama.py's matmul helpers
+accept either a plain array or this dict.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+#: layers-tree leaves that are matmul weights (contraction on axis -2)
+_MATMUL_LEAVES = {"wq", "wk", "wv", "wo", "gate", "up", "down",
+                  "moe_gate", "moe_up", "moe_down"}
+
+
+def quantize_weight(w: jnp.ndarray) -> dict[str, jnp.ndarray]:
+    """Symmetric per-output-channel int8: scale over the contraction axis (-2)."""
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)  # [..., 1, out]
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale[..., 0, :].astype(jnp.float32)}
+
+
+def dequantize_weight(wq: dict[str, jnp.ndarray], dtype=jnp.bfloat16) -> jnp.ndarray:
+    return (wq["q"].astype(jnp.float32) * wq["s"][..., None, :]).astype(dtype)
+
+
+def quantize_llama_params(params: dict[str, Any]) -> dict[str, Any]:
+    """Quantize every matmul weight + lm_head + embed; norms stay as-is."""
+    out: dict[str, Any] = {"final_norm": params["final_norm"]}
+    out["embed"] = _quantize_embed(params["embed"])
+    if "lm_head" in params:
+        out["lm_head"] = quantize_weight(params["lm_head"])
+    layers = {}
+    for name, w in params["layers"].items():
+        if name in _MATMUL_LEAVES:
+            layers[name] = quantize_weight(w)
+        else:
+            layers[name] = w  # norms, router (tiny + precision-sensitive)
+    out["layers"] = layers
+    return out
+
+
+def _quantize_embed(embed: jnp.ndarray) -> dict[str, jnp.ndarray]:
+    """Embedding rows: per-ROW scales (gather then rescale)."""
+    ef = embed.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(ef), axis=-1, keepdims=True)  # [V, 1]
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(ef / scale), -127, 127).astype(jnp.int8)
+    # distinct keys ("qe"/"se") mark per-ROW scaling; a string marker would break
+    # jit argument handling (every pytree leaf must be an array)
+    return {"qe": q, "se": scale[:, 0].astype(jnp.float32)}
+
+
+def init_params_quantized(cfg, key: jax.Array, dtype=jnp.bfloat16) -> dict[str, Any]:
+    """Synthetic-weight init directly into W8: each leaf is sampled in bf16,
+    quantized, and the bf16 original freed before the next — peak HBM is the
+    int8 tree + ONE bf16 leaf, so an 8B model inits inside a 16 GB chip."""
+    from ..models import llama
+
+    H, I, V, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
+    Dq, Dkv = cfg.num_heads * cfg.head_dim, cfg.num_kv_heads * cfg.head_dim
+    keys = iter(jax.random.split(key, 16))
+
+    def w(*shape):
+        scale = jnp.asarray(1.0 / (shape[-2] if len(shape) > 1 else shape[-1]) ** 0.5, dtype)
+        full = jax.random.normal(next(keys), shape, dtype) * scale
+        q = quantize_weight(full)
+        q["q"].block_until_ready()
+        del full
+        return q
+
+    layers: dict[str, Any] = {
+        "attn_norm": jnp.ones((L, H), dtype),
+        "wq": w(L, H, Dq), "wk": w(L, H, Dkv), "wv": w(L, H, Dkv),
+        "wo": w(L, Dq, H),
+        "mlp_norm": jnp.ones((L, H), dtype),
+    }
+    if cfg.num_experts > 0:
+        E = cfg.num_experts
+        layers["router"] = (jax.random.normal(next(keys), (L, H, E), dtype)
+                            * jnp.asarray(H ** -0.5, dtype))
+        layers.update({"moe_gate": w(L, E, H, I), "moe_up": w(L, E, H, I),
+                       "moe_down": w(L, E, I, H)})
+    else:
+        layers.update({"gate": w(L, H, I), "up": w(L, H, I), "down": w(L, I, H)})
+
+    embed_full = (jax.random.normal(next(keys), (V, H), dtype)
+                  * jnp.asarray(H ** -0.5, dtype))
+    params: dict[str, Any] = {
+        "embed": _quantize_embed(embed_full),
+        "final_norm": jnp.ones((H,), dtype),
+        "layers": layers,
+    }
+    del embed_full
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(H, V)
+    return params
+
+
+def quantized_bytes(params: dict[str, Any]) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        if hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += leaf.size * leaf.dtype.itemsize
+    return total
