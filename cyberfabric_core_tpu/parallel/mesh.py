@@ -10,6 +10,11 @@ insert collectives):
 - ``sp``  — sequence parallel: ring attention over the sequence axis (long context).
 - ``ep``  — expert parallel: MoE expert weights sharded across devices; the
   top-k combine is XLA's all-reduce.
+- ``pp``  — pipeline/layer parallel: the stacked layer dim shards over pp, so
+  each device's HBM holds 1/pp of the depth and the lax.scan streams each
+  layer's weights over ICI as it runs (weight-gather pipelining — the
+  memory-scaling half of pipelining; staged microbatch execution is the
+  throughput half, noted for a later round).
 
 On multi-slice systems the mesh should be built with dp outermost so dp crosses DCN
 and tp/sp ride ICI (collective locality).
@@ -30,10 +35,11 @@ class MeshConfig:
     tp: int = 1
     sp: int = 1
     ep: int = 1  # expert parallel (MoE experts sharded over this axis)
+    pp: int = 1  # pipeline/layer parallel (stacked layer dim sharded)
 
     @property
     def total(self) -> int:
-        return self.dp * self.tp * self.sp * self.ep
+        return self.dp * self.tp * self.sp * self.ep * self.pp
 
     @classmethod
     def for_devices(cls, n: int, tp: int | None = None) -> "MeshConfig":
@@ -54,5 +60,6 @@ def build_mesh(config: MeshConfig, devices=None) -> Mesh:
         raise ValueError(
             f"mesh {config} needs {config.total} devices, have {len(devices)}"
         )
-    arr = np.asarray(devices).reshape(config.dp, config.tp, config.sp, config.ep)
-    return Mesh(arr, axis_names=("dp", "tp", "sp", "ep"))
+    arr = np.asarray(devices).reshape(config.dp, config.tp, config.sp,
+                                      config.ep, config.pp)
+    return Mesh(arr, axis_names=("dp", "tp", "sp", "ep", "pp"))

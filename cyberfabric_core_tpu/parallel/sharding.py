@@ -24,16 +24,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.configs import ModelConfig
 
 
-def llama_param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
-    """Tree of NamedShardings matching models/llama.init_params structure."""
+def llama_param_shardings(cfg: ModelConfig, mesh: Mesh,
+                          layer_axis: Any = None) -> dict[str, Any]:
+    """Tree of NamedShardings matching models/llama.init_params structure.
+
+    ``layer_axis``: mesh axis name (e.g. "pp") to shard the stacked layer dim
+    over — each device holds 1/pp of the depth and the scan streams the next
+    layer's weights over ICI (memory scaling for deep models)."""
 
     def ns(*spec):
+        if layer_axis is not None and len(spec) >= 2:
+            # leaves under "layers" carry the leading stacked-L dim
+            spec = (layer_axis,) + spec[1:]
+        return NamedSharding(mesh, P(*spec))
+
+    def ns_global(*spec):
         return NamedSharding(mesh, P(*spec))
 
     tree = {
-        "embed": ns(None, None),          # replicated: gather is tiny, avoid a
+        "embed": ns_global(None, None),   # replicated: gather is tiny, avoid a
                                           # vocab all-gather on every step
-        "final_norm": ns(None),
+        "final_norm": ns_global(None),
         "layers": {
             "attn_norm": ns(None, None),
             "wq": ns(None, None, "tp"),
@@ -47,7 +58,7 @@ def llama_param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
         },
     }
     if not cfg.tie_embeddings:
-        tree["lm_head"] = ns(None, "tp")  # vocab-sharded head
+        tree["lm_head"] = ns_global(None, "tp")  # vocab-sharded head
     if cfg.num_experts > 0:
         # expert parallelism: the expert dim shards over ep; each device computes
         # its local experts, the weighted combine is one all-reduce over ep
