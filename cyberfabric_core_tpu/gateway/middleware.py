@@ -126,6 +126,10 @@ def build_middlewares(
     @web.middleware
     async def trace_mw(request: web.Request, handler):
         # layer 2: TraceLayer span with method/uri/request_id (module.rs:276-281)
+        # + serving metrics (request counter, latency histogram per route)
+        from ..modkit.metrics import default_registry
+
+        start = time.monotonic()
         with tracer.span(
             f"http {request.method} {request.path}",
             traceparent=request.headers.get("traceparent"),
@@ -136,6 +140,14 @@ def build_middlewares(
             request["trace_id"] = span.trace_id
             resp = await handler(request)
             span.set_attribute("status", resp.status)
+            spec = request.get("spec")
+            route = spec.path if spec is not None else request.path
+            default_registry.counter(
+                "http_requests_total", "HTTP requests served").inc(
+                route=route, method=request.method, status=str(resp.status))
+            default_registry.histogram(
+                "http_request_duration_seconds", "Request latency").observe(
+                time.monotonic() - start, route=route)
             return resp
 
     @web.middleware

@@ -1,0 +1,153 @@
+"""Metrics registry — counters, gauges, histograms with Prometheus text export.
+
+Reference: the Monitoring module is *specified* but not implemented there
+(docs/MODULES.md:475-491, ARCHITECTURE_MANIFEST.md:430-435); SURVEY §5 directs
+this build to make metrics real: tokens/sec/chip, TTFT histograms, batch
+occupancy, HBM usage. Process-local registry, no external deps; exports the
+Prometheus text exposition format.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+@dataclass
+class Counter:
+    name: str
+    help: str
+    _values: dict[tuple, float] = field(default_factory=dict)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        self._values[key] = self._values.get(key, 0.0) + amount
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return out
+
+
+@dataclass
+class Gauge:
+    name: str
+    help: str
+    _values: dict[tuple, float] = field(default_factory=dict)
+    _fn: Optional[callable] = None
+
+    def set(self, value: float, **labels: str) -> None:
+        self._values[tuple(sorted(labels.items()))] = float(value)
+
+    def set_function(self, fn) -> None:
+        """Lazily evaluated at scrape time (e.g. HBM stats)."""
+        self._fn = fn
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        values = dict(self._values)
+        if self._fn is not None:
+            try:
+                values[()] = float(self._fn())
+            except Exception:  # noqa: BLE001 — scrape must not fail
+                pass
+        for key, v in sorted(values.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return out
+
+
+@dataclass
+class Histogram:
+    name: str
+    help: str
+    buckets: tuple[float, ...] = _DEFAULT_BUCKETS
+    _counts: dict[tuple, list] = field(default_factory=dict)
+    _sums: dict[tuple, float] = field(default_factory=dict)
+    _totals: dict[tuple, int] = field(default_factory=dict)
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        counts = self._counts.setdefault(key, [0] * len(self.buckets))
+        idx = bisect.bisect_left(self.buckets, value)
+        for i in range(idx, len(self.buckets)):
+            counts[i] += 1
+        self._sums[key] = self._sums.get(key, 0.0) + value
+        self._totals[key] = self._totals.get(key, 0) + 1
+
+    def quantile(self, q: float, **labels: str) -> Optional[float]:
+        """Approximate quantile from bucket counts (upper bound of the bucket)."""
+        key = tuple(sorted(labels.items()))
+        total = self._totals.get(key, 0)
+        if total == 0:
+            return None
+        target = q * total
+        counts = self._counts[key]
+        for i, c in enumerate(counts):
+            if c >= target:
+                return self.buckets[i]
+        return self.buckets[-1]
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for key in sorted(self._counts):
+            labels = dict(key)
+            counts = self._counts[key]
+            for bound, c in zip(self.buckets, counts):
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels({**labels, 'le': str(bound)})} {c}")
+            out.append(
+                f"{self.name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} "
+                f"{self._totals[key]}")
+            out.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sums[key]}")
+            out.append(f"{self.name}_count{_fmt_labels(labels)} {self._totals[key]}")
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+        self.started_at = time.time()
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help))
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Iterable[float] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(
+            name, lambda: Histogram(name, help, tuple(buckets)))
+
+    def _get_or_create(self, name: str, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            return m
+
+    def render(self) -> str:
+        with self._lock:
+            lines: list[str] = []
+            for name in sorted(self._metrics):
+                lines.extend(self._metrics[name].render())
+        return "\n".join(lines) + "\n"
+
+
+#: process-global default registry (modules grab it via ClientHub or directly)
+default_registry = MetricsRegistry()

@@ -23,3 +23,4 @@ from .module_orchestrator import ModuleOrchestratorModule  # noqa: F401
 from .grpc_hub import GrpcHubModule  # noqa: F401
 from .calculator import CalculatorModule  # noqa: F401
 from .oagw import OagwModule  # noqa: F401
+from .monitoring import MonitoringModule  # noqa: F401

@@ -61,6 +61,14 @@ class UsageTracker:
         entry["output_tokens"] += usage.get("output_tokens", 0)
         entry["total_tokens"] += usage.get("input_tokens", 0) + usage.get("output_tokens", 0)
         entry["requests"] += 1
+        from ...modkit.metrics import default_registry
+
+        default_registry.counter(
+            "llm_tokens_total", "LLM tokens processed").inc(
+            usage.get("input_tokens", 0), direction="input", tenant=ctx.tenant_id)
+        default_registry.counter(
+            "llm_tokens_total", "LLM tokens processed").inc(
+            usage.get("output_tokens", 0), direction="output", tenant=ctx.tenant_id)
 
     def snapshot(self, ctx: SecurityContext) -> dict[str, int]:
         return dict(self._usage.get(ctx.tenant_id, {}))
@@ -188,6 +196,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         else:
             agen = external.chat_stream(ctx, model, body["messages"], body)
         deadline = asyncio.get_event_loop().time() + self.total_timeout_s
+        t_start = asyncio.get_event_loop().time()
         first = True
         while True:
             timeout = self.ttft_timeout_s if first else max(
@@ -203,6 +212,13 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
                     code="ttft_timeout" if first else "total_timeout",
                     detail=f"model {model.canonical_id} "
                            f"{'TTFT' if first else 'total'} timeout"))
+            if first:
+                from ...modkit.metrics import default_registry
+
+                default_registry.histogram(
+                    "llm_ttft_seconds", "Time to first token").observe(
+                    asyncio.get_event_loop().time() - t_start,
+                    model=model.canonical_id)
             first = False
             yield chunk
 

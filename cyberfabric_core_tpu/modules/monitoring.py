@@ -1,0 +1,68 @@
+"""Monitoring module — the /metrics endpoint (Prometheus text format).
+
+Reference: the Monitoring module exists only as a spec there
+(docs/MODULES.md:475-491); here it is real, per SURVEY §5's mandate: serving
+metrics (request counts/latency), LLM metrics (tokens, TTFT histograms, batch
+occupancy), and device metrics (TPU count, HBM when the PJRT plugin reports it).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from ..modkit import Module, module
+from ..modkit.contracts import RestApiCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.metrics import MetricsRegistry, default_registry
+from .sdk import LlmWorkerApi
+
+
+@module(name="monitoring", capabilities=["rest"])
+class MonitoringModule(Module, RestApiCapability):
+    def __init__(self) -> None:
+        self.registry = default_registry
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        ctx.client_hub.register(MetricsRegistry, self.registry)
+        hub = ctx.client_hub
+
+        # device gauges, evaluated at scrape time
+        def device_count() -> float:
+            import jax
+
+            return float(len(jax.devices()))
+
+        self.registry.gauge(
+            "tpu_devices", "Accelerator devices visible to this host"
+        ).set_function(device_count)
+
+        def hbm_in_use() -> float:
+            import jax
+
+            stats = jax.devices()[0].memory_stats() or {}
+            return float(stats.get("bytes_in_use", 0))
+
+        self.registry.gauge(
+            "tpu_hbm_bytes_in_use", "HBM in use on device 0 (0 if unreported)"
+        ).set_function(hbm_in_use)
+
+        def active_slots() -> float:
+            worker = hub.try_get(LlmWorkerApi)
+            total = 0
+            for entry in getattr(worker, "_entries", {}).values():
+                sched = getattr(entry, "scheduler", None)
+                if sched is not None:
+                    total += sched.active_slots
+            return float(total)
+
+        self.registry.gauge(
+            "llm_batch_active_slots", "Active continuous-batching slots"
+        ).set_function(active_slots)
+
+    def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
+        async def metrics(request: web.Request):
+            return web.Response(text=self.registry.render(),
+                                content_type="text/plain")
+
+        router.operation("GET", "/metrics", module="monitoring").public() \
+            .summary("Prometheus text exposition").handler(metrics).register()

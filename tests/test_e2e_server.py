@@ -48,6 +48,7 @@ BASE_CONFIG = {
         "credstore": {},
         "file_parser": {},
         "serverless_runtime": {},
+        "monitoring": {},
     }
 }
 
@@ -500,3 +501,14 @@ def test_serverless_event_triggers(server):
     status, out = req(server, "POST", "/v1/serverless/events",
                       json={"topic": "nobody.listens"})
     assert out["fired_invocations"] == []
+
+
+def test_metrics_endpoint(server):
+    status, text = req(server, "GET", "/metrics")
+    assert status == 200
+    text = text.decode() if isinstance(text, bytes) else str(text)
+    assert "http_requests_total" in text
+    assert "llm_tokens_total" in text
+    assert "llm_ttft_seconds_bucket" in text
+    assert "tpu_devices" in text
+    assert "llm_batch_active_slots" in text
