@@ -24,3 +24,4 @@ from .grpc_hub import GrpcHubModule  # noqa: F401
 from .calculator import CalculatorModule  # noqa: F401
 from .oagw import OagwModule  # noqa: F401
 from .monitoring import MonitoringModule  # noqa: F401
+from .user_settings import UserSettingsModule  # noqa: F401

@@ -1,0 +1,102 @@
+"""simple-user-settings — the minimal CRUD-with-DB module exemplar.
+
+Reference (implemented there): modules/simple-user-settings — per-module DB,
+repo pattern, tenant-scoped rows. The smallest complete example of the module
+shape: migrations + SecureConn storage + OData listing + REST.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from aiohttp import web
+
+from ..modkit import Module, module
+from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
+from ..modkit.context import ModuleCtx
+from ..modkit.db import ScopableEntity
+from ..modkit.errors import ProblemError
+from ..gateway.middleware import SECURITY_CONTEXT_KEY
+from ..gateway.validation import read_json
+
+SETTINGS = ScopableEntity(
+    table="user_settings",
+    field_map={"id": "id", "tenant_id": "tenant_id", "user_id": "user_id",
+               "key": "key", "value": "value"},
+    owner_col="user_id",
+    json_cols=("value",),
+)
+
+_MIGRATIONS = [
+    Migration("0001_user_settings", lambda c: c.execute(
+        "CREATE TABLE user_settings (id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, "
+        "user_id TEXT NOT NULL, key TEXT NOT NULL, value TEXT, "
+        "UNIQUE (tenant_id, user_id, key))"
+    )),
+]
+
+
+@module(name="user_settings", capabilities=["db", "rest"])
+class UserSettingsModule(Module, DatabaseCapability, RestApiCapability):
+    def __init__(self) -> None:
+        self._ctx: Optional[ModuleCtx] = None
+
+    def migrations(self):
+        return _MIGRATIONS
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        self._ctx = ctx
+
+    def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
+        db = ctx.db_required()
+
+        def conn(request: web.Request):
+            return db.secure(request[SECURITY_CONTEXT_KEY], SETTINGS)
+
+        async def put_setting(request: web.Request):
+            body = await read_json(request, {
+                "type": "object", "required": ["value"],
+                "properties": {"value": {}}, "additionalProperties": False})
+            sc = request[SECURITY_CONTEXT_KEY]
+            c = conn(request)
+            key = request.match_info["key"]
+            row = c.find_one({"user_id": sc.subject, "key": key})
+            if row:
+                c.update(row["id"], {"value": body["value"]})
+            else:
+                c.insert({"user_id": sc.subject, "key": key, "value": body["value"]})
+            return None
+
+        async def get_setting(request: web.Request):
+            sc = request[SECURITY_CONTEXT_KEY]
+            row = conn(request).find_one({"user_id": sc.subject,
+                                          "key": request.match_info["key"]})
+            if row is None:
+                raise ProblemError.not_found("setting not found", code="setting_not_found")
+            return {"key": row["key"], "value": row["value"]}
+
+        async def list_settings(request: web.Request):
+            return conn(request).list_odata(
+                filter_text=request.query.get("$filter"),
+                orderby_text=request.query.get("$orderby") or "key",
+                cursor=request.query.get("cursor"),
+            ).to_dict()
+
+        async def delete_setting(request: web.Request):
+            sc = request[SECURITY_CONTEXT_KEY]
+            c = conn(request)
+            row = c.find_one({"user_id": sc.subject,
+                              "key": request.match_info["key"]})
+            if row is None or not c.delete(row["id"]):
+                raise ProblemError.not_found("setting not found", code="setting_not_found")
+            return None
+
+        m = "user_settings"
+        router.operation("PUT", "/v1/settings/{key}", module=m).auth_required() \
+            .summary("Upsert a per-user setting").handler(put_setting).register()
+        router.operation("GET", "/v1/settings/{key}", module=m).auth_required() \
+            .summary("Read a setting").handler(get_setting).register()
+        router.operation("GET", "/v1/settings", module=m).auth_required() \
+            .summary("List settings (OData)").handler(list_settings).register()
+        router.operation("DELETE", "/v1/settings/{key}", module=m).auth_required() \
+            .summary("Delete a setting").handler(delete_setting).register()

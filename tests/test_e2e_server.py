@@ -49,6 +49,7 @@ BASE_CONFIG = {
         "file_parser": {},
         "serverless_runtime": {},
         "monitoring": {},
+        "user_settings": {},
     }
 }
 
@@ -512,3 +513,24 @@ def test_metrics_endpoint(server):
     assert "llm_ttft_seconds_bucket" in text
     assert "tpu_devices" in text
     assert "llm_batch_active_slots" in text
+
+
+def test_user_settings_crud(server):
+    status, _ = req(server, "PUT", "/v1/settings/theme", json={"value": {"mode": "dark"}})
+    assert status == 204
+    status, body = req(server, "GET", "/v1/settings/theme")
+    assert status == 200 and body["value"] == {"mode": "dark"}
+    # upsert overwrites
+    req(server, "PUT", "/v1/settings/theme", json={"value": "light"})
+    status, body = req(server, "GET", "/v1/settings/theme")
+    assert body["value"] == "light"
+    status, body = req(server, "GET", "/v1/settings")
+    assert any(r["key"] == "theme" for r in body["items"])
+    # another tenant sees nothing (tenant scoping through the whole stack)
+    status, _ = req(server, "GET", "/v1/settings/theme",
+                    headers={"x-tenant-id": "acme-eu"})
+    assert status == 404
+    status, _ = req(server, "DELETE", "/v1/settings/theme")
+    assert status == 204
+    status, _ = req(server, "GET", "/v1/settings/theme")
+    assert status == 404

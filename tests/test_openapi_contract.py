@@ -27,7 +27,7 @@ def _current_routes():
                 "authz_resolver", "types_registry", "module_orchestrator",
                 "nodes_registry", "model_registry", "llm_gateway",
                 "file_storage", "credstore", "file_parser",
-                "serverless_runtime", "oagw", "monitoring")}})
+                "serverless_runtime", "oagw", "monitoring", "user_settings")}})
         registry = ModuleRegistry.discover_and_build(enabled=cfg.module_names())
         rt = HostRuntime(RunOptions(config=cfg, registry=registry,
                                     db_manager=DbManager(in_memory=True)))
