@@ -31,7 +31,7 @@ from ...modkit.security import SecurityContext
 from ...modkit.sse import SSE_DONE, format_sse_json
 from ...gateway.middleware import SECURITY_CONTEXT_KEY
 from ...gateway.validation import read_json, validate_against
-from ..sdk import ChatStreamChunk, LlmWorkerApi, ModelInfo, ModelRegistryApi
+from ..sdk import ChatStreamChunk, LlmHookApi, LlmWorkerApi, ModelInfo, ModelRegistryApi
 from . import schemas
 from .worker import LocalTpuWorker
 
@@ -227,6 +227,17 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         body = await read_json(request, schemas.REQUEST)
         ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
         self.usage.check_budget(ctx)
+        # pre_call hook: allow / block / override (DESIGN.md:743-766)
+        hook = self._hub.try_get(LlmHookApi)
+        if hook is not None:
+            verdict = await hook.pre_call(ctx, body)
+            action = (verdict or {}).get("action", "allow")
+            if action == "block":
+                raise ProblemError.forbidden(
+                    (verdict or {}).get("reason", "blocked by pre-call hook"))
+            if action == "override":
+                body = verdict["body"]
+                validate_against(schemas.REQUEST, body)
         if body.get("tools"):
             # UC-010 step 3: resolve all three tool encodings (references via
             # the types registry) BEFORE provider dispatch
@@ -287,6 +298,9 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
 
                         validate_structured_output(text, body["response_schema"])
                     resp["content"] = [{"type": "text", "text": text}]
+                hook = self._hub.try_get(LlmHookApi) if hasattr(self, "_hub") else None
+                if hook is not None:
+                    resp = await hook.post_response(ctx, body, resp)
                 validate_against(schemas.RESPONSE, resp)
                 return resp
             except ProblemError as e:

@@ -90,6 +90,21 @@ class LlmWorkerApi(abc.ABC):
         ...
 
 
+class LlmHookApi(abc.ABC):
+    """Pre/post interceptors for the llm-gateway (DESIGN.md:743-766): pre_call
+    may allow, block, or override the request; post_response may rewrite the
+    final response. Registered in the ClientHub; absent = passthrough."""
+
+    async def pre_call(self, ctx: SecurityContext, body: dict) -> dict:
+        """Return {"action": "allow"} | {"action": "block", "reason": ...} |
+        {"action": "override", "body": <modified request>}."""
+        return {"action": "allow"}
+
+    async def post_response(self, ctx: SecurityContext, body: dict,
+                            response: dict) -> dict:
+        return response
+
+
 # ----------------------------------------------------------------- file storage
 @dataclass
 class StoredFile:

@@ -127,6 +127,10 @@ def save_llama_params(params: dict, cfg: ModelConfig, out_dir: str | Path) -> Pa
     """Write our tree back to HF-layout safetensors (round-trip/testing support)."""
     from safetensors.numpy import save_file
 
+    if isinstance(params.get("embed"), dict):
+        raise ValueError(
+            "cannot save a quantized param tree to HF safetensors layout; "
+            "save the fp tree, or dequantize first (runtime/quant.py)")
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     tensors: dict[str, np.ndarray] = {}

@@ -115,9 +115,32 @@ def scripted_stack(fresh_registry):
 
     worker = FakeWorker()
 
+    from cyberfabric_core_tpu.modules.sdk import LlmHookApi
+
+    class ToggleHook(LlmHookApi):
+        mode = "allow"
+
+        async def pre_call(self, ctx, body):
+            if self.mode == "block":
+                return {"action": "block", "reason": "policy says no"}
+            if self.mode == "override":
+                new = dict(body)
+                new["max_tokens"] = 1
+                return {"action": "override", "body": new}
+            return {"action": "allow"}
+
+        async def post_response(self, ctx, body, response):
+            if self.mode == "post":
+                response = dict(response)
+                response["model_used"] = response["model_used"] + "+hooked"
+            return response
+
+    hook = ToggleHook()
+
     async def boot():
         hub = ClientHub()
         hub.register(LlmWorkerApi, worker)  # pre-registered seam (client_hub.rs:16)
+        hub.register(LlmHookApi, hook)
         cfg = AppConfig.load_or_default(environ={}, cli_overrides={"modules": {
             "api_gateway": {"config": {"bind_addr": "127.0.0.1:0",
                                        "auth_disabled": True}},
@@ -134,7 +157,8 @@ def scripted_stack(fresh_registry):
 
     loop = asyncio.new_event_loop()
     rt, base = loop.run_until_complete(boot())
-    yield loop, base, script, worker
+    yield loop, base, script, worker, hook
+    hook.mode = "allow"
     rt.root_token.cancel()
     loop.run_until_complete(rt.run_stop_phase())
     loop.close()
@@ -150,7 +174,7 @@ def _chat(loop, base, body):
 
 
 def test_tool_call_end_to_end(scripted_stack):
-    loop, base, script, worker = scripted_stack
+    loop, base, script, worker, _hook = scripted_stack
     script["text"] = '{"tool_call": {"name": "get_weather", "arguments": {"city": "oslo"}}}'
     status, body = _chat(loop, base, {
         "model": "fake::m1",
@@ -177,7 +201,7 @@ def test_tools_preamble_rendering():
 
 
 def test_structured_output_end_to_end(scripted_stack):
-    loop, base, script, _ = scripted_stack
+    loop, base, script, _worker, _hook = scripted_stack
     schema = {"type": "object", "required": ["answer"],
               "properties": {"answer": {"type": "integer"}}}
     script["text"] = '{"answer": 7}'
@@ -190,3 +214,25 @@ def test_structured_output_end_to_end(scripted_stack):
         "model": "fake::m1", "response_schema": schema,
         "messages": [{"role": "user", "content": [{"type": "text", "text": "q"}]}]})
     assert status == 422 and body["code"] == "structured_output_invalid"
+
+
+def test_pre_post_hooks(scripted_stack):
+    """Hook interceptors: block -> 403; override rewrites the request;
+    post_response rewrites the reply (DESIGN.md:743-766)."""
+    loop, base, script, _worker, hook = scripted_stack
+    script["text"] = "plain answer"
+    body = {"model": "fake::m1",
+            "messages": [{"role": "user",
+                          "content": [{"type": "text", "text": "q"}]}]}
+
+    hook.mode = "block"
+    status, resp = _chat(loop, base, body)
+    assert status == 403 and "policy says no" in resp["detail"]
+
+    hook.mode = "post"
+    status, resp = _chat(loop, base, body)
+    assert status == 200 and resp["model_used"] == "fake::m1+hooked"
+
+    hook.mode = "allow"
+    status, resp = _chat(loop, base, body)
+    assert status == 200 and resp["model_used"] == "fake::m1"
