@@ -247,7 +247,13 @@ def build_middlewares(
             return await handler(request)
         except ProblemError as e:
             return _problem_response(e.problem, request.get(REQUEST_ID_KEY))
-        except web.HTTPException:
+        except web.HTTPException as e:
+            if e.status >= 400:
+                # framework 404/405/… become RFC-9457 documents too
+                return _problem_response(
+                    Problem(status=e.status, title=e.reason or "Error",
+                            code=(e.reason or "error").lower().replace(" ", "_")),
+                    request.get(REQUEST_ID_KEY))
             raise
         except asyncio.CancelledError:
             raise
