@@ -85,6 +85,34 @@ class ModelRegistryService(ModelRegistryApi):
         # read-through resolution cache: (tenant, name) -> (ModelInfo, expiry)
         self._cache: dict[tuple[str, str], tuple[ModelInfo, float]] = {}
         self._cache_ttl = 5.0
+        #: AutoApprovalRule list (PRD.md:255-276): a registration matching a
+        #: rule's provider_slug (and optional model-id prefix) starts approved
+        self._auto_approval_rules: list[dict[str, Any]] = list(
+            ctx.raw_config().get("auto_approval_rules") or [])
+        #: ProviderHealth (PRD.md:278-296, discovery-only): slug -> state
+        self._provider_health: dict[str, str] = {}
+
+    def _auto_approved(self, spec: dict[str, Any]) -> bool:
+        for rule in self._auto_approval_rules:
+            if rule.get("provider_slug") not in (None, spec["provider_slug"]):
+                continue
+            prefix = rule.get("model_id_prefix")
+            if prefix and not str(spec["provider_model_id"]).startswith(prefix):
+                continue
+            return True
+        return False
+
+    # ------------------------------------------------------------- health
+    def set_provider_health(self, slug: str, state: str) -> None:
+        """healthy | degraded | unhealthy (discovery-only; resolution skips
+        unhealthy providers so fallback chains route around them)."""
+        if state not in ("healthy", "degraded", "unhealthy"):
+            raise ProblemError.bad_request("state must be healthy|degraded|unhealthy")
+        self._provider_health[slug] = state
+        self._cache.clear()
+
+    def provider_health(self, slug: str) -> str:
+        return self._provider_health.get(slug, "healthy")
 
     # ------------------------------------------------------------- write side
     def register_model(self, ctx: SecurityContext, spec: dict[str, Any]) -> ModelInfo:
@@ -93,6 +121,7 @@ class ModelRegistryService(ModelRegistryApi):
         if missing:
             raise ProblemError.bad_request(f"missing fields: {missing}")
         canonical = f"{spec['provider_slug']}::{spec['provider_model_id']}"
+        default_approval = "approved" if self._auto_approved(spec) else "pending"
         row = {
             "provider_slug": spec["provider_slug"],
             "provider_model_id": spec["provider_model_id"],
@@ -102,7 +131,7 @@ class ModelRegistryService(ModelRegistryApi):
             "limits": spec.get("limits", {}),
             "cost": spec.get("cost", {}),
             "lifecycle_status": spec.get("lifecycle_status", "active"),
-            "approval_state": spec.get("approval_state", "pending"),
+            "approval_state": spec.get("approval_state", default_approval),
             "managed": bool(spec.get("managed", False)),
             "architecture": spec.get("architecture"),
             "size_bytes": spec.get("size_bytes"),
@@ -186,6 +215,12 @@ class ModelRegistryService(ModelRegistryApi):
         if row["lifecycle_status"] in ("retired", "disabled"):
             raise ProblemError.not_found(
                 f"model {row['canonical_id']} is {row['lifecycle_status']}")
+        if self.provider_health(row["provider_slug"]) == "unhealthy":
+            # health-aware resolution: fallback chains route around sick
+            # providers (PRD ProviderHealth + DESIGN fallback ranking)
+            raise ProblemError.service_unavailable(
+                f"provider {row['provider_slug']} is unhealthy",
+                code="provider_unhealthy")
         return self._to_info(row)
 
     async def list_models(self, ctx: SecurityContext, filter_text: Optional[str] = None,
@@ -294,3 +329,21 @@ class ModelRegistryModule(Module, DatabaseCapability, RestApiCapability):
             .auth_required().summary("Drive the approval state machine").handler(set_approval).register()
         router.operation("POST", "/v1/model-registry/aliases", module=m).auth_required() \
             .summary("Create/update an alias").handler(set_alias).register()
+
+        async def set_health(request: web.Request):
+            body = await read_json(request, {"type": "object", "required": ["state"],
+                                             "properties": {"state": {"type": "string"}},
+                                             "additionalProperties": False})
+            svc.set_provider_health(request.match_info["slug"], body["state"])
+            return {"provider_slug": request.match_info["slug"],
+                    "state": body["state"]}
+
+        async def get_health(request: web.Request):
+            slug = request.match_info["slug"]
+            return {"provider_slug": slug, "state": svc.provider_health(slug)}
+
+        router.operation("PUT", "/v1/model-registry/providers/{slug}/health", module=m) \
+            .auth_required().summary("Set provider health (healthy|degraded|unhealthy)") \
+            .handler(set_health).register()
+        router.operation("GET", "/v1/model-registry/providers/{slug}/health", module=m) \
+            .auth_required().summary("Provider health state").handler(get_health).register()

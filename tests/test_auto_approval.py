@@ -1,0 +1,39 @@
+"""AutoApprovalRule (PRD:255-276) at the service level."""
+
+import asyncio
+
+from cyberfabric_core_tpu.modkit import AppConfig, ClientHub
+from cyberfabric_core_tpu.modkit.cancellation import CancellationToken
+from cyberfabric_core_tpu.modkit.context import ModuleCtx
+from cyberfabric_core_tpu.modkit.db import DbManager
+from cyberfabric_core_tpu.modkit.security import SecurityContext
+from cyberfabric_core_tpu.modules.model_registry import ModelRegistryService, _MIGRATIONS
+
+
+def make_service(rules):
+    cfg = AppConfig.load_or_default(environ={}, cli_overrides={"modules": {
+        "model_registry": {"config": {"auto_approval_rules": rules}}}})
+    ctx = ModuleCtx(module_name="model_registry", app_config=cfg,
+                    client_hub=ClientHub(), cancellation_token=CancellationToken())
+    ctx.db = DbManager(in_memory=True).db_for_module("model_registry")
+    ctx.db.run_migrations(_MIGRATIONS)
+    return ModelRegistryService(ctx)
+
+
+def test_rules_match_slug_and_prefix():
+    svc = make_service([{"provider_slug": "trusted", "model_id_prefix": "llama"}])
+    ctx = SecurityContext.anonymous()
+    auto = svc.register_model(ctx, {"provider_slug": "trusted",
+                                    "provider_model_id": "llama-3-8b"})
+    assert auto.approval_state == "approved"
+    wrong_prefix = svc.register_model(ctx, {"provider_slug": "trusted",
+                                            "provider_model_id": "gpt-9"})
+    assert wrong_prefix.approval_state == "pending"
+    wrong_slug = svc.register_model(ctx, {"provider_slug": "sketchy",
+                                          "provider_model_id": "llama-3-8b"})
+    assert wrong_slug.approval_state == "pending"
+    # explicit approval_state always wins over rules
+    explicit = svc.register_model(ctx, {"provider_slug": "trusted",
+                                        "provider_model_id": "llama-held",
+                                        "approval_state": "pending"})
+    assert explicit.approval_state == "pending"
