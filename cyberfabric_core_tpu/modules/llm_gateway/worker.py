@@ -252,7 +252,8 @@ class LocalTpuWorker(LlmWorkerApi):
             from ...runtime.weights import load_llama_params
 
             cfg = get_config(arch_config)
-            params = load_llama_params(model.checkpoint_path, cfg)
+            params = load_llama_params(model.checkpoint_path, cfg,
+                                       quantize=eng_cfg.quantization == "int8")
             tokenizer = load_tokenizer(model.checkpoint_path)
         else:
             # synthetic weights (airgapped/dev): byte tokenizer over model vocab

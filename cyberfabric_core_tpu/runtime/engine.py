@@ -176,7 +176,8 @@ class InferenceEngine:
             else:
                 params = llama.init_params(
                     self.model_config, jax.random.PRNGKey(seed), self.dtype)
-        elif config.quantization == "int8":
+        elif config.quantization == "int8" and not isinstance(
+                params.get("embed"), dict):  # already-quantized trees pass through
             from .quant import quantize_llama_params
 
             params = quantize_llama_params(params)

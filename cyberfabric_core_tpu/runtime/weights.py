@@ -79,19 +79,31 @@ def load_llama_params(
     dtype=jnp.bfloat16,
     shardings: Optional[dict[str, Any]] = None,
     progress: Optional[Callable[[str], None]] = None,
+    quantize: bool = False,
 ) -> dict:
     """Load a HF llama-family safetensors checkpoint into our param tree.
 
     ``shardings``: optional map of tree paths ("layers.wq", "embed", ...) →
     jax.sharding.Sharding; tensors go straight to their sharded placement.
+    ``quantize``: int8 weight-only quantization applied PER TENSOR as it loads —
+    peak device memory is the int8 tree plus one fp tensor, so checkpoints up to
+    ~2× HBM load on one chip.
     """
     idx = SafetensorsIndex(Path(model_dir))
     shardings = shardings or {}
+    from .quant import _MATMUL_LEAVES, _quantize_embed, quantize_weight
 
     def put(path: str, arr: np.ndarray):
         if progress:
             progress(path)
         target = arr.astype(np.float32).astype(dtype) if arr.dtype != np.dtype("bfloat16") else arr
+        leaf_name = path.split(".")[-1]
+        if quantize and (leaf_name in _MATMUL_LEAVES or path in ("lm_head", "embed")):
+            dev = jnp.asarray(target)
+            q = _quantize_embed(dev) if path == "embed" else quantize_weight(dev)
+            jax.tree.map(lambda a: a.block_until_ready(), q)
+            del dev
+            return q
         sharding = shardings.get(path)
         if sharding is not None:
             return jax.device_put(jnp.asarray(target), sharding)

@@ -72,3 +72,29 @@ def test_init_params_quantized_structure():
     qm = init_params_quantized(moe, jax.random.PRNGKey(0), jnp.float32)
     assert qm["layers"]["moe_gate"]["q"].dtype == jnp.int8
     assert qm["layers"]["router"].dtype == jnp.float32  # router stays fp
+
+
+def test_quantize_on_load_roundtrip(tmp_path):
+    """Checkpoint -> per-tensor quantized tree, logits correlate with fp load."""
+    from cyberfabric_core_tpu.runtime.weights import load_llama_params, save_llama_params
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(4), jnp.float32)
+    save_llama_params(params, CFG, tmp_path)
+    qloaded = load_llama_params(tmp_path, CFG, dtype=jnp.float32, quantize=True)
+    assert qloaded["layers"]["wq"]["q"].dtype == jnp.int8
+    assert "qe" in qloaded["embed"] and qloaded["lm_head"]["q"].dtype == jnp.int8
+
+    from cyberfabric_core_tpu.ops.rope import rope_frequencies
+
+    rope = rope_frequencies(CFG.head_dim, CFG.max_position, CFG.rope_theta)
+    ids = jax.random.randint(jax.random.PRNGKey(5), (1, 6), 3, CFG.vocab_size)
+    pos = jnp.arange(6, dtype=jnp.int32)[None, :]
+
+    def logits(p):
+        cache = llama.init_cache(CFG, 1, 8, jnp.float32)
+        h, _ = llama.forward(p, CFG, ids, pos, cache,
+                             jnp.zeros((1,), jnp.int32), rope)
+        return np.asarray(llama.lm_head_logits(p, CFG, h[0, -1]))
+
+    corr = np.corrcoef(logits(params), logits(qloaded))[0, 1]
+    assert corr > 0.99
