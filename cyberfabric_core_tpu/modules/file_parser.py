@@ -213,6 +213,7 @@ class FileParserModule(Module, RestApiCapability):
             Path(base) if base else None,
             int(cfg.get("max_file_size_bytes", 16 * 1024 * 1024)),
         )
+        ctx.client_hub.register(FileParserService, self.service)
 
     def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
         svc = self.service

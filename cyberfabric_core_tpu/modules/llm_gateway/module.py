@@ -147,6 +147,56 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         for t in list(self._job_tasks):
             t.cancel()
 
+    async def _resolve_media(self, ctx: SecurityContext, body: dict) -> dict:
+        """Media via FileStorage (DESIGN ADR-0003 + vision/document UCs):
+        document parts referencing file-storage URLs are fetched, parsed to
+        markdown by the file-parser, and inlined as text before the model sees
+        the prompt. Image/audio/video parts pass through untouched (multimodal
+        decode is a model capability, not a gateway one)."""
+        from ..sdk import FileStorageApi
+
+        storage = self._hub.try_get(FileStorageApi)
+        if storage is None:
+            return body
+        parser = None
+        try:
+            from ..file_parser import FileParserService
+
+            parser_module = self._hub.try_get(FileParserService)
+            parser = parser_module
+        except ImportError:
+            pass
+
+        changed = False
+        messages = []
+        for message in body["messages"]:
+            parts = []
+            for part in message.get("content", []):
+                if isinstance(part, dict) and part.get("type") == "document" \
+                        and str(part.get("url", "")).startswith("/v1/files/"):
+                    try:
+                        data = await storage.fetch(ctx, part["url"])
+                        meta = await storage.metadata(ctx, part["url"])
+                    except ProblemError:
+                        raise ProblemError.unprocessable(
+                            f"document part references missing file {part['url']}",
+                            code="media_not_found")
+                    if parser is not None:
+                        doc, _ = parser.parse_bytes(data, part.get("mime_type")
+                                                    or meta.mime_type)
+                        text = doc.to_markdown()
+                    else:
+                        text = data.decode("utf-8", errors="replace")
+                    parts.append({"type": "text",
+                                  "text": f"[document {meta.filename or meta.file_id}]\n{text}"})
+                    changed = True
+                else:
+                    parts.append(part)
+            messages.append({**message, "content": parts})
+        if not changed:
+            return body
+        return {**body, "messages": messages}
+
     def _get_external(self):
         if self._external is None and getattr(self, "_hub", None) is not None:
             from ..oagw import OagwService
@@ -238,6 +288,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
             if action == "override":
                 body = verdict["body"]
                 validate_against(schemas.REQUEST, body)
+        body = await self._resolve_media(ctx, body)
         if body.get("tools"):
             # UC-010 step 3: resolve all three tool encodings (references via
             # the types registry) BEFORE provider dispatch

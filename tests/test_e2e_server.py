@@ -560,3 +560,26 @@ def test_auto_approval_rules(server):
     status, body = req(server, "POST", "/v1/model-registry/models", json={
         "provider_slug": "local", "provider_model_id": "another-model"})
     assert status == 201 and body["approval_state"] == "pending"
+
+
+def test_document_part_inlined_from_file_storage(server):
+    """Document content parts resolve through file-storage + file-parser before
+    the model sees the prompt (media-via-FileStorage UCs)."""
+    html = b"<html><body><h1>Quarterly Report</h1><p>Revenue up.</p></body></html>"
+    status, meta = req(server, "POST", "/v1/files", data=html,
+                       headers={"Content-Type": "text/html", "x-filename": "q.html"})
+    assert status == 201
+    status, body = req(server, "POST", "/v1/chat/completions", json={
+        "model": "default-chat", "max_tokens": 2,
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "summarize:"},
+            {"type": "document", "url": meta["url"], "mime_type": "text/html"}]}]})
+    assert status == 200, body
+    # prompt grew: the parsed markdown was inlined (input tokens >> bare text)
+    assert body["usage"]["input_tokens"] > 120
+    # missing file -> clean 422
+    status, body = req(server, "POST", "/v1/chat/completions", json={
+        "model": "default-chat",
+        "messages": [{"role": "user", "content": [
+            {"type": "document", "url": "/v1/files/ghost.bin"}]}]})
+    assert status == 422 and body["code"] == "media_not_found"
