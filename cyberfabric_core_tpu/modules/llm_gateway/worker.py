@@ -306,6 +306,7 @@ class LocalTpuWorker(LlmWorkerApi):
             temperature=float(params.get("temperature", 0.0)),
             top_p=float(params.get("top_p", 1.0)),
             top_k=int(params.get("top_k", 0)),
+            seed=params.get("seed"),
         )
         max_input = int(model.limits.get("max_input_tokens", 0)) if model.limits else 0
         if max_input and len(prompt_ids) > max_input:

@@ -282,6 +282,13 @@ class InferenceEngine:
         if B > self.config.max_batch:
             raise ValueError(f"batch {B} exceeds max_batch {self.config.max_batch}")
         per_req = sampling if isinstance(sampling, list) else [sampling] * B
+        # per-request seed (REQUEST schema): when the whole batch shares one
+        # explicit seed, sampling is reproducible across calls. (Mixed seeds in
+        # one lockstep batch are best-effort — the continuous scheduler docs
+        # the same; per-row device keys are a later refinement.)
+        seeds = {s.seed for s in per_req}
+        if len(seeds) == 1 and (seed_val := next(iter(seeds))) is not None:
+            self._rng = jax.random.PRNGKey(seed_val)
         t_start = time.monotonic()
 
         lengths_list = [len(p) for p in prompts]
