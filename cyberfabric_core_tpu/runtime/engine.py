@@ -201,13 +201,15 @@ class InferenceEngine:
         cfg = self.model_config
         max_seq = self.config.max_seq_len
         dtype = self.dtype
+        use_flash = self.config.resolve_use_flash()
 
         def prefill(params, input_ids, lengths, rng, temperature, top_p, top_k, rope):
             B, T = input_ids.shape
             cache = llama.init_cache(cfg, B, max_seq, dtype)
             positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
             start = jnp.zeros((B,), jnp.int32)
-            hidden, cache = llama.forward(params, cfg, input_ids, positions, cache, start, rope)
+            hidden, cache = llama.forward(params, cfg, input_ids, positions, cache, start, rope,
+                                          use_flash=use_flash)
             last_h = llama.gather_last_hidden(hidden, lengths)
             logits = llama.lm_head_logits(params, cfg, last_h)  # [B, V] f32
             rng, sub = jax.random.split(rng)

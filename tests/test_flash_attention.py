@@ -9,12 +9,13 @@ from cyberfabric_core_tpu.ops.attention import attention_with_cache
 from cyberfabric_core_tpu.ops.flash_attention import flash_self_attention
 
 
-@pytest.mark.parametrize("B,T,Hq,Hkv,D,block_q", [
-    (2, 64, 4, 2, 32, 32),
-    (1, 128, 8, 8, 16, 64),   # MHA (G=1)
-    (2, 32, 4, 1, 16, 32),    # extreme GQA
+@pytest.mark.parametrize("B,T,Hq,Hkv,D,block_q,block_k", [
+    (2, 64, 4, 2, 32, 32, 16),
+    (1, 128, 8, 8, 16, 64, 32),   # MHA (G=1)
+    (2, 32, 4, 1, 16, 32, 32),    # extreme GQA, single kv block
+    (1, 128, 2, 1, 32, 32, 64),   # bk > bq (kv block spans several q blocks)
 ])
-def test_flash_matches_reference(B, T, Hq, Hkv, D, block_q):
+def test_flash_matches_reference(B, T, Hq, Hkv, D, block_q, block_k):
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
     q = jax.random.normal(kq, (B, T, Hq, D), jnp.float32)
@@ -24,10 +25,51 @@ def test_flash_matches_reference(B, T, Hq, Hkv, D, block_q):
 
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T)).astype(jnp.int32)
     ref = attention_with_cache(q, k, v, positions, lengths)
-    out = flash_self_attention(q, k, v, lengths, block_q=block_q, interpret=True)
+    out = flash_self_attention(q, k, v, lengths, block_q=block_q,
+                               block_k=block_k, interpret=True)
 
     # only positions < length are meaningful
     for b in range(B):
         L = int(lengths[b])
         np.testing.assert_allclose(
             np.asarray(out[b, :L]), np.asarray(ref[b, :L]), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_sliding_window():
+    B, T, Hq, Hkv, D = 1, 128, 4, 2, 32
+    window = 48
+    key = jax.random.PRNGKey(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, T, Hq, D), jnp.float32)
+    k = jax.random.normal(kk, (B, T, Hkv, D), jnp.float32)
+    v = jax.random.normal(kv, (B, T, Hkv, D), jnp.float32)
+    lengths = jnp.asarray([T], jnp.int32)
+
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T)).astype(jnp.int32)
+    ref = attention_with_cache(q, k, v, positions, lengths,
+                               sliding_window=window)
+    out = flash_self_attention(q, k, v, lengths, block_q=32, block_k=32,
+                               interpret=True, sliding_window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_long_context_vmem_bound():
+    """KV streams in blocks: VMEM footprint is O(BQ*D + BK*D + BQ*BK),
+    independent of T — an 8k sequence with 512-blocks stays ~a few MB
+    where the old kernel needed the full [T, D] K/V resident."""
+    B, T, Hq, Hkv, D = 1, 2048, 2, 1, 32
+    key = jax.random.PRNGKey(2)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, T, Hq, D), jnp.float32)
+    k = jax.random.normal(kk, (B, T, Hkv, D), jnp.float32)
+    v = jax.random.normal(kv, (B, T, Hkv, D), jnp.float32)
+    lengths = jnp.asarray([T - 100], jnp.int32)
+
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T)).astype(jnp.int32)
+    ref = attention_with_cache(q, k, v, positions, lengths)
+    out = flash_self_attention(q, k, v, lengths, block_q=256, block_k=256,
+                               interpret=True)
+    L = int(lengths[0])
+    np.testing.assert_allclose(
+        np.asarray(out[0, :L]), np.asarray(ref[0, :L]), rtol=2e-5, atol=2e-5)
