@@ -141,6 +141,51 @@ def _moe_mlp(x: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
     return jnp.einsum("bteh,bte->bth", expert_out, weights.astype(jnp.float32))
 
 
+def _qkv_proj(lp: dict, x: jnp.ndarray, cfg: ModelConfig,
+              positions: jnp.ndarray, cos_t, sin_t):
+    """Shared q/k/v projection + reshape + rope for one layer (any T)."""
+    B, T = x.shape[0], x.shape[1]
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    wq_m, wq_s = _wmat(lp["wq"], x.dtype)
+    wk_m, wk_s = _wmat(lp["wk"], x.dtype)
+    wv_m, wv_s = _wmat(lp["wv"], x.dtype)
+    q = _scaled(jnp.einsum("bth,hd->btd", x, wq_m,
+                preferred_element_type=jnp.float32), wq_s).astype(x.dtype)
+    kproj = _scaled(jnp.einsum("bth,hd->btd", x, wk_m,
+                    preferred_element_type=jnp.float32), wk_s).astype(x.dtype)
+    vproj = _scaled(jnp.einsum("bth,hd->btd", x, wv_m,
+                    preferred_element_type=jnp.float32), wv_s).astype(x.dtype)
+    q = q.reshape(B, T, Hq, D)
+    kproj = kproj.reshape(B, T, Hkv, D)
+    vproj = vproj.reshape(B, T, Hkv, D)
+    q = apply_rope(q, positions, cos_t, sin_t)
+    kproj = apply_rope(kproj, positions, cos_t, sin_t)
+    return q, kproj, vproj
+
+
+def _attn_out(lp: dict, h: jnp.ndarray, attn_flat: jnp.ndarray) -> jnp.ndarray:
+    wo_m, wo_s = _wmat(lp["wo"], h.dtype)
+    return h + _scaled(jnp.einsum("btd,dh->bth", attn_flat, wo_m,
+                       preferred_element_type=jnp.float32), wo_s).astype(h.dtype)
+
+
+def _mlp_residual(lp: dict, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Post-attention norm + (MoE or dense) MLP + residual."""
+    x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+    if cfg.num_experts > 0:
+        return h + _moe_mlp(x, lp, cfg).astype(h.dtype)
+    g_m, g_s = _wmat(lp["gate"], h.dtype)
+    u_m, u_s = _wmat(lp["up"], h.dtype)
+    d_m, d_s = _wmat(lp["down"], h.dtype)
+    gate = _scaled(jnp.einsum("bth,hi->bti", x, g_m,
+                   preferred_element_type=jnp.float32), g_s)
+    up = _scaled(jnp.einsum("bth,hi->bti", x, u_m,
+                 preferred_element_type=jnp.float32), u_s)
+    act = (jax.nn.silu(gate) * up).astype(h.dtype)
+    return h + _scaled(jnp.einsum("bti,ih->bth", act, d_m,
+                       preferred_element_type=jnp.float32), d_s).astype(h.dtype)
+
+
 def forward(
     params: Params,
     cfg: ModelConfig,
@@ -154,12 +199,12 @@ def forward(
     """One forward pass (prefill T>1 or decode T=1). Returns (hidden [B,T,H], cache).
 
     ``use_flash`` routes attention through the Pallas flash kernel — ONLY valid
-    for fresh-cache prefill (cache_start all zero, cache S == T): the kernel
-    attends within the new tokens, not over cache history.
+    for fresh-cache prefill (cache_start all zero): the kernel attends within
+    the new tokens, not over cache history.
     """
     cos_t, sin_t = rope_tables
     B, T = input_ids.shape
-    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    Hq, D = cfg.num_heads, cfg.head_dim
 
     h = embed_lookup(params["embed"], input_ids,
                      params["final_norm"].dtype)  # [B, T, H] gather
@@ -168,20 +213,7 @@ def forward(
     def layer_body(h, xs):
         lp, k_cache_l, v_cache_l = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-        wq_m, wq_s = _wmat(lp["wq"], h.dtype)
-        wk_m, wk_s = _wmat(lp["wk"], h.dtype)
-        wv_m, wv_s = _wmat(lp["wv"], h.dtype)
-        q = _scaled(jnp.einsum("bth,hd->btd", x, wq_m,
-                    preferred_element_type=jnp.float32), wq_s).astype(h.dtype)
-        kproj = _scaled(jnp.einsum("bth,hd->btd", x, wk_m,
-                        preferred_element_type=jnp.float32), wk_s).astype(h.dtype)
-        vproj = _scaled(jnp.einsum("bth,hd->btd", x, wv_m,
-                        preferred_element_type=jnp.float32), wv_s).astype(h.dtype)
-        q = q.reshape(B, T, Hq, D)
-        kproj = kproj.reshape(B, T, Hkv, D)
-        vproj = vproj.reshape(B, T, Hkv, D)
-        q = apply_rope(q, positions, cos_t, sin_t)
-        kproj = apply_rope(kproj, positions, cos_t, sin_t)
+        q, kproj, vproj = _qkv_proj(lp, x, cfg, positions, cos_t, sin_t)
 
         k_cache_l = _insert_kv(k_cache_l, kproj, cache_start)
         v_cache_l = _insert_kv(v_cache_l, vproj, cache_start)
@@ -199,25 +231,8 @@ def forward(
                 q, k_cache_l, v_cache_l, positions, kv_len_after,
                 sliding_window=cfg.sliding_window,
             )
-        attn = attn.reshape(B, T, Hq * D)
-        wo_m, wo_s = _wmat(lp["wo"], h.dtype)
-        h = h + _scaled(jnp.einsum("btd,dh->bth", attn, wo_m,
-                        preferred_element_type=jnp.float32), wo_s).astype(h.dtype)
-
-        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        if cfg.num_experts > 0:
-            h = h + _moe_mlp(x, lp, cfg).astype(h.dtype)
-        else:
-            g_m, g_s = _wmat(lp["gate"], h.dtype)
-            u_m, u_s = _wmat(lp["up"], h.dtype)
-            d_m, d_s = _wmat(lp["down"], h.dtype)
-            gate = _scaled(jnp.einsum("bth,hi->bti", x, g_m,
-                           preferred_element_type=jnp.float32), g_s)
-            up = _scaled(jnp.einsum("bth,hi->bti", x, u_m,
-                         preferred_element_type=jnp.float32), u_s)
-            act = (jax.nn.silu(gate) * up).astype(h.dtype)
-            h = h + _scaled(jnp.einsum("bti,ih->bth", act, d_m,
-                            preferred_element_type=jnp.float32), d_s).astype(h.dtype)
+        h = _attn_out(lp, h, attn.reshape(B, T, Hq * D))
+        h = _mlp_residual(lp, h, cfg)
         return h, (k_cache_l, v_cache_l)
 
     k_cache, v_cache = cache
@@ -226,6 +241,67 @@ def forward(
     )
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     return h, (k_cache, v_cache)
+
+
+PagedPools = tuple[jnp.ndarray, jnp.ndarray]  # (k, v): [L, N, page, Hkv, D]
+
+
+def forward_paged_decode(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,    # [B, 1] int32 — one token per slot
+    pools: PagedPools,
+    page_table: jnp.ndarray,   # [B, Pmax] int32 physical page ids per slot
+    lengths: jnp.ndarray,      # [B] int32 current valid length (BEFORE this token)
+    rope_tables: tuple[jnp.ndarray, jnp.ndarray],
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, PagedPools]:
+    """One decode step over the paged KV pool. Returns (hidden [B,1,H], pools).
+
+    Each slot's new k/v token lands at (page_table[b, len//page], len%page);
+    attention runs through the ragged paged kernel, so HBM reads scale with the
+    tokens present, not n_slots × max_seq. Pages may be shared across slots
+    (prefix cache) — they are only ever read here; writes target each slot's
+    private tail page (admission guarantees the tail page is unshared).
+    """
+    from ..ops.paged_attention import paged_decode_attention
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    cos_t, sin_t = rope_tables
+    B = input_ids.shape[0]
+    Hq, D = cfg.num_heads, cfg.head_dim
+    page_size = pools[0].shape[2]
+    positions = lengths[:, None]
+
+    idx_page = lengths // page_size
+    pid = jnp.take_along_axis(page_table, idx_page[:, None], axis=1)[:, 0]
+    off = lengths % page_size
+
+    h = embed_lookup(params["embed"], input_ids, params["final_norm"].dtype)
+
+    def layer_body(h, xs):
+        lp, k_pool_l, v_pool_l = xs
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        q, kproj, vproj = _qkv_proj(lp, x, cfg, positions, cos_t, sin_t)
+
+        # scatter the new token into each slot's tail page (inactive slots all
+        # target scratch page 0 — duplicate writes there are harmless)
+        k_pool_l = k_pool_l.at[pid, off].set(kproj[:, 0].astype(k_pool_l.dtype))
+        v_pool_l = v_pool_l.at[pid, off].set(vproj[:, 0].astype(v_pool_l.dtype))
+
+        attn = paged_decode_attention(
+            q[:, 0], k_pool_l, v_pool_l, page_table, lengths + 1,
+            interpret=interpret, sliding_window=cfg.sliding_window)
+        h = _attn_out(lp, h, attn.reshape(B, 1, Hq * D))
+        h = _mlp_residual(lp, h, cfg)
+        return h, (k_pool_l, v_pool_l)
+
+    k_pool, v_pool = pools
+    h, (k_pool, v_pool) = jax.lax.scan(
+        layer_body, h, (params["layers"], k_pool, v_pool))
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    return h, (k_pool, v_pool)
 
 
 def prefill_collect(

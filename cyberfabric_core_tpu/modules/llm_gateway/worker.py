@@ -236,14 +236,23 @@ class LocalTpuWorker(LlmWorkerApi):
     def _build_entry(self, model: ModelInfo) -> _EngineEntry:
         opts = dict(model.engine_options or {})
         arch_config = opts.pop("model_config", None) or model.provider_model_id
+        max_seq_len = int(opts.pop("max_seq_len", 2048))
+        max_batch = int(opts.pop("max_batch", 8))
+        page_size = int(opts.pop("prefix_page_size", 64))
+        # paged decode is the default serving path: slot KV + prefix cache in
+        # ONE paged pool (the scheduler raises this to the per-slot minimum;
+        # the margin here is prefix-cache retention headroom). 0 disables.
+        default_pages = max_batch * (-(-max_seq_len // page_size)) * 5 // 4 + 1
         eng_cfg = EngineConfig(
             model=arch_config,
-            max_seq_len=int(opts.pop("max_seq_len", 2048)),
-            max_batch=int(opts.pop("max_batch", 8)),
+            max_seq_len=max_seq_len,
+            max_batch=max_batch,
             dtype=opts.pop("dtype", "bfloat16"),
             eos_token_ids=tuple(opts.pop("eos_token_ids", ()) or ()),
             decode_chunk=int(opts.pop("decode_chunk", 8)),
             quantization=opts.pop("quantization", "none"),
+            prefix_cache_pages=int(opts.pop("prefix_cache_pages", default_pages)),
+            prefix_page_size=page_size,
         )
         params = None
         tokenizer: Tokenizer

@@ -58,3 +58,40 @@ def sample_token(
         jnp.all(temperature <= 0.0), greedy_branch, sample_branch,
         (logits, key, temperature, top_p, top_k),
     )
+
+
+def sample_token_per_slot(
+    logits: jnp.ndarray,       # [B, V] f32
+    keys: jnp.ndarray,         # [B, 2] uint32 — one PRNG key per slot
+    temperature: jnp.ndarray,  # [B] f32; 0 → greedy
+    top_p: jnp.ndarray,        # [B] f32
+    top_k: jnp.ndarray,        # [B] int32
+) -> jnp.ndarray:
+    """Per-slot-keyed sampling for continuous batching: each slot draws from its
+    OWN key stream, so a request's seed reproduces its tokens regardless of
+    which other requests share the batch (round-1 advisory: the shared-rng
+    scheduler silently dropped per-request seeds). The all-greedy fast path is
+    kept at the batch level — the vmapped sort only runs when some row samples."""
+
+    def greedy_branch(operands):
+        logits, *_ = operands
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sample_branch(operands):
+        logits, keys, temperature, top_p, top_k = operands
+
+        def one(lg, kk, tt, pp, tk):
+            return sample_token(lg[None], kk, tt[None], pp[None], tk[None])[0]
+
+        return jax.vmap(one)(logits, keys, temperature, top_p, top_k)
+
+    return jax.lax.cond(
+        jnp.all(temperature <= 0.0), greedy_branch, sample_branch,
+        (logits, keys, temperature, top_p, top_k),
+    )
+
+
+def split_keys_per_slot(keys: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, 2] keys → (advanced keys [B, 2], subkeys [B, 2]), vmapped split."""
+    both = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    return both[:, 0], both[:, 1]

@@ -64,6 +64,13 @@ class PrefixKVPool:
         self.tree = PrefixCache(page_size, force_python=force_python_native)
         self.prefill_tokens_saved = 0
         self.admissions = 0
+        # paged-decode bookkeeping: pages referenced by live slots must survive
+        # tree eviction (the tree can drop a page from the *cache* while a slot
+        # still reads it — it then becomes an orphan, returned to the allocator
+        # only when the last referencing slot completes)
+        self._refs: dict[int, int] = {}
+        self._tree_owned: set[int] = set()
+        self._orphans: set[int] = set()
 
     # ------------------------------------------------------------ jitted movers
     @partial(jax.jit, static_argnums=(0, 3))
@@ -94,14 +101,63 @@ class PrefixKVPool:
         return (k_pool.at[:, page_ids].set(k_pages),
                 v_pool.at[:, page_ids].set(v_pages))
 
+    def _scatter_full_pages(self, kv: tuple, page_ids: list[int],
+                            start_token: int) -> None:
+        """Scatter len(page_ids) full pages from kv (token dim) into the pool.
+        Pads both the id list (to a pow2 bucket: bounded compile variants;
+        padding targets scratch page 0) and the kv token dim (the pow2 span can
+        exceed the prefill bucket — dynamic_slice rejects, never clamps)."""
+        n = len(page_ids)
+        pb = next(b for b in _buckets_upto(self.num_pages) if b >= n)
+        padded = np.zeros(pb, np.int32)
+        padded[:n] = page_ids
+        span_end = start_token + pb * self.page_size
+        width = kv[0].shape[2]
+        if width < span_end:
+            pad = [(0, 0), (0, 0), (0, span_end - width), (0, 0), (0, 0)]
+            kv = (jnp.pad(kv[0], pad), jnp.pad(kv[1], pad))
+        self.k_pool, self.v_pool = self._scatter(
+            (self.k_pool, self.v_pool), kv, jnp.asarray(padded), start_token)
+
     # ------------------------------------------------------------ admission
     def _alloc(self, n: int) -> list[int]:
-        try:
-            return [p + self._page_offset for p in self.allocator.alloc(n)]
-        except MemoryError:
-            freed = self.tree.evict(n)
-            self.allocator.free([p - self._page_offset for p in freed])
-            return [p + self._page_offset for p in self.allocator.alloc(n)]
+        """Allocate n pages, evicting unpinned tree entries as needed. Evicted
+        pages still referenced by a live slot become orphans (freed at unref),
+        so eviction may need several rounds to actually recover allocator space."""
+        while True:
+            try:
+                return [p + self._page_offset for p in self.allocator.alloc(n)]
+            except MemoryError:
+                freed = self.tree.evict(n)
+                if not freed:
+                    raise
+                now_free = []
+                for p in freed:
+                    self._tree_owned.discard(p)
+                    if self._refs.get(p, 0) > 0:
+                        self._orphans.add(p)
+                    else:
+                        now_free.append(p - self._page_offset)
+                self.allocator.free(now_free)
+
+    # ------------------------------------------------------------ slot refs
+    def ref_pages(self, pages: list[int]) -> None:
+        for p in pages:
+            self._refs[p] = self._refs.get(p, 0) + 1
+
+    def unref_pages(self, pages: list[int]) -> None:
+        """Drop a completed slot's references; frees pages nothing else owns."""
+        to_free = []
+        for p in pages:
+            c = self._refs.get(p, 0) - 1
+            if c <= 0:
+                self._refs.pop(p, None)
+                if p not in self._tree_owned:
+                    self._orphans.discard(p)
+                    to_free.append(p - self._page_offset)
+            else:
+                self._refs[p] = c
+        self.allocator.free(to_free)
 
     def match_prefix(self, prompt_ids: list[int]) -> tuple[list[int], int]:
         """Returns (pinned page ids, cached token count). Never returns the FULL
@@ -137,30 +193,111 @@ class PrefixKVPool:
         return (k, v)
 
     def store_prefill(self, prompt_ids: list[int], cached_pages: list[int],
-                      kv: tuple) -> None:
+                      kv: tuple) -> list[int]:
         """After prefill: scatter the NEW full pages into the pool and record the
-        whole prompt's page chain in the radix tree."""
+        whole prompt's page chain in the radix tree. Returns the full-page chain
+        (cached + new) for the admitting slot's page table."""
         total_pages = len(prompt_ids) // self.page_size
         n_new = total_pages - len(cached_pages)
         if n_new <= 0:
-            return
+            return list(cached_pages)
         try:
             new_ids = self._alloc(n_new)
         except MemoryError:
             logger.debug("pool exhausted; skipping prefix store")
-            return
-        pb = next(b for b in _buckets_upto(self.num_pages) if b >= n_new)
-        padded = np.zeros(pb, np.int32)
-        padded[:n_new] = new_ids
-        self.k_pool, self.v_pool = self._scatter(
-            (self.k_pool, self.v_pool), kv, jnp.asarray(padded),
-            len(cached_pages) * self.page_size)
+            return list(cached_pages)
+        try:
+            self._scatter_full_pages(kv, new_ids,
+                                     len(cached_pages) * self.page_size)
+        except Exception:
+            self.allocator.free([p - self._page_offset for p in new_ids])
+            raise
         chain = list(cached_pages) + new_ids
         self.tree.insert(prompt_ids[: total_pages * self.page_size], chain)
+        self._tree_owned.update(new_ids)
         self.admissions += 1
+        return chain
+
+    @partial(jax.jit, static_argnums=(0,))
+    def _scatter_tail(self, pools, kv, start_token, page_id):
+        """Write one page worth of kv tokens starting at start_token into pool
+        page page_id (the slot's partial tail after prefill; positions past the
+        prompt are garbage masked by length and overwritten by decode)."""
+        k_pool, v_pool = pools
+        k_new, v_new = kv
+        k_page = jax.lax.dynamic_slice_in_dim(
+            k_new[:, 0], start_token, self.page_size, axis=1).astype(k_pool.dtype)
+        v_page = jax.lax.dynamic_slice_in_dim(
+            v_new[:, 0], start_token, self.page_size, axis=1).astype(v_pool.dtype)
+        return (k_pool.at[:, page_id].set(k_page),
+                v_pool.at[:, page_id].set(v_page))
+
+    def scatter_tail(self, kv: tuple, start_token: int, page_id: int) -> None:
+        """Host wrapper: place a slot's partial tail tokens into its private
+        page. Pads kv when the prefill bucket is shorter than one page past
+        start_token (dynamic_slice would otherwise clamp the start)."""
+        bucket = kv[0].shape[2]
+        if bucket < start_token + self.page_size:
+            pad = [(0, 0), (0, 0), (0, start_token + self.page_size - bucket),
+                   (0, 0), (0, 0)]
+            kv = (jnp.pad(kv[0], pad), jnp.pad(kv[1], pad))
+        self.k_pool, self.v_pool = self._scatter_tail(
+            (self.k_pool, self.v_pool), kv,
+            jnp.asarray(start_token, jnp.int32), jnp.asarray(page_id, jnp.int32))
 
     def release(self, prompt_ids: list[int]) -> None:
         self.tree.release(prompt_ids)
+
+    # ------------------------------------------------------------ slot chains
+    def pages_for(self, length: int) -> int:
+        return (length + self.page_size - 1) // self.page_size
+
+    def admit_slot(self, prompt_ids: list[int], cached_pages: list[int],
+                   kv: tuple) -> list[int]:
+        """Place one request's prefilled KV into pool pages for paged decode.
+
+        Full prompt pages go through the shared radix tree (store_prefill) so
+        later requests reuse them; the partial tail lands in a private page.
+        Every chain page is ref'd for the slot's lifetime — call
+        release_slot(chain) on completion. Raises MemoryError when the pool
+        cannot hold the request even after eviction."""
+        T = len(prompt_ids)
+        full = T // self.page_size
+        tail = T - full * self.page_size
+        chain = self.store_prefill(prompt_ids, cached_pages, kv)
+        private: list[int] = []
+        try:
+            if len(chain) < full:
+                # tree store skipped (pool pressure): hold the remaining full
+                # pages privately so the slot can still decode
+                missing = full - len(chain)
+                ids = self._alloc(missing)
+                private.extend(ids)
+                self._scatter_full_pages(kv, ids, len(chain) * self.page_size)
+                chain = chain + ids
+            if tail:
+                tid = self._alloc(1)[0]
+                private.append(tid)
+                self.scatter_tail(kv, full * self.page_size, tid)
+                chain = chain + [tid]
+        except Exception:
+            self.allocator.free([p - self._page_offset for p in private])
+            raise
+        self.ref_pages(chain)
+        return chain
+
+    def extend_chain(self, chain: list[int], length_needed: int) -> list[int]:
+        """Grow a slot's chain (private decode pages) to cover length_needed
+        tokens. Returns the same list, extended in place."""
+        add = self.pages_for(length_needed) - len(chain)
+        if add > 0:
+            ids = self._alloc(add)
+            self.ref_pages(ids)
+            chain.extend(ids)
+        return chain
+
+    def release_slot(self, chain: list[int]) -> None:
+        self.unref_pages(chain)
 
     def stats(self) -> dict[str, Any]:
         return {

@@ -49,6 +49,7 @@ class _SlotState:
     stops: frozenset[int]
     emitted: int = 0
     request_index: int = 0  # external correlation id
+    chain: Optional[list[int]] = None  # paged mode: page ids held by this slot
 
 
 @dataclass
@@ -94,19 +95,39 @@ class ContinuousBatchingEngine:
         self._top_p = np.ones(self.n_slots, np.float32)
         self._top_k = np.zeros(self.n_slots, np.int32)
 
-        # device state
-        self.cache = llama.init_cache(
-            self.model_config, self.n_slots, config.max_seq_len, self.dtype)
         self._last_tokens = jnp.zeros((self.n_slots,), jnp.int32)
 
-        # optional cross-request prefix reuse (paged pool + native radix tree)
+        # paged decode (default): slot KV lives in ONE paged pool shared with
+        # the prefix cache — decode attention reads through per-slot page
+        # tables (ops/paged_attention.py), prefix pages are shared zero-copy,
+        # and idle slots cost one scratch-page read instead of a max_seq scan.
+        # config.prefix_cache_pages <= 0 opts out (dense per-slot cache).
         self.pool = None
-        if config.prefix_cache_pages > 0:
+        self.paged = config.prefix_cache_pages > 0
+        if self.paged:
             from .paged import PrefixKVPool
 
+            page = config.prefix_page_size
+            self.pmax = -(-config.max_seq_len // page)
+            # every slot must be able to hold a full-window chain: size the
+            # pool so capacity extension can always succeed via eviction
+            min_pages = self.n_slots * self.pmax + 1
+            num_pages = max(config.prefix_cache_pages, min_pages)
+            if num_pages > config.prefix_cache_pages:
+                logger.info("prefix_cache_pages %d below slot minimum; using %d",
+                            config.prefix_cache_pages, num_pages)
             self.pool = PrefixKVPool(
-                self.model_config, num_pages=config.prefix_cache_pages,
-                page_size=config.prefix_page_size, dtype=self.dtype)
+                self.model_config, num_pages=num_pages,
+                page_size=page, dtype=self.dtype)
+            self.page_table = np.zeros((self.n_slots, self.pmax), np.int32)
+            self._page_table_dev = jnp.asarray(self.page_table)
+            self._pt_dirty = False
+            self.cache = None  # no dense pool — HBM belongs to the paged pool
+            self._slot_keys = jax.random.split(
+                jax.random.PRNGKey(seed ^ 0x5EED), self.n_slots)
+        else:
+            self.cache = llama.init_cache(
+                self.model_config, self.n_slots, config.max_seq_len, self.dtype)
 
         self._pending: _queue.Queue[_Pending] = _queue.Queue()
         self._wake = threading.Event()
@@ -158,15 +179,43 @@ class ContinuousBatchingEngine:
 
         self._suffix_prefill_fn = jax.jit(suffix_prefill)
 
-        def insert(k_cache, v_cache, k_new, v_new, slot):
-            return llama.insert_slot_kv((k_cache, v_cache), (k_new, v_new), slot)
+        if self.paged:
+            from ..ops.sampling import sample_token_per_slot, split_keys_per_slot
 
-        self._insert_fn = jax.jit(insert, donate_argnums=(0, 1))
+            rope = self.rope_tables
 
-        # the SAME fused decode body as InferenceEngine — semantics cannot diverge
-        self._decode_fn = jax.jit(
-            build_decode_chunk_fn(cfg, k_steps, self.rope_tables),
-            donate_argnums=(1, 2))
+            def paged_decode_chunk(params, k_pool, v_pool, page_table,
+                                   last_tokens, lengths, keys, temp, top_p, top_k):
+                """k fused paged decode steps; per-slot key streams so each
+                request's seed reproduces its tokens (round-1 advisory)."""
+
+                def step(carry, _):
+                    pools, toks, lens, keys = carry
+                    hidden, pools = llama.forward_paged_decode(
+                        params, cfg, toks[:, None], pools, page_table, lens, rope)
+                    logits = llama.lm_head_logits(params, cfg, hidden[:, 0, :])
+                    keys, subs = split_keys_per_slot(keys)
+                    nxt = sample_token_per_slot(logits, subs, temp, top_p, top_k)
+                    return (pools, nxt, lens + 1, keys), nxt
+
+                (pools, last, _, keys), toks = jax.lax.scan(
+                    step, ((k_pool, v_pool), last_tokens, lengths, keys),
+                    None, length=k_steps)
+                return toks.T, pools[0], pools[1], last, keys
+
+            self._paged_decode_fn = jax.jit(paged_decode_chunk,
+                                            donate_argnums=(1, 2))
+        else:
+            def insert(k_cache, v_cache, k_new, v_new, slot):
+                return llama.insert_slot_kv((k_cache, v_cache), (k_new, v_new), slot)
+
+            self._insert_fn = jax.jit(insert, donate_argnums=(0, 1))
+
+            # the SAME fused decode body as InferenceEngine — semantics cannot
+            # diverge between the lockstep engine and the dense scheduler
+            self._decode_fn = jax.jit(
+                build_decode_chunk_fn(cfg, k_steps, self.rope_tables),
+                donate_argnums=(1, 2))
         self._k_steps = k_steps
 
     def _bucket_for(self, length: int) -> int:
@@ -286,6 +335,17 @@ class ContinuousBatchingEngine:
         top_p = jnp.asarray([s.top_p], jnp.float32)
         top_k = jnp.asarray([s.top_k], jnp.int32)
 
+        # paged mode: the request gets its own key stream from admission on —
+        # an explicit seed reproduces the whole generation (first token
+        # included) regardless of batch composition (round-1 advisory)
+        if self.paged:
+            if s.seed is not None:
+                req_key = jax.random.PRNGKey(s.seed)
+            else:
+                self._rng, req_key = jax.random.split(self._rng)
+        else:
+            req_key = None
+
         cached_pages: list[int] = []
         if self.pool is not None:
             cached_pages, cached_len = self.pool.match_prefix(req.prompt_ids)
@@ -302,6 +362,7 @@ class ContinuousBatchingEngine:
                 else:
                     self.pool.release(req.prompt_ids)
                     cached_pages = []
+        chain: Optional[list[int]] = None
         if cached_pages:
             # prefix hit: gather history, prefill the suffix only
             try:
@@ -311,28 +372,46 @@ class ContinuousBatchingEngine:
                 ids[0, : len(suffix)] = suffix
                 cache = llama.init_cache(self.model_config, 1, bucket, self.dtype)
                 cache = self.pool.gather_for_prefill(cached_pages, bucket, cache)
-                first, kv, self._rng = self._suffix_prefill_fn(
+                first, kv, rng_out = self._suffix_prefill_fn(
                     self.params, jnp.asarray(ids),
                     jnp.asarray([len(suffix)], jnp.int32),
                     jnp.asarray(cached_len, jnp.int32), cache,
-                    self._rng, temp, top_p, top_k)
-                self.pool.store_prefill(req.prompt_ids, cached_pages, kv)
+                    req_key if self.paged else self._rng, temp, top_p, top_k)
+                if self.paged:
+                    req_key = rng_out
+                else:
+                    self._rng = rng_out
+                chain = self.pool.admit_slot(req.prompt_ids, cached_pages, kv)
             finally:
                 self.pool.release(req.prompt_ids)
         else:
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :T] = req.prompt_ids
-            first, kv, self._rng = self._prefill_fn(
+            first, kv, rng_out = self._prefill_fn(
                 self.params, jnp.asarray(ids), jnp.asarray([T], jnp.int32),
-                self._rng, temp, top_p, top_k, self.rope_tables)
-            if self.pool is not None:
-                self.pool.store_prefill(req.prompt_ids, [], kv)
-                self.pool.release(req.prompt_ids)
-        # pad the collected kv to max_seq? No: insert writes [L,1,bucket,...] at
-        # slot offset 0; the remaining tail keeps stale data masked by length.
-        self.cache = self._insert_fn(
-            self.cache[0], self.cache[1], kv[0], kv[1],
-            jnp.asarray(slot, jnp.int32))
+                req_key if self.paged else self._rng, temp, top_p, top_k,
+                self.rope_tables)
+            if self.paged:
+                req_key = rng_out
+            else:
+                self._rng = rng_out
+            if self.pool is not None:  # pool exists iff paged mode
+                try:
+                    chain = self.pool.admit_slot(req.prompt_ids, [], kv)
+                finally:
+                    self.pool.release(req.prompt_ids)
+        if self.paged:
+            assert chain is not None
+            self.page_table[slot, :] = 0
+            self.page_table[slot, : len(chain)] = chain
+            self._pt_dirty = True
+            # continue this request's key stream (advanced by prefill) in decode
+            self._slot_keys = self._slot_keys.at[slot].set(req_key)
+        else:
+            # dense mode: scatter the collected kv into the slot's cache rows
+            self.cache = self._insert_fn(
+                self.cache[0], self.cache[1], kv[0], kv[1],
+                jnp.asarray(slot, jnp.int32))
         tok = int(np.asarray(first)[0])
 
         state = _SlotState(
@@ -340,6 +419,7 @@ class ContinuousBatchingEngine:
             emit=req.emit,
             sampling=s,
             stops=frozenset(s.stop_token_ids) | frozenset(self.config.eos_token_ids),
+            chain=chain,
         )
         self.slots[slot] = state
         self.lengths[slot] = T
@@ -371,16 +451,63 @@ class ContinuousBatchingEngine:
             self.active[slot] = False
             self.slots[slot] = None
             self.requests_completed += 1
+            if self.paged and state.chain is not None:
+                self.pool.release_slot(state.chain)
+                self.page_table[slot, :] = 0
+                self._pt_dirty = True
+
+    def _ensure_chunk_capacity(self) -> None:
+        """Paged mode: before a chunk, every active slot's chain must cover its
+        length + k tokens (a chunk may cross a page boundary mid-flight; page
+        allocation is host-side, so it happens here, never inside jit). Slots
+        the pool cannot serve are finished with 'length' (bounded shed)."""
+        for slot in range(self.n_slots):
+            state = self.slots[slot]
+            if state is None or not self.active[slot]:
+                continue
+            chain = state.chain
+            assert chain is not None
+            needed = int(self.lengths[slot]) + self._k_steps
+            if self.pool.pages_for(needed) <= len(chain):
+                continue
+            try:
+                before = len(chain)
+                self.pool.extend_chain(chain, needed)
+                self.page_table[slot, before: len(chain)] = chain[before:]
+                self._pt_dirty = True
+            except MemoryError:
+                logger.warning("pool exhausted; failing %s", state.request_id)
+                state.emit(StepEvent(0, -1, "error"))
+                self.active[slot] = False
+                self.slots[slot] = None
+                self.pool.release_slot(chain)
+                self.page_table[slot, :] = 0
+                self._pt_dirty = True
 
     def _decode_round(self) -> None:
         self.occupancy_samples.append(self.active_slots)
-        lengths_dev = jnp.asarray(self.lengths)
-        chunk_dev, k_cache, v_cache, last, self._rng = self._decode_fn(
-            self.params, self.cache[0], self.cache[1], self._last_tokens,
-            lengths_dev, self._rng,
-            jnp.asarray(self._temp), jnp.asarray(self._top_p),
-            jnp.asarray(self._top_k))
-        self.cache = (k_cache, v_cache)
+        if self.paged:
+            self._ensure_chunk_capacity()
+            if not self.active.any():
+                return
+            if self._pt_dirty:
+                self._page_table_dev = jnp.asarray(self.page_table)
+                self._pt_dirty = False
+            lengths_dev = jnp.asarray(self.lengths)
+            chunk_dev, k_pool, v_pool, last, self._slot_keys = self._paged_decode_fn(
+                self.params, self.pool.k_pool, self.pool.v_pool,
+                self._page_table_dev, self._last_tokens, lengths_dev,
+                self._slot_keys, jnp.asarray(self._temp),
+                jnp.asarray(self._top_p), jnp.asarray(self._top_k))
+            self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
+        else:
+            lengths_dev = jnp.asarray(self.lengths)
+            chunk_dev, k_cache, v_cache, last, self._rng = self._decode_fn(
+                self.params, self.cache[0], self.cache[1], self._last_tokens,
+                lengths_dev, self._rng,
+                jnp.asarray(self._temp), jnp.asarray(self._top_p),
+                jnp.asarray(self._top_k))
+            self.cache = (k_cache, v_cache)
         self._last_tokens = last
         chunk = np.asarray(chunk_dev, np.int32)  # [N, k]
         k = self._k_steps

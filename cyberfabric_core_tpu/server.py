@@ -54,7 +54,26 @@ def _setup_logging(config: AppConfig, override: Optional[str]) -> None:
     init_logging_unified(section)
 
 
+def _honor_cpu_intent() -> None:
+    """If the launching env asks for CPU, pin the jax backend before any device
+    op: the axon sitecustomize pins JAX_PLATFORMS=axon at interpreter start,
+    and a wedged TPU transport hangs the first backend init — an operator who
+    exported JAX_PLATFORMS=cpu must never touch the TPU path at all."""
+    import os
+
+    platforms = os.environ.get("JAX_PLATFORMS", "").strip()
+    if platforms == "cpu" or \
+            "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — backend already pinned; leave it
+            pass
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    _honor_cpu_intent()
     args = build_parser().parse_args(argv)
     _load_modules()
 
