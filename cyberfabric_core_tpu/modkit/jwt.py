@@ -71,6 +71,9 @@ class JwtValidator:
     issuer: Optional[str] = None
     audience: Optional[str] = None
     leeway_s: float = 30.0
+    #: reject tokens without an exp claim (round-1 advisory: a token minted
+    #: without exp validated forever, leaving key rotation the only revocation)
+    require_exp: bool = True
 
     @classmethod
     def from_config(cls, cfg: dict) -> "JwtValidator":
@@ -80,7 +83,8 @@ class JwtValidator:
                                secret=spec.get("secret"),
                                public_key_pem=spec.get("public_key_pem"))
         return cls(keys=keys, issuer=cfg.get("issuer"), audience=cfg.get("audience"),
-                   leeway_s=float(cfg.get("leeway_s", 30.0)))
+                   leeway_s=float(cfg.get("leeway_s", 30.0)),
+                   require_exp=bool(cfg.get("require_exp", True)))
 
     def _verify_signature(self, header: dict, signing_input: bytes, sig: bytes) -> None:
         alg = header.get("alg")
@@ -135,6 +139,9 @@ class JwtValidator:
             except (TypeError, ValueError) as e:
                 raise JwtError(f"claim {name!r} is not numeric") from e
 
+        if "exp" not in claims and self.require_exp:
+            raise JwtError("token missing exp claim (set require_exp: false "
+                           "to accept non-expiring tokens)")
         if "exp" in claims and now > numeric("exp") + self.leeway_s:
             raise JwtError("token expired")
         if "nbf" in claims and now < numeric("nbf") - self.leeway_s:

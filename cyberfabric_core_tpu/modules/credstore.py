@@ -57,8 +57,72 @@ class CredStorePluginApi(abc.ABC):
 
 
 class SqliteCredPlugin(CredStorePluginApi):
+    """Sqlite KV with AES-256-GCM encryption at rest (round-1 advisory: secret
+    values were plaintext in the module db file — filesystem access read every
+    tenant's credentials). The master key comes from module config
+    ``encryption_key`` (64 hex chars) or, by default, an auto-generated 0600
+    keyfile under the server home dir. The tenant id is bound as AAD so a row
+    copied between tenants fails authentication. Legacy plaintext rows (no
+    ``enc:v1:`` prefix) still read, and re-encrypt on the next put."""
+
+    _PREFIX = "enc:v1:"
+
     def __init__(self, ctx: ModuleCtx) -> None:
         self._db = ctx.db_required()
+        self._key = self._load_key(ctx)
+
+    @staticmethod
+    def _load_key(ctx: ModuleCtx) -> bytes:
+        configured = ctx.raw_config().get("encryption_key")
+        if configured:
+            key = bytes.fromhex(str(configured))
+            if len(key) != 32:
+                raise ValueError("credstore encryption_key must be 64 hex chars")
+            return key
+        import os
+
+        def read_key(path) -> bytes:
+            key = bytes.fromhex(path.read_text().strip())
+            if len(key) != 32:
+                raise ValueError(f"corrupt credstore keyfile {path} "
+                                 f"({len(key)} bytes, expected 32)")
+            return key
+
+        key_path = ctx.app_config.home_dir() / "credstore.key"
+        if key_path.exists():
+            return read_key(key_path)
+        key = os.urandom(32)
+        key_path.parent.mkdir(parents=True, exist_ok=True)
+        try:
+            fd = os.open(str(key_path), os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        except FileExistsError:
+            # concurrent first start: another process won the create — use its key
+            return read_key(key_path)
+        with os.fdopen(fd, "w") as f:
+            f.write(key.hex())
+        return key
+
+    def _encrypt(self, tenant_id: str, plain: str) -> str:
+        import base64
+        import os
+
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        nonce = os.urandom(12)
+        ct = AESGCM(self._key).encrypt(nonce, plain.encode(),
+                                       tenant_id.encode())
+        return self._PREFIX + base64.b64encode(nonce + ct).decode()
+
+    def _decrypt(self, tenant_id: str, stored: str) -> str:
+        import base64
+
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        if not stored.startswith(self._PREFIX):
+            return stored  # legacy plaintext row
+        raw = base64.b64decode(stored[len(self._PREFIX):])
+        return AESGCM(self._key).decrypt(raw[:12], raw[12:],
+                                         tenant_id.encode()).decode()
 
     def _conn(self, tenant_id: str):
         return self._db.secure(
@@ -66,15 +130,18 @@ class SqliteCredPlugin(CredStorePluginApi):
 
     def get(self, tenant_id: str, key: str) -> Optional[tuple[str, str]]:
         row = self._conn(tenant_id).find_one({"key": key})
-        return (row["value"], row["sharing"]) if row else None
+        if not row:
+            return None
+        return self._decrypt(tenant_id, row["value"]), row["sharing"]
 
     def put(self, tenant_id: str, key: str, value: str, sharing: str) -> None:
         conn = self._conn(tenant_id)
+        stored = self._encrypt(tenant_id, value)
         existing = conn.find_one({"key": key})
         if existing:
-            conn.update(existing["id"], {"value": value, "sharing": sharing})
+            conn.update(existing["id"], {"value": stored, "sharing": sharing})
         else:
-            conn.insert({"key": key, "value": value, "sharing": sharing})
+            conn.insert({"key": key, "value": stored, "sharing": sharing})
 
     def delete(self, tenant_id: str, key: str) -> bool:
         conn = self._conn(tenant_id)

@@ -13,6 +13,7 @@ gateway+plugin pattern. Plugins implemented here:
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Optional
 
 from ..modkit import Module, module
@@ -56,6 +57,9 @@ class StaticTenantResolver(TenantResolverApi):
 
     def knows(self, tenant_id: str) -> bool:
         return tenant_id in self._parent
+
+    async def exists(self, tenant_id: str) -> bool:
+        return self.knows(tenant_id)
 
 
 class JwtAuthnResolver(AuthnApi):
@@ -115,17 +119,33 @@ class StaticAuthnResolver(AuthnApi):
     {token: {subject, tenant_id, scopes, roles}}."""
 
     def __init__(self, mode: str = "accept_all", tokens: Optional[dict] = None,
-                 default_tenant: str = "default") -> None:
+                 default_tenant: str = "default",
+                 known_tenants: Optional[TenantResolverApi] = None) -> None:
         if mode not in ("accept_all", "static"):
             raise ValueError(f"unknown authn mode {mode!r}")
         self.mode = mode
         self.tokens = tokens or {}
         self.default_tenant = default_tenant
+        self.known_tenants = known_tenants
+        if mode == "accept_all":
+            # round-1 advisory: header-selected tenants silently removed
+            # isolation if this dev default shipped — make it loud, and bound
+            # the header to tenants the resolver actually knows
+            logging.getLogger("authn").warning(
+                "authn mode=accept_all: requests are UNAUTHENTICATED and the "
+                "x-tenant-id header selects the tenant (restricted to tenants "
+                "known to the tenant resolver). Dev/quickstart only — never "
+                "production.")
 
     async def authenticate(self, bearer_token: Optional[str],
                            request_meta: dict[str, Any]) -> SecurityContext:
         if self.mode == "accept_all":
             tenant = request_meta.get("tenant_header") or self.default_tenant
+            if tenant != self.default_tenant and self.known_tenants is not None:
+                known = await self.known_tenants.exists(tenant)
+                if not known:
+                    raise ProblemError.unauthorized(
+                        f"unknown tenant {tenant!r}")
             return SecurityContext(
                 subject="anonymous", tenant_id=tenant,
                 access_scope=AccessScope.for_tenants([tenant]),
@@ -185,7 +205,7 @@ class TenantResolverModule(Module, SystemCapability):
         ctx.client_hub.register(TenantResolverApi, resolver)
 
 
-@module(name="authn_resolver", capabilities=["system"])
+@module(name="authn_resolver", deps=["tenant_resolver"], capabilities=["system"])
 class AuthnResolverModule(Module, SystemCapability):
     async def init(self, ctx: ModuleCtx) -> None:
         cfg = ctx.raw_config()
@@ -197,6 +217,7 @@ class AuthnResolverModule(Module, SystemCapability):
                 mode=mode,
                 tokens=cfg.get("tokens"),
                 default_tenant=cfg.get("default_tenant", "default"),
+                known_tenants=ctx.client_hub.try_get(TenantResolverApi),
             )
         ctx.client_hub.register(AuthnApi, resolver)
 

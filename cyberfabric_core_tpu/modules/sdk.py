@@ -168,6 +168,11 @@ class TenantResolverApi(abc.ABC):
     async def subtree_of(self, tenant_id: str) -> list[str]:
         ...
 
+    async def exists(self, tenant_id: str) -> bool:
+        """Whether the tenant is known. Resolvers that cannot enumerate
+        (e.g. remote directories) stay permissive by default."""
+        return True
+
     async def walk_up(self, tenant_id: str) -> list[str]:
         """tenant + ancestors to the root (credstore resolution order)."""
         chain = [tenant_id]

@@ -304,6 +304,8 @@ class PrefixKVPool:
             **self.tree.stats(),
             "pages_free": self.allocator.num_free,
             "pages_total": self.num_pages - 1,
+            "pages_referenced": len(self._refs),
+            "orphan_pages": len(self._orphans),  # evicted but still slot-held
             "prefill_tokens_saved": self.prefill_tokens_saved,
             "native": self.tree.native,
         }

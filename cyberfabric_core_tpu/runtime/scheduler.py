@@ -400,19 +400,28 @@ class ContinuousBatchingEngine:
                     chain = self.pool.admit_slot(req.prompt_ids, [], kv)
                 finally:
                     self.pool.release(req.prompt_ids)
-        if self.paged:
-            assert chain is not None
-            self.page_table[slot, :] = 0
-            self.page_table[slot, : len(chain)] = chain
-            self._pt_dirty = True
-            # continue this request's key stream (advanced by prefill) in decode
-            self._slot_keys = self._slot_keys.at[slot].set(req_key)
-        else:
-            # dense mode: scatter the collected kv into the slot's cache rows
-            self.cache = self._insert_fn(
-                self.cache[0], self.cache[1], kv[0], kv[1],
-                jnp.asarray(slot, jnp.int32))
-        tok = int(np.asarray(first)[0])
+        try:
+            if self.paged:
+                assert chain is not None
+                self.page_table[slot, :] = 0
+                self.page_table[slot, : len(chain)] = chain
+                self._pt_dirty = True
+                # continue this request's key stream (advanced by prefill)
+                self._slot_keys = self._slot_keys.at[slot].set(req_key)
+            else:
+                # dense mode: scatter the collected kv into the slot's cache rows
+                self.cache = self._insert_fn(
+                    self.cache[0], self.cache[1], kv[0], kv[1],
+                    jnp.asarray(slot, jnp.int32))
+            tok = int(np.asarray(first)[0])
+        except Exception:
+            # the chain's refs are held from admit_slot on — drop them or the
+            # pool shrinks permanently on every failed admission
+            if chain is not None:
+                self.pool.release_slot(chain)
+                self.page_table[slot, :] = 0
+                self._pt_dirty = True
+            raise
 
         state = _SlotState(
             request_id=req.request_id,

@@ -1,0 +1,84 @@
+"""Credstore encryption at rest: values never touch sqlite in plaintext."""
+
+import asyncio
+from types import SimpleNamespace
+
+import pytest
+
+from cyberfabric_core_tpu.modkit.contracts import Migration
+from cyberfabric_core_tpu.modkit.db import Database
+from cyberfabric_core_tpu.modules.credstore import _MIGRATIONS, SqliteCredPlugin
+
+
+class _FakeCtx(SimpleNamespace):
+    def db_required(self):
+        return self.db
+
+    def raw_config(self):
+        return self.cfg
+
+
+def _plugin(tmp_path, cfg=None):
+    db = Database(":memory:")
+    db.run_migrations(_MIGRATIONS)
+    app_config = SimpleNamespace(home_dir=lambda: tmp_path)
+    ctx = _FakeCtx(db=db, cfg=cfg or {}, app_config=app_config)
+    return SqliteCredPlugin(ctx), db
+
+
+def test_value_encrypted_at_rest_and_round_trips(tmp_path):
+    plugin, db = _plugin(tmp_path)
+    plugin.put("t1", "api_key", "s3cret-value", "private")
+
+    # raw row must be ciphertext, not the secret
+    raw = db._conn.execute(
+        "SELECT value FROM secrets").fetchone()[0]
+    assert raw.startswith("enc:v1:")
+    assert "s3cret-value" not in raw
+
+    assert plugin.get("t1", "api_key") == ("s3cret-value", "private")
+
+
+def test_keyfile_generated_once_0600(tmp_path):
+    p1, _ = _plugin(tmp_path)
+    key_path = tmp_path / "credstore.key"
+    assert key_path.exists()
+    assert (key_path.stat().st_mode & 0o777) == 0o600
+    # second plugin instance reuses the same key: values decrypt across restarts
+    p1.put("t1", "k", "v", "private")
+    p2 = SqliteCredPlugin(_FakeCtx(db=p1._db, cfg={},
+                                   app_config=SimpleNamespace(home_dir=lambda: tmp_path)))
+    assert p2.get("t1", "k") == ("v", "private")
+
+
+def test_tenant_bound_as_aad(tmp_path):
+    """A ciphertext row copied to another tenant fails authentication —
+    the tenant id is bound into the AES-GCM AAD."""
+    plugin, db = _plugin(tmp_path)
+    plugin.put("t1", "k", "cross-tenant", "private")
+    conn = db._conn
+    stored = conn.execute("SELECT value FROM secrets").fetchone()[0]
+    conn.execute(
+        "INSERT INTO secrets (id, tenant_id, key, value, sharing) "
+        "VALUES ('x', 't2', 'k', ?, 'private')", (stored,))
+    conn.commit()
+    with pytest.raises(Exception):
+        plugin.get("t2", "k")
+
+
+def test_legacy_plaintext_rows_still_read(tmp_path):
+    plugin, db = _plugin(tmp_path)
+    conn = db._conn
+    conn.execute(
+        "INSERT INTO secrets (id, tenant_id, key, value, sharing) "
+        "VALUES ('l', 't1', 'old', 'plain-old-value', 'private')")
+    conn.commit()
+    assert plugin.get("t1", "old") == ("plain-old-value", "private")
+
+
+def test_configured_key_used(tmp_path):
+    key = "ab" * 32
+    plugin, _ = _plugin(tmp_path, cfg={"encryption_key": key})
+    plugin.put("t1", "k", "v", "shared")
+    assert plugin.get("t1", "k") == ("v", "shared")
+    assert not (tmp_path / "credstore.key").exists()  # no keyfile when configured

@@ -21,7 +21,7 @@ BASE_CONFIG = {
         "authz_resolver": {},
         "types_registry": {},
         "module_orchestrator": {},
-        "nodes_registry": {},
+        "nodes_registry": {"config": {"tenant": "acme"}},
         "model_registry": {"config": {
             "seed_tenant": "acme",
             "models": [
@@ -391,7 +391,7 @@ def test_modules_inventory_and_health(server):
 
 def test_nodes_registry_self_registration(server):
     status, body = req(server, "GET", "/v1/nodes",
-                       headers={"x-tenant-id": "default"})
+                       headers={"x-tenant-id": "acme"})
     assert status == 200
     assert len(body["items"]) >= 1
     node = body["items"][0]
