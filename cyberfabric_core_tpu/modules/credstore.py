@@ -93,13 +93,20 @@ class SqliteCredPlugin(CredStorePluginApi):
             return read_key(key_path)
         key = os.urandom(32)
         key_path.parent.mkdir(parents=True, exist_ok=True)
-        try:
-            fd = os.open(str(key_path), os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
-        except FileExistsError:
-            # concurrent first start: another process won the create — use its key
-            return read_key(key_path)
+        # write-then-rename: a crash mid-write must never leave a truncated
+        # keyfile in place (that would brick every later startup)
+        tmp_path = key_path.with_suffix(f".tmp.{os.getpid()}")
+        fd = os.open(str(tmp_path), os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
         with os.fdopen(fd, "w") as f:
             f.write(key.hex())
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(str(tmp_path), str(key_path))  # fails if another won
+        except FileExistsError:
+            return read_key(key_path)
+        finally:
+            os.unlink(str(tmp_path))
         return key
 
     def _encrypt(self, tenant_id: str, plain: str) -> str:

@@ -525,8 +525,8 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         assert self.registry is not None and self.worker is not None
         model = await self.registry.resolve(ctx, body["model"])
         inputs = body["input"] if isinstance(body["input"], list) else [body["input"]]
-        vectors = await self.worker.embed(model, inputs, body)
-        usage = {"input_tokens": sum(len(t.split()) for t in inputs), "output_tokens": 0}
+        vectors, input_tokens = await self.worker.embed(model, inputs, body)
+        usage = {"input_tokens": input_tokens, "output_tokens": 0}
         self.usage.report(ctx, usage)
         data = [{"index": i, "embedding": v} for i, v in enumerate(vectors)]
         return {"data": data, "model": model.canonical_id, "usage": usage}

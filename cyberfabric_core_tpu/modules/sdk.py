@@ -82,7 +82,8 @@ class LlmWorkerApi(abc.ABC):
         ...
 
     @abc.abstractmethod
-    async def embed(self, model: ModelInfo, inputs: list[str], params: dict) -> list[list[float]]:
+    async def embed(self, model: ModelInfo, inputs: list[str],
+                    params: dict) -> tuple[list[list[float]], int]:
         ...
 
     @abc.abstractmethod

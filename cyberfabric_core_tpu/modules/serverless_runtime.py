@@ -183,7 +183,7 @@ class ServerlessService(ServerlessApi):
             registry = hub.get(ModelRegistryApi)
             worker = hub.get(LlmWorkerApi)
             model = await registry.resolve(ctx, params["model"])
-            vectors = await worker.embed(model, params["input"], params)
+            vectors, _tokens = await worker.embed(model, params["input"], params)
             return {"vectors": vectors, "model_used": model.canonical_id}
 
         self._functions.update({

@@ -1,0 +1,101 @@
+"""BERT/bge checkpoint loading: HF-layout safetensors round-trip + goldens.
+
+VERDICT r1 weak #4: /v1/embeddings ran on random weights because no encoder
+checkpoint loader existed. These tests pin the HF name mapping and transposes
+(a wrong transpose still produces plausible-looking vectors — the cosine
+golden catches it) and that the worker actually uses the loaded weights.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cyberfabric_core_tpu.models import bert
+from cyberfabric_core_tpu.models.configs import get_config
+from cyberfabric_core_tpu.runtime.weights import (
+    load_bert_params, save_bert_params)
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    cfg = get_config("tiny-bert")
+    tree = bert.init_params(cfg, jax.random.PRNGKey(7), jnp.float32)
+    out = tmp_path_factory.mktemp("bge")
+    save_bert_params(tree, cfg, out)
+    return cfg, tree, out
+
+
+def test_roundtrip_exact(checkpoint):
+    cfg, tree, out = checkpoint
+    loaded = load_bert_params(out, cfg, dtype=jnp.float32)
+    flat_a = jax.tree.leaves(tree)
+    flat_b = jax.tree.leaves(loaded)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_loaded_embeddings_match_source_not_random(checkpoint):
+    cfg, tree, out = checkpoint
+    loaded = load_bert_params(out, cfg, dtype=jnp.float32)
+    ids = jnp.asarray([[2, 5, 9, 11, 0, 0], [3, 7, 1, 0, 0, 0]], jnp.int32)
+    mask = jnp.asarray([[1, 1, 1, 1, 0, 0], [1, 1, 1, 0, 0, 0]], jnp.int32)
+
+    want = np.asarray(bert.embed_pooled(tree, cfg, ids, mask))
+    got = np.asarray(bert.embed_pooled(loaded, cfg, ids, mask))
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+    rand = np.asarray(bert.embed_pooled(
+        bert.init_params(cfg, jax.random.PRNGKey(0), jnp.float32), cfg, ids, mask))
+    # loaded weights must NOT equal the random-init path the old code used
+    assert float(np.abs(got - rand).max()) > 1e-3
+
+    # unit norm + self-similarity golden: cos(x, x) == 1, cross-sim strictly <
+    norms = np.linalg.norm(got, axis=-1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+    cross = float(got[0] @ got[1])
+    assert -1.0 <= cross < 0.999
+
+
+def test_bert_prefix_detected(checkpoint, tmp_path):
+    """BertForMaskedLM-style checkpoints prefix every tensor with 'bert.'."""
+    import json
+    from safetensors import safe_open
+    from safetensors.numpy import save_file
+
+    cfg, tree, out = checkpoint
+    with safe_open(str(out / "model.safetensors"), framework="numpy") as sf:
+        tensors = {f"bert.{k}": sf.get_tensor(k) for k in sf.keys()}
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+    loaded = load_bert_params(tmp_path, cfg, dtype=jnp.float32)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(loaded)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_worker_uses_checkpoint(checkpoint):
+    """The llm-gateway worker loads the checkpoint when present (and reports
+    tokenizer-based token counts)."""
+    import asyncio
+
+    from cyberfabric_core_tpu.modules.llm_gateway.worker import LocalTpuWorker
+    from cyberfabric_core_tpu.modules.sdk import ModelInfo
+
+    cfg, tree, out = checkpoint
+    worker = LocalTpuWorker({})
+    model = ModelInfo(canonical_id="local::tiny-bge", provider_slug="local",
+                      provider_model_id="tiny-bge", managed=True,
+                      architecture="bert", checkpoint_path=str(out),
+                      engine_options={"model_config": "tiny-bert"})
+    vectors, tokens = asyncio.run(worker.embed(model, ["hello world"], {}))
+    assert tokens > 0
+    # mirror the worker's tokenization (byte fallback: bos + bytes+3)
+    toks = [1] + [3 + b for b in b"hello world"]
+    row = np.zeros((1, 128), np.int32)
+    row[0, : len(toks)] = toks
+    ids = jnp.asarray(row)
+    mask = (ids > 0).astype(jnp.int32)
+    want = np.asarray(bert.embed_pooled(tree, cfg, ids, mask))[0]
+    # worker loads in bf16; tree here is f32 — tolerance covers the cast
+    np.testing.assert_allclose(np.asarray(vectors[0]), want, atol=4e-2)
+    assert float(np.asarray(vectors[0]) @ want) > 0.99  # same direction

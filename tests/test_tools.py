@@ -108,7 +108,7 @@ def scripted_stack(fresh_registry):
                                   usage={"input_tokens": 3, "output_tokens": 2})
 
         async def embed(self, model, inputs, params):
-            return [[0.0]]
+            return [[0.0]], 1
 
         async def health(self):
             return {"status": "ok"}
