@@ -160,6 +160,25 @@ def parse_stub(data: bytes) -> Document:
                                   f"[unsupported content: {len(data)} bytes]")])
 
 
+def _binary_parsers() -> dict[str, Callable[[bytes], "Document"]]:
+    from . import file_parser_backends as fb
+
+    return {
+        "application/pdf": fb.parse_pdf,
+        "application/vnd.openxmlformats-officedocument.wordprocessingml.document":
+            fb.parse_docx,
+        "application/vnd.openxmlformats-officedocument.spreadsheetml.sheet":
+            fb.parse_xlsx,
+        "application/vnd.openxmlformats-officedocument.presentationml.presentation":
+            fb.parse_pptx,
+        "image/png": fb.parse_image,
+        "image/jpeg": fb.parse_image,
+        "image/gif": fb.parse_image,
+        "image/bmp": fb.parse_image,
+        "image/webp": fb.parse_image,
+    }
+
+
 PARSERS: dict[str, Callable[[bytes], Document]] = {
     "text/plain": parse_plain_text,
     "text/markdown": parse_markdown,
@@ -169,7 +188,16 @@ PARSERS: dict[str, Callable[[bytes], Document]] = {
 }
 
 _EXT_MIME = {".txt": "text/plain", ".md": "text/markdown", ".html": "text/html",
-             ".htm": "text/html", ".csv": "text/csv", ".json": "application/json"}
+             ".htm": "text/html", ".csv": "text/csv", ".json": "application/json",
+             ".pdf": "application/pdf",
+             ".docx": "application/vnd.openxmlformats-officedocument"
+                      ".wordprocessingml.document",
+             ".xlsx": "application/vnd.openxmlformats-officedocument"
+                      ".spreadsheetml.sheet",
+             ".pptx": "application/vnd.openxmlformats-officedocument"
+                      ".presentationml.presentation",
+             ".png": "image/png", ".jpg": "image/jpeg", ".jpeg": "image/jpeg",
+             ".gif": "image/gif", ".bmp": "image/bmp", ".webp": "image/webp"}
 
 
 class FileParserService:
@@ -182,7 +210,8 @@ class FileParserService:
         if len(data) > self.max_size:
             raise ProblemError.bad_request(
                 f"file exceeds max_file_size_bytes={self.max_size}")
-        parser = PARSERS.get(mime.split(";")[0].strip().lower(), parse_stub)
+        key = mime.split(";")[0].strip().lower()
+        parser = PARSERS.get(key) or _binary_parsers().get(key) or parse_stub
         return parser(data), mime
 
     def parse_local(self, path_str: str) -> tuple[Document, str]:
@@ -236,7 +265,7 @@ class FileParserModule(Module, RestApiCapability):
                     "mime_type": mime, "blocks": len(doc.blocks)}
 
         async def info(request: web.Request):
-            return {"supported_mime_types": sorted(PARSERS),
+            return {"supported_mime_types": sorted(set(PARSERS) | set(_binary_parsers())),
                     "max_file_size_bytes": svc.max_size,
                     "local_parsing": svc.base_dir is not None}
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
@@ -137,6 +138,7 @@ class LocalTpuWorker(LlmWorkerApi):
         self._config = worker_config or {}
         self._entries: dict[str, _EngineEntry] = {}
         self._embed_entries: dict[str, _EmbedEntry] = {}
+        self._embed_build_lock = threading.Lock()
         self._entry_locks: dict[str, asyncio.Lock] = {}
         self._executor = ThreadPoolExecutor(
             max_workers=int(self._config.get("max_engine_threads", 4)),
@@ -427,34 +429,10 @@ class LocalTpuWorker(LlmWorkerApi):
         from ...models import bert, get_config
 
         key = f"embed::{model.canonical_id}"
-        entry = self._embed_entries.get(key)
-        if entry is None:
-            cfg = get_config(dict(model.engine_options or {}).get("model_config")
-                             or model.provider_model_id)
-            if model.checkpoint_path and Path(model.checkpoint_path).exists():
-                # real weights (bge-base-en et al.) — VERDICT r1 weak #4: this
-                # path previously ran on random init unconditionally
-                from ...runtime.weights import load_bert_params
-
-                params_tree = load_bert_params(model.checkpoint_path, cfg)
-                tokenizer = load_tokenizer(model.checkpoint_path, cfg.vocab_size)
-                if isinstance(tokenizer, ByteTokenizer):
-                    # byte ids into a WordPiece-vocab model = garbage vectors —
-                    # as bad as the random-weights bug this path fixes
-                    logger.warning(
-                        "checkpoint %s has no tokenizer.json: falling back to "
-                        "byte tokenization, embeddings will NOT match the "
-                        "original model", model.checkpoint_path)
-            else:
-                logger.warning(
-                    "embedding model %s has no checkpoint_path: serving "
-                    "RANDOM-WEIGHT embeddings (dev/synthetic mode only)",
-                    model.canonical_id)
-                params_tree = bert.init_params(cfg, jax.random.PRNGKey(0))
-                tokenizer = ByteTokenizer(cfg.vocab_size)
-            fwd = jax.jit(lambda p, ids, mask: bert.embed_pooled(p, cfg, ids, mask))
-            entry = _EmbedEntry(tokenizer=tokenizer, embed_fn=(fwd, params_tree, cfg))
-            self._embed_entries[key] = entry
+        with self._embed_build_lock:  # single-flight: a cold checkpoint load +
+            entry = self._embed_entries.get(key)  # jit must not run 4x concurrently
+            if entry is None:
+                entry = self._build_embed_entry(key, model)
         fwd, params_tree, cfg = entry.embed_fn
 
         max_len = min(cfg.max_position, 128)
@@ -473,6 +451,45 @@ class LocalTpuWorker(LlmWorkerApi):
             emb = np.asarray(fwd(params_tree, jnp.asarray(ids), jnp.asarray(mask)))
             out.extend(emb[: len(chunk)].astype(float).tolist())
         return out, total_tokens
+
+    def _build_embed_entry(self, key: str, model: ModelInfo) -> "_EmbedEntry":
+        import jax
+
+        from ...models import bert, get_config
+
+        cfg = get_config(dict(model.engine_options or {}).get("model_config")
+                         or model.provider_model_id)
+        if model.checkpoint_path:
+            if not Path(model.checkpoint_path).exists():
+                # fail loudly: silently serving random vectors for a model
+                # that DECLARES weights would poison callers' vector stores
+                raise FileNotFoundError(
+                    f"checkpoint_path {model.checkpoint_path!r} for "
+                    f"{model.canonical_id} does not exist")
+            # real weights (bge-base-en et al.) — VERDICT r1 weak #4: this
+            # path previously ran on random init unconditionally
+            from ...runtime.weights import load_bert_params
+
+            params_tree = load_bert_params(model.checkpoint_path, cfg)
+            tokenizer = load_tokenizer(model.checkpoint_path, cfg.vocab_size)
+            if isinstance(tokenizer, ByteTokenizer):
+                # byte ids into a WordPiece-vocab model = garbage vectors —
+                # as bad as the random-weights bug this path fixes
+                logger.warning(
+                    "checkpoint %s has no tokenizer.json: falling back to "
+                    "byte tokenization, embeddings will NOT match the "
+                    "original model", model.checkpoint_path)
+        else:
+            logger.warning(
+                "embedding model %s has no checkpoint_path: serving "
+                "RANDOM-WEIGHT embeddings (dev/synthetic mode only)",
+                model.canonical_id)
+            params_tree = bert.init_params(cfg, jax.random.PRNGKey(0))
+            tokenizer = ByteTokenizer(cfg.vocab_size)
+        fwd = jax.jit(lambda p, ids, mask: bert.embed_pooled(p, cfg, ids, mask))
+        entry = _EmbedEntry(tokenizer=tokenizer, embed_fn=(fwd, params_tree, cfg))
+        self._embed_entries[key] = entry
+        return entry
 
     # ------------------------------------------------------------------ health
     async def health(self) -> dict[str, Any]:

@@ -1,0 +1,259 @@
+"""Golden tests for the binary parser backends (docx/xlsx/pptx/pdf/image).
+
+Mirrors the reference's modules/file-parser/tests/{docx,xlsx,pptx,image}_
+parser_tests.rs golden style: build a real file of each format, parse, and
+pin the rendered markdown.
+"""
+
+import io
+import struct
+import zipfile
+import zlib
+
+import pytest
+
+from cyberfabric_core_tpu.modkit.errors import ProblemError
+from cyberfabric_core_tpu.modules.file_parser import FileParserService
+from cyberfabric_core_tpu.modules.file_parser_backends import (
+    parse_docx, parse_image, parse_pdf, parse_pptx, parse_xlsx)
+
+W_NS = 'xmlns:w="http://schemas.openxmlformats.org/wordprocessingml/2006/main"'
+
+
+def _docx(document_xml: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("[Content_Types].xml", "<Types/>")
+        zf.writestr("word/document.xml", document_xml)
+    return buf.getvalue()
+
+
+def test_docx_headings_paragraphs_lists_tables():
+    xml = f"""<w:document {W_NS}><w:body>
+      <w:p><w:pPr><w:pStyle w:val="Heading1"/></w:pPr>
+         <w:r><w:t>Quarterly Report</w:t></w:r></w:p>
+      <w:p><w:r><w:t>Revenue grew </w:t></w:r><w:r><w:t>12%.</w:t></w:r></w:p>
+      <w:p><w:pPr><w:numPr><w:ilvl w:val="0"/></w:numPr></w:pPr>
+         <w:r><w:t>first item</w:t></w:r></w:p>
+      <w:p><w:pPr><w:numPr><w:ilvl w:val="0"/></w:numPr></w:pPr>
+         <w:r><w:t>second item</w:t></w:r></w:p>
+      <w:tbl>
+        <w:tr><w:tc><w:p><w:r><w:t>metric</w:t></w:r></w:p></w:tc>
+              <w:tc><w:p><w:r><w:t>value</w:t></w:r></w:p></w:tc></w:tr>
+        <w:tr><w:tc><w:p><w:r><w:t>revenue</w:t></w:r></w:p></w:tc>
+              <w:tc><w:p><w:r><w:t>12</w:t></w:r></w:p></w:tc></w:tr>
+      </w:tbl>
+      <w:p><w:pPr><w:pStyle w:val="Heading2"/></w:pPr>
+         <w:r><w:t>Outlook</w:t></w:r></w:p>
+    </w:body></w:document>"""
+    doc = parse_docx(_docx(xml))
+    assert doc.title == "Quarterly Report"
+    golden = (
+        "# Quarterly Report\n\n"
+        "Revenue grew 12%.\n\n"
+        "- first item\n- second item\n\n"
+        "metric | value\n\n--- | ---\n\nrevenue | 12\n\n"
+        "## Outlook"
+    )
+    assert doc.to_markdown() == golden
+
+
+def test_docx_rejects_garbage():
+    with pytest.raises(ProblemError):
+        parse_docx(b"not a zip at all")
+    with pytest.raises(ProblemError):
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            zf.writestr("other.xml", "<x/>")
+        parse_docx(buf.getvalue())
+
+
+S_NS = 'xmlns="http://schemas.openxmlformats.org/spreadsheetml/2006/main"'
+R_NS = ('xmlns:r="http://schemas.openxmlformats.org/officeDocument/2006/'
+        'relationships"')
+
+
+def _xlsx() -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("xl/workbook.xml",
+                    f'<workbook {S_NS} {R_NS}><sheets>'
+                    '<sheet name="Costs" sheetId="1" r:id="rId1"/>'
+                    "</sheets></workbook>")
+        zf.writestr("xl/_rels/workbook.xml.rels",
+                    '<Relationships xmlns="http://schemas.openxmlformats.org/'
+                    'package/2006/relationships">'
+                    '<Relationship Id="rId1" Type="t" '
+                    'Target="worksheets/sheet1.xml"/></Relationships>')
+        zf.writestr("xl/sharedStrings.xml",
+                    f'<sst {S_NS}><si><t>item</t></si>'
+                    "<si><t>price</t></si><si><t>gpu</t></si></sst>")
+        zf.writestr("xl/worksheets/sheet1.xml",
+                    f'<worksheet {S_NS}><sheetData>'
+                    '<row r="1"><c r="A1" t="s"><v>0</v></c>'
+                    '<c r="B1" t="s"><v>1</v></c></row>'
+                    '<row r="2"><c r="A2" t="s"><v>2</v></c>'
+                    '<c r="C2"><v>9999.5</v></c></row>'
+                    '<row r="3"><c r="A3" t="inlineStr"><is><t>tpu</t></is></c>'
+                    '<c r="B3" t="b"><v>1</v></c></row>'
+                    "</sheetData></worksheet>")
+    return buf.getvalue()
+
+
+def test_xlsx_sheets_shared_strings_sparse_cells():
+    doc = parse_xlsx(_xlsx())
+    golden = (
+        "## Costs\n\n"
+        "item | price | \n\n--- | --- | ---\n\n"
+        "gpu |  | 9999.5\n\ntpu | TRUE | "
+    )
+    assert doc.to_markdown() == golden
+
+
+P_NS = ('xmlns:p="http://schemas.openxmlformats.org/presentationml/2006/main" '
+        'xmlns:a="http://schemas.openxmlformats.org/drawingml/2006/main" '
+        'xmlns:r="http://schemas.openxmlformats.org/officeDocument/2006/'
+        'relationships"')
+
+
+def _pptx() -> bytes:
+    buf = io.BytesIO()
+    slide = (f'<p:sld {P_NS}><p:cSld><p:spTree>'
+             "<p:sp><p:nvSpPr><p:nvPr>"
+             '<p:ph type="title"/></p:nvPr></p:nvSpPr>'
+             "<p:txBody><a:p><a:r><a:t>Roadmap</a:t></a:r></a:p></p:txBody>"
+             "</p:sp>"
+             "<p:sp><p:nvSpPr><p:nvPr><p:ph type=\"body\"/></p:nvPr></p:nvSpPr>"
+             "<p:txBody><a:p><a:r><a:t>ship it</a:t></a:r></a:p>"
+             "<a:p><a:r><a:t>scale it</a:t></a:r></a:p></p:txBody></p:sp>"
+             "</p:spTree></p:cSld></p:sld>")
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("ppt/presentation.xml",
+                    f'<p:presentation {P_NS}><p:sldIdLst>'
+                    '<p:sldId id="256" r:id="rId1"/></p:sldIdLst>'
+                    "</p:presentation>")
+        zf.writestr("ppt/_rels/presentation.xml.rels",
+                    '<Relationships xmlns="http://schemas.openxmlformats.org/'
+                    'package/2006/relationships">'
+                    '<Relationship Id="rId1" Type="t" '
+                    'Target="slides/slide1.xml"/></Relationships>')
+        zf.writestr("ppt/slides/slide1.xml", slide)
+    return buf.getvalue()
+
+
+def test_pptx_title_and_bullets():
+    doc = parse_pptx(_pptx())
+    assert doc.title == "Roadmap"
+    assert doc.to_markdown() == "## Roadmap\n\n- ship it\n- scale it"
+
+
+def _pdf(compressed: bool) -> bytes:
+    content = (b"BT /F1 12 Tf 72 720 Td (Hello, PDF world!) Tj T* "
+               b"[(Frag) -250 (mented line)] TJ ET")
+    if compressed:
+        payload = zlib.compress(content)
+        extra = b" /Filter /FlateDecode"
+    else:
+        payload, extra = content, b""
+    stream_obj = (b"4 0 obj\n<< /Length " + str(len(payload)).encode()
+                  + extra + b" >>\nstream\n" + payload + b"endstream\nendobj\n")
+    return (b"%PDF-1.4\n"
+            b"1 0 obj << /Type /Catalog /Pages 2 0 R >> endobj\n"
+            b"2 0 obj << /Type /Pages /Kids [3 0 R] /Count 1 >> endobj\n"
+            b"3 0 obj << /Type /Page /Parent 2 0 R /Contents 4 0 R >> endobj\n"
+            + stream_obj + b"trailer << /Root 1 0 R >>\n%%EOF")
+
+
+@pytest.mark.parametrize("compressed", [False, True])
+def test_pdf_text_extraction(compressed):
+    doc = parse_pdf(_pdf(compressed))
+    assert doc.to_markdown() == "Hello, PDF world!\n\nFragmented line"
+
+
+def test_pdf_rejects_non_pdf():
+    with pytest.raises(ProblemError):
+        parse_pdf(b"plain text pretending")
+
+
+def _png(w=17, h=9) -> bytes:
+    ihdr = struct.pack(">II5B", w, h, 8, 6, 0, 0, 0)
+    chunk = (struct.pack(">I", len(ihdr)) + b"IHDR" + ihdr
+             + struct.pack(">I", zlib.crc32(b"IHDR" + ihdr)))
+    return b"\x89PNG\r\n\x1a\n" + chunk + b"\x00" * 12
+
+
+def test_image_png_metadata():
+    doc = parse_image(_png())
+    md = doc.to_markdown()
+    assert "## PNG image" in md
+    assert "width | 17" in md and "height | 9" in md
+    assert "channels | 4" in md
+
+
+def test_image_jpeg_gif_bmp():
+    jpeg = (b"\xff\xd8" + b"\xff\xe0" + struct.pack(">H", 16) + b"JFIF\x00" + b"\x00" * 10
+            + b"\xff\xc0" + struct.pack(">H", 11) + bytes([8])
+            + struct.pack(">HH", 33, 44) + bytes([3]) + b"\x00" * 4)
+    md = parse_image(jpeg).to_markdown()
+    assert "JPEG" in md and "width | 44" in md and "height | 33" in md
+
+    gif = b"GIF89a" + struct.pack("<HH", 5, 7) + b"\x00" * 6
+    md = parse_image(gif).to_markdown()
+    assert "GIF" in md and "width | 5" in md
+
+    bmp = b"BM" + b"\x00" * 16 + struct.pack("<ii", 21, -13) + b"\x00" * 8
+    md = parse_image(bmp).to_markdown()
+    assert "BMP" in md and "width | 21" in md and "height | 13" in md
+
+    with pytest.raises(ProblemError):
+        parse_image(b"\x00\x01\x02 not an image")
+
+
+def test_service_routes_by_mime_and_extension(tmp_path):
+    svc = FileParserService(tmp_path, max_file_size_bytes=1 << 20)
+    (tmp_path / "deck.pptx").write_bytes(_pptx())
+    doc, mime = svc.parse_local("deck.pptx")
+    assert "Roadmap" in doc.to_markdown()
+    assert mime.endswith("presentationml.presentation")
+
+    doc, _ = svc.parse_bytes(_pdf(True), "application/pdf")
+    assert "Hello, PDF world!" in doc.to_markdown()
+
+
+def test_xlsx_absolute_rel_target_and_corrupt_sheet():
+    """OPC absolute targets ('/xl/...') resolve; malformed sheet XML → 422."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("xl/workbook.xml",
+                    f'<workbook {S_NS} {R_NS}><sheets>'
+                    '<sheet name="Abs" sheetId="1" r:id="rId1"/>'
+                    "</sheets></workbook>")
+        zf.writestr("xl/_rels/workbook.xml.rels",
+                    '<Relationships xmlns="http://schemas.openxmlformats.org/'
+                    'package/2006/relationships">'
+                    '<Relationship Id="rId1" Type="t" '
+                    'Target="/xl/worksheets/sheet1.xml"/></Relationships>')
+        zf.writestr("xl/worksheets/sheet1.xml",
+                    f'<worksheet {S_NS}><sheetData>'
+                    '<row r="1"><c r="A1" t="inlineStr"><is><t>abs-ok</t></is></c>'
+                    "</row></sheetData></worksheet>")
+    md = parse_xlsx(buf.getvalue()).to_markdown()
+    assert "abs-ok" in md
+
+    bad = io.BytesIO()
+    with zipfile.ZipFile(bad, "w") as zf:
+        zf.writestr("xl/workbook.xml",
+                    f'<workbook {S_NS}><sheets>'
+                    '<sheet name="X" sheetId="1"/></sheets></workbook>')
+        zf.writestr("xl/worksheets/sheet1.xml", "<worksheet truncated")
+    with pytest.raises(ProblemError):
+        parse_xlsx(bad.getvalue())
+
+
+def test_pdf_non_octal_escape():
+    """\\8 is not an octal escape — backslash is dropped, no crash."""
+    content = rb"BT (back\8slash \101ctal) Tj ET"
+    pdf = (b"%PDF-1.4\n1 0 obj\n<< >>\nstream\n" + content
+           + b"endstream\nendobj\ntrailer\n%%EOF")
+    doc = parse_pdf(pdf)
+    assert doc.to_markdown() == "back8slash Actal"
