@@ -66,8 +66,9 @@ class ProblemError(Exception):
         return cls(Problem(status=401, title="Unauthorized", code="unauthorized", detail=detail))
 
     @classmethod
-    def forbidden(cls, detail: str = "access denied") -> "ProblemError":
-        return cls(Problem(status=403, title="Forbidden", code="forbidden", detail=detail))
+    def forbidden(cls, detail: str = "access denied",
+                  code: str = "forbidden") -> "ProblemError":
+        return cls(Problem(status=403, title="Forbidden", code=code, detail=detail))
 
     @classmethod
     def not_found(cls, detail: str, code: str = "not_found") -> "ProblemError":

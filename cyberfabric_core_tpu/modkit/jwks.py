@@ -1,0 +1,141 @@
+"""Remote JWKS fetch/cache/rotation (modkit-auth parity).
+
+Reference: libs/modkit-auth/src/providers/jwks.rs (807 LoC) — the reference
+fetches the IdP's JWKS document, caches keys by kid, and refreshes on
+rotation. Same semantics here:
+
+- fetch on first use, cache for ``cache_ttl_s``;
+- an unknown kid triggers an immediate refetch (key rotation publishes new
+  kids before tokens carrying them arrive), rate-limited by
+  ``negative_cache_s`` so a flood of bogus kids cannot hammer the IdP;
+- stale keys keep serving if a refresh attempt fails (availability over
+  freshness — matches the reference's stale-while-revalidate behavior);
+- JWK kty RSA (n/e) → cryptography public key; kty oct (k) → HS256 secret.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .jwt import JwtError, JwtKey
+
+logger = logging.getLogger("jwks")
+
+
+def _b64url_uint(val: str) -> int:
+    padded = val + "=" * (-len(val) % 4)
+    return int.from_bytes(base64.urlsafe_b64decode(padded), "big")
+
+
+def jwk_to_key(jwk: dict) -> Optional[JwtKey]:
+    """One JWK dict → JwtKey (None for unsupported key types/algs)."""
+    kty = jwk.get("kty")
+    kid = jwk.get("kid", "")
+    if kty == "RSA":
+        try:
+            from cryptography.hazmat.primitives.asymmetric.rsa import (
+                RSAPublicNumbers)
+            from cryptography.hazmat.primitives import serialization
+
+            pub = RSAPublicNumbers(
+                e=_b64url_uint(jwk["e"]), n=_b64url_uint(jwk["n"])
+            ).public_key()
+            pem = pub.public_bytes(
+                serialization.Encoding.PEM,
+                serialization.PublicFormat.SubjectPublicKeyInfo).decode()
+            return JwtKey(kid=kid, alg=jwk.get("alg", "RS256"),
+                          public_key_pem=pem)
+        except (KeyError, ValueError) as e:
+            logger.warning("skipping malformed RSA JWK kid=%r: %s", kid, e)
+            return None
+    if kty == "oct":
+        k = jwk.get("k")
+        if not k:
+            return None
+        padded = k + "=" * (-len(k) % 4)
+        secret = base64.urlsafe_b64decode(padded).decode("utf-8", "surrogateescape")
+        return JwtKey(kid=kid, alg=jwk.get("alg", "HS256"), secret=secret)
+    logger.debug("unsupported JWK kty=%r kid=%r", kty, kid)
+    return None
+
+
+@dataclass
+class JwksCache:
+    """Async JWKS client with rotation-aware refresh."""
+
+    jwks_url: str
+    cache_ttl_s: float = 300.0
+    negative_cache_s: float = 30.0
+    fetch_timeout_s: float = 10.0
+
+    _keys: dict[str, JwtKey] = field(default_factory=dict)
+    _fetched_at: float = 0.0
+    _last_miss_refresh: float = 0.0
+    _lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+
+    async def _fetch(self) -> None:
+        import aiohttp
+
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.fetch_timeout_s)
+        ) as session:
+            async with session.get(self.jwks_url) as resp:
+                if resp.status != 200:
+                    raise JwtError(
+                        f"JWKS fetch failed: {resp.status} from {self.jwks_url}")
+                doc = await resp.json(content_type=None)
+        keys = {}
+        for jwk in doc.get("keys", []):
+            key = jwk_to_key(jwk)
+            if key is not None:
+                keys[key.kid] = key
+        if not keys:
+            raise JwtError(f"JWKS at {self.jwks_url} contained no usable keys")
+        self._keys = keys
+        self._fetched_at = time.monotonic()
+        logger.info("JWKS refreshed from %s: kids=%s", self.jwks_url,
+                    sorted(keys))
+
+    async def _refresh(self, *, stale_after: float) -> None:
+        """Refetch unless someone else already did after ``stale_after``
+        (single-flight under the lock). Serves stale keys when the IdP is
+        unreachable and we have any."""
+        async with self._lock:
+            if self._keys and self._fetched_at > stale_after:
+                return
+            try:
+                await self._fetch()
+            except Exception as e:  # noqa: BLE001 — stale-while-revalidate
+                if not self._keys:
+                    raise
+                logger.warning("JWKS refresh failed; serving %d stale keys: %s",
+                               len(self._keys), e)
+
+    async def get_key(self, kid: Optional[str]) -> JwtKey:
+        now = time.monotonic()
+        if not self._keys or now - self._fetched_at > self.cache_ttl_s:
+            await self._refresh(stale_after=now - self.cache_ttl_s)
+
+        if kid is None:
+            if len(self._keys) == 1:
+                return next(iter(self._keys.values()))
+            raise JwtError("token has no kid and JWKS has multiple keys")
+        key = self._keys.get(kid)
+        if key is not None:
+            return key
+        # rotation path: unknown kid → refetch once per negative-cache window
+        if now - self._last_miss_refresh >= self.negative_cache_s:
+            self._last_miss_refresh = now
+            await self._refresh(stale_after=now)
+            key = self._keys.get(kid)
+            if key is not None:
+                return key
+        raise JwtError(f"no JWKS key for kid {kid!r}")
+
+    def current_keys(self) -> dict[str, JwtKey]:
+        return dict(self._keys)

@@ -65,6 +65,18 @@ class JwtKey:
         return self._public_key
 
 
+def peek_header(token: str) -> dict[str, Any]:
+    """Decode the (UNVERIFIED) JWT header — used to select a JWKS key by kid
+    before signature verification. Never trust its contents beyond key lookup."""
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise JwtError("token must have 3 segments")
+    try:
+        return json.loads(_b64url_decode(parts[0]))
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise JwtError(f"malformed token header: {e}") from e
+
+
 @dataclass
 class JwtValidator:
     keys: dict[str, JwtKey] = field(default_factory=dict)

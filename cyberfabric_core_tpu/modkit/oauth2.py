@@ -1,0 +1,106 @@
+"""Outbound OAuth2 client-credentials token source (modkit-auth parity).
+
+Reference: libs/modkit-auth/src/oauth2/{source,token,layer,discovery}.rs — the
+reference maintains a client-credentials token per upstream, refreshing before
+expiry, and injects it via a tower layer. Here the source is an async cache
+used by the OAGW proxy's credential injection (auth.type == "oauth2").
+
+Semantics:
+- POST token_url (application/x-www-form-urlencoded) with
+  grant_type=client_credentials + client_id/client_secret (+ scope);
+- cache access_token until ``expires_in`` minus a refresh margin;
+- single-flight refresh (concurrent requests share one token fetch);
+- a refresh failure while a still-valid token exists serves the old token.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+logger = logging.getLogger("oauth2")
+
+
+class OAuth2Error(RuntimeError):
+    pass
+
+
+@dataclass
+class ClientCredentialsTokenSource:
+    token_url: str
+    client_id: str
+    client_secret: str
+    scope: Optional[str] = None
+    refresh_margin_s: float = 30.0
+    fetch_timeout_s: float = 15.0
+
+    _token: Optional[str] = None
+    _expires_at: float = 0.0
+    _lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+
+    async def _fetch(self) -> None:
+        import aiohttp
+
+        form = {"grant_type": "client_credentials",
+                "client_id": self.client_id,
+                "client_secret": self.client_secret}
+        if self.scope:
+            form["scope"] = self.scope
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.fetch_timeout_s)
+        ) as session:
+            async with session.post(self.token_url, data=form) as resp:
+                try:
+                    body = await resp.json(content_type=None)
+                except Exception as e:  # noqa: BLE001 — HTML error pages etc.
+                    raise OAuth2Error(
+                        f"token endpoint returned {resp.status} with a "
+                        f"non-JSON body") from e
+                if not isinstance(body, dict):
+                    raise OAuth2Error(
+                        f"token endpoint returned {resp.status} with a "
+                        f"non-object JSON body")
+                if resp.status != 200:
+                    # surface the OAuth error code only — never the raw body
+                    # (it may be an internal service's response)
+                    raise OAuth2Error(
+                        f"token endpoint returned {resp.status}"
+                        + (f": {body['error']}" if isinstance(
+                            body.get("error"), str) else ""))
+        token = body.get("access_token")
+        if not token:
+            raise OAuth2Error("token response missing access_token")
+        self._token = token
+        expires_in = float(body.get("expires_in", 3600))
+        self._expires_at = time.monotonic() + expires_in
+        logger.debug("OAuth2 token refreshed for %s (expires in %.0fs)",
+                     self.client_id, expires_in)
+
+    def _fresh(self) -> bool:
+        return (self._token is not None
+                and time.monotonic() < self._expires_at - self.refresh_margin_s)
+
+    async def get_token(self) -> str:
+        if self._fresh():
+            return self._token  # type: ignore[return-value]
+        async with self._lock:
+            if self._fresh():
+                return self._token  # type: ignore[return-value]
+            try:
+                await self._fetch()
+            except Exception:
+                # a still-valid (inside margin) token beats failing the request
+                if self._token is not None and time.monotonic() < self._expires_at:
+                    logger.warning("OAuth2 refresh failed; serving token "
+                                   "within expiry margin", exc_info=True)
+                    return self._token
+                raise
+        return self._token  # type: ignore[return-value]
+
+    def invalidate(self) -> None:
+        """Drop the cached token (e.g. after an upstream 401)."""
+        self._token = None
+        self._expires_at = 0.0

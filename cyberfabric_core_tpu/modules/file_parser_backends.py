@@ -308,10 +308,17 @@ def parse_pdf(data: bytes) -> Document:
             lines.append(text)
         cur.clear()
 
+    max_inflate = 64 * 1024 * 1024  # decompression-bomb cap per stream
     for m in re.finditer(rb"stream\r?\n(.*?)endstream", data, re.DOTALL):
         payload = m.group(1)
         try:
-            payload = zlib.decompress(payload)
+            d = zlib.decompressobj()
+            inflated = d.decompress(payload, max_inflate)
+            if d.unconsumed_tail:
+                raise ProblemError.unprocessable(
+                    "pdf stream inflates beyond the size cap",
+                    code="parse_failed")
+            payload = inflated
         except zlib.error:
             pass  # uncompressed stream
         if b"BT" not in payload:

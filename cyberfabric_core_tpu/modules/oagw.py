@@ -44,12 +44,27 @@ UPSTREAMS = ScopableEntity(
     json_cols=("auth", "rate_limit", "circuit_breaker"),
 )
 
+ROUTES = ScopableEntity(
+    table="oagw_routes",
+    field_map={"id": "id", "tenant_id": "tenant_id", "slug": "slug",
+               "upstream_slug": "upstream_slug", "path_prefix": "path_prefix",
+               "methods": "methods", "strip_headers": "strip_headers",
+               "rate_limit": "rate_limit", "enabled": "enabled"},
+    json_cols=("methods", "strip_headers", "rate_limit"),
+)
+
 _MIGRATIONS = [
     Migration("0001_oagw", lambda c: c.execute(
         "CREATE TABLE upstreams (id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, "
         "slug TEXT NOT NULL, base_url TEXT NOT NULL, auth TEXT, rate_limit TEXT, "
         "circuit_breaker TEXT, enabled INTEGER DEFAULT 1, "
         "UNIQUE (tenant_id, slug))"
+    )),
+    Migration("0002_oagw_routes", lambda c: c.execute(
+        "CREATE TABLE oagw_routes (id TEXT PRIMARY KEY, tenant_id TEXT NOT NULL, "
+        "slug TEXT NOT NULL, upstream_slug TEXT NOT NULL, path_prefix TEXT, "
+        "methods TEXT, strip_headers TEXT, rate_limit TEXT, "
+        "enabled INTEGER DEFAULT 1, UNIQUE (tenant_id, slug))"
     )),
 ]
 
@@ -161,6 +176,60 @@ def parse_sse_stream(chunks: AsyncIterator[bytes]) -> AsyncIterator[dict]:
     return gen()
 
 
+async def _assert_public_destination(host: str) -> None:
+    """SSRF baseline (reference DESIGN F-P1-008): resolve the upstream host and
+    reject private / loopback / link-local / reserved destinations so a tenant
+    cannot relay the gateway against metadata endpoints or localhost admin
+    ports. Every resolved address must be public."""
+    import ipaddress
+    import socket
+
+    try:
+        addr = ipaddress.ip_address(host)
+        addrs = [addr]
+    except ValueError:
+        loop = asyncio.get_running_loop()
+        try:
+            infos = await loop.getaddrinfo(host, None, type=socket.SOCK_STREAM)
+        except socket.gaierror as e:
+            raise ProblemError.bad_request(
+                f"upstream host {host!r} does not resolve: {e}",
+                code="upstream_unresolvable")
+        addrs = [ipaddress.ip_address(info[4][0]) for info in infos]
+    for a in addrs:
+        if (a.is_private or a.is_loopback or a.is_link_local or a.is_reserved
+                or a.is_multicast or a.is_unspecified):
+            raise ProblemError.forbidden(
+                f"upstream host {host!r} resolves to non-public address {a}",
+                code="upstream_forbidden")
+
+
+class _PublicOnlyResolver(aiohttp.abc.AbstractResolver):
+    """DNS resolver that drops non-public addresses at connect time — the
+    rebinding-proof counterpart of _assert_public_destination (the hostname is
+    resolved exactly once, and only vetted addresses reach the connector)."""
+
+    def __init__(self) -> None:
+        self._inner = aiohttp.DefaultResolver()
+
+    async def resolve(self, host, port=0, family=0):
+        import ipaddress
+
+        infos = await self._inner.resolve(host, port, family)
+        public = []
+        for info in infos:
+            a = ipaddress.ip_address(info["host"])
+            if not (a.is_private or a.is_loopback or a.is_link_local
+                    or a.is_reserved or a.is_multicast or a.is_unspecified):
+                public.append(info)
+        if not public:
+            raise OSError(f"host {host!r} resolves only to non-public addresses")
+        return public
+
+    async def close(self) -> None:
+        await self._inner.close()
+
+
 class OagwService:
     def __init__(self, ctx: ModuleCtx) -> None:
         self._db = ctx.db_required()
@@ -168,10 +237,25 @@ class OagwService:
         self._breakers: dict[str, CircuitBreaker] = {}
         self._buckets: dict[str, _TokenBucket] = {}
         self._session: Optional[aiohttp.ClientSession] = None
+        cfg = ctx.raw_config()
+        #: dev/test escape hatches — production default is https-only to
+        #: public addresses (ADVICE r1 medium; reference DESIGN F-P0-008)
+        self.allow_insecure_http = bool(cfg.get("allow_insecure_http", False))
+        self.allow_private_upstreams = bool(cfg.get("allow_private_upstreams", False))
+        self._token_sources: dict[str, Any] = {}  # (tenant:slug) → OAuth2 source
 
     async def session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
+            connector = None
+            if not self.allow_private_upstreams:
+                # pin the SSRF vetting into name resolution itself: the check
+                # in proxy() is advisory (clear error early), but a TTL-0
+                # rebinding domain could swap to a private address between
+                # check and connect — this resolver filters at connect time
+                connector = aiohttp.TCPConnector(
+                    resolver=_PublicOnlyResolver())
             self._session = aiohttp.ClientSession(
+                connector=connector,
                 timeout=aiohttp.ClientTimeout(total=120, connect=10))
         return self._session
 
@@ -183,22 +267,80 @@ class OagwService:
     def create_upstream(self, ctx: SecurityContext, spec: dict) -> dict:
         if not spec.get("slug") or not spec.get("base_url"):
             raise ProblemError.bad_request("slug and base_url required")
-        if not spec["base_url"].startswith(("http://", "https://")):
+        base_url = spec["base_url"]
+        if base_url.startswith("http://"):
+            if not self.allow_insecure_http:
+                raise ProblemError.bad_request(
+                    "base_url must be https (set oagw.allow_insecure_http for "
+                    "dev environments)", code="insecure_upstream")
+        elif not base_url.startswith("https://"):
             raise ProblemError.bad_request("base_url must be http(s)")
         auth = spec.get("auth") or {}
-        if auth and auth.get("type") not in ("bearer", "header"):
-            raise ProblemError.bad_request("auth.type must be bearer|header")
+        if auth and auth.get("type") not in ("bearer", "header", "oauth2"):
+            raise ProblemError.bad_request("auth.type must be bearer|header|oauth2")
         if auth and not auth.get("secret_ref"):
             raise ProblemError.bad_request(
                 "auth.secret_ref (credstore key) required — inline secrets are not accepted")
+        if auth.get("type") == "oauth2":
+            if not (auth.get("token_url") and auth.get("client_id")):
+                raise ProblemError.bad_request(
+                    "oauth2 auth requires token_url and client_id "
+                    "(client_secret comes from credstore via secret_ref)")
+            # the token endpoint is an outbound destination too — same
+            # scheme rules as base_url or it becomes an SSRF side door
+            if auth["token_url"].startswith("http://"):
+                if not self.allow_insecure_http:
+                    raise ProblemError.bad_request(
+                        "token_url must be https", code="insecure_upstream")
+            elif not auth["token_url"].startswith("https://"):
+                raise ProblemError.bad_request("token_url must be http(s)")
         conn = self._db.secure(ctx, UPSTREAMS)
         if conn.find_one({"slug": spec["slug"]}):
             raise ProblemError.conflict(f"upstream {spec['slug']} exists")
         return conn.insert({
-            "slug": spec["slug"], "base_url": spec["base_url"].rstrip("/"),
+            "slug": spec["slug"], "base_url": base_url.rstrip("/"),
             "auth": auth, "rate_limit": spec.get("rate_limit") or {},
             "circuit_breaker": spec.get("circuit_breaker") or {}, "enabled": True,
         })
+
+    # ------------------------------------------------------- route control plane
+    def create_route(self, ctx: SecurityContext, spec: dict) -> dict:
+        """Route-level CRUD (reference CP/DP split: routes bind a public slug
+        to an upstream + path prefix with method allowlist and extra header
+        stripping — oagw/src/domain/services/client.rs)."""
+        if not spec.get("slug") or not spec.get("upstream_slug"):
+            raise ProblemError.bad_request("slug and upstream_slug required")
+        self._get_upstream(ctx, spec["upstream_slug"])  # must exist, tenant-scoped
+        methods = [m.upper() for m in spec.get("methods") or []]
+        bad = [m for m in methods
+               if m not in ("GET", "POST", "PUT", "PATCH", "DELETE", "HEAD")]
+        if bad:
+            raise ProblemError.bad_request(f"unsupported methods: {bad}")
+        conn = self._db.secure(ctx, ROUTES)
+        if conn.find_one({"slug": spec["slug"]}):
+            raise ProblemError.conflict(f"route {spec['slug']} exists")
+        return conn.insert({
+            "slug": spec["slug"], "upstream_slug": spec["upstream_slug"],
+            "path_prefix": (spec.get("path_prefix") or "").strip("/"),
+            "methods": methods,
+            "strip_headers": [h.lower() for h in spec.get("strip_headers") or []],
+            "rate_limit": spec.get("rate_limit") or {}, "enabled": True,
+        })
+
+    def list_routes(self, ctx: SecurityContext) -> list[dict]:
+        return self._db.secure(ctx, ROUTES).select(order_by="slug")
+
+    def delete_route(self, ctx: SecurityContext, slug: str) -> bool:
+        conn = self._db.secure(ctx, ROUTES)
+        row = conn.find_one({"slug": slug})
+        return conn.delete(row["id"]) if row else False
+
+    def _get_route(self, ctx: SecurityContext, slug: str) -> dict:
+        row = self._db.secure(ctx, ROUTES).find_one({"slug": slug})
+        if row is None or not row.get("enabled"):
+            raise ProblemError.not_found(f"route {slug!r} not found",
+                                         code="route_not_found")
+        return row
 
     def list_upstreams(self, ctx: SecurityContext) -> list[dict]:
         rows = self._db.secure(ctx, UPSTREAMS).select(order_by="slug")
@@ -207,6 +349,7 @@ class OagwService:
     def delete_upstream(self, ctx: SecurityContext, slug: str) -> bool:
         conn = self._db.secure(ctx, UPSTREAMS)
         row = conn.find_one({"slug": slug})
+        self._token_sources.pop(f"{ctx.tenant_id}:{slug}", None)
         return conn.delete(row["id"]) if row else False
 
     def _get_upstream(self, ctx: SecurityContext, slug: str) -> dict:
@@ -228,16 +371,71 @@ class OagwService:
         return breaker
 
     # ------------------------------------------------------------ data plane
+    async def _inject_credentials(self, ctx: SecurityContext, upstream: dict,
+                                  headers: dict) -> None:
+        auth = upstream.get("auth") or {}
+        if not auth:
+            return
+        secret = None
+        if self._credstore is not None:
+            secret = await self._credstore.get_secret(ctx, auth["secret_ref"])
+        if secret is None:
+            raise ProblemError(Problem(
+                status=502, title="Bad Gateway", code="credential_missing",
+                detail=f"secret {auth['secret_ref']!r} not found in credstore"))
+        if auth["type"] == "bearer":
+            headers["Authorization"] = f"Bearer {secret}"
+        elif auth["type"] == "oauth2":
+            # client-credentials with cached refresh (modkit-auth oauth2/ parity)
+            from urllib.parse import urlsplit
+
+            from ..modkit.oauth2 import ClientCredentialsTokenSource, OAuth2Error
+
+            if not self.allow_private_upstreams:
+                # the token endpoint is an outbound destination too
+                await _assert_public_destination(
+                    urlsplit(auth["token_url"]).hostname or "")
+            key = f"{ctx.tenant_id}:{upstream['slug']}"
+            # the cached source is only valid for the exact auth config it was
+            # built from — a recreated upstream must not reuse a stale endpoint
+            fingerprint = (auth["token_url"], auth["client_id"],
+                           auth.get("scope"), secret)
+            cached = self._token_sources.get(key)
+            if cached is None or cached[0] != fingerprint:
+                source = ClientCredentialsTokenSource(
+                    token_url=auth["token_url"], client_id=auth["client_id"],
+                    client_secret=secret, scope=auth.get("scope"))
+                self._token_sources[key] = (fingerprint, source)
+            else:
+                source = cached[1]
+            try:
+                headers["Authorization"] = f"Bearer {await source.get_token()}"
+            except OAuth2Error as e:
+                raise ProblemError(Problem(
+                    status=502, title="Bad Gateway", code="oauth2_token_error",
+                    detail=str(e)))
+        else:
+            headers[auth.get("header_name", "X-Api-Key")] = secret
+
     async def proxy(self, request: web.Request, ctx: SecurityContext,
-                    slug: str, tail: str) -> web.StreamResponse:
+                    slug: str, tail: str,
+                    route: Optional[dict] = None) -> web.StreamResponse:
         upstream = self._get_upstream(ctx, slug)
         key = f"{ctx.tenant_id}:{slug}"
 
-        rl = upstream.get("rate_limit") or {}
+        # a route-level limit gets its own bucket; otherwise ALL traffic to the
+        # upstream (direct + every route) shares the upstream's bucket, so the
+        # configured rps stays a hard ceiling no matter how many routes exist
+        if route and route.get("rate_limit"):
+            rl = route["rate_limit"]
+            bucket_key = f"route:{ctx.tenant_id}:{route['slug']}"
+        else:
+            rl = upstream.get("rate_limit") or {}
+            bucket_key = f"up:{key}"
         if rl:
-            bucket = self._buckets.get(key)
+            bucket = self._buckets.get(bucket_key)
             if bucket is None:
-                bucket = self._buckets[key] = _TokenBucket(
+                bucket = self._buckets[bucket_key] = _TokenBucket(
                     float(rl.get("rps", 10)), int(rl.get("burst", 20)))
             if not bucket.try_acquire():
                 raise ProblemError.too_many_requests(f"upstream {slug} rate limit")
@@ -249,31 +447,30 @@ class OagwService:
                 detail=f"circuit breaker open for upstream {slug}"))
 
         # header hygiene + credential injection
+        strip = set(_STRIP_REQUEST_HEADERS)
+        if route:
+            strip |= set(route.get("strip_headers") or ())
         headers = {k: v for k, v in request.headers.items()
-                   if k.lower() not in _STRIP_REQUEST_HEADERS}
-        auth = upstream.get("auth") or {}
-        if auth:
-            secret = None
-            if self._credstore is not None:
-                secret = await self._credstore.get_secret(ctx, auth["secret_ref"])
-            if secret is None:
-                raise ProblemError(Problem(
-                    status=502, title="Bad Gateway", code="credential_missing",
-                    detail=f"secret {auth['secret_ref']!r} not found in credstore"))
-            if auth["type"] == "bearer":
-                headers["Authorization"] = f"Bearer {secret}"
-            else:
-                headers[auth.get("header_name", "X-Api-Key")] = secret
+                   if k.lower() not in strip}
+        await self._inject_credentials(ctx, upstream, headers)
 
         url = f"{upstream['base_url']}/{tail.lstrip('/')}" if tail else upstream["base_url"]
         if request.query_string:
             url += f"?{request.query_string}"
         body = await request.read() if request.can_read_body else None
 
+        if not self.allow_private_upstreams:
+            from urllib.parse import urlsplit
+
+            host = urlsplit(upstream["base_url"]).hostname or ""
+            await _assert_public_destination(host)
+
         session = await self.session()
         try:
+            # redirects are NEVER followed: a 3xx from the upstream could
+            # point anywhere (incl. private ranges) — pass it through instead
             async with session.request(request.method, url, headers=headers,
-                                       data=body) as resp:
+                                       data=body, allow_redirects=False) as resp:
                 if resp.status >= 500:
                     breaker.record_failure()
                 else:
@@ -291,6 +488,21 @@ class OagwService:
             raise ProblemError(Problem(
                 status=502, title="Bad Gateway", code="upstream_error",
                 detail=f"upstream {slug}: {e}"))
+
+    async def proxy_route(self, request: web.Request, ctx: SecurityContext,
+                          route_slug: str, tail: str) -> web.StreamResponse:
+        """Route-level data plane: method allowlist + path prefix + extra
+        header hygiene, then the upstream proxy path."""
+        route = self._get_route(ctx, route_slug)
+        methods = route.get("methods") or []
+        if methods and request.method.upper() not in methods:
+            raise ProblemError(Problem(
+                status=405, title="Method Not Allowed", code="method_not_allowed",
+                detail=f"route {route_slug} allows {methods}"))
+        prefix = route.get("path_prefix") or ""
+        full_tail = f"{prefix}/{tail.lstrip('/')}".strip("/") if prefix else tail
+        return await self.proxy(request, ctx, route["upstream_slug"],
+                                full_tail, route=route)
 
 
 @module(name="oagw", deps=["credstore"], capabilities=["db", "rest"])
@@ -330,6 +542,27 @@ class OagwModule(Module, DatabaseCapability, RestApiCapability):
                 request, request[SECURITY_CONTEXT_KEY],
                 request.match_info["slug"], request.match_info.get("tail", ""))
 
+        async def create_route(request: web.Request):
+            body = await read_json(request)
+            row = svc.create_route(request[SECURITY_CONTEXT_KEY], body)
+            return {k: v for k, v in row.items() if k != "tenant_id"}, 201
+
+        async def list_routes(request: web.Request):
+            rows = svc.list_routes(request[SECURITY_CONTEXT_KEY])
+            return {"items": [{k: v for k, v in r.items() if k != "tenant_id"}
+                              for r in rows]}
+
+        async def delete_route(request: web.Request):
+            if not svc.delete_route(request[SECURITY_CONTEXT_KEY],
+                                    request.match_info["slug"]):
+                raise ProblemError.not_found("route not found")
+            return None
+
+        async def proxy_route(request: web.Request):
+            return await svc.proxy_route(
+                request, request[SECURITY_CONTEXT_KEY],
+                request.match_info["slug"], request.match_info.get("tail", ""))
+
         m = "oagw"
         router.operation("POST", "/v1/oagw/upstreams", module=m).auth_required() \
             .summary("Register an upstream (auth via credstore secret_ref)") \
@@ -338,8 +571,19 @@ class OagwModule(Module, DatabaseCapability, RestApiCapability):
             .summary("List upstreams with breaker state").handler(list_upstreams).register()
         router.operation("DELETE", "/v1/oagw/upstreams/{slug}", module=m).auth_required() \
             .summary("Delete an upstream").handler(delete_upstream).register()
+        router.operation("POST", "/v1/oagw/routes", module=m).auth_required() \
+            .summary("Register a route binding a slug to an upstream") \
+            .handler(create_route).register()
+        router.operation("GET", "/v1/oagw/routes", module=m).auth_required() \
+            .summary("List routes").handler(list_routes).register()
+        router.operation("DELETE", "/v1/oagw/routes/{slug}", module=m).auth_required() \
+            .summary("Delete a route").handler(delete_route).register()
         for method in ("GET", "POST", "PUT", "PATCH", "DELETE"):
             router.operation(method, "/v1/oagw/proxy/{slug}/{tail:.*}", module=m) \
                 .auth_required().accepts("*/*") \
                 .summary(f"Data-plane proxy ({method})").sse_response() \
                 .handler(proxy).register()
+            router.operation(method, "/v1/oagw/route/{slug}/{tail:.*}", module=m) \
+                .auth_required().accepts("*/*") \
+                .summary(f"Route-level data-plane proxy ({method})").sse_response() \
+                .handler(proxy_route).register()

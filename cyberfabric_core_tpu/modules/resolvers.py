@@ -19,7 +19,7 @@ from typing import Any, Optional
 from ..modkit import Module, module
 from ..modkit.contracts import SystemCapability
 from ..modkit.context import ModuleCtx
-from ..modkit.errors import ProblemError
+from ..modkit.errors import Problem, ProblemError
 from ..modkit.security import AccessScope, Dimension, ScopeFilter, SecretString, SecurityContext
 from ..gateway.middleware import AuthnApi, AuthzApi
 from .sdk import TenantResolverApi
@@ -75,6 +75,15 @@ class JwtAuthnResolver(AuthnApi):
         from ..modkit.jwt import JwtValidator
 
         self.validator = JwtValidator.from_config(cfg)
+        self.jwks = None
+        if cfg.get("jwks_url"):
+            # remote key set with rotation (modkit-auth providers/jwks.rs parity)
+            from ..modkit.jwks import JwksCache
+
+            self.jwks = JwksCache(
+                jwks_url=cfg["jwks_url"],
+                cache_ttl_s=float(cfg.get("jwks_cache_ttl_s", 300.0)),
+                negative_cache_s=float(cfg.get("jwks_negative_cache_s", 30.0)))
         self.tenant_claim = cfg.get("tenant_claim", "tenant_id")
         self.scopes_claim = cfg.get("scopes_claim", "scope")
         self.roles_claim = cfg.get("roles_claim", "roles")
@@ -82,11 +91,23 @@ class JwtAuthnResolver(AuthnApi):
 
     async def authenticate(self, bearer_token: Optional[str],
                            request_meta: dict[str, Any]) -> SecurityContext:
-        from ..modkit.jwt import JwtError
+        from ..modkit.jwt import JwtError, peek_header
 
         if not bearer_token:
             raise ProblemError.unauthorized("missing bearer token")
         try:
+            if self.jwks is not None:
+                kid = peek_header(bearer_token).get("kid")
+                try:
+                    key = await self.jwks.get_key(kid)
+                except JwtError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — IdP unreachable, no cache
+                    raise ProblemError(Problem(
+                        status=503, title="Service Unavailable",
+                        code="authn_unavailable",
+                        detail=f"JWKS endpoint unreachable: {e}"))
+                self.validator.keys = {key.kid: key}
             claims = self.validator.validate(bearer_token)
         except JwtError as e:
             raise ProblemError.unauthorized(f"invalid token: {e}")

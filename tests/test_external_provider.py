@@ -66,7 +66,8 @@ def stack(fresh_registry):
         cfg = AppConfig.load_or_default(environ={}, cli_overrides={"modules": {
             "api_gateway": {"config": {"bind_addr": "127.0.0.1:0",
                                        "auth_disabled": True}},
-            "tenant_resolver": {}, "credstore": {}, "oagw": {},
+            "tenant_resolver": {}, "credstore": {}, "oagw": {"config": {
+                "allow_insecure_http": True, "allow_private_upstreams": True}},
             "model_registry": {"config": {
                 "seed_tenant": "default",
                 "models": [{"provider_slug": "openai-mock",
