@@ -72,9 +72,12 @@ def peek_header(token: str) -> dict[str, Any]:
     if len(parts) != 3:
         raise JwtError("token must have 3 segments")
     try:
-        return json.loads(_b64url_decode(parts[0]))
+        header = json.loads(_b64url_decode(parts[0]))
     except (json.JSONDecodeError, UnicodeDecodeError) as e:
         raise JwtError(f"malformed token header: {e}") from e
+    if not isinstance(header, dict):
+        raise JwtError("token header is not a JSON object")
+    return header
 
 
 @dataclass
@@ -111,7 +114,10 @@ class JwtValidator:
         if alg == "HS256":
             if not key.secret:
                 raise JwtError("HS256 key has no secret")
-            expected = hmac.new(key.secret.encode(), signing_input, "sha256").digest()
+            # surrogateescape round-trips binary HMAC secrets that arrived
+            # through a JWKS oct key (jwks.py decodes them the same way)
+            expected = hmac.new(key.secret.encode("utf-8", "surrogateescape"),
+                                signing_input, "sha256").digest()
             if not hmac.compare_digest(expected, sig):
                 raise JwtError("signature mismatch")
         elif alg == "RS256":

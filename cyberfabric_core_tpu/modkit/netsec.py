@@ -1,0 +1,39 @@
+"""Outbound network-security primitives shared by OAGW and the OAuth2 client.
+
+SSRF defense in depth: `is_public_address` classifies a literal address;
+`PublicOnlyResolver` enforces the same rule inside DNS resolution so a
+TTL-0 rebinding domain cannot swap to a private address between an advisory
+pre-check and the actual connect (reference DESIGN F-P1-008)."""
+
+from __future__ import annotations
+
+import ipaddress
+
+import aiohttp
+
+
+def is_public_address(addr: str) -> bool:
+    a = ipaddress.ip_address(addr)
+    return not (a.is_private or a.is_loopback or a.is_link_local
+                or a.is_reserved or a.is_multicast or a.is_unspecified)
+
+
+class PublicOnlyResolver(aiohttp.abc.AbstractResolver):
+    """DNS resolver that drops non-public addresses at connect time."""
+
+    def __init__(self) -> None:
+        self._inner = aiohttp.DefaultResolver()
+
+    async def resolve(self, host, port=0, family=0):
+        infos = await self._inner.resolve(host, port, family)
+        public = [i for i in infos if is_public_address(i["host"])]
+        if not public:
+            raise OSError(f"host {host!r} resolves only to non-public addresses")
+        return public
+
+    async def close(self) -> None:
+        await self._inner.close()
+
+
+def public_only_connector() -> aiohttp.TCPConnector:
+    return aiohttp.TCPConnector(resolver=PublicOnlyResolver())

@@ -36,6 +36,9 @@ class ClientCredentialsTokenSource:
     scope: Optional[str] = None
     refresh_margin_s: float = 30.0
     fetch_timeout_s: float = 15.0
+    #: SSRF guard: when True the token endpoint resolves through the
+    #: public-only resolver (same rebinding defense as the OAGW proxy)
+    public_only: bool = False
 
     _token: Optional[str] = None
     _expires_at: float = 0.0
@@ -49,10 +52,17 @@ class ClientCredentialsTokenSource:
                 "client_secret": self.client_secret}
         if self.scope:
             form["scope"] = self.scope
+        connector = None
+        if self.public_only:
+            from .netsec import public_only_connector
+
+            connector = public_only_connector()
         async with aiohttp.ClientSession(
+            connector=connector,
             timeout=aiohttp.ClientTimeout(total=self.fetch_timeout_s)
         ) as session:
-            async with session.post(self.token_url, data=form) as resp:
+            async with session.post(self.token_url, data=form,
+                                    allow_redirects=False) as resp:
                 try:
                     body = await resp.json(content_type=None)
                 except Exception as e:  # noqa: BLE001 — HTML error pages etc.

@@ -106,8 +106,13 @@ async def run_oop_module(module_name: str) -> None:
     from .context import ModuleCtx
     from .registry import ModuleRegistry
 
-    # import module definitions (inventory side effects)
-    import cyberfabric_core_tpu.modules  # noqa: F401
+    # import module definitions (inventory side effects). The package is
+    # env-configurable so the substrate stays layering-clean — modkit never
+    # statically depends on the business tier (arch lint L1)
+    import importlib
+
+    importlib.import_module(
+        os.environ.get("MODKIT_MODULES_PACKAGE", "cyberfabric_core_tpu.modules"))
 
     token = CancellationToken()
     loop = asyncio.get_running_loop()

@@ -23,6 +23,7 @@ from typing import Any, Callable, Optional
 from aiohttp import web
 
 from ..modkit import Module, module
+from .sdk import FileParserApi
 from ..modkit.contracts import RestApiCapability
 from ..modkit.context import ModuleCtx
 from ..modkit.errors import ProblemError
@@ -200,7 +201,7 @@ _EXT_MIME = {".txt": "text/plain", ".md": "text/markdown", ".html": "text/html",
              ".gif": "image/gif", ".bmp": "image/bmp", ".webp": "image/webp"}
 
 
-class FileParserService:
+class FileParserService(FileParserApi):
     def __init__(self, allowed_local_base_dir: Optional[Path],
                  max_file_size_bytes: int) -> None:
         self.base_dir = allowed_local_base_dir
@@ -213,6 +214,11 @@ class FileParserService:
         key = mime.split(";")[0].strip().lower()
         parser = PARSERS.get(key) or _binary_parsers().get(key) or parse_stub
         return parser(data), mime
+
+    def parse_to_markdown(self, data: bytes, mime: str) -> tuple[str, Optional[str]]:
+        """FileParserApi (SDK trait): parse → (markdown, title)."""
+        doc, _ = self.parse_bytes(data, mime)
+        return doc.to_markdown(), doc.title
 
     def parse_local(self, path_str: str) -> tuple[Document, str]:
         """Path-traversal-safe local parse (module.rs:62-86 defense)."""
@@ -243,6 +249,7 @@ class FileParserModule(Module, RestApiCapability):
             int(cfg.get("max_file_size_bytes", 16 * 1024 * 1024)),
         )
         ctx.client_hub.register(FileParserService, self.service)
+        ctx.client_hub.register(FileParserApi, self.service)
 
     def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
         svc = self.service

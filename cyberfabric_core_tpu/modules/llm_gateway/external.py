@@ -21,8 +21,7 @@ import aiohttp
 
 from ...modkit.errors import Problem, ProblemError
 from ...modkit.security import SecurityContext
-from ..oagw import OagwService, parse_sse_stream
-from ..sdk import ChatStreamChunk, ModelInfo
+from ..sdk import ChatStreamChunk, ModelInfo, OagwApi, parse_sse_stream
 
 logger = logging.getLogger("llm_external")
 
@@ -50,43 +49,25 @@ class ExternalProviderAdapter:
     """Streams a chat completion from an external provider via the OAGW
     data plane's upstream client (breaker + credentials + rate limit)."""
 
-    def __init__(self, oagw: OagwService) -> None:
+    def __init__(self, oagw: OagwApi) -> None:
         self._oagw = oagw
 
     async def chat_stream(
         self, ctx: SecurityContext, model: ModelInfo, messages: list[dict],
         params: dict,
     ) -> AsyncIterator[ChatStreamChunk]:
-        upstream = self._oagw._get_upstream(ctx, model.provider_slug)
-        breaker = self._oagw._breaker_for(ctx, upstream)
-        if not breaker.allow():
-            raise ProblemError(Problem(
-                status=503, title="Service Unavailable", code="CircuitBreakerOpen",
-                detail=f"provider {model.provider_slug} circuit open"))
-
-        headers = {"Content-Type": "application/json"}
-        auth = upstream.get("auth") or {}
-        if auth and self._oagw._credstore is not None:
-            secret = await self._oagw._credstore.get_secret(ctx, auth["secret_ref"])
-            if secret is None:
-                raise ProblemError(Problem(
-                    status=502, title="Bad Gateway", code="credential_missing",
-                    detail=f"secret {auth['secret_ref']!r} not in credstore"))
-            if auth["type"] == "bearer":
-                headers["Authorization"] = f"Bearer {secret}"
-            else:
-                headers[auth.get("header_name", "X-Api-Key")] = secret
-
         body = to_openai_request(messages, params, model.provider_model_id)
-        url = f"{upstream['base_url']}/chat/completions"
-        session = await self._oagw.session()
         request_id = f"ext-{model.provider_slug}"
         n_out = 0
         try:
-            async with session.post(url, json=body, headers=headers) as resp:
+            # the SDK seam supplies credential injection (incl. oauth2),
+            # breaker, SSRF guards — this adapter only translates dialects
+            async with self._oagw.open_upstream_stream(
+                ctx, model.provider_slug, "chat/completions",
+                method="POST", json_body=body,
+                headers={"Content-Type": "application/json"},
+            ) as resp:
                 if resp.status >= 400:
-                    if resp.status >= 500:
-                        breaker.record_failure()
                     detail = (await resp.text())[:300]
                     raise ProblemError(Problem(
                         status=502, title="Bad Gateway", code="provider_error",
@@ -114,12 +95,10 @@ class ExternalProviderAdapter:
                             yield ChatStreamChunk(request_id=request_id, text=text)
                         if choice.get("finish_reason"):
                             finish = choice["finish_reason"]
-                breaker.record_success()
                 yield ChatStreamChunk(
                     request_id=request_id, finish_reason=finish or "stop",
                     usage=usage or {"input_tokens": 0, "output_tokens": n_out})
         except aiohttp.ClientError as e:
-            breaker.record_failure()
             raise ProblemError(Problem(
                 status=502, title="Bad Gateway", code="provider_unreachable",
                 detail=f"provider {model.provider_slug}: {e}"))

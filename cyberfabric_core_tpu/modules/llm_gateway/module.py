@@ -158,14 +158,9 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         storage = self._hub.try_get(FileStorageApi)
         if storage is None:
             return body
-        parser = None
-        try:
-            from ..file_parser import FileParserService
+        from ..sdk import FileParserApi
 
-            parser_module = self._hub.try_get(FileParserService)
-            parser = parser_module
-        except ImportError:
-            pass
+        parser = self._hub.try_get(FileParserApi)
 
         changed = False
         messages = []
@@ -182,9 +177,8 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
                             f"document part references missing file {part['url']}",
                             code="media_not_found")
                     if parser is not None:
-                        doc, _ = parser.parse_bytes(data, part.get("mime_type")
-                                                    or meta.mime_type)
-                        text = doc.to_markdown()
+                        text, _title = parser.parse_to_markdown(
+                            data, part.get("mime_type") or meta.mime_type)
                     else:
                         text = data.decode("utf-8", errors="replace")
                     parts.append({"type": "text",
@@ -199,10 +193,10 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
 
     def _get_external(self):
         if self._external is None and getattr(self, "_hub", None) is not None:
-            from ..oagw import OagwService
+            from ..sdk import OagwApi
             from .external import ExternalProviderAdapter
 
-            oagw = self._hub.try_get(OagwService)
+            oagw = self._hub.try_get(OagwApi)
             if oagw is not None:
                 self._external = ExternalProviderAdapter(oagw)
         return self._external

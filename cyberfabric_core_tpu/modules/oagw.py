@@ -142,38 +142,9 @@ class _TokenBucket:
         return False
 
 
-def parse_sse_stream(chunks: AsyncIterator[bytes]) -> AsyncIterator[dict]:
-    """Incremental SSE parser (oagw-sdk/src/sse/parse.rs:1-60): yields
-    {event?, data, id?} dicts; handles multi-line data and CRLF."""
-
-    async def gen():
-        buf = b""
-        async for chunk in chunks:
-            buf += chunk
-            while b"\n\n" in buf or b"\r\n\r\n" in buf:
-                sep = b"\r\n\r\n" if b"\r\n\r\n" in buf.split(b"\n\n")[0] else b"\n\n"
-                frame, buf = buf.split(sep, 1)
-                event: dict[str, Any] = {}
-                data_lines = []
-                for line in frame.replace(b"\r\n", b"\n").split(b"\n"):
-                    if line.startswith(b":"):
-                        continue  # comment/keep-alive
-                    if b":" in line:
-                        k, v = line.split(b":", 1)
-                        v = v[1:] if v.startswith(b" ") else v
-                    else:
-                        k, v = line, b""
-                    k = k.decode()
-                    if k == "data":
-                        data_lines.append(v.decode())
-                    elif k in ("event", "id"):
-                        event[k] = v.decode()
-                if data_lines:
-                    event["data"] = "\n".join(data_lines)
-                if event:
-                    yield event
-
-    return gen()
+# the SSE parser lives in the SDK (reference: oagw-sdk/src/sse/parse.rs);
+# re-exported here for existing importers
+from .sdk import OagwApi, parse_sse_stream  # noqa: E402, F401
 
 
 async def _assert_public_destination(host: str) -> None:
@@ -204,33 +175,7 @@ async def _assert_public_destination(host: str) -> None:
                 code="upstream_forbidden")
 
 
-class _PublicOnlyResolver(aiohttp.abc.AbstractResolver):
-    """DNS resolver that drops non-public addresses at connect time — the
-    rebinding-proof counterpart of _assert_public_destination (the hostname is
-    resolved exactly once, and only vetted addresses reach the connector)."""
-
-    def __init__(self) -> None:
-        self._inner = aiohttp.DefaultResolver()
-
-    async def resolve(self, host, port=0, family=0):
-        import ipaddress
-
-        infos = await self._inner.resolve(host, port, family)
-        public = []
-        for info in infos:
-            a = ipaddress.ip_address(info["host"])
-            if not (a.is_private or a.is_loopback or a.is_link_local
-                    or a.is_reserved or a.is_multicast or a.is_unspecified):
-                public.append(info)
-        if not public:
-            raise OSError(f"host {host!r} resolves only to non-public addresses")
-        return public
-
-    async def close(self) -> None:
-        await self._inner.close()
-
-
-class OagwService:
+class OagwService(OagwApi):
     def __init__(self, ctx: ModuleCtx) -> None:
         self._db = ctx.db_required()
         self._credstore: Optional[CredStoreApi] = ctx.client_hub.try_get(CredStoreApi)
@@ -252,8 +197,9 @@ class OagwService:
                 # in proxy() is advisory (clear error early), but a TTL-0
                 # rebinding domain could swap to a private address between
                 # check and connect — this resolver filters at connect time
-                connector = aiohttp.TCPConnector(
-                    resolver=_PublicOnlyResolver())
+                from ..modkit.netsec import public_only_connector
+
+                connector = public_only_connector()
             self._session = aiohttp.ClientSession(
                 connector=connector,
                 timeout=aiohttp.ClientTimeout(total=120, connect=10))
@@ -333,6 +279,7 @@ class OagwService:
     def delete_route(self, ctx: SecurityContext, slug: str) -> bool:
         conn = self._db.secure(ctx, ROUTES)
         row = conn.find_one({"slug": slug})
+        self._buckets.pop(f"route:{ctx.tenant_id}:{slug}", None)
         return conn.delete(row["id"]) if row else False
 
     def _get_route(self, ctx: SecurityContext, slug: str) -> dict:
@@ -349,7 +296,10 @@ class OagwService:
     def delete_upstream(self, ctx: SecurityContext, slug: str) -> bool:
         conn = self._db.secure(ctx, UPSTREAMS)
         row = conn.find_one({"slug": slug})
+        # evict cached runtime state so a recreated upstream gets fresh config
         self._token_sources.pop(f"{ctx.tenant_id}:{slug}", None)
+        self._buckets.pop(f"up:{ctx.tenant_id}:{slug}", None)
+        self._breakers.pop(f"{ctx.tenant_id}:{slug}", None)
         return conn.delete(row["id"]) if row else False
 
     def _get_upstream(self, ctx: SecurityContext, slug: str) -> dict:
@@ -404,7 +354,8 @@ class OagwService:
             if cached is None or cached[0] != fingerprint:
                 source = ClientCredentialsTokenSource(
                     token_url=auth["token_url"], client_id=auth["client_id"],
-                    client_secret=secret, scope=auth.get("scope"))
+                    client_secret=secret, scope=auth.get("scope"),
+                    public_only=not self.allow_private_upstreams)
                 self._token_sources[key] = (fingerprint, source)
             else:
                 source = cached[1]
@@ -473,8 +424,6 @@ class OagwService:
                                        data=body, allow_redirects=False) as resp:
                 if resp.status >= 500:
                     breaker.record_failure()
-                else:
-                    breaker.record_success()
                 out_headers = {k: v for k, v in resp.headers.items()
                                if k.lower() not in _STRIP_RESPONSE_HEADERS}
                 out = web.StreamResponse(status=resp.status, headers=out_headers)
@@ -482,12 +431,60 @@ class OagwService:
                 async for chunk in resp.content.iter_chunked(16 * 1024):
                     await out.write(chunk)  # streaming passthrough (SSE included)
                 await out.write_eof()
+                if resp.status < 500:
+                    breaker.record_success()  # only after the stream drained
                 return out
         except aiohttp.ClientError as e:
             breaker.record_failure()
             raise ProblemError(Problem(
                 status=502, title="Bad Gateway", code="upstream_error",
                 detail=f"upstream {slug}: {e}"))
+
+    def open_upstream_stream(self, ctx: SecurityContext, slug: str, path: str,
+                             *, method: str = "POST", json_body: Any = None,
+                             headers: Optional[dict] = None):
+        """OagwApi: breaker-guarded, credential-injected upstream request as an
+        async context manager (the llm-gateway external adapter's seam — it
+        gets oauth2 + SSRF + breaker behavior without touching internals)."""
+        from contextlib import asynccontextmanager
+
+        @asynccontextmanager
+        async def cm():
+            upstream = self._get_upstream(ctx, slug)
+            breaker = self._breaker_for(ctx, upstream)
+            if not breaker.allow():
+                raise ProblemError(Problem(
+                    status=503, title="Service Unavailable",
+                    code="CircuitBreakerOpen",
+                    detail=f"circuit breaker open for upstream {slug}"))
+            hdrs = dict(headers or {})
+            await self._inject_credentials(ctx, upstream, hdrs)
+            if not self.allow_private_upstreams:
+                from urllib.parse import urlsplit
+
+                await _assert_public_destination(
+                    urlsplit(upstream["base_url"]).hostname or "")
+            url = f"{upstream['base_url']}/{path.lstrip('/')}"
+            session = await self.session()
+            try:
+                async with session.request(method, url, json=json_body,
+                                           headers=hdrs,
+                                           allow_redirects=False) as resp:
+                    if resp.status >= 500:
+                        breaker.record_failure()
+                    yield resp
+                    # success only once the caller drained the stream without
+                    # raising — a provider dying mid-stream must trip the
+                    # breaker, not reset it at header time
+                    if resp.status < 500:
+                        breaker.record_success()
+            except aiohttp.ClientError as e:
+                breaker.record_failure()
+                raise ProblemError(Problem(
+                    status=502, title="Bad Gateway", code="upstream_error",
+                    detail=f"upstream {slug}: {e}"))
+
+        return cm()
 
     async def proxy_route(self, request: web.Request, ctx: SecurityContext,
                           route_slug: str, tail: str) -> web.StreamResponse:
@@ -516,6 +513,7 @@ class OagwModule(Module, DatabaseCapability, RestApiCapability):
     async def init(self, ctx: ModuleCtx) -> None:
         self.service = OagwService(ctx)
         ctx.client_hub.register(OagwService, self.service)
+        ctx.client_hub.register(OagwApi, self.service)
 
     def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
         svc = self.service

@@ -75,6 +75,10 @@ class JwtAuthnResolver(AuthnApi):
         from ..modkit.jwt import JwtValidator
 
         self.validator = JwtValidator.from_config(cfg)
+        #: statically configured keys keep working alongside a JWKS URL
+        #: (e.g. service tokens signed with a local key + user tokens from
+        #: the IdP) — JWKS lookups merge into this set, never replace it
+        self._static_keys = dict(self.validator.keys)
         self.jwks = None
         if cfg.get("jwks_url"):
             # remote key set with rotation (modkit-auth providers/jwks.rs parity)
@@ -98,16 +102,17 @@ class JwtAuthnResolver(AuthnApi):
         try:
             if self.jwks is not None:
                 kid = peek_header(bearer_token).get("kid")
-                try:
-                    key = await self.jwks.get_key(kid)
-                except JwtError:
-                    raise
-                except Exception as e:  # noqa: BLE001 — IdP unreachable, no cache
-                    raise ProblemError(Problem(
-                        status=503, title="Service Unavailable",
-                        code="authn_unavailable",
-                        detail=f"JWKS endpoint unreachable: {e}"))
-                self.validator.keys = {key.kid: key}
+                if kid is None or kid not in self._static_keys:
+                    try:
+                        key = await self.jwks.get_key(kid)
+                    except JwtError:
+                        raise
+                    except Exception as e:  # noqa: BLE001 — IdP down, no cache
+                        raise ProblemError(Problem(
+                            status=503, title="Service Unavailable",
+                            code="authn_unavailable",
+                            detail=f"JWKS endpoint unreachable: {e}"))
+                    self.validator.keys = {**self._static_keys, key.kid: key}
             claims = self.validator.validate(bearer_token)
         except JwtError as e:
             raise ProblemError.unauthorized(f"invalid token: {e}")

@@ -134,6 +134,65 @@ class FileStorageApi(abc.ABC):
         ...
 
 
+# ----------------------------------------------------------------- file parser
+class FileParserApi(abc.ABC):
+    """file-parser SDK trait: parse bytes to markdown without exposing the
+    module's Document IR (reference: file-parser's DDD-light api surface)."""
+
+    @abc.abstractmethod
+    def parse_to_markdown(self, data: bytes,
+                          mime: str) -> tuple[str, Optional[str]]:
+        """Returns (markdown, title)."""
+
+
+# ----------------------------------------------------------------- oagw
+class OagwApi(abc.ABC):
+    """Outbound-gateway SDK trait: open a credential-injected, breaker-guarded
+    request to a registered upstream (the data-plane client surface the
+    llm-gateway's external provider adapter consumes)."""
+
+    @abc.abstractmethod
+    def open_upstream_stream(self, ctx: SecurityContext, slug: str, path: str,
+                             *, method: str = "POST", json_body: Any = None,
+                             headers: Optional[dict] = None):
+        """Async context manager yielding the upstream's streaming response."""
+
+
+def parse_sse_stream(chunks: "AsyncIterator[bytes]") -> "AsyncIterator[dict]":
+    """Incremental SSE parser (reference keeps this in oagw-sdk —
+    oagw-sdk/src/sse/parse.rs:1-60): yields {event?, data, id?} dicts; handles
+    multi-line data and CRLF."""
+
+    async def gen():
+        buf = b""
+        async for chunk in chunks:
+            buf += chunk
+            while b"\n\n" in buf or b"\r\n\r\n" in buf:
+                sep = b"\r\n\r\n" if b"\r\n\r\n" in buf.split(b"\n\n")[0] else b"\n\n"
+                frame, buf = buf.split(sep, 1)
+                event: dict[str, Any] = {}
+                data_lines = []
+                for line in frame.replace(b"\r\n", b"\n").split(b"\n"):
+                    if line.startswith(b":"):
+                        continue  # comment/keep-alive
+                    if b":" in line:
+                        k, v = line.split(b":", 1)
+                        v = v[1:] if v.startswith(b" ") else v
+                    else:
+                        k, v = line, b""
+                    k = k.decode()
+                    if k == "data":
+                        data_lines.append(v.decode())
+                    elif k in ("event", "id"):
+                        event[k] = v.decode()
+                if data_lines:
+                    event["data"] = "\n".join(data_lines)
+                if event:
+                    yield event
+
+    return gen()
+
+
 # ----------------------------------------------------------------- credstore
 class CredStoreApi(abc.ABC):
     """credstore DESIGN.md:45-166: gateway with hierarchical walk-up resolution;

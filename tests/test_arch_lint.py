@@ -1,0 +1,108 @@
+"""Architectural lint (dylint-equivalent enforcement, SURVEY §2.5).
+
+Reference analogue: dylint_lints/ (8 custom lint crates — DE01 contract
+purity, DE02 DTO containment, …). Python-tier rules enforced by AST scan:
+
+L1  modkit (the substrate) never imports upward (gateway/, modules/).
+L2  sqlite3 is touched ONLY by modkit/db.py — "no plain SQL outside the
+    secure ORM" (reference: advisory_locks.rs:6-9 policy).
+L3  The compute tier (models/, ops/, parallel/) never imports the serving
+    tier (modules/, gateway/) — kernels stay host-framework-free.
+L4  Business modules use only the gateway's public seams
+    (gateway.middleware, gateway.validation); from gateway.module only
+    contract types (*Api) — router/openapi internals are off limits.
+L5  Modules talk to each other through ClientHub SDK traits (.sdk), never
+    by importing a sibling module's implementation (package-internal files
+    and __init__ re-exports excepted).
+"""
+
+import ast
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parents[1] / "cyberfabric_core_tpu"
+
+
+def _imports(path: Path):
+    """Yield (level, module, names) for every import in the file."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            yield node.level, node.module or "", [a.name for a in node.names]
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                yield 0, a.name, []
+
+
+def _resolve(path: Path, level: int, module: str) -> str:
+    """Absolute dotted module for a (possibly relative) import."""
+    if level == 0:
+        return module
+    parts = path.relative_to(PKG.parent).with_suffix("").parts
+    base = list(parts[:-1]) if path.name != "__init__.py" else list(parts[:-1])
+    up = base[: len(base) - (level - 1)] if level > 1 else base
+    return ".".join(up + ([module] if module else []))
+
+
+def _scan(root: Path):
+    for path in sorted(root.rglob("*.py")):
+        for level, module, names in _imports(path):
+            yield path, _resolve(path, level, module), names
+
+
+def test_L1_modkit_never_imports_upward():
+    bad = [(p, m) for p, m, _ in _scan(PKG / "modkit")
+           if ".gateway" in m or ".modules" in m]
+    assert not bad, f"modkit imports upward: {bad}"
+
+
+def test_L2_sqlite_only_in_db():
+    bad = [(p, m) for p, m, _ in _scan(PKG)
+           if m.split(".")[0] == "sqlite3" and p.name != "db.py"]
+    assert not bad, (
+        f"sqlite3 outside modkit/db.py (the secure-ORM boundary): {bad}")
+
+
+def test_L3_compute_tier_is_serving_free():
+    for tier in ("models", "ops", "parallel"):
+        bad = [(p, m) for p, m, _ in _scan(PKG / tier)
+               if ".modules" in m or ".gateway" in m or ".modkit" in m]
+        assert not bad, f"compute tier {tier}/ imports serving tier: {bad}"
+
+
+def test_L4_modules_use_only_public_gateway_seams():
+    allowed_submodules = {"cyberfabric_core_tpu.gateway.middleware",
+                          "cyberfabric_core_tpu.gateway.validation"}
+    violations = []
+    for path, mod, names in _scan(PKG / "modules"):
+        if ".gateway" not in mod:
+            continue
+        if path.name == "__init__.py":
+            continue  # registration re-export is the sanctioned exception
+        if mod in allowed_submodules:
+            continue
+        if mod == "cyberfabric_core_tpu.gateway.module" and all(
+                n.endswith("Api") for n in names):
+            continue  # contract ABCs only
+        violations.append((str(path.relative_to(PKG)), mod, names))
+    assert not violations, (
+        "modules may import only gateway.middleware/gateway.validation "
+        f"(or *Api contracts): {violations}")
+
+
+def test_L5_cross_module_calls_go_through_sdk():
+    module_files = {p.stem for p in (PKG / "modules").glob("*.py")} - {
+        "__init__", "sdk"}
+    violations = []
+    for path, mod, names in _scan(PKG / "modules"):
+        if path.name == "__init__.py":
+            continue
+        parts = mod.split(".")
+        if (len(parts) >= 3 and parts[-2] == "modules"
+                and parts[-1] in module_files and parts[-1] != "sdk"):
+            target = parts[-1]
+            # same-family implementation detail files are allowed
+            if target.startswith(path.stem) or path.stem.startswith(target):
+                continue
+            violations.append((str(path.relative_to(PKG)), mod))
+    assert not violations, (
+        f"cross-module implementation imports (use ClientHub/.sdk): {violations}")
