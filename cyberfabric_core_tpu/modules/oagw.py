@@ -321,6 +321,27 @@ class OagwService(OagwApi):
         return breaker
 
     # ------------------------------------------------------------ data plane
+    def _acquire_rate(self, ctx: SecurityContext, upstream: dict,
+                      route: Optional[dict] = None) -> None:
+        """A route-level limit gets its own bucket; otherwise ALL traffic to
+        the upstream (direct proxy, every route, and SDK clients like the
+        llm-gateway external adapter) shares the upstream's bucket, so the
+        configured rps stays a hard ceiling."""
+        if route and route.get("rate_limit"):
+            rl = route["rate_limit"]
+            bucket_key = f"route:{ctx.tenant_id}:{route['slug']}"
+        else:
+            rl = upstream.get("rate_limit") or {}
+            bucket_key = f"up:{ctx.tenant_id}:{upstream['slug']}"
+        if rl:
+            bucket = self._buckets.get(bucket_key)
+            if bucket is None:
+                bucket = self._buckets[bucket_key] = _TokenBucket(
+                    float(rl.get("rps", 10)), int(rl.get("burst", 20)))
+            if not bucket.try_acquire():
+                raise ProblemError.too_many_requests(
+                    f"upstream {upstream['slug']} rate limit")
+
     async def _inject_credentials(self, ctx: SecurityContext, upstream: dict,
                                   headers: dict) -> None:
         auth = upstream.get("auth") or {}
@@ -374,22 +395,7 @@ class OagwService(OagwApi):
         upstream = self._get_upstream(ctx, slug)
         key = f"{ctx.tenant_id}:{slug}"
 
-        # a route-level limit gets its own bucket; otherwise ALL traffic to the
-        # upstream (direct + every route) shares the upstream's bucket, so the
-        # configured rps stays a hard ceiling no matter how many routes exist
-        if route and route.get("rate_limit"):
-            rl = route["rate_limit"]
-            bucket_key = f"route:{ctx.tenant_id}:{route['slug']}"
-        else:
-            rl = upstream.get("rate_limit") or {}
-            bucket_key = f"up:{key}"
-        if rl:
-            bucket = self._buckets.get(bucket_key)
-            if bucket is None:
-                bucket = self._buckets[bucket_key] = _TokenBucket(
-                    float(rl.get("rps", 10)), int(rl.get("burst", 20)))
-            if not bucket.try_acquire():
-                raise ProblemError.too_many_requests(f"upstream {slug} rate limit")
+        self._acquire_rate(ctx, upstream, route)
 
         breaker = self._breaker_for(ctx, upstream)
         if not breaker.allow():
@@ -451,6 +457,7 @@ class OagwService(OagwApi):
         @asynccontextmanager
         async def cm():
             upstream = self._get_upstream(ctx, slug)
+            self._acquire_rate(ctx, upstream)
             breaker = self._breaker_for(ctx, upstream)
             if not breaker.allow():
                 raise ProblemError(Problem(

@@ -62,8 +62,9 @@ INVOCATIONS = ScopableEntity(
                "status": "status", "mode": "mode", "params": "params",
                "result": "result", "error": "error", "attempt": "attempt",
                "idempotency_key": "idempotency_key", "timeline": "timeline",
+               "checkpoint": "checkpoint",
                "created_at": "created_at", "updated_at": "updated_at"},
-    json_cols=("params", "result", "error", "timeline"),
+    json_cols=("params", "result", "error", "timeline", "checkpoint"),
 )
 
 SCHEDULES = ScopableEntity(
@@ -115,8 +116,15 @@ def _migrate_0002(c):
     c.execute("CREATE INDEX idx_triggers_topic ON triggers (tenant_id, topic)")
 
 
+def _migrate_0003(c):
+    # durable-execution state: per-step workflow checkpoint so a host restart
+    # resumes where it left off instead of replaying completed steps
+    c.execute("ALTER TABLE invocations ADD COLUMN checkpoint TEXT")
+
+
 _MIGRATIONS = [Migration("0001_serverless", _migrate_0001),
-               Migration("0002_triggers", _migrate_0002)]
+               Migration("0002_triggers", _migrate_0002),
+               Migration("0003_checkpoint", _migrate_0003)]
 
 TRIGGERS = ScopableEntity(
     table="triggers",
@@ -145,9 +153,41 @@ class ServerlessService(ServerlessApi):
         self._db = ctx.db_required()
         self._functions: dict[str, FunctionHandler] = {}
         self._tasks: dict[str, asyncio.Task] = {}
+        self._task_tenants: dict[str, str] = {}
         self._suspended: dict[str, asyncio.Event] = {}
         self._response_cache: dict[str, tuple[float, dict]] = {}
+        # tenant runtime policies (reference PRD: tenant runtime policies +
+        # quotas): {tenant_id|"default": {max_concurrent, per_minute}}
+        self._policies: dict[str, dict] = dict(
+            ctx.raw_config().get("tenant_policies") or {})
+        self._rate_windows: dict[str, list[float]] = {}
         self._register_builtins()
+
+    def _policy_for(self, tenant_id: str) -> dict:
+        return self._policies.get(tenant_id) or self._policies.get("default") or {}
+
+    def _enforce_quota(self, ctx: SecurityContext) -> None:
+        policy = self._policy_for(ctx.tenant_id)
+        if not policy:
+            return
+        max_conc = int(policy.get("max_concurrent", 0))
+        if max_conc > 0:
+            live = sum(1 for t in self._task_tenants.values()
+                       if t == ctx.tenant_id)
+            if live >= max_conc:
+                raise ProblemError.too_many_requests(
+                    f"tenant concurrency quota ({max_conc}) reached")
+        per_minute = int(policy.get("per_minute", 0))
+        if per_minute > 0:
+            now = time.monotonic()
+            window = [t for t in self._rate_windows.get(ctx.tenant_id, ())
+                      if t > now - 60.0]
+            if len(window) >= per_minute:
+                self._rate_windows[ctx.tenant_id] = window
+                raise ProblemError.too_many_requests(
+                    f"tenant rate quota ({per_minute}/min) reached")
+            window.append(now)
+            self._rate_windows[ctx.tenant_id] = window
 
     # ------------------------------------------------------------- functions
     def register_function(self, name: str, handler: FunctionHandler) -> None:
@@ -290,7 +330,9 @@ class ServerlessService(ServerlessApi):
             return {"record": None, "dry_run": True, "cached": False,
                     "valid": True, "entrypoint": self._ep_view(ep)}
 
-        # response cache (ADR:3529-3543)
+        # response cache (ADR:3529-3543) — consulted BEFORE quota: an
+        # idempotent retry must return the cached result, not a 429, and a
+        # cache hit does no work so it charges no quota
         cache_key = None
         if idem_key and ep["is_idempotent"] and ep["cache_max_age_seconds"] > 0:
             cache_key = f"{ctx.tenant_id}:{ep['id']}:{ep['version']}:{idem_key}"
@@ -304,6 +346,7 @@ class ServerlessService(ServerlessApi):
                 self._response_cache = {
                     k: v for k, v in self._response_cache.items() if v[0] > now}
 
+        self._enforce_quota(ctx)
         conn = self._db.secure(ctx, INVOCATIONS)
         inv = conn.insert({
             "entrypoint_id": ep["id"], "entrypoint_name": ep["name"],
@@ -316,7 +359,12 @@ class ServerlessService(ServerlessApi):
             self._spawn(ctx, ep, inv)
             return {"record": self._inv_view(inv), "dry_run": False, "cached": False}
 
-        record = await self._execute(ctx, ep, inv)
+        # sync executions count against max_concurrent too
+        self._task_tenants[inv["id"]] = ctx.tenant_id
+        try:
+            record = await self._execute(ctx, ep, inv)
+        finally:
+            self._task_tenants.pop(inv["id"], None)
         if cache_key and record["status"] == "completed":
             self._response_cache[cache_key] = (
                 time.monotonic() + ep["cache_max_age_seconds"], record)
@@ -325,7 +373,13 @@ class ServerlessService(ServerlessApi):
     def _spawn(self, ctx: SecurityContext, ep: dict, inv: dict) -> None:
         task = asyncio.ensure_future(self._execute(ctx, ep, inv))
         self._tasks[inv["id"]] = task
-        task.add_done_callback(lambda t: self._tasks.pop(inv["id"], None))
+        self._task_tenants[inv["id"]] = ctx.tenant_id
+
+        def _done(t) -> None:
+            self._tasks.pop(inv["id"], None)
+            self._task_tenants.pop(inv["id"], None)
+
+        task.add_done_callback(_done)
 
     async def _execute(self, ctx: SecurityContext, ep: dict, inv: dict) -> dict:
         conn = self._db.secure(ctx, INVOCATIONS)
@@ -385,12 +439,24 @@ class ServerlessService(ServerlessApi):
         # workflow: sequential steps; ``$prev`` references the previous result;
         # suspension honored between steps; a step failure runs COMPENSATIONS of
         # completed steps in reverse order (saga semantics, serverless PRD:
-        # compensation/saga + CompensationContext)
-        prev: Any = None
-        results = []
-        completed: list[tuple[dict, Any]] = []  # (step def, its result)
+        # compensation/saga + CompensationContext). Progress is CHECKPOINTED to
+        # the invocation row after every step, so resume — in this process
+        # life or after a host restart — continues from the next step instead
+        # of replaying completed ones (durable execution, PRD RTO <= 30s).
+        conn = self._db.secure(ctx, INVOCATIONS)
         steps = definition.get("steps", [])
-        for i, step in enumerate(steps):
+        row = conn.get(inv_id) or {}
+        cp = row.get("checkpoint") or {}
+        start_step = int(cp.get("next_step", 0))
+        results: list[Any] = list(cp.get("results") or [])[:start_step]
+        prev: Any = results[-1] if results else None
+        completed: list[tuple[dict, Any]] = [
+            (steps[i], results[i]) for i in range(min(start_step, len(steps)))]
+        if start_step:
+            timeline.append(self._evt(
+                "resumed_from_checkpoint", f"step {start_step}"))
+        for i in range(start_step, len(steps)):
+            step = steps[i]
             gate = self._suspended.get(inv_id)
             if gate is not None:
                 raise _Suspended()
@@ -407,10 +473,16 @@ class ServerlessService(ServerlessApi):
             except Exception as e:  # noqa: BLE001 — trigger the saga rollback
                 timeline.append(self._evt("step_failed", f"{name}: {e}"[:300]))
                 await self._compensate(ctx, completed, timeline)
+                conn.update(inv_id, {"checkpoint": None})  # saga rolled back
                 raise
             results.append(_jsonable(prev))
             completed.append((step, prev))
             timeline.append(self._evt("step_completed", name))
+            # cumulative-results rewrite is O(steps x result size); workflows
+            # with large per-step payloads should pass references (file-storage
+            # urls), not bodies — the ADR's media-by-reference convention
+            conn.update(inv_id, {"checkpoint": {
+                "next_step": i + 1, "results": results}, "timeline": timeline})
         return {"steps": results, "output": _jsonable(prev)}
 
     async def _compensate(self, ctx: SecurityContext,
@@ -533,14 +605,41 @@ class ServerlessService(ServerlessApi):
         if every < 0.05:
             raise ProblemError.bad_request("every_seconds must be >= 0.05")
         policy = spec.get("missed_run_policy", "skip")
-        if policy not in ("skip", "catch_up"):
-            raise ProblemError.bad_request("missed_run_policy must be skip|catch_up")
+        if policy not in ("skip", "catch_up", "backfill"):
+            raise ProblemError.bad_request(
+                "missed_run_policy must be skip|catch_up|backfill")
         conn = self._db.secure(ctx, SCHEDULES)
         return conn.insert({
             "entrypoint_name": spec["entrypoint"], "every_seconds": every,
             "params": spec.get("params") or {}, "missed_run_policy": policy,
             "enabled": True, "next_fire_at": time.time() + every,
         })
+
+    async def recover_on_start(self) -> int:
+        """Crash recovery (PRD RTO <= 30 s): invocations left 'running' or
+        'pending' by a dead host respawn from their checkpoint; 'suspended'
+        rows stay parked until an explicit resume (suspensions survive >= 30
+        days by being nothing but a DB row). Returns the respawn count."""
+        sysctx = SecurityContext.system()
+        conn = self._db.secure(sysctx, INVOCATIONS)
+        recovered = 0
+        for row in conn.select(where={"status": "running"}) + \
+                conn.select(where={"status": "pending"}):
+            if row["id"] in self._tasks:
+                continue  # owned by this process (not a crash leftover)
+            tenant_ctx = SecurityContext.anonymous(row["tenant_id"])
+            try:
+                ep = self._resolve_ep(tenant_ctx, row["entrypoint_name"],
+                                      row["version"], any_status=True)
+            except ProblemError:
+                continue
+            timeline = list(row.get("timeline") or [])
+            timeline.append(self._evt("recovered", "host restart"))
+            conn.update(row["id"], {"timeline": timeline})
+            fresh = conn.get(row["id"])
+            self._spawn(tenant_ctx, ep, fresh)
+            recovered += 1
+        return recovered
 
     async def scheduler_tick(self) -> int:
         """Fire due schedules; returns count fired. Driven by the module's
@@ -554,19 +653,38 @@ class ServerlessService(ServerlessApi):
                 continue
             tenant_ctx = SecurityContext.anonymous(sched["tenant_id"])
             missed = 0
-            nxt = sched["next_fire_at"] or now
+            first_missed = sched["next_fire_at"] or now
+            nxt = first_missed
             while nxt <= now:
                 nxt += sched["every_seconds"]
                 missed += 1
-            runs = missed if sched["missed_run_policy"] == "catch_up" else 1
-            for _ in range(min(runs, 10)):  # catch-up burst cap
+            policy = sched["missed_run_policy"]
+            runs = missed if policy in ("catch_up", "backfill") else 1
+            done = 0
+            for j in range(min(runs, 10)):  # per-tick burst cap
+                params = dict(sched.get("params") or {})
+                if policy == "backfill":
+                    # each missed occurrence runs with ITS scheduled time, so
+                    # time-partitioned work processes the right window
+                    params["scheduled_for"] = first_missed + j * sched["every_seconds"]
                 try:
                     await self.start_invocation(tenant_ctx, {
                         "entrypoint": sched["entrypoint_name"],
-                        "params": sched.get("params") or {}, "mode": "async"})
+                        "params": params, "mode": "async"})
                     fired += 1
+                    done += 1
                 except ProblemError:
                     break
+            if policy in ("catch_up", "backfill") and done < runs:
+                # windows beyond the burst cap (or past a quota rejection) are
+                # DEFERRED, not dropped: next_fire_at stays at the first
+                # unprocessed occurrence so the next tick continues the backlog
+                nxt = first_missed + done * sched["every_seconds"]
+                import logging
+
+                logging.getLogger("serverless").info(
+                    "schedule %s: %d missed run(s) deferred to next tick",
+                    sched["id"], runs - done)
             conn.update(sched["id"], {"next_fire_at": nxt, "last_fired_at": now})
         return fired
 
@@ -619,6 +737,19 @@ class ServerlessRuntimeModule(Module, DatabaseCapability, RestApiCapability,
         svc = self.service
         assert svc is not None
         token = ctx.cancellation_token
+
+        try:
+            recovered = await svc.recover_on_start()
+            if recovered:
+                import logging
+
+                logging.getLogger("serverless").info(
+                    "recovered %d interrupted invocation(s) after restart",
+                    recovered)
+        except Exception:  # noqa: BLE001 — recovery must not block startup
+            import logging
+
+            logging.getLogger("serverless").exception("crash recovery failed")
 
         async def loop() -> None:
             while not token.is_cancelled:

@@ -38,7 +38,7 @@ def _resolve(path: Path, level: int, module: str) -> str:
     if level == 0:
         return module
     parts = path.relative_to(PKG.parent).with_suffix("").parts
-    base = list(parts[:-1]) if path.name != "__init__.py" else list(parts[:-1])
+    base = list(parts[:-1])
     up = base[: len(base) - (level - 1)] if level > 1 else base
     return ".".join(up + ([module] if module else []))
 
