@@ -33,7 +33,8 @@ MODELS = ScopableEntity(
         "approval_state": "approval_state", "managed": "managed",
         "architecture": "architecture", "size_bytes": "size_bytes",
         "format": "format", "checkpoint_path": "checkpoint_path",
-        "engine_options": "engine_options", "created_at": "created_at",
+        "engine_options": "engine_options", "shadowable": "shadowable",
+        "created_at": "created_at",
     },
     json_cols=("capabilities", "limits", "cost", "engine_options"),
 )
@@ -75,13 +76,24 @@ def _migrate_0001(c):
     )
 
 
-_MIGRATIONS = [Migration("0001_models", _migrate_0001)]
+def _migrate_0002(c):
+    # tenant-hierarchy inheritance: a parent's model may forbid child
+    # tenants from shadowing it (PRD.md:179-190 disable-shadowing)
+    c.execute("ALTER TABLE models ADD COLUMN shadowable INTEGER DEFAULT 1")
+
+
+_MIGRATIONS = [Migration("0001_models", _migrate_0001),
+               Migration("0002_shadowable", _migrate_0002)]
 
 
 class ModelRegistryService(ModelRegistryApi):
     def __init__(self, ctx: ModuleCtx) -> None:
         self._ctx = ctx
         self._db = ctx.db_required()
+        from .sdk import TenantResolverApi
+
+        #: tenant hierarchy for provider/model inheritance (PRD.md:179-190)
+        self._tenants = ctx.client_hub.try_get(TenantResolverApi)
         # read-through resolution cache: (tenant, name) -> (ModelInfo, expiry)
         self._cache: dict[tuple[str, str], tuple[ModelInfo, float]] = {}
         self._cache_ttl = 5.0
@@ -115,12 +127,23 @@ class ModelRegistryService(ModelRegistryApi):
         return self._provider_health.get(slug, "healthy")
 
     # ------------------------------------------------------------- write side
-    def register_model(self, ctx: SecurityContext, spec: dict[str, Any]) -> ModelInfo:
+    async def register_model(self, ctx: SecurityContext,
+                             spec: dict[str, Any]) -> ModelInfo:
         required = ("provider_slug", "provider_model_id")
         missing = [k for k in required if not spec.get(k)]
         if missing:
             raise ProblemError.bad_request(f"missing fields: {missing}")
         canonical = f"{spec['provider_slug']}::{spec['provider_model_id']}"
+        # disable-shadowing (PRD.md:179-190) is enforced HERE, not in a REST
+        # wrapper, so seeding and SDK callers cannot bypass it
+        for ancestor in await self._ancestors_of(ctx.tenant_id):
+            anc_row = self._conn_for(ancestor, MODELS).find_one(
+                {"canonical_id": canonical})
+            if anc_row is not None and not anc_row.get("shadowable", True):
+                raise ProblemError.conflict(
+                    f"model {canonical} is defined by ancestor tenant "
+                    f"{ancestor!r} with shadowing disabled",
+                    code="shadowing_disabled")
         default_approval = "approved" if self._auto_approved(spec) else "pending"
         row = {
             "provider_slug": spec["provider_slug"],
@@ -139,12 +162,19 @@ class ModelRegistryService(ModelRegistryApi):
             "checkpoint_path": spec.get("checkpoint_path"),
             "engine_options": spec.get("engine_options", {}),
         }
+        row["shadowable"] = bool(spec.get("shadowable", True))
         conn = self._db.secure(ctx, MODELS)
         if conn.find_one({"canonical_id": canonical}):
             raise ProblemError.conflict(f"model {canonical} already registered")
         created = conn.insert(row)
-        self._invalidate(ctx.tenant_id)
+        self._invalidate_all()
         return self._to_info(created)
+
+    async def _ancestors_of(self, tenant_id: str) -> list[str]:
+        if self._tenants is None:
+            return []
+        chain = await self._tenants.walk_up(tenant_id)
+        return chain[1:]  # exclude the tenant itself
 
     def set_approval(self, ctx: SecurityContext, canonical_id: str, new_state: str) -> ModelInfo:
         conn = self._db.secure(ctx, MODELS)
@@ -159,7 +189,7 @@ class ModelRegistryService(ModelRegistryApi):
                 code="invalid_transition",
             )
         conn.update(row["id"], {"approval_state": new_state})
-        self._invalidate(ctx.tenant_id)
+        self._invalidate_all()
         row["approval_state"] = new_state
         return self._to_info(row)
 
@@ -170,10 +200,12 @@ class ModelRegistryService(ModelRegistryApi):
             conn.update(existing["id"], {"target": target})
         else:
             conn.insert({"alias": alias, "target": target})
-        self._invalidate(ctx.tenant_id)
+        self._invalidate_all()
 
-    def _invalidate(self, tenant_id: str) -> None:
-        self._cache = {k: v for k, v in self._cache.items() if k[0] != tenant_id}
+    def _invalidate_all(self) -> None:
+        # inheritance makes a parent's writes visible to every descendant —
+        # clear the whole cache (TTL is 5 s; the p99 bar holds regardless)
+        self._cache.clear()
 
     # ------------------------------------------------------------- read side
     async def resolve(self, ctx: SecurityContext, name: str) -> ModelInfo:
@@ -181,30 +213,72 @@ class ModelRegistryService(ModelRegistryApi):
         hit = self._cache.get(key)
         if hit and hit[1] > time.monotonic():
             return hit[0]
-        info = self._resolve_uncached(ctx, name)
+        chain = [ctx.tenant_id] + await self._ancestors_of(ctx.tenant_id)
+        info = self._resolve_uncached(ctx, name, chain)
         self._cache[key] = (info, time.monotonic() + self._cache_ttl)
         return info
 
-    def _resolve_uncached(self, ctx: SecurityContext, name: str) -> ModelInfo:
-        alias_conn = self._db.secure(ctx, ALIASES)
-        conn = self._db.secure(ctx, MODELS)
-        # alias chain (PRD.md:298-306), cycle-guarded
+    def _conn_for(self, tenant_id: str, entity):
+        return self._db.secure(SecurityContext.anonymous(tenant_id), entity)
+
+    def _resolve_uncached(self, ctx: SecurityContext, name: str,
+                          chain: Optional[list[str]] = None) -> ModelInfo:
+        """Resolution down the tenant hierarchy (PRD.md:179-190): the chain is
+        [tenant, parent, ..., root]; the NEAREST tenant's definition wins
+        (shadowing), unless an ancestor above it marks the same canonical id
+        non-shadowable — then that ancestor's definition is authoritative."""
+        chain = chain or [ctx.tenant_id]
+        # alias chain (PRD.md:298-306), cycle-guarded; aliases inherit too —
+        # the nearest tenant defining the alias wins at each hop
         seen: set[str] = set()
         target = name
         for _ in range(8):
             if target in seen:
                 raise ProblemError.conflict(f"alias cycle at {target!r}", code="alias_cycle")
             seen.add(target)
-            alias_row = alias_conn.find_one({"alias": target})
+            alias_row = None
+            alias_level = -1
+            for i, t in enumerate(chain):
+                alias_row = self._conn_for(t, ALIASES).find_one({"alias": target})
+                if alias_row is not None:
+                    alias_level = i
+                    break
             if alias_row is None:
                 break
+            # an alias must not reroute a name an ANCESTOR (above the alias's
+            # tenant) pins with shadowing disabled — the model wins
+            pinned = any(
+                (r := self._conn_for(t, MODELS).find_one(
+                    {"canonical_id": target})) is not None
+                and not r.get("shadowable", True)
+                for t in chain[alias_level + 1:])
+            if pinned:
+                break
             target = alias_row["target"]
-        row = conn.find_one({"canonical_id": target})
+
+        # per-tenant hits in chain order (index 0 = nearest)
+        hits: list[tuple[int, dict]] = []
+        for i, t in enumerate(chain):
+            r = self._conn_for(t, MODELS).find_one({"canonical_id": target})
+            if r is not None:
+                hits.append((i, r))
+        row = hits[0][1] if hits else None
+        if row is not None and len(hits) > 1:
+            for i, r in hits[1:]:
+                if not r.get("shadowable", True):
+                    row = r  # disable-shadowing: nearest such ancestor rules
+                    break
         if row is None:
             # convenience: bare provider_model_id resolves if unambiguous
-            candidates = conn.select(where={"provider_model_id": target})
-            if len(candidates) == 1:
-                row = candidates[0]
+            # within the nearest tenant that has any candidates
+            for t in chain:
+                candidates = self._conn_for(t, MODELS).select(
+                    where={"provider_model_id": target})
+                if len(candidates) == 1:
+                    row = candidates[0]
+                    break
+                if candidates:
+                    break  # ambiguous at this level — do not guess
         if row is None:
             raise ProblemError.not_found(f"model {name!r} not found", code="model_not_found")
         if row["approval_state"] != "approved":
@@ -250,7 +324,8 @@ class ModelRegistryService(ModelRegistryApi):
         )
 
 
-@module(name="model_registry", capabilities=["db", "rest"])
+@module(name="model_registry", deps=["tenant_resolver"],
+        capabilities=["db", "rest"])
 class ModelRegistryModule(Module, DatabaseCapability, RestApiCapability):
     """Module wiring: seeds config-declared models at init (quickstart pattern)."""
 
@@ -268,7 +343,7 @@ class ModelRegistryModule(Module, DatabaseCapability, RestApiCapability):
             ctx.raw_config().get("seed_tenant", "default"))
         for spec in ctx.raw_config().get("models", []):
             try:
-                self.service.register_model(seed_ctx, dict(spec))
+                await self.service.register_model(seed_ctx, dict(spec))
             except ProblemError as e:
                 if e.problem.status != 409:  # idempotent restarts
                     raise
@@ -295,7 +370,7 @@ class ModelRegistryModule(Module, DatabaseCapability, RestApiCapability):
 
         async def register_model(request: web.Request):
             body = await read_json(request)
-            info = svc.register_model(request[SECURITY_CONTEXT_KEY], body)
+            info = await svc.register_model(request[SECURITY_CONTEXT_KEY], body)
             return info.to_dict(), 201
 
         async def get_model(request: web.Request):

@@ -631,7 +631,16 @@ class ServerlessService(ServerlessApi):
             try:
                 ep = self._resolve_ep(tenant_ctx, row["entrypoint_name"],
                                       row["version"], any_status=True)
-            except ProblemError:
+            except ProblemError as e:
+                # the entrypoint is gone: dead-letter the invocation so it
+                # does not read as 'running' forever (and stop re-scanning it)
+                timeline = list(row.get("timeline") or [])
+                timeline.append(self._evt(
+                    "dead_letter", f"unrecoverable: {e.problem.detail}"[:300]))
+                conn.update(row["id"], {
+                    "status": "failed", "timeline": timeline,
+                    "error": {"detail": "entrypoint unresolvable after "
+                                        "restart"}})
                 continue
             timeline = list(row.get("timeline") or [])
             timeline.append(self._evt("recovered", "host restart"))
@@ -658,6 +667,17 @@ class ServerlessService(ServerlessApi):
             while nxt <= now:
                 nxt += sched["every_seconds"]
                 missed += 1
+            if missed > 100:
+                # bound the backlog a dead/paused entrypoint can accumulate:
+                # occurrences older than 100 windows are DROPPED (logged once)
+                dropped = missed - 100
+                first_missed += dropped * sched["every_seconds"]
+                missed = 100
+                import logging
+
+                logging.getLogger("serverless").warning(
+                    "schedule %s: dropped %d missed occurrence(s) beyond the "
+                    "backlog cap", sched["id"], dropped)
             policy = sched["missed_run_policy"]
             runs = missed if policy in ("catch_up", "backfill") else 1
             done = 0
@@ -665,8 +685,11 @@ class ServerlessService(ServerlessApi):
                 params = dict(sched.get("params") or {})
                 if policy == "backfill":
                     # each missed occurrence runs with ITS scheduled time, so
-                    # time-partitioned work processes the right window
-                    params["scheduled_for"] = first_missed + j * sched["every_seconds"]
+                    # time-partitioned work processes the right window (a
+                    # user-configured scheduled_for param is left untouched)
+                    params.setdefault(
+                        "scheduled_for",
+                        first_missed + j * sched["every_seconds"])
                 try:
                     await self.start_invocation(tenant_ctx, {
                         "entrypoint": sched["entrypoint_name"],
@@ -678,13 +701,9 @@ class ServerlessService(ServerlessApi):
             if policy in ("catch_up", "backfill") and done < runs:
                 # windows beyond the burst cap (or past a quota rejection) are
                 # DEFERRED, not dropped: next_fire_at stays at the first
-                # unprocessed occurrence so the next tick continues the backlog
+                # unprocessed occurrence so the next tick continues the
+                # backlog (bounded by the 100-window cap above)
                 nxt = first_missed + done * sched["every_seconds"]
-                import logging
-
-                logging.getLogger("serverless").info(
-                    "schedule %s: %d missed run(s) deferred to next tick",
-                    sched["id"], runs - done)
             conn.update(sched["id"], {"next_fire_at": nxt, "last_fired_at": now})
         return fired
 

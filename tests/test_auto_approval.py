@@ -10,6 +10,11 @@ from cyberfabric_core_tpu.modkit.security import SecurityContext
 from cyberfabric_core_tpu.modules.model_registry import ModelRegistryService, _MIGRATIONS
 
 
+def _reg(svc, ctx, spec):
+    return asyncio.new_event_loop().run_until_complete(
+        svc.register_model(ctx, spec))
+
+
 def make_service(rules):
     cfg = AppConfig.load_or_default(environ={}, cli_overrides={"modules": {
         "model_registry": {"config": {"auto_approval_rules": rules}}}})
@@ -23,17 +28,17 @@ def make_service(rules):
 def test_rules_match_slug_and_prefix():
     svc = make_service([{"provider_slug": "trusted", "model_id_prefix": "llama"}])
     ctx = SecurityContext.anonymous()
-    auto = svc.register_model(ctx, {"provider_slug": "trusted",
+    auto = _reg(svc, ctx, {"provider_slug": "trusted",
                                     "provider_model_id": "llama-3-8b"})
     assert auto.approval_state == "approved"
-    wrong_prefix = svc.register_model(ctx, {"provider_slug": "trusted",
+    wrong_prefix = _reg(svc, ctx, {"provider_slug": "trusted",
                                             "provider_model_id": "gpt-9"})
     assert wrong_prefix.approval_state == "pending"
-    wrong_slug = svc.register_model(ctx, {"provider_slug": "sketchy",
+    wrong_slug = _reg(svc, ctx, {"provider_slug": "sketchy",
                                           "provider_model_id": "llama-3-8b"})
     assert wrong_slug.approval_state == "pending"
     # explicit approval_state always wins over rules
-    explicit = svc.register_model(ctx, {"provider_slug": "trusted",
+    explicit = _reg(svc, ctx, {"provider_slug": "trusted",
                                         "provider_model_id": "llama-held",
                                         "approval_state": "pending"})
     assert explicit.approval_state == "pending"

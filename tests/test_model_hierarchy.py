@@ -1,0 +1,129 @@
+"""Model-registry tenant hierarchy: inheritance, shadowing, disable-shadowing.
+
+Reference: model-registry/docs/PRD.md:179-190 — providers/models inherit down
+the tenant tree; a child may shadow a parent's definition; a parent may
+disable shadowing to stay authoritative.
+"""
+
+import asyncio
+
+import pytest
+
+from cyberfabric_core_tpu.modkit import AppConfig, ClientHub
+from cyberfabric_core_tpu.modkit.cancellation import CancellationToken
+from cyberfabric_core_tpu.modkit.context import ModuleCtx
+from cyberfabric_core_tpu.modkit.db import Database
+from cyberfabric_core_tpu.modkit.errors import ProblemError
+from cyberfabric_core_tpu.modkit.security import SecurityContext
+from cyberfabric_core_tpu.modules.model_registry import (
+    _MIGRATIONS, ModelRegistryService)
+from cyberfabric_core_tpu.modules.resolvers import StaticTenantResolver
+from cyberfabric_core_tpu.modules.sdk import TenantResolverApi
+
+
+@pytest.fixture()
+def svc():
+    db = Database(":memory:")
+    db.run_migrations(_MIGRATIONS)
+    hub = ClientHub()
+    hub.register(TenantResolverApi, StaticTenantResolver(tree={
+        "root": {}, "acme": {"parent": "root"}, "acme-eu": {"parent": "acme"}}))
+    cfg = AppConfig.load_or_default(environ={}, cli_overrides={})
+    ctx = ModuleCtx(module_name="model_registry", app_config=cfg,
+                    client_hub=hub, cancellation_token=CancellationToken(),
+                    db=db)
+    return ModelRegistryService(ctx)
+
+
+def _ctx(tenant):
+    return SecurityContext.anonymous(tenant)
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _reg(svc, ctx, spec):
+    return _run(svc.register_model(ctx, spec))
+
+
+def test_child_inherits_parent_model(svc):
+    _reg(svc, _ctx("root"), {
+        "provider_slug": "openai", "provider_model_id": "gpt-x",
+        "approval_state": "approved", "cost": {"in": 1.0}})
+    # grandchild resolves the root's model without its own registration
+    info = _run(svc.resolve(_ctx("acme-eu"), "openai::gpt-x"))
+    assert info.canonical_id == "openai::gpt-x"
+    assert info.cost == {"in": 1.0}
+    # a sibling tree tenant (unknown) only sees its own rows
+    with pytest.raises(ProblemError):
+        _run(svc.resolve(_ctx("other-root"), "openai::gpt-x"))
+
+
+def test_child_shadows_parent(svc):
+    _reg(svc, _ctx("root"), {
+        "provider_slug": "openai", "provider_model_id": "gpt-x",
+        "approval_state": "approved", "cost": {"in": 1.0}})
+    _reg(svc, _ctx("acme"), {
+        "provider_slug": "openai", "provider_model_id": "gpt-x",
+        "approval_state": "approved", "cost": {"in": 0.5}})
+    # the child's own definition wins for the child and its subtree
+    assert _run(svc.resolve(_ctx("acme"), "openai::gpt-x")).cost == {"in": 0.5}
+    assert _run(svc.resolve(_ctx("acme-eu"), "openai::gpt-x")).cost == {"in": 0.5}
+    # the parent keeps its own
+    assert _run(svc.resolve(_ctx("root"), "openai::gpt-x")).cost == {"in": 1.0}
+
+
+def test_disable_shadowing_blocks_child_registration(svc):
+    _reg(svc, _ctx("root"), {
+        "provider_slug": "gov", "provider_model_id": "audited",
+        "approval_state": "approved", "shadowable": False})
+    with pytest.raises(ProblemError) as e:
+        _reg(svc, _ctx("acme"), {
+            "provider_slug": "gov", "provider_model_id": "audited"})
+    assert e.value.problem.code == "shadowing_disabled"
+
+
+def test_disable_shadowing_overrides_existing_child_row(svc):
+    # child registered first (before the parent flipped the flag)
+    _reg(svc, _ctx("acme"), {
+        "provider_slug": "gov", "provider_model_id": "audited",
+        "approval_state": "approved", "cost": {"in": 9.0}})
+    _reg(svc, _ctx("root"), {
+        "provider_slug": "gov", "provider_model_id": "audited",
+        "approval_state": "approved", "shadowable": False,
+        "cost": {"in": 2.0}})
+    # resolution prefers the non-shadowable ancestor over the child's row
+    assert _run(svc.resolve(_ctx("acme"), "gov::audited")).cost == {"in": 2.0}
+
+
+def test_alias_inheritance(svc):
+    _reg(svc, _ctx("root"), {
+        "provider_slug": "openai", "provider_model_id": "gpt-x",
+        "approval_state": "approved"})
+    svc.set_alias(_ctx("root"), "default-chat", "openai::gpt-x")
+    info = _run(svc.resolve(_ctx("acme-eu"), "default-chat"))
+    assert info.canonical_id == "openai::gpt-x"
+    # a child's alias shadows the parent's
+    _reg(svc, _ctx("acme"), {
+        "provider_slug": "local", "provider_model_id": "tiny",
+        "approval_state": "approved"})
+    svc.set_alias(_ctx("acme"), "default-chat", "local::tiny")
+    assert _run(svc.resolve(_ctx("acme"), "default-chat")).canonical_id == "local::tiny"
+    assert _run(svc.resolve(_ctx("root"), "default-chat")).canonical_id == "openai::gpt-x"
+
+
+def test_alias_cannot_bypass_disable_shadowing(svc):
+    """A child alias named exactly like an ancestor's non-shadowable canonical
+    id must NOT reroute resolution (review finding: alias bypass)."""
+    _reg(svc, _ctx("root"), {
+        "provider_slug": "gov", "provider_model_id": "audited",
+        "approval_state": "approved", "shadowable": False,
+        "cost": {"in": 2.0}})
+    _reg(svc, _ctx("acme"), {
+        "provider_slug": "local", "provider_model_id": "other",
+        "approval_state": "approved", "cost": {"in": 0.1}})
+    svc.set_alias(_ctx("acme"), "gov::audited", "local::other")
+    info = _run(svc.resolve(_ctx("acme"), "gov::audited"))
+    assert info.canonical_id == "gov::audited"
+    assert info.cost == {"in": 2.0}
