@@ -72,6 +72,12 @@ class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemC
         raw = ctx.raw_config()
         self.config = GatewayConfig(**raw) if raw else GatewayConfig()
         self._hub = ctx.client_hub
+        # app-level tracing section: sampler + optional OTLP/HTTP export
+        tracing_cfg = dict(ctx.app_config.section("tracing"))
+        if tracing_cfg:
+            from ..modkit.telemetry import tracer_from_config
+
+            self.tracer = tracer_from_config(tracing_cfg)
 
     # ------------------------------------------------------------- rest host
     def rest_prepare(self, ctx: ModuleCtx) -> tuple[RestRouter, OpenApiRegistry]:
@@ -179,6 +185,10 @@ class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemC
             await self._runner.cleanup()
             self._runner = None
             self._site = None
+        # ship buffered spans before the exporter's daemon thread dies
+        shutdown = getattr(self.tracer.exporter, "shutdown", None)
+        if callable(shutdown):
+            shutdown()
 
 
 def _wrap_handler(spec: OperationSpec):

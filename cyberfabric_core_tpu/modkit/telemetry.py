@@ -39,6 +39,7 @@ class Span:
     parent_id: Optional[str] = None
     attributes: dict[str, Any] = field(default_factory=dict)
     start_ns: int = field(default_factory=time.monotonic_ns)
+    start_unix_ns: int = field(default_factory=time.time_ns)
     status: str = "ok"
     sampled: bool = True
 
@@ -108,6 +109,139 @@ class Tracer:
         return _current_span.get()
 
 
+class OtlpHttpExporter(SpanExporter):
+    """OTLP/HTTP JSON span exporter (reference: telemetry/init.rs builds OTLP
+    gRPC/HTTP exporters; this speaks the standard OTLP/HTTP JSON encoding to
+    any collector's 4318 endpoint).
+
+    Spans are buffered and shipped from a daemon thread — span exit never
+    blocks on the network; a dead collector drops batches with a throttled
+    warning (availability over telemetry)."""
+
+    def __init__(self, endpoint: str, service_name: str = "tpu-fabric",
+                 flush_interval_s: float = 2.0, max_batch: int = 256,
+                 max_buffer: int = 4096) -> None:
+        import queue
+        import threading
+
+        self.endpoint = endpoint.rstrip("/")
+        if not self.endpoint.endswith("/v1/traces"):
+            self.endpoint += "/v1/traces"
+        self.service_name = service_name
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max_batch
+        self._queue: "queue.Queue[dict]" = queue.Queue(maxsize=max_buffer)
+        self._throttle = ThrottledLog(30.0)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="otlp-exporter")
+        self._thread.start()
+
+    # -------------------------------------------------------------- encoding
+    @staticmethod
+    def _attr(key: str, value: Any) -> dict:
+        if isinstance(value, bool):
+            return {"key": key, "value": {"boolValue": value}}
+        if isinstance(value, int):
+            return {"key": key, "value": {"intValue": str(value)}}
+        if isinstance(value, float):
+            return {"key": key, "value": {"doubleValue": value}}
+        return {"key": key, "value": {"stringValue": str(value)}}
+
+    def _encode(self, span: Span, duration_ms: float) -> dict:
+        end_ns = span.start_unix_ns + int(duration_ms * 1e6)
+        out = {
+            "traceId": span.trace_id,
+            "spanId": span.span_id,
+            "name": span.name,
+            "kind": 2,  # SERVER
+            "startTimeUnixNano": str(span.start_unix_ns),
+            "endTimeUnixNano": str(end_ns),
+            "attributes": [self._attr(k, v) for k, v in span.attributes.items()],
+            "status": {"code": 2 if span.status == "error" else 1},
+        }
+        if span.parent_id:
+            out["parentSpanId"] = span.parent_id
+        return out
+
+    def export(self, span: Span, duration_ms: float) -> None:
+        try:
+            self._queue.put_nowait(self._encode(span, duration_ms))
+        except Exception:  # noqa: BLE001 — full buffer: drop, never block
+            if self._throttle.should_log("buffer_full"):
+                logger.warning("OTLP span buffer full; dropping spans")
+
+    # -------------------------------------------------------------- shipping
+    def _drain(self) -> list[dict]:
+        import queue
+
+        batch: list[dict] = []
+        while len(batch) < self.max_batch:
+            try:
+                batch.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        return batch
+
+    def _post(self, batch: list[dict]) -> None:
+        import json as _json
+        import urllib.request
+
+        payload = _json.dumps({"resourceSpans": [{
+            "resource": {"attributes": [
+                self._attr("service.name", self.service_name)]},
+            "scopeSpans": [{"scope": {"name": "cyberfabric_core_tpu"},
+                            "spans": batch}],
+        }]}).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=payload,
+            headers={"Content-Type": "application/json"}, method="POST")
+        urllib.request.urlopen(req, timeout=10)  # noqa: S310 — operator-set URL
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(self.flush_interval_s)
+            batch = self._drain()
+            if not batch:
+                continue
+            try:
+                self._post(batch)
+            except Exception as e:  # noqa: BLE001 — collector down
+                if self._throttle.should_log("post_failed"):
+                    logger.warning("OTLP export failed (%d spans dropped): %s",
+                                   len(batch), e)
+
+    def flush(self, timeout_s: float = 5.0) -> None:
+        """Synchronously ship whatever is buffered (tests/shutdown)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            batch = self._drain()
+            if not batch:
+                return
+            try:
+                self._post(batch)
+            except Exception:  # noqa: BLE001
+                return
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.flush(timeout_s=2.0)
+
+
+def tracer_from_config(cfg: dict) -> Tracer:
+    """Build the tracer from the app-level ``tracing`` config section:
+    {enabled, sample_ratio, otlp_endpoint?, service_name?}. Without an
+    otlp_endpoint, spans export to the structured log stream."""
+    exporter: Optional[SpanExporter] = None
+    endpoint = cfg.get("otlp_endpoint")
+    if endpoint:
+        exporter = OtlpHttpExporter(
+            endpoint, service_name=cfg.get("service_name", "tpu-fabric"))
+    return Tracer(enabled=bool(cfg.get("enabled", True)),
+                  sample_ratio=float(cfg.get("sample_ratio", 1.0)),
+                  exporter=exporter)
+
+
 class ThrottledLog:
     """Log at most once per ``interval`` seconds per key (throttled_log.rs)."""
 
@@ -134,3 +268,29 @@ def device_profile(name: str, enabled: bool = False, logdir: str = "/tmp/jax-tra
     with jax.profiler.trace(logdir):
         with jax.profiler.TraceAnnotation(name):
             yield
+
+
+def xla_cost_summary(compiled) -> dict[str, float]:
+    """Normalize a compiled computation's XLA cost analysis to the few numbers
+    perf work needs (SURVEY §5: jax.profiler traces + XLA cost-analysis dumps
+    are the device-side counterpart of OTel host spans).
+
+    Returns {} when the backend exposes no cost model (e.g. interpret mode)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — backend without a cost model
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    out: dict[str, float] = {}
+    for key in ("flops", "bytes accessed", "transcendentals",
+                "utilization operand 0 {}", "optimal_seconds"):
+        if key in ca:
+            out[key.replace(" ", "_")] = float(ca[key])
+    # keep any hbm-ish byte counters the backend reports
+    for k, v in ca.items():
+        if "bytes accessed" in k and k != "bytes accessed":
+            out[k.replace(" ", "_")] = float(v)
+    return out

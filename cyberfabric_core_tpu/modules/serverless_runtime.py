@@ -161,6 +161,9 @@ class ServerlessService(ServerlessApi):
         self._policies: dict[str, dict] = dict(
             ctx.raw_config().get("tenant_policies") or {})
         self._rate_windows: dict[str, list[float]] = {}
+        from ..modkit.telemetry import ThrottledLog
+
+        self._backlog_log = ThrottledLog(30.0)
         self._register_builtins()
 
     def _policy_for(self, tenant_id: str) -> dict:
@@ -669,15 +672,17 @@ class ServerlessService(ServerlessApi):
                 missed += 1
             if missed > 100:
                 # bound the backlog a dead/paused entrypoint can accumulate:
-                # occurrences older than 100 windows are DROPPED (logged once)
+                # occurrences older than 100 windows are DROPPED (warning
+                # throttled — a stuck schedule re-hits this every tick)
                 dropped = missed - 100
                 first_missed += dropped * sched["every_seconds"]
                 missed = 100
-                import logging
+                if self._backlog_log.should_log(sched["id"]):
+                    import logging
 
-                logging.getLogger("serverless").warning(
-                    "schedule %s: dropped %d missed occurrence(s) beyond the "
-                    "backlog cap", sched["id"], dropped)
+                    logging.getLogger("serverless").warning(
+                        "schedule %s: dropped %d missed occurrence(s) beyond "
+                        "the backlog cap", sched["id"], dropped)
             policy = sched["missed_run_policy"]
             runs = missed if policy in ("catch_up", "backfill") else 1
             done = 0

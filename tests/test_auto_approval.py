@@ -11,8 +11,7 @@ from cyberfabric_core_tpu.modules.model_registry import ModelRegistryService, _M
 
 
 def _reg(svc, ctx, spec):
-    return asyncio.new_event_loop().run_until_complete(
-        svc.register_model(ctx, spec))
+    return asyncio.run(svc.register_model(ctx, spec))
 
 
 def make_service(rules):

@@ -40,7 +40,7 @@ def _ctx(tenant):
 
 
 def _run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 def _reg(svc, ctx, spec):
