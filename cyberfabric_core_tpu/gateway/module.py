@@ -73,7 +73,7 @@ class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemC
         self.config = GatewayConfig(**raw) if raw else GatewayConfig()
         self._hub = ctx.client_hub
         # app-level tracing section: sampler + optional OTLP/HTTP export
-        tracing_cfg = dict(ctx.app_config.section("tracing"))
+        tracing_cfg = dict(ctx.app_config.section("tracing") or {})
         if tracing_cfg:
             from ..modkit.telemetry import tracer_from_config
 
@@ -185,10 +185,13 @@ class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemC
             await self._runner.cleanup()
             self._runner = None
             self._site = None
-        # ship buffered spans before the exporter's daemon thread dies
+        # ship buffered spans before the exporter's daemon thread dies —
+        # off-loop: flush does blocking network I/O
         shutdown = getattr(self.tracer.exporter, "shutdown", None)
         if callable(shutdown):
-            shutdown()
+            import asyncio
+
+            await asyncio.get_running_loop().run_in_executor(None, shutdown)
 
 
 def _wrap_handler(spec: OperationSpec):

@@ -33,6 +33,10 @@ class ModelConfig:
     # mixture-of-experts (0 = dense MLP)
     num_experts: int = 0
     experts_per_token: int = 2
+    #: grouped-dispatch bucket headroom: capacity = ceil(N*K/E) * factor.
+    #: Tokens overflowing an expert's bucket lose that expert's contribution
+    #: (standard capacity semantics); 2.0 makes drops rare at serving loads.
+    moe_capacity_factor: float = 2.0
     # bert-family extras
     layer_norm_eps: float = 1e-12
     type_vocab_size: int = 2

@@ -183,7 +183,7 @@ class OtlpHttpExporter(SpanExporter):
                 break
         return batch
 
-    def _post(self, batch: list[dict]) -> None:
+    def _post(self, batch: list[dict], timeout_s: float = 10.0) -> None:
         import json as _json
         import urllib.request
 
@@ -196,7 +196,7 @@ class OtlpHttpExporter(SpanExporter):
         req = urllib.request.Request(
             self.endpoint, data=payload,
             headers={"Content-Type": "application/json"}, method="POST")
-        urllib.request.urlopen(req, timeout=10)  # noqa: S310 — operator-set URL
+        urllib.request.urlopen(req, timeout=max(0.1, timeout_s))  # noqa: S310
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -212,14 +212,19 @@ class OtlpHttpExporter(SpanExporter):
                                    len(batch), e)
 
     def flush(self, timeout_s: float = 5.0) -> None:
-        """Synchronously ship whatever is buffered (tests/shutdown)."""
+        """Synchronously ship whatever is buffered (tests/shutdown). The
+        network timeout is bounded by the remaining flush budget so flush can
+        never overrun its deadline on a blackholed collector."""
         deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
             batch = self._drain()
             if not batch:
                 return
             try:
-                self._post(batch)
+                self._post(batch, timeout_s=remaining)
             except Exception:  # noqa: BLE001
                 return
 

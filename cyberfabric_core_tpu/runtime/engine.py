@@ -238,24 +238,29 @@ class InferenceEngine:
     def decode_cost_analysis(self, batch: Optional[int] = None) -> dict:
         """XLA cost analysis of one fused decode chunk (SURVEY §5 device-side
         profiling): flops + bytes per chunk, and per-token derived numbers —
-        the roofline inputs for tokens/sec work. Compiles the decode program
-        for ``batch`` rows (default max_batch) if not already cached."""
+        the roofline inputs for tokens/sec work. The AOT-compiled program is
+        cached per batch size (lower().compile() bypasses the jit cache)."""
         from ..modkit.telemetry import xla_cost_summary
 
         B = batch or self.config.max_batch
-        cfg = self.model_config
-        # abstract avals only — lowering must not allocate a second KV cache
-        # on a device already holding the live one
-        sds = jax.ShapeDtypeStruct
-        cache_aval = sds((cfg.num_layers, B, self.config.max_seq_len,
-                          cfg.num_kv_heads, cfg.head_dim), self.dtype)
-        params_avals = jax.tree.map(
-            lambda a: sds(jnp.shape(a), jnp.asarray(a).dtype), self.params)
-        args = (params_avals, cache_aval, cache_aval,
-                sds((B,), jnp.int32), sds((B,), jnp.int32),
-                sds((2,), jnp.uint32), sds((B,), jnp.float32),
-                sds((B,), jnp.float32), sds((B,), jnp.int32))
-        compiled = self._decode_fn.lower(*args).compile()
+        if not hasattr(self, "_cost_compiled"):
+            self._cost_compiled: dict[int, Any] = {}
+        compiled = self._cost_compiled.get(B)
+        if compiled is None:
+            cfg = self.model_config
+            # abstract avals only — lowering must not allocate a second KV
+            # cache on a device already holding the live one
+            sds = jax.ShapeDtypeStruct
+            cache_aval = sds((cfg.num_layers, B, self.config.max_seq_len,
+                              cfg.num_kv_heads, cfg.head_dim), self.dtype)
+            params_avals = jax.tree.map(
+                lambda a: sds(jnp.shape(a), jnp.asarray(a).dtype), self.params)
+            args = (params_avals, cache_aval, cache_aval,
+                    sds((B,), jnp.int32), sds((B,), jnp.int32),
+                    sds((2,), jnp.uint32), sds((B,), jnp.float32),
+                    sds((B,), jnp.float32), sds((B,), jnp.int32))
+            compiled = self._decode_fn.lower(*args).compile()
+            self._cost_compiled[B] = compiled
         out = xla_cost_summary(compiled)
         k = max(1, self.config.decode_chunk)
         if "flops" in out:
