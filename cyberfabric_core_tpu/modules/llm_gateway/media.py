@@ -1,0 +1,156 @@
+"""Non-text modalities: image generation, TTS, STT (llm-gateway PRD FRs).
+
+Reference flow (PRD.md:104-311 image/audio FRs; ADR-0003 media-via-FileStorage):
+the gateway translates, the PROVIDER computes — exactly as the reference
+delegates all media generation to external providers through OAGW. Managed
+(local TPU) models currently serve chat + embeddings; media requests against a
+managed model return 501 with a clear problem rather than pretending.
+
+- image generation → provider ``images/generations`` (OpenAI dialect),
+  b64 payloads are stored into file-storage and returned as platform URLs
+  (ADR-0003: generated media never travels inline past the gateway);
+- TTS → provider ``audio/speech`` → audio bytes → file-storage URL;
+- STT → provider ``audio/transcriptions`` (multipart) → text.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+from typing import Any, Optional
+
+import aiohttp
+
+from ...modkit.errors import Problem, ProblemError
+from ...modkit.security import SecurityContext
+from ..sdk import FileStorageApi, ModelInfo, OagwApi
+
+logger = logging.getLogger("llm_media")
+
+
+def _managed_unsupported(model: ModelInfo, what: str) -> ProblemError:
+    return ProblemError(Problem(
+        status=501, title="Not Implemented", code="modality_not_implemented",
+        detail=f"managed model {model.canonical_id} does not serve {what}; "
+               f"register a provider-backed model for this modality"))
+
+
+def _require_capability(model: ModelInfo, flag: str, what: str) -> None:
+    if model.capabilities and not model.capabilities.get(flag, False):
+        raise ProblemError(Problem(
+            status=409, title="Conflict", code="capability_missing",
+            detail=f"model {model.canonical_id} does not declare the "
+                   f"{flag} capability required for {what}"))
+
+
+class MediaAdapter:
+    """Provider-backed media operations through the OAGW data-plane seam."""
+
+    def __init__(self, oagw: OagwApi, storage: Optional[FileStorageApi]) -> None:
+        self._oagw = oagw
+        self._storage = storage
+
+    async def _provider_call(self, ctx: SecurityContext, model: ModelInfo,
+                             path: str, *, json_body: Any = None,
+                             data: Any = None, raw: bool = False):
+        """One provider POST with shared error mapping; ``raw`` returns the
+        body bytes (audio), otherwise parsed JSON."""
+        try:
+            async with self._oagw.open_upstream_stream(
+                ctx, model.provider_slug, path, method="POST",
+                json_body=json_body, data=data,
+            ) as resp:
+                if resp.status >= 400:
+                    detail = (await resp.text())[:300]
+                    raise ProblemError(Problem(
+                        status=502, title="Bad Gateway", code="provider_error",
+                        detail=f"provider returned {resp.status}: {detail}"))
+                if raw:
+                    return await resp.read()
+                return await resp.json(content_type=None)
+        except aiohttp.ClientError as e:
+            raise ProblemError(Problem(
+                status=502, title="Bad Gateway", code="provider_unreachable",
+                detail=f"provider {model.provider_slug}: {e}"))
+
+    def _storage_required(self) -> FileStorageApi:
+        if self._storage is None:
+            raise ProblemError(Problem(
+                status=503, title="Service Unavailable", code="storage_missing",
+                detail="file-storage module required for media output"))
+        return self._storage
+
+    # ------------------------------------------------------------- images
+    async def generate_image(self, ctx: SecurityContext, model: ModelInfo,
+                             body: dict) -> dict:
+        if model.managed:
+            raise _managed_unsupported(model, "image generation")
+        _require_capability(model, "image_generation", "image generation")
+        storage = self._storage_required()  # before billing the provider
+        provider_body = {"model": model.provider_model_id,
+                         "prompt": body["prompt"],
+                         "n": int(body.get("n", 1)),
+                         "response_format": "b64_json"}
+        if body.get("size"):
+            provider_body["size"] = body["size"]
+        out = await self._provider_call(ctx, model, "images/generations",
+                                        json_body=provider_body)
+        items = []
+        for entry in out.get("data", []):
+            if entry.get("b64_json"):
+                raw = base64.b64decode(entry["b64_json"])
+                stored = await storage.store(
+                    ctx, raw, "image/png", filename="generated.png")
+                items.append({"url": stored.url,
+                              "size_bytes": stored.size_bytes,
+                              "revised_prompt": entry.get("revised_prompt")})
+            elif entry.get("url"):
+                items.append({"url": entry["url"],
+                              "revised_prompt": entry.get("revised_prompt")})
+        if not items:
+            raise ProblemError(Problem(
+                status=502, title="Bad Gateway", code="provider_error",
+                detail="provider returned no image payloads"))
+        return {"data": items, "model_used": model.canonical_id}
+
+    # ------------------------------------------------------------- tts
+    async def speech(self, ctx: SecurityContext, model: ModelInfo,
+                     body: dict) -> dict:
+        if model.managed:
+            raise _managed_unsupported(model, "speech synthesis")
+        _require_capability(model, "tts", "speech synthesis")
+        storage = self._storage_required()  # before billing the provider
+        provider_body = {"model": model.provider_model_id,
+                         "input": body["input"],
+                         "voice": body.get("voice", "alloy"),
+                         "response_format": body.get("response_format", "mp3")}
+        fmt = provider_body["response_format"]
+        mime = {"mp3": "audio/mpeg", "wav": "audio/wav",
+                "opus": "audio/opus", "flac": "audio/flac"}.get(fmt, "audio/mpeg")
+        audio = await self._provider_call(ctx, model, "audio/speech",
+                                          json_body=provider_body, raw=True)
+        stored = await storage.store(ctx, audio, mime,
+                                     filename=f"speech.{fmt}")
+        return {"url": stored.url, "mime_type": mime,
+                "size_bytes": stored.size_bytes,
+                "model_used": model.canonical_id}
+
+    # ------------------------------------------------------------- stt
+    async def transcribe(self, ctx: SecurityContext, model: ModelInfo,
+                         audio: bytes, mime: str, params: dict) -> dict:
+        if model.managed:
+            raise _managed_unsupported(model, "transcription")
+        _require_capability(model, "stt", "transcription")
+        form = aiohttp.FormData()
+        ext = (mime.split("/")[-1] or "wav").split(";")[0]
+        form.add_field("file", audio, filename=f"audio.{ext}",
+                       content_type=mime)
+        form.add_field("model", model.provider_model_id)
+        if params.get("language"):
+            form.add_field("language", str(params["language"]))
+        out = await self._provider_call(ctx, model, "audio/transcriptions",
+                                        data=form)
+        return {"text": out.get("text", ""),
+                "language": out.get("language"),
+                "duration": out.get("duration"),
+                "model_used": model.canonical_id}

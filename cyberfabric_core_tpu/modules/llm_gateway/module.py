@@ -534,7 +534,21 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
         ws = web.WebSocketResponse(heartbeat=20.0)
         await ws.prepare(request)
+        audio_buf = bytearray()  # realtime audio frames (PRD audio modality)
         async for msg in ws:
+            if msg.type == aiohttp.WSMsgType.BINARY:
+                # binary frames append to the session's input audio buffer
+                # (the spec's input_audio_buffer.append, bytes instead of b64);
+                # bounded like every other input path
+                if len(audio_buf) + len(msg.data) > 16 * 1024 * 1024:
+                    await ws.send_json({"type": "error", "error": {
+                        "code": "audio_buffer_full",
+                        "detail": "audio buffer limit 16MiB; commit or clear"}})
+                    continue
+                audio_buf.extend(msg.data)
+                await ws.send_json({"type": "audio.appended",
+                                    "buffered_bytes": len(audio_buf)})
+                continue
             if msg.type != aiohttp.WSMsgType.TEXT:
                 continue
             try:
@@ -545,6 +559,33 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
                 continue
             if frame.get("type") == "session.close":
                 break
+            if frame.get("type") == "audio.clear":
+                audio_buf.clear()
+                await ws.send_json({"type": "audio.cleared"})
+                continue
+            if frame.get("type") == "audio.commit":
+                # committed audio → STT via the provider adapter, transcript
+                # returned to the client (who typically folds it into the next
+                # chat.create) — the session protocol of DESIGN.md realtime
+                event_id = frame.get("id") or f"rt-{uuid.uuid4().hex[:12]}"
+                try:
+                    if not audio_buf:
+                        raise ProblemError.bad_request("audio buffer is empty")
+                    self.usage.check_budget(ctx)
+                    model = await self.registry.resolve(
+                        ctx, frame.get("model") or "")
+                    out = await self._media_required().transcribe(
+                        ctx, model, bytes(audio_buf),
+                        frame.get("mime_type", "audio/wav"),
+                        {"language": frame.get("language")})
+                    audio_buf.clear()
+                    await ws.send_json({"type": "transcript", "id": event_id,
+                                        "text": out["text"],
+                                        "model_used": out["model_used"]})
+                except ProblemError as e:
+                    await ws.send_json({"type": "error", "id": event_id,
+                                        "error": e.problem.to_dict()})
+                continue
             if frame.get("type") != "chat.create":
                 await ws.send_json({"type": "error", "error": {
                     "code": "unknown_frame_type",
@@ -572,6 +613,63 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
                 await ws.send_json({"type": "error", "id": event_id,
                                     "error": e.problem.to_dict()})
         return ws
+
+    # ------------------------------------------------------------- media (PRD FRs)
+    def _get_media(self):
+        if getattr(self, "_media", None) is None and \
+                getattr(self, "_hub", None) is not None:
+            from ..sdk import FileStorageApi, OagwApi
+            from .media import MediaAdapter
+
+            oagw = self._hub.try_get(OagwApi)
+            if oagw is not None:
+                self._media = MediaAdapter(oagw,
+                                           self._hub.try_get(FileStorageApi))
+        return getattr(self, "_media", None)
+
+    def _media_required(self):
+        media = self._get_media()
+        if media is None:
+            raise ProblemError(Problem(
+                status=503, title="Service Unavailable", code="oagw_missing",
+                detail="media modalities require the oagw module"))
+        return media
+
+    async def handle_image_generation(self, request: web.Request):
+        body = await read_json(request, schemas.IMAGE_REQUEST)
+        ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
+        self.usage.check_budget(ctx)
+        model = await self.registry.resolve(ctx, body["model"])
+        out = await self._media_required().generate_image(ctx, model, body)
+        self.usage.report(ctx, {"input_tokens": 0, "output_tokens": 0,
+                                "images": len(out["data"])})
+        return out
+
+    async def handle_speech(self, request: web.Request):
+        body = await read_json(request, schemas.SPEECH_REQUEST)
+        ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
+        self.usage.check_budget(ctx)
+        model = await self.registry.resolve(ctx, body["model"])
+        return await self._media_required().speech(ctx, model, body)
+
+    async def handle_transcription(self, request: web.Request):
+        ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
+        self.usage.check_budget(ctx)
+        model_name = request.query.get("model")
+        if not model_name:
+            raise ProblemError.bad_request("model query parameter required")
+        model = await self.registry.resolve(ctx, model_name)
+        audio = await request.read()
+        if not audio:
+            raise ProblemError.bad_request("request body must be audio bytes")
+        # aiohttp defaults a missing Content-Type to octet-stream — map that
+        # to the wav default, since STT providers reject octet-stream files
+        mime = request.content_type
+        if not mime or mime == "application/octet-stream":
+            mime = "audio/wav"
+        return await self._media_required().transcribe(
+            ctx, model, audio, mime,
+            {"language": request.query.get("language")})
 
     async def handle_usage(self, request: web.Request):
         ctx = request[SECURITY_CONTEXT_KEY]
@@ -608,6 +706,16 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
             .summary("Cancel an async job").handler(self.handle_cancel_job).register()
         router.operation("GET", "/v1/usage", module=m).auth_required() \
             .summary("Tenant usage counters").handler(self.handle_usage).register()
+        router.operation("POST", "/v1/images/generations", module=m).auth_required() \
+            .summary("Generate images (provider-backed; stored via file-storage)") \
+            .handler(self.handle_image_generation).register()
+        router.operation("POST", "/v1/audio/speech", module=m).auth_required() \
+            .summary("Text-to-speech (provider-backed; audio via file-storage)") \
+            .handler(self.handle_speech).register()
+        router.operation("POST", "/v1/audio/transcriptions", module=m).auth_required() \
+            .accepts("*/*") \
+            .summary("Speech-to-text (?model=...; body = audio bytes)") \
+            .handler(self.handle_transcription).register()
         openapi.register_schema("Batch", schemas.BATCH)
         router.operation("POST", "/v1/batches", module=m).auth_required() \
             .summary("Submit a request batch").response_schema(schemas.BATCH) \

@@ -173,6 +173,32 @@ STREAM_CHUNK = _schema("core", "stream_chunk", {
     },
 })
 
+IMAGE_REQUEST = _schema("content", "image_request", {
+    "type": "object",
+    "required": ["model", "prompt"],
+    "properties": {
+        "model": {"type": "string"},
+        "prompt": {"type": "string", "minLength": 1},
+        "n": {"type": "integer", "minimum": 1, "maximum": 8, "default": 1},
+        "size": {"type": "string"},
+    },
+    "additionalProperties": False,
+})
+
+SPEECH_REQUEST = _schema("content", "speech_request", {
+    "type": "object",
+    "required": ["model", "input"],
+    "properties": {
+        "model": {"type": "string"},
+        "input": {"type": "string", "minLength": 1},
+        "voice": {"type": "string"},
+        "response_format": {"type": "string",
+                            "enum": ["mp3", "wav", "opus", "flac"],
+                            "default": "mp3"},
+    },
+    "additionalProperties": False,
+})
+
 EMBEDDING_REQUEST = _schema("core", "embedding_request", {
     "type": "object",
     "required": ["model", "input"],

@@ -448,6 +448,7 @@ class OagwService(OagwApi):
 
     def open_upstream_stream(self, ctx: SecurityContext, slug: str, path: str,
                              *, method: str = "POST", json_body: Any = None,
+                             data: Any = None,
                              headers: Optional[dict] = None):
         """OagwApi: breaker-guarded, credential-injected upstream request as an
         async context manager (the llm-gateway external adapter's seam — it
@@ -475,7 +476,7 @@ class OagwService(OagwApi):
             session = await self.session()
             try:
                 async with session.request(method, url, json=json_body,
-                                           headers=hdrs,
+                                           data=data, headers=hdrs,
                                            allow_redirects=False) as resp:
                     if resp.status >= 500:
                         breaker.record_failure()

@@ -154,8 +154,10 @@ class OagwApi(abc.ABC):
     @abc.abstractmethod
     def open_upstream_stream(self, ctx: SecurityContext, slug: str, path: str,
                              *, method: str = "POST", json_body: Any = None,
+                             data: Any = None,
                              headers: Optional[dict] = None):
-        """Async context manager yielding the upstream's streaming response."""
+        """Async context manager yielding the upstream's streaming response.
+        ``json_body`` or ``data`` (raw bytes / multipart) — not both."""
 
 
 def parse_sse_stream(chunks: "AsyncIterator[bytes]") -> "AsyncIterator[dict]":

@@ -34,6 +34,10 @@ def client_hub():
 @pytest.fixture()
 def fresh_registry():
     """Isolate module registrations per test."""
+    # ensure the full decorator inventory exists BEFORE saving — otherwise a
+    # first-in-process user of this fixture snapshots an empty registry and
+    # teardown wipes the registrations for every later test
+    import cyberfabric_core_tpu.modules  # noqa: F401
     from cyberfabric_core_tpu.modkit import registry as reg
 
     saved = list(reg._REGISTRATIONS)

@@ -1,0 +1,217 @@
+"""Non-text modalities (image gen, TTS, STT, realtime audio frames) against a
+mock provider — llm-gateway PRD FRs :104-311, ADR-0003 media-via-FileStorage."""
+
+import asyncio
+import base64
+import json
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+PNG = (b"\x89PNG\r\n\x1a\n" + b"\x00" * 16)
+MP3 = b"ID3fake-mp3-bytes" * 4
+
+
+@pytest.fixture()
+def stack(fresh_registry):
+    from cyberfabric_core_tpu.modkit import AppConfig, ClientHub, ModuleRegistry, RunOptions
+    from cyberfabric_core_tpu.modkit.db import DbManager
+    from cyberfabric_core_tpu.modkit.registry import Registration
+    from cyberfabric_core_tpu.modkit.runtime import HostRuntime
+    from cyberfabric_core_tpu.gateway.module import ApiGatewayModule
+    from cyberfabric_core_tpu.modules.credstore import CredStoreModule
+    from cyberfabric_core_tpu.modules.file_storage import FileStorageModule
+    from cyberfabric_core_tpu.modules.llm_gateway.module import LlmGatewayModule
+    from cyberfabric_core_tpu.modules.model_registry import ModelRegistryModule
+    from cyberfabric_core_tpu.modules.oagw import OagwModule
+    from cyberfabric_core_tpu.modules.resolvers import TenantResolverModule
+
+    fresh_registry._REGISTRATIONS.clear()
+    regs = [
+        Registration("api_gateway", ApiGatewayModule, (),
+                     ("rest_host", "stateful", "system")),
+        Registration("tenant_resolver", TenantResolverModule, (), ("system",)),
+        Registration("credstore", CredStoreModule, ("tenant_resolver",),
+                     ("db", "rest")),
+        Registration("oagw", OagwModule, ("credstore",), ("db", "rest")),
+        Registration("model_registry", ModelRegistryModule, (), ("db", "rest")),
+        Registration("file_storage", FileStorageModule, (), ("rest",)),
+        Registration("llm_gateway", LlmGatewayModule, ("model_registry",),
+                     ("rest", "stateful")),
+    ]
+    seen: list[dict] = []
+
+    async def boot():
+        mock = web.Application()
+
+        async def images(request):
+            body = await request.json()
+            seen.append({"path": "images", "body": body})
+            return web.json_response({"data": [
+                {"b64_json": base64.b64encode(PNG).decode(),
+                 "revised_prompt": "a nicer cat"}]})
+
+        async def speech(request):
+            body = await request.json()
+            seen.append({"path": "speech", "body": body})
+            return web.Response(body=MP3, content_type="audio/mpeg")
+
+        async def transcriptions(request):
+            post = await request.post()
+            seen.append({"path": "stt",
+                         "model": post["model"],
+                         "bytes": len(post["file"].file.read())})
+            return web.json_response({"text": "hello from audio",
+                                      "language": "en"})
+
+        mock.router.add_post("/v1/images/generations", images)
+        mock.router.add_post("/v1/audio/speech", speech)
+        mock.router.add_post("/v1/audio/transcriptions", transcriptions)
+        runner = web.AppRunner(mock)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        mock_port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+        cfg = AppConfig.load_or_default(environ={}, cli_overrides={"modules": {
+            "api_gateway": {"config": {"bind_addr": "127.0.0.1:0",
+                                       "auth_disabled": True}},
+            "tenant_resolver": {}, "credstore": {}, "file_storage": {},
+            "oagw": {"config": {"allow_insecure_http": True,
+                                "allow_private_upstreams": True}},
+            "model_registry": {"config": {
+                "seed_tenant": "default",
+                "models": [
+                    {"provider_slug": "media-mock", "provider_model_id": "pix",
+                     "approval_state": "approved", "managed": False,
+                     "capabilities": {"image_generation": True}},
+                    {"provider_slug": "media-mock", "provider_model_id": "tts-1",
+                     "approval_state": "approved", "managed": False,
+                     "capabilities": {"tts": True}},
+                    {"provider_slug": "media-mock", "provider_model_id": "whisper",
+                     "approval_state": "approved", "managed": False,
+                     "capabilities": {"stt": True}},
+                    {"provider_slug": "local", "provider_model_id": "tiny-llama",
+                     "approval_state": "approved", "managed": True,
+                     "architecture": "llama",
+                     "engine_options": {"model_config": "tiny-llama"}},
+                ]}},
+            "llm_gateway": {},
+        }})
+        registry = ModuleRegistry.discover_and_build(extra=regs)
+        rt = HostRuntime(RunOptions(config=cfg, registry=registry,
+                                    client_hub=ClientHub(),
+                                    db_manager=DbManager(in_memory=True)))
+        await rt.run_setup_phases()
+        base = f"http://127.0.0.1:{registry.get('api_gateway').instance.bound_port}"
+        async with aiohttp.ClientSession() as s:
+            await s.put(f"{base}/v1/credstore/secrets/media-key",
+                        json={"value": "sk-media"})
+            await s.post(f"{base}/v1/oagw/upstreams", json={
+                "slug": "media-mock",
+                "base_url": f"http://127.0.0.1:{mock_port}/v1",
+                "auth": {"type": "bearer", "secret_ref": "media-key"}})
+        return rt, runner, base
+
+    loop = asyncio.new_event_loop()
+    rt, runner, base = loop.run_until_complete(boot())
+    yield loop, base, seen
+    loop.run_until_complete(rt.registry.get("oagw").instance.service.close())
+    rt.root_token.cancel()
+    loop.run_until_complete(rt.run_stop_phase())
+    loop.run_until_complete(runner.cleanup())
+    loop.close()
+
+
+def _req(loop, method, url, **kw):
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.request(method, url, **kw) as r:
+                try:
+                    return r.status, await r.json(content_type=None)
+                except Exception:  # noqa: BLE001
+                    return r.status, await r.read()
+
+    return loop.run_until_complete(go())
+
+
+def test_image_generation_stored_via_file_storage(stack):
+    loop, base, seen = stack
+    status, body = _req(loop, "POST", f"{base}/v1/images/generations", json={
+        "model": "media-mock::pix", "prompt": "a cat on a TPU"})
+    assert status == 200, body
+    assert body["model_used"] == "media-mock::pix"
+    url = body["data"][0]["url"]
+    assert url.startswith("/v1/files/")
+    assert body["data"][0]["revised_prompt"] == "a nicer cat"
+    # the stored bytes round-trip through file-storage
+    status, raw = _req(loop, "GET", f"{base}{url}")
+    assert status == 200 and raw == PNG
+    assert seen[0]["body"]["prompt"] == "a cat on a TPU"
+    assert seen[0]["body"]["model"] == "pix"
+
+
+def test_tts_audio_via_file_storage(stack):
+    loop, base, seen = stack
+    status, body = _req(loop, "POST", f"{base}/v1/audio/speech", json={
+        "model": "media-mock::tts-1", "input": "read this aloud",
+        "voice": "nova"})
+    assert status == 200, body
+    assert body["mime_type"] == "audio/mpeg"
+    status, raw = _req(loop, "GET", f"{base}{body['url']}")
+    assert status == 200 and raw == MP3
+    call = [s for s in seen if s["path"] == "speech"][0]
+    assert call["body"]["input"] == "read this aloud"
+    assert call["body"]["voice"] == "nova"
+
+
+def test_stt_transcription(stack):
+    loop, base, seen = stack
+    status, body = _req(
+        loop, "POST",
+        f"{base}/v1/audio/transcriptions?model=media-mock::whisper",
+        data=b"RIFFfake-wav-bytes", headers={"Content-Type": "audio/wav"})
+    assert status == 200, body
+    assert body["text"] == "hello from audio"
+    call = [s for s in seen if s["path"] == "stt"][0]
+    assert call["model"] == "whisper"
+    assert call["bytes"] == len(b"RIFFfake-wav-bytes")
+
+
+def test_capability_and_managed_gating(stack):
+    loop, base, _ = stack
+    # model without the capability → 409
+    status, body = _req(loop, "POST", f"{base}/v1/images/generations", json={
+        "model": "media-mock::whisper", "prompt": "x"})
+    assert status == 409 and body["code"] == "capability_missing"
+    # managed model → 501
+    status, body = _req(loop, "POST", f"{base}/v1/images/generations", json={
+        "model": "local::tiny-llama", "prompt": "x"})
+    assert status == 501 and body["code"] == "modality_not_implemented"
+
+
+def test_realtime_binary_audio_frames(stack):
+    loop, base, seen = stack
+
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.ws_connect(f"{base}/v1/realtime") as ws:
+                await ws.send_bytes(b"RIFF-chunk-1")
+                ack1 = await ws.receive_json()
+                await ws.send_bytes(b"-chunk-2")
+                ack2 = await ws.receive_json()
+                await ws.send_json({"type": "audio.commit",
+                                    "model": "media-mock::whisper",
+                                    "mime_type": "audio/wav"})
+                transcript = await ws.receive_json()
+                await ws.send_json({"type": "session.close"})
+                return ack1, ack2, transcript
+
+    ack1, ack2, transcript = loop.run_until_complete(go())
+    assert ack1 == {"type": "audio.appended", "buffered_bytes": 12}
+    assert ack2["buffered_bytes"] == 20
+    assert transcript["type"] == "transcript"
+    assert transcript["text"] == "hello from audio"
+    call = [s for s in seen if s["path"] == "stt"][-1]
+    assert call["bytes"] == 20  # both frames committed as one buffer
