@@ -5,11 +5,12 @@ connect_with_retry :239, rpc retry layer rpc_retry.rs, tracing interceptors) and
 proto/directory/v1/directory.proto (DirectoryService: Register/Deregister/
 Heartbeat/ResolveGrpcService/ListInstances).
 
-Wire format: JSON-over-gRPC with dynamically registered generic method handlers
-(no protoc codegen in this environment — grpc_tools is absent; the method
-*surface* mirrors the reference proto 1:1 and payloads are schema-checked
-JSON, so swapping in protobuf stubs later is a serializer change, not an API
-change). All servers/clients are grpc.aio (asyncio-native, matching the host).
+Wire formats: application module services use JSON-over-gRPC generic handlers
+(runtime-registered, no codegen step for module authors); the DIRECTORY plane
+speaks real protobuf generated from the committed IDL
+(proto/directory/v1/directory.proto → modkit/gen/) via per-method codecs —
+handlers keep their dict signatures, the codec layer converts
+protobuf ↔ dict at the wire. All servers/clients are grpc.aio.
 """
 
 from __future__ import annotations
@@ -38,25 +39,86 @@ def _de(data: bytes) -> dict:
     return json.loads(data.decode()) if data else {}
 
 
+@dataclass(frozen=True)
+class ProtoCodec:
+    """Per-method protobuf codec: handlers stay dict-shaped, the wire is the
+    generated message types (snake_case field names preserved both ways)."""
+
+    request_cls: Any
+    response_cls: Any
+
+    @staticmethod
+    def _to_dict(msg) -> dict:
+        from google.protobuf.json_format import MessageToDict
+
+        # defaults must materialize (ok=false, empty lists) — handler dicts
+        # and client callers index these keys
+        return MessageToDict(msg, preserving_proto_field_name=True,
+                             always_print_fields_with_no_presence=True)
+
+    def decode_request(self, data: bytes) -> dict:
+        return self._to_dict(self.request_cls.FromString(data))
+
+    def encode_request(self, obj: dict) -> bytes:
+        from google.protobuf.json_format import ParseDict
+
+        clean = {k: v for k, v in obj.items() if v is not None}
+        return ParseDict(clean, self.request_cls()).SerializeToString()
+
+    def decode_response(self, data: bytes) -> dict:
+        return self._to_dict(self.response_cls.FromString(data))
+
+    def encode_response(self, obj: dict) -> bytes:
+        from google.protobuf.json_format import ParseDict
+
+        clean = {k: v for k, v in obj.items() if v is not None}
+        return ParseDict(clean, self.response_cls()).SerializeToString()
+
+
+def directory_codecs() -> dict[str, ProtoCodec]:
+    """Codecs for the five DirectoryService methods, from the committed IDL."""
+    from .gen.directory.v1 import directory_pb2 as pb
+
+    return {
+        "RegisterInstance": ProtoCodec(pb.RegisterInstanceRequest,
+                                       pb.RegisterInstanceResponse),
+        "DeregisterInstance": ProtoCodec(pb.InstanceRef, pb.Ack),
+        "Heartbeat": ProtoCodec(pb.InstanceRef, pb.Ack),
+        "ResolveGrpcService": ProtoCodec(pb.ResolveRequest, pb.InstanceInfo),
+        "ListInstances": ProtoCodec(pb.ListRequest, pb.ListResponse),
+    }
+
+
 class JsonGrpcServer:
     """grpc.aio server hosting JSON-unary services registered at runtime."""
 
     def __init__(self) -> None:
         self._services: dict[str, dict[str, Handler]] = {}
+        self._codecs: dict[str, dict[str, ProtoCodec]] = {}
         self._server: Optional[grpc_aio.Server] = None
         self.bound_port: Optional[int] = None
 
-    def add_service(self, service_name: str, methods: dict[str, Handler]) -> None:
+    def add_service(self, service_name: str, methods: dict[str, Handler],
+                    codecs: Optional[dict[str, "ProtoCodec"]] = None) -> None:
         self._services.setdefault(service_name, {}).update(methods)
+        if codecs:
+            self._codecs.setdefault(service_name, {}).update(codecs)
 
     def _build(self) -> grpc_aio.Server:
         server = grpc_aio.server()
         for service_name, methods in self._services.items():
             handlers = {}
             for method_name, fn in methods.items():
-                async def unary(request: bytes, context, _fn=fn) -> bytes:
+                codec = self._codecs.get(service_name, {}).get(method_name)
+
+                async def unary(request: bytes, context, _fn=fn,
+                                _codec=codec) -> bytes:
                     try:
-                        return _ser(await _fn(_de(request)))
+                        req = (_codec.decode_request(request) if _codec
+                               else _de(request))
+                        out = await _fn(req)
+                        return (_codec.encode_response(out) if _codec
+                                else _ser(out))
                     except KeyError as e:
                         await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
                     except ValueError as e:
@@ -116,19 +178,21 @@ class JsonGrpcClient:
             self._channel = grpc_aio.insecure_channel(self.target)
         return self._channel
 
-    async def call(self, service: str, method: str, payload: dict) -> dict:
+    async def call(self, service: str, method: str, payload: dict,
+                   codec: Optional[ProtoCodec] = None) -> dict:
         channel = await self._ensure_channel()
         rpc = channel.unary_unary(
             f"/{service}/{method}",
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b,
         )
+        wire = codec.encode_request(payload) if codec else _ser(payload)
         delay = self.config.retry_backoff_s
         last: Optional[grpc_aio.AioRpcError] = None
         for attempt in range(self.config.max_retries + 1):
             try:
-                resp = await rpc(_ser(payload), timeout=self.config.call_timeout_s)
-                return _de(resp)
+                resp = await rpc(wire, timeout=self.config.call_timeout_s)
+                return codec.decode_response(resp) if codec else _de(resp)
             except grpc_aio.AioRpcError as e:
                 if e.code() not in self._RETRYABLE or attempt == self.config.max_retries:
                     raise
@@ -244,32 +308,38 @@ class DirectoryService:
 
 
 class DirectoryClient:
-    """gRPC-side directory client (the LocalDirectoryClient counterpart is the
-    DirectoryService object itself, used in-process)."""
+    """gRPC-side directory client speaking the protobuf wire of the committed
+    IDL (the LocalDirectoryClient counterpart is the DirectoryService object
+    itself, used in-process)."""
 
     def __init__(self, endpoint: str) -> None:
         self._client = JsonGrpcClient(endpoint)
+        self._codecs = directory_codecs()
 
     async def register(self, service_name: str, endpoint: str,
                        module_name: str = "", instance_id: Optional[str] = None) -> str:
         resp = await self._client.call(DIRECTORY_SERVICE, "RegisterInstance", {
             "service_name": service_name, "endpoint": endpoint,
-            "module_name": module_name, "instance_id": instance_id})
+            "module_name": module_name, "instance_id": instance_id},
+            codec=self._codecs["RegisterInstance"])
         return resp["instance_id"]
 
     async def deregister(self, instance_id: str) -> bool:
         resp = await self._client.call(DIRECTORY_SERVICE, "DeregisterInstance",
-                                       {"instance_id": instance_id})
+                                       {"instance_id": instance_id},
+                                       codec=self._codecs["DeregisterInstance"])
         return resp["ok"]
 
     async def heartbeat(self, instance_id: str) -> bool:
         resp = await self._client.call(DIRECTORY_SERVICE, "Heartbeat",
-                                       {"instance_id": instance_id})
+                                       {"instance_id": instance_id},
+                                       codec=self._codecs["Heartbeat"])
         return resp["ok"]
 
     async def resolve(self, service_name: str) -> dict:
         return await self._client.call(DIRECTORY_SERVICE, "ResolveGrpcService",
-                                       {"service_name": service_name})
+                                       {"service_name": service_name},
+                                       codec=self._codecs["ResolveGrpcService"])
 
     async def close(self) -> None:
         await self._client.close()

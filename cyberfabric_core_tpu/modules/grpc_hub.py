@@ -43,7 +43,10 @@ class GrpcHubModule(Module, SystemCapability, RunnableCapability):
         raw = ctx.raw_config()
         self.config = GrpcHubConfig(**raw) if raw else GrpcHubConfig()
         self.directory.ttl = self.config.heartbeat_ttl_s
-        self.server.add_service(DIRECTORY_SERVICE, self.directory.rpc_handlers())
+        from ..modkit.transport_grpc import directory_codecs
+
+        self.server.add_service(DIRECTORY_SERVICE, self.directory.rpc_handlers(),
+                                codecs=directory_codecs())
         # expose for other modules: in-process directory + service registration
         ctx.client_hub.register(DirectoryService, self.directory)
         ctx.client_hub.register(JsonGrpcServer, self.server)

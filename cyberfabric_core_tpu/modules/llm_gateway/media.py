@@ -36,7 +36,9 @@ def _managed_unsupported(model: ModelInfo, what: str) -> ProblemError:
 
 
 def _require_capability(model: ModelInfo, flag: str, what: str) -> None:
-    if model.capabilities and not model.capabilities.get(flag, False):
+    # the flag must be declared — an empty capabilities block (the registry
+    # default) means "chat only", not "everything"
+    if not (model.capabilities or {}).get(flag, False):
         raise ProblemError(Problem(
             status=409, title="Conflict", code="capability_missing",
             detail=f"model {model.canonical_id} does not declare the "
@@ -54,24 +56,21 @@ class MediaAdapter:
                              path: str, *, json_body: Any = None,
                              data: Any = None, raw: bool = False):
         """One provider POST with shared error mapping; ``raw`` returns the
-        body bytes (audio), otherwise parsed JSON."""
-        try:
-            async with self._oagw.open_upstream_stream(
-                ctx, model.provider_slug, path, method="POST",
-                json_body=json_body, data=data,
-            ) as resp:
-                if resp.status >= 400:
-                    detail = (await resp.text())[:300]
-                    raise ProblemError(Problem(
-                        status=502, title="Bad Gateway", code="provider_error",
-                        detail=f"provider returned {resp.status}: {detail}"))
-                if raw:
-                    return await resp.read()
-                return await resp.json(content_type=None)
-        except aiohttp.ClientError as e:
-            raise ProblemError(Problem(
-                status=502, title="Bad Gateway", code="provider_unreachable",
-                detail=f"provider {model.provider_slug}: {e}"))
+        body bytes (audio), otherwise parsed JSON. Transport-level failures
+        surface as the OAGW seam's 502 upstream_error — the seam wraps
+        aiohttp.ClientError itself, including mid-body reads at the yield."""
+        async with self._oagw.open_upstream_stream(
+            ctx, model.provider_slug, path, method="POST",
+            json_body=json_body, data=data,
+        ) as resp:
+            if resp.status >= 400:
+                detail = (await resp.text())[:300]
+                raise ProblemError(Problem(
+                    status=502, title="Bad Gateway", code="provider_error",
+                    detail=f"provider returned {resp.status}: {detail}"))
+            if raw:
+                return await resp.read()
+            return await resp.json(content_type=None)
 
     def _storage_required(self) -> FileStorageApi:
         if self._storage is None:
@@ -142,7 +141,13 @@ class MediaAdapter:
             raise _managed_unsupported(model, "transcription")
         _require_capability(model, "stt", "transcription")
         form = aiohttp.FormData()
-        ext = (mime.split("/")[-1] or "wav").split(";")[0]
+        # canonical extensions — providers validate by filename suffix and
+        # reject subtypes like "x-wav" or "mpeg"
+        ext = {"audio/wav": "wav", "audio/x-wav": "wav", "audio/wave": "wav",
+               "audio/mpeg": "mp3", "audio/mp3": "mp3", "audio/mp4": "m4a",
+               "audio/x-m4a": "m4a", "audio/ogg": "ogg", "audio/opus": "opus",
+               "audio/flac": "flac", "audio/webm": "webm",
+               }.get(mime.split(";")[0].strip().lower(), "wav")
         form.add_field("file", audio, filename=f"audio.{ext}",
                        content_type=mime)
         form.add_field("model", model.provider_model_id)

@@ -61,6 +61,11 @@ class UsageTracker:
         entry["output_tokens"] += usage.get("output_tokens", 0)
         entry["total_tokens"] += usage.get("input_tokens", 0) + usage.get("output_tokens", 0)
         entry["requests"] += 1
+        # media counters (images, media_requests, ...) accumulate generically
+        for k, v in usage.items():
+            if k in ("input_tokens", "output_tokens") or not isinstance(v, int):
+                continue
+            entry[k] = entry.get(k, 0) + v
         from ...modkit.metrics import default_registry
 
         default_registry.counter(
@@ -578,6 +583,8 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
                         ctx, model, bytes(audio_buf),
                         frame.get("mime_type", "audio/wav"),
                         {"language": frame.get("language")})
+                    self.usage.report(ctx, {"media_requests": 1,
+                                            "stt_bytes": len(audio_buf)})
                     audio_buf.clear()
                     await ws.send_json({"type": "transcript", "id": event_id,
                                         "text": out["text"],
@@ -650,7 +657,10 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
         self.usage.check_budget(ctx)
         model = await self.registry.resolve(ctx, body["model"])
-        return await self._media_required().speech(ctx, model, body)
+        out = await self._media_required().speech(ctx, model, body)
+        self.usage.report(ctx, {"media_requests": 1,
+                                "tts_bytes": out.get("size_bytes", 0)})
+        return out
 
     async def handle_transcription(self, request: web.Request):
         ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
@@ -667,9 +677,12 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         mime = request.content_type
         if not mime or mime == "application/octet-stream":
             mime = "audio/wav"
-        return await self._media_required().transcribe(
+        out = await self._media_required().transcribe(
             ctx, model, audio, mime,
             {"language": request.query.get("language")})
+        self.usage.report(ctx, {"media_requests": 1,
+                                "stt_bytes": len(audio)})
+        return out
 
     async def handle_usage(self, request: web.Request):
         ctx = request[SECURITY_CONTEXT_KEY]

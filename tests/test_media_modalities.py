@@ -215,3 +215,30 @@ def test_realtime_binary_audio_frames(stack):
     assert transcript["text"] == "hello from audio"
     call = [s for s in seen if s["path"] == "stt"][-1]
     assert call["bytes"] == 20  # both frames committed as one buffer
+
+
+def test_media_usage_reported(stack):
+    loop, base, seen = stack
+    _req(loop, "POST", f"{base}/v1/images/generations", json={
+        "model": "media-mock::pix", "prompt": "count me"})
+    _req(loop, "POST", f"{base}/v1/audio/speech", json={
+        "model": "media-mock::tts-1", "input": "count me too"})
+    s, body = _req(loop, "GET", f"{base}/v1/usage")
+    assert s == 200
+    usage = body["usage"]
+    assert usage.get("images", 0) >= 1
+    assert usage.get("media_requests", 0) >= 1
+    assert usage.get("tts_bytes", 0) >= 1
+
+
+def test_undeclared_capabilities_denied(stack):
+    """A model with an EMPTY capabilities block gets 409 on media endpoints —
+    empty means chat-only, not everything (review finding)."""
+    loop, base, _ = stack
+    s, _ = _req(loop, "POST", f"{base}/v1/model-registry/models", json={
+        "provider_slug": "media-mock", "provider_model_id": "plain-chat",
+        "approval_state": "approved"})
+    assert s == 201
+    s, body = _req(loop, "POST", f"{base}/v1/images/generations", json={
+        "model": "media-mock::plain-chat", "prompt": "x"})
+    assert s == 409 and body["code"] == "capability_missing"
