@@ -143,11 +143,12 @@ class MediaAdapter:
         form = aiohttp.FormData()
         # canonical extensions — providers validate by filename suffix and
         # reject subtypes like "x-wav" or "mpeg"
+        subtype = mime.split(";")[0].strip().lower()
         ext = {"audio/wav": "wav", "audio/x-wav": "wav", "audio/wave": "wav",
                "audio/mpeg": "mp3", "audio/mp3": "mp3", "audio/mp4": "m4a",
                "audio/x-m4a": "m4a", "audio/ogg": "ogg", "audio/opus": "opus",
                "audio/flac": "flac", "audio/webm": "webm",
-               }.get(mime.split(";")[0].strip().lower(), "wav")
+               }.get(subtype) or (subtype.split("/", 1)[-1] or "wav")
         form.add_field("file", audio, filename=f"audio.{ext}",
                        content_type=mime)
         form.add_field("model", model.provider_model_id)

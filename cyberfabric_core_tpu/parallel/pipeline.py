@@ -1,0 +1,243 @@
+"""Staged microbatch pipeline parallelism (the throughput half of ``pp``).
+
+Round-1 ``pp`` sharded the stacked layer dim so each device holds 1/pp of the
+depth and the layer scan streams weights over ICI — memory scaling only
+(``mesh.py``). This module adds GPipe-style **staged execution**: the batch is
+split into M microbatches that flow through the pp stages concurrently, so all
+stages compute at once instead of idling while weights stream.
+
+TPU-first formulation (the SPMD-pipeline pattern, scaling-book §pipelining):
+
+- ``jax.shard_map`` over the ``pp`` mesh axis puts 1/pp of the stacked layers on
+  each device (a plain array slice — no per-stage module classes).
+- One ``lax.scan`` over M+P-1 ticks; every tick each stage runs its layer block
+  on its current microbatch and hands the activation to the next stage with a
+  single ``ppermute`` (a neighbor hop that rides ICI).
+- The *backward* pipeline comes from autodiff: the transpose of ``ppermute`` is
+  the reverse ``ppermute``, and the transpose of the tick scan is the reverse
+  tick scan — so ``jax.grad`` of the pipelined forward IS the reverse-staged
+  backward, no hand-written schedule.
+- Bubble fraction is the textbook (P-1)/(M+P-1); pick M ≥ 4·P to amortize.
+- The data-parallel axis composes orthogonally: microbatch rows are sharded over
+  ``dp`` in the same shard_map, and gradient psums ride the mesh.
+
+Training semantics match the reference's trainer loop (SURVEY §2.6: training is
+in-scope for parity; the reference drives torch autograd + optimizer steps —
+here it is jax.grad + optax under one jit with donated state).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama
+from ..models.configs import ModelConfig
+from ..ops.norms import rms_norm
+from ..ops.rope import rope_frequencies
+
+Params = dict[str, Any]
+
+
+def _causal_attention(q, k, v):
+    """Full-sequence causal attention for training (no KV cache).
+
+    [B, T, H*, D] einsum softmax attention with GQA head grouping; f32 scores.
+    Training shapes are static and moderate (the pipeline splits T memory over
+    microbatches), so the plain formulation lets XLA fuse; the flash kernel
+    stays on the serving path.
+    """
+    B, T, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, T, Hkv, G, D)
+    scores = jnp.einsum("bthgd,bshd->bhgts", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / np.sqrt(D))
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgts,bshd->bthgd", probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, T, Hq, D).astype(q.dtype)
+
+
+def _stage_block(local_layers: dict, h: jnp.ndarray, cfg: ModelConfig,
+                 rope_tables) -> jnp.ndarray:
+    """Run this stage's layer block (stacked [L/pp, ...]) over h [B, T, H]."""
+    cos_t, sin_t = rope_tables
+    B, T = h.shape[0], h.shape[1]
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+
+    def body(h, lp):
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        q, kproj, vproj = llama._qkv_proj(lp, x, cfg, positions, cos_t, sin_t)
+        attn = _causal_attention(q, kproj, vproj)
+        h = llama._attn_out(lp, h, attn.reshape(B, T, -1))
+        h = llama._mlp_residual(lp, h, cfg)
+        return h, None
+
+    h, _ = jax.lax.scan(body, h, local_layers)
+    return h
+
+
+def pipelined_loss_fn(cfg: ModelConfig, mesh: Mesh, num_microbatches: int,
+                      pp_axis: str = "pp", dp_axis: str = "dp"):
+    """Build loss(params, ids, targets) with GPipe microbatching over ``pp``.
+
+    ids/targets: [B, T] with B = num_microbatches × microbatch rows; microbatch
+    rows are additionally sharded over ``dp``. Returns mean next-token
+    cross-entropy (a scalar, identical on every device).
+    """
+    PP = mesh.shape[pp_axis]
+    M = num_microbatches
+    rope = rope_frequencies(cfg.head_dim, cfg.max_position, cfg.rope_theta)
+    fwd_perm = [(i, (i + 1) % PP) for i in range(PP)]
+
+    def sharded_body(layers_local, embed, final_norm, lm_head, ids, targets):
+        # ids/targets local shard: [M, mb_local, T]
+        p = jax.lax.axis_index(pp_axis)
+        is_first = p == 0
+        is_last = p == PP - 1
+
+        # embed all microbatches up front (cheap gather; grads flow only
+        # through the stage-0 selection below)
+        h_in = llama.embed_lookup(embed, ids, final_norm.dtype)  # [M, mb, T, H]
+
+        state = jnp.zeros_like(h_in[0])
+        collected = jnp.zeros_like(h_in)
+
+        def tick(carry, t):
+            state, collected = carry
+            feed = h_in[jnp.clip(t, 0, M - 1)]
+            inp = jnp.where(is_first, feed, state)
+            out = _stage_block(layers_local, inp, cfg, rope)
+            done = t - (PP - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                collected, out, jnp.clip(done, 0, M - 1), 0)
+            take = jnp.logical_and(is_last,
+                                   jnp.logical_and(done >= 0, done < M))
+            collected = jnp.where(take, upd, collected)
+            state = jax.lax.ppermute(out, pp_axis, fwd_perm)
+            return (state, collected), None
+
+        (state, collected), _ = jax.lax.scan(
+            tick, (state, collected), jnp.arange(M + PP - 1))
+
+        # loss on the last stage only; other stages contribute exact zeros and
+        # the psum replicates the scalar (their head FLOPs are masked waste —
+        # the standard SPMD-pipeline trade for one program on every device)
+        hidden = rms_norm(collected, final_norm, cfg.rms_norm_eps)
+        head = embed if cfg.tie_embeddings else lm_head
+        logits = jnp.einsum("mbth,hv->mbtv", hidden,
+                            head.T if cfg.tie_embeddings else head,
+                            preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        local = jnp.where(is_last, jnp.sum(nll), 0.0)
+        total = jax.lax.psum(local, pp_axis)
+        total = jax.lax.psum(total, dp_axis)
+        count = jax.lax.psum(jnp.where(is_last, nll.size, 0), (pp_axis, dp_axis))
+        return total / count.astype(jnp.float32)
+
+    in_specs = (
+        P(pp_axis),                         # stacked layers: L dim split over pp
+        P(), P(), P(),                      # embed / final_norm / lm_head replicated
+        P(None, dp_axis, None),             # ids [M, mb, T]
+        P(None, dp_axis, None),             # targets
+    )
+
+    smapped = jax.shard_map(
+        sharded_body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+        check_vma=False,
+    )
+
+    def loss_fn(params: Params, ids: jnp.ndarray, targets: jnp.ndarray):
+        B, T = ids.shape
+        assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
+        mb = B // M
+        ids_m = ids.reshape(M, mb, T)
+        tgt_m = targets.reshape(M, mb, T)
+        lm_head = params.get("lm_head", params["embed"])
+        return smapped(params["layers"], params["embed"], params["final_norm"],
+                       lm_head, ids_m, tgt_m)
+
+    return loss_fn
+
+
+def reference_loss_fn(cfg: ModelConfig):
+    """Single-device stacked-scan CE loss — the parity oracle for the pipeline."""
+    rope = rope_frequencies(cfg.head_dim, cfg.max_position, cfg.rope_theta)
+
+    def loss_fn(params: Params, ids: jnp.ndarray, targets: jnp.ndarray):
+        B, T = ids.shape
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+        cache = llama.init_cache(cfg, B, T, params["final_norm"].dtype)
+        hidden, _ = llama.forward(params, cfg, ids, positions, cache,
+                                  jnp.zeros((B,), jnp.int32), rope)
+        logits = llama.lm_head_logits(params, cfg, hidden)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    return loss_fn
+
+
+def make_train_step(cfg: ModelConfig, mesh: Mesh, num_microbatches: int,
+                    learning_rate: float = 1e-3, pp_axis: str = "pp",
+                    dp_axis: str = "dp"):
+    """(params, opt_state, ids, targets) -> (params, opt_state, loss), jitted
+    with donated state — the full training step the driver dry-runs.
+
+    AdamW on all params; grads arrive pp/dp-correct from the pipelined loss
+    (layer grads live pp-sharded, replicated grads are psummed by the shard_map
+    transpose). Optimizer state inherits each param's sharding via init-under-
+    jit, so moments stay distributed exactly like the weights.
+    """
+    import optax
+
+    loss_fn = pipelined_loss_fn(cfg, mesh, num_microbatches, pp_axis, dp_axis)
+    tx = optax.adamw(learning_rate)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, ids, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids, targets)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def init_opt_state(params):
+        return jax.jit(tx.init)(params)
+
+    return train_step, init_opt_state
+
+
+def pipeline_param_shardings(cfg: ModelConfig, mesh: Mesh,
+                             pp_axis: str = "pp") -> dict[str, Any]:
+    """NamedShardings for the training layout: stacked layer dim over pp,
+    everything else replicated (tp-within-stage composes later via the serving
+    shardings; training parity runs tp=1)."""
+    def lyr(_):
+        return NamedSharding(mesh, P(pp_axis))
+
+    out: dict[str, Any] = {
+        "embed": NamedSharding(mesh, P()),
+        "final_norm": NamedSharding(mesh, P()),
+        "layers": jax.tree.map(lyr, _layer_tree(cfg)),
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = NamedSharding(mesh, P())
+    return out
+
+
+def _layer_tree(cfg: ModelConfig) -> dict:
+    """Shape-only skeleton of the stacked layer tree (for sharding maps)."""
+    names = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"]
+    names += (["router", "moe_gate", "moe_up", "moe_down"]
+              if cfg.num_experts > 0 else ["gate", "up", "down"])
+    return {n: 0 for n in names}

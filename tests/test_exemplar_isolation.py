@@ -74,7 +74,7 @@ def stack():
     loop.close()
 
 
-def _req(loop, method, url, token, json_body=None, raw=False):
+def _req(loop, method, url, token, json_body=None):
     async def go():
         async with aiohttp.ClientSession() as s:
             async with s.request(method, url, json=json_body, headers={
