@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import base64
 import logging
+import re
 from typing import Any, Optional
 
 import aiohttp
@@ -143,12 +144,17 @@ class MediaAdapter:
         form = aiohttp.FormData()
         # canonical extensions — providers validate by filename suffix and
         # reject subtypes like "x-wav" or "mpeg"
-        subtype = mime.split(";")[0].strip().lower()
+        mtype = mime.split(";")[0].strip().lower()
         ext = {"audio/wav": "wav", "audio/x-wav": "wav", "audio/wave": "wav",
                "audio/mpeg": "mp3", "audio/mp3": "mp3", "audio/mp4": "m4a",
                "audio/x-m4a": "m4a", "audio/ogg": "ogg", "audio/opus": "opus",
-               "audio/flac": "flac", "audio/webm": "webm",
-               }.get(subtype) or (subtype.split("/", 1)[-1] or "wav")
+               "audio/flac": "flac", "audio/webm": "webm"}.get(mtype)
+        if ext is None:
+            # unmapped mime: the subtype is usable iff it already looks like a
+            # canonical extension (aac, mp2, 3gpp) — vendor subtypes
+            # (x-aiff, vnd.dlna.adts) are not; default those to wav
+            sub = mtype.split("/", 1)[-1]
+            ext = sub if re.fullmatch(r"[a-z0-9]{1,4}", sub) else "wav"
         form.add_field("file", audio, filename=f"audio.{ext}",
                        content_type=mime)
         form.add_field("model", model.provider_model_id)
