@@ -1,0 +1,86 @@
+"""Plugin selection — vendor/priority choice + single-flight cached resolution.
+
+Reference: libs/modkit/src/plugins/mod.rs — ``GtsPluginSelector`` (single-flight
+cached instance id, :14-98) and ``choose_plugin_instance`` (lowest-priority
+instance matching a vendor, :136-192). The gateway+plugins pattern registers
+plugin impls in the ClientHub scoped by GTS instance id; a gateway resolves
+WHICH instance to use once, caches the id, and every later call takes the
+lock-free fast path.
+
+asyncio rendition: the fast path is a plain attribute read (safe under the
+GIL); the slow path holds an asyncio.Lock so concurrent first-callers share
+one resolve() — a failing resolve caches nothing and the next caller retries.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable, Iterable, Optional
+
+
+class PluginNotFound(LookupError):
+    """No plugin instance matched the requested vendor."""
+
+    def __init__(self, vendor: str) -> None:
+        super().__init__(f"no plugin instances found for vendor {vendor!r}")
+        self.vendor = vendor
+
+
+def choose_plugin_instance(
+    vendor: str,
+    instances: Iterable[tuple[str, dict[str, Any]]],
+) -> str:
+    """Pick the gts_id of the LOWEST-priority instance whose content matches
+    ``vendor``. ``instances`` yields (gts_id, content) where content carries
+    "vendor" and "priority" (the GTS plugin-instance schema). Instances with
+    malformed content are skipped, mirroring the reference's tolerant scan."""
+    best: Optional[tuple[str, int]] = None
+    for gts_id, content in instances:
+        if not isinstance(content, dict):
+            continue
+        if content.get("vendor") != vendor:
+            continue
+        priority = content.get("priority")
+        if not isinstance(priority, int):
+            continue
+        if best is None or priority < best[1]:
+            best = (gts_id, priority)
+    if best is None:
+        raise PluginNotFound(vendor)
+    return best[0]
+
+
+class GtsPluginSelector:
+    """Single-flight cached plugin-instance id.
+
+    ``get_or_init(resolve)`` returns the cached id or runs ``resolve`` exactly
+    once even under concurrent callers; ``reset()`` invalidates (returns
+    whether a cached value was dropped) — call it when the instance registry
+    changes."""
+
+    def __init__(self) -> None:
+        self._cached: Optional[str] = None
+        self._lock = asyncio.Lock()
+
+    async def get_or_init(
+        self, resolve: Callable[[], Awaitable[str]]
+    ) -> str:
+        cached = self._cached  # fast path: no lock
+        if cached is not None:
+            return cached
+        async with self._lock:
+            if self._cached is not None:  # resolved while we waited
+                return self._cached
+            value = await resolve()
+            self._cached = value
+            return value
+
+    async def reset(self) -> bool:
+        async with self._lock:
+            had = self._cached is not None
+            self._cached = None
+            return had
+
+    @property
+    def cached(self) -> Optional[str]:
+        return self._cached

@@ -15,7 +15,9 @@ from typing import Optional
 from aiohttp import web
 
 from ..modkit import Module, module
+from ..modkit.client_hub import ClientHub, ClientScope
 from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
+from ..modkit.plugins import GtsPluginSelector, choose_plugin_instance
 from ..modkit.context import ModuleCtx
 from ..modkit.db import ScopableEntity
 from ..modkit.errors import ProblemError
@@ -39,6 +41,10 @@ _MIGRATIONS = [
 ]
 
 _SHARING_MODES = ("private", "tenant", "shared")
+
+#: GTS instance id of the built-in sqlite plugin (the gateway's selector picks
+#: among registered instances by vendor + lowest priority)
+SQLITE_PLUGIN_GTS_ID = "gts.x.core.credstore.plugin.v1~gts.x.core.credstore.sqlite.v1"
 
 
 class CredStorePluginApi(abc.ABC):
@@ -64,6 +70,10 @@ class SqliteCredPlugin(CredStorePluginApi):
     keyfile under the server home dir. The tenant id is bound as AAD so a row
     copied between tenants fails authentication. Legacy plaintext rows (no
     ``enc:v1:`` prefix) still read, and re-encrypt on the next put."""
+
+    #: GTS plugin-instance content the selector matches on (vendor/priority)
+    instance_content = {"id": SQLITE_PLUGIN_GTS_ID, "vendor": "sqlite",
+                        "priority": 100}
 
     _PREFIX = "enc:v1:"
 
@@ -158,20 +168,43 @@ class SqliteCredPlugin(CredStorePluginApi):
 
 class CredStoreGateway(CredStoreApi):
     """Hierarchical resolution: own tenant first (any mode), then ancestors —
-    where only 'tenant'-shared (subtree) and 'shared' secrets are visible."""
+    where only 'tenant'-shared (subtree) and 'shared' secrets are visible.
 
-    def __init__(self, plugin: CredStorePluginApi,
-                 tenants: Optional[TenantResolverApi]) -> None:
-        self._plugin = plugin
+    Plugin choice goes through the modkit plugin selector: the hub holds every
+    plugin impl scoped by GTS instance id; the gateway resolves the configured
+    vendor's lowest-priority instance ONCE (single-flight, cached) and every
+    later call takes the lock-free path (libs/modkit/src/plugins/mod.rs)."""
+
+    def __init__(self, hub: ClientHub, tenants: Optional[TenantResolverApi],
+                 vendor: str = "sqlite") -> None:
+        self._hub = hub
         self._tenants = tenants
+        self._vendor = vendor
+        self._selector = GtsPluginSelector()
+
+    async def _resolve_instance(self) -> str:
+        instances = (
+            (gts_id, getattr(impl, "instance_content", {}))
+            for gts_id, impl in self._hub.scoped_instances(CredStorePluginApi).items()
+        )
+        return choose_plugin_instance(self._vendor, instances)
+
+    async def _plugin(self) -> CredStorePluginApi:
+        gts_id = await self._selector.get_or_init(self._resolve_instance)
+        return self._hub.get(CredStorePluginApi, ClientScope.for_gts_id(gts_id))
+
+    async def invalidate_plugin(self) -> bool:
+        """Drop the cached selection (call when plugin registrations change)."""
+        return await self._selector.reset()
 
     async def get_secret(self, ctx: SecurityContext, key: str) -> Optional[str]:
-        hit = self._plugin.get(ctx.tenant_id, key)
+        plugin = await self._plugin()
+        hit = plugin.get(ctx.tenant_id, key)
         if hit is not None:
             return hit[0]
         chain = (await self._tenants.walk_up(ctx.tenant_id))[1:] if self._tenants else []
         for ancestor in chain:
-            hit = self._plugin.get(ancestor, key)
+            hit = plugin.get(ancestor, key)
             if hit is not None and hit[1] in ("tenant", "shared"):
                 return hit[0]
         return None
@@ -181,10 +214,10 @@ class CredStoreGateway(CredStoreApi):
         if sharing not in _SHARING_MODES:
             raise ProblemError.bad_request(
                 f"sharing must be one of {_SHARING_MODES}", code="bad_sharing_mode")
-        self._plugin.put(ctx.tenant_id, key, value, sharing)
+        (await self._plugin()).put(ctx.tenant_id, key, value, sharing)
 
     async def delete_secret(self, ctx: SecurityContext, key: str) -> bool:
-        return self._plugin.delete(ctx.tenant_id, key)
+        return (await self._plugin()).delete(ctx.tenant_id, key)
 
 
 @module(name="credstore", deps=["tenant_resolver"], capabilities=["db", "rest"])
@@ -198,9 +231,14 @@ class CredStoreModule(Module, DatabaseCapability, RestApiCapability):
     async def init(self, ctx: ModuleCtx) -> None:
         plugin = SqliteCredPlugin(ctx)
         tenants = ctx.client_hub.try_get(TenantResolverApi)
-        self.gateway = CredStoreGateway(plugin, tenants)
+        self.gateway = CredStoreGateway(ctx.client_hub, tenants)
         ctx.client_hub.register(CredStoreApi, self.gateway)
+        # unscoped registration = direct access seam; the scoped one is what
+        # the gateway's plugin selector resolves by vendor/priority
         ctx.client_hub.register(CredStorePluginApi, plugin)
+        ctx.client_hub.register(
+            CredStorePluginApi, plugin,
+            ClientScope.for_gts_id(SQLITE_PLUGIN_GTS_ID))
 
     def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
         gw = self.gateway

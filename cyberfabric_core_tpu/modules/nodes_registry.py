@@ -8,7 +8,6 @@ available).
 
 from __future__ import annotations
 
-import os
 import platform
 import time
 import uuid
@@ -16,7 +15,7 @@ from typing import Any, Optional
 
 from aiohttp import web
 
-from ..modkit import Module, module
+from ..modkit import Module, module, node_info
 from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
 from ..modkit.context import ModuleCtx
 from ..modkit.db import ScopableEntity
@@ -42,46 +41,16 @@ _MIGRATIONS = [
 
 
 def collect_sys_info() -> dict[str, Any]:
-    """Host telemetry (modkit-node-info/src/model.rs NodeSysInfo analogue)."""
-    info: dict[str, Any] = {
-        "os": platform.system().lower(),
-        "os_version": platform.release(),
-        "arch": platform.machine(),
-        "python": platform.python_version(),
-        "cpu_count": os.cpu_count(),
-    }
-    try:
-        with open("/proc/meminfo") as f:
-            for line in f:
-                if line.startswith("MemTotal:"):
-                    info["memory_kb"] = int(line.split()[1])
-                    break
-    except OSError:
-        pass
+    """Full NodeSysInfo document — os/cpu/memory/host/battery/hardware-uuid
+    collectors live in modkit.node_info (modkit-node-info/src/model.rs:13-22)."""
+    info = node_info.collect_node_sys_info()
+    info.pop("accelerators", None)  # stored in their own column
     return info
 
 
 def collect_accelerators() -> list[dict[str, Any]]:
     """Accelerator inventory via JAX (the NVML-collector analogue for TPU)."""
-    try:
-        import jax
-
-        out = []
-        for d in jax.devices():
-            dev: dict[str, Any] = {
-                "id": d.id, "platform": d.platform, "kind": getattr(d, "device_kind", "?"),
-            }
-            try:
-                stats = d.memory_stats()
-                if stats:
-                    dev["hbm_bytes_limit"] = stats.get("bytes_limit")
-                    dev["hbm_bytes_in_use"] = stats.get("bytes_in_use")
-            except Exception:
-                pass
-            out.append(dev)
-        return out
-    except Exception:
-        return []
+    return node_info.collect_accelerators()
 
 
 @module(name="nodes_registry", capabilities=["db", "rest"])
@@ -146,7 +115,16 @@ class NodesRegistryModule(Module, DatabaseCapability, RestApiCapability):
             created = conn.insert(payload)
             return {"id": created["id"], "status": "registered"}, 201
 
+        async def local_syscaps(request: web.Request):
+            """Live capability probe of THIS host (NodeSysCap analogue —
+            syscap_collector.rs)."""
+            return {"capabilities": node_info.collect_syscaps(),
+                    "collected_at": time.time()}
+
         m = "nodes_registry"
+        router.operation("GET", "/v1/nodes/self/syscaps", module=m).auth_required() \
+            .summary("This host's system capabilities").handler(local_syscaps) \
+            .register()
         router.operation("GET", "/v1/nodes", module=m).auth_required() \
             .summary("List registered nodes").handler(list_nodes).register()
         router.operation("GET", "/v1/nodes/{node_id}", module=m).auth_required() \

@@ -1,0 +1,178 @@
+"""Data-parallel serving pool — request fan-out over model replicas.
+
+SURVEY §2.6 "DP request fan-out": the dp mesh axis gives independent model
+replicas; this pool is the *serving-path* half — a front-end router that
+spreads live requests across N ContinuousBatchingEngine replicas, each pinned
+to its own device (or tp-subset of the mesh), with health tracking and
+transparent failover.
+
+TPU-first shape: replicas are whole engines (own params copy, own KV pool, own
+scheduler thread, own jit cache) — replication is at the *request* level, not
+inside one program, so one replica's device fault (the reference's analogue:
+one worker process dying under a NCCL fault) cannot take down the others.
+
+Routing: least-loaded healthy replica (active slots + queued). Failover: when a
+replica breaks mid-request (its scheduler loop emits ``error``), the pool
+re-submits the request to another healthy replica — already-emitted tokens are
+carried as prompt continuation so the client stream continues seamlessly; the
+retry is invisible apart from latency.
+
+Reference parity anchor: modules/llm-gateway/docs/DESIGN.md resilience FRs
+(provider failover / fallback chains) — this is the same policy one level
+down, at the model-replica tier.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+
+from .engine import EngineConfig, SamplingParams, StepEvent
+from .scheduler import ContinuousBatchingEngine
+
+logger = logging.getLogger("replicas")
+
+
+@dataclass
+class _Tracked:
+    """Host-side request record enabling failover resubmission."""
+    prompt_ids: list[int]
+    sampling: SamplingParams
+    emit: Callable[[StepEvent], None]
+    emitted: list[int]
+    replica: int
+    retries_left: int
+    done: bool = False
+
+
+class DataParallelServingPool:
+    """N continuous-batching replicas behind one submit()."""
+
+    def __init__(
+        self,
+        config: EngineConfig,
+        n_replicas: int,
+        devices: Optional[list[Any]] = None,
+        seed: int = 0,
+        max_retries: int = 1,
+    ) -> None:
+        devices = devices if devices is not None else jax.devices()
+        if n_replicas > len(devices):
+            raise ValueError(
+                f"{n_replicas} replicas need {n_replicas} devices, have {len(devices)}")
+        self.config = config
+        self.max_retries = max_retries
+        self._lock = threading.Lock()
+        self._requests: dict[str, _Tracked] = {}
+        self.replicas: list[ContinuousBatchingEngine] = []
+        self.devices = devices[:n_replicas]
+        for dev in self.devices:
+            # params committed to the replica's device and the scheduler thread
+            # pinned there (engine `device=`); same seed → identical weights on
+            # every replica (a data-parallel serving pool is N copies of ONE
+            # model)
+            self.replicas.append(
+                ContinuousBatchingEngine(config, seed=seed, device=dev))
+        logger.info("serving pool: %d replicas over %s", n_replicas,
+                    [str(d) for d in self.devices])
+
+    # ------------------------------------------------------------------ routing
+    def _healthy(self) -> list[int]:
+        return [i for i, r in enumerate(self.replicas) if r.stats()["broken"] is None]
+
+    def _pick(self) -> int:
+        """Least-loaded healthy replica (active slots + pending queue)."""
+        best, best_load = None, None
+        for i in self._healthy():
+            s = self.replicas[i].stats()
+            load = s["active"] + s["pending"]
+            if best_load is None or load < best_load:
+                best, best_load = i, load
+        if best is None:
+            raise RuntimeError("no healthy replicas")
+        return best
+
+    def submit(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams,
+        emit: Callable[[StepEvent], None],
+        request_id: Optional[str] = None,
+    ) -> str:
+        idx = self._pick()
+        tracked = _Tracked(list(prompt_ids), sampling, emit, [], idx,
+                           self.max_retries)
+        rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
+        self.replicas[idx].submit(prompt_ids, sampling,
+                                  self._wrap(rid, tracked), rid)
+        with self._lock:
+            self._requests[rid] = tracked
+        return rid
+
+    def _wrap(self, rid: str, tracked: _Tracked) -> Callable[[StepEvent], None]:
+        """Intercept the replica's events: record progress, fail over on error,
+        drop the tracking record once the request finishes."""
+
+        def emit(ev: StepEvent) -> None:
+            if ev.finished == "error" and tracked.retries_left > 0 and not tracked.done:
+                tracked.retries_left -= 1
+                if self._failover(rid, tracked):
+                    return  # resubmitted; suppress the error event
+            if ev.token_id >= 0:
+                tracked.emitted.append(ev.token_id)
+            if ev.finished is not None:
+                tracked.done = True
+                with self._lock:
+                    self._requests.pop(rid, None)
+            tracked.emit(ev)
+
+        return emit
+
+    def _failover(self, rid: str, tracked: _Tracked) -> bool:
+        """Resubmit on another healthy replica, carrying emitted tokens as
+        prompt continuation (remaining budget shrinks accordingly)."""
+        try:
+            idx = self._pick()
+        except RuntimeError:
+            return False
+        remaining = tracked.sampling.max_tokens - len(tracked.emitted)
+        if remaining <= 0:
+            return False
+        import dataclasses
+
+        cont_prompt = tracked.prompt_ids + tracked.emitted
+        cont_sampling = dataclasses.replace(tracked.sampling, max_tokens=remaining)
+        old = tracked.replica
+        tracked.replica = idx
+        logger.warning("failover: replica %d broke; resuming request on %d "
+                       "(%d tokens emitted, %d budget left)",
+                       old, idx, len(tracked.emitted), remaining)
+        try:
+            self.replicas[idx].submit(cont_prompt, cont_sampling,
+                                      self._wrap(rid, tracked))
+            return True
+        except Exception:  # noqa: BLE001 — fall through to the error event
+            logger.exception("failover resubmission failed")
+            return False
+
+    # ------------------------------------------------------------------ admin
+    def stats(self) -> dict[str, Any]:
+        per = [r.stats() for r in self.replicas]
+        return {
+            "replicas": len(self.replicas),
+            "healthy": len(self._healthy()),
+            "active": sum(s["active"] for s in per),
+            "pending": sum(s["pending"] for s in per),
+            "tokens_emitted": sum(s["tokens_emitted"] for s in per),
+            "requests_completed": sum(s["requests_completed"] for s in per),
+            "per_replica": per,
+        }
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        for r in self.replicas:
+            r.shutdown(timeout)

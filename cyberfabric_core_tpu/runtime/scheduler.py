@@ -41,6 +41,12 @@ from .engine import EngineConfig, SamplingParams, StepEvent, build_decode_chunk_
 logger = logging.getLogger("scheduler")
 
 
+def _null_ctx():
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
 @dataclass
 class _SlotState:
     request_id: str
@@ -72,12 +78,28 @@ class ContinuousBatchingEngine:
         model_config: Optional[ModelConfig] = None,
         params: Optional[Any] = None,
         seed: int = 0,
+        device: Optional[Any] = None,
     ) -> None:
         self.config = config
         self.model_config = model_config or get_config(config.model)
         self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.dtype(config.dtype)
+        # device pinning (DP replica pools): params are COMMITTED to the device
+        # and the scheduler thread sets it as its default, so every program this
+        # engine compiles — and every host->device transfer it makes — lands
+        # there, not on jax.devices()[0]
+        self.device = device
+        self._device_ctx = (lambda: jax.default_device(self.device)) \
+            if device is not None else _null_ctx
+        import contextlib
+
+        _init_ctx = contextlib.ExitStack()  # rest of __init__ allocates on-device
+        if device is not None:
+            _init_ctx.enter_context(jax.default_device(device))
         if params is None:
-            params = llama.init_params(self.model_config, jax.random.PRNGKey(seed), self.dtype)
+            params = llama.init_params(
+                self.model_config, jax.random.PRNGKey(seed), self.dtype)
+        elif device is not None:
+            params = jax.device_put(params, device)
         self.params = params
         self.rope_tables = rope_frequencies(
             self.model_config.head_dim,
@@ -143,6 +165,7 @@ class ContinuousBatchingEngine:
         self.tokens_emitted = 0
         self.requests_completed = 0
         self.occupancy_samples: "deque[int]" = deque(maxlen=1000)
+        _init_ctx.close()
 
     # ------------------------------------------------------------------ programs
     def _build_programs(self) -> None:
@@ -275,6 +298,10 @@ class ContinuousBatchingEngine:
     def _run_loop(self) -> None:
         logger.info("continuous scheduler up: %d slots, chunk %d",
                     self.n_slots, self._k_steps)
+        with self._device_ctx():
+            self._loop_body()
+
+    def _loop_body(self) -> None:
         while not self._stop.is_set():
             try:
                 admitted = self._admit()

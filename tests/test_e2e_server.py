@@ -395,7 +395,8 @@ def test_nodes_registry_self_registration(server):
     assert status == 200
     assert len(body["items"]) >= 1
     node = body["items"][0]
-    assert node["sys_info"]["cpu_count"] >= 1
+    assert node["sys_info"]["cpu"]["num_cpus"] >= 1
+    assert node["sys_info"]["memory"]["total_bytes"] > 0
 
 
 def test_batches_api(server):

@@ -1,0 +1,72 @@
+"""modkit-node-info collectors (modkit/node_info.py) — run against the real
+Linux host; every collector must return the reference model's fields
+(libs/modkit-node-info/src/model.rs:13-95) without raising."""
+
+from cyberfabric_core_tpu.modkit import node_info
+
+
+def test_os_info_fields():
+    osi = node_info.collect_os()
+    assert set(osi) == {"name", "version", "arch"}
+    assert osi["name"] and osi["arch"]
+
+
+def test_cpu_info_fields():
+    cpu = node_info.collect_cpu()
+    assert set(cpu) == {"model", "num_cpus", "cores", "frequency_mhz"}
+    assert cpu["num_cpus"] >= 1
+    assert cpu["cores"] >= 1
+
+
+def test_memory_info_consistency():
+    mem = node_info.collect_memory()
+    assert set(mem) == {"total_bytes", "available_bytes", "used_bytes",
+                        "used_percent"}
+    assert mem["total_bytes"] > 0
+    assert mem["used_bytes"] == mem["total_bytes"] - mem["available_bytes"]
+    assert 0 <= mem["used_percent"] <= 100
+
+
+def test_host_info_fields():
+    host = node_info.collect_host()
+    assert host["hostname"]
+    assert host["uptime_seconds"] >= 0
+    assert isinstance(host["ip_addresses"], list)
+
+
+def test_battery_optional():
+    bat = node_info.collect_battery()
+    if bat is not None:  # battery-less servers return None
+        assert set(bat) == {"on_battery", "percentage"}
+        assert 0 <= bat["percentage"] <= 100
+
+
+def test_hardware_uuid_stable():
+    a, b = node_info.hardware_uuid(), node_info.hardware_uuid()
+    assert a == b  # stable identity; may be None in exotic containers
+
+
+def test_accelerators_list():
+    accs = node_info.collect_accelerators()
+    assert isinstance(accs, list)
+    for d in accs:
+        assert {"id", "platform", "model"} <= set(d)
+
+
+def test_syscaps_matrix():
+    caps = node_info.collect_syscaps()
+    keys = {c["key"] for c in caps}
+    assert "runtime.python" in keys
+    assert "runtime.jax" in keys
+    assert "toolchain.g++" in keys
+    for c in caps:
+        assert {"key", "category", "name", "display_name", "present",
+                "version", "amount", "amount_dimension"} <= set(c)
+    py = next(c for c in caps if c["key"] == "runtime.python")
+    assert py["present"] and py["version"]
+
+
+def test_full_document():
+    doc = node_info.collect_node_sys_info()
+    assert {"os", "cpu", "memory", "host", "accelerators", "battery",
+            "hardware_uuid", "collected_at"} <= set(doc)

@@ -1,0 +1,138 @@
+"""Plugin selector: vendor/priority choice + single-flight caching
+(modkit/plugins.py; reference libs/modkit/src/plugins/mod.rs)."""
+
+import asyncio
+
+import pytest
+
+from cyberfabric_core_tpu.modkit.plugins import (
+    GtsPluginSelector,
+    PluginNotFound,
+    choose_plugin_instance,
+)
+
+
+def test_choose_lowest_priority_for_vendor():
+    instances = [
+        ("gts.a~1", {"id": "gts.a~1", "vendor": "acme", "priority": 50}),
+        ("gts.a~2", {"id": "gts.a~2", "vendor": "acme", "priority": 10}),
+        ("gts.b~1", {"id": "gts.b~1", "vendor": "other", "priority": 1}),
+    ]
+    assert choose_plugin_instance("acme", instances) == "gts.a~2"
+
+
+def test_choose_skips_malformed_content():
+    instances = [
+        ("bad1", "not-a-dict"),
+        ("bad2", {"vendor": "acme", "priority": "high"}),  # non-int priority
+        ("ok", {"vendor": "acme", "priority": 5}),
+    ]
+    assert choose_plugin_instance("acme", instances) == "ok"
+
+
+def test_choose_no_match_raises():
+    with pytest.raises(PluginNotFound):
+        choose_plugin_instance("ghost", [("x", {"vendor": "acme", "priority": 1})])
+
+
+def test_single_flight_resolution():
+    """Concurrent first callers share exactly one resolve()."""
+    sel = GtsPluginSelector()
+    calls = {"n": 0}
+
+    async def resolve():
+        calls["n"] += 1
+        await asyncio.sleep(0.05)
+        return "gts.chosen~1"
+
+    async def go():
+        results = await asyncio.gather(*[sel.get_or_init(resolve) for _ in range(8)])
+        assert set(results) == {"gts.chosen~1"}
+        # cached: further calls don't resolve again
+        assert await sel.get_or_init(resolve) == "gts.chosen~1"
+
+    asyncio.run(go())
+    assert calls["n"] == 1
+
+
+def test_failed_resolve_is_not_cached():
+    sel = GtsPluginSelector()
+    calls = {"n": 0}
+
+    async def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("registry not ready")
+        return "gts.ok~1"
+
+    async def go():
+        with pytest.raises(RuntimeError):
+            await sel.get_or_init(flaky)
+        assert sel.cached is None
+        assert await sel.get_or_init(flaky) == "gts.ok~1"
+
+    asyncio.run(go())
+    assert calls["n"] == 2
+
+
+def test_reset_invalidates():
+    sel = GtsPluginSelector()
+
+    async def go():
+        assert await sel.reset() is False  # nothing cached yet
+        await sel.get_or_init(_const("a"))
+        assert await sel.reset() is True
+        assert await sel.get_or_init(_const("b")) == "b"
+
+    def _const(v):
+        async def f():
+            return v
+        return f
+
+    asyncio.run(go())
+
+
+def test_credstore_gateway_resolves_via_selector(client_hub):
+    """The credstore gateway picks its plugin by vendor/priority from the hub's
+    scoped instances and caches the choice."""
+    from cyberfabric_core_tpu.modkit.client_hub import ClientScope
+    from cyberfabric_core_tpu.modules.credstore import (
+        CredStoreGateway,
+        CredStorePluginApi,
+    )
+
+    class MemPlugin(CredStorePluginApi):
+        instance_content = {"vendor": "sqlite", "priority": 1}
+
+        def __init__(self):
+            self.data = {}
+
+        def get(self, tenant_id, key):
+            return self.data.get((tenant_id, key))
+
+        def put(self, tenant_id, key, value, sharing):
+            self.data[(tenant_id, key)] = (value, sharing)
+
+        def delete(self, tenant_id, key):
+            return self.data.pop((tenant_id, key), None) is not None
+
+    class Decoy(MemPlugin):
+        instance_content = {"vendor": "sqlite", "priority": 999}
+
+    winner, decoy = MemPlugin(), Decoy()
+    client_hub.register(CredStorePluginApi, winner, ClientScope.for_gts_id("gts.w~1"))
+    client_hub.register(CredStorePluginApi, decoy, ClientScope.for_gts_id("gts.d~1"))
+    gw = CredStoreGateway(client_hub, tenants=None)
+
+    from cyberfabric_core_tpu.modkit.security import SecurityContext
+
+    ctx = SecurityContext(subject="u", tenant_id="t1")
+
+    async def go():
+        await gw.put_secret(ctx, "k", "v")
+        assert await gw.get_secret(ctx, "k") == "v"
+
+    asyncio.run(go())
+    assert ("t1", "k") in winner.data       # lowest priority won
+    assert not decoy.data
+    assert gw._selector.cached == "gts.w~1"
