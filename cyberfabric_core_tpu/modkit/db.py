@@ -9,15 +9,15 @@ tenant scope. Entities opt in via ScopableEntity with four dimension columns
 (secure/entity_traits.rs:99-150). Migrations are the only sanctioned raw-SQL surface
 (advisory_locks.rs:6-9).
 
-Backend: sqlite3 (stdlib) with WAL + pragmas tuned per sqlite/pragmas.rs. The
-reference's PG/MySQL matrix is out of scope for a single-process TPU host; the
-Database API is backend-neutral so another engine can slot in.
+Backends are pluggable DbEngines (db_engine.py): sqlite (stdlib, WAL-tuned per
+sqlite/pragmas.rs) is the default; the PostgreSQL engine translates the qmark
+SQL the builders emit and maps advisory locks to pg_advisory_lock. The full
+SecureConn/OData matrix runs against both engines in tests/test_db_engines.py.
 """
 
 from __future__ import annotations
 
 import json
-import sqlite3
 import threading
 import uuid
 from dataclasses import dataclass
@@ -25,6 +25,7 @@ from pathlib import Path
 from typing import Any, Optional, Sequence
 
 from .contracts import Migration
+from .db_engine import DbEngine, SqliteEngine, engine_from_url
 from .odata import (
     ODataError,
     OrderField,
@@ -75,82 +76,79 @@ class ScopableEntity:
 
 
 class Database:
-    """One isolated store (per module). Thread-safe via a single lock — the TPU host
-    is asyncio-single-threaded; sqlite serializes anyway."""
+    """One isolated store (per module), backed by a pluggable
+    :class:`~.db_engine.DbEngine` (sqlite default; PG engine in db_engine.py).
+    Engines own thread safety; this class owns migrations + the secure ORM."""
 
-    def __init__(self, path: str | Path) -> None:
-        self._path = str(path)
-        # autocommit mode; transactions are managed explicitly (BEGIN/COMMIT) so that
-        # DDL inside migrations is actually transactional (sqlite3's legacy implicit
-        # transactions auto-commit DDL, which would break migration rollback)
-        self._conn = sqlite3.connect(self._path, check_same_thread=False, isolation_level=None)
-        self._conn.row_factory = sqlite3.Row
-        self._lock = threading.RLock()
-        with self._lock:
-            cur = self._conn.cursor()
-            # sqlite/pragmas.rs parity: WAL for concurrent readers, NORMAL sync
-            if self._path != ":memory:":
-                cur.execute("PRAGMA journal_mode=WAL")
-            cur.execute("PRAGMA synchronous=NORMAL")
-            cur.execute("PRAGMA foreign_keys=ON")
-            self._conn.commit()
+    def __init__(self, path: str | Path | None = None,
+                 engine: Optional[DbEngine] = None) -> None:
+        if engine is None:
+            if path is None:
+                raise ValueError("Database needs a path or an engine")
+            engine = SqliteEngine(path)
+        self._engine = engine
+
+    @classmethod
+    def from_engine(cls, engine: DbEngine) -> "Database":
+        return cls(engine=engine)
+
+    @property
+    def engine(self) -> DbEngine:
+        return self._engine
 
     # ------------------------------------------------------------------ migrations
     def run_migrations(self, migrations: Sequence[Migration]) -> int:
-        """Apply pending migrations in version order inside a transaction; records
-        them in ``_schema_migrations`` (migration_runner.rs)."""
-        with self._lock:
-            cur = self._conn.cursor()
-            cur.execute(
+        """Apply pending migrations in version order, each inside a transaction,
+        under a cross-process advisory lock (migration_runner.rs +
+        advisory_locks.rs: concurrent starters must not race DDL); records them
+        in ``_schema_migrations``."""
+        import datetime
+
+        eng = self._engine
+        with eng.advisory_lock("_migrations"):
+            eng.execute(
                 "CREATE TABLE IF NOT EXISTS _schema_migrations ("
-                "version TEXT PRIMARY KEY, applied_at TEXT NOT NULL DEFAULT (datetime('now')))"
+                "version TEXT PRIMARY KEY, applied_at TEXT NOT NULL)"
             )
-            applied = {r["version"] for r in cur.execute("SELECT version FROM _schema_migrations")}
+            applied = {r["version"] for r in eng.execute(
+                "SELECT version FROM _schema_migrations").rows}
             count = 0
+            now = datetime.datetime.now(datetime.timezone.utc).isoformat()
             for mig in sorted(migrations, key=lambda m: m.version):
                 if mig.version in applied:
                     continue
-                cur.execute("BEGIN")
-                try:
-                    mig.apply(self._conn)
-                    # NOTE: executescript() would implicitly COMMIT and break
-                    # atomicity — migrations must use conn.execute() statements
-                    if not self._conn.in_transaction:
-                        raise RuntimeError(
-                            f"migration {mig.version} committed implicitly "
-                            "(executescript?); use individual execute() calls"
-                        )
-                    cur.execute("INSERT INTO _schema_migrations(version) VALUES (?)", (mig.version,))
-                    cur.execute("COMMIT")
-                    count += 1
-                except Exception:
-                    if self._conn.in_transaction:
-                        cur.execute("ROLLBACK")
-                    raise
+                # version record commits ATOMICALLY with the migration's DDL
+                eng.executescript_tx(
+                    mig.apply,
+                    post_sql="INSERT INTO _schema_migrations(version, applied_at)"
+                             " VALUES (?, ?)",
+                    post_params=(mig.version, now))
+                count += 1
             return count
 
     def applied_migrations(self) -> list[str]:
-        with self._lock:
-            try:
-                rows = self._conn.execute(
-                    "SELECT version FROM _schema_migrations ORDER BY version"
-                ).fetchall()
-            except sqlite3.OperationalError:
-                return []
-            return [r["version"] for r in rows]
+        try:
+            rows = self._engine.execute(
+                "SELECT version FROM _schema_migrations ORDER BY version").rows
+        except Exception:  # noqa: BLE001 — table absent (engine-specific error)
+            return []
+        return [r["version"] for r in rows]
 
     # ------------------------------------------------------------------ secure access
     def secure(self, ctx: SecurityContext, entity: ScopableEntity) -> "SecureConn":
         """The only query surface — scoped by construction (secure_conn.rs:5-12)."""
         return SecureConn(self, ctx, entity)
 
-    def raw_for_migrations(self) -> sqlite3.Connection:
+    def raw_for_migrations(self) -> Any:
         """Escape hatch for migration authors ONLY (advisory_locks.rs:6-9)."""
-        return self._conn
+        return self._engine.raw_connection()
+
+    def advisory_lock(self, key: str):
+        """Cross-process advisory lock scoped to this store (advisory_locks.rs)."""
+        return self._engine.advisory_lock(key)
 
     def close(self) -> None:
-        with self._lock:
-            self._conn.close()
+        self._engine.close()
 
 
 class SecureConn:
@@ -231,7 +229,7 @@ class SecureConn:
                 out[k] = v
         return out
 
-    def _decode(self, row: sqlite3.Row) -> dict[str, Any]:
+    def _decode(self, row: dict[str, Any]) -> dict[str, Any]:
         out = dict(row)
         for k in self._entity.json_cols:
             if out.get(k) is not None:
@@ -252,21 +250,18 @@ class SecureConn:
         enc = self._encode(values)
         cols = ", ".join(enc)
         marks = ", ".join("?" for _ in enc)
-        with self._db._lock:
-            self._db._conn.execute(
-                f"INSERT INTO {ent.table} ({cols}) VALUES ({marks})", list(enc.values())
-            )
-            self._db._conn.commit()
+        self._db.engine.execute(
+            f"INSERT INTO {ent.table} ({cols}) VALUES ({marks})", list(enc.values())
+        )
         return values
 
     def get(self, pk: Any) -> Optional[dict[str, Any]]:
         ent = self._entity
         scope_sql, scope_params = self._scope_clause()
-        with self._db._lock:
-            row = self._db._conn.execute(
-                f"SELECT * FROM {ent.table} WHERE {ent.primary_key} = ? AND {scope_sql}",
-                [pk, *scope_params],
-            ).fetchone()
+        row = self._db.engine.execute(
+            f"SELECT * FROM {ent.table} WHERE {ent.primary_key} = ? AND {scope_sql}",
+            [pk, *scope_params],
+        ).fetchone()
         return self._decode(row) if row else None
 
     def find_one(self, where: dict[str, Any]) -> Optional[dict[str, Any]]:
@@ -297,8 +292,7 @@ class SecureConn:
             sql += f" ORDER BY {order_by} {'DESC' if descending else 'ASC'}"
         if limit is not None:
             sql += f" LIMIT {int(limit)}"
-        with self._db._lock:
-            rows = self._db._conn.execute(sql, params).fetchall()
+        rows = self._db.engine.execute(sql, params).rows
         return [self._decode(r) for r in rows]
 
     def update(self, pk: Any, changes: dict[str, Any]) -> bool:
@@ -313,24 +307,20 @@ class SecureConn:
         enc = self._encode(dict(changes))
         sets = ", ".join(f"{c} = ?" for c in enc)
         scope_sql, scope_params = self._scope_clause()
-        with self._db._lock:
-            cur = self._db._conn.execute(
-                f"UPDATE {ent.table} SET {sets} WHERE {ent.primary_key} = ? AND {scope_sql}",
-                [*enc.values(), pk, *scope_params],
-            )
-            self._db._conn.commit()
-        return cur.rowcount > 0
+        res = self._db.engine.execute(
+            f"UPDATE {ent.table} SET {sets} WHERE {ent.primary_key} = ? AND {scope_sql}",
+            [*enc.values(), pk, *scope_params],
+        )
+        return res.rowcount > 0
 
     def delete(self, pk: Any) -> bool:
         ent = self._entity
         scope_sql, scope_params = self._scope_clause()
-        with self._db._lock:
-            cur = self._db._conn.execute(
-                f"DELETE FROM {ent.table} WHERE {ent.primary_key} = ? AND {scope_sql}",
-                [pk, *scope_params],
-            )
-            self._db._conn.commit()
-        return cur.rowcount > 0
+        res = self._db.engine.execute(
+            f"DELETE FROM {ent.table} WHERE {ent.primary_key} = ? AND {scope_sql}",
+            [pk, *scope_params],
+        )
+        return res.rowcount > 0
 
     def count(self, where: Optional[dict[str, Any]] = None) -> int:
         ent = self._entity
@@ -340,8 +330,7 @@ class SecureConn:
         for col, val in (where or {}).items():
             sql += f" AND {col} = ?"
             params.append(val)
-        with self._db._lock:
-            return self._db._conn.execute(sql, params).fetchone()["n"]
+        return self._db.engine.execute(sql, params).fetchone()["n"]
 
     # ------------------------------------------------------------------ OData listing
     def list_odata(
@@ -394,8 +383,7 @@ class SecureConn:
             f"SELECT * FROM {ent.table} WHERE {' AND '.join(where_parts)} "
             f"ORDER BY {order_sql} LIMIT {lim + 1}"
         )
-        with self._db._lock:
-            rows = self._db._conn.execute(sql, params).fetchall()
+        rows = self._db.engine.execute(sql, params).rows
         items = [self._decode(r) for r in rows[:lim]]
         has_more = len(rows) > lim
         next_cursor = None
@@ -422,12 +410,17 @@ def _keyset_predicate(order_cols: list[tuple[str, bool]], key_vals: list[Any]) -
 
 
 class DbManager:
-    """Per-module isolated databases under ``<home_dir>/db/<module>.sqlite``
-    (manager.rs: per-module isolation policy). ``:memory:`` for tests/--mock."""
+    """Per-module isolated databases (manager.rs: per-module isolation policy
+    derived from a server template). Default template: sqlite files under
+    ``<home_dir>/db/<module>.sqlite``; ``:memory:`` for tests/--mock; a
+    ``url_template`` like ``postgres://…/{module}`` switches every module store
+    to another engine (manager.rs: engine choice is server config)."""
 
-    def __init__(self, home_dir: Optional[Path] = None, in_memory: bool = False) -> None:
+    def __init__(self, home_dir: Optional[Path] = None, in_memory: bool = False,
+                 url_template: Optional[str] = None) -> None:
         self._home = home_dir
-        self._in_memory = in_memory or home_dir is None
+        self._in_memory = in_memory or (home_dir is None and url_template is None)
+        self._url_template = url_template
         self._dbs: dict[str, Database] = {}
         self._lock = threading.Lock()
 
@@ -435,7 +428,10 @@ class DbManager:
         with self._lock:
             db = self._dbs.get(module_name)
             if db is None:
-                if self._in_memory:
+                if self._url_template is not None:
+                    db = Database.from_engine(
+                        engine_from_url(self._url_template.format(module=module_name)))
+                elif self._in_memory:
                     db = Database(":memory:")
                 else:
                     assert self._home is not None

@@ -1,0 +1,292 @@
+"""Database engines — the backend seam beneath ``modkit.db.Database``.
+
+Reference: libs/modkit-db supports a sqlite/PG/MySQL matrix behind one
+DbManager (manager.rs derives per-module connections from server templates;
+advisory_locks.rs exposes cross-process advisory locks on PG). Round 1 shipped
+sqlite wired directly into ``Database``; this module makes the backend a real
+interface with TWO implementations:
+
+- :class:`SqliteEngine` — the production default (stdlib sqlite3, WAL).
+- :class:`PostgresEngine` — complete engine + dialect (placeholder
+  translation, dict rows, advisory locks via ``pg_advisory_lock``); takes any
+  DB-API-2 psycopg-style driver. The bare TPU image ships no PG driver, so the
+  engine raises a clear error without one — the full SecureConn/OData matrix
+  runs against it in tests through an injected driver (tests/test_db_engines.py),
+  which is what keeps the "swappable" claim honest.
+
+Engines speak *qmark* placeholder SQL (the style the query builders emit) and
+translate to their driver's style at execute time. Rows come back as plain
+dicts so callers never see a driver cursor type.
+
+Advisory locks (advisory_locks.rs parity): ``engine.advisory_lock(key)`` is a
+context manager. PG maps to session advisory locks; sqlite maps to ``flock``
+on a per-key sidecar file (real cross-process semantics for the file-backed
+case) or an in-process lock table for ``:memory:``.
+"""
+
+from __future__ import annotations
+
+import abc
+import contextlib
+import hashlib
+import sqlite3
+import threading
+from pathlib import Path
+from typing import Any, Iterator, Optional, Sequence
+
+
+class ExecResult:
+    """Uniform result: materialized dict rows + rowcount."""
+
+    __slots__ = ("rows", "rowcount")
+
+    def __init__(self, rows: list[dict[str, Any]], rowcount: int) -> None:
+        self.rows = rows
+        self.rowcount = rowcount
+
+    def fetchone(self) -> Optional[dict[str, Any]]:
+        return self.rows[0] if self.rows else None
+
+
+class DbEngine(abc.ABC):
+    """Executes qmark-style SQL; owns the connection + its thread safety."""
+
+    #: dialect name, for feature gates and diagnostics
+    name: str = "?"
+
+    @abc.abstractmethod
+    def execute(self, sql: str, params: Sequence[Any] = ()) -> ExecResult: ...
+
+    @abc.abstractmethod
+    def executescript_tx(self, fn, post_sql: Optional[str] = None,
+                         post_params: Sequence[Any] = ()) -> None:
+        """Run ``fn(raw_conn)`` inside an explicit transaction (migrations).
+        ``post_sql`` (qmark style) executes in the SAME transaction after
+        ``fn`` — the migration-version record must commit atomically with the
+        DDL it describes."""
+
+    @abc.abstractmethod
+    def raw_connection(self) -> Any:
+        """Migration escape hatch — the only raw surface."""
+
+    @abc.abstractmethod
+    def advisory_lock(self, key: str) -> contextlib.AbstractContextManager: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+
+# ---------------------------------------------------------------------- sqlite
+
+
+class SqliteEngine(DbEngine):
+    """stdlib sqlite3 in autocommit mode (explicit BEGIN/COMMIT for
+    migrations), WAL + pragma tuning per the reference's sqlite/pragmas.rs."""
+
+    name = "sqlite"
+
+    def __init__(self, path: str | Path) -> None:
+        self._path = str(path)
+        self._conn = sqlite3.connect(self._path, check_same_thread=False,
+                                     isolation_level=None)
+        self._conn.row_factory = sqlite3.Row
+        self._lock = threading.RLock()
+        self._mem_locks: dict[str, threading.Lock] = {}
+        with self._lock:
+            cur = self._conn.cursor()
+            if self._path != ":memory:":
+                cur.execute("PRAGMA journal_mode=WAL")
+            cur.execute("PRAGMA synchronous=NORMAL")
+            cur.execute("PRAGMA foreign_keys=ON")
+            self._conn.commit()
+
+    def execute(self, sql: str, params: Sequence[Any] = ()) -> ExecResult:
+        with self._lock:
+            cur = self._conn.execute(sql, list(params))
+            rows = [dict(r) for r in cur.fetchall()] if cur.description else []
+            rowcount = cur.rowcount
+            if self._conn.in_transaction:
+                self._conn.commit()
+        return ExecResult(rows, rowcount)
+
+    def executescript_tx(self, fn, post_sql: Optional[str] = None,
+                         post_params: Sequence[Any] = ()) -> None:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute("BEGIN")
+            try:
+                fn(self._conn)
+                if not self._conn.in_transaction:
+                    raise RuntimeError(
+                        "migration committed implicitly (executescript?); "
+                        "use individual execute() calls")
+                if post_sql:
+                    cur.execute(post_sql, list(post_params))
+                cur.execute("COMMIT")
+            except Exception:
+                if self._conn.in_transaction:
+                    cur.execute("ROLLBACK")
+                raise
+
+    def raw_connection(self) -> sqlite3.Connection:
+        return self._conn
+
+    @contextlib.contextmanager
+    def advisory_lock(self, key: str) -> Iterator[None]:
+        """File-backed: flock on a per-key sidecar (cross-process, like PG's
+        advisory locks). ``:memory:``: per-key in-process lock."""
+        if self._path == ":memory:":
+            with self._lock:
+                lk = self._mem_locks.setdefault(key, threading.Lock())
+            with lk:
+                yield
+            return
+        import fcntl
+
+        digest = hashlib.sha256(key.encode()).hexdigest()[:16]
+        lock_path = f"{self._path}.lock.{digest}"
+        with open(lock_path, "w") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+# ---------------------------------------------------------------------- postgres
+
+
+def _qmark_to_format(sql: str) -> str:
+    """Translate qmark placeholders to psycopg's ``%s``, respecting string
+    literals (a ``?`` inside quotes must survive). Every literal ``%`` is
+    doubled — including inside string literals — because psycopg %-formats the
+    WHOLE query string when parameters are present."""
+    out: list[str] = []
+    in_str = False
+    for ch in sql:
+        if ch == "'":
+            in_str = not in_str
+            out.append(ch)
+        elif ch == "%":
+            out.append("%%")
+        elif ch == "?" and not in_str:
+            out.append("%s")
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+class PostgresEngine(DbEngine):
+    """PostgreSQL engine over any psycopg-style DB-API driver.
+
+    The driver is injected (``driver=``) or imported (psycopg2 → psycopg); the
+    bare image has neither, so constructing without one raises with guidance
+    rather than failing at first query. SQL arrives qmark-style and is
+    translated to ``%s``; rows come back as dicts via cursor.description.
+    """
+
+    name = "postgres"
+
+    def __init__(self, dsn: str, driver: Any = None) -> None:
+        if driver is None:
+            try:
+                import psycopg2 as driver  # type: ignore[no-redef]
+            except ImportError:
+                try:
+                    import psycopg as driver  # type: ignore[no-redef]
+                except ImportError as e:
+                    raise RuntimeError(
+                        "PostgresEngine needs a psycopg-style driver; none is "
+                        "installed in this image. Pass driver= explicitly or "
+                        "use the sqlite engine.") from e
+        self._driver = driver
+        self._conn = driver.connect(dsn)
+        # autocommit: commits are explicit in execute(), mirroring SqliteEngine
+        try:
+            self._conn.autocommit = True
+        except Exception:  # noqa: BLE001 — driver-specific attribute
+            pass
+        self._lock = threading.RLock()
+
+    def execute(self, sql: str, params: Sequence[Any] = ()) -> ExecResult:
+        with self._lock:
+            cur = self._conn.cursor()
+            try:
+                cur.execute(_qmark_to_format(sql), tuple(params))
+                if cur.description:
+                    cols = [d[0] for d in cur.description]
+                    rows = [dict(zip(cols, r)) for r in cur.fetchall()]
+                else:
+                    rows = []
+                return ExecResult(rows, cur.rowcount)
+            finally:
+                cur.close()
+
+    def executescript_tx(self, fn, post_sql: Optional[str] = None,
+                         post_params: Sequence[Any] = ()) -> None:
+        with self._lock:
+            prev = getattr(self._conn, "autocommit", True)
+            try:
+                self._conn.autocommit = False
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                fn(self._conn)
+                # implicit-commit guard (SqliteEngine's in_transaction parity,
+                # best effort): psycopg2 exposes get_transaction_status —
+                # IDLE (0) after fn means it committed behind our back
+                status_fn = getattr(self._conn, "get_transaction_status", None)
+                if status_fn is not None and status_fn() == 0:
+                    raise RuntimeError(
+                        "migration committed implicitly; the version record "
+                        "can no longer commit atomically with its DDL")
+                if post_sql:
+                    cur = self._conn.cursor()
+                    try:
+                        cur.execute(_qmark_to_format(post_sql), tuple(post_params))
+                    finally:
+                        cur.close()
+                self._conn.commit()
+            except Exception:
+                self._conn.rollback()
+                raise
+            finally:
+                try:
+                    self._conn.autocommit = prev
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def raw_connection(self) -> Any:
+        return self._conn
+
+    @contextlib.contextmanager
+    def advisory_lock(self, key: str) -> Iterator[None]:
+        """Session advisory lock; the key hashes to PG's bigint keyspace."""
+        key_id = int.from_bytes(
+            hashlib.sha256(key.encode()).digest()[:8], "big", signed=True)
+        self.execute("SELECT pg_advisory_lock(?)", [key_id])
+        try:
+            yield
+        finally:
+            self.execute("SELECT pg_advisory_unlock(?)", [key_id])
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def engine_from_url(url: str) -> DbEngine:
+    """``sqlite:///path`` | ``sqlite://:memory:`` | ``postgres://…`` — the
+    DbManager's server-template hook (manager.rs: engine choice is config)."""
+    if url.startswith("sqlite://"):
+        rest = url[len("sqlite://"):]
+        if rest in ("", ":memory:"):
+            return SqliteEngine(":memory:")
+        return SqliteEngine(rest.lstrip("/") if rest.startswith("//") else rest)
+    if url.startswith(("postgres://", "postgresql://")):
+        return PostgresEngine(url)
+    raise ValueError(f"unsupported database url {url!r}")

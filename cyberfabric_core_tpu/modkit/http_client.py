@@ -1,0 +1,363 @@
+"""First-party layered HTTP client — the modkit-http stack.
+
+Reference: libs/modkit-http/src/ — builder + layer pipeline (lib.rs),
+RetryLayer with idempotency-aware triggers and Retry-After handling
+(layers/retry.rs:23-370, config.rs:16-245), user-agent layer, TLS root
+config (tls.rs), outbound security policy (security.rs). The asyncio
+rendition layers over one shared aiohttp session:
+
+    request → user-agent → tracing span → retry(budget) → timeout → transport
+
+Retry semantics mirror the reference exactly:
+- ``always_retry`` triggers fire for any method (default: 429);
+- ``idempotent_retry`` triggers (transport errors, timeout, 408/500/502/503/
+  504) fire only for RFC-9110 idempotent methods (GET/HEAD/PUT/DELETE/
+  OPTIONS/TRACE) — or any method carrying an ``Idempotency-Key`` header;
+- ``Retry-After`` is honored (seconds form, capped) unless disabled;
+- exponential backoff ``min(initial·mult^n, max)`` with full jitter.
+
+On top of per-request ``max_retries`` sits a client-wide **retry budget**
+(the finagle/tower discipline the reference's RetryLayer defers to its
+``budget`` field): each completed first attempt deposits ``retry_ratio``
+tokens, each retry withdraws one, and ``min_retries_per_sec`` keeps a floor
+so low-traffic clients can still retry. When the bucket is empty, retries
+stop — a downstream brownout cannot be amplified into a retry storm.
+
+TLS: ``TlsConfig`` builds the ``ssl.SSLContext`` (system roots | custom CA |
+insecure-dev), and ``deny_private_addresses`` plugs the shared SSRF resolver
+(netsec.py) into the connector.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import ssl
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import aiohttp
+
+from .telemetry import Tracer
+
+#: RFC 9110 idempotent methods (config.rs is_idempotent_method)
+IDEMPOTENT_METHODS = frozenset({"GET", "HEAD", "PUT", "DELETE", "OPTIONS", "TRACE"})
+
+#: retry triggers — statuses plus the two transport pseudo-triggers
+TRANSPORT_ERROR = "transport_error"
+TIMEOUT = "timeout"
+
+DEFAULT_ALWAYS_RETRY = frozenset({429})
+DEFAULT_IDEMPOTENT_RETRY = frozenset(
+    {TRANSPORT_ERROR, TIMEOUT, 408, 500, 502, 503, 504})
+
+
+@dataclass
+class ExponentialBackoff:
+    initial_s: float = 0.1
+    multiplier: float = 2.0
+    max_s: float = 10.0
+    jitter: bool = True
+
+    def delay(self, attempt: int) -> float:
+        base = min(self.initial_s * (self.multiplier ** attempt), self.max_s)
+        return random.uniform(0, base) if self.jitter else base
+
+
+@dataclass
+class RetryBudget:
+    """Token-bucket retry budget: deposits on first attempts, withdrawals per
+    retry. ``retry_ratio`` bounds retries to a fraction of request volume;
+    ``min_retries_per_sec`` is the low-traffic floor."""
+
+    retry_ratio: float = 0.2
+    min_retries_per_sec: float = 1.0
+    ttl_s: float = 10.0
+
+    def __post_init__(self) -> None:
+        # single-event-loop discipline: deposit/withdraw run on the client's
+        # loop, so plain float mutation is race-free here
+        self._tokens = 0.0
+        self._floor_at = time.monotonic()
+
+    def _refill_floor(self) -> None:
+        now = time.monotonic()
+        self._tokens = min(
+            self._tokens + (now - self._floor_at) * self.min_retries_per_sec,
+            max(self.ttl_s * self.min_retries_per_sec, 10.0),
+        )
+        self._floor_at = now
+
+    def deposit(self) -> None:
+        self._refill_floor()
+        self._tokens = min(self._tokens + self.retry_ratio,
+                           max(self.ttl_s * self.min_retries_per_sec, 10.0))
+
+    def withdraw(self) -> bool:
+        self._refill_floor()
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+
+@dataclass
+class RetryConfig:
+    max_retries: int = 3
+    backoff: ExponentialBackoff = field(default_factory=ExponentialBackoff)
+    always_retry: frozenset = DEFAULT_ALWAYS_RETRY
+    idempotent_retry: frozenset = DEFAULT_IDEMPOTENT_RETRY
+    ignore_retry_after: bool = False
+    retry_after_cap_s: float = 30.0
+    idempotency_key_header: Optional[str] = "Idempotency-Key"
+    budget: Optional[RetryBudget] = None
+
+    def should_retry(self, trigger: Any, method: str,
+                     has_idempotency_key: bool) -> bool:
+        if trigger in self.always_retry:
+            return True
+        if trigger not in self.idempotent_retry:
+            return False
+        return method.upper() in IDEMPOTENT_METHODS or has_idempotency_key
+
+
+@dataclass
+class TlsConfig:
+    """tls.rs parity: system roots by default, custom CA bundle, optional
+    client cert, and an explicit insecure switch for dev."""
+
+    verify: bool = True
+    ca_file: Optional[str] = None
+    client_cert: Optional[str] = None
+    client_key: Optional[str] = None
+
+    def ssl_context(self) -> ssl.SSLContext | bool:
+        if not self.verify:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            return ctx
+        if self.ca_file is None and self.client_cert is None:
+            return True  # aiohttp default: system roots
+        ctx = ssl.create_default_context(cafile=self.ca_file)
+        if self.client_cert:
+            ctx.load_cert_chain(self.client_cert, self.client_key)
+        return ctx
+
+
+@dataclass
+class HttpClientConfig:
+    base_url: Optional[str] = None
+    user_agent: str = "tpu-fabric/0.2 (modkit-http)"
+    connect_timeout_s: float = 10.0
+    total_timeout_s: float = 30.0
+    retry: RetryConfig = field(default_factory=RetryConfig)
+    tls: TlsConfig = field(default_factory=TlsConfig)
+    #: SSRF policy — route DNS through the public-only resolver (security.rs);
+    #: redirects are then followed MANUALLY so every hop is re-validated
+    #: (layers/redirect.rs: the policy applies per hop, not per request)
+    deny_private_addresses: bool = False
+    follow_redirects: bool = True
+    max_redirects: int = 5
+    max_connections: int = 100
+
+
+class HttpResponse:
+    """Materialized response (status/headers/body) — the retry layer must own
+    body consumption, so callers get bytes, not a live stream."""
+
+    def __init__(self, status: int, headers: dict[str, str], body: bytes,
+                 url: str) -> None:
+        self.status = status
+        self.headers = headers
+        self.body = body
+        self.url = url
+
+    def json(self) -> Any:
+        import json
+
+        return json.loads(self.body)
+
+    @property
+    def text(self) -> str:
+        return self.body.decode("utf-8", "replace")
+
+    @property
+    def ok(self) -> bool:
+        return 200 <= self.status < 300
+
+
+class HttpClient:
+    """The layered client. One shared session; ``close()`` when done (or use
+    as an async context manager)."""
+
+    def __init__(self, config: Optional[HttpClientConfig] = None,
+                 tracer: Optional[Tracer] = None) -> None:
+        self.config = config or HttpClientConfig()
+        self._tracer = tracer
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def _ensure_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            cfg = self.config
+            if cfg.deny_private_addresses:
+                from .netsec import PublicOnlyResolver
+
+                connector = aiohttp.TCPConnector(
+                    resolver=PublicOnlyResolver(), limit=cfg.max_connections,
+                    ssl=cfg.tls.ssl_context())
+            else:
+                connector = aiohttp.TCPConnector(
+                    limit=cfg.max_connections, ssl=cfg.tls.ssl_context())
+            self._session = aiohttp.ClientSession(
+                connector=connector,
+                timeout=aiohttp.ClientTimeout(
+                    total=cfg.total_timeout_s, connect=cfg.connect_timeout_s),
+                headers={"User-Agent": cfg.user_agent},
+            )
+        return self._session
+
+    def _url(self, path_or_url: str) -> str:
+        if path_or_url.startswith(("http://", "https://")):
+            return path_or_url
+        base = (self.config.base_url or "").rstrip("/")
+        return f"{base}/{path_or_url.lstrip('/')}"
+
+    def _check_literal_ip(self, target: str) -> None:
+        """Literal-IP hosts never hit the resolver; re-check every hop so the
+        SSRF policy holds for both names and literals (security.rs)."""
+        import ipaddress
+        from urllib.parse import urlsplit
+
+        host = urlsplit(target).hostname or ""
+        try:
+            addr = ipaddress.ip_address(host)
+        except ValueError:
+            return  # a name: PublicOnlyResolver enforces at connect time
+        from .netsec import is_public_address
+
+        if not is_public_address(str(addr)):
+            raise PermissionError(
+                f"request to non-public address {host} denied by policy")
+
+    async def request(self, method: str, url: str, *,
+                      headers: Optional[dict[str, str]] = None,
+                      json: Any = None, data: Any = None,
+                      params: Optional[dict[str, str]] = None,
+                      allow_redirects: Optional[bool] = None) -> HttpResponse:
+        """Full pipeline: UA → span → retry(budget) → redirect-check →
+        timeout → transport."""
+        cfg = self.config
+        retry = cfg.retry
+        full_url = self._url(url)
+        follow = cfg.follow_redirects if allow_redirects is None else allow_redirects
+        if cfg.deny_private_addresses:
+            self._check_literal_ip(full_url)
+        has_idem_key = bool(
+            retry.idempotency_key_header
+            and headers
+            and retry.idempotency_key_header in headers)
+        session = await self._ensure_session()
+
+        async def attempt() -> HttpResponse:
+            # redirects are followed MANUALLY: each hop gets the literal-IP
+            # check, and non-GET/HEAD hops never re-send the body (a 307/308
+            # from a token endpoint must not leak credentials — the reference
+            # token client pins allow_redirects=false)
+            target = full_url
+            send_body = (json, data)
+            for _hop in range(cfg.max_redirects + 1):
+                async with session.request(
+                    method, target, headers=headers, json=send_body[0],
+                    data=send_body[1], params=params if target is full_url else None,
+                    allow_redirects=False,
+                ) as resp:
+                    if follow and resp.status in (301, 302, 303, 307, 308):
+                        loc = resp.headers.get("Location")
+                        if loc:
+                            from urllib.parse import urljoin
+
+                            target = urljoin(target, loc)
+                            if cfg.deny_private_addresses:
+                                self._check_literal_ip(target)
+                            if method.upper() not in ("GET", "HEAD"):
+                                return HttpResponse(
+                                    resp.status, dict(resp.headers),
+                                    await resp.read(), str(resp.url))
+                            continue
+                    body = await resp.read()
+                    return HttpResponse(resp.status, dict(resp.headers), body,
+                                        str(resp.url))
+            raise aiohttp.ClientError(
+                f"too many redirects (> {cfg.max_redirects}) for {full_url}")
+
+        last_exc: Optional[BaseException] = None
+        resp: Optional[HttpResponse] = None
+        deposited = False
+        for n in range(retry.max_retries + 1):
+            trigger: Any = None
+            try:
+                if self._tracer is not None:
+                    with self._tracer.span(
+                            "http.client", method=method, url=full_url,
+                            attempt=n):
+                        resp = await attempt()
+                else:
+                    resp = await attempt()
+                last_exc = None
+                if not deposited and retry.budget is not None:
+                    retry.budget.deposit()
+                    deposited = True
+                if resp.status < 400:
+                    return resp
+                trigger = resp.status
+            except asyncio.TimeoutError as e:
+                last_exc, trigger = e, TIMEOUT
+            except aiohttp.ClientError as e:
+                last_exc, trigger = e, TRANSPORT_ERROR
+
+            if n >= retry.max_retries:
+                break
+            if not retry.should_retry(trigger, method, has_idem_key):
+                break
+            if retry.budget is not None and not retry.budget.withdraw():
+                break  # budget exhausted: no retry storm
+            delay = retry.backoff.delay(n)
+            if resp is not None and not retry.ignore_retry_after:
+                ra = resp.headers.get("Retry-After")
+                if ra:
+                    try:
+                        delay = min(float(ra), retry.retry_after_cap_s)
+                    except ValueError:
+                        pass
+            await asyncio.sleep(delay)
+            resp = None
+
+        if resp is not None:
+            return resp  # terminal HTTP error passes through (retry.rs:495)
+        assert last_exc is not None
+        raise last_exc
+
+    async def get(self, url: str, **kw: Any) -> HttpResponse:
+        return await self.request("GET", url, **kw)
+
+    async def post(self, url: str, **kw: Any) -> HttpResponse:
+        return await self.request("POST", url, **kw)
+
+    async def put(self, url: str, **kw: Any) -> HttpResponse:
+        return await self.request("PUT", url, **kw)
+
+    async def delete(self, url: str, **kw: Any) -> HttpResponse:
+        return await self.request("DELETE", url, **kw)
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def __aenter__(self) -> "HttpClient":
+        await self._ensure_session()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.close()

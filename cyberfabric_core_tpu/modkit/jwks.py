@@ -79,16 +79,19 @@ class JwksCache:
     _lock: asyncio.Lock = field(default_factory=asyncio.Lock)
 
     async def _fetch(self) -> None:
-        import aiohttp
+        # modkit-http stack: retries (idempotent GET — transport/5xx/429) with
+        # jittered backoff, so one IdP blip doesn't start the negative-cache
+        from .http_client import HttpClient, HttpClientConfig, RetryConfig
 
-        async with aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=self.fetch_timeout_s)
-        ) as session:
-            async with session.get(self.jwks_url) as resp:
-                if resp.status != 200:
-                    raise JwtError(
-                        f"JWKS fetch failed: {resp.status} from {self.jwks_url}")
-                doc = await resp.json(content_type=None)
+        async with HttpClient(HttpClientConfig(
+            total_timeout_s=self.fetch_timeout_s,
+            retry=RetryConfig(max_retries=2),
+        )) as client:
+            resp = await client.get(self.jwks_url)
+            if resp.status != 200:
+                raise JwtError(
+                    f"JWKS fetch failed: {resp.status} from {self.jwks_url}")
+            doc = resp.json()
         keys = {}
         for jwk in doc.get("keys", []):
             key = jwk_to_key(jwk)

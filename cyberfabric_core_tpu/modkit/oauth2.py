@@ -45,41 +45,40 @@ class ClientCredentialsTokenSource:
     _lock: asyncio.Lock = field(default_factory=asyncio.Lock)
 
     async def _fetch(self) -> None:
-        import aiohttp
+        # modkit-http stack: token POST retries only on 429 (always_retry) —
+        # client_credentials grants are not idempotent-key requests, and the
+        # SSRF policy rides the client's deny_private_addresses switch
+        from .http_client import HttpClient, HttpClientConfig, RetryConfig
 
         form = {"grant_type": "client_credentials",
                 "client_id": self.client_id,
                 "client_secret": self.client_secret}
         if self.scope:
             form["scope"] = self.scope
-        connector = None
-        if self.public_only:
-            from .netsec import public_only_connector
-
-            connector = public_only_connector()
-        async with aiohttp.ClientSession(
-            connector=connector,
-            timeout=aiohttp.ClientTimeout(total=self.fetch_timeout_s)
-        ) as session:
-            async with session.post(self.token_url, data=form,
-                                    allow_redirects=False) as resp:
-                try:
-                    body = await resp.json(content_type=None)
-                except Exception as e:  # noqa: BLE001 — HTML error pages etc.
-                    raise OAuth2Error(
-                        f"token endpoint returned {resp.status} with a "
-                        f"non-JSON body") from e
-                if not isinstance(body, dict):
-                    raise OAuth2Error(
-                        f"token endpoint returned {resp.status} with a "
-                        f"non-object JSON body")
-                if resp.status != 200:
-                    # surface the OAuth error code only — never the raw body
-                    # (it may be an internal service's response)
-                    raise OAuth2Error(
-                        f"token endpoint returned {resp.status}"
-                        + (f": {body['error']}" if isinstance(
-                            body.get("error"), str) else ""))
+        async with HttpClient(HttpClientConfig(
+            total_timeout_s=self.fetch_timeout_s,
+            deny_private_addresses=self.public_only,
+            retry=RetryConfig(max_retries=2),
+        )) as client:
+            resp = await client.post(self.token_url, data=form,
+                                     allow_redirects=False)
+            try:
+                body = resp.json()
+            except Exception as e:  # noqa: BLE001 — HTML error pages etc.
+                raise OAuth2Error(
+                    f"token endpoint returned {resp.status} with a "
+                    f"non-JSON body") from e
+            if not isinstance(body, dict):
+                raise OAuth2Error(
+                    f"token endpoint returned {resp.status} with a "
+                    f"non-object JSON body")
+            if resp.status != 200:
+                # surface the OAuth error code only — never the raw body
+                # (it may be an internal service's response)
+                raise OAuth2Error(
+                    f"token endpoint returned {resp.status}"
+                    + (f": {body['error']}" if isinstance(
+                        body.get("error"), str) else ""))
         token = body.get("access_token")
         if not token:
             raise OAuth2Error("token response missing access_token")

@@ -1,0 +1,131 @@
+// ThreadSanitizer exercise for fabric_host (SURVEY §5 race strategy: the
+// reference runs its C++/Rust tiers under sanitizers in CI; this is the
+// equivalent gate for the native allocator + radix prefix cache).
+//
+// Hammers the two shared objects from several threads concurrently:
+//  - allocator: alloc/free page batches
+//  - prefix cache: insert/match/release/evict on overlapping token prefixes
+// Any data race under -fsanitize=thread exits nonzero; the logic also
+// self-checks conservation (no page leaked or double-freed).
+//
+// Build+run: `make tsan` in this directory (used by tests/test_native.py).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* fh_alloc_new(int32_t num_pages);
+void fh_alloc_free(void* a);
+int32_t fh_alloc_pages(void* a, int32_t n, int32_t* out);
+void fh_free_pages(void* a, const int32_t* pages, int32_t n);
+int32_t fh_alloc_num_free(void* a);
+
+void* fh_cache_new(int32_t page_size);
+void fh_cache_free(void* c);
+int32_t fh_cache_match(void* c, const int32_t* tokens, int32_t n,
+                       int32_t* out_pages);
+void fh_cache_release(void* c, const int32_t* tokens, int32_t n);
+int32_t fh_cache_insert(void* c, const int32_t* tokens, int32_t n,
+                        const int32_t* pages, int32_t n_pages);
+int32_t fh_cache_evict(void* c, int32_t target_pages, int32_t* out_pages);
+void fh_cache_stats(void* c, int64_t* out4);
+}
+
+namespace {
+
+constexpr int kThreads = 8;
+constexpr int kIters = 2000;
+constexpr int kPages = 4096;
+constexpr int kPageSize = 16;
+
+std::atomic<int> failures{0};
+
+void hammer_allocator(void* alloc, unsigned seed) {
+    int32_t buf[8];
+    unsigned s = seed;
+    for (int i = 0; i < kIters; ++i) {
+        s = s * 1664525u + 1013904223u;
+        int32_t n = 1 + static_cast<int32_t>(s % 8);
+        int32_t got = fh_alloc_pages(alloc, n, buf);
+        if (got > 0) {
+            fh_free_pages(alloc, buf, got);
+        }
+    }
+}
+
+void hammer_cache(void* cache, void* alloc, unsigned seed) {
+    unsigned s = seed;
+    std::vector<int32_t> tokens(4 * kPageSize);
+    int32_t pages[8];
+    int32_t matched[64];
+    for (int i = 0; i < kIters; ++i) {
+        s = s * 1664525u + 1013904223u;
+        // overlapping prefixes across threads: shared vocabulary of 4 stems
+        int stem = static_cast<int>(s % 4);
+        int npages = 1 + static_cast<int>((s >> 8) % 4);
+        for (int p = 0; p < npages * kPageSize; ++p) {
+            tokens[static_cast<size_t>(p)] = stem * 100 + p / kPageSize;
+        }
+        int32_t n_tok = npages * kPageSize;
+        int32_t got = fh_alloc_pages(alloc, npages, pages);
+        if (got != npages) {
+            if (got > 0) fh_free_pages(alloc, pages, got);
+            // pool pressure: evict and retry once
+            int32_t evicted[256];
+            int32_t n_ev = fh_cache_evict(cache, npages, evicted);
+            if (n_ev > 0) fh_free_pages(alloc, evicted, n_ev);
+            continue;
+        }
+        int32_t kept = fh_cache_insert(cache, tokens.data(), n_tok, pages, npages);
+        if (kept < npages) {  // duplicate suffix: surplus pages come back
+            fh_free_pages(alloc, pages + kept, npages - kept);
+        }
+        int32_t hits = fh_cache_match(cache, tokens.data(), n_tok, matched);
+        if (hits < 0 || hits > npages) {
+            std::fprintf(stderr, "match returned %d for %d pages\n", hits, npages);
+            failures.fetch_add(1);
+        }
+        if (hits > 0) {
+            fh_cache_release(cache, tokens.data(), hits * kPageSize);
+        }
+    }
+}
+
+}  // namespace
+
+int main() {
+    void* alloc = fh_alloc_new(kPages);
+    void* cache = fh_cache_new(kPageSize);
+
+    std::vector<std::thread> threads;
+    for (int t = 0; t < kThreads / 2; ++t) {
+        threads.emplace_back(hammer_allocator, alloc, 17u * (t + 1));
+    }
+    for (int t = 0; t < kThreads / 2; ++t) {
+        threads.emplace_back(hammer_cache, cache, alloc, 29u * (t + 1));
+    }
+    for (auto& th : threads) th.join();
+
+    // drain the cache and verify page conservation
+    int32_t evicted[kPages];
+    int32_t n_ev = fh_cache_evict(cache, kPages, evicted);
+    if (n_ev > 0) fh_free_pages(alloc, evicted, n_ev);
+    int32_t free_pages = fh_alloc_num_free(alloc);
+    int64_t stats[4];
+    fh_cache_stats(cache, stats);
+    std::printf("tsan exercise: free=%d/%d evicted_at_end=%d failures=%d\n",
+                free_pages, kPages, n_ev, failures.load());
+
+    fh_cache_free(cache);
+    fh_alloc_free(alloc);
+    if (failures.load() != 0) return 2;
+    if (free_pages != kPages) {
+        std::fprintf(stderr, "page leak: %d != %d\n", free_pages, kPages);
+        return 3;
+    }
+    return 0;
+}

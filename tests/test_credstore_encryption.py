@@ -31,7 +31,7 @@ def test_value_encrypted_at_rest_and_round_trips(tmp_path):
     plugin.put("t1", "api_key", "s3cret-value", "private")
 
     # raw row must be ciphertext, not the secret
-    raw = db._conn.execute(
+    raw = db.raw_for_migrations().execute(
         "SELECT value FROM secrets").fetchone()[0]
     assert raw.startswith("enc:v1:")
     assert "s3cret-value" not in raw
@@ -56,7 +56,7 @@ def test_tenant_bound_as_aad(tmp_path):
     the tenant id is bound into the AES-GCM AAD."""
     plugin, db = _plugin(tmp_path)
     plugin.put("t1", "k", "cross-tenant", "private")
-    conn = db._conn
+    conn = db.raw_for_migrations()
     stored = conn.execute("SELECT value FROM secrets").fetchone()[0]
     conn.execute(
         "INSERT INTO secrets (id, tenant_id, key, value, sharing) "
@@ -68,7 +68,7 @@ def test_tenant_bound_as_aad(tmp_path):
 
 def test_legacy_plaintext_rows_still_read(tmp_path):
     plugin, db = _plugin(tmp_path)
-    conn = db._conn
+    conn = db.raw_for_migrations()
     conn.execute(
         "INSERT INTO secrets (id, tenant_id, key, value, sharing) "
         "VALUES ('l', 't1', 'old', 'plain-old-value', 'private')")

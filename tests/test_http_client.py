@@ -1,0 +1,263 @@
+"""modkit-http layered client (modkit/http_client.py) against a live local
+mock upstream — retry triggers, idempotency rules, Retry-After, retry budget
+(reference layers/retry.rs test matrix)."""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+
+from cyberfabric_core_tpu.modkit.http_client import (
+    ExponentialBackoff,
+    HttpClient,
+    HttpClientConfig,
+    RetryBudget,
+    RetryConfig,
+    TlsConfig,
+)
+
+
+class Upstream:
+    """Counts hits; scripted status sequences per path."""
+
+    def __init__(self):
+        self.hits: dict[str, int] = {}
+        self.scripts: dict[str, list[int]] = {}
+        self.retry_after: dict[str, str] = {}
+
+    async def handle(self, request: web.Request):
+        path = request.path
+        self.hits[path] = self.hits.get(path, 0) + 1
+        script = self.scripts.get(path, [])
+        idx = self.hits[path] - 1
+        status = script[idx] if idx < len(script) else 200
+        headers = {}
+        if status in (429, 503) and path in self.retry_after:
+            headers["Retry-After"] = self.retry_after[path]
+        if status == 200:
+            return web.json_response({"path": path, "hits": self.hits[path],
+                                      "method": request.method})
+        return web.Response(status=status, headers=headers)
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture()
+def upstream(loop):
+    up = Upstream()
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", up.handle)
+    runner = web.AppRunner(app)
+    loop.run_until_complete(runner.setup())
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    loop.run_until_complete(site.start())
+    port = site._server.sockets[0].getsockname()[1]
+    up.base = f"http://127.0.0.1:{port}"
+    yield up
+    loop.run_until_complete(runner.cleanup())
+
+
+def _client(up, **retry_kw):
+    retry_kw.setdefault("backoff", ExponentialBackoff(initial_s=0.01, jitter=False))
+    return HttpClient(HttpClientConfig(base_url=up.base,
+                                       retry=RetryConfig(**retry_kw)))
+
+
+def test_get_retries_503_then_succeeds(loop, upstream):
+    upstream.scripts["/a"] = [503, 503, 200]
+
+    async def go():
+        async with _client(upstream) as c:
+            r = await c.get("/a")
+            assert r.status == 200
+            assert r.json()["hits"] == 3
+
+    loop.run_until_complete(go())
+
+
+def test_post_does_not_retry_500(loop, upstream):
+    """Non-idempotent + 500 → passes through as a response (retry.rs:495)."""
+    upstream.scripts["/b"] = [500, 200]
+
+    async def go():
+        async with _client(upstream) as c:
+            r = await c.post("/b")
+            assert r.status == 500
+            assert upstream.hits["/b"] == 1
+
+    loop.run_until_complete(go())
+
+
+def test_post_with_idempotency_key_retries(loop, upstream):
+    upstream.scripts["/c"] = [502, 200]
+
+    async def go():
+        async with _client(upstream) as c:
+            r = await c.post("/c", headers={"Idempotency-Key": "k-1"})
+            assert r.status == 200
+            assert upstream.hits["/c"] == 2
+
+    loop.run_until_complete(go())
+
+
+def test_429_always_retries_even_post(loop, upstream):
+    upstream.scripts["/d"] = [429, 200]
+
+    async def go():
+        async with _client(upstream) as c:
+            r = await c.post("/d")
+            assert r.status == 200
+            assert upstream.hits["/d"] == 2
+
+    loop.run_until_complete(go())
+
+
+def test_retry_after_header_is_honored(loop, upstream):
+    upstream.scripts["/e"] = [429, 200]
+    upstream.retry_after["/e"] = "0.3"
+
+    async def go():
+        async with _client(upstream) as c:
+            t0 = asyncio.get_event_loop().time()
+            r = await c.get("/e")
+            elapsed = asyncio.get_event_loop().time() - t0
+            assert r.status == 200
+            assert elapsed >= 0.28, elapsed  # waited Retry-After, not 10ms backoff
+
+    loop.run_until_complete(go())
+
+
+def test_retries_exhausted_returns_last_response(loop, upstream):
+    upstream.scripts["/f"] = [503, 503, 503, 503, 503]
+
+    async def go():
+        async with _client(upstream, max_retries=2) as c:
+            r = await c.get("/f")
+            assert r.status == 503
+            assert upstream.hits["/f"] == 3  # initial + 2 retries
+
+    loop.run_until_complete(go())
+
+
+def test_transport_error_retries_idempotent(loop, upstream):
+    async def go():
+        # connect to a closed port, then nothing: transport error surfaces
+        cfg = HttpClientConfig(
+            base_url="http://127.0.0.1:9",  # discard port: refused
+            connect_timeout_s=0.5,
+            retry=RetryConfig(max_retries=1,
+                              backoff=ExponentialBackoff(initial_s=0.01, jitter=False)))
+        async with HttpClient(cfg) as c:
+            with pytest.raises(Exception):
+                await c.get("/x")
+
+    loop.run_until_complete(go())
+
+
+def test_retry_budget_limits_storm(loop, upstream):
+    """With an empty budget, retries stop after the first withdrawal fails —
+    a brownout is not amplified."""
+    upstream.scripts["/g"] = [503] * 50
+    budget = RetryBudget(retry_ratio=0.0, min_retries_per_sec=0.0)
+
+    async def go():
+        async with _client(upstream, max_retries=5, budget=budget) as c:
+            r = await c.get("/g")
+            assert r.status == 503
+            # 1 initial attempt, zero budget → no retries at all
+            assert upstream.hits["/g"] == 1
+
+    loop.run_until_complete(go())
+
+
+def test_retry_budget_floor_allows_some(loop, upstream):
+    upstream.scripts["/h"] = [503, 200]
+    budget = RetryBudget(retry_ratio=0.0, min_retries_per_sec=100.0)
+
+    async def go():
+        async with _client(upstream, max_retries=2, budget=budget) as c:
+            await asyncio.sleep(0.05)  # accrue floor tokens
+            r = await c.get("/h")
+            assert r.status == 200
+
+    loop.run_until_complete(go())
+
+
+def test_tls_config_contexts():
+    import ssl
+
+    assert TlsConfig().ssl_context() is True
+    insecure = TlsConfig(verify=False).ssl_context()
+    assert isinstance(insecure, ssl.SSLContext)
+    assert insecure.verify_mode == ssl.CERT_NONE
+
+
+def test_deny_private_addresses_blocks_loopback(loop, upstream):
+    async def go():
+        cfg = HttpClientConfig(base_url=upstream.base, deny_private_addresses=True,
+                               retry=RetryConfig(max_retries=0))
+        async with HttpClient(cfg) as c:
+            with pytest.raises(Exception):
+                await c.get("/blocked")
+
+    loop.run_until_complete(go())
+    assert "/blocked" not in upstream.hits  # never reached the server
+
+
+def test_user_agent_and_base_url(loop, upstream):
+    async def go():
+        async with HttpClient(HttpClientConfig(base_url=upstream.base)) as c:
+            r = await c.get("relative/path")
+            assert r.status == 200
+            assert r.json()["path"] == "/relative/path"
+
+    loop.run_until_complete(go())
+
+
+def test_get_follows_redirects_post_does_not(loop, upstream):
+    """Manual redirect layer: GET follows (re-validating each hop), non-GET
+    returns the 3xx untouched so credentials in the body are never re-sent."""
+
+    async def go():
+        # extend the mock: /redir bounces to /final
+        async def redir(request):
+            return web.Response(status=307,
+                                headers={"Location": f"{upstream.base}/final"})
+
+        app = web.Application()
+        app.router.add_route("*", "/redir", redir)
+        up2 = Upstream()
+        app.router.add_route("*", "/{tail:.*}", up2.handle)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with HttpClient(HttpClientConfig(base_url=base)) as c:
+                r_post = await c.post("/redir", json={"secret": "x"})
+                assert r_post.status == 307  # not followed for POST
+                r_get = await c.get("/redir")
+                assert r_get.status == 200
+                assert r_get.json()["path"] == "/final"
+        finally:
+            await runner.cleanup()
+
+    loop.run_until_complete(go())
+
+
+def test_redirect_hop_to_private_literal_denied():
+    from cyberfabric_core_tpu.modkit.http_client import HttpClient, HttpClientConfig
+
+    c = HttpClient(HttpClientConfig(deny_private_addresses=True))
+    with pytest.raises(PermissionError):
+        c._check_literal_ip("http://169.254.169.254/latest/meta-data")
+    with pytest.raises(PermissionError):
+        c._check_literal_ip("http://127.0.0.1:8080/admin")
+    c._check_literal_ip("http://93.184.216.34/")  # public: passes

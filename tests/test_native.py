@@ -99,3 +99,32 @@ def test_native_python_parity():
         page += n_pages
         assert nat.insert(seq, pages) == pyt.insert(seq, pages)
     assert nat.stats()["cached_pages"] == pyt.stats()["cached_pages"]
+
+
+def test_sanitizer_exercise():
+    """Race/sanitizer strategy (SURVEY §5): build the fabric_host concurrency
+    exercise under -fsanitize=thread and run it — 8 threads hammering the
+    allocator + radix cache; TSAN findings or page-conservation failures exit
+    nonzero. Skipped where the toolchain lacks TSAN (never on the TPU image)."""
+    import shutil
+    import subprocess
+    from pathlib import Path
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    src_dir = Path(__file__).parent.parent / "native" / "fabric_host"
+    import os
+
+    build = subprocess.run(["make", "tsan_exercise"], cwd=src_dir,
+                           capture_output=True, text=True, timeout=300)
+    err = (build.stderr or "").lower()
+    if build.returncode != 0 and (
+            "unrecognized" in err or "unsupported" in err or
+            "cannot find -ltsan" in err):
+        pytest.skip(f"TSAN unavailable on this toolchain: {build.stderr[-200:]}")
+    assert build.returncode == 0, build.stderr[-500:]
+    run = subprocess.run([str(src_dir / "tsan_exercise")], capture_output=True,
+                         text=True, timeout=600,
+                         env={**os.environ, "TSAN_OPTIONS": "halt_on_error=1"})
+    assert run.returncode == 0, (run.stdout, run.stderr[-800:])
+    assert "failures=0" in run.stdout
