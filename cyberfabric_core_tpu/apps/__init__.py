@@ -1,0 +1,2 @@
+"""Standalone apps (reference: apps/ — hyperspot-server lives in server.py at
+the package root; CLI tools live here)."""

@@ -1,0 +1,229 @@
+"""gts-docs-validator — validate GTS identifiers in documentation files.
+
+Reference: apps/gts-docs-validator (README.md: CLI over .md/.json/.yaml/.yml,
+--vendor / --exclude / --json / --verbose; scanner.rs:31 candidate regex +
+false-positive filters; validator.rs:189-360 segment rules). Complements the
+arch-lint tier the way the reference's DE0903 complements its DE0901 dylint.
+
+Validation rules (validator.rs semantics):
+- schema segment: ≥5 dot components ``vendor.pkg.ns.name.vN[.N…]``, lowercase
+  ``[a-z0-9_]`` components, numeric version after ``v``, no hyphens;
+- instance segments (after ``~``): free-form short ids, UUIDs (hyphens ok),
+  dotted lowercase ids, or chained GTS ids;
+- single-segment schema ids must end with ``~``;
+- wildcards (``*``) only in pattern contexts (query/pattern lines);
+- template placeholders (``{…}``), trailing dots, and ``...``-truncated
+  example ids are skipped as false positives.
+
+Usage:
+    python -m cyberfabric_core_tpu.apps.gts_docs_validator [--vendor x]
+        [--exclude GLOB]... [--json] [--verbose] PATH...
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import re
+import sys
+from dataclasses import asdict, dataclass
+from pathlib import Path
+from typing import Optional
+
+#: candidate matcher (scanner.rs:31) — intentionally loose; validation decides
+_CANDIDATE_RE = re.compile(r"gts\.[a-z0-9_.*~\-]+\.[a-z0-9_.*~\-]+")
+
+_DOC_SUFFIXES = {".md", ".json", ".yaml", ".yml"}
+_SKIP_DIRS = {"target", "node_modules", ".git", "__pycache__", ".venv",
+              "build", "dist"}
+
+#: vendors used in docs as placeholders — exempt from --vendor enforcement
+_EXAMPLE_VENDORS = {"vendor", "example", "acme", "myvendor", "foo"}
+
+
+@dataclass
+class GtsError:
+    file: str
+    line: int
+    column: int
+    gts_id: str
+    error: str
+    context: str
+
+
+def _validate_schema_segment(segment: str) -> list[str]:
+    if not segment:
+        return []
+    if "-" in segment:
+        return [f"hyphen not allowed in schema segment: {segment!r}"]
+    parts = segment.split(".")
+    if len(parts) < 5:
+        return [f"schema segment needs 5 components "
+                f"(vendor.pkg.ns.name.version), got {len(parts)}: {segment!r}"]
+    version = parts[4]
+    if not version.startswith("v"):
+        return [f"version must start with 'v': {segment!r}"]
+    ver_numbers = [version[1:], *parts[5:]]
+    if not ver_numbers[0]:
+        return [f"version number missing after 'v': {segment!r}"]
+    for vc in ver_numbers:
+        if not vc.isdigit():
+            return [f"version components must be numeric: {segment!r}"]
+    for i, part in enumerate(parts[:4]):
+        if not part:
+            return [f"empty component at position {i}: {segment!r}"]
+        if not re.fullmatch(r"[a-z0-9_]+", part):
+            return [f"components must be lowercase alphanumeric/underscore: "
+                    f"{segment!r}"]
+    return []
+
+
+def _validate_instance_segment(segment: str) -> list[str]:
+    if not segment:
+        return []
+    if segment.startswith(".") and segment.lower().endswith(".json"):
+        return []  # filename suffix like .schema.json
+    if "-" in segment:
+        return []  # UUIDs etc.
+    if "." in segment:
+        for part in segment.split("."):
+            if part and not re.fullmatch(r"[a-z0-9_*]+", part):
+                return [f"instance segment contains invalid characters: "
+                        f"{segment!r}"]
+    return []
+
+
+def validate_gts_id(gts_id: str, expected_vendor: Optional[str] = None,
+                    allow_wildcards: bool = False) -> list[str]:
+    """Full-id validation (validator.rs:295-360). Returns error strings."""
+    original = gts_id
+    gts_id = gts_id.strip().strip("\"'")
+    if not gts_id.startswith("gts."):
+        return [f"must start with 'gts.': {original!r}"]
+    if "*" in gts_id and not allow_wildcards:
+        return [f"wildcards not allowed outside pattern contexts: {original!r}"]
+
+    rest = gts_id[4:]
+    segments = rest.split("~")
+    non_empty = [s for s in segments if s]
+    if not non_empty:
+        return [f"no segments after 'gts.': {original!r}"]
+
+    errors: list[str] = []
+    if "*" not in gts_id:
+        for i, seg in enumerate(non_empty):
+            errors.extend(_validate_schema_segment(seg) if i == 0
+                          else _validate_instance_segment(seg))
+        if len(non_empty) == 1 and not gts_id.endswith("~"):
+            errors.append(f"schema id must end with '~': {original!r}")
+
+    if expected_vendor:
+        vendor = non_empty[0].split(".")[0]
+        if ("*" not in vendor and vendor != expected_vendor
+                and vendor not in _EXAMPLE_VENDORS):
+            errors.append(f"vendor mismatch: expected {expected_vendor!r}, "
+                          f"found {vendor!r} in {original!r}")
+    return errors
+
+
+def _is_false_positive(raw: str) -> bool:
+    return "{" in raw or raw.endswith(".")
+
+
+def _wildcard_context(line: str) -> bool:
+    low = line.lower()
+    return "pattern" in low or "query" in low or "wildcard" in low
+
+
+def _bad_example_context(line: str, prev: list[str]) -> bool:
+    window = [line] + prev[-3:]
+    for text in window:
+        low = text.lower()
+        if "invalid" in low or "bad example" in low or "malformed" in low \
+                or "wrong" in low:
+            return True
+    return False
+
+
+def scan_file(path: Path, expected_vendor: Optional[str] = None,
+              verbose: bool = False) -> list[GtsError]:
+    try:
+        lines = path.read_text(encoding="utf-8", errors="replace").splitlines()
+    except OSError as e:
+        return [GtsError(str(path), 0, 0, "", f"failed to read file: {e}", "")]
+    errors: list[GtsError] = []
+    for idx, line in enumerate(lines):
+        for m in _CANDIDATE_RE.finditer(line):
+            raw = m.group(0)
+            # strip doc-example ellipsis BEFORE the false-positive filter
+            # (a '...'-suffixed id also ends with '.', which would swallow it)
+            gts_id, truncated = (raw[:-3], True) if raw.endswith("...") else (raw, False)
+            if truncated and gts_id.count(".") < 5:
+                continue  # the ellipsis cut the id short — not an error
+            if _is_false_positive(gts_id):
+                continue
+            if line[m.end():].startswith("{"):
+                continue  # template like gts.x.core.{type}_plugin.v1
+            if _bad_example_context(line, lines[max(0, idx - 3):idx]):
+                continue
+            for err in validate_gts_id(gts_id, expected_vendor,
+                                       allow_wildcards=_wildcard_context(line)):
+                start = max(m.start() - 20, 0)
+                ctx = line[start:m.end() + 20]
+                errors.append(GtsError(str(path), idx + 1, m.start() + 1,
+                                       gts_id, err, ctx))
+    if verbose and not errors:
+        print(f"  ok: {path}", file=sys.stderr)
+    return errors
+
+
+def find_files(paths: list[Path], exclude: list[str]) -> list[Path]:
+    out: list[Path] = []
+    for root in paths:
+        candidates = [root] if root.is_file() else sorted(root.rglob("*"))
+        for p in candidates:
+            if p.suffix.lower() not in _DOC_SUFFIXES or not p.is_file():
+                continue
+            rel = str(p)
+            if any(part in _SKIP_DIRS for part in p.parts):
+                continue
+            if any(fnmatch.fnmatch(rel, pat) for pat in exclude):
+                continue
+            out.append(p)
+    return out
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gts-docs-validator",
+        description="Validate GTS identifiers in documentation files")
+    ap.add_argument("paths", nargs="+", type=Path)
+    ap.add_argument("--vendor", help="expected vendor for all GTS ids")
+    ap.add_argument("--exclude", action="append", default=[],
+                    help="glob pattern to exclude (repeatable)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    files = find_files(args.paths, args.exclude)
+    all_errors: list[GtsError] = []
+    for f in files:
+        all_errors.extend(scan_file(f, args.vendor, args.verbose))
+
+    if args.as_json:
+        print(json.dumps({
+            "files_scanned": len(files),
+            "errors": [asdict(e) for e in all_errors],
+        }, indent=1))
+    else:
+        for e in all_errors:
+            print(f"{e.file}:{e.line}:{e.column}: {e.error}"
+                  f"  [{e.gts_id}]  …{e.context}…")
+        print(f"{len(files)} files scanned, {len(all_errors)} error(s)",
+              file=sys.stderr)
+    return 1 if all_errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,8 +130,10 @@ class Database:
         try:
             rows = self._engine.execute(
                 "SELECT version FROM _schema_migrations ORDER BY version").rows
-        except Exception:  # noqa: BLE001 — table absent (engine-specific error)
-            return []
+        except Exception as e:
+            if self._engine.is_missing_table_error(e):
+                return []  # unmigrated store — legitimately empty
+            raise  # real outage must surface, not read as "no migrations"
         return [r["version"] for r in rows]
 
     # ------------------------------------------------------------------ secure access

@@ -72,6 +72,11 @@ class DbEngine(abc.ABC):
     @abc.abstractmethod
     def advisory_lock(self, key: str) -> contextlib.AbstractContextManager: ...
 
+    def is_missing_table_error(self, exc: BaseException) -> bool:
+        """Whether ``exc`` means 'relation does not exist' — callers use this
+        to distinguish an unmigrated store from a real outage."""
+        return False
+
     @abc.abstractmethod
     def close(self) -> None: ...
 
@@ -130,6 +135,10 @@ class SqliteEngine(DbEngine):
 
     def raw_connection(self) -> sqlite3.Connection:
         return self._conn
+
+    def is_missing_table_error(self, exc: BaseException) -> bool:
+        return (isinstance(exc, sqlite3.OperationalError)
+                and "no such table" in str(exc))
 
     @contextlib.contextmanager
     def advisory_lock(self, key: str) -> Iterator[None]:
@@ -205,6 +214,11 @@ class PostgresEngine(DbEngine):
                         "use the sqlite engine.") from e
         self._driver = driver
         self._conn = driver.connect(dsn)
+        #: PG session advisory locks are re-entrant per session, and every
+        #: thread here shares ONE session — an in-process lock per key provides
+        #: the intra-process exclusion the session lock can't
+        self._local_locks: dict[str, threading.Lock] = {}
+        self._local_locks_guard = threading.Lock()
         # autocommit: commits are explicit in execute(), mirroring SqliteEngine
         try:
             self._conn.autocommit = True
@@ -263,16 +277,28 @@ class PostgresEngine(DbEngine):
     def raw_connection(self) -> Any:
         return self._conn
 
+    def is_missing_table_error(self, exc: BaseException) -> bool:
+        # psycopg: UndefinedTable carries sqlstate 42P01; fall back to message
+        code = getattr(getattr(exc, "diag", None), "sqlstate", None) \
+            or getattr(exc, "pgcode", None)
+        return code == "42P01" or "does not exist" in str(exc)
+
     @contextlib.contextmanager
     def advisory_lock(self, key: str) -> Iterator[None]:
-        """Session advisory lock; the key hashes to PG's bigint keyspace."""
-        key_id = int.from_bytes(
-            hashlib.sha256(key.encode()).digest()[:8], "big", signed=True)
-        self.execute("SELECT pg_advisory_lock(?)", [key_id])
-        try:
-            yield
-        finally:
-            self.execute("SELECT pg_advisory_unlock(?)", [key_id])
+        """Cross-process: PG session advisory lock (key hashed to the bigint
+        keyspace). Intra-process: a per-key thread lock — the session lock is
+        re-entrant within one session, so threads sharing this connection
+        would otherwise pass straight through."""
+        with self._local_locks_guard:
+            local = self._local_locks.setdefault(key, threading.Lock())
+        with local:
+            key_id = int.from_bytes(
+                hashlib.sha256(key.encode()).digest()[:8], "big", signed=True)
+            self.execute("SELECT pg_advisory_lock(?)", [key_id])
+            try:
+                yield
+            finally:
+                self.execute("SELECT pg_advisory_unlock(?)", [key_id])
 
     def close(self) -> None:
         with self._lock:

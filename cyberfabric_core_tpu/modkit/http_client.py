@@ -265,11 +265,21 @@ class HttpClient:
             # check, and non-GET/HEAD hops never re-send the body (a 307/308
             # from a token endpoint must not leak credentials — the reference
             # token client pins allow_redirects=false)
+            from urllib.parse import urlsplit
+
             target = full_url
             send_body = (json, data)
+            hop_headers = headers
+            origin_host = urlsplit(full_url).hostname
             for _hop in range(cfg.max_redirects + 1):
+                if urlsplit(target).hostname != origin_host and hop_headers:
+                    # cross-origin hop: credential-bearing headers must not
+                    # follow (aiohttp's built-in redirects strip these too)
+                    hop_headers = {k: v for k, v in hop_headers.items()
+                                   if k.lower() not in ("authorization", "cookie",
+                                                        "proxy-authorization")}
                 async with session.request(
-                    method, target, headers=headers, json=send_body[0],
+                    method, target, headers=hop_headers, json=send_body[0],
                     data=send_body[1], params=params if target is full_url else None,
                     allow_redirects=False,
                 ) as resp:

@@ -11,6 +11,7 @@ from .llm_gateway.module import LlmGatewayModule  # noqa: F401
 from .file_storage import FileStorageModule  # noqa: F401
 from .credstore import CredStoreModule  # noqa: F401
 from .types_registry import TypesRegistryModule  # noqa: F401
+from .types_base import TypesModule  # noqa: F401
 from .resolvers import (  # noqa: F401
     AuthnResolverModule,
     AuthzResolverModule,

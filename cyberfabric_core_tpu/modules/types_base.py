@@ -1,0 +1,80 @@
+"""types — core GTS type registration module.
+
+Reference: modules/system/types/src/lib.rs:1-26 — the owner of core framework
+schemas (``BaseModkitPluginV1``). Registering them from types_registry itself
+created a circular dependency in the reference's history; the fix is this
+separate module that DEPENDS ON types_registry and seeds the core schemas
+during its init, before any plugin module registers derived instances
+(dependency chain: types_registry → types → plugin modules).
+
+SDK surface: ``TypesClient.is_ready()`` (types-sdk/src/api.rs:20-31).
+"""
+
+from __future__ import annotations
+
+import abc
+
+from ..modkit import Module, module
+from ..modkit.context import ModuleCtx
+from ..modkit.contracts import SystemCapability
+from ..modkit.errors import ProblemError
+from ..modkit.security import SecurityContext
+from .sdk import GtsEntity, TypesRegistryApi
+
+
+class TypesClient(abc.ABC):
+    """Public API of the types module (types-sdk/src/api.rs)."""
+
+    @abc.abstractmethod
+    async def is_ready(self) -> bool:
+        """True once core schemas are registered."""
+
+
+#: the core framework schemas this module owns
+def core_gts_schemas() -> list[GtsEntity]:
+    return [
+        GtsEntity(
+            gts_id="gts.x.modkit.plugins.base_plugin.v1~",
+            kind="schema",
+            vendor="x",
+            description="Base plugin registration envelope (BaseModkitPluginV1)",
+            body={
+                "type": "object",
+                "required": ["id", "vendor", "priority"],
+                "properties": {
+                    "id": {"type": "string"},
+                    "vendor": {"type": "string"},
+                    "priority": {"type": "integer"},
+                    "properties": {"type": "object"},
+                },
+            },
+        ),
+    ]
+
+
+class _TypesLocalClient(TypesClient):
+    def __init__(self) -> None:
+        self._ready = False
+
+    def set_ready(self) -> None:
+        self._ready = True
+
+    async def is_ready(self) -> bool:
+        return self._ready
+
+
+@module(name="types", deps=["types_registry"], capabilities=["system"])
+class TypesModule(Module, SystemCapability):
+    def __init__(self) -> None:
+        self.client = _TypesLocalClient()
+
+    async def init(self, ctx: ModuleCtx) -> None:
+        registry = ctx.client_hub.get(TypesRegistryApi)
+        sysctx = SecurityContext.system()
+        for entity in core_gts_schemas():
+            try:
+                await registry.register(sysctx, entity)
+            except ProblemError:
+                pass  # already present (idempotent re-init)
+        self.client.set_ready()
+        ctx.client_hub.register(TypesClient, self.client)

@@ -123,28 +123,9 @@ class TypesRegistryModule(Module, RestApiCapability, SystemCapability):
 
     async def init(self, ctx: ModuleCtx) -> None:
         ctx.client_hub.register(TypesRegistryApi, self.service)
-        # seed base platform types (modules/system/types pattern: BaseModkitPluginV1)
-        base = GtsEntity(
-            gts_id="gts.x.modkit.plugins.base_plugin.v1~",
-            kind="schema",
-            vendor="x",
-            description="Base plugin registration envelope",
-            body={
-                "type": "object",
-                "required": ["id", "vendor", "priority"],
-                "properties": {
-                    "id": {"type": "string"},
-                    "vendor": {"type": "string"},
-                    "priority": {"type": "integer"},
-                    "properties": {"type": "object"},
-                },
-            },
-        )
-        sysctx = SecurityContext.system()
-        try:
-            await self.service.register(sysctx, base)
-        except ProblemError:
-            pass
+        # base platform schemas are owned by the separate `types` module
+        # (modules/types_base.py) — the reference split them out precisely to
+        # break the registry→base-types circular dependency
 
     async def post_init(self, ctx: ModuleCtx) -> None:
         self.service.mark_ready()

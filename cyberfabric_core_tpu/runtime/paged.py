@@ -299,6 +299,30 @@ class PrefixKVPool:
     def release_slot(self, chain: list[int]) -> None:
         self.unref_pages(chain)
 
+    # ------------------------------------------------------------ preemption
+    def save_chain_to_host(self, chain: list[int]) -> tuple[np.ndarray, np.ndarray]:
+        """Copy a slot's chain pages device→host (KV eviction for preempted
+        requests — SURVEY §5 checkpoint/resume; the serving analogue of the
+        reference's suspend path). One gather per pool; the transfer is the
+        chain's actual bytes, not the window."""
+        idx = jnp.asarray(chain, jnp.int32)
+        return (np.asarray(self.k_pool[:, idx]), np.asarray(self.v_pool[:, idx]))
+
+    def restore_chain_from_host(self, host_kv: tuple[np.ndarray, np.ndarray]) -> list[int]:
+        """Allocate fresh pages and scatter a saved chain back (device resume).
+        Raises MemoryError when the pool still lacks space — caller keeps the
+        request suspended. Restored pages are private (shared-prefix structure
+        is not reconstructed; correctness is unaffected)."""
+        n = host_kv[0].shape[1]
+        ids = self._alloc(n)
+        self.ref_pages(ids)
+        idx = jnp.asarray(ids, jnp.int32)
+        self.k_pool = self.k_pool.at[:, idx].set(
+            jnp.asarray(host_kv[0], self.k_pool.dtype))
+        self.v_pool = self.v_pool.at[:, idx].set(
+            jnp.asarray(host_kv[1], self.v_pool.dtype))
+        return ids
+
     def stats(self) -> dict[str, Any]:
         return {
             **self.tree.stats(),

@@ -67,6 +67,20 @@ class _Pending:
     enqueued_at: float = field(default_factory=time.monotonic)
 
 
+@dataclass
+class _Suspended:
+    """A preempted request: its KV pages live on HOST until pool space frees.
+    Resume restores the pages and continues decoding — no recompute, the
+    client stream just pauses (checkpoint/resume for in-flight requests)."""
+
+    state: _SlotState
+    host_kv: tuple  # (k, v) numpy [L, n_pages, page, Hkv, D]
+    length: int
+    last_token: int
+    slot_key: Any  # per-slot RNG key (reproducibility across the suspend)
+    suspended_at: float = field(default_factory=time.monotonic)
+
+
 class ContinuousBatchingEngine:
     """Runs a dedicated scheduler thread driving the device; submission is
     thread-safe. ``emit`` callbacks fire on the scheduler thread — bridge to
@@ -151,7 +165,11 @@ class ContinuousBatchingEngine:
             self.cache = llama.init_cache(
                 self.model_config, self.n_slots, config.max_seq_len, self.dtype)
 
+        from collections import deque as _deque
+
         self._pending: _queue.Queue[_Pending] = _queue.Queue()
+        self._suspended: "_deque[_Suspended]" = _deque()
+        self.preemptions = 0
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -289,6 +307,8 @@ class ContinuousBatchingEngine:
             "slots": self.n_slots,
             "active": self.active_slots,
             "pending": self._pending.qsize(),
+            "suspended": len(self._suspended),
+            "preemptions": self.preemptions,
             "tokens_emitted": self.tokens_emitted,
             "requests_completed": self.requests_completed,
             "mean_occupancy": round(occ, 2),
@@ -323,6 +343,12 @@ class ContinuousBatchingEngine:
                             pass
                         self.slots[slot] = None
                 self.active[:] = False
+                while self._suspended:  # preempted requests fail too
+                    rec = self._suspended.popleft()
+                    try:
+                        rec.state.emit(StepEvent(0, -1, "error"))
+                    except Exception:
+                        pass
                 while True:  # drain queued requests too
                     try:
                         req = self._pending.get_nowait()
@@ -337,8 +363,49 @@ class ContinuousBatchingEngine:
                 return i
         return None
 
+    def _resume_suspended(self) -> int:
+        """Restore preempted requests (FIFO) while slots AND pool space allow.
+        Suspended requests outrank new admissions — their prefill is already
+        paid and a client is mid-stream."""
+        resumed = 0
+        while self._suspended:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            rec = self._suspended[0]
+            try:
+                chain = self.pool.restore_chain_from_host(rec.host_kv)
+            except MemoryError:
+                break  # still no room; stay suspended
+            try:
+                self.pool.extend_chain(chain, rec.length + self._k_steps)
+            except MemoryError:
+                # give back the restored pages — a half-resume must not leak
+                self.pool.release_slot(chain)
+                break
+            self._suspended.popleft()
+            state = rec.state
+            state.chain = chain
+            self.slots[slot] = state
+            self.active[slot] = True
+            self.lengths[slot] = rec.length
+            s = state.sampling
+            self._temp[slot] = s.temperature
+            self._top_p[slot] = s.top_p
+            self._top_k[slot] = s.top_k
+            self._last_tokens = self._last_tokens.at[slot].set(rec.last_token)
+            self._slot_keys = self._slot_keys.at[slot].set(
+                jnp.asarray(rec.slot_key))
+            self.page_table[slot, :] = 0
+            self.page_table[slot, : len(chain)] = chain
+            self._pt_dirty = True
+            resumed += 1
+            logger.info("resumed %s into slot %d (len=%d)",
+                        state.request_id, slot, rec.length)
+        return resumed
+
     def _admit(self) -> int:
-        admitted = 0
+        admitted = self._resume_suspended() if self.paged else 0
         while True:
             slot = self._free_slot()
             if slot is None:
@@ -512,8 +579,19 @@ class ContinuousBatchingEngine:
                 self.page_table[slot, before: len(chain)] = chain[before:]
                 self._pt_dirty = True
             except MemoryError:
-                logger.warning("pool exhausted; failing %s", state.request_id)
-                state.emit(StepEvent(0, -1, "error"))
+                # preempt-to-host, don't shed: save the chain's KV, free the
+                # pages, and park the request — _admit resumes it when space
+                # frees (no recompute; the stream pauses, never errors)
+                logger.warning("pool exhausted; preempting %s to host "
+                               "(len=%d, %d pages)", state.request_id,
+                               int(self.lengths[slot]), len(chain))
+                host_kv = self.pool.save_chain_to_host(chain)
+                self._suspended.append(_Suspended(
+                    state=state, host_kv=host_kv,
+                    length=int(self.lengths[slot]),
+                    last_token=int(np.asarray(self._last_tokens)[slot]),
+                    slot_key=np.asarray(self._slot_keys[slot])))
+                self.preemptions += 1
                 self.active[slot] = False
                 self.slots[slot] = None
                 self.pool.release_slot(chain)

@@ -19,7 +19,7 @@ BASE_CONFIG = {
             "root": {}, "acme": {"parent": "root"}, "acme-eu": {"parent": "acme"}}}},
         "authn_resolver": {"config": {"mode": "accept_all", "default_tenant": "acme"}},
         "authz_resolver": {},
-        "types_registry": {},
+        "types_registry": {}, "types": {},
         "module_orchestrator": {},
         "nodes_registry": {"config": {"tenant": "acme"}},
         "model_registry": {"config": {

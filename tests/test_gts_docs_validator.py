@@ -1,0 +1,131 @@
+"""gts-docs-validator app (apps/gts_docs_validator.py) — validation matrix
+from the reference's validator.rs tests + CLI behavior on a doc tree."""
+
+import json
+
+from cyberfabric_core_tpu.apps.gts_docs_validator import (
+    main,
+    scan_file,
+    validate_gts_id,
+)
+
+
+# ------------------------------------------------------------------ id matrix
+
+def test_valid_schema_id():
+    assert validate_gts_id("gts.x.core.oagw.upstream.v1~") == []
+
+
+def test_valid_instance_id():
+    assert validate_gts_id("gts.x.core.oagw.upstream.v1~main") == []
+    assert validate_gts_id(
+        "gts.x.core.oagw.upstream.v1~7c9e6679-7425-40de-944b-e07fc1f90ae7") == []
+
+
+def test_chained_instance_id():
+    assert validate_gts_id(
+        "gts.x.core.credstore.plugin.v1~gts.x.core.credstore.sqlite.v1") == []
+
+
+def test_schema_must_end_with_tilde():
+    errs = validate_gts_id("gts.x.core.oagw.upstream.v1")
+    assert any("end with '~'" in e for e in errs)
+
+
+def test_too_few_components():
+    errs = validate_gts_id("gts.x.core.v1~")
+    assert any("5 components" in e for e in errs)
+
+
+def test_version_must_be_numeric():
+    errs = validate_gts_id("gts.x.core.oagw.upstream.vx~")
+    assert any("numeric" in e for e in errs)
+
+
+def test_hyphen_rejected_in_schema_segment():
+    errs = validate_gts_id("gts.x.core-api.oagw.upstream.v1~")
+    assert any("hyphen" in e.lower() for e in errs)
+
+
+def test_uppercase_rejected():
+    errs = validate_gts_id("gts.x.Core.oagw.upstream.v1~")
+    assert errs
+
+
+def test_multipart_version_ok():
+    assert validate_gts_id("gts.x.core.oagw.upstream.v1.2.3~") == []
+
+
+def test_wildcards_gated_by_context():
+    wid = "gts.x.core.oagw.*.v1~"
+    assert validate_gts_id(wid, allow_wildcards=True) == []
+    assert validate_gts_id(wid, allow_wildcards=False)
+
+
+def test_vendor_enforcement():
+    errs = validate_gts_id("gts.evil.core.oagw.upstream.v1~", expected_vendor="x")
+    assert any("vendor mismatch" in e for e in errs)
+    # example vendors are exempt
+    assert validate_gts_id("gts.acme.core.oagw.upstream.v1~",
+                           expected_vendor="x") == []
+
+
+# ------------------------------------------------------------------ scanning
+
+def test_scan_skips_templates_ellipsis_and_bad_examples(tmp_path):
+    doc = tmp_path / "doc.md"
+    doc.write_text("""# ids
+Good: `gts.x.core.oagw.upstream.v1~main`
+Template: gts.x.core.oagw.{type}_plugin.v1~
+Truncated example: gts.x.core.oagw.upstream.v1~7c9e6679...
+An invalid example (malformed): gts.x.core.v1~
+Query pattern: gts.x.core.oagw.*.v1~
+""")
+    errors = scan_file(doc, expected_vendor="x")
+    assert errors == [], [e.error for e in errors]
+
+
+def test_scan_reports_real_errors_with_location(tmp_path):
+    doc = tmp_path / "bad.md"
+    doc.write_text("line one\nuse gts.x.core.oagw.upstream.v9x~ here\n")
+    errors = scan_file(doc)
+    assert len(errors) == 1
+    assert errors[0].line == 2
+    assert "numeric" in errors[0].error
+
+
+def test_cli_json_output_and_exit_codes(tmp_path, capsys):
+    good = tmp_path / "good.md"
+    good.write_text("`gts.x.core.oagw.upstream.v1~`\n")
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("id: gts.x.core.oagw.upstream.v1\n")
+
+    rc = main([str(tmp_path), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["files_scanned"] == 2
+    assert len(out["errors"]) == 1
+    assert out["errors"][0]["file"].endswith("bad.yaml")
+
+    rc = main([str(good), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["errors"] == []
+
+
+def test_cli_exclude_globs(tmp_path):
+    (tmp_path / "keep.md").write_text("gts.x.core.oagw.upstream.v1~\n")
+    sub = tmp_path / "generated"
+    sub.mkdir()
+    (sub / "skip.md").write_text("gts.BROKEN\n")
+    rc = main([str(tmp_path), "--exclude", "*generated*"])
+    assert rc == 0
+
+
+def test_repo_docs_are_gts_clean():
+    """Dogfood: the repo's own docs must validate with --vendor x."""
+    from pathlib import Path
+
+    root = Path(__file__).parent.parent
+    rc = main([str(root / "docs"), str(root / "config"),
+               str(root / "README.md"), "--vendor", "x"])
+    assert rc == 0

@@ -261,3 +261,42 @@ def test_redirect_hop_to_private_literal_denied():
     with pytest.raises(PermissionError):
         c._check_literal_ip("http://127.0.0.1:8080/admin")
     c._check_literal_ip("http://93.184.216.34/")  # public: passes
+
+
+def test_cross_origin_redirect_strips_credentials(loop):
+    """Authorization must not follow a redirect to another host."""
+
+    async def go():
+        seen = {}
+
+        async def a_handler(request):
+            return web.Response(status=302, headers={"Location": seen["b_url"]})
+
+        async def b_handler(request):
+            seen["auth_at_b"] = request.headers.get("Authorization")
+            return web.json_response({"ok": True})
+
+        async def serve(handler):
+            app = web.Application()
+            app.router.add_get("/{tail:.*}", handler)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            return runner, site._server.sockets[0].getsockname()[1]
+
+        runner_a, port_a = await serve(a_handler)
+        runner_b, port_b = await serve(b_handler)
+        # different origin: localhost name vs 127.0.0.1 literal
+        seen["b_url"] = f"http://localhost:{port_b}/target"
+        try:
+            async with HttpClient(HttpClientConfig()) as c:
+                r = await c.get(f"http://127.0.0.1:{port_a}/start",
+                                headers={"Authorization": "Bearer sekrit"})
+                assert r.status == 200
+                assert seen["auth_at_b"] is None  # credential did not follow
+        finally:
+            await runner_a.cleanup()
+            await runner_b.cleanup()
+
+    loop.run_until_complete(go())

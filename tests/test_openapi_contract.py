@@ -24,7 +24,7 @@ def _current_routes():
         cfg = AppConfig.load_or_default(environ={}, cli_overrides={"modules": {
             name: {} for name in (
                 "api_gateway", "tenant_resolver", "authn_resolver",
-                "authz_resolver", "types_registry", "module_orchestrator",
+                "authz_resolver", "types_registry", "types", "module_orchestrator",
                 "nodes_registry", "model_registry", "llm_gateway",
                 "file_storage", "credstore", "file_parser",
                 "serverless_runtime", "oagw", "monitoring", "user_settings")}})

@@ -1,0 +1,41 @@
+"""types base module (modules/types_base.py) — owns core GTS schemas
+(reference modules/system/types: breaks the registry→base-types cycle)."""
+
+import asyncio
+
+from cyberfabric_core_tpu.modkit.security import SecurityContext
+
+
+def test_types_module_registers_core_schemas(client_hub):
+    from cyberfabric_core_tpu.modules.sdk import TypesRegistryApi
+    from cyberfabric_core_tpu.modules.types_base import TypesClient, TypesModule
+    from cyberfabric_core_tpu.modules.types_registry import TypesRegistryService
+
+    service = TypesRegistryService()
+    client_hub.register(TypesRegistryApi, service)
+
+    class Ctx:
+        pass
+
+    ctx = Ctx()
+    ctx.client_hub = client_hub
+    mod = TypesModule()
+
+    async def go():
+        await mod.init(ctx)
+        client = client_hub.get(TypesClient)
+        assert await client.is_ready()
+        ent = await service.get(SecurityContext.system(),
+                                "gts.x.modkit.plugins.base_plugin.v1~")
+        assert ent is not None and ent.kind == "schema"
+        # idempotent re-init (restart) must not raise
+        await mod.init(ctx)
+
+    asyncio.run(go())
+
+
+def test_types_module_declares_registry_dependency():
+    from cyberfabric_core_tpu.modkit.registry import _REGISTRATIONS
+
+    reg = next(r for r in _REGISTRATIONS if r.name == "types")
+    assert "types_registry" in reg.deps
