@@ -185,10 +185,17 @@ def find_files(paths: list[Path], exclude: list[str]) -> list[Path]:
         for p in candidates:
             if p.suffix.lower() not in _DOC_SUFFIXES or not p.is_file():
                 continue
-            rel = str(p)
             if any(part in _SKIP_DIRS for part in p.parts):
                 continue
-            if any(fnmatch.fnmatch(rel, pat) for pat in exclude):
+            # match excludes against the ROOT-relative path so the same
+            # pattern behaves identically for absolute and relative
+            # invocations (CI passes absolute paths, developers relative)
+            try:
+                rel = str(p.relative_to(root if root.is_dir() else root.parent))
+            except ValueError:
+                rel = str(p)
+            if any(fnmatch.fnmatch(rel, pat) or fnmatch.fnmatch(str(p), pat)
+                   for pat in exclude):
                 continue
             out.append(p)
     return out

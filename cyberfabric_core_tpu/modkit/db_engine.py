@@ -31,6 +31,7 @@ import contextlib
 import hashlib
 import sqlite3
 import threading
+import time
 from pathlib import Path
 from typing import Any, Iterator, Optional, Sequence
 
@@ -278,10 +279,17 @@ class PostgresEngine(DbEngine):
         return self._conn
 
     def is_missing_table_error(self, exc: BaseException) -> bool:
-        # psycopg: UndefinedTable carries sqlstate 42P01; fall back to message
+        # psycopg: UndefinedTable carries sqlstate 42P01. The sqlstate is
+        # authoritative when present — a 42703 UndefinedColumn also says
+        # "does not exist" and must NOT read as missing-table (it would make
+        # the migrator re-run everything against a live store). The message
+        # fallback applies only to driver errors with no sqlstate at all.
         code = getattr(getattr(exc, "diag", None), "sqlstate", None) \
             or getattr(exc, "pgcode", None)
-        return code == "42P01" or "does not exist" in str(exc)
+        if code is not None:
+            return code == "42P01"
+        return ("does not exist" in str(exc)
+                and ("relation" in str(exc) or "table" in str(exc)))
 
     @contextlib.contextmanager
     def advisory_lock(self, key: str) -> Iterator[None]:
@@ -294,7 +302,20 @@ class PostgresEngine(DbEngine):
         with local:
             key_id = int.from_bytes(
                 hashlib.sha256(key.encode()).digest()[:8], "big", signed=True)
-            self.execute("SELECT pg_advisory_lock(?)", [key_id])
+            # Poll pg_try_advisory_lock instead of blocking inside
+            # pg_advisory_lock: execute() holds the engine-wide connection
+            # lock, and a server-side wait under it would stall every query on
+            # this connection — including the unlock another thread needs
+            # (cross-process ABBA deadlock). Each try is a short round trip;
+            # the connection stays usable between attempts.
+            delay = 0.01
+            while True:
+                got = self.execute("SELECT pg_try_advisory_lock(?) AS ok",
+                                   [key_id]).rows[0]["ok"]
+                if got:
+                    break
+                time.sleep(delay)
+                delay = min(delay * 2, 0.5)
             try:
                 yield
             finally:

@@ -270,11 +270,15 @@ class HttpClient:
             target = full_url
             send_body = (json, data)
             hop_headers = headers
-            origin_host = urlsplit(full_url).hostname
+            origin = urlsplit(full_url)
             for _hop in range(cfg.max_redirects + 1):
-                if urlsplit(target).hostname != origin_host and hop_headers:
-                    # cross-origin hop: credential-bearing headers must not
-                    # follow (aiohttp's built-in redirects strip these too)
+                hop = urlsplit(target)
+                downgraded = origin.scheme == "https" and hop.scheme != "https"
+                if (hop.hostname != origin.hostname or downgraded) and hop_headers:
+                    # cross-origin hop OR https→http downgrade: credential-
+                    # bearing headers must not follow — same host over
+                    # cleartext still leaks the bearer (requests'
+                    # should_strip_auth treats the downgrade as cross-origin)
                     hop_headers = {k: v for k, v in hop_headers.items()
                                    if k.lower() not in ("authorization", "cookie",
                                                         "proxy-authorization")}

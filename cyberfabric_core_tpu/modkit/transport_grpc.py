@@ -138,6 +138,11 @@ class JsonGrpcServer:
         return server
 
     async def start(self, bind_addr: str = "127.0.0.1:0") -> int:
+        """Bind and serve. ``bind_addr`` is either ``host:port`` (TCP) or a
+        ``unix:/path`` / ``unix-abstract:name`` socket (grpc-hub ListenConfig
+        {Tcp, Uds} — module.rs:36-41; named pipes are Windows-only there and
+        UDS is their POSIX analogue). For UDS, gRPC returns port 1 as the
+        bind-success sentinel; callers use the address itself as the endpoint."""
         self._server = self._build()
         self.bound_port = self._server.add_insecure_port(bind_addr)
         if self.bound_port == 0:

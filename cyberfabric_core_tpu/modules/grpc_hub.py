@@ -53,9 +53,14 @@ class GrpcHubModule(Module, SystemCapability, RunnableCapability):
 
     async def start(self, ctx: ModuleCtx, ready: ReadySignal) -> None:
         self.bound_port = await self.server.start(self.config.bind_addr)
-        # OoP children find the directory through this endpoint
-        host = self.config.bind_addr.rsplit(":", 1)[0] or "127.0.0.1"
-        self.endpoint = f"{host}:{self.bound_port}"
+        # OoP children find the directory through this endpoint. A unix:/path
+        # bind IS the endpoint (ListenConfig::Uds — grpc targets accept it
+        # verbatim); for TCP the ephemeral port is substituted in.
+        if self.config.bind_addr.startswith(("unix:", "unix-abstract:")):
+            self.endpoint = self.config.bind_addr
+        else:
+            host = self.config.bind_addr.rsplit(":", 1)[0] or "127.0.0.1"
+            self.endpoint = f"{host}:{self.bound_port}"
         ctx.system["directory_endpoint"] = self.endpoint
 
         async def evict_loop() -> None:

@@ -1,4 +1,4 @@
-"""Non-text modalities: image generation, TTS, STT (llm-gateway PRD FRs).
+"""Non-text modalities: image/video generation, TTS, STT (llm-gateway PRD FRs).
 
 Reference flow (PRD.md:104-311 image/audio FRs; ADR-0003 media-via-FileStorage):
 the gateway translates, the PROVIDER computes — exactly as the reference
@@ -9,6 +9,8 @@ managed model return 501 with a clear problem rather than pretending.
 - image generation → provider ``images/generations`` (OpenAI dialect),
   b64 payloads are stored into file-storage and returned as platform URLs
   (ADR-0003: generated media never travels inline past the gateway);
+- video generation → provider ``videos/generations``; job-shaped providers
+  ({id, status}) are polled until completion, then stored the same way;
 - TTS → provider ``audio/speech`` → audio bytes → file-storage URL;
 - STT → provider ``audio/transcriptions`` (multipart) → text.
 """
@@ -49,19 +51,24 @@ def _require_capability(model: ModelInfo, flag: str, what: str) -> None:
 class MediaAdapter:
     """Provider-backed media operations through the OAGW data-plane seam."""
 
-    def __init__(self, oagw: OagwApi, storage: Optional[FileStorageApi]) -> None:
+    def __init__(self, oagw: OagwApi, storage: Optional[FileStorageApi],
+                 *, video_poll_interval_s: float = 2.0,
+                 video_poll_timeout_s: float = 120.0) -> None:
         self._oagw = oagw
         self._storage = storage
+        self._video_poll_interval_s = video_poll_interval_s
+        self._video_poll_timeout_s = video_poll_timeout_s
 
     async def _provider_call(self, ctx: SecurityContext, model: ModelInfo,
                              path: str, *, json_body: Any = None,
-                             data: Any = None, raw: bool = False):
-        """One provider POST with shared error mapping; ``raw`` returns the
+                             data: Any = None, raw: bool = False,
+                             method: str = "POST"):
+        """One provider call with shared error mapping; ``raw`` returns the
         body bytes (audio), otherwise parsed JSON. Transport-level failures
         surface as the OAGW seam's 502 upstream_error — the seam wraps
         aiohttp.ClientError itself, including mid-body reads at the yield."""
         async with self._oagw.open_upstream_stream(
-            ctx, model.provider_slug, path, method="POST",
+            ctx, model.provider_slug, path, method=method,
             json_body=json_body, data=data,
         ) as resp:
             if resp.status >= 400:
@@ -111,6 +118,72 @@ class MediaAdapter:
             raise ProblemError(Problem(
                 status=502, title="Bad Gateway", code="provider_error",
                 detail="provider returned no image payloads"))
+        return {"data": items, "model_used": model.canonical_id}
+
+    # ------------------------------------------------------------- video
+    async def generate_video(self, ctx: SecurityContext, model: ModelInfo,
+                             body: dict) -> dict:
+        """Video generation (PRD video FR). Video providers are job-shaped:
+        the create call usually returns ``{id, status}`` and the result must be
+        polled — unlike images, which complete inline. Both shapes are handled:
+        an immediate ``data`` payload is used as-is; a job id is polled at
+        ``video_poll_interval_s`` until completed/failed or the poll timeout.
+        Finished payloads are stored into file-storage (ADR-0003: generated
+        media never travels inline past the gateway)."""
+        import asyncio
+        import time as _time
+
+        if model.managed:
+            raise _managed_unsupported(model, "video generation")
+        _require_capability(model, "video_generation", "video generation")
+        storage = self._storage_required()  # before billing the provider
+        provider_body = {"model": model.provider_model_id,
+                         "prompt": body["prompt"],
+                         "response_format": "b64_json"}
+        if body.get("size"):
+            provider_body["size"] = body["size"]
+        if body.get("duration_seconds"):
+            provider_body["duration_seconds"] = int(body["duration_seconds"])
+        out = await self._provider_call(ctx, model, "videos/generations",
+                                        json_body=provider_body)
+        deadline = _time.monotonic() + self._video_poll_timeout_s
+        while "data" not in out:
+            status = str(out.get("status", ""))
+            if status in ("failed", "cancelled", "error"):
+                raise ProblemError(Problem(
+                    status=502, title="Bad Gateway", code="provider_error",
+                    detail=f"video generation {status}: "
+                           f"{str(out.get('error', ''))[:200]}"))
+            job_id = out.get("id")
+            if not job_id:
+                raise ProblemError(Problem(
+                    status=502, title="Bad Gateway", code="provider_error",
+                    detail="provider returned neither video data nor a job id"))
+            if _time.monotonic() > deadline:
+                raise ProblemError(Problem(
+                    status=504, title="Gateway Timeout", code="provider_timeout",
+                    detail=f"video job {job_id} still {status or 'pending'} "
+                           f"after {self._video_poll_timeout_s:.0f}s"))
+            await asyncio.sleep(self._video_poll_interval_s)
+            out = await self._provider_call(
+                ctx, model, f"videos/generations/{job_id}", method="GET")
+
+        items = []
+        for entry in out.get("data", []):
+            if entry.get("b64_json"):
+                raw = base64.b64decode(entry["b64_json"])
+                stored = await storage.store(
+                    ctx, raw, "video/mp4", filename="generated.mp4")
+                items.append({"url": stored.url,
+                              "size_bytes": stored.size_bytes,
+                              "revised_prompt": entry.get("revised_prompt")})
+            elif entry.get("url"):
+                items.append({"url": entry["url"],
+                              "revised_prompt": entry.get("revised_prompt")})
+        if not items:
+            raise ProblemError(Problem(
+                status=502, title="Bad Gateway", code="provider_error",
+                detail="provider returned no video payloads"))
         return {"data": items, "model_used": model.canonical_id}
 
     # ------------------------------------------------------------- tts

@@ -128,6 +128,8 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         self.batches: dict[str, dict] = {}
         self.ttft_timeout_s = 120.0
         self.total_timeout_s = 600.0
+        self._video_poll_interval_s = 2.0
+        self._video_poll_timeout_s = 120.0
         self._external = None
         self._job_tasks: set[asyncio.Task] = set()
 
@@ -142,6 +144,8 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         self.usage = UsageTracker(cfg.get("budgets"))
         self.ttft_timeout_s = float(cfg.get("ttft_timeout_s", 120.0))
         self.total_timeout_s = float(cfg.get("total_timeout_s", 600.0))
+        self._video_poll_interval_s = float(cfg.get("video_poll_interval_s", 2.0))
+        self._video_poll_timeout_s = float(cfg.get("video_poll_timeout_s", 120.0))
         self._hub = ctx.client_hub  # external adapter resolves lazily (oagw may
         #                             init after this module — no dep ordering)
 
@@ -630,8 +634,10 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
 
             oagw = self._hub.try_get(OagwApi)
             if oagw is not None:
-                self._media = MediaAdapter(oagw,
-                                           self._hub.try_get(FileStorageApi))
+                self._media = MediaAdapter(
+                    oagw, self._hub.try_get(FileStorageApi),
+                    video_poll_interval_s=self._video_poll_interval_s,
+                    video_poll_timeout_s=self._video_poll_timeout_s)
         return getattr(self, "_media", None)
 
     def _media_required(self):
@@ -650,6 +656,16 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         out = await self._media_required().generate_image(ctx, model, body)
         self.usage.report(ctx, {"input_tokens": 0, "output_tokens": 0,
                                 "images": len(out["data"])})
+        return out
+
+    async def handle_video_generation(self, request: web.Request):
+        body = await read_json(request, schemas.VIDEO_REQUEST)
+        ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
+        self.usage.check_budget(ctx)
+        model = await self.registry.resolve(ctx, body["model"])
+        out = await self._media_required().generate_video(ctx, model, body)
+        self.usage.report(ctx, {"input_tokens": 0, "output_tokens": 0,
+                                "videos": len(out["data"])})
         return out
 
     async def handle_speech(self, request: web.Request):
@@ -722,6 +738,9 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         router.operation("POST", "/v1/images/generations", module=m).auth_required() \
             .summary("Generate images (provider-backed; stored via file-storage)") \
             .handler(self.handle_image_generation).register()
+        router.operation("POST", "/v1/videos/generations", module=m).auth_required() \
+            .summary("Generate video (provider-backed, job-polling; stored via file-storage)") \
+            .handler(self.handle_video_generation).register()
         router.operation("POST", "/v1/audio/speech", module=m).auth_required() \
             .summary("Text-to-speech (provider-backed; audio via file-storage)") \
             .handler(self.handle_speech).register()

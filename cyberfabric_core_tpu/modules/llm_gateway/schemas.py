@@ -185,6 +185,18 @@ IMAGE_REQUEST = _schema("content", "image_request", {
     "additionalProperties": False,
 })
 
+VIDEO_REQUEST = _schema("content", "video_request", {
+    "type": "object",
+    "required": ["model", "prompt"],
+    "properties": {
+        "model": {"type": "string"},
+        "prompt": {"type": "string", "minLength": 1},
+        "duration_seconds": {"type": "integer", "minimum": 1, "maximum": 60},
+        "size": {"type": "string"},
+    },
+    "additionalProperties": False,
+})
+
 SPEECH_REQUEST = _schema("content", "speech_request", {
     "type": "object",
     "required": ["model", "input"],

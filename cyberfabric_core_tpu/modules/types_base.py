@@ -74,7 +74,11 @@ class TypesModule(Module, SystemCapability):
         for entity in core_gts_schemas():
             try:
                 await registry.register(sysctx, entity)
-            except ProblemError:
-                pass  # already present (idempotent re-init)
+            except ProblemError as e:
+                # only the already-present conflict is benign (idempotent
+                # re-init); anything else means a core schema failed to land
+                # and must not be reported ready
+                if e.problem.code != "gts_exists":
+                    raise
         self.client.set_ready()
         ctx.client_hub.register(TypesClient, self.client)

@@ -24,10 +24,13 @@ from ..gateway.middleware import SECURITY_CONTEXT_KEY
 from ..gateway.validation import read_json
 from .sdk import GtsEntity, TypesRegistryApi
 
-#: gts.vendor.pkg.ns.name.v1~ with optional instance suffix
+#: gts.vendor.pkg.ns.name.v1~ with optional instance suffix; versions may be
+#: multipart (v1.2.3) per the reference validator — the docs validator
+#: (apps/gts_docs_validator.py) accepts the same grammar, kept in agreement by
+#: tests/test_gts_docs_validator.py::test_agrees_with_runtime_registry
 _GTS_ID_RE = re.compile(
     r"^gts\.(?P<vendor>[a-z0-9_]+)\.(?P<pkg>[a-z0-9_]+)\.(?P<ns>[a-z0-9_]+)"
-    r"\.(?P<name>[a-z0-9_]+)\.v(?P<ver>\d+)~(?P<instance>[A-Za-z0-9_.\-]*)$"
+    r"\.(?P<name>[a-z0-9_]+)\.v(?P<ver>\d+(?:\.\d+)*)~(?P<instance>[A-Za-z0-9_.\-]*)$"
 )
 
 _GTS_NAMESPACE_UUID = uuid.UUID("6ba7b812-9dad-11d1-80b4-00c04fd430c8")  # uuid5 ns

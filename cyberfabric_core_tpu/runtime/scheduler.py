@@ -375,14 +375,27 @@ class ContinuousBatchingEngine:
             rec = self._suspended[0]
             try:
                 chain = self.pool.restore_chain_from_host(rec.host_kv)
+                try:
+                    self.pool.extend_chain(chain, rec.length + self._k_steps)
+                except MemoryError:
+                    # give back the restored pages — a half-resume must not leak
+                    self.pool.release_slot(chain)
+                    raise
             except MemoryError:
+                # No active slot will ever free pages for a request the idle
+                # pool still can't hold — retrying forever would hang the
+                # client's stream (and, FIFO, everyone suspended behind it).
+                # Terminal-shed it; otherwise park and wait for decode churn.
+                if not self.active.any():
+                    self._suspended.popleft()
+                    logger.warning(
+                        "request %s (len=%d) cannot fit the idle pool; "
+                        "finishing with 'length'", rec.state.request_id,
+                        rec.length)
+                    rec.state.emit(StepEvent(0, -1, "length"))
+                    self.requests_completed += 1
+                    continue
                 break  # still no room; stay suspended
-            try:
-                self.pool.extend_chain(chain, rec.length + self._k_steps)
-            except MemoryError:
-                # give back the restored pages — a half-resume must not leak
-                self.pool.release_slot(chain)
-                break
             self._suspended.popleft()
             state = rec.state
             state.chain = chain
@@ -563,7 +576,9 @@ class ContinuousBatchingEngine:
         """Paged mode: before a chunk, every active slot's chain must cover its
         length + k tokens (a chunk may cross a page boundary mid-flight; page
         allocation is host-side, so it happens here, never inside jit). Slots
-        the pool cannot serve are finished with 'length' (bounded shed)."""
+        the pool cannot serve are preempted to host and resumed by _admit when
+        space frees; a request even an idle pool can't hold is terminal-shed
+        there (bounded — no infinite retry)."""
         for slot in range(self.n_slots):
             state = self.slots[slot]
             if state is None or not self.active[slot]:

@@ -39,7 +39,7 @@ class FakeCursor:
         assert "?" not in re.sub(r"'[^']*'", "", sql), \
             f"qmark placeholder leaked to the PG driver: {sql!r}"
         self._conn.statements.append(sql)
-        if "pg_advisory_lock" in sql or "pg_advisory_unlock" in sql:
+        if "advisory_lock" in sql or "advisory_unlock" in sql:
             self._conn.advisory_calls.append((sql, tuple(params)))
             self.description = [("ok",)]
             self._rows = [(True,)]
@@ -181,7 +181,7 @@ def test_matrix_on_postgres_engine():
     assert any(s.startswith("INSERT INTO things") for s in stmts)
     assert all("?" not in re.sub(r"'[^']*'", "", s) for s in stmts)
     # migrations ran under the PG advisory lock
-    assert any("pg_advisory_lock" in s for s, _ in driver.conns[0].advisory_calls)
+    assert any("pg_try_advisory_lock" in s for s, _ in driver.conns[0].advisory_calls)
     assert any("pg_advisory_unlock" in s for s, _ in driver.conns[0].advisory_calls)
 
 

@@ -69,6 +69,75 @@ def test_json_grpc_roundtrip_and_errors():
     asyncio.run(go())
 
 
+def test_json_grpc_over_unix_domain_socket(tmp_path):
+    """ListenConfig::Uds parity (grpc-hub module.rs:36-41): the same server and
+    client stack over a unix:/path bind, endpoint string used verbatim."""
+    async def go():
+        server = JsonGrpcServer()
+
+        async def echo(req):
+            return {"echo": req}
+
+        server.add_service("test.Svc", {"Echo": echo})
+        addr = f"unix:{tmp_path}/hub.sock"
+        sentinel = await server.start(addr)
+        assert sentinel == 1  # gRPC's UDS bind-success sentinel, not a port
+        client = JsonGrpcClient(addr)
+        try:
+            out = await client.call("test.Svc", "Echo", {"over": "uds"})
+            assert out == {"echo": {"over": "uds"}}
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(go())
+
+
+def test_grpc_hub_uds_endpoint(tmp_path):
+    """A unix-bound grpc_hub publishes the UDS address itself as the directory
+    endpoint (no host:port substitution)."""
+    from cyberfabric_core_tpu.modules.grpc_hub import GrpcHubConfig, GrpcHubModule
+    from cyberfabric_core_tpu.modkit.lifecycle import ReadySignal as RS
+
+    async def go():
+        uds = f"unix:{tmp_path}/dir.sock"
+
+        class Ctx:
+            class cancellation_token:  # noqa: N801 — minimal stub
+                is_cancelled = True
+
+            system = {}
+
+            class client_hub:  # noqa: N801
+                @staticmethod
+                def register(*a, **k):
+                    pass
+
+            @staticmethod
+            def raw_config():
+                return {"bind_addr": uds}
+
+        hub = GrpcHubModule()
+        await hub.init(Ctx)
+        assert hub.config == GrpcHubConfig(bind_addr=uds)
+        ready = RS()
+        await hub.start(Ctx, ready)
+        try:
+            assert Ctx.system["directory_endpoint"] == f"unix:{tmp_path}/dir.sock"
+            from cyberfabric_core_tpu.modkit.transport_grpc import DirectoryClient
+
+            client = DirectoryClient(Ctx.system["directory_endpoint"])
+            # directory reachable over the socket: full register/resolve trip
+            iid = await client.register("svc.Uds", "unix:/tmp/x", "m")
+            resolved = await client.resolve("svc.Uds")
+            assert resolved["instance_id"] == iid
+            await client.close()
+        finally:
+            await hub.stop(Ctx)
+
+    asyncio.run(go())
+
+
 def test_grpc_client_retries_unavailable():
     async def go():
         from cyberfabric_core_tpu.modkit.transport_grpc import GrpcClientConfig

@@ -121,6 +121,52 @@ def test_cli_exclude_globs(tmp_path):
     assert rc == 0
 
 
+def test_cli_exclude_globs_absolute_and_relative_agree(tmp_path):
+    """The same root-relative exclude pattern works whether the scan path is
+    given absolute or relative (review finding: str(p) matching made exclude
+    behavior depend on invocation form)."""
+    import os
+
+    (tmp_path / "keep.md").write_text("gts.x.core.oagw.upstream.v1~\n")
+    sub = tmp_path / "generated"
+    sub.mkdir()
+    (sub / "skip.md").write_text("gts.BROKEN\n")
+    assert main([str(tmp_path), "--exclude", "generated/*"]) == 0
+    cwd = os.getcwd()
+    os.chdir(tmp_path.parent)
+    try:
+        assert main([tmp_path.name, "--exclude", "generated/*"]) == 0
+    finally:
+        os.chdir(cwd)
+
+
+def test_agrees_with_runtime_registry():
+    """The docs validator and the live types-registry accept/reject the same
+    plain (non-wildcard) type ids — docs must never bless an id the API 422s."""
+    from cyberfabric_core_tpu.modules.types_registry import (
+        validate_gts_id as runtime_validate,
+    )
+    from cyberfabric_core_tpu.modkit.errors import ProblemError
+
+    corpus = [
+        "gts.x.core.oagw.upstream.v1~",
+        "gts.x.llmgw.core.request.v1~",
+        "gts.x.core.oagw.upstream.v1.2.3~",   # multipart version
+        "gts.acme.pkg.ns.name.v2~inst.a",
+        "gts.x.Core.oagw.upstream.v1~",       # uppercase → reject
+        "gts.x.core.oagw.upstream.v~",        # missing version number
+        "gts.x.core.upstream.v1~",            # too few components
+    ]
+    for gid in corpus:
+        docs_ok = validate_gts_id(gid) == []
+        try:
+            runtime_validate(gid)
+            runtime_ok = True
+        except ProblemError:
+            runtime_ok = False
+        assert docs_ok == runtime_ok, f"validators disagree on {gid!r}"
+
+
 def test_repo_docs_are_gts_clean():
     """Dogfood: the repo's own docs must validate with --vendor x."""
     from pathlib import Path

@@ -11,6 +11,7 @@ from aiohttp import web
 
 PNG = (b"\x89PNG\r\n\x1a\n" + b"\x00" * 16)
 MP3 = b"ID3fake-mp3-bytes" * 4
+MP4 = b"\x00\x00\x00 ftypisom" + b"\x00" * 24
 
 
 @pytest.fixture()
@@ -65,7 +66,30 @@ def stack(fresh_registry):
             return web.json_response({"text": "hello from audio",
                                       "language": "en"})
 
+        video_polls: dict[str, int] = {}
+
+        async def videos(request):
+            body = await request.json()
+            seen.append({"path": "videos", "body": body})
+            # job-shaped create: the gateway must poll for the result
+            video_polls["vid-1"] = 0
+            return web.json_response({"id": "vid-1", "status": "processing"})
+
+        async def video_status(request):
+            vid = request.match_info["vid"]
+            video_polls[vid] = video_polls.get(vid, 0) + 1
+            seen.append({"path": "video_poll", "id": vid,
+                         "n": video_polls[vid]})
+            if video_polls[vid] < 2:
+                return web.json_response({"id": vid, "status": "processing"})
+            return web.json_response({
+                "id": vid, "status": "completed",
+                "data": [{"b64_json": base64.b64encode(MP4).decode(),
+                          "revised_prompt": "a cinematic cat"}]})
+
         mock.router.add_post("/v1/images/generations", images)
+        mock.router.add_post("/v1/videos/generations", videos)
+        mock.router.add_get("/v1/videos/generations/{vid}", video_status)
         mock.router.add_post("/v1/audio/speech", speech)
         mock.router.add_post("/v1/audio/transcriptions", transcriptions)
         runner = web.AppRunner(mock)
@@ -86,6 +110,9 @@ def stack(fresh_registry):
                     {"provider_slug": "media-mock", "provider_model_id": "pix",
                      "approval_state": "approved", "managed": False,
                      "capabilities": {"image_generation": True}},
+                    {"provider_slug": "media-mock", "provider_model_id": "vidgen",
+                     "approval_state": "approved", "managed": False,
+                     "capabilities": {"video_generation": True}},
                     {"provider_slug": "media-mock", "provider_model_id": "tts-1",
                      "approval_state": "approved", "managed": False,
                      "capabilities": {"tts": True}},
@@ -97,7 +124,7 @@ def stack(fresh_registry):
                      "architecture": "llama",
                      "engine_options": {"model_config": "tiny-llama"}},
                 ]}},
-            "llm_gateway": {},
+            "llm_gateway": {"config": {"video_poll_interval_s": 0.02}},
         }})
         registry = ModuleRegistry.discover_and_build(extra=regs)
         rt = HostRuntime(RunOptions(config=cfg, registry=registry,
@@ -150,6 +177,33 @@ def test_image_generation_stored_via_file_storage(stack):
     assert status == 200 and raw == PNG
     assert seen[0]["body"]["prompt"] == "a cat on a TPU"
     assert seen[0]["body"]["model"] == "pix"
+
+
+def test_video_generation_polled_and_stored(stack):
+    loop, base, seen = stack
+    status, body = _req(loop, "POST", f"{base}/v1/videos/generations", json={
+        "model": "media-mock::vidgen", "prompt": "a TPU pod spinning",
+        "duration_seconds": 4})
+    assert status == 200, body
+    assert body["model_used"] == "media-mock::vidgen"
+    assert body["data"][0]["revised_prompt"] == "a cinematic cat"
+    url = body["data"][0]["url"]
+    assert url.startswith("/v1/files/")
+    status, raw = _req(loop, "GET", f"{base}{url}")
+    assert status == 200 and raw == MP4
+    create = next(s for s in seen if s.get("path") == "videos")
+    assert create["body"]["model"] == "vidgen"
+    assert create["body"]["duration_seconds"] == 4
+    # the job really was polled to completion (two status round trips)
+    assert [s["n"] for s in seen if s.get("path") == "video_poll"] == [1, 2]
+
+
+def test_video_capability_gated(stack):
+    loop, base, _ = stack
+    # the image model does not declare video_generation -> 409, never billed
+    status, body = _req(loop, "POST", f"{base}/v1/videos/generations", json={
+        "model": "media-mock::pix", "prompt": "nope"})
+    assert status == 409 and body["code"] == "capability_missing"
 
 
 def test_tts_audio_via_file_storage(stack):

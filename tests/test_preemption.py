@@ -136,6 +136,24 @@ def test_suspended_request_outranks_new_admissions():
         sched.shutdown()
 
 
+def test_unserviceable_suspended_request_terminal_sheds_not_hangs():
+    """A preempted request even the IDLE pool can't re-hold must finish with
+    'length' instead of retrying forever (review finding: infinite resume loop
+    left the client stream — and everyone queued behind it — hanging)."""
+    sched = ContinuousBatchingEngine(_cfg(), seed=0)
+    try:
+        def always_fail(chain, needed):
+            raise MemoryError("no pages, ever")
+
+        sched.pool.extend_chain = always_fail
+        prompt = [5] * 20
+        out = _collect(sched, prompt, max_tokens=16)  # must terminate
+        assert out["finish"] == "length"
+        assert sched.stats()["preemptions"] >= 1
+    finally:
+        sched.shutdown()
+
+
 def test_scheduler_failure_fails_suspended_requests_too():
     sched = ContinuousBatchingEngine(_cfg(), seed=0)
     try:
