@@ -3,9 +3,9 @@
 TPU-first design choices:
 - **Stacked layer parameters + lax.scan** over layers: one compiled layer body
   regardless of depth (compile time O(1) in num_layers, and XLA pipelines the scan).
-- **Dense KV cache [L, B, S, Hkv, D]** with per-row insert offsets via vmapped
-  dynamic_update_slice (a scatter XLA handles natively); static S keeps every shape
-  compile-time constant.
+- **Dense KV cache [L, B, S, Hkv, D]** carried through the layer scan and updated
+  with a token-sized scatter (while-loop carries alias in place, so decode writes
+  T new tokens, never the cache); static S keeps every shape compile-time constant.
 - **bf16 weights/activations, f32 softmax/norm statistics**, einsum contractions
   with preferred_element_type=f32 so the MXU accumulates in f32.
 - Forward returns hidden states; the LM head is applied separately so prefill can
@@ -100,13 +100,6 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 def init_cache(cfg: ModelConfig, batch: int, max_seq: int, dtype=jnp.bfloat16) -> KVCache:
     shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
-
-
-def _insert_kv(cache_l: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray) -> jnp.ndarray:
-    """Write new [B, T, Hkv, D] into cache_l [B, S, Hkv, D] at per-row offset."""
-    return jax.vmap(
-        lambda c, n, s: jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
-    )(cache_l, new, start)
 
 
 def _moe_mlp_dense(x: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
@@ -271,13 +264,24 @@ def forward(
                      params["final_norm"].dtype)  # [B, T, H] gather
     kv_len_after = cache_start + T  # valid cache length after this step's insert
 
-    def layer_body(h, xs):
-        lp, k_cache_l, v_cache_l = xs
+    # The cache rides the scan CARRY (not ys): XLA aliases while-loop carries
+    # in place, so each layer writes only its [B, T] new tokens via scatter —
+    # the ys formulation re-materialized the full layer cache every step,
+    # which at decode (T=1) cost a cache-sized HBM write per token
+    # (ROUND_NOTES r1 item 2: scan-carry cache copies).
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]            # [B, 1]
+    t_idx = cache_start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    def layer_body(carry, xs):
+        h, k_cache, v_cache = carry
+        lp, layer = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
         q, kproj, vproj = _qkv_proj(lp, x, cfg, positions, cos_t, sin_t)
 
-        k_cache_l = _insert_kv(k_cache_l, kproj, cache_start)
-        v_cache_l = _insert_kv(v_cache_l, vproj, cache_start)
+        k_cache = k_cache.at[layer, b_idx, t_idx].set(
+            kproj.astype(k_cache.dtype))
+        v_cache = v_cache.at[layer, b_idx, t_idx].set(
+            vproj.astype(v_cache.dtype))
 
         if use_flash:
             from ..ops.flash_attention import flash_self_attention
@@ -289,16 +293,17 @@ def forward(
             )
         else:
             attn = attention_with_cache(
-                q, k_cache_l, v_cache_l, positions, kv_len_after,
+                q, k_cache[layer], v_cache[layer], positions, kv_len_after,
                 sliding_window=cfg.sliding_window,
             )
         h = _attn_out(lp, h, attn.reshape(B, T, Hq * D))
         h = _mlp_residual(lp, h, cfg)
-        return h, (k_cache_l, v_cache_l)
+        return (h, k_cache, v_cache), None
 
     k_cache, v_cache = cache
-    h, (k_cache, v_cache) = jax.lax.scan(
-        layer_body, h, (params["layers"], k_cache, v_cache)
+    (h, k_cache, v_cache), _ = jax.lax.scan(
+        layer_body, (h, k_cache, v_cache),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     return h, (k_cache, v_cache)
@@ -341,26 +346,33 @@ def forward_paged_decode(
 
     h = embed_lookup(params["embed"], input_ids, params["final_norm"].dtype)
 
-    def layer_body(h, xs):
-        lp, k_pool_l, v_pool_l = xs
+    # pools ride the scan carry (in-place via while-loop aliasing) — the ys
+    # form would re-materialize the WHOLE pool per layer per step, and the
+    # pool is n_pages-sized, far larger than one request's cache
+    def layer_body(carry, xs):
+        h, k_pool, v_pool = carry
+        lp, layer = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
         q, kproj, vproj = _qkv_proj(lp, x, cfg, positions, cos_t, sin_t)
 
         # scatter the new token into each slot's tail page (inactive slots all
         # target scratch page 0 — duplicate writes there are harmless)
-        k_pool_l = k_pool_l.at[pid, off].set(kproj[:, 0].astype(k_pool_l.dtype))
-        v_pool_l = v_pool_l.at[pid, off].set(vproj[:, 0].astype(v_pool_l.dtype))
+        k_pool = k_pool.at[layer, pid, off].set(
+            kproj[:, 0].astype(k_pool.dtype))
+        v_pool = v_pool.at[layer, pid, off].set(
+            vproj[:, 0].astype(v_pool.dtype))
 
         attn = paged_decode_attention(
-            q[:, 0], k_pool_l, v_pool_l, page_table, lengths + 1,
+            q[:, 0], k_pool[layer], v_pool[layer], page_table, lengths + 1,
             interpret=interpret, sliding_window=cfg.sliding_window)
         h = _attn_out(lp, h, attn.reshape(B, 1, Hq * D))
         h = _mlp_residual(lp, h, cfg)
-        return h, (k_pool_l, v_pool_l)
+        return (h, k_pool, v_pool), None
 
     k_pool, v_pool = pools
-    h, (k_pool, v_pool) = jax.lax.scan(
-        layer_body, h, (params["layers"], k_pool, v_pool))
+    (h, k_pool, v_pool), _ = jax.lax.scan(
+        layer_body, (h, k_pool, v_pool),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     return h, (k_pool, v_pool)
 

@@ -271,10 +271,17 @@ class HttpClient:
             send_body = (json, data)
             hop_headers = headers
             origin = urlsplit(full_url)
+            def origin_key(parts):
+                default = {"https": 443, "http": 80}.get(parts.scheme)
+                return parts.hostname, parts.port or default
+
             for _hop in range(cfg.max_redirects + 1):
                 hop = urlsplit(target)
                 downgraded = origin.scheme == "https" and hop.scheme != "https"
-                if (hop.hostname != origin.hostname or downgraded) and hop_headers:
+                # origin = (host, port): a same-host different-port hop is a
+                # different origin too (requests' should_strip_auth semantics)
+                if (origin_key(hop) != origin_key(origin) or downgraded) \
+                        and hop_headers:
                     # cross-origin hop OR https→http downgrade: credential-
                     # bearing headers must not follow — same host over
                     # cleartext still leaks the bearer (requests'

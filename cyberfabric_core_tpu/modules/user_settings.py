@@ -2,7 +2,10 @@
 
 Reference (implemented there): modules/simple-user-settings — per-module DB,
 repo pattern, tenant-scoped rows. The smallest complete example of the module
-shape: migrations + SecureConn storage + OData listing + REST.
+shape: migrations + SecureConn storage + OData listing + REST — plus the
+users-info exemplar's SSE surface (api/rest/tests/sse_tests.rs): a per-tenant
+change-event stream, so the SSE broadcaster is exercised in the CRUD template
+exactly as the reference's blueprint module does.
 """
 
 from __future__ import annotations
@@ -16,6 +19,7 @@ from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
 from ..modkit.context import ModuleCtx
 from ..modkit.db import ScopableEntity
 from ..modkit.errors import ProblemError
+from ..modkit.sse import SseBroadcaster
 from ..gateway.middleware import SECURITY_CONTEXT_KEY
 from ..gateway.validation import read_json
 
@@ -40,6 +44,15 @@ _MIGRATIONS = [
 class UserSettingsModule(Module, DatabaseCapability, RestApiCapability):
     def __init__(self) -> None:
         self._ctx: Optional[ModuleCtx] = None
+        #: per-tenant broadcasters — events are tenant-isolated by
+        #: construction (a subscriber only ever sees its own tenant's channel)
+        self._broadcasters: dict[str, SseBroadcaster] = {}
+
+    def _broadcaster(self, tenant_id: str) -> SseBroadcaster:
+        b = self._broadcasters.get(tenant_id)
+        if b is None:
+            b = self._broadcasters[tenant_id] = SseBroadcaster(keepalive_secs=5.0)
+        return b
 
     def migrations(self):
         return _MIGRATIONS
@@ -65,6 +78,9 @@ class UserSettingsModule(Module, DatabaseCapability, RestApiCapability):
                 c.update(row["id"], {"value": body["value"]})
             else:
                 c.insert({"user_id": sc.subject, "key": key, "value": body["value"]})
+            self._broadcaster(sc.tenant_id).send({
+                "type": "setting.updated" if row else "setting.created",
+                "key": key, "user_id": sc.subject})
             return None
 
         async def get_setting(request: web.Request):
@@ -89,9 +105,27 @@ class UserSettingsModule(Module, DatabaseCapability, RestApiCapability):
                               "key": request.match_info["key"]})
             if row is None or not c.delete(row["id"]):
                 raise ProblemError.not_found("setting not found", code="setting_not_found")
+            self._broadcaster(sc.tenant_id).send({
+                "type": "setting.deleted", "key": row["key"],
+                "user_id": sc.subject})
             return None
 
+        async def setting_events(request: web.Request):
+            sc = request[SECURITY_CONTEXT_KEY]
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache"})
+            await resp.prepare(request)
+            async for chunk in self._broadcaster(sc.tenant_id).sse_stream():
+                await resp.write(chunk)
+            return resp
+
         m = "user_settings"
+        # the events route registers BEFORE /{key} so "events" is not
+        # swallowed by the key matcher (aiohttp dispatches in add order)
+        router.operation("GET", "/v1/settings/events", module=m).auth_required() \
+            .summary("SSE stream of this tenant's setting-change events") \
+            .sse_response().handler(setting_events).register()
         router.operation("PUT", "/v1/settings/{key}", module=m).auth_required() \
             .summary("Upsert a per-user setting").handler(put_setting).register()
         router.operation("GET", "/v1/settings/{key}", module=m).auth_required() \

@@ -192,3 +192,51 @@ def test_unknown_token_rejected(stack):
     loop, base = stack
     s, _ = _req(loop, "GET", f"{base}/v1/settings", "tok-mallory")
     assert s == 401
+
+
+# ----------------------------------------------------------- SSE events
+def test_sse_setting_events_tenant_isolated(stack):
+    """users-info sse_tests.rs parity: change events stream over SSE and are
+    tenant-isolated — an acme subscriber sees acme writes, never evil-corp's."""
+    loop, base = stack
+
+    async def go():
+        received = []
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/v1/settings/events", headers={
+                    "Authorization": "Bearer tok-alice"}) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/event-stream")
+
+                async def reader():
+                    async for raw in resp.content:
+                        line = raw.decode().strip()
+                        if line.startswith("data:"):
+                            received.append(json.loads(line[5:]))
+
+                task = asyncio.ensure_future(reader())
+                await asyncio.sleep(0.2)  # subscription active
+                # same-tenant write (bob@acme) and cross-tenant write (eve)
+                async with s.put(f"{base}/v1/settings/sse-probe",
+                                 json={"value": "x"},
+                                 headers={"Authorization": "Bearer tok-bob"}) as r:
+                    assert r.status in (200, 204)
+                async with s.put(f"{base}/v1/settings/evil-probe",
+                                 json={"value": "y"},
+                                 headers={"Authorization": "Bearer tok-eve"}) as r:
+                    assert r.status in (200, 204)
+                async with s.delete(f"{base}/v1/settings/sse-probe", headers={
+                        "Authorization": "Bearer tok-admin"}) as r:
+                    # admin may delete; members are denied (AUTHZ_RULES)
+                    assert r.status in (200, 204, 404)
+                deadline = asyncio.get_event_loop().time() + 5
+                while len(received) < 2 and asyncio.get_event_loop().time() < deadline:
+                    await asyncio.sleep(0.05)
+                task.cancel()
+        return received
+
+    events = loop.run_until_complete(go())
+    kinds = {(e["type"], e["key"]) for e in events}
+    assert ("setting.created", "sse-probe") in kinds
+    # the cross-tenant write never reaches the acme stream
+    assert all(e["key"] != "evil-probe" for e in events)
