@@ -146,6 +146,26 @@ class TlsConfig:
         return ctx
 
 
+def _default_port(parts) -> Optional[int]:
+    return parts.port or {"https": 443, "http": 80}.get(parts.scheme)
+
+
+def _should_strip_auth(origin, hop) -> bool:
+    """requests' should_strip_auth semantics for redirect hops: strip
+    credential headers on host change, on any https→http downgrade, and on
+    scheme/port changes — EXCEPT the standard default-port http→https TLS
+    upgrade. ``origin``/``hop`` are urlsplit results."""
+    if hop.hostname != origin.hostname:
+        return True
+    if (origin.scheme, hop.scheme) == ("http", "https") \
+            and _default_port(origin) == 80 and _default_port(hop) == 443:
+        return False
+    if origin.scheme == "https" and hop.scheme != "https":
+        return True
+    return (origin.scheme, _default_port(origin)) != \
+        (hop.scheme, _default_port(hop))
+
+
 @dataclass
 class HttpClientConfig:
     base_url: Optional[str] = None
@@ -271,21 +291,9 @@ class HttpClient:
             send_body = (json, data)
             hop_headers = headers
             origin = urlsplit(full_url)
-            def origin_key(parts):
-                default = {"https": 443, "http": 80}.get(parts.scheme)
-                return parts.hostname, parts.port or default
-
             for _hop in range(cfg.max_redirects + 1):
                 hop = urlsplit(target)
-                downgraded = origin.scheme == "https" and hop.scheme != "https"
-                # origin = (host, port): a same-host different-port hop is a
-                # different origin too (requests' should_strip_auth semantics)
-                if (origin_key(hop) != origin_key(origin) or downgraded) \
-                        and hop_headers:
-                    # cross-origin hop OR https→http downgrade: credential-
-                    # bearing headers must not follow — same host over
-                    # cleartext still leaks the bearer (requests'
-                    # should_strip_auth treats the downgrade as cross-origin)
+                if hop_headers and _should_strip_auth(origin, hop):
                     hop_headers = {k: v for k, v in hop_headers.items()
                                    if k.lower() not in ("authorization", "cookie",
                                                         "proxy-authorization")}

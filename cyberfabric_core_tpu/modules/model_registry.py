@@ -19,7 +19,7 @@ from ..modkit import Module, module
 from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
 from ..modkit.context import ModuleCtx
 from ..modkit.db import ScopableEntity
-from ..modkit.errors import ProblemError
+from ..modkit.errors import Problem, ProblemError
 from ..modkit.security import SecurityContext
 from .sdk import ModelInfo, ModelRegistryApi
 
@@ -385,6 +385,39 @@ class ModelRegistryModule(Module, DatabaseCapability, RestApiCapability):
                                     request.match_info["name"], body["state"])
             return info.to_dict()
 
+        async def export_stablehlo(request: web.Request):
+            """Emit StableHLO for a managed model's serving programs (the
+            north-star "model-registry emits StableHLO for each registered
+            architecture" — BASELINE.json). Lowering only: no device compile,
+            no weights; artifacts land under home_dir/artifacts/stablehlo."""
+            sc = request[SECURITY_CONTEXT_KEY]
+            info = await svc.resolve(sc, request.match_info["name"])
+            if not info.managed:
+                raise ProblemError(Problem(
+                    status=409, title="Conflict", code="not_managed",
+                    detail=f"{info.canonical_id} is provider-backed; StableHLO "
+                           f"export applies to managed (local TPU) models"))
+            opts = info.engine_options or {}
+            model_cfg = opts.get("model_config", info.provider_model_id)
+            out_root = ctx.app_config.home_dir() / "artifacts" / "stablehlo"
+            from ..runtime.export import export_for_model
+
+            import asyncio as _asyncio
+
+            try:
+                manifest = await _asyncio.get_event_loop().run_in_executor(
+                    None, lambda: export_for_model(
+                        model_cfg, info.architecture or "llama", out_root,
+                        engine_options=opts))
+            except (KeyError, ValueError) as e:
+                # unknown model_config (e.g. an HF id with no built-in config)
+                # or architecture/config mismatch — a client problem, not a 500
+                raise ProblemError(Problem(
+                    status=422, title="Unprocessable Entity",
+                    code="export_unsupported",
+                    detail=f"cannot export {info.canonical_id}: {e}")) from e
+            return manifest
+
         async def set_alias(request: web.Request):
             body = await read_json(request, {"type": "object",
                                              "required": ["alias", "target"],
@@ -404,6 +437,10 @@ class ModelRegistryModule(Module, DatabaseCapability, RestApiCapability):
             .auth_required().summary("Drive the approval state machine").handler(set_approval).register()
         router.operation("POST", "/v1/model-registry/aliases", module=m).auth_required() \
             .summary("Create/update an alias").handler(set_alias).register()
+        router.operation("POST", "/v1/model-registry/models/{name}/stablehlo", module=m) \
+            .auth_required() \
+            .summary("Export StableHLO serving programs for a managed model") \
+            .handler(export_stablehlo).register()
 
         async def set_health(request: web.Request):
             body = await read_json(request, {"type": "object", "required": ["state"],

@@ -21,6 +21,7 @@ from .sdk import LlmWorkerApi
 class MonitoringModule(Module, RestApiCapability):
     def __init__(self) -> None:
         self.registry = default_registry
+        self._profile_dir = None
 
     async def init(self, ctx: ModuleCtx) -> None:
         ctx.client_hub.register(MetricsRegistry, self.registry)
@@ -66,3 +67,48 @@ class MonitoringModule(Module, RestApiCapability):
 
         router.operation("GET", "/metrics", module="monitoring").public() \
             .summary("Prometheus text exposition").handler(metrics).register()
+
+        # jax.profiler device tracing (SURVEY §5: host spans + jax.profiler
+        # traces + XLA cost-analysis dumps are the device-side observability
+        # triple; cost analysis lives on the engine, this is the trace leg)
+        async def profiler_start(request: web.Request):
+            from ..modkit.errors import Problem, ProblemError
+
+            if self._profile_dir is not None:
+                raise ProblemError(Problem(
+                    status=409, title="Conflict", code="profiler_running",
+                    detail=f"trace already running at {self._profile_dir}"))
+            import time
+
+            import jax
+
+            out = ctx.app_config.home_dir() / "profiles" / f"trace-{int(time.time())}"
+            out.mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(str(out))
+            self._profile_dir = out
+            return {"status": "started", "dir": str(out)}
+
+        async def profiler_stop(request: web.Request):
+            from ..modkit.errors import ProblemError
+
+            if self._profile_dir is None:
+                raise ProblemError.bad_request(
+                    "no trace running", code="profiler_not_running")
+            import jax
+
+            # clear state FIRST: a failing stop_trace must not wedge the
+            # endpoints in "running" with no API path to reset
+            out, self._profile_dir = self._profile_dir, None
+            jax.profiler.stop_trace()
+            files = sorted(str(p.relative_to(out))
+                           for p in out.rglob("*") if p.is_file())
+            return {"status": "stopped", "dir": str(out), "files": files}
+
+        router.operation("POST", "/v1/monitoring/profiler/start",
+                         module="monitoring").auth_required() \
+            .summary("Start a jax.profiler device trace") \
+            .handler(profiler_start).register()
+        router.operation("POST", "/v1/monitoring/profiler/stop",
+                         module="monitoring").auth_required() \
+            .summary("Stop the device trace; returns the dump location") \
+            .handler(profiler_stop).register()

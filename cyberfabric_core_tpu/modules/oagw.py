@@ -514,6 +514,7 @@ class OagwService(OagwApi):
 class OagwModule(Module, DatabaseCapability, RestApiCapability):
     def __init__(self) -> None:
         self.service: Optional[OagwService] = None
+        self._gts_task: Optional[asyncio.Task] = None
 
     def migrations(self):
         return _MIGRATIONS
@@ -522,10 +523,79 @@ class OagwModule(Module, DatabaseCapability, RestApiCapability):
         self.service = OagwService(ctx)
         ctx.client_hub.register(OagwService, self.service)
         ctx.client_hub.register(OagwApi, self.service)
+        # GTS provisioning runs after ALL inits (rest phase schedules it):
+        # oagw has no dep edge on types_registry, so at this point the
+        # registry's ClientHub entry may not exist yet
+        await self._provision_gts_types(ctx)
+
+    @staticmethod
+    async def _provision_gts_types(ctx: ModuleCtx) -> None:
+        """Register OAGW's config entity types into the types registry (the
+        reference OAGW provisions its GTS types at startup — SURVEY §2.3
+        oagw row: "GTS type provisioning"). Optional: a deployment without a
+        types registry still proxies."""
+        from .sdk import GtsEntity, TypesRegistryApi
+
+        registry = ctx.client_hub.try_get(TypesRegistryApi)
+        if registry is None:
+            return
+        sysctx = SecurityContext.system()
+        schemas = [
+            GtsEntity(
+                gts_id="gts.x.core.oagw.upstream.v1~", kind="schema",
+                vendor="x", description="OAGW upstream config",
+                body={"type": "object",
+                      "required": ["slug", "base_url"],
+                      "properties": {
+                          "slug": {"type": "string"},
+                          "base_url": {"type": "string"},
+                          "auth": {"type": "object"},
+                          "rate_limit": {"type": "object"},
+                          "circuit_breaker": {"type": "object"},
+                          "enabled": {"type": "boolean"}}}),
+            GtsEntity(
+                gts_id="gts.x.core.oagw.route.v1~", kind="schema",
+                vendor="x", description="OAGW route config",
+                body={"type": "object",
+                      "required": ["slug", "upstream_slug"],
+                      "properties": {
+                          "slug": {"type": "string"},
+                          "upstream_slug": {"type": "string"},
+                          "path_prefix": {"type": "string"},
+                          "methods": {"type": "array",
+                                      "items": {"type": "string"}},
+                          "strip_headers": {"type": "array",
+                                            "items": {"type": "string"}},
+                          "rate_limit": {"type": "object"},
+                          "enabled": {"type": "boolean"}}}),
+        ]
+        for entity in schemas:
+            try:
+                await registry.register(sysctx, entity)
+            except ProblemError as e:
+                # gts_exists: idempotent re-init; not_ready: the init-phase
+                # attempt ran before ready gating lifted — the rest-phase
+                # retry lands after it
+                if e.problem.code not in ("gts_exists", "not_ready"):
+                    raise
 
     def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
         svc = self.service
         assert svc is not None
+        # retry GTS provisioning now that every module's init has run (the
+        # rest phase is the first hook guaranteed to see types_registry).
+        # The task ref is held on self — the loop only weak-refs tasks — and
+        # failures are logged rather than dying unobserved at GC time.
+        self._gts_task = asyncio.ensure_future(self._provision_gts_types(ctx))
+
+        def _log_provision_failure(task: asyncio.Task) -> None:
+            if not task.cancelled() and task.exception() is not None:
+                import logging
+
+                logging.getLogger("oagw").error(
+                    "GTS type provisioning failed: %s", task.exception())
+
+        self._gts_task.add_done_callback(_log_provision_failure)
 
         async def create_upstream(request: web.Request):
             body = await read_json(request)

@@ -1,0 +1,210 @@
+"""AOT StableHLO export — the model-registry "emits StableHLO for each
+registered architecture" requirement (BASELINE.json north star; SURVEY §7:
+the C++ host consumes AOT-exported programs, so the serving computations must
+exist as portable artifacts, not only as live jit caches).
+
+Exports are pure lowering (jit(...).lower(avals) → StableHLO MLIR) — no device
+compile, no weight materialization: parameter shapes come from
+``jax.eval_shape`` over the architecture's init, so a 70B export costs MBs of
+text, not HBM. Each artifact is deterministic for (architecture, shapes,
+dtype, quantization), recorded in a manifest with sha256 so registries can
+dedupe and the host can cache compiled executables keyed by digest.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models import ModelConfig, get_config
+from ..models import llama
+from ..ops.rope import rope_frequencies
+
+
+@dataclass
+class ExportedProgram:
+    name: str                 # e.g. "prefill-b1x128" | "decode-k8"
+    path: str                 # artifact file (MLIR text)
+    sha256: str
+    size_bytes: int
+    arg_shapes: list[str]
+
+
+def _param_avals(cfg: ModelConfig, dtype, quantization: str):
+    """Abstract parameter tree for the architecture (no allocation)."""
+    base = jax.eval_shape(
+        lambda k: llama.init_params(cfg, k, dtype), jax.random.PRNGKey(0))
+    if quantization == "int8":
+        from .quant import quantize_llama_params
+
+        # shape-level quantization (init_params_quantized materializes +
+        # blocks per leaf — the abstract path must stay allocation-free)
+        return jax.eval_shape(quantize_llama_params, base)
+    return base
+
+
+def _stablehlo_text(jitted, *avals) -> str:
+    lowered = jitted.lower(*avals)
+    return str(lowered.compiler_ir(dialect="stablehlo"))
+
+
+def _write_artifact(out_dir: Path, stem: str, text: str,
+                    arg_shapes: list[str]) -> ExportedProgram:
+    out_dir.mkdir(parents=True, exist_ok=True)
+    digest = hashlib.sha256(text.encode()).hexdigest()
+    path = out_dir / f"{stem}.mlir"
+    path.write_text(text)
+    return ExportedProgram(name=stem, path=str(path), sha256=digest,
+                           size_bytes=len(text), arg_shapes=arg_shapes)
+
+
+def export_llama_programs(
+    model: str,
+    out_dir: Path,
+    *,
+    batch: int = 1,
+    prefill_bucket: int = 128,
+    decode_chunk: int = 8,
+    max_seq_len: int = 1024,
+    dtype=jnp.bfloat16,
+    quantization: str = "none",
+) -> dict[str, Any]:
+    """Export the two serving programs (prefill+first-token, fused decode
+    chunk) for a decoder architecture. Returns the manifest dict."""
+    from .engine import build_decode_chunk_fn
+
+    cfg = get_config(model)
+    if cfg.architecture != "llama":
+        raise ValueError(f"export_llama_programs drives decoder models, got "
+                         f"{cfg.architecture}")
+    rope = rope_frequencies(cfg.head_dim, max(cfg.max_position, max_seq_len),
+                            cfg.rope_theta)
+    params = _param_avals(cfg, dtype, quantization)
+    sds = jax.ShapeDtypeStruct
+    B = batch
+
+    def prefill(p, input_ids, lengths, rng, temperature, top_p, top_k):
+        T = input_ids.shape[1]
+        cache = llama.init_cache(cfg, B, max_seq_len, dtype)
+        positions = jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+        start = jnp.zeros((B,), jnp.int32)
+        hidden, cache = llama.forward(p, cfg, input_ids, positions, cache,
+                                      start, rope)
+        last_h = llama.gather_last_hidden(hidden, lengths)
+        logits = llama.lm_head_logits(p, cfg, last_h)
+        from ..ops.sampling import sample_token
+
+        rng, sub = jax.random.split(rng)
+        first = sample_token(logits, sub, temperature, top_p, top_k)
+        return first, cache, rng
+
+    prefill_avals = (
+        params, sds((B, prefill_bucket), jnp.int32), sds((B,), jnp.int32),
+        sds((2,), jnp.uint32), sds((B,), jnp.float32), sds((B,), jnp.float32),
+        sds((B,), jnp.int32))
+    decode_fn = build_decode_chunk_fn(cfg, decode_chunk, rope)
+    cache_aval = sds((cfg.num_layers, B, max_seq_len, cfg.num_kv_heads,
+                      cfg.head_dim), dtype)
+    decode_avals = (
+        params, cache_aval, cache_aval, sds((B,), jnp.int32),
+        sds((B,), jnp.int32), sds((2,), jnp.uint32), sds((B,), jnp.float32),
+        sds((B,), jnp.float32), sds((B,), jnp.int32))
+
+    programs = [
+        _write_artifact(
+            out_dir, f"prefill-b{B}x{prefill_bucket}",
+            _stablehlo_text(jax.jit(prefill), *prefill_avals),
+            [str(a) for a in prefill_avals[1:]]),
+        _write_artifact(
+            out_dir, f"decode-k{decode_chunk}",
+            _stablehlo_text(
+                jax.jit(decode_fn, donate_argnums=(1, 2)), *decode_avals),
+            [str(a) for a in decode_avals[1:]]),
+    ]
+    manifest = {
+        "model": model,
+        "architecture": cfg.architecture,
+        "dialect": "stablehlo",
+        "dtype": jnp.dtype(dtype).name,
+        "quantization": quantization,
+        "batch": B,
+        "prefill_bucket": prefill_bucket,
+        "decode_chunk": decode_chunk,
+        "max_seq_len": max_seq_len,
+        "exported_at": time.time(),
+        "programs": [vars(p) for p in programs],
+    }
+    (out_dir / "manifest.json").write_text(json.dumps(manifest, indent=1))
+    return manifest
+
+
+def export_bert_program(
+    model: str,
+    out_dir: Path,
+    *,
+    batch: int = 8,
+    seq_len: int = 256,
+    dtype=jnp.bfloat16,
+) -> dict[str, Any]:
+    """Export the encoder forward (embeddings path, BASELINE config #3)."""
+    from ..models import bert
+
+    cfg = get_config(model)
+    if cfg.architecture != "bert":
+        raise ValueError(f"export_bert_program drives encoder models, got "
+                         f"{cfg.architecture}")
+    params = jax.eval_shape(
+        lambda k: bert.init_params(cfg, k, dtype), jax.random.PRNGKey(0))
+    sds = jax.ShapeDtypeStruct
+
+    def encode(p, input_ids, attention_mask):
+        return bert.embed_pooled(p, cfg, input_ids, attention_mask)
+
+    avals = (params, sds((batch, seq_len), jnp.int32),
+             sds((batch, seq_len), jnp.int32))
+    program = _write_artifact(
+        out_dir, f"encode-b{batch}x{seq_len}",
+        _stablehlo_text(jax.jit(encode), *avals),
+        [str(a) for a in avals[1:]])
+    manifest = {
+        "model": model,
+        "architecture": cfg.architecture,
+        "dialect": "stablehlo",
+        "dtype": jnp.dtype(dtype).name,
+        "batch": batch,
+        "seq_len": seq_len,
+        "exported_at": time.time(),
+        "programs": [vars(program)],
+    }
+    (out_dir / "manifest.json").write_text(json.dumps(manifest, indent=1))
+    return manifest
+
+
+def export_for_model(model_config_name: str, architecture: str,
+                     out_root: Path, *,
+                     engine_options: Optional[dict] = None) -> dict[str, Any]:
+    """Registry-facing entry: export the serving programs for a managed model
+    using its engine options (quantization, chunk, seq len)."""
+    opts = engine_options or {}
+    out_dir = out_root / model_config_name
+    if architecture == "bert":
+        return export_bert_program(
+            model_config_name, out_dir,
+            batch=int(opts.get("embed_batch", 8)),
+            seq_len=int(opts.get("embed_seq_len", 256)))
+    return export_llama_programs(
+        model_config_name, out_dir,
+        batch=int(opts.get("export_batch", 1)),
+        prefill_bucket=int(opts.get("export_prefill_bucket", 128)),
+        decode_chunk=int(opts.get("decode_chunk", 8)),
+        max_seq_len=int(opts.get("max_seq_len", 1024)),
+        quantization=str(opts.get("quantization", "none")),
+    )

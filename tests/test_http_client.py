@@ -263,6 +263,66 @@ def test_redirect_hop_to_private_literal_denied():
     c._check_literal_ip("http://93.184.216.34/")  # public: passes
 
 
+def test_same_host_port_change_strips_credentials(loop):
+    """A same-host different-port redirect is a different origin — the bearer
+    must not follow (requests' should_strip_auth semantics); the one allowed
+    exception is the default-port http→https TLS upgrade, unit-checked here
+    since tests can't bind 80/443."""
+
+    async def go():
+        seen = {}
+
+        async def a_handler(request):
+            return web.Response(status=302, headers={"Location": seen["b_url"]})
+
+        async def b_handler(request):
+            seen["auth_at_b"] = request.headers.get("Authorization")
+            return web.json_response({"ok": True})
+
+        async def serve(handler):
+            app = web.Application()
+            app.router.add_get("/{tail:.*}", handler)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            return runner, site._server.sockets[0].getsockname()[1]
+
+        runner_a, port_a = await serve(a_handler)
+        runner_b, port_b = await serve(b_handler)
+        # SAME host, different port
+        seen["b_url"] = f"http://127.0.0.1:{port_b}/target"
+        try:
+            async with HttpClient(HttpClientConfig()) as c:
+                r = await c.get(f"http://127.0.0.1:{port_a}/start",
+                                headers={"Authorization": "Bearer sekrit"})
+                assert r.status == 200
+                assert seen["auth_at_b"] is None
+        finally:
+            await runner_a.cleanup()
+            await runner_b.cleanup()
+
+    loop.run_until_complete(go())
+
+
+def test_tls_upgrade_keeps_credentials_unit():
+    """Default-port http→https upgrade on the same host keeps headers; every
+    other scheme/port change strips (pure origin-rule check)."""
+    from urllib.parse import urlsplit
+
+    from cyberfabric_core_tpu.modkit.http_client import _should_strip_auth as strip
+    assert not strip(urlsplit("http://api.example.com/a"),
+                     urlsplit("https://api.example.com/b"))       # TLS upgrade
+    assert strip(urlsplit("https://api.example.com/a"),
+                 urlsplit("http://api.example.com/b"))            # downgrade
+    assert strip(urlsplit("https://api.example.com/a"),
+                 urlsplit("https://api.example.com:8443/b"))      # port change
+    assert strip(urlsplit("https://api.example.com/a"),
+                 urlsplit("https://evil.example.com/b"))          # host change
+    assert not strip(urlsplit("https://api.example.com/a"),
+                     urlsplit("https://api.example.com:443/b"))   # same origin
+
+
 def test_cross_origin_redirect_strips_credentials(loop):
     """Authorization must not follow a redirect to another host."""
 

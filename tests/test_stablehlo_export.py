@@ -1,0 +1,127 @@
+"""StableHLO AOT export — the north-star "model-registry emits StableHLO for
+each registered architecture" requirement (BASELINE.json; SURVEY §7: the C++
+host consumes AOT artifacts keyed by digest)."""
+
+import hashlib
+import json
+from pathlib import Path
+
+import pytest
+
+
+def test_llama_export_artifacts(tmp_path):
+    from cyberfabric_core_tpu.runtime.export import export_llama_programs
+
+    m = export_llama_programs("tiny-llama", tmp_path, max_seq_len=128,
+                              prefill_bucket=32, decode_chunk=4)
+    assert m["dialect"] == "stablehlo" and m["architecture"] == "llama"
+    names = {p["name"] for p in m["programs"]}
+    assert names == {"prefill-b1x32", "decode-k4"}
+    for prog in m["programs"]:
+        text = Path(prog["path"]).read_text()
+        assert text.startswith("module @")
+        assert "stablehlo." in text          # real dialect ops, not HLO text
+        assert hashlib.sha256(text.encode()).hexdigest() == prog["sha256"]
+        assert prog["size_bytes"] == len(text)
+    manifest = json.loads((tmp_path / "manifest.json").read_text())
+    assert manifest["model"] == "tiny-llama"
+
+
+def test_export_is_deterministic(tmp_path):
+    """Same (arch, shapes, dtype, quant) → byte-identical artifact: the digest
+    is a valid compile-cache key for the host."""
+    from cyberfabric_core_tpu.runtime.export import export_llama_programs
+
+    m1 = export_llama_programs("tiny-llama", tmp_path / "a", max_seq_len=128,
+                               prefill_bucket=32, decode_chunk=4)
+    m2 = export_llama_programs("tiny-llama", tmp_path / "b", max_seq_len=128,
+                               prefill_bucket=32, decode_chunk=4)
+    d1 = {p["name"]: p["sha256"] for p in m1["programs"]}
+    d2 = {p["name"]: p["sha256"] for p in m2["programs"]}
+    assert d1 == d2
+
+
+def test_quantized_export_differs(tmp_path):
+    from cyberfabric_core_tpu.runtime.export import export_llama_programs
+
+    base = export_llama_programs("tiny-llama", tmp_path / "bf16",
+                                 max_seq_len=128, prefill_bucket=32,
+                                 decode_chunk=4)
+    q = export_llama_programs("tiny-llama", tmp_path / "int8",
+                              max_seq_len=128, prefill_bucket=32,
+                              decode_chunk=4, quantization="int8")
+    assert q["quantization"] == "int8"
+    # int8 weights show up as i8 tensors in the program signature
+    text = Path(q["programs"][1]["path"]).read_text()
+    assert "xi8>" in text
+    assert {p["sha256"] for p in q["programs"]} != \
+        {p["sha256"] for p in base["programs"]}
+
+
+def test_bert_export(tmp_path):
+    from cyberfabric_core_tpu.runtime.export import export_bert_program
+
+    m = export_bert_program("tiny-bert", tmp_path, batch=2, seq_len=32)
+    assert m["architecture"] == "bert"
+    text = Path(m["programs"][0]["path"]).read_text()
+    assert "stablehlo." in text
+
+
+def test_registry_export_endpoint(tmp_path):
+    """POST /v1/model-registry/models/{name}/stablehlo over the full stack:
+    managed model exports; provider-backed model is a 409."""
+    import asyncio
+
+    import aiohttp
+
+    from cyberfabric_core_tpu.modkit import AppConfig, ClientHub, ModuleRegistry, RunOptions
+    from cyberfabric_core_tpu.modkit.db import DbManager
+    from cyberfabric_core_tpu.modkit.runtime import HostRuntime
+    import cyberfabric_core_tpu.modules  # noqa: F401
+
+    async def go():
+        cfg = AppConfig.load_or_default(environ={}, cli_overrides={
+            "server": {"home_dir": str(tmp_path)},
+            "modules": {
+                "api_gateway": {"config": {"bind_addr": "127.0.0.1:0",
+                                           "auth_disabled": True}},
+                "tenant_resolver": {}, "authn_resolver": {},
+                "authz_resolver": {},
+                "model_registry": {"config": {"models": [
+                    {"provider_slug": "local", "provider_model_id": "tiny-llama",
+                     "approval_state": "approved", "managed": True,
+                     "architecture": "llama",
+                     "engine_options": {"model_config": "tiny-llama",
+                                        "max_seq_len": 128, "decode_chunk": 4,
+                                        "export_prefill_bucket": 32}},
+                    {"provider_slug": "openai", "provider_model_id": "gpt-x",
+                     "approval_state": "approved", "managed": False},
+                ]}},
+            }})
+        registry = ModuleRegistry.discover_and_build(enabled=cfg.module_names())
+        rt = HostRuntime(RunOptions(config=cfg, registry=registry,
+                                    client_hub=ClientHub(),
+                                    db_manager=DbManager(in_memory=True)))
+        await rt.run_setup_phases()
+        base = f"http://127.0.0.1:{registry.get('api_gateway').instance.bound_port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/model-registry/models/"
+                                  f"local::tiny-llama/stablehlo") as r:
+                    assert r.status == 200, await r.text()
+                    manifest = await r.json()
+                async with s.post(f"{base}/v1/model-registry/models/"
+                                  f"openai::gpt-x/stablehlo") as r:
+                    assert r.status == 409
+                    assert (await r.json())["code"] == "not_managed"
+        finally:
+            rt.root_token.cancel()
+            await rt.run_stop_phase()
+        return manifest
+
+    manifest = asyncio.new_event_loop().run_until_complete(go())
+    assert len(manifest["programs"]) == 2
+    for prog in manifest["programs"]:
+        path = Path(prog["path"])
+        assert path.exists() and str(path).startswith(str(tmp_path))
+        assert "stablehlo." in path.read_text()
