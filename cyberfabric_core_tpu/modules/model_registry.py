@@ -405,7 +405,7 @@ class ModelRegistryModule(Module, DatabaseCapability, RestApiCapability):
             import asyncio as _asyncio
 
             try:
-                manifest = await _asyncio.get_event_loop().run_in_executor(
+                manifest = await _asyncio.get_running_loop().run_in_executor(
                     None, lambda: export_for_model(
                         model_cfg, info.architecture or "llama", out_root,
                         engine_options=opts))

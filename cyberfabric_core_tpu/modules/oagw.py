@@ -523,10 +523,8 @@ class OagwModule(Module, DatabaseCapability, RestApiCapability):
         self.service = OagwService(ctx)
         ctx.client_hub.register(OagwService, self.service)
         ctx.client_hub.register(OagwApi, self.service)
-        # GTS provisioning runs after ALL inits (rest phase schedules it):
-        # oagw has no dep edge on types_registry, so at this point the
-        # registry's ClientHub entry may not exist yet
-        await self._provision_gts_types(ctx)
+        # GTS provisioning happens in the rest phase: oagw has no dep edge on
+        # types_registry, so at init time its ClientHub entry may not exist
 
     @staticmethod
     async def _provision_gts_types(ctx: ModuleCtx) -> None:
@@ -573,19 +571,16 @@ class OagwModule(Module, DatabaseCapability, RestApiCapability):
             try:
                 await registry.register(sysctx, entity)
             except ProblemError as e:
-                # gts_exists: idempotent re-init; not_ready: the init-phase
-                # attempt ran before ready gating lifted — the rest-phase
-                # retry lands after it
-                if e.problem.code not in ("gts_exists", "not_ready"):
+                if e.problem.code != "gts_exists":  # idempotent re-init
                     raise
 
     def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
         svc = self.service
         assert svc is not None
-        # retry GTS provisioning now that every module's init has run (the
-        # rest phase is the first hook guaranteed to see types_registry).
-        # The task ref is held on self — the loop only weak-refs tasks — and
-        # failures are logged rather than dying unobserved at GC time.
+        # GTS provisioning now that every module's init has run (the rest
+        # phase is the first hook guaranteed to see types_registry). The task
+        # ref is held on self — the loop only weak-refs tasks — and failures
+        # are logged rather than dying unobserved at GC time.
         self._gts_task = asyncio.ensure_future(self._provision_gts_types(ctx))
 
         def _log_provision_failure(task: asyncio.Task) -> None:

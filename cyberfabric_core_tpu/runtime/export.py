@@ -13,6 +13,7 @@ dedupe and the host can cache compiled executables keyed by digest.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import time
@@ -55,12 +56,30 @@ def _stablehlo_text(jitted, *avals) -> str:
     return str(lowered.compiler_ir(dialect="stablehlo"))
 
 
+def _atomic_write(path: Path, text: str) -> None:
+    """Write-then-rename: concurrent exports / readers must never see a torn
+    file whose bytes no longer match the manifest digest."""
+    import os
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=f".{path.name}.",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
 def _write_artifact(out_dir: Path, stem: str, text: str,
                     arg_shapes: list[str]) -> ExportedProgram:
     out_dir.mkdir(parents=True, exist_ok=True)
     digest = hashlib.sha256(text.encode()).hexdigest()
     path = out_dir / f"{stem}.mlir"
-    path.write_text(text)
+    _atomic_write(path, text)
     return ExportedProgram(name=stem, path=str(path), sha256=digest,
                            size_bytes=len(text), arg_shapes=arg_shapes)
 
@@ -142,7 +161,7 @@ def export_llama_programs(
         "exported_at": time.time(),
         "programs": [vars(p) for p in programs],
     }
-    (out_dir / "manifest.json").write_text(json.dumps(manifest, indent=1))
+    _atomic_write(out_dir / "manifest.json", json.dumps(manifest, indent=1))
     return manifest
 
 
@@ -184,7 +203,7 @@ def export_bert_program(
         "exported_at": time.time(),
         "programs": [vars(program)],
     }
-    (out_dir / "manifest.json").write_text(json.dumps(manifest, indent=1))
+    _atomic_write(out_dir / "manifest.json", json.dumps(manifest, indent=1))
     return manifest
 
 
