@@ -1,0 +1,17 @@
+#!/bin/bash
+# Probe the TPU every 5 min; log status lines. Never SIGKILL a device op.
+LOG=/root/repo/.probe/tpu_watch.log
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout --signal=TERM 150 python -c "
+import jax, time
+d = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((256,256), jnp.bfloat16)
+(x@x).block_until_ready()
+print('OK', d[0].platform, len(d))
+" 2>&1 | tail -1)
+  echo "$ts $out" >> "$LOG"
+  case "$out" in OK*) echo "$ts TPU_AVAILABLE" >> "$LOG";; esac
+  sleep 300
+done

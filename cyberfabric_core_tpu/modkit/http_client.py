@@ -297,6 +297,11 @@ class HttpClient:
                     hop_headers = {k: v for k, v in hop_headers.items()
                                    if k.lower() not in ("authorization", "cookie",
                                                         "proxy-authorization")}
+                # per-hop comparison (requests semantics): each hop becomes
+                # the origin for the next one, so an https→http downgrade
+                # later in the chain is always caught even when the final hop
+                # matches the ORIGINAL origin exactly (round-2 advisory)
+                origin = hop
                 async with session.request(
                     method, target, headers=hop_headers, json=send_body[0],
                     data=send_body[1], params=params if target is full_url else None,

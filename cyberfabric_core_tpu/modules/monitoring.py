@@ -22,6 +22,9 @@ class MonitoringModule(Module, RestApiCapability):
     def __init__(self) -> None:
         self.registry = default_registry
         self._profile_dir = None
+        # True when a stop_trace raised after we cleared _profile_dir: JAX's
+        # global tracer may still be active even though our state says stopped
+        self._tracer_maybe_live = False
 
     async def init(self, ctx: ModuleCtx) -> None:
         ctx.client_hub.register(MetricsRegistry, self.registry)
@@ -84,12 +87,24 @@ class MonitoringModule(Module, RestApiCapability):
 
             out = ctx.app_config.home_dir() / "profiles" / f"trace-{int(time.time())}"
             out.mkdir(parents=True, exist_ok=True)
+            if self._tracer_maybe_live:
+                # a prior stop_trace may have raised AFTER we cleared
+                # _profile_dir, leaving JAX's global tracer active while our
+                # state says stopped — best-effort stop so start can succeed
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
             jax.profiler.start_trace(str(out))
+            # only a successful start proves the global tracer is ours again;
+            # clearing the flag before this point would make a persistently
+            # failing stop wedge every future /start
+            self._tracer_maybe_live = False
             self._profile_dir = out
             return {"status": "started", "dir": str(out)}
 
         async def profiler_stop(request: web.Request):
-            from ..modkit.errors import ProblemError
+            from ..modkit.errors import Problem, ProblemError
 
             if self._profile_dir is None:
                 raise ProblemError.bad_request(
@@ -97,9 +112,17 @@ class MonitoringModule(Module, RestApiCapability):
             import jax
 
             # clear state FIRST: a failing stop_trace must not wedge the
-            # endpoints in "running" with no API path to reset
+            # endpoints in "running" with no API path to reset — but remember
+            # the tracer may still be live so the next /start can clear it
             out, self._profile_dir = self._profile_dir, None
-            jax.profiler.stop_trace()
+            try:
+                jax.profiler.stop_trace()
+                self._tracer_maybe_live = False
+            except Exception as e:
+                self._tracer_maybe_live = True
+                raise ProblemError(Problem(
+                    status=500, title="Internal Server Error",
+                    code="profiler_stop_failed", detail=str(e)[:200]))
             files = sorted(str(p.relative_to(out))
                            for p in out.rglob("*") if p.is_file())
             return {"status": "stopped", "dir": str(out), "files": files}

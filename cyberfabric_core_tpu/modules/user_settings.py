@@ -49,10 +49,25 @@ class UserSettingsModule(Module, DatabaseCapability, RestApiCapability):
         self._broadcasters: dict[str, SseBroadcaster] = {}
 
     def _broadcaster(self, tenant_id: str) -> SseBroadcaster:
+        """Materialize a broadcaster — only subscribers call this; publishers
+        use :meth:`_publish` so tenants with no listeners never allocate one
+        (the dict would otherwise grow with tenant cardinality, round-2
+        advisory)."""
         b = self._broadcasters.get(tenant_id)
         if b is None:
             b = self._broadcasters[tenant_id] = SseBroadcaster(keepalive_secs=5.0)
         return b
+
+    def _publish(self, tenant_id: str, event: dict) -> None:
+        b = self._broadcasters.get(tenant_id)
+        if b is None:
+            return  # publish-to-nobody is a no-op; don't materialize
+        if b.subscriber_count == 0:
+            # last subscriber left: drop the broadcaster so the map stays
+            # bounded by tenants with live listeners
+            del self._broadcasters[tenant_id]
+            return
+        b.send(event)
 
     def migrations(self):
         return _MIGRATIONS
@@ -78,7 +93,7 @@ class UserSettingsModule(Module, DatabaseCapability, RestApiCapability):
                 c.update(row["id"], {"value": body["value"]})
             else:
                 c.insert({"user_id": sc.subject, "key": key, "value": body["value"]})
-            self._broadcaster(sc.tenant_id).send({
+            self._publish(sc.tenant_id, {
                 "type": "setting.updated" if row else "setting.created",
                 "key": key, "user_id": sc.subject})
             return None
@@ -105,7 +120,7 @@ class UserSettingsModule(Module, DatabaseCapability, RestApiCapability):
                               "key": request.match_info["key"]})
             if row is None or not c.delete(row["id"]):
                 raise ProblemError.not_found("setting not found", code="setting_not_found")
-            self._broadcaster(sc.tenant_id).send({
+            self._publish(sc.tenant_id, {
                 "type": "setting.deleted", "key": row["key"],
                 "user_id": sc.subject})
             return None
@@ -116,8 +131,16 @@ class UserSettingsModule(Module, DatabaseCapability, RestApiCapability):
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache"})
             await resp.prepare(request)
-            async for chunk in self._broadcaster(sc.tenant_id).sse_stream():
-                await resp.write(chunk)
+            b = self._broadcaster(sc.tenant_id)
+            try:
+                async for chunk in b.sse_stream():
+                    await resp.write(chunk)
+            finally:
+                # eager eviction on disconnect: tenants whose listeners all
+                # left (and that never publish) must not pin a broadcaster
+                if (b.subscriber_count == 0
+                        and self._broadcasters.get(sc.tenant_id) is b):
+                    del self._broadcasters[sc.tenant_id]
             return resp
 
         m = "user_settings"

@@ -103,6 +103,15 @@ def export_llama_programs(
     if cfg.architecture != "llama":
         raise ValueError(f"export_llama_programs drives decoder models, got "
                          f"{cfg.architecture}")
+    # the forward's cache insert is a scatter whose OOB writes are DROPPED
+    # (unlike dynamic_update_slice, which clamps) — a bucket wider than the
+    # cache would silently attend over zero KV, so reject it loudly here
+    if prefill_bucket > max_seq_len:
+        raise ValueError(
+            f"prefill_bucket {prefill_bucket} must be <= max_seq_len "
+            f"{max_seq_len}: the cache insert at offset cache_start must fit "
+            f"the cache entirely (decode room is enforced per-prompt by "
+            f"EngineConfig.bucket_for)")
     rope = rope_frequencies(cfg.head_dim, max(cfg.max_position, max_seq_len),
                             cfg.rope_theta)
     params = _param_avals(cfg, dtype, quantization)

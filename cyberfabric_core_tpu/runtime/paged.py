@@ -72,6 +72,13 @@ class PrefixKVPool:
         self._tree_owned: set[int] = set()
         self._orphans: set[int] = set()
 
+    @property
+    def capacity_pages(self) -> int:
+        """Pages a single chain could ever hold (page 0 is scratch) — the
+        feasibility bound callers must check before parking a request on
+        'the pool will free up eventually'."""
+        return self.num_pages - self._page_offset
+
     # ------------------------------------------------------------ jitted movers
     @partial(jax.jit, static_argnums=(0, 3))
     def _gather(self, pools, page_ids, n_pages_bucket):

@@ -382,11 +382,17 @@ class ContinuousBatchingEngine:
                     self.pool.release_slot(chain)
                     raise
             except MemoryError:
-                # No active slot will ever free pages for a request the idle
-                # pool still can't hold — retrying forever would hang the
-                # client's stream (and, FIFO, everyone suspended behind it).
-                # Terminal-shed it; otherwise park and wait for decode churn.
-                if not self.active.any():
+                # Terminal-shed when the request can NEVER fit: either its
+                # page need exceeds the whole pool, or the pool is idle and
+                # still can't hold it.  Checking feasibility (not just
+                # idleness) matters under sustained load — _admit keeps the
+                # slots busy, so `active` may never empty, and an infeasible
+                # suspended request would otherwise hang its client stream
+                # and everyone FIFO-behind it while thrashing restore/release
+                # of its host KV pages every cycle (round-2 advisory).
+                pages_needed = self.pool.pages_for(rec.length + self._k_steps)
+                if (pages_needed > self.pool.capacity_pages
+                        or not self.active.any()):
                     self._suspended.popleft()
                     logger.warning(
                         "request %s (len=%d) cannot fit the idle pool; "

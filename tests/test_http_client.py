@@ -360,3 +360,55 @@ def test_cross_origin_redirect_strips_credentials(loop):
             await runner_b.cleanup()
 
     loop.run_until_complete(go())
+
+
+def test_downgrade_after_tls_upgrade_strips_credentials(loop):
+    """Per-hop origin tracking (round-2 advisory): in an http→https→http
+    chain on the same host/default ports, hop 1 takes the TLS-upgrade
+    exception, but hop 2 is a *downgrade from the previous hop* and must
+    strip — even though it matches the ORIGINAL origin exactly."""
+    from cyberfabric_core_tpu.modkit.http_client import HttpClient, HttpClientConfig
+
+    chain = ["http://h.example/a", "https://h.example/b", "http://h.example/c"]
+    auth_seen = []
+
+    class FakeResp:
+        def __init__(self, status, headers, url):
+            self.status, self.headers, self.url = status, headers, url
+
+        async def read(self):
+            return b"{}"
+
+    class FakeReqCtx:
+        def __init__(self, target, headers):
+            i = chain.index(target)
+            auth_seen.append((target, (headers or {}).get("Authorization")))
+            if i + 1 < len(chain):
+                self._resp = FakeResp(302, {"Location": chain[i + 1]}, target)
+            else:
+                self._resp = FakeResp(200, {}, target)
+
+        async def __aenter__(self):
+            return self._resp
+
+        async def __aexit__(self, *a):
+            return False
+
+    class FakeSession:
+        def request(self, method, target, *, headers=None, **kw):
+            return FakeReqCtx(target, headers)
+
+    async def go():
+        c = HttpClient(HttpClientConfig())
+
+        async def fake_session():
+            return FakeSession()
+
+        c._ensure_session = fake_session
+        r = await c.get(chain[0], headers={"Authorization": "Bearer sekrit"})
+        assert r.status == 200
+
+    loop.run_until_complete(go())
+    assert auth_seen[0] == (chain[0], "Bearer sekrit")
+    assert auth_seen[1] == (chain[1], "Bearer sekrit")  # TLS upgrade keeps
+    assert auth_seen[2] == (chain[2], None)             # downgrade strips

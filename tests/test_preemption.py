@@ -179,3 +179,41 @@ def test_scheduler_failure_fails_suspended_requests_too():
         assert errors and errors[0].finished == "error"
     finally:
         sched.shutdown()
+
+
+def test_infeasible_suspended_request_sheds_even_under_load():
+    """Feasibility-based terminal shed (round-2 advisory): a suspended request
+    whose page need exceeds the ENTIRE pool must shed immediately — under
+    sustained load `active` never empties, so idleness-gated shedding would
+    hang its client stream forever while thrashing restore/release."""
+    cfg = EngineConfig(model="tiny-llama", max_seq_len=256, max_batch=2,
+                       decode_chunk=4, use_flash=False,
+                       prefix_cache_pages=8, prefix_page_size=8)
+    sched = ContinuousBatchingEngine(cfg, seed=0)
+    try:
+        from cyberfabric_core_tpu.runtime.scheduler import _SlotState, _Suspended
+
+        events = []
+        # simulate a pool whose capacity the request exceeds outright (e.g.
+        # orphan pages shrank effective capacity); restore keeps MemoryError-ing
+        def no_room(host_kv):
+            raise MemoryError("pool exhausted")
+
+        sched.pool.restore_chain_from_host = no_room
+        n_pages = sched.pool.pages_for(200)
+        sched.pool.num_pages = n_pages  # capacity (num_pages-1) < need
+        rec = _Suspended(
+            state=_SlotState(request_id="big", emit=events.append,
+                             sampling=SamplingParams(max_tokens=4),
+                             stops=frozenset()),
+            host_kv=(np.zeros((1, n_pages, 8, 1, 4), np.float32),
+                     np.zeros((1, n_pages, 8, 1, 4), np.float32)),
+            length=200, last_token=5, slot_key=np.zeros((2,), np.uint32))
+        sched._suspended.append(rec)
+        sched.active[0] = True  # pool is NOT idle — old code would park forever
+        sched._resume_suspended()
+        assert not sched._suspended, "infeasible request must not stay parked"
+        assert events and events[-1].finished == "length"
+    finally:
+        sched.active[0] = False
+        sched.shutdown()

@@ -128,3 +128,98 @@ def test_host_runtime_failed_start_tears_down(fresh_registry):
 
     asyncio.run(go())
     assert events == ["started-bg", "stopped"]
+
+
+def test_settings_publish_does_not_materialize_broadcasters():
+    """Publish-to-nobody is a no-op and zero-subscriber broadcasters are
+    evicted — the per-tenant map must stay bounded by tenants with live
+    listeners, not grow with every tenant that ever wrote a setting
+    (round-2 advisory)."""
+    from cyberfabric_core_tpu.modules.user_settings import UserSettingsModule
+
+    m = UserSettingsModule()
+    for i in range(100):
+        m._publish(f"tenant-{i}", {"type": "setting.created", "key": "k"})
+    assert m._broadcasters == {}
+
+    # a subscriber materializes one; publish reaches it
+    b = m._broadcaster("t1")
+    received = []
+
+    async def consume():
+        async for ev in b.subscribe():
+            received.append(ev)
+            break
+
+    async def run():
+        task = asyncio.ensure_future(consume())
+        await asyncio.sleep(0.05)
+        m._publish("t1", {"type": "setting.created", "key": "k"})
+        await asyncio.wait_for(task, 5)
+
+    asyncio.new_event_loop().run_until_complete(run())
+    assert received and received[0]["key"] == "k"
+
+    # last subscriber gone -> next publish evicts the broadcaster
+    assert b.subscriber_count == 0
+    m._publish("t1", {"type": "setting.deleted", "key": "k"})
+    assert "t1" not in m._broadcasters
+
+
+def test_profiler_stop_failure_recoverable(tmp_path, monkeypatch):
+    """A stop_trace that raises must not wedge the profiler endpoints: the
+    next /start best-effort clears JAX's possibly-live global tracer instead
+    of 500ing forever (round-2 advisory)."""
+    import types
+
+    import jax
+
+    from cyberfabric_core_tpu.modkit.errors import ProblemError
+    from cyberfabric_core_tpu.modules.monitoring import MonitoringModule
+
+    m = MonitoringModule()
+    handlers = {}
+
+    class FakeOp:
+        def __init__(self, method, path):
+            self._key = (method, path)
+
+        def __getattr__(self, name):
+            def chain(*a, **kw):
+                if name == "handler":
+                    handlers[self._key] = a[0]
+                return self
+            return chain
+
+    router = types.SimpleNamespace(
+        operation=lambda method, path, **kw: FakeOp(method, path))
+    ctx = types.SimpleNamespace(
+        app_config=types.SimpleNamespace(home_dir=lambda: tmp_path))
+    m.register_rest(ctx, router, None)
+    start = handlers[("POST", "/v1/monitoring/profiler/start")]
+    stop = handlers[("POST", "/v1/monitoring/profiler/stop")]
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+
+    def failing_stop():
+        calls.append(("stop",))
+        raise RuntimeError("collector died")
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", failing_stop)
+
+    loop = asyncio.new_event_loop()
+    try:
+        assert loop.run_until_complete(start(None))["status"] == "started"
+        with pytest.raises(ProblemError):
+            loop.run_until_complete(stop(None))
+        assert m._profile_dir is None  # state says stopped, not wedged
+        assert m._tracer_maybe_live is True
+        # next start must best-effort stop the live tracer, then succeed
+        out = loop.run_until_complete(start(None))
+        assert out["status"] == "started"
+        assert ("stop",) in calls[-3:]
+        assert m._tracer_maybe_live is False
+    finally:
+        loop.close()

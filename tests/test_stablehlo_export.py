@@ -125,3 +125,20 @@ def test_registry_export_endpoint(tmp_path):
         path = Path(prog["path"])
         assert path.exists() and str(path).startswith(str(tmp_path))
         assert "stablehlo." in path.read_text()
+
+
+def test_export_rejects_bucket_wider_than_cache(tmp_path):
+    """The cache insert is a scatter whose OOB writes are silently DROPPED
+    (unlike dynamic_update_slice, which clamps) — a prefill bucket that can't
+    fit the cache must be rejected at the host boundary (round-2 advisory)."""
+    import pytest
+
+    from cyberfabric_core_tpu.runtime.export import export_llama_programs
+
+    with pytest.raises(ValueError, match="prefill_bucket"):
+        export_llama_programs("tiny-llama", tmp_path, max_seq_len=64,
+                              prefill_bucket=128)
+    # bucket == max_seq_len is the engine's own top bucket: must export fine
+    m = export_llama_programs("tiny-llama", tmp_path, max_seq_len=128,
+                              prefill_bucket=128)
+    assert m["prefill_bucket"] == 128
