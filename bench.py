@@ -42,6 +42,25 @@ def log(msg: str) -> None:
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
+HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_HISTORY.jsonl")
+
+
+def record_history(kind: str, entry: dict) -> None:
+    """Append a successful REAL-TPU measurement to the committed evidence
+    file. Round-2 verdict: every perf claim must live in an artifact — a
+    number that exists only in prose is unverifiable. CPU runs are never
+    recorded here; the file is TPU evidence only."""
+    row = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "kind": kind, **entry}
+    try:
+        with open(HISTORY_PATH, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        log(f"history += {kind}: {json.dumps(entry)[:160]}")
+    except OSError as e:
+        log(f"history append failed: {e}")
+
+
 #: children the watchdog must reap before exiting — an orphaned child mid-
 #: device-op keeps holding the relay claim (the r1 wedge)
 _LIVE_CHILDREN: list[subprocess.Popen] = []
@@ -216,6 +235,7 @@ def single(model: str, quant: str) -> int:
         "ttft_p50_ms": round(ttft_p50, 1),
         "decode_chunk": cfg.decode_chunk,
         "north_star": "p50 TTFT < 100 ms (BASELINE.json); vs_baseline = 100/ttft_p50",
+        "tpu": on_tpu,
     }
     print(json.dumps(result), flush=True)
     return 0
@@ -252,6 +272,10 @@ def main() -> int:
             result["metric"] = str(result.get("metric", "")).replace("(cpu", "(cpu-dev")
         else:
             result["tpu_unavailable"] = probe_detail
+            # a CPU TTFT against the 100 ms TPU north-star reads like "90×
+            # baseline" while measuring nothing real (round-2 verdict weak #8)
+            result["vs_baseline"] = 0.0
+            result["vs_baseline_suppressed"] = "cpu fallback; north-star ratio is TPU-only"
         print(json.dumps(result), flush=True)
         return 0
 
@@ -287,6 +311,8 @@ def main() -> int:
     # the headline line ships FIRST — a wedge in the best-effort aggregate
     # below must never cost the primary number (the r1 failure mode)
     print(json.dumps(result), flush=True)
+    if result.get("tpu"):
+        record_history("headline", result)
 
     # BASELINE config #2: continuous batching aggregate (the PAGED decode
     # path) — 8 concurrent streams, aggregate tokens/sec. Results go to
@@ -328,6 +354,7 @@ def main() -> int:
                         os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_AGGREGATE.json"), "w") as f:
                     json.dump(agg, f)
+                record_history("aggregate", agg)
                 break
             log(f"aggregate {agg_model}/{agg_quant} produced no tokens "
                 f"({agg.get('errors', 0)} error finishes); stepping down")
@@ -349,6 +376,8 @@ def main() -> int:
                         os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_EMBED.json"), "w") as f:
                     json.dump(emb, f)
+                if emb.get("tpu"):
+                    record_history("embed", emb)
         except Exception as e:  # noqa: BLE001
             log(f"embed bench failed: {e}")
             _terminate_gracefully(proc)

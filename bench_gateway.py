@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Gateway-overhead benchmark against the <50 ms P99 NFR.
+
+The reference declares "LLM-gateway added overhead (excluding provider latency)
+< 50 ms P99" (modules/llm-gateway/docs/PRD.md:28, BASELINE.md) but never
+measures it. This harness does, for OUR 12-layer stack: it boots the real
+api-gateway with REAL JWT authn (HS256 validation per request — not
+accept_all), registers a no-op echo handler, and measures full loopback
+round-trip latency at 1 / 64 / 256 concurrent streams. Because the handler
+does nothing, the round-trip IS the stack's added overhead (transport
+included, which only over-counts — the NFR bar is conservative this way).
+
+Writes GATEWAY_OVERHEAD.json {concurrency: {p50_ms, p95_ms, p99_ms, rps}, ...}
+and prints one JSON summary line. Exit 1 if any P99 misses the 50 ms bar.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+
+def make_token(secret: str) -> str:
+    from cyberfabric_core_tpu.modkit.jwt import encode_hs256
+
+    now = int(time.time())
+    return encode_hs256(
+        {"sub": "bench", "tenant_id": "acme", "scope": "bench.run",
+         "iss": "https://bench.test", "aud": "tpu-fabric",
+         "iat": now, "exp": now + 3600}, secret, kid="bench-key")
+
+
+async def run_bench(concurrencies: tuple[int, ...] = (1, 64, 256),
+                    requests_per_level: int | None = None,
+                    repeats: int = 3) -> dict:
+    """Measure gateway vs bare-floor latency.
+
+    ``repeats`` interleaved gw/floor measurement pairs per concurrency level;
+    the reported added_* is the MEDIAN of per-pair differences — a single
+    GC/event-loop hiccup in one run must not flip the NFR verdict (differences
+    of independently measured p99s are noise-dominated otherwise).
+    """
+    from cyberfabric_core_tpu.gateway.module import ApiGatewayModule
+    from cyberfabric_core_tpu.modkit import (AppConfig, ClientHub, Module,
+                                             ModuleRegistry, RestApiCapability,
+                                             RunOptions, module)
+    from cyberfabric_core_tpu.modkit.registry import Registration, _REGISTRATIONS
+    from cyberfabric_core_tpu.modkit.runtime import HostRuntime
+    from cyberfabric_core_tpu.modules.resolvers import AuthnResolverModule
+
+    import aiohttp
+
+    secret = "bench-secret-0123456789abcdef0123456789abcdef"
+
+    saved = list(_REGISTRATIONS)
+    _REGISTRATIONS.clear()
+
+    @module(name="echo", capabilities=["rest"])
+    class EchoModule(Module, RestApiCapability):
+        async def init(self, ctx):
+            pass
+
+        def register_rest(self, ctx, router, openapi):
+            async def echo(request):
+                return {"ok": True}
+
+            # high limits: the bench must measure the stack, not throttle on it
+            router.operation("POST", "/v1/echo", module="echo") \
+                .auth_required("bench.run") \
+                .rate_limit(rps=1e6, burst=100000, max_in_flight=1024) \
+                .handler(echo).register()
+
+    regs = [
+        Registration("api_gateway", ApiGatewayModule, (),
+                     ("rest_host", "stateful", "system")),
+        Registration("authn_resolver", AuthnResolverModule, (), ("system",)),
+    ]
+    cfg = AppConfig.load_or_default(environ={}, cli_overrides={"modules": {
+        "api_gateway": {"config": {"bind_addr": "127.0.0.1:0"}},
+        "authn_resolver": {"config": {
+            "mode": "jwt",
+            "keys": {"bench-key": {"alg": "HS256", "secret": secret}},
+            "issuer": "https://bench.test", "audience": "tpu-fabric",
+        }},
+        "echo": {},
+    }})
+    registry = ModuleRegistry.discover_and_build(extra=regs)
+    rt = HostRuntime(RunOptions(config=cfg, registry=registry,
+                                client_hub=ClientHub()))
+    await rt.run_setup_phases()
+    base = f"http://127.0.0.1:{registry.get('api_gateway').instance.bound_port}"
+    token = make_token(secret)
+    headers = {"Authorization": f"Bearer {token}",
+               "Content-Type": "application/json"}
+    payload = {"messages": [{"role": "user", "content": "x" * 256}]}
+
+    # bare aiohttp server with the same no-op handler: the transport +
+    # event-loop queueing floor at each concurrency level. "Added overhead"
+    # is gateway latency minus this floor — at saturation the floor is pure
+    # Little's-law queueing that any asyncio server pays, not our stack.
+    from aiohttp import web as _web
+
+    bare_app = _web.Application()
+
+    async def bare_echo(request):
+        await request.read()
+        return _web.json_response({"ok": True})
+
+    bare_app.router.add_post("/v1/echo", bare_echo)
+    bare_runner = _web.AppRunner(bare_app)
+    await bare_runner.setup()
+    bare_site = _web.TCPSite(bare_runner, "127.0.0.1", 0)
+    await bare_site.start()
+    bare_base = f"http://127.0.0.1:{bare_site._server.sockets[0].getsockname()[1]}"
+
+    async def measure(session, url, concurrency, n_requests) -> dict:
+        lat: list[float] = []
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one() -> None:
+            async with sem:
+                t0 = time.perf_counter()
+                async with session.post(url, json=payload, headers=headers) as r:
+                    await r.read()
+                    assert r.status == 200, r.status
+                lat.append((time.perf_counter() - t0) * 1000.0)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[one() for _ in range(n_requests)])
+        wall = time.perf_counter() - t0
+        lat.sort()
+
+        def pct(p: float) -> float:
+            return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+        return {"requests": n_requests, "p50_ms": round(pct(0.50), 2),
+                "p95_ms": round(pct(0.95), 2), "p99_ms": round(pct(0.99), 2),
+                "max_ms": round(lat[-1], 2), "rps": round(n_requests / wall, 1)}
+
+    results: dict[str, dict] = {}
+    try:
+        conn = aiohttp.TCPConnector(limit=512)
+        async with aiohttp.ClientSession(connector=conn) as s:
+            # warmup both servers: connection pool + code paths hot
+            await measure(s, base + "/v1/echo", 32, 64)
+            await measure(s, bare_base + "/v1/echo", 32, 64)
+
+            for concurrency in concurrencies:
+                n_requests = requests_per_level or max(1000, concurrency * 20)
+                pairs = []
+                for _ in range(repeats):
+                    gw = await measure(s, base + "/v1/echo", concurrency,
+                                       n_requests)
+                    floor = await measure(s, bare_base + "/v1/echo",
+                                          concurrency, n_requests)
+                    pairs.append((gw, floor))
+
+                def med(vals: list[float]) -> float:
+                    vals = sorted(vals)
+                    return vals[len(vals) // 2]
+
+                results[str(concurrency)] = {
+                    "gateway": pairs[-1][0], "bare_floor": pairs[-1][1],
+                    "repeats": repeats,
+                    "added_p50_ms": round(
+                        med([g["p50_ms"] - f["p50_ms"] for g, f in pairs]), 2),
+                    "added_p99_ms": round(
+                        med([g["p99_ms"] - f["p99_ms"] for g, f in pairs]), 2),
+                }
+                print(f"# concurrency={concurrency}: "
+                      f"{ {k: v for k, v in results[str(concurrency)].items() if k.startswith('added')} } "
+                      f"last gw={pairs[-1][0]}", file=sys.stderr, flush=True)
+    finally:
+        await bare_runner.cleanup()
+        rt.root_token.cancel()
+        await rt.run_stop_phase()
+        _REGISTRATIONS.clear()
+        _REGISTRATIONS.extend(saved)
+    return results
+
+
+def main() -> int:
+    # gateway-only bench: no device work — unconditionally keep any
+    # transitively imported JAX off the shared TPU relay
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    results = asyncio.run(run_bench())
+    bar_ms = 50.0
+    worst_added_p99 = max(r["added_p99_ms"] for r in results.values())
+    summary = {
+        "metric": "api-gateway 12-layer stack ADDED latency vs bare aiohttp "
+                  "(jwt auth, loopback, no-op handler)",
+        "nfr": "added overhead < 50 ms P99 (reference llm-gateway PRD.md:28)",
+        "worst_added_p99_ms": worst_added_p99,
+        "pass": worst_added_p99 < bar_ms,
+        "by_concurrency": results,
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "GATEWAY_OVERHEAD.json")
+    with open(out, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps(summary), flush=True)
+    return 0 if summary["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -394,10 +394,14 @@ class ContinuousBatchingEngine:
                 if (pages_needed > self.pool.capacity_pages
                         or not self.active.any()):
                     self._suspended.popleft()
+                    reason = (
+                        f"needs {pages_needed} pages > pool capacity "
+                        f"{self.pool.capacity_pages}"
+                        if pages_needed > self.pool.capacity_pages
+                        else "cannot fit the idle pool")
                     logger.warning(
-                        "request %s (len=%d) cannot fit the idle pool; "
-                        "finishing with 'length'", rec.state.request_id,
-                        rec.length)
+                        "request %s (len=%d) %s; finishing with 'length'",
+                        rec.state.request_id, rec.length, reason)
                     rec.state.emit(StepEvent(0, -1, "length"))
                     self.requests_completed += 1
                     continue
