@@ -1,0 +1,29 @@
+# Local enforcement targets — reference `make safety` parity (Makefile:216:
+# clippy + kani + dylint there; arch lint + fuzz + sanitizers + contract
+# gates here). CI (.github/workflows/ci.yml) runs the same gates.
+
+PY ?= python
+export JAX_PLATFORMS ?= cpu
+
+.PHONY: safety lint fuzz sanitizers contracts test native
+
+safety: lint fuzz sanitizers contracts  ## the full local gate
+
+lint:  ## architectural lints (dylint equivalent: L1-L7 incl. DE07/DE08)
+	$(PY) -m pytest tests/test_arch_lint.py -q
+
+fuzz:  ## OData parser property-fuzz (ClusterFuzzLite equivalent), deeper than CI
+	FUZZ_EXAMPLES=2000 $(PY) -m pytest tests/test_odata_fuzz.py -q
+
+sanitizers:  ## TSAN/ASAN exercise of the native allocator + radix tree
+	$(MAKE) -C native/fabric_host tsan asan
+
+contracts:  ## OpenAPI golden gate + GTS docs validation (oasdiff equivalent)
+	$(PY) -m pytest tests/test_openapi_contract.py -q
+	$(PY) -m cyberfabric_core_tpu.apps.gts_docs_validator docs config README.md --vendor x
+
+test:  ## full suite
+	$(PY) -m pytest tests/ -q
+
+native:  ## build the native host library
+	$(MAKE) -C native/fabric_host

@@ -152,10 +152,14 @@ async def run_bench(concurrencies: tuple[int, ...] = (1, 64, 256),
                 n_requests = requests_per_level or max(1000, concurrency * 20)
                 pairs = []
                 for _ in range(repeats):
-                    gw = await measure(s, base + "/v1/echo", concurrency,
-                                       n_requests)
-                    floor = await measure(s, bare_base + "/v1/echo",
-                                          concurrency, n_requests)
+                    # SAME-WINDOW measurement: both servers run concurrently
+                    # under one event loop, so a GC/scheduler hiccup lands in
+                    # both distributions and cancels in the difference —
+                    # sequential runs made added_p99 noise-dominated
+                    gw, floor = await asyncio.gather(
+                        measure(s, base + "/v1/echo", concurrency, n_requests),
+                        measure(s, bare_base + "/v1/echo", concurrency,
+                                n_requests))
                     pairs.append((gw, floor))
 
                 def med(vals: list[float]) -> float:

@@ -109,3 +109,109 @@ def test_L5_cross_module_calls_go_through_sdk():
             violations.append((str(path.relative_to(PKG)), mod))
     assert not violations, (
         f"cross-module implementation imports (use ClientHub/.sdk): {violations}")
+
+
+def _calls(path: Path):
+    """Yield every ast.Call in a file."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def test_L6_security_raw_connection_confined():
+    """DE07 equivalent (security lint): the raw-connection escape hatches
+    (`raw_connection()`, `raw_for_migrations()`) are callable only inside the
+    modkit DB boundary — 'no plain SQL outside migrations'
+    (reference advisory_locks.rs:6-9, dylint DE07)."""
+    allowed = {"db.py", "db_engine.py"}
+    violations = []
+    for path in sorted(PKG.rglob("*.py")):
+        if path.name in allowed:
+            continue
+        for call in _calls(path):
+            fn = call.func
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in ("raw_connection", "raw_for_migrations")):
+                violations.append((str(path.relative_to(PKG)), fn.attr))
+    assert not violations, (
+        f"raw DB connection access outside modkit/db: {violations}")
+
+
+def test_L6_secret_string_never_interpolated():
+    """DE07 equivalent: SecretString.expose() is the only sanctioned reveal,
+    and it must never feed a string-formatting expression directly (an
+    f-string / str.format / % would put the secret in a rendered string that
+    can reach logs)."""
+    violations = []
+    for path in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            # f-string with .expose() inside
+            if isinstance(node, ast.JoinedStr):
+                for v in ast.walk(node):
+                    if (isinstance(v, ast.Call)
+                            and isinstance(v.func, ast.Attribute)
+                            and v.func.attr == "expose"):
+                        violations.append(
+                            (str(path.relative_to(PKG)), "f-string"))
+            # "...".format(x.expose())
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "format":
+                for a in list(node.args) + [k.value for k in node.keywords]:
+                    for v in ast.walk(a):
+                        if (isinstance(v, ast.Call)
+                                and isinstance(v.func, ast.Attribute)
+                                and v.func.attr == "expose"):
+                            violations.append(
+                                (str(path.relative_to(PKG)), ".format"))
+            # "%s" % x.expose()
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+                for v in ast.walk(node.right):
+                    if (isinstance(v, ast.Call)
+                            and isinstance(v.func, ast.Attribute)
+                            and v.func.attr == "expose"):
+                        violations.append(
+                            (str(path.relative_to(PKG)), "%-format"))
+    assert not violations, (
+        f"SecretString revealed inside string formatting: {violations}")
+
+
+def test_L7_rest_route_conventions():
+    """DE08 equivalent (REST conventions lint): every registered route uses a
+    known HTTP verb, is rooted at /v1/ (or a sanctioned infra path), has no
+    trailing slash, and uses lowercase kebab/snake segments with {snake_case}
+    params."""
+    import re as _re
+
+    INFRA = {"/metrics", "/health", "/healthz", "/openapi.json", "/docs"}
+    VERBS = {"GET", "POST", "PUT", "PATCH", "DELETE"}
+    seg_re = _re.compile(r"^(?:[a-z0-9][a-z0-9_\-.]*|\{[a-z][a-z0-9_]*\})$")
+    violations = []
+    for path in sorted(PKG.rglob("*.py")):
+        for call in _calls(path):
+            fn = call.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr == "operation"):
+                continue
+            if len(call.args) < 2:
+                continue
+            method, route = call.args[0], call.args[1]
+            if not (isinstance(method, ast.Constant) and isinstance(route, ast.Constant)):
+                continue
+            m, r = method.value, route.value
+            where = (str(path.relative_to(PKG)), m, r)
+            if m not in VERBS:
+                violations.append((*where, "unknown verb"))
+                continue
+            if r in INFRA:
+                continue
+            if not r.startswith("/v1/"):
+                violations.append((*where, "not rooted at /v1/"))
+            if r != "/" and r.endswith("/"):
+                violations.append((*where, "trailing slash"))
+            for seg in r.strip("/").split("/")[1:]:
+                if seg.startswith(":"):
+                    continue  # :control-style action segments
+                if not seg_re.match(seg):
+                    violations.append((*where, f"bad segment {seg!r}"))
+    assert not violations, f"REST convention violations: {violations}"

@@ -11,6 +11,7 @@ them in CI. Invariants pinned here:
 4. cursors round-trip exactly and tampered/mismatched cursors are rejected.
 """
 
+import os
 import re
 import string
 
@@ -23,11 +24,18 @@ from cyberfabric_core_tpu.modkit.odata import (
 
 FIELD_MAP = {"name": "name_col", "age": "age_col", "city": "city_col"}
 
+def _ex(n: int) -> int:
+    """CI runs the baseline count; `make fuzz` / FUZZ_EXAMPLES deepens
+    the sweep (bounded-example fuzzing scales by budget, round-2 verdict
+    weak #7)."""
+    return max(n, int(os.environ.get("FUZZ_EXAMPLES", "0")))
+
+
 # ---------------------------------------------------------------- crash-safety
 
 
 @given(st.text(max_size=200))
-@settings(max_examples=300, deadline=None)
+@settings(max_examples=_ex(300), deadline=None)
 def test_parse_filter_never_crashes_unexpectedly(text):
     try:
         parse_filter(text)
@@ -36,7 +44,7 @@ def test_parse_filter_never_crashes_unexpectedly(text):
 
 
 @given(st.text(max_size=120))
-@settings(max_examples=300, deadline=None)
+@settings(max_examples=_ex(300), deadline=None)
 def test_parse_orderby_never_crashes_unexpectedly(text):
     try:
         parse_orderby(text)
@@ -45,7 +53,7 @@ def test_parse_orderby_never_crashes_unexpectedly(text):
 
 
 @given(st.text(alphabet=string.printable, max_size=120))
-@settings(max_examples=300, deadline=None)
+@settings(max_examples=_ex(300), deadline=None)
 def test_decode_cursor_never_crashes_unexpectedly(text):
     try:
         decode_cursor(text, "somehash")
@@ -87,7 +95,7 @@ _SQL_OK = re.compile(r"^[A-Za-z0-9_ ().?<>=!,]*$")
 
 
 @given(filters())
-@settings(max_examples=300, deadline=None)
+@settings(max_examples=_ex(300), deadline=None)
 def test_generated_sql_is_fully_parameterized(filter_text):
     expr = parse_filter(filter_text)
     sql, params = to_sql(expr, FIELD_MAP)
@@ -102,7 +110,7 @@ def test_generated_sql_is_fully_parameterized(filter_text):
 
 
 @given(filters())
-@settings(max_examples=100, deadline=None)
+@settings(max_examples=_ex(100), deadline=None)
 def test_parse_to_sql_deterministic(filter_text):
     a = to_sql(parse_filter(filter_text), FIELD_MAP)
     b = to_sql(parse_filter(filter_text), FIELD_MAP)
@@ -134,14 +142,14 @@ _key_value = st.one_of(st.integers(-10**9, 10**9), st.text(max_size=30),
 
 @given(st.lists(_key_value, min_size=1, max_size=4),
        st.text(alphabet=string.hexdigits, min_size=1, max_size=12))
-@settings(max_examples=200, deadline=None)
+@settings(max_examples=_ex(200), deadline=None)
 def test_cursor_roundtrip(key, fhash):
     cur = encode_cursor(key, fhash)
     assert decode_cursor(cur, fhash) == list(key)
 
 
 @given(st.lists(_key_value, min_size=1, max_size=4))
-@settings(max_examples=100, deadline=None)
+@settings(max_examples=_ex(100), deadline=None)
 def test_cursor_filter_binding(key):
     cur = encode_cursor(key, short_filter_hash("age gt 1", "name"))
     with pytest.raises(ODataError):
@@ -150,7 +158,7 @@ def test_cursor_filter_binding(key):
 
 @given(st.lists(_key_value, min_size=1, max_size=3), st.integers(0, 40),
        st.sampled_from(string.ascii_letters))
-@settings(max_examples=200, deadline=None)
+@settings(max_examples=_ex(200), deadline=None)
 def test_cursor_tampering_detected_or_error(key, pos, ch):
     """Flipping any character of a cursor either fails decode (ODataError) or
     still matches the filter hash only if the payload is untouched."""
