@@ -25,5 +25,6 @@ contracts:  ## OpenAPI golden gate + GTS docs validation (oasdiff equivalent)
 test:  ## full suite
 	$(PY) -m pytest tests/ -q
 
-native:  ## build the native host library
+native:  ## build the native host library + PJRT AOT consumer
 	$(MAKE) -C native/fabric_host
+	$(MAKE) -C native/pjrt_host

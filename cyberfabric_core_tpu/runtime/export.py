@@ -94,15 +94,27 @@ def export_llama_programs(
     max_seq_len: int = 1024,
     dtype=jnp.bfloat16,
     quantization: str = "none",
+    conformance: bool = False,
 ) -> dict[str, Any]:
     """Export the two serving programs (prefill+first-token, fused decode
-    chunk) for a decoder architecture. Returns the manifest dict."""
+    chunk) for a decoder architecture. Returns the manifest dict.
+
+    ``conformance=True`` additionally materializes (small!) params and writes
+    ``conformance.npz`` — recorded inputs/outputs a fresh-process consumer
+    replays to prove the artifacts execute (runtime/consume.py)."""
     from .engine import build_decode_chunk_fn
 
     cfg = get_config(model)
     if cfg.architecture != "llama":
         raise ValueError(f"export_llama_programs drives decoder models, got "
                          f"{cfg.architecture}")
+    if conformance and jnp.dtype(dtype).name not in (
+            "float32", "float64", "int32", "int64"):
+        # fail BEFORE artifacts are written / params materialized — a late
+        # error would leave a partial export (artifacts, no manifest)
+        raise ValueError(
+            f"conformance=True needs an npz-native dtype (float32), got "
+            f"{jnp.dtype(dtype).name}")
     # the forward's cache insert is a scatter whose OOB writes are DROPPED
     # (unlike dynamic_update_slice, which clamps) — a bucket wider than the
     # cache would silently attend over zero KV, so reject it loudly here
@@ -157,6 +169,57 @@ def export_llama_programs(
                 jax.jit(decode_fn, donate_argnums=(1, 2)), *decode_avals),
             [str(a) for a in decode_avals[1:]]),
     ]
+
+    if conformance:
+        # Conformance bundle: recorded inputs + live-jit outputs so a fresh
+        # process (runtime/consume.py — or a native PJRT host) can prove the
+        # ARTIFACT executes to the same results. Materializes params, so only
+        # sensible for small configs; the npz stores the flattened calling
+        # convention (leaf order == the lowered program's arg order).
+        import numpy as np
+
+        if quantization == "int8":
+            from .quant import init_params_quantized
+
+            live_params = init_params_quantized(cfg, jax.random.PRNGKey(0),
+                                                dtype)
+        else:
+            live_params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype)
+        rng = jax.random.PRNGKey(7)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (B, prefill_bucket),
+                                 3, cfg.vocab_size, jnp.int32)
+        lengths = jnp.full((B,), prefill_bucket, jnp.int32)
+        temp = jnp.zeros((B,), jnp.float32)      # greedy: deterministic
+        top_p = jnp.ones((B,), jnp.float32)
+        top_k = jnp.zeros((B,), jnp.int32)
+        pre_in = (live_params, ids, lengths, rng, temp, top_p, top_k)
+        pre_out = jax.jit(prefill)(*pre_in)
+        first, cache, rng2 = pre_out
+        dec_in = (live_params, cache[0], cache[1],
+                  first, lengths, rng2, temp, top_p, top_k)
+        dec_out = jax.jit(decode_fn)(*dec_in)  # no donation: inputs reused
+
+        bundle: dict[str, Any] = {}
+        for prog_name, args_tree, outs_tree in (
+                (programs[0].name, pre_in, pre_out),
+                (programs[1].name, dec_in, dec_out)):
+            in_leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(args_tree)]
+            out_leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(outs_tree)]
+            for leaves in (in_leaves, out_leaves):
+                for a in leaves:
+                    if a.dtype.name not in ("float32", "float64", "int8",
+                                            "int32", "int64", "uint32",
+                                            "uint64", "bool"):
+                        raise ValueError(
+                            f"conformance bundle needs npz-native dtypes; got "
+                            f"{a.dtype} — export with dtype=float32")
+            bundle[f"{prog_name}.n_in"] = np.int64(len(in_leaves))
+            bundle[f"{prog_name}.n_out"] = np.int64(len(out_leaves))
+            for i, a in enumerate(in_leaves):
+                bundle[f"{prog_name}.in{i}"] = a
+            for i, a in enumerate(out_leaves):
+                bundle[f"{prog_name}.out{i}"] = a
+        np.savez(out_dir / "conformance.npz", **bundle)
     manifest = {
         "model": model,
         "architecture": cfg.architecture,

@@ -142,3 +142,52 @@ def test_export_rejects_bucket_wider_than_cache(tmp_path):
     m = export_llama_programs("tiny-llama", tmp_path, max_seq_len=128,
                               prefill_bucket=128)
     assert m["prefill_bucket"] == 128
+
+
+def test_exported_artifacts_execute_in_fresh_process(tmp_path):
+    """The export story's proof leg (round-2 verdict item 6): a FRESH process
+    loads the artifacts (MLIR text → PJRT compile_and_load → execute, no jax
+    tracing) and reproduces the live-jit outputs recorded at export time."""
+    import json
+    import subprocess
+    import sys
+
+    import jax.numpy as jnp
+
+    from cyberfabric_core_tpu.runtime.export import export_llama_programs
+
+    m = export_llama_programs("tiny-llama", tmp_path, max_seq_len=128,
+                              prefill_bucket=32, decode_chunk=4,
+                              dtype=jnp.float32, conformance=True)
+    assert (tmp_path / "conformance.npz").exists()
+
+    repo_root = str(Path(__file__).resolve().parents[1])
+    proc = subprocess.run(
+        [sys.executable, "-m", "cyberfabric_core_tpu.runtime.consume",
+         "--cpu", str(tmp_path)],
+        capture_output=True, text=True, timeout=600, cwd=repo_root)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["ok"], verdict
+    assert set(verdict["executed"]) == {p["name"] for p in m["programs"]}
+
+
+def test_consume_detects_tampered_artifact(tmp_path):
+    """Digest verification: a flipped byte in the artifact must be caught
+    before anything compiles."""
+    import pytest as _pytest
+
+    import jax.numpy as jnp
+
+    from cyberfabric_core_tpu.runtime.consume import verify_manifest
+    from cyberfabric_core_tpu.runtime.export import export_llama_programs
+
+    m = export_llama_programs("tiny-llama", tmp_path, max_seq_len=128,
+                              prefill_bucket=32, decode_chunk=4,
+                              dtype=jnp.float32)
+    verify_manifest(tmp_path)  # clean passes
+    victim = m["programs"][0]["path"]
+    data = open(victim).read()
+    open(victim, "w").write(data.replace("stablehlo", "stablehlx", 1))
+    with _pytest.raises(ValueError, match="digest"):
+        verify_manifest(tmp_path)
