@@ -77,6 +77,10 @@ class JwksCache:
     _fetched_at: float = 0.0
     _last_miss_refresh: float = 0.0
     _lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    #: bumped whenever a refetch lands a DIFFERENT kid set — consumers that
+    #: cache per-token validation results key their caches on this so a key
+    #: rotation invalidates tokens signed by withdrawn kids immediately
+    generation: int = 0
 
     async def _fetch(self) -> None:
         # modkit-http stack: retries (idempotent GET — transport/5xx/429) with
@@ -99,6 +103,8 @@ class JwksCache:
                 keys[key.kid] = key
         if not keys:
             raise JwtError(f"JWKS at {self.jwks_url} contained no usable keys")
+        if set(keys) != set(self._keys):
+            self.generation += 1
         self._keys = keys
         self._fetched_at = time.monotonic()
         logger.info("JWKS refreshed from %s: kids=%s", self.jwks_url,

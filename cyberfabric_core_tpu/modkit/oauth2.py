@@ -30,6 +30,10 @@ class OAuth2Error(RuntimeError):
 
 @dataclass
 class ClientCredentialsTokenSource:
+    """``token_url`` may be empty when ``issuer`` is set — the endpoint is
+    then resolved from the issuer's OIDC discovery document
+    (reference: libs/modkit-auth/src/oauth2/discovery.rs)."""
+
     token_url: str
     client_id: str
     client_secret: str
@@ -39,10 +43,62 @@ class ClientCredentialsTokenSource:
     #: SSRF guard: when True the token endpoint resolves through the
     #: public-only resolver (same rebinding defense as the OAGW proxy)
     public_only: bool = False
+    #: OIDC issuer for token-endpoint discovery (used when token_url is "")
+    issuer: Optional[str] = None
+    discovery_ttl_s: float = 3600.0
 
     _token: Optional[str] = None
     _expires_at: float = 0.0
     _lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    _discovered_url: Optional[str] = None
+    _discovered_at: float = 0.0
+
+    async def _resolve_token_url(self) -> str:
+        if self.token_url:
+            return self.token_url
+        if not self.issuer:
+            raise OAuth2Error("either token_url or issuer must be configured")
+        now = time.monotonic()
+        if (self._discovered_url is not None
+                and now - self._discovered_at < self.discovery_ttl_s):
+            return self._discovered_url
+        from .http_client import HttpClient, HttpClientConfig, RetryConfig
+
+        well_known = self.issuer.rstrip("/") + "/.well-known/openid-configuration"
+        async with HttpClient(HttpClientConfig(
+            total_timeout_s=self.fetch_timeout_s,
+            deny_private_addresses=self.public_only,
+            retry=RetryConfig(max_retries=2),
+        )) as client:
+            resp = await client.get(well_known, allow_redirects=False)
+            if resp.status != 200:
+                if self._discovered_url is not None:
+                    logger.warning("OIDC discovery returned %d; keeping "
+                                   "cached token_endpoint", resp.status)
+                    return self._discovered_url
+                raise OAuth2Error(
+                    f"OIDC discovery failed: {well_known} -> {resp.status}")
+            try:
+                doc = resp.json()
+            except Exception as e:  # noqa: BLE001
+                raise OAuth2Error("OIDC discovery returned non-JSON") from e
+        # OIDC Discovery §4.3: the document's issuer MUST match the one the
+        # metadata was fetched for — a mismatch is a misconfigured (or
+        # malicious) endpoint
+        if not isinstance(doc, dict) or \
+                doc.get("issuer", "").rstrip("/") != self.issuer.rstrip("/"):
+            raise OAuth2Error(
+                f"OIDC discovery issuer mismatch: expected {self.issuer!r}, "
+                f"got {doc.get('issuer')!r}" if isinstance(doc, dict)
+                else "OIDC discovery returned a non-object document")
+        endpoint = doc.get("token_endpoint")
+        if not isinstance(endpoint, str) or not endpoint:
+            raise OAuth2Error("OIDC discovery document has no token_endpoint")
+        self._discovered_url = endpoint
+        self._discovered_at = now
+        logger.debug("OIDC discovery: %s -> token_endpoint %s",
+                     self.issuer, endpoint)
+        return endpoint
 
     async def _fetch(self) -> None:
         # modkit-http stack: token POST retries only on 429 (always_retry) —
@@ -55,12 +111,13 @@ class ClientCredentialsTokenSource:
                 "client_secret": self.client_secret}
         if self.scope:
             form["scope"] = self.scope
+        token_url = await self._resolve_token_url()
         async with HttpClient(HttpClientConfig(
             total_timeout_s=self.fetch_timeout_s,
             deny_private_addresses=self.public_only,
             retry=RetryConfig(max_retries=2),
         )) as client:
-            resp = await client.post(self.token_url, data=form,
+            resp = await client.post(token_url, data=form,
                                      allow_redirects=False)
             try:
                 body = resp.json()

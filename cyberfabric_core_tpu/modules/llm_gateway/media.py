@@ -187,12 +187,14 @@ class MediaAdapter:
         return {"data": items, "model_used": model.canonical_id}
 
     # ------------------------------------------------------------- tts
-    async def speech(self, ctx: SecurityContext, model: ModelInfo,
-                     body: dict) -> dict:
+    async def speech_raw(self, ctx: SecurityContext, model: ModelInfo,
+                         body: dict) -> tuple[bytes, str]:
+        """Synthesize and return raw audio bytes + mime — the realtime WS
+        session streams these straight over the socket (DESIGN.md realtime
+        bidirectional audio; no FileStorage round-trip on the hot path)."""
         if model.managed:
             raise _managed_unsupported(model, "speech synthesis")
         _require_capability(model, "tts", "speech synthesis")
-        storage = self._storage_required()  # before billing the provider
         provider_body = {"model": model.provider_model_id,
                          "input": body["input"],
                          "voice": body.get("voice", "alloy"),
@@ -202,6 +204,13 @@ class MediaAdapter:
                 "opus": "audio/opus", "flac": "audio/flac"}.get(fmt, "audio/mpeg")
         audio = await self._provider_call(ctx, model, "audio/speech",
                                           json_body=provider_body, raw=True)
+        return audio, mime
+
+    async def speech(self, ctx: SecurityContext, model: ModelInfo,
+                     body: dict) -> dict:
+        storage = self._storage_required()  # before billing the provider
+        audio, mime = await self.speech_raw(ctx, model, body)
+        fmt = body.get("response_format", "mp3")
         stored = await storage.store(ctx, audio, mime,
                                      filename=f"speech.{fmt}")
         return {"url": stored.url, "mime_type": mime,

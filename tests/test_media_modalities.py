@@ -258,17 +258,92 @@ def test_realtime_binary_audio_frames(stack):
                 await ws.send_json({"type": "audio.commit",
                                     "model": "media-mock::whisper",
                                     "mime_type": "audio/wav"})
-                transcript = await ws.receive_json()
+                deltas = []
+                ev = await ws.receive_json()
+                while ev["type"] == "transcript.delta":
+                    deltas.append(ev["delta"])
+                    ev = await ws.receive_json()
                 await ws.send_json({"type": "session.close"})
-                return ack1, ack2, transcript
+                return ack1, ack2, deltas, ev
 
-    ack1, ack2, transcript = loop.run_until_complete(go())
+    ack1, ack2, deltas, transcript = loop.run_until_complete(go())
     assert ack1 == {"type": "audio.appended", "buffered_bytes": 12}
     assert ack2["buffered_bytes"] == 20
+    # incremental deltas precede and concatenate to the final transcript
+    assert deltas and "".join(deltas) == "hello from audio"
     assert transcript["type"] == "transcript"
     assert transcript["text"] == "hello from audio"
     call = [s for s in seen if s["path"] == "stt"][-1]
     assert call["bytes"] == 20  # both frames committed as one buffer
+
+
+def test_realtime_full_audio_loop(stack):
+    """The DESIGN.md:262-271 bidirectional loop end to end over one socket:
+    audio-in → transcript deltas → chat on the transcript → TTS audio OUT as
+    binary frames (round-2 verdict item 8)."""
+    loop, base, seen = stack
+
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.ws_connect(f"{base}/v1/realtime") as ws:
+                # 1) audio in + commit → transcript
+                await ws.send_bytes(b"RIFF" + b"\x00" * 60)
+                assert (await ws.receive_json())["type"] == "audio.appended"
+                await ws.send_json({"type": "audio.commit",
+                                    "model": "media-mock::whisper"})
+                ev = await ws.receive_json()
+                deltas = []
+                while ev["type"] == "transcript.delta":
+                    deltas.append(ev["delta"])
+                    ev = await ws.receive_json()
+                assert ev["type"] == "transcript"
+                transcript_text = ev["text"]
+
+                # 2) chat on the transcript, asking for spoken output
+                await ws.send_json({
+                    "type": "chat.create", "id": "loop-1",
+                    "response_audio": {"model": "media-mock::tts-1",
+                                       "voice": "nova", "format": "mp3"},
+                    "request": {
+                        "model": "local::tiny-llama",
+                        "messages": [{"role": "user", "content": [
+                            {"type": "text", "text": transcript_text}]}],
+                        "max_tokens": 4}})
+                tokens, audio_out = [], bytearray()
+                begin = done = out_done = None
+                while out_done is None:
+                    msg = await ws.receive()
+                    if msg.type == aiohttp.WSMsgType.BINARY:
+                        audio_out.extend(msg.data)
+                        continue
+                    if msg.type != aiohttp.WSMsgType.TEXT:
+                        continue  # ping/pong frames
+                    ev = json.loads(msg.data)
+                    if ev["type"] == "token":
+                        tokens.append(ev["content"])
+                    elif ev["type"] == "done":
+                        done = ev
+                    elif ev["type"] == "audio.out.begin":
+                        begin = ev
+                    elif ev["type"] == "audio.out.done":
+                        out_done = ev
+                    elif ev["type"] == "error":
+                        raise AssertionError(ev)
+                await ws.send_json({"type": "session.close"})
+                return deltas, tokens, done, begin, bytes(audio_out), out_done
+
+    deltas, tokens, done, begin, audio_out, out_done = loop.run_until_complete(go())
+    assert deltas, "expected at least one transcript delta"
+    assert tokens, "expected streamed chat tokens"
+    assert done["finish_reason"] in ("stop", "length")
+    assert begin["mime_type"] == "audio/mpeg"
+    assert begin["model_used"] == "media-mock::tts-1"
+    assert audio_out == MP3                      # TTS bytes over the socket
+    assert out_done["bytes"] == len(MP3)
+    # the TTS provider was fed the CHAT REPLY, not the transcript
+    tts_call = [s for s in seen if s["path"] == "speech"][-1]
+    assert tts_call["body"]["voice"] == "nova"
+    assert tts_call["body"]["input"] == "".join(tokens)
 
 
 def test_media_usage_reported(stack):

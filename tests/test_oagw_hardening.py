@@ -332,6 +332,78 @@ def test_oauth2_token_url_validated(stack):
     assert e.value.problem.code == "insecure_upstream"
 
 
+def test_oidc_discovery_resolves_token_endpoint():
+    """token_url="" + issuer=… resolves the endpoint from the issuer's
+    /.well-known/openid-configuration (ref: modkit-auth oauth2/discovery.rs),
+    caches the result, and rejects an issuer-mismatched document."""
+    from cyberfabric_core_tpu.modkit.oauth2 import (
+        ClientCredentialsTokenSource, OAuth2Error)
+
+    loop = asyncio.new_event_loop()
+    state = {"discoveries": 0, "tokens": 0, "issuer_override": None}
+
+    async def boot():
+        app = web.Application()
+
+        async def well_known(request: web.Request):
+            state["discoveries"] += 1
+            issuer = state["issuer_override"] or f"http://127.0.0.1:{port}"
+            return web.json_response({
+                "issuer": issuer,
+                "token_endpoint": f"http://127.0.0.1:{port}/discovered/token"})
+
+        async def token(request: web.Request):
+            state["tokens"] += 1
+            return web.json_response({
+                "access_token": f"disc-tok-{state['tokens']}",
+                "expires_in": 3600})
+
+        app.router.add_get("/.well-known/openid-configuration", well_known)
+        app.router.add_post("/discovered/token", token)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        return runner, site._server.sockets[0].getsockname()[1]
+
+    runner, port = None, 0
+
+    async def run_all():
+        nonlocal runner, port
+        runner, port = await boot()
+        try:
+            src = ClientCredentialsTokenSource(
+                token_url="", client_id="svc", client_secret="s3cret",
+                issuer=f"http://127.0.0.1:{port}")
+            tok = await src.get_token()
+            assert tok == "disc-tok-1"
+            # a second refresh reuses the cached discovery document
+            src.invalidate()
+            assert await src.get_token() == "disc-tok-2"
+            assert state["discoveries"] == 1
+
+            # issuer mismatch in the metadata document is rejected
+            state["issuer_override"] = "http://evil.example"
+            bad = ClientCredentialsTokenSource(
+                token_url="", client_id="svc", client_secret="s3cret",
+                issuer=f"http://127.0.0.1:{port}")
+            with pytest.raises(OAuth2Error, match="issuer mismatch"):
+                await bad.get_token()
+
+            # neither token_url nor issuer configured → loud error
+            none = ClientCredentialsTokenSource(
+                token_url="", client_id="svc", client_secret="s3cret")
+            with pytest.raises(OAuth2Error, match="token_url or issuer"):
+                await none.get_token()
+        finally:
+            await runner.cleanup()
+
+    try:
+        loop.run_until_complete(run_all())
+    finally:
+        loop.close()
+
+
 def test_pdf_decompression_bomb_capped():
     from cyberfabric_core_tpu.modkit.errors import ProblemError
     """A small PDF inflating beyond the cap is rejected, not OOM'd."""
