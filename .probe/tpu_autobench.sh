@@ -1,0 +1,67 @@
+#!/bin/bash
+# Watch the tunneled TPU; the moment it answers, run the bench ladder and
+# commit the evidence (BENCH_HISTORY.jsonl). Never SIGKILL a device op —
+# a process killed mid-op strands the relay claim for hours.
+#
+# States (in $STATE file): "" -> no TPU number yet this round;
+#   "headline" -> got a number, still chasing the 8B north-star + sweep;
+#   "done" -> 8B (or better) + sweep landed; keep logging availability only.
+LOG=/root/repo/.probe/tpu_watch.log
+STATE=/root/repo/.probe/autobench.state
+REPO=/root/repo
+cd "$REPO" || exit 1
+
+probe() {
+  timeout --signal=TERM 150 python -c "
+import jax
+d = jax.devices()
+assert d[0].platform != 'cpu', d
+import jax.numpy as jnp
+x = jnp.ones((256,256), jnp.bfloat16)
+(x@x).block_until_ready()
+print('PROBE_OK', d[0].platform, len(d))
+" 2>&1 | grep -q PROBE_OK
+}
+
+commit_evidence() {
+  cd "$REPO" || return
+  git add -f BENCH_HISTORY.jsonl BENCH_AGGREGATE.json BENCH_EMBED.json \
+      .probe/tpu_watch.log 2>/dev/null
+  git diff --cached --quiet || git commit -q -m "bench: real-TPU measurements ($1)"
+}
+
+while true; do
+  ts=$(date -u +%FT%TZ)
+  if probe; then
+    echo "$ts TPU_AVAILABLE" >> "$LOG"
+    state=$(cat "$STATE" 2>/dev/null)
+    if [ "$state" != "done" ]; then
+      echo "$ts autobench: running bench ladder" >> "$LOG"
+      BENCH_WATCHDOG_S=2700 timeout --signal=TERM 2820 \
+        python "$REPO/bench.py" > /tmp/bench_auto.json 2>/tmp/bench_auto.log
+      tail -1 /tmp/bench_auto.json >> "$LOG"
+      headline=$(tail -1 /tmp/bench_auto.json 2>/dev/null)
+      if echo "$headline" | grep -q '"tpu": true'; then
+        model=$(echo "$headline" | sed -n 's/.*"metric": "\([a-z0-9-]*\).*/\1/p')
+        echo "$ts autobench: headline landed ($model)" >> "$LOG"
+        echo headline > "$STATE"
+        # sweep decode_chunk on the winning model while the chip is warm
+        quant=none
+        echo "$headline" | grep -q int8 && quant=int8
+        timeout --signal=TERM 2900 python "$REPO/bench.py" --sweep "$model" "$quant" \
+          >> /tmp/bench_auto.json 2>>/tmp/bench_auto.log
+        # north-star reached (8B headline) -> done; else keep retrying for 8B
+        case "$model" in llama-3-8b*) echo done > "$STATE";; esac
+        commit_evidence "$model"
+      else
+        echo "$ts autobench: ladder produced no TPU number" >> "$LOG"
+        commit_evidence "attempt"
+      fi
+      sleep 600
+      continue
+    fi
+  else
+    echo "$ts TPU_DOWN" >> "$LOG"
+  fi
+  sleep 300
+done

@@ -127,12 +127,14 @@ def _terminate_gracefully(proc: subprocess.Popen, grace_s: float = 45.0) -> None
             pass
 
 
-def run_attempt(model: str, quant: str, timeout_s: float) -> dict | None:
+def run_attempt(model: str, quant: str, timeout_s: float,
+                env: dict | None = None) -> dict | None:
     """One ladder attempt in a fresh subprocess. Returns the attempt's JSON
     result dict, a dict with "error", or None on hang/crash-without-output."""
     cmd = [sys.executable, os.path.abspath(__file__), "--single", model, quant]
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=sys.stderr,
-                            text=True, cwd=os.path.dirname(os.path.abspath(__file__)))
+                            text=True, cwd=os.path.dirname(os.path.abspath(__file__)),
+                            env=env)
     _LIVE_CHILDREN.append(proc)
     line = None
     try:
@@ -172,8 +174,9 @@ def single(model: str, quant: str) -> int:
     max_seq = 1024 if on_tpu else 128
     prompt_len = 128 if on_tpu else 16
     gen_tokens = 256 if on_tpu else 16
+    chunk = int(os.environ.get("BENCH_DECODE_CHUNK", "0")) or (64 if on_tpu else 4)
     cfg = EngineConfig(model=model, max_seq_len=max_seq, max_batch=1,
-                       decode_chunk=64 if on_tpu else 4, quantization=quant)
+                       decode_chunk=chunk, quantization=quant)
 
     try:
         t0 = time.monotonic()
@@ -522,6 +525,36 @@ def aggregate(model_name: str, quant: str) -> int:
         return 1
 
 
+def sweep(model: str, quant: str) -> int:
+    """decode_chunk sweep on the real chip (round-2 verdict item 2): one
+    fresh subprocess per chunk via --single, each row appended to
+    BENCH_HISTORY.jsonl with its roofline context. Runs AFTER a headline
+    lands so the winning model is known to fit."""
+    chunks = [int(c) for c in
+              os.environ.get("BENCH_SWEEP_CHUNKS", "16,32,64,128").split(",")]
+    rows = []
+    for chunk in chunks:
+        # run_attempt, not subprocess.run: a hung child must get SIGTERM +
+        # grace (never SIGKILL mid-device-op — the relay-wedge invariant) and
+        # must be registered for watchdog cleanup
+        out = run_attempt(model, quant, 700.0,
+                          env=dict(os.environ, BENCH_DECODE_CHUNK=str(chunk)))
+        if out is None:
+            log(f"sweep chunk={chunk}: hung or died without output")
+            continue
+        if "error" in out or not out.get("tpu"):
+            log(f"sweep chunk={chunk}: {out.get('error') or 'not on tpu'}; "
+                "skipping row")
+            continue
+        row = {"model": model, "quant": quant, "decode_chunk": chunk,
+               "tokens_per_sec": out["value"],
+               "ttft_p50_ms": out.get("ttft_p50_ms")}
+        rows.append(row)
+        record_history("sweep", row)
+    print(json.dumps({"sweep": rows}), flush=True)
+    return 0 if rows else 1
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 3 and sys.argv[1] == "--single":
         sys.exit(single(sys.argv[2], sys.argv[3]))
@@ -531,4 +564,6 @@ if __name__ == "__main__":
         sys.exit(embed_bench())
     if len(sys.argv) > 3 and sys.argv[1] == "--cost":
         sys.exit(cost_mode(sys.argv[2], sys.argv[3]))
+    if len(sys.argv) > 3 and sys.argv[1] == "--sweep":
+        sys.exit(sweep(sys.argv[2], sys.argv[3]))
     sys.exit(main())

@@ -1,0 +1,46 @@
+"""Mock HTTP sidecar for the compose e2e rig (reference parity:
+testing/docker/http-mock.Dockerfile + helpers/mock_server.py).
+
+Serves /ping for the compose healthcheck plus deterministic payloads the
+black-box suites fetch through OAGW / file-parser URL endpoints. Stdlib-only
+so the sidecar image needs no dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+
+class Handler(BaseHTTPRequestHandler):
+    def _send(self, status: int, body: bytes, ctype: str) -> None:
+        self.send_response(status)
+        self.send_header("content-type", ctype)
+        self.send_header("content-length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path == "/ping":
+            self._send(200, b"pong", "text/plain")
+        elif self.path == "/doc.txt":
+            self._send(200, b"hello from the mock sidecar", "text/plain")
+        elif self.path == "/doc.html":
+            self._send(200, b"<html><body><h1>Mock</h1><p>body</p></body></html>",
+                       "text/html")
+        elif self.path.startswith("/api/"):
+            self._send(200, json.dumps({
+                "path": self.path,
+                "auth": self.headers.get("Authorization"),
+            }).encode(), "application/json")
+        else:
+            self._send(404, b"not found", "text/plain")
+
+    def log_message(self, fmt: str, *args) -> None:  # quiet healthcheck spam
+        pass
+
+
+if __name__ == "__main__":
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 8087
+    HTTPServer(("0.0.0.0", port), Handler).serve_forever()

@@ -1,0 +1,192 @@
+"""Deployment-artifact black-box suite (SURVEY §4 tier-4 Docker-mode analogue).
+
+Reference: testing/docker/docker-compose.yml + hyperspot.Dockerfile drive the
+server as a deployable artifact — configuration arrives ONLY via `APP__*` env
+overrides (docker-compose.yml:27-29), never via files baked into the test
+harness. This suite proves the same properties without a container runtime:
+
+- the server runs as a REAL child process (`python -m cyberfabric_core_tpu.server`)
+  from a foreign working directory (as an installed artifact would);
+- the entire deployment config — bind address, auth mode, tenant tree, model
+  catalog — is injected via the `APP__SECTION__...` env convention (§8.6);
+- /healthz gates readiness the way the compose healthcheck does;
+- the serving surface works over plain HTTP (chat completion, SSE `[DONE]`);
+- SIGTERM produces a graceful exit (compose `stop_grace_period` contract).
+
+The containerized version of this same flow lives in deploy/docker-compose.yml
+and runs in CI's deploy-e2e job (this image has no container runtime).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _deploy_env(tmp_path, port: int) -> dict:
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # single CPU device is plenty; 8 slows boot
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO,
+        # the full deployment config, env-only (docker-compose.yml:27-29 parity).
+        # Any modules.* entry switches module selection from "all registered"
+        # to "listed only", so a deployment manifest enumerates its module set
+        # explicitly — same as the reference's feature-gated registered_modules.
+        **{f"APP__MODULES__{m.upper()}__ENABLED": "true" for m in (
+            "api_gateway", "authn_resolver", "authz_resolver", "credstore",
+            "file_parser", "file_storage", "llm_gateway", "model_registry",
+            "module_orchestrator", "monitoring", "nodes_registry", "oagw",
+            "serverless_runtime", "tenant_resolver", "types", "types_registry",
+            "user_settings")},
+        "APP__SERVER__HOME_DIR": str(tmp_path / "home"),
+        "APP__LOGGING__LEVEL": "warning",
+        "APP__MODULES__API_GATEWAY__CONFIG__BIND_ADDR": f"127.0.0.1:{port}",
+        "APP__MODULES__AUTHN_RESOLVER__CONFIG__MODE": "accept_all",
+        "APP__MODULES__AUTHN_RESOLVER__CONFIG__DEFAULT_TENANT": "default",
+        "APP__MODULES__TENANT_RESOLVER__CONFIG__SINGLE_TENANT": "default",
+        # env values are YAML-parsed, so a structured catalog rides one var
+        "APP__MODULES__MODEL_REGISTRY__CONFIG__MODELS": (
+            "[{provider_slug: local, provider_model_id: tiny-llama, "
+            "approval_state: approved, managed: true, architecture: llama, "
+            "capabilities: {chat: true, streaming: true}, "
+            "engine_options: {model_config: tiny-llama, max_seq_len: 128, "
+            "max_batch: 2, decode_chunk: 4}}]"),
+    })
+    return env
+
+
+def _get(url: str, timeout: float = 10.0) -> tuple[int, bytes]:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _post_json(url: str, body: dict, timeout: float = 180.0) -> tuple[int, bytes]:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"content-type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+@pytest.fixture(scope="module")
+def deployed(tmp_path_factory):
+    """One env-only child-process deployment shared by the module's tests."""
+    tmp_path = tmp_path_factory.mktemp("deploy")
+    port = _free_port()
+    env = _deploy_env(tmp_path, port)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cyberfabric_core_tpu.server", "run", "--mock"],
+        env=env, cwd=str(tmp_path),  # foreign cwd: artifact, not checkout
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + 180
+    last_err = None
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            out = proc.stdout.read() if proc.stdout else ""
+            raise AssertionError(
+                f"server exited {proc.returncode} during boot:\n{out[-4000:]}")
+        try:
+            status, _ = _get(f"{base}/healthz", timeout=5)
+            if status == 200:
+                break
+        except (urllib.error.URLError, OSError) as e:
+            last_err = e
+            time.sleep(1.0)
+    else:
+        proc.send_signal(signal.SIGTERM)
+        raise AssertionError(f"/healthz never came up: {last_err}")
+    yield proc, base
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_env_only_boot_and_health(deployed):
+    _, base = deployed
+    status, body = _get(f"{base}/health")
+    assert status == 200
+    health = json.loads(body)
+    assert health["status"] == "ok"
+    for mod in ("api_gateway", "llm_gateway", "model_registry"):
+        assert mod in health["modules"]
+
+
+def test_env_configured_chat_completion(deployed):
+    """The env-var model catalog is live: a chat completion round-trips."""
+    _, base = deployed
+    status, body = _post_json(f"{base}/v1/chat/completions", {
+        "model": "local::tiny-llama",
+        "messages": [{"role": "user",
+                      "content": [{"type": "text", "text": "ping"}]}],
+        "max_tokens": 4})
+    assert status == 200
+    out = json.loads(body)
+    assert out["model_used"] == "local::tiny-llama"
+    assert out["usage"]["output_tokens"] >= 1
+
+
+def test_sse_stream_terminates_with_done(deployed):
+    _, base = deployed
+    req = urllib.request.Request(
+        f"{base}/v1/chat/completions",
+        data=json.dumps({
+            "model": "local::tiny-llama", "stream": True,
+            "messages": [{"role": "user",
+                          "content": [{"type": "text", "text": "hi"}]}],
+            "max_tokens": 4}).encode(),
+        headers={"content-type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=180) as resp:
+        assert resp.status == 200
+        assert resp.headers["content-type"].startswith("text/event-stream")
+        payload = resp.read().decode()
+    frames = [ln for ln in payload.splitlines() if ln.startswith("data: ")]
+    assert frames and frames[-1] == "data: [DONE]"
+    first = json.loads(frames[0][len("data: "):])
+    assert first["delta"].get("role") == "assistant"
+
+
+def test_print_config_shows_env_overrides(tmp_path):
+    """--print-config proves the APP__* layer is applied (and redacts)."""
+    port = _free_port()
+    env = _deploy_env(tmp_path, port)
+    env["APP__MODULES__CREDSTORE__CONFIG__MASTER_KEY"] = "super-secret-value"
+    out = subprocess.run(
+        [sys.executable, "-m", "cyberfabric_core_tpu.server", "run",
+         "--print-config"],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    cfg = json.loads(out.stdout)
+    assert cfg["modules"]["api_gateway"]["config"]["bind_addr"] == \
+        f"127.0.0.1:{port}"
+    models = cfg["modules"]["model_registry"]["config"]["models"]
+    assert models[0]["provider_model_id"] == "tiny-llama"
+    # secretish keys never print in clear text (dump.rs redaction parity)
+    assert "super-secret-value" not in out.stdout
+
+
+def test_sigterm_graceful_shutdown(deployed):
+    """SIGTERM drains and exits 0 (compose stop_grace_period contract).
+    Runs last: the shared deployment is torn down here on purpose."""
+    proc, base = deployed
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(30) == 0
