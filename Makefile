@@ -12,8 +12,9 @@ safety: lint fuzz sanitizers contracts  ## the full local gate
 lint:  ## architectural lints (dylint equivalent: L1-L7 incl. DE07/DE08)
 	$(PY) -m pytest tests/test_arch_lint.py -q
 
-fuzz:  ## OData parser property-fuzz (ClusterFuzzLite equivalent), deeper than CI
+fuzz:  ## parser fuzzing: property layer + coverage-guided mutation w/ corpus
 	FUZZ_EXAMPLES=2000 $(PY) -m pytest tests/test_odata_fuzz.py -q
+	$(PY) -m fuzz.fuzz_odata --target all --time $${FUZZ_TIME:-20}
 
 sanitizers:  ## TSAN/ASAN exercise of the native allocator + radix tree
 	$(MAKE) -C native/fabric_host tsan asan

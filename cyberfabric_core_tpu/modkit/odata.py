@@ -309,6 +309,10 @@ def decode_cursor(cursor: str, expected_filter_hash: str) -> list[Any]:
         key, fhash = payload["k"], payload["f"]
     except Exception as e:
         raise ODataError(f"malformed cursor: {e}") from e
+    if not isinstance(key, list):
+        # fuzz-found: a crafted {"k": 5} payload would flow a non-list key
+        # into keyset-pagination SQL construction
+        raise ODataError("malformed cursor: key must be an array")
     if fhash != expected_filter_hash:
         raise ODataError("cursor does not match current filter/order (stale cursor)")
     return key

@@ -1,0 +1,286 @@
+"""Coverage-guided mutational fuzzing engine (cargo-fuzz/libFuzzer analogue).
+
+Reference parity: fuzz/fuzz_targets/*.rs + .clusterfuzzlite — the reference
+fuzzes its untrusted-input parsers with *coverage-guided* mutation and a
+persistent corpus, not just bounded random examples. This engine supplies the
+same feedback loop for the Python build:
+
+- **Coverage signal**: `sys.monitoring` (PEP 669, Python 3.12) LINE events,
+  restricted to the target modules; "edges" are (code, prev_line, line)
+  pairs, which approximate libFuzzer's edge coverage rather than bare line
+  sets.
+- **Corpus**: seeds live in-repo (`fuzz/corpus/<target>/`); any mutated input
+  that reaches new edges is written back, so coverage accumulates across CI
+  runs exactly like ClusterFuzzLite's corpus persistence.
+- **Mutations**: byte-level flips/inserts/deletes, block duplication, corpus
+  splicing, and dictionary token injection (libFuzzer's `-dict=`).
+- **Crashes**: any exception other than the target's declared expected error
+  types is a finding — the input is persisted to `fuzz/crashes/<target>/`
+  and the run fails loudly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+_TOOL_NAME = "cf-fuzz"
+
+
+class FuzzCrash(AssertionError):
+    """An input produced a non-declared exception (or invariant failure)."""
+
+    def __init__(self, data: bytes, exc: BaseException, path: Optional[str]):
+        super().__init__(
+            f"fuzz crash: {type(exc).__name__}: {exc} "
+            f"(input {data[:80]!r}{'…' if len(data) > 80 else ''}"
+            f"{', saved to ' + path if path else ''})")
+        self.data = data
+        self.exc = exc
+        self.path = path
+
+
+class _EdgeTracer:
+    """Edge coverage over a set of target filenames, campaign-scoped.
+
+    Uses sys.monitoring when available (3.12+): the tool stays registered for
+    the whole campaign and non-target code locations return DISABLE on first
+    hit, so after warm-up only target-module lines pay the callback cost.
+    Falls back to sys.settrace otherwise.
+    """
+
+    def __init__(self, target_files: set[str]) -> None:
+        self.target_files = target_files
+        self.edges: set[tuple[int, int, int]] = set()
+        self._last: dict[int, int] = {}
+        self._mon_id: Optional[int] = None
+        self._open = False
+
+    def _acquire(self) -> None:
+        mon = getattr(sys, "monitoring", None)
+        if mon is not None and self._mon_id is None:
+            for tool_id in range(1, 6):
+                if mon.get_tool(tool_id) is None:
+                    mon.use_tool_id(tool_id, _TOOL_NAME)
+                    self._mon_id = tool_id
+                    mon.register_callback(tool_id, mon.events.LINE, self._on_line)
+                    break
+            # a reused tool id must not inherit a previous campaign's DISABLE
+            # state on THIS campaign's target files
+            mon.restart_events()
+        self._open = True
+
+    def start(self) -> None:
+        """Arm tracing for one input (edges reset; disable-state persists)."""
+        if not self._open:
+            self._acquire()
+        self.edges = set()
+        self._last = {}
+        mon = getattr(sys, "monitoring", None)
+        if self._mon_id is not None and mon is not None:
+            mon.set_events(self._mon_id, mon.events.LINE)
+        else:  # pragma: no cover — py<3.12 fallback
+            sys.settrace(self._trace)
+
+    def stop(self) -> set[tuple[int, int, int]]:
+        """Disarm after one input; the tool id stays held for the campaign."""
+        mon = getattr(sys, "monitoring", None)
+        if self._mon_id is not None and mon is not None:
+            mon.set_events(self._mon_id, 0)
+        else:  # pragma: no cover
+            sys.settrace(None)
+        return self.edges
+
+    def close(self) -> None:
+        mon = getattr(sys, "monitoring", None)
+        if self._mon_id is not None and mon is not None:
+            mon.set_events(self._mon_id, 0)
+            mon.register_callback(self._mon_id, mon.events.LINE, None)
+            mon.free_tool_id(self._mon_id)
+            self._mon_id = None
+        self._open = False
+
+    def _on_line(self, code, line: int):
+        if code.co_filename in self.target_files:
+            key = id(code)
+            self.edges.add((hash(code.co_qualname), self._last.get(key, 0), line))
+            self._last[key] = line
+            return None
+        # non-target location: never fire here again this campaign
+        return sys.monitoring.DISABLE
+
+    def _trace(self, frame, event, arg):  # pragma: no cover — fallback
+        if event == "call":
+            return self._trace if frame.f_code.co_filename in self.target_files else None
+        if event == "line":
+            code = frame.f_code
+            key = id(code)
+            self.edges.add((hash(code.co_qualname), self._last.get(key, 0),
+                            frame.f_lineno))
+            self._last[key] = frame.f_lineno
+        return self._trace
+
+
+@dataclass
+class FuzzTarget:
+    """One fuzzable entrypoint.
+
+    ``run(data)`` executes the target and enforces its invariants; it must
+    raise only exceptions in ``expected`` for malformed input. ``dictionary``
+    holds grammar tokens the mutator splices in.
+    """
+
+    name: str
+    run: Callable[[bytes], None]
+    target_files: tuple[str, ...]
+    expected: tuple[type[BaseException], ...]
+    dictionary: tuple[bytes, ...] = ()
+    seeds: tuple[bytes, ...] = (b"",)
+
+
+@dataclass
+class FuzzStats:
+    executions: int = 0
+    corpus_size: int = 0
+    edges: int = 0
+    new_inputs: list[bytes] = field(default_factory=list)
+    crashes: list[FuzzCrash] = field(default_factory=list)
+
+
+class Fuzzer:
+    def __init__(self, target: FuzzTarget, corpus_dir: Optional[str] = None,
+                 crash_dir: Optional[str] = None, rng_seed: int = 0,
+                 max_len: int = 512) -> None:
+        self.target = target
+        self.corpus_dir = corpus_dir
+        self.crash_dir = crash_dir
+        self.rng = random.Random(rng_seed)
+        self.max_len = max_len
+        self.global_edges: set[tuple[int, int, int]] = set()
+        self.corpus: list[bytes] = []
+        self._tracer: Optional[_EdgeTracer] = None
+
+    # ---------------------------------------------------------------- corpus
+    def load_corpus(self) -> list[bytes]:
+        entries = list(self.target.seeds)
+        if self.corpus_dir and os.path.isdir(self.corpus_dir):
+            for fn in sorted(os.listdir(self.corpus_dir)):
+                path = os.path.join(self.corpus_dir, fn)
+                if os.path.isfile(path):
+                    with open(path, "rb") as f:
+                        entries.append(f.read())
+        return entries
+
+    def _persist(self, data: bytes) -> None:
+        if not self.corpus_dir:
+            return
+        os.makedirs(self.corpus_dir, exist_ok=True)
+        digest = hashlib.sha1(data).hexdigest()[:16]
+        path = os.path.join(self.corpus_dir, digest)
+        if not os.path.exists(path):
+            with open(path, "wb") as f:
+                f.write(data)
+
+    def _persist_crash(self, data: bytes) -> Optional[str]:
+        if not self.crash_dir:
+            return None
+        os.makedirs(self.crash_dir, exist_ok=True)
+        path = os.path.join(self.crash_dir,
+                            hashlib.sha1(data).hexdigest()[:16])
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+
+    # ------------------------------------------------------------- mutations
+    def mutate(self, data: bytes) -> bytes:
+        rng = self.rng
+        out = bytearray(data)
+        for _ in range(rng.randint(1, 4)):
+            choice = rng.randrange(7)
+            if choice == 0 and out:  # byte flip
+                i = rng.randrange(len(out))
+                out[i] ^= 1 << rng.randrange(8)
+            elif choice == 1:  # insert random byte
+                out.insert(rng.randint(0, len(out)), rng.randrange(256))
+            elif choice == 2 and out:  # delete span
+                i = rng.randrange(len(out))
+                del out[i:i + rng.randint(1, 8)]
+            elif choice == 3 and out:  # duplicate span
+                i = rng.randrange(len(out))
+                span = bytes(out[i:i + rng.randint(1, 16)])
+                out[i:i] = span
+            elif choice == 4 and self.target.dictionary:  # dictionary token
+                tok = rng.choice(self.target.dictionary)
+                i = rng.randint(0, len(out))
+                out[i:i] = tok
+            elif choice == 5 and self.corpus:  # splice with another entry
+                other = rng.choice(self.corpus)
+                if other:
+                    i = rng.randint(0, len(out))
+                    j = rng.randrange(len(other))
+                    out = bytearray(bytes(out[:i]) + other[j:])
+            elif out:  # ASCII-biased replace (parsers are text-heavy)
+                i = rng.randrange(len(out))
+                out[i] = rng.choice(b"()'\",.~ 0aZ_-%\x00\xff")
+        return bytes(out[: self.max_len])
+
+    # -------------------------------------------------------------- running
+    def _execute(self, data: bytes) -> tuple[set[tuple[int, int, int]], Optional[FuzzCrash]]:
+        tracer = self._tracer
+        if tracer is None:
+            tracer = self._tracer = _EdgeTracer(set(self.target.target_files))
+        tracer.start()
+        crash = None
+        try:
+            self.target.run(data)
+        except self.target.expected:
+            pass
+        except (KeyboardInterrupt, SystemExit):
+            raise  # operator abort, not a finding (finally still stops tracing)
+        except Exception as e:  # noqa: BLE001 — any other escape is a finding
+            crash = FuzzCrash(data, e, None)
+        finally:
+            edges = tracer.stop()
+        return edges, crash
+
+    def run(self, max_time_s: float = 10.0,
+            max_execs: Optional[int] = None) -> FuzzStats:
+        stats = FuzzStats()
+        deadline = time.monotonic() + max_time_s
+
+        def feed(data: bytes, persist: bool) -> None:
+            edges, crash = self._execute(data)
+            stats.executions += 1
+            if crash is not None:
+                crash_path = self._persist_crash(data)
+                stats.crashes.append(FuzzCrash(data, crash.exc, crash_path))
+                return
+            if edges - self.global_edges:
+                self.global_edges |= edges
+                self.corpus.append(data)
+                stats.new_inputs.append(data)
+                if persist:
+                    self._persist(data)
+
+        try:
+            for seed in self.load_corpus():
+                feed(seed, persist=False)
+
+            while time.monotonic() < deadline and not stats.crashes:
+                if max_execs is not None and stats.executions >= max_execs:
+                    break
+                base = self.rng.choice(self.corpus) if self.corpus else b""
+                feed(self.mutate(base), persist=True)
+        finally:
+            if self._tracer is not None:
+                self._tracer.close()
+                self._tracer = None
+
+        stats.corpus_size = len(self.corpus)
+        stats.edges = len(self.global_edges)
+        return stats

@@ -1,0 +1,162 @@
+"""Coverage-guided fuzz targets for the untrusted-input parsers.
+
+Reference parity: fuzz/fuzz_targets/fuzz_odata_{filter,orderby,cursor}.rs plus
+the file-parser goldens' security posture — every parser that turns untrusted
+bytes into structure gets a target. Run:
+
+    python -m fuzz.fuzz_odata --target all --time 30
+    make fuzz-coverage
+
+Each target declares its *only* acceptable failure mode (the typed error) and
+enforces the same invariants the hypothesis suite pins:
+- odata_filter: parse → to_sql yields only mapped column names, every user
+  value travels as a bind parameter (SQL-injection guard);
+- odata_orderby: field/direction tuples only;
+- odata_cursor: decode rejects tampering, round-trips what it accepts;
+- pdf: the content-stream parser never dies on crafted bytes with anything
+  but the typed unprocessable error (decompression bombs included).
+
+New-coverage inputs persist to fuzz/corpus/<target>/ (committed — the corpus
+accumulates across runs, ClusterFuzzLite-style); crashing inputs persist to
+fuzz/crashes/<target>/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:  # direct `python fuzz/fuzz_odata.py` invocation
+    sys.path.insert(0, ROOT)
+
+from cyberfabric_core_tpu.modkit import odata as odata_mod
+from cyberfabric_core_tpu.modkit.errors import ProblemError
+from cyberfabric_core_tpu.modkit.odata import (
+    ODataError, decode_cursor, encode_cursor, parse_filter, parse_orderby,
+    to_sql)
+from cyberfabric_core_tpu.modules import file_parser_backends as fp_mod
+from fuzz.engine import FuzzTarget, Fuzzer
+
+FIELD_MAP = {"name": "name_col", "age": "age_col", "city": "city_col"}
+_SQL_SHAPE = re.compile(
+    r"^[\sA-Za-z0-9_().,?=<>!]*$")  # mapped cols, ops, markers — no literals
+
+
+def _text(data: bytes) -> str:
+    return data.decode("utf-8", "replace")
+
+
+def run_filter(data: bytes) -> None:
+    expr = parse_filter(_text(data))
+    sql, params = to_sql(expr, FIELD_MAP)
+    # injection invariants: only mapped columns appear, every string value is
+    # a bind param (the SQL text never contains a quoted literal)
+    assert "'" not in sql and '"' not in sql, sql
+    assert _SQL_SHAPE.match(sql), sql
+    for word in re.findall(r"[A-Za-z_][A-Za-z0-9_]*", sql):
+        assert word in {"AND", "OR", "NOT", "IS", "NULL", "IN",
+                        *FIELD_MAP.values()}, (word, sql)
+    # determinism: same text → same SQL + params
+    sql2, params2 = to_sql(parse_filter(_text(data)), FIELD_MAP)
+    assert (sql, params) == (sql2, params2)
+
+
+def run_orderby(data: bytes) -> None:
+    fields = parse_orderby(_text(data))
+    for f in fields:
+        assert isinstance(f.descending, bool)
+        assert re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", f.field)
+
+
+def run_cursor(data: bytes) -> None:
+    text = _text(data)
+    try:
+        key = decode_cursor(text, "fuzzhash")
+    except ODataError:
+        # tampered/mismatched cursors must be rejected — and a cursor we
+        # minted ourselves must never be
+        return
+    # anything accepted must round-trip exactly
+    assert decode_cursor(encode_cursor(key, "fuzzhash"), "fuzzhash") == key
+
+
+def run_pdf(data: bytes) -> None:
+    doc = fp_mod.parse_pdf(data)
+    assert doc is not None
+
+
+def _odata_dict() -> tuple[bytes, ...]:
+    return (b" eq ", b" ne ", b" lt ", b" le ", b" gt ", b" ge ", b" and ",
+            b" or ", b"not ", b" in ", b"(", b")", b",", b"'", b"''", b"null",
+            b"true", b"false", b"name", b"age", b"city", b" asc", b" desc",
+            b"3.5", b"-7", b"'x''y'")
+
+
+TARGETS = {
+    "odata_filter": FuzzTarget(
+        name="odata_filter", run=run_filter,
+        target_files=(odata_mod.__file__,),
+        expected=(ODataError,), dictionary=_odata_dict(),
+        seeds=(b"", b"name eq 'a'", b"age gt 3 and (city eq 'x' or not age le 7)",
+               b"name in ('a','b') and age ne null")),
+    "odata_orderby": FuzzTarget(
+        name="odata_orderby", run=run_orderby,
+        target_files=(odata_mod.__file__,),
+        expected=(ODataError,), dictionary=_odata_dict(),
+        seeds=(b"", b"name asc", b"age desc, name", b"city, age desc")),
+    "odata_cursor": FuzzTarget(
+        name="odata_cursor", run=run_cursor,
+        target_files=(odata_mod.__file__,),
+        expected=(ODataError,),
+        dictionary=(b"=", b"eyJ", b"fuzzhash", b":", b"[", b"]", b'"'),
+        seeds=(b"", encode_cursor(["a", 3], "fuzzhash").encode())),
+    "pdf": FuzzTarget(
+        name="pdf", run=run_pdf,
+        target_files=(fp_mod.__file__,),
+        expected=(ProblemError,),
+        dictionary=(b"%PDF-1.4", b"obj", b"endobj", b"stream\n", b"endstream",
+                    b"/FlateDecode", b"BT", b"ET", b"Tj", b"TJ", b"Td",
+                    b"(text)", b"<< >>", b"trailer", b"%%EOF", b"\\(", b"<41>"),
+        seeds=(b"", b"%PDF-1.4\n1 0 obj\n<< >>\nstream\nBT (hi) Tj ET\n"
+               b"endstream\nendobj\ntrailer\n%%EOF")),
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--target", default="all", choices=["all", *TARGETS])
+    ap.add_argument("--time", type=float, default=20.0,
+                    help="seconds per target")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="mutation RNG seed (default: random)")
+    args = ap.parse_args(argv)
+
+    names = list(TARGETS) if args.target == "all" else [args.target]
+    rng_seed = args.seed if args.seed is not None else int.from_bytes(
+        os.urandom(4), "big")
+    failed = False
+    for name in names:
+        target = TARGETS[name]
+        fuzzer = Fuzzer(
+            target,
+            corpus_dir=os.path.join(ROOT, "fuzz", "corpus", name),
+            crash_dir=os.path.join(ROOT, "fuzz", "crashes", name),
+            rng_seed=rng_seed)
+        stats = fuzzer.run(max_time_s=args.time)
+        row = {"target": name, "execs": stats.executions,
+               "edges": stats.edges, "corpus": stats.corpus_size,
+               "new_inputs": len(stats.new_inputs),
+               "crashes": len(stats.crashes), "rng_seed": rng_seed}
+        print(json.dumps(row), flush=True)
+        for crash in stats.crashes:
+            failed = True
+            print(f"CRASH[{name}]: {crash}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

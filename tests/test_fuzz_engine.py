@@ -1,0 +1,113 @@
+"""Tests for the coverage-guided fuzzing engine + corpus regression.
+
+Reference parity: fuzz/ (cargo-fuzz targets + corpus, ClusterFuzzLite). Three
+properties pinned:
+
+1. the engine's coverage feedback actually guides: it finds a seeded
+   multi-stage bug that requires chaining discovered prefixes (which blind
+   random generation of the same budget essentially never hits);
+2. every committed corpus entry still satisfies its target's invariants
+   (corpus regression — a crash found once stays fixed);
+3. the real targets sustain a short run crash-free and grow coverage beyond
+   the seeds.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fuzz.engine import FuzzTarget, Fuzzer
+from fuzz.fuzz_odata import TARGETS
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------- guided-search proof
+
+_canary_file = __file__
+
+
+def _canary(data: bytes) -> None:
+    """Staged bug: each stage only becomes reachable once the previous
+    byte is present, so progress requires keeping coverage-new inputs."""
+    if len(data) > 0 and data[0] == ord("F"):
+        if len(data) > 1 and data[1] == ord("U"):
+            if len(data) > 2 and data[2] == ord("Z"):
+                if len(data) > 3 and data[3] == ord("!"):
+                    raise RuntimeError("canary reached")
+
+
+def test_engine_finds_staged_bug_via_coverage():
+    target = FuzzTarget(name="canary", run=_canary,
+                        target_files=(_canary_file,), expected=(ValueError,),
+                        dictionary=(b"F", b"U", b"Z", b"!"), seeds=(b"A",))
+    fuzzer = Fuzzer(target, rng_seed=7)
+    stats = fuzzer.run(max_time_s=30.0, max_execs=200_000)
+    assert stats.crashes, (
+        f"engine failed to reach the staged canary in {stats.executions} "
+        f"execs (corpus {stats.corpus_size}, edges {stats.edges})")
+    assert stats.crashes[0].data[:4] == b"FUZ!"
+
+
+def test_engine_treats_expected_errors_as_non_crashes():
+    def picky(data: bytes) -> None:
+        raise ValueError("always malformed")
+
+    target = FuzzTarget(name="picky", run=picky,
+                        target_files=(_canary_file,), expected=(ValueError,))
+    stats = Fuzzer(target, rng_seed=1).run(max_time_s=0.5, max_execs=200)
+    assert not stats.crashes
+    assert stats.executions >= 100
+
+
+def test_engine_persists_new_coverage_to_corpus(tmp_path):
+    corpus = tmp_path / "corpus"
+
+    def stepped(data: bytes) -> None:
+        if data.startswith(b"Q"):
+            pass  # a second branch worth keeping
+
+    target = FuzzTarget(name="stepped", run=stepped,
+                        target_files=(_canary_file,), expected=(ValueError,),
+                        dictionary=(b"Q",), seeds=(b"",))
+    stats = Fuzzer(target, corpus_dir=str(corpus), rng_seed=3).run(
+        max_time_s=5.0, max_execs=20_000)
+    assert stats.new_inputs
+    assert corpus.is_dir() and list(corpus.iterdir())
+
+
+# ----------------------------------------------------------- corpus regression
+
+
+@pytest.mark.parametrize("name", sorted(TARGETS))
+def test_committed_corpus_still_passes(name):
+    """Every persisted interesting input keeps satisfying the invariants."""
+    target = TARGETS[name]
+    corpus_dir = os.path.join(ROOT, "fuzz", "corpus", name)
+    entries = list(target.seeds)
+    if os.path.isdir(corpus_dir):
+        for fn in sorted(os.listdir(corpus_dir)):
+            with open(os.path.join(corpus_dir, fn), "rb") as f:
+                entries.append(f.read())
+    assert entries
+    for data in entries:
+        try:
+            target.run(data)
+        except target.expected:
+            pass  # the declared failure mode is fine
+
+
+# ------------------------------------------------------------------ short run
+
+
+@pytest.mark.parametrize("name", sorted(TARGETS))
+def test_real_targets_short_run_crash_free(name):
+    target = TARGETS[name]
+    fuzzer = Fuzzer(target, rng_seed=11)  # no corpus_dir: CI stays read-only
+    stats = fuzzer.run(max_time_s=2.0)
+    assert not stats.crashes, stats.crashes[0]
+    assert stats.executions > 200
+    assert stats.edges > 0
