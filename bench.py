@@ -175,8 +175,10 @@ def single(model: str, quant: str) -> int:
     prompt_len = 128 if on_tpu else 16
     gen_tokens = 256 if on_tpu else 16
     chunk = int(os.environ.get("BENCH_DECODE_CHUNK", "0")) or (64 if on_tpu else 4)
+    spec = os.environ.get("BENCH_SPEC", "0") == "1"
     cfg = EngineConfig(model=model, max_seq_len=max_seq, max_batch=1,
-                       decode_chunk=chunk, quantization=quant)
+                       decode_chunk=chunk, quantization=quant,
+                       speculative="ngram" if spec else "off")
 
     try:
         t0 = time.monotonic()
@@ -228,10 +230,11 @@ def single(model: str, quant: str) -> int:
         return 7 if kind == "oom" else 1
 
     precision = "int8-weights" if quant == "int8" else "bf16"
+    spec_label = ", ngram-speculative" if spec else ""
     result = {
         "metric": f"{model} greedy decode tokens/sec/chip "
                   f"({'TPU v5e-1' if on_tpu else 'cpu'}, {precision}, bs=1, "
-                  f"prompt {prompt_len}, synthetic weights)",
+                  f"prompt {prompt_len}, synthetic weights{spec_label})",
         "value": round(tps, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(100.0 / ttft_p50, 3),
@@ -317,6 +320,7 @@ def main() -> int:
     if result.get("tpu"):
         record_history("headline", result)
 
+
     # BASELINE config #2: continuous batching aggregate (the PAGED decode
     # path) — 8 concurrent streams, aggregate tokens/sec. Results go to
     # stderr + BENCH_AGGREGATE.json (stdout stays one JSON line). The paged
@@ -386,6 +390,21 @@ def main() -> int:
             _terminate_gracefully(proc)
         finally:
             _LIVE_CHILDREN.remove(proc)
+
+    # ngram-speculative variant of the winning config (separate evidence row,
+    # never the headline: on synthetic weights greedy output loops, which
+    # flatters prompt-lookup acceptance — honest labeling over a big number).
+    # Runs LAST and capped so it can never starve the baseline sections above.
+    if os.environ.get("BENCH_SPEC_VARIANT", "1") != "0" and \
+            result.get("tpu") and hard_deadline - time.monotonic() > 300:
+        model, quant = won
+        out = run_attempt(model, quant,
+                          min(420.0, hard_deadline - time.monotonic() - 70),
+                          env=dict(os.environ, BENCH_SPEC="1"))
+        if out and "error" not in out and out.get("tpu"):
+            record_history("speculative", out)
+            log(f"speculative variant: {out['value']} tok/s "
+                f"(vs headline {result['value']})")
     return 0
 
 

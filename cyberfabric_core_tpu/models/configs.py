@@ -94,6 +94,18 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         num_kv_heads=8, head_dim=128, max_position=8192, rope_theta=1000000.0,
         num_experts=8, experts_per_token=2,
     ),
+    "qwen2-7b": ModelConfig(
+        name="qwen2-7b", architecture="llama", vocab_size=152064,
+        hidden_size=3584, intermediate_size=18944, num_layers=28,
+        num_heads=28, num_kv_heads=4, head_dim=128, max_position=32768,
+        rope_theta=1e6, attention_bias=True,
+    ),
+    "tiny-qwen2": ModelConfig(
+        name="tiny-qwen2", architecture="llama", vocab_size=512,
+        hidden_size=64, intermediate_size=128, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, max_position=256, rope_theta=10000.0,
+        attention_bias=True, tie_embeddings=True,
+    ),
     "bge-base-en": ModelConfig(
         name="bge-base-en", architecture="bert", vocab_size=30522, hidden_size=768,
         intermediate_size=3072, num_layers=12, num_heads=12, num_kv_heads=12,

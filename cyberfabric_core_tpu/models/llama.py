@@ -57,7 +57,7 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     """Random-init parameters at model shape (bench/synthetic-weight path)."""
     H, I, V, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
     Dq, Dkv = cfg.num_heads * cfg.head_dim, cfg.num_kv_heads * cfg.head_dim
-    k = iter(jax.random.split(key, 12))
+    k = iter(jax.random.split(key, 20))
 
     def w(rng, *shape):
         # sample directly in the target dtype: a 70B-scale f32 intermediate would
@@ -73,6 +73,11 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         "wo": w(next(k), L, Dq, H),
         "mlp_norm": jnp.ones((L, H), dtype),
     }
+    if cfg.attention_bias:  # Qwen2-family: bias on q/k/v projections only
+        layers.update({
+            "bq": w(next(k), L, Dq), "bk": w(next(k), L, Dkv),
+            "bv": w(next(k), L, Dkv),
+        })
     if cfg.num_experts > 0:
         E = cfg.num_experts
         layers.update({
@@ -204,11 +209,18 @@ def _qkv_proj(lp: dict, x: jnp.ndarray, cfg: ModelConfig,
     wk_m, wk_s = _wmat(lp["wk"], x.dtype)
     wv_m, wv_s = _wmat(lp["wv"], x.dtype)
     q = _scaled(jnp.einsum("bth,hd->btd", x, wq_m,
-                preferred_element_type=jnp.float32), wq_s).astype(x.dtype)
+                preferred_element_type=jnp.float32), wq_s)
     kproj = _scaled(jnp.einsum("bth,hd->btd", x, wk_m,
-                    preferred_element_type=jnp.float32), wk_s).astype(x.dtype)
+                    preferred_element_type=jnp.float32), wk_s)
     vproj = _scaled(jnp.einsum("bth,hd->btd", x, wv_m,
-                    preferred_element_type=jnp.float32), wv_s).astype(x.dtype)
+                    preferred_element_type=jnp.float32), wv_s)
+    if cfg.attention_bias:  # Qwen2-family q/k/v bias (biases stay unquantized)
+        q = q + lp["bq"]
+        kproj = kproj + lp["bk"]
+        vproj = vproj + lp["bv"]
+    q = q.astype(x.dtype)
+    kproj = kproj.astype(x.dtype)
+    vproj = vproj.astype(x.dtype)
     q = q.reshape(B, T, Hq, D)
     kproj = kproj.reshape(B, T, Hkv, D)
     vproj = vproj.reshape(B, T, Hkv, D)

@@ -255,6 +255,8 @@ class LocalTpuWorker(LlmWorkerApi):
             quantization=opts.pop("quantization", "none"),
             prefix_cache_pages=int(opts.pop("prefix_cache_pages", default_pages)),
             prefix_page_size=page_size,
+            speculative=opts.pop("speculative", "off"),
+            spec_k=int(opts.pop("spec_k", 8)),
         )
         params = None
         tokenizer: Tokenizer
@@ -275,6 +277,12 @@ class LocalTpuWorker(LlmWorkerApi):
                 eng_cfg = EngineConfig(**{**eng_cfg.__dict__,
                                           "eos_token_ids": (tokenizer.eos_id,)})
         mode = self._config.get("scheduler", "continuous")
+        if eng_cfg.speculative != "off" and mode == "continuous":
+            logger.warning(
+                "engine_options.speculative=%r is inert under the continuous "
+                "scheduler (speculation is a lockstep bs=1 greedy path); use "
+                "scheduler: lockstep for this model or drop the option",
+                eng_cfg.speculative)
         if mode == "continuous":
             scheduler = ContinuousBatchingEngine(eng_cfg, params=params)
             logger.info("continuous engine ready for %s (%s, slots=%d, max_seq=%d)",

@@ -57,6 +57,11 @@ def llama_param_shardings(cfg: ModelConfig, mesh: Mesh,
             "down": ns(None, "tp", None),
         },
     }
+    if cfg.attention_bias:
+        # bias vectors follow their projection's OUTPUT sharding
+        tree["layers"].update({
+            "bq": ns(None, "tp"), "bk": ns(None, "tp"), "bv": ns(None, "tp"),
+        })
     if not cfg.tie_embeddings:
         tree["lm_head"] = ns_global(None, "tp")  # vocab-sharded head
     if cfg.num_experts > 0:

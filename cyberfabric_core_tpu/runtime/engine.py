@@ -69,6 +69,13 @@ class EngineConfig:
     prefix_page_size: int = 64
     #: weight-only quantization: "none" | "int8" (halves HBM + decode traffic)
     quantization: str = "none"
+    #: speculative decoding: "off" | "ngram" (prompt-lookup drafting + one
+    #: fused [1, k+1] verify forward; greedy bs=1 only, lossless — see
+    #: runtime/speculative.py). Non-eligible requests fall back silently.
+    speculative: str = "off"
+    spec_k: int = 8
+    spec_max_ngram: int = 3
+    spec_min_ngram: int = 1
 
     def resolve_use_flash(self) -> bool:
         if self.use_flash is not None:
@@ -191,6 +198,10 @@ class InferenceEngine:
         self._compiled_prefill: dict[tuple[int, int], Callable] = {}
         self._decode_fn = self._build_decode(max(1, config.decode_chunk))
         self._decode_tail_fn: Optional[Callable] = None  # k=1, built on demand
+        self._verify_fn: Optional[Callable] = None  # spec decode, on demand
+        #: cumulative speculative-decoding counters (observability surface)
+        self.spec_stats = {"verify_calls": 0, "drafted": 0, "accepted": 0,
+                           "spec_tokens": 0, "fallback_steps": 0}
         self.last_prefill_compile_s: float = 0.0
 
     # ------------------------------------------------------------------ jit builders
@@ -409,27 +420,97 @@ class InferenceEngine:
                     done[i] = fin is not None
                     yield StepEvent(i, tok, fin)
 
-        while not all(done) and steps < max_steps:
-            # a chunk writes k cache slots from the current length; it must fit
-            # entirely (chunks are static-shaped — no partial dispatch)
-            if int(lengths_np.max()) + k_steps > self.config.max_seq_len:
-                break
-            chunk = run_chunk(self._decode_fn, k_steps)
-            next_fits = int(lengths_np.max()) + k_steps <= self.config.max_seq_len
-            # once full chunks stop fitting, the k=1 tail decoder continues below
-            tail_will_run = (not next_fits
-                             and int(lengths_np.max()) < self.config.max_seq_len)
-            yield from emit_chunk(chunk, k_steps, next_fits or tail_will_run)
+        def spec_loop():
+            """Prompt-lookup speculative decode (greedy bs=1, lossless —
+            runtime/speculative.py). Each iteration commits 1..spec_k+1
+            tokens for one device round trip."""
+            nonlocal cache
+            from .speculative import NgramProposer, accept_length, build_verify_fn
 
-        # tail: single-step decode fills the last < decode_chunk slots of the
-        # window so near-capacity prompts still decode to the brim
-        while not all(done) and steps < max_steps \
-                and int(lengths_np.max()) < self.config.max_seq_len:
-            if self._decode_tail_fn is None:
-                self._decode_tail_fn = self._build_decode(1)
-            chunk = run_chunk(self._decode_tail_fn, 1)
-            next_fits = int(lengths_np.max()) < self.config.max_seq_len
-            yield from emit_chunk(chunk, 1, next_fits)
+            spec_k = max(1, self.config.spec_k)
+            if self._verify_fn is None:
+                self._verify_fn = build_verify_fn(
+                    self.model_config, spec_k, self.rope_tables)
+            proposer = NgramProposer(self.config.spec_max_ngram,
+                                     self.config.spec_min_ngram, spec_k)
+            last_tok = int(cur[0])
+            proposer.extend(list(prompts[0]) + [last_tok])
+            L = int(lengths_np[0])
+            max_seq = self.config.max_seq_len
+
+            while not done[0] and emitted[0] < max_new[0] and L < max_seq:
+                drafts = (proposer.propose()
+                          if L + spec_k + 1 <= max_seq else None)
+                if drafts is None:
+                    # no recurring n-gram (or window tail): plain single step
+                    if self._decode_tail_fn is None:
+                        self._decode_tail_fn = self._build_decode(1)
+                    self.spec_stats["fallback_steps"] += 1
+                    chunk_dev, kc, vc, _, self._rng = self._decode_tail_fn(
+                        self.params, cache[0], cache[1],
+                        jnp.asarray([last_tok], jnp.int32),
+                        jnp.asarray([L], jnp.int32),
+                        self._rng, temperature, top_p, top_k)
+                    cache = (kc, vc)
+                    toks = [int(np.asarray(chunk_dev)[0, 0])]
+                    L += 1
+                else:
+                    # pad to the static draft width; a padded token only gets
+                    # accepted when it IS the greedy argmax, so padding never
+                    # changes output
+                    drafts = (drafts + [drafts[-1]] * spec_k)[:spec_k]
+                    tokens = jnp.asarray([[last_tok] + drafts], jnp.int32)
+                    outs_dev, kc, vc = self._verify_fn(
+                        self.params, cache[0], cache[1], tokens,
+                        jnp.asarray([L], jnp.int32))
+                    cache = (kc, vc)
+                    outs = np.asarray(outs_dev, np.int32)[0].tolist()
+                    a = accept_length(drafts, outs)
+                    toks = drafts[:a] + [outs[a]]
+                    self.spec_stats["verify_calls"] += 1
+                    self.spec_stats["drafted"] += spec_k
+                    self.spec_stats["accepted"] += a
+                    self.spec_stats["spec_tokens"] += len(toks)
+                    L += a + 1
+                proposer.extend(toks)
+                for j, tok in enumerate(toks):
+                    if done[0]:
+                        break  # tokens past a finish are discarded
+                    emitted[0] += 1
+                    last_tok = tok
+                    fin = classify(0, tok)
+                    if fin is None and j == len(toks) - 1 and L >= max_seq:
+                        fin = "length"  # window exhausted on this token
+                    done[0] = fin is not None
+                    yield StepEvent(0, tok, fin)
+            lengths_np[0] = L  # keep the shared epilogue's view consistent
+
+        if (self.config.speculative == "ngram" and B == 1
+                and all(s.temperature == 0.0 for s in per_req)
+                and not all(done)):
+            yield from spec_loop()
+        else:
+            while not all(done) and steps < max_steps:
+                # a chunk writes k cache slots from the current length; it must
+                # fit entirely (chunks are static-shaped — no partial dispatch)
+                if int(lengths_np.max()) + k_steps > self.config.max_seq_len:
+                    break
+                chunk = run_chunk(self._decode_fn, k_steps)
+                next_fits = int(lengths_np.max()) + k_steps <= self.config.max_seq_len
+                # once full chunks stop fitting, the k=1 tail decoder continues
+                tail_will_run = (not next_fits
+                                 and int(lengths_np.max()) < self.config.max_seq_len)
+                yield from emit_chunk(chunk, k_steps, next_fits or tail_will_run)
+
+            # tail: single-step decode fills the last < decode_chunk slots of
+            # the window so near-capacity prompts still decode to the brim
+            while not all(done) and steps < max_steps \
+                    and int(lengths_np.max()) < self.config.max_seq_len:
+                if self._decode_tail_fn is None:
+                    self._decode_tail_fn = self._build_decode(1)
+                chunk = run_chunk(self._decode_tail_fn, 1)
+                next_fits = int(lengths_np.max()) < self.config.max_seq_len
+                yield from emit_chunk(chunk, 1, next_fits)
 
         # epilogue: any still-active row gets a token-less finish event so every
         # stream terminates with a reason

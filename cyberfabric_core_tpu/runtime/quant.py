@@ -88,6 +88,12 @@ def init_params_quantized(cfg, key: jax.Array, dtype=jnp.bfloat16) -> dict[str, 
         "wo": w(L, Dq, H),
         "mlp_norm": jnp.ones((L, H), dtype),
     }
+    if cfg.attention_bias:  # Qwen2-family; biases stay unquantized (tiny)
+        layers.update({
+            "bq": jax.random.normal(next(keys), (L, Dq), dtype) * 0.02,
+            "bk": jax.random.normal(next(keys), (L, Dkv), dtype) * 0.02,
+            "bv": jax.random.normal(next(keys), (L, Dkv), dtype) * 0.02,
+        })
     if cfg.num_experts > 0:
         E = cfg.num_experts
         layers["router"] = (jax.random.normal(next(keys), (L, H, E), dtype)

@@ -1,0 +1,124 @@
+"""Speculative decoding: n-gram prompt-lookup drafting + single-pass verify.
+
+TPU-first design of the standard draft/verify loop (the technique vLLM ships
+as "prompt lookup decoding" / ngram speculation; no reference counterpart —
+the reference delegates inference to external providers, SURVEY §0):
+
+- **Drafting is free**: instead of a draft model, the proposer looks the
+  trailing n-gram of the sequence up in its own history (prompt + generated
+  text repeats itself: quotes, code identifiers, RAG copies). Host-side, no
+  device work at all.
+- **Verification is one fused forward**: the k drafted tokens plus the last
+  committed token run as ONE [B, k+1] forward with the standard per-position
+  causal mask — on a bandwidth-bound decode, weights dominate HBM traffic,
+  so verifying k+1 positions costs nearly the same as decoding one token.
+  Greedy acceptance: drafts match while ``draft[i] == argmax[i-1]``; the
+  verify output at the last accepted position is a free "bonus" token, so
+  every call commits between 1 and k+1 tokens.
+- **Static shapes**: the verify program is jitted once for a fixed k
+  (XLA-friendly); when the sequence window can no longer fit k+1 slots the
+  engine falls back to its single-step tail decoder.
+- **Cache rollback is free**: rejected positions' KV entries sit beyond the
+  committed length, are masked out of attention (`ops/attention.py:48`), and
+  get overwritten by the next verify pass at the same offsets.
+
+Greedy only (temperature 0): lossless — emitted tokens are bit-identical to
+plain decode (pinned by tests/test_speculative.py parity tests).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models import llama
+from ..models.configs import ModelConfig
+
+
+class NgramProposer:
+    """Incremental n-gram index over one sequence's tokens.
+
+    For each n in [min_n, max_n], remembers the position right after the most
+    recent occurrence of every n-gram. ``propose`` matches the current tail
+    n-gram (longest n first) and copies up to k tokens that followed its
+    previous occurrence.
+    """
+
+    def __init__(self, max_n: int = 3, min_n: int = 1, k: int = 8) -> None:
+        if not 1 <= min_n <= max_n:
+            raise ValueError(f"bad n-gram range [{min_n}, {max_n}]")
+        self.max_n = max_n
+        self.min_n = min_n
+        self.k = k
+        self.tokens: list[int] = []
+        #: ngram -> (end of latest occurrence, end of previous occurrence).
+        #: The sequence tail is always its own latest occurrence, so propose()
+        #: reads the PREVIOUS slot.
+        self._index: dict[tuple[int, ...], tuple[int, Optional[int]]] = {}
+
+    def extend(self, tokens: list[int]) -> None:
+        for tok in tokens:
+            self.tokens.append(tok)
+            end = len(self.tokens)
+            for n in range(self.min_n, self.max_n + 1):
+                if end >= n:
+                    gram = tuple(self.tokens[end - n:end])
+                    prev = self._index.get(gram)
+                    self._index[gram] = (end, prev[0] if prev else None)
+
+    def propose(self) -> Optional[list[int]]:
+        """Up to k draft tokens, or None when no tail n-gram has recurred."""
+        end = len(self.tokens)
+        for n in range(self.max_n, self.min_n - 1, -1):
+            if end < n:
+                continue
+            hit = self._index.get(tuple(self.tokens[end - n:end]))
+            if hit is None:
+                continue
+            latest, prev = hit
+            pos = prev if latest == end else latest
+            if pos is not None:
+                drafts = self.tokens[pos:pos + self.k]
+                if drafts:
+                    return drafts
+        return None
+
+
+def build_verify_fn(model_config: ModelConfig, k: int,
+                    rope_tables) -> Callable:
+    """Jit the [B, k+1] greedy verify forward.
+
+    Inputs: tokens[:, 0] is the last committed token (its KV is not yet in
+    cache), tokens[:, 1:] are the k drafts. The forward writes all k+1 KV
+    entries at positions lengths..lengths+k and returns the per-position
+    argmax — out[:, i] is the model's next token after consuming
+    tokens[:, :i+1]. The caller accepts the longest matching draft prefix and
+    treats later cache entries as garbage (masked, then overwritten).
+    """
+
+    def verify(params, k_cache, v_cache, tokens, lengths):
+        B, T = tokens.shape
+        positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        hidden, cache = llama.forward(
+            params, model_config, tokens, positions, (k_cache, v_cache),
+            lengths, rope_tables)
+        H = hidden.shape[-1]
+        logits = llama.lm_head_logits(
+            params, model_config, hidden.reshape(B * T, H))
+        out = jnp.argmax(logits, axis=-1).astype(jnp.int32).reshape(B, T)
+        return out, cache[0], cache[1]
+
+    return jax.jit(verify, donate_argnums=(1, 2))
+
+
+def accept_length(drafts: list[int], outs: list[int]) -> int:
+    """Greedy acceptance: number of leading drafts equal to the model's own
+    argmax continuation (outs[i] is the model token after draft prefix i)."""
+    a = 0
+    for i, d in enumerate(drafts):
+        if d != outs[i]:
+            break
+        a += 1
+    return a

@@ -34,6 +34,10 @@ _LLAMA_MAP: dict[str, tuple[str, bool]] = {
     "layers.wq": ("model.layers.{i}.self_attn.q_proj.weight", True),
     "layers.wk": ("model.layers.{i}.self_attn.k_proj.weight", True),
     "layers.wv": ("model.layers.{i}.self_attn.v_proj.weight", True),
+    # Qwen2-family attention biases (present only when cfg.attention_bias)
+    "layers.bq": ("model.layers.{i}.self_attn.q_proj.bias", False),
+    "layers.bk": ("model.layers.{i}.self_attn.k_proj.bias", False),
+    "layers.bv": ("model.layers.{i}.self_attn.v_proj.bias", False),
     "layers.wo": ("model.layers.{i}.self_attn.o_proj.weight", True),
     "layers.mlp_norm": ("model.layers.{i}.post_attention_layernorm.weight", False),
     "layers.gate": ("model.layers.{i}.mlp.gate_proj.weight", True),
@@ -114,6 +118,9 @@ def load_llama_params(
         if leaf == "lm_head":
             if cfg.tie_embeddings or not idx.has(tmpl):
                 continue
+        if leaf in ("layers.bq", "layers.bk", "layers.bv") \
+                and not cfg.attention_bias:
+            continue
         if "{i}" not in tmpl:
             t = idx.load(tmpl)
             params_leaf = t.T if transpose else t

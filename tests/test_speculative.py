@@ -1,0 +1,161 @@
+"""Speculative decoding (prompt-lookup drafting + fused verify) tests.
+
+The critical property is LOSSLESSNESS: greedy output with speculation on is
+bit-identical to plain greedy decode — acceptance only ever admits tokens
+that equal the model's own argmax (runtime/speculative.py). Plus proposer
+unit behavior, finish-reason parity at stops/window-end, and eligibility
+fallback for sampled requests.
+"""
+
+import numpy as np
+import pytest
+
+from cyberfabric_core_tpu.models import get_config, llama
+from cyberfabric_core_tpu.runtime import EngineConfig, InferenceEngine, SamplingParams
+from cyberfabric_core_tpu.runtime.speculative import NgramProposer, accept_length
+
+import jax
+
+
+# ------------------------------------------------------------------- proposer
+
+
+def test_proposer_matches_longest_recent_ngram():
+    p = NgramProposer(max_n=3, min_n=1, k=4)
+    p.extend([1, 2, 3, 9, 1, 2, 3])
+    # tail trigram (1,2,3) matched its earlier occurrence -> continues with 9…
+    assert p.propose() == [9, 1, 2, 3]
+
+
+def test_proposer_prefers_most_recent_occurrence():
+    p = NgramProposer(max_n=2, min_n=1, k=2)
+    p.extend([7, 1, 7, 2, 7])
+    # unigram (7,): latest EARLIER occurrence is index 2 -> follows with 2, 7
+    assert p.propose() == [2, 7]
+
+
+def test_proposer_no_match_returns_none():
+    p = NgramProposer(max_n=3, min_n=2, k=4)
+    p.extend([1, 2, 3, 4, 5])
+    assert p.propose() is None
+
+
+def test_proposer_short_continuation_truncates():
+    p = NgramProposer(max_n=1, min_n=1, k=8)
+    p.extend([5, 6, 5])
+    assert p.propose() == [6, 5]  # only two tokens follow the match
+
+
+def test_accept_length():
+    assert accept_length([1, 2, 3], [1, 2, 3, 4]) == 3
+    assert accept_length([1, 9, 3], [1, 2, 3, 4]) == 1
+    assert accept_length([9, 2, 3], [1, 2, 3, 4]) == 0
+    assert accept_length([], [4]) == 0
+
+
+# --------------------------------------------------------------------- parity
+
+
+@pytest.fixture(scope="module")
+def shared_params():
+    cfg = get_config("tiny-llama")
+    return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _engine(shared, speculative: str, **kw) -> InferenceEngine:
+    cfg, params = shared
+    defaults = dict(model="tiny-llama", max_seq_len=128, max_batch=2,
+                    decode_chunk=4, use_flash=False, speculative=speculative,
+                    spec_k=6)
+    defaults.update(kw)
+    return InferenceEngine(EngineConfig(**defaults), model_config=cfg,
+                           params=params, seed=0)
+
+
+def _tokens(engine, prompt, **sampling_kw):
+    [res] = engine.generate([prompt], SamplingParams(
+        temperature=0.0, **sampling_kw))
+    return res.token_ids, res.finish_reason
+
+
+@pytest.mark.parametrize("prompt", [
+    [5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6],       # repetitive: drafts accepted
+    list(range(40, 72)),                      # no repeats: drafts rejected
+    [11, 3, 11, 3, 250, 11, 3, 11],
+])
+def test_greedy_parity_with_and_without_spec(shared_params, prompt):
+    base_toks, base_fin = _tokens(_engine(shared_params, "off"), prompt,
+                                  max_tokens=48)
+    spec = _engine(shared_params, "ngram")
+    spec_toks, spec_fin = _tokens(spec, prompt, max_tokens=48)
+    assert spec_toks == base_toks
+    assert spec_fin == base_fin
+    # the machinery actually ran (verify calls or explicit fallbacks)
+    assert spec.spec_stats["verify_calls"] + spec.spec_stats["fallback_steps"] > 0
+
+
+def test_spec_acceptance_happens_on_looping_output(shared_params):
+    """Greedy decode of a random-weight model settles into a cycle; once it
+    does, prompt-lookup drafts the cycle and verification accepts it. This is
+    exactly the bandwidth win the feature exists for."""
+    spec = _engine(shared_params, "ngram")
+    toks, _ = _tokens(spec, [9, 9, 9, 9], max_tokens=96)
+    assert len(toks) == 96
+    assert spec.spec_stats["accepted"] > 0, spec.spec_stats
+    # multi-token commits means fewer device calls than tokens
+    calls = spec.spec_stats["verify_calls"] + spec.spec_stats["fallback_steps"]
+    assert calls < 96, spec.spec_stats
+
+
+def test_stop_token_parity(shared_params):
+    """Pick a token the plain run emits mid-stream; both engines must stop
+    identically on it (stop token hidden from visible output)."""
+    base_toks, _ = _tokens(_engine(shared_params, "off"), [5, 6, 7, 5, 6],
+                           max_tokens=40)
+    stop = base_toks[len(base_toks) // 2]
+    base = _tokens(_engine(shared_params, "off"), [5, 6, 7, 5, 6],
+                   max_tokens=40, stop_token_ids=(stop,))
+    spec = _tokens(_engine(shared_params, "ngram"), [5, 6, 7, 5, 6],
+                   max_tokens=40, stop_token_ids=(stop,))
+    assert spec == base
+    assert base[1] == "stop"
+
+
+def test_window_end_parity(shared_params):
+    """Near max_seq_len both paths fill the window to the brim and finish
+    with 'length'."""
+    prompt = [3] * 20
+    base = _tokens(_engine(shared_params, "off", max_seq_len=40), prompt,
+                   max_tokens=500)
+    spec = _tokens(_engine(shared_params, "ngram", max_seq_len=40), prompt,
+                   max_tokens=500)
+    assert spec == base
+    assert base[1] == "length"
+    # prefill emits token 1 without consuming a decode slot; the 20 free
+    # window slots then host 20 decode inputs -> 21 visible tokens
+    assert len(base[0]) == 21
+
+
+def test_sampled_requests_fall_back_to_plain_decode(shared_params):
+    spec = _engine(shared_params, "ngram")
+    [res] = spec.generate([[5, 6, 7]], SamplingParams(
+        temperature=0.8, max_tokens=8, seed=1))
+    assert len(res.token_ids) == 8
+    assert spec.spec_stats["verify_calls"] == 0  # ineligible: not greedy
+
+
+def test_batch_requests_fall_back(shared_params):
+    spec = _engine(shared_params, "ngram")
+    results = spec.generate([[5, 6, 7], [8, 9]], SamplingParams(max_tokens=6))
+    assert all(len(r.token_ids) == 6 for r in results)
+    assert spec.spec_stats["verify_calls"] == 0  # ineligible: bs > 1
+
+
+def test_int8_spec_parity(shared_params):
+    """Speculation composes with weight-only int8 (the bench ladder's
+    configuration for the 8B north star)."""
+    base = _tokens(_engine(shared_params, "off", quantization="int8"),
+                   [5, 6, 7, 5, 6, 7, 5], max_tokens=32)
+    spec = _tokens(_engine(shared_params, "ngram", quantization="int8"),
+                   [5, 6, 7, 5, 6, 7, 5], max_tokens=32)
+    assert spec == base
