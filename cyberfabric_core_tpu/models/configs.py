@@ -30,6 +30,11 @@ class ModelConfig:
     tie_embeddings: bool = False
     sliding_window: Optional[int] = None  # Mistral-style SWA
     attention_bias: bool = False
+    # gemma-family knobs
+    hidden_act: str = "silu"          # "silu" (llama) | "gelu" (gemma GeGLU)
+    norm_weight_offset: float = 0.0   # gemma RMSNorm computes (offset + w) * x̂
+    embedding_multiplier: float = 1.0  # gemma scales embeddings by sqrt(H)
+    final_logit_softcap: float = 0.0  # gemma-2: logits = cap * tanh(logits/cap)
     # mixture-of-experts (0 = dense MLP)
     num_experts: int = 0
     experts_per_token: int = 2
@@ -41,6 +46,13 @@ class ModelConfig:
     layer_norm_eps: float = 1e-12
     type_vocab_size: int = 2
     pooling: str = "cls"  # bge uses CLS pooling + L2 norm
+
+    def __post_init__(self) -> None:
+        if self.hidden_act not in ("silu", "gelu", "gelu_pytorch_tanh"):
+            # fail at config time, not as silently-wrong activations at runtime
+            raise ValueError(
+                f"unknown hidden_act {self.hidden_act!r} "
+                "(supported: silu, gelu, gelu_pytorch_tanh)")
 
     @property
     def q_per_kv(self) -> int:
@@ -105,6 +117,21 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         hidden_size=64, intermediate_size=128, num_layers=2, num_heads=4,
         num_kv_heads=2, head_dim=16, max_position=256, rope_theta=10000.0,
         attention_bias=True, tie_embeddings=True,
+    ),
+    "gemma-7b": ModelConfig(
+        name="gemma-7b", architecture="llama", vocab_size=256000,
+        hidden_size=3072, intermediate_size=24576, num_layers=28,
+        num_heads=16, num_kv_heads=16, head_dim=256, max_position=8192,
+        rope_theta=10000.0, rms_norm_eps=1e-6, tie_embeddings=True,
+        hidden_act="gelu", norm_weight_offset=1.0,
+        embedding_multiplier=3072.0 ** 0.5,
+    ),
+    "tiny-gemma": ModelConfig(
+        name="tiny-gemma", architecture="llama", vocab_size=512,
+        hidden_size=64, intermediate_size=128, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, max_position=256, rope_theta=10000.0,
+        tie_embeddings=True, hidden_act="gelu", norm_weight_offset=1.0,
+        embedding_multiplier=8.0, final_logit_softcap=30.0,
     ),
     "bge-base-en": ModelConfig(
         name="bge-base-en", architecture="bert", vocab_size=30522, hidden_size=768,

@@ -46,6 +46,22 @@ def _scaled(y: jnp.ndarray, scale) -> jnp.ndarray:
     return y if scale is None else y * scale
 
 
+def _act(x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Gated-MLP activation: SiLU (llama family) or tanh-approx GeLU (gemma).
+    Unknown values are rejected at config time (ModelConfig.__post_init__)."""
+    if cfg.hidden_act in ("gelu", "gelu_pytorch_tanh"):
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _embed_scale(h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """gemma multiplies embeddings by sqrt(hidden_size) (in the activation
+    dtype, matching the reference checkpoints' bf16 rounding)."""
+    if cfg.embedding_multiplier != 1.0:
+        return h * jnp.asarray(cfg.embedding_multiplier, h.dtype)
+    return h
+
+
 def embed_lookup(embed, ids: jnp.ndarray, dtype) -> jnp.ndarray:
     if isinstance(embed, dict):  # {"qe","se"}: int8 rows with per-row scales
         rows = embed["qe"][ids].astype(jnp.float32) * embed["se"][ids][..., None]
@@ -128,7 +144,7 @@ def _moe_mlp_dense(x: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
                    preferred_element_type=jnp.float32), g_s)
     up = _scaled(jnp.einsum("bth,ehi->btei", x, u_m,
                  preferred_element_type=jnp.float32), u_s)
-    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    act = (_act(gate, cfg) * up).astype(x.dtype)
     expert_out = _scaled(jnp.einsum("btei,eih->bteh", act, d_m,
                          preferred_element_type=jnp.float32), d_s)
     return jnp.einsum("bteh,bte->bth", expert_out, weights.astype(jnp.float32))
@@ -189,7 +205,7 @@ def _moe_mlp(x: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
                    preferred_element_type=jnp.float32), g_s)
     up = _scaled(jnp.einsum("ech,ehi->eci", xb, u_m,
                  preferred_element_type=jnp.float32), u_s)
-    act = (jax.nn.silu(gate) * up).astype(x.dtype)
+    act = (_act(gate, cfg) * up).astype(x.dtype)
     expert_out = _scaled(jnp.einsum("eci,eih->ech", act, d_m,
                          preferred_element_type=jnp.float32), d_s)  # [E, C, H]
 
@@ -237,7 +253,7 @@ def _attn_out(lp: dict, h: jnp.ndarray, attn_flat: jnp.ndarray) -> jnp.ndarray:
 
 def _mlp_residual(lp: dict, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     """Post-attention norm + (MoE or dense) MLP + residual."""
-    x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+    x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_weight_offset)
     if cfg.num_experts > 0:
         return h + _moe_mlp(x, lp, cfg).astype(h.dtype)
     g_m, g_s = _wmat(lp["gate"], h.dtype)
@@ -247,7 +263,7 @@ def _mlp_residual(lp: dict, h: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
                    preferred_element_type=jnp.float32), g_s)
     up = _scaled(jnp.einsum("bth,hi->bti", x, u_m,
                  preferred_element_type=jnp.float32), u_s)
-    act = (jax.nn.silu(gate) * up).astype(h.dtype)
+    act = (_act(gate, cfg) * up).astype(h.dtype)
     return h + _scaled(jnp.einsum("bti,ih->bth", act, d_m,
                        preferred_element_type=jnp.float32), d_s).astype(h.dtype)
 
@@ -272,8 +288,8 @@ def forward(
     B, T = input_ids.shape
     Hq, D = cfg.num_heads, cfg.head_dim
 
-    h = embed_lookup(params["embed"], input_ids,
-                     params["final_norm"].dtype)  # [B, T, H] gather
+    h = _embed_scale(embed_lookup(params["embed"], input_ids,
+                     params["final_norm"].dtype), cfg)  # [B, T, H] gather
     kv_len_after = cache_start + T  # valid cache length after this step's insert
 
     # The cache rides the scan CARRY (not ys): XLA aliases while-loop carries
@@ -287,7 +303,7 @@ def forward(
     def layer_body(carry, xs):
         h, k_cache, v_cache = carry
         lp, layer = xs
-        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_weight_offset)
         q, kproj, vproj = _qkv_proj(lp, x, cfg, positions, cos_t, sin_t)
 
         k_cache = k_cache.at[layer, b_idx, t_idx].set(
@@ -317,7 +333,7 @@ def forward(
         layer_body, (h, k_cache, v_cache),
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, cfg.norm_weight_offset)
     return h, (k_cache, v_cache)
 
 
@@ -356,7 +372,7 @@ def forward_paged_decode(
     pid = jnp.take_along_axis(page_table, idx_page[:, None], axis=1)[:, 0]
     off = lengths % page_size
 
-    h = embed_lookup(params["embed"], input_ids, params["final_norm"].dtype)
+    h = _embed_scale(embed_lookup(params["embed"], input_ids, params["final_norm"].dtype), cfg)
 
     # pools ride the scan carry (in-place via while-loop aliasing) — the ys
     # form would re-materialize the WHOLE pool per layer per step, and the
@@ -364,7 +380,7 @@ def forward_paged_decode(
     def layer_body(carry, xs):
         h, k_pool, v_pool = carry
         lp, layer = xs
-        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_weight_offset)
         q, kproj, vproj = _qkv_proj(lp, x, cfg, positions, cos_t, sin_t)
 
         # scatter the new token into each slot's tail page (inactive slots all
@@ -385,7 +401,7 @@ def forward_paged_decode(
     (h, k_pool, v_pool), _ = jax.lax.scan(
         layer_body, (h, k_pool, v_pool),
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, cfg.norm_weight_offset)
     return h, (k_pool, v_pool)
 
 
@@ -432,20 +448,31 @@ def insert_slot_kv(
     )
 
 
+def _softcap(logits: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """gemma-2 final-logit soft capping: cap * tanh(logits / cap)."""
+    if cfg.final_logit_softcap > 0.0:
+        cap = cfg.final_logit_softcap
+        return cap * jnp.tanh(logits / cap)
+    return logits
+
+
 def lm_head_logits(params: Params, cfg: ModelConfig, hidden: jnp.ndarray) -> jnp.ndarray:
     """hidden [B, H] (or [B, T, H]) → logits in f32."""
     head = params["embed"] if cfg.tie_embeddings else params["lm_head"]
     if isinstance(head, dict):
         if "qe" in head:  # tied quantized embed: rows [V, H] with per-row scales
             logits = jnp.einsum("...h,vh->...v", hidden, head["qe"].astype(hidden.dtype),
-                                preferred_element_type=jnp.float32)
-            return logits * head["se"]
-        logits = jnp.einsum("...h,hv->...v", hidden, head["q"].astype(hidden.dtype),
+                                preferred_element_type=jnp.float32) * head["se"]
+        else:
+            logits = jnp.einsum("...h,hv->...v", hidden, head["q"].astype(hidden.dtype),
+                                preferred_element_type=jnp.float32) * head["s"]
+    else:
+        if cfg.tie_embeddings:
+            head = head.T
+        logits = jnp.einsum("...h,hv->...v", hidden, head,
                             preferred_element_type=jnp.float32)
-        return logits * head["s"]
-    if cfg.tie_embeddings:
-        head = head.T
-    return jnp.einsum("...h,hv->...v", hidden, head, preferred_element_type=jnp.float32)
+    # single exit: every head variant gets the gemma-2 softcap
+    return _softcap(logits, cfg)
 
 
 def gather_last_hidden(hidden: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:

@@ -7,12 +7,15 @@ import jax.lax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5,
+             weight_offset: float = 0.0) -> jnp.ndarray:
+    """``weight_offset``: gemma-family checkpoints store w where the norm
+    applies (1 + w) — pass 1.0 there, 0.0 for llama-family."""
     orig_dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jax.lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(orig_dtype)
+    return (normed * (weight_offset + weight.astype(jnp.float32))).astype(orig_dtype)
 
 
 def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,

@@ -75,7 +75,8 @@ def _stage_block(local_layers: dict, h: jnp.ndarray, cfg: ModelConfig,
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
 
     def body(h, lp):
-        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps,
+                     cfg.norm_weight_offset)
         q, kproj, vproj = llama._qkv_proj(lp, x, cfg, positions, cos_t, sin_t)
         attn = _causal_attention(q, kproj, vproj)
         h = llama._attn_out(lp, h, attn.reshape(B, T, -1))
@@ -107,7 +108,8 @@ def pipelined_loss_fn(cfg: ModelConfig, mesh: Mesh, num_microbatches: int,
 
         # embed all microbatches up front (cheap gather; grads flow only
         # through the stage-0 selection below)
-        h_in = llama.embed_lookup(embed, ids, final_norm.dtype)  # [M, mb, T, H]
+        h_in = llama._embed_scale(
+            llama.embed_lookup(embed, ids, final_norm.dtype), cfg)  # [M, mb, T, H]
 
         state = jnp.zeros_like(h_in[0])
         collected = jnp.zeros_like(h_in)
@@ -132,11 +134,13 @@ def pipelined_loss_fn(cfg: ModelConfig, mesh: Mesh, num_microbatches: int,
         # loss on the last stage only; other stages contribute exact zeros and
         # the psum replicates the scalar (their head FLOPs are masked waste —
         # the standard SPMD-pipeline trade for one program on every device)
-        hidden = rms_norm(collected, final_norm, cfg.rms_norm_eps)
+        hidden = rms_norm(collected, final_norm, cfg.rms_norm_eps,
+                          cfg.norm_weight_offset)
         head = embed if cfg.tie_embeddings else lm_head
-        logits = jnp.einsum("mbth,hv->mbtv", hidden,
-                            head.T if cfg.tie_embeddings else head,
-                            preferred_element_type=jnp.float32)
+        logits = llama._softcap(
+            jnp.einsum("mbth,hv->mbtv", hidden,
+                       head.T if cfg.tie_embeddings else head,
+                       preferred_element_type=jnp.float32), cfg)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         local = jnp.where(is_last, jnp.sum(nll), 0.0)

@@ -244,3 +244,45 @@ def test_qwen2_engine_and_quant():
     assert not isinstance(q.params["layers"]["bq"], dict)  # bias not quantized
     [res_q] = q.generate([[5, 6, 7]], SamplingParams(max_tokens=6))
     assert len(res_q.token_ids) == 6
+
+
+def test_gemma_family_knobs():
+    """Gemma-family: GeGLU activation, (1+w) RMSNorm, sqrt(H) embedding
+    scaling, and gemma-2 logit softcapping are all live (each knob changes
+    the output), and the family runs end to end through the engine.
+    Geometry reference: gemma-7b in models/configs.py."""
+    import dataclasses
+
+    import jax
+
+    from cyberfabric_core_tpu.models import get_config, llama
+
+    cfg = get_config("tiny-gemma")
+    assert cfg.hidden_act == "gelu" and cfg.norm_weight_offset == 1.0
+    params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    assert "lm_head" not in params  # tied embeddings
+
+    from cyberfabric_core_tpu.ops.rope import rope_frequencies
+    rope = rope_frequencies(cfg.head_dim, 64, cfg.rope_theta)
+    ids = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32)[None, :], (1, 4))
+    start = jnp.zeros((1,), jnp.int32)
+
+    def logits_for(c):
+        h, _ = llama.forward(params, c, ids, pos, llama.init_cache(c, 1, 16),
+                             start, rope)
+        return np.asarray(llama.lm_head_logits(params, c, h[:, -1, :]))
+
+    base = logits_for(cfg)
+    # every knob is live: flipping each one changes the logits
+    for change in ({"hidden_act": "silu"}, {"norm_weight_offset": 0.0},
+                   {"embedding_multiplier": 1.0}, {"final_logit_softcap": 0.0}):
+        assert not np.allclose(base, logits_for(
+            dataclasses.replace(cfg, **change)), atol=1e-5), change
+    # softcap bounds the logits
+    assert np.abs(base).max() <= cfg.final_logit_softcap + 1e-3
+
+    eng = InferenceEngine(EngineConfig(model="tiny-gemma", max_seq_len=64,
+                                       decode_chunk=4, use_flash=False))
+    [res] = eng.generate([[5, 6, 7]], SamplingParams(max_tokens=6))
+    assert len(res.token_ids) == 6

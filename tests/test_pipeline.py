@@ -96,3 +96,24 @@ def test_microbatch_count_must_divide_batch():
     loss_fn = pipelined_loss_fn(CFG, mesh, 3)
     with pytest.raises(AssertionError):
         loss_fn(_params(), ids, targets)
+
+
+def test_pipelined_loss_matches_reference_gemma_family():
+    """The gemma knobs (GeGLU, (1+w) norms, embed scaling, softcap) must hold
+    in the pipelined path too — it shares llama's layer helpers but has its
+    own embed/final-norm/head code."""
+    import dataclasses
+
+    gcfg = dataclasses.replace(
+        CFG, name="pipe-gemma", tie_embeddings=True, hidden_act="gelu",
+        norm_weight_offset=1.0, embedding_multiplier=32.0 ** 0.5,
+        final_logit_softcap=30.0)
+    mesh = build_mesh(MeshConfig(dp=1, tp=1, sp=1, ep=1, pp=2),
+                      jax.devices()[:2])
+    ids, targets = _data(B=8, T=16)
+    params = llama.init_params(gcfg, jax.random.PRNGKey(0), jnp.float32)
+
+    ref = jax.jit(reference_loss_fn(gcfg))(params, ids, targets)
+    piped = jax.jit(pipelined_loss_fn(gcfg, mesh, 4))(params, ids, targets)
+    np.testing.assert_allclose(np.asarray(piped), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
