@@ -28,9 +28,13 @@ import subprocess
 import sys
 import time
 
-#: (model, quant) from most- to least-capable; each ~halves HBM need
+#: (model, quant) from most- to least-capable; each ~halves HBM need.
+#: int8 FIRST for the 8B north star (accuracy-default quantization); the W4
+#: bandwidth experiment follows as its own rung — on a shared chip it also
+#: has the best odds of fitting (~4.3 GB).
 LADDER = [
     ("llama-3-8b", "int8"),    # 8.1 GB — the north-star model on one v5e chip
+    ("llama-3-8b", "int4"),    # 4.3 GB — W4 bandwidth rung (halves decode bytes)
     ("mistral-7b", "int8"),    # 7.3 GB
     ("phi-3-mini", "none"),    # 7.6 GB bf16 (round-1 measured config)
     ("phi-3-mini", "int8"),    # 3.9 GB
@@ -229,7 +233,7 @@ def single(model: str, quant: str) -> int:
                           "detail": msg[:300]}), flush=True)
         return 7 if kind == "oom" else 1
 
-    precision = "int8-weights" if quant == "int8" else "bf16"
+    precision = f"{quant}-weights" if quant in ("int8", "int4") else "bf16"
     spec_label = ", ngram-speculative" if spec else ""
     result = {
         "metric": f"{model} greedy decode tokens/sec/chip "

@@ -422,7 +422,8 @@ def prefill_collect(
     """
     B, T = input_ids.shape
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
-    cache = init_cache(cfg, B, T, params["embed"].dtype)
+    # dtype from final_norm, not embed: quantized trees carry a dict embed
+    cache = init_cache(cfg, B, T, params["final_norm"].dtype)
     hidden, kv = forward(
         params, cfg, input_ids, positions, cache,
         jnp.zeros((B,), jnp.int32), rope_tables, use_flash=use_flash,

@@ -265,8 +265,12 @@ class LocalTpuWorker(LlmWorkerApi):
             from ...runtime.weights import load_llama_params
 
             cfg = get_config(arch_config)
-            params = load_llama_params(model.checkpoint_path, cfg,
-                                       quantize=eng_cfg.quantization == "int8")
+            from ...runtime.quant import quant_bits
+
+            bits = quant_bits(eng_cfg.quantization)
+            params = load_llama_params(
+                model.checkpoint_path, cfg,
+                quantize=bits is not None, quant_bits=bits or 8)
             tokenizer = load_tokenizer(model.checkpoint_path)
         else:
             # synthetic weights (airgapped/dev): byte tokenizer over model vocab

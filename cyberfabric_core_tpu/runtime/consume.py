@@ -99,6 +99,13 @@ def run_conformance(export_dir: str | Path, *,
         if n_in == 0 and n_out == 0:
             continue
         args = [bundle[f"{name}.in{i}"] for i in range(n_in)]
+        if f"{name}.int4_in" in bundle:
+            # W4 artifacts: these args were widened to int8 for npz storage;
+            # the lowered program's signature expects s4
+            import jax.numpy as jnp
+
+            for i in bundle[f"{name}.int4_in"].tolist():
+                args[i] = jnp.asarray(args[i]).astype(jnp.int4)
         expected = [bundle[f"{name}.out{i}"] for i in range(n_out)]
         loaded = load_program(prog["path"])
         got = loaded.execute(args)

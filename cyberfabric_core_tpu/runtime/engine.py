@@ -67,7 +67,9 @@ class EngineConfig:
     #: prefix-cache pool size in pages (0 = disabled). Continuous scheduler only.
     prefix_cache_pages: int = 0
     prefix_page_size: int = 64
-    #: weight-only quantization: "none" | "int8" (halves HBM + decode traffic)
+    #: weight-only quantization: "none" | "int8" | "int4" (each rung ~halves
+    #: HBM + decode traffic; int4 is per-channel — the bandwidth experiment,
+    #: int8 the accuracy default — see runtime/quant.py)
     quantization: str = "none"
     #: speculative decoding: "off" | "ngram" (prompt-lookup drafting + one
     #: fused [1, k+1] verify forward; greedy bs=1 only, lossless — see
@@ -174,20 +176,24 @@ class InferenceEngine:
         if self.model_config.architecture != "llama":
             raise ValueError(f"InferenceEngine drives decoder models, got {self.model_config.architecture}")
         self.dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.dtype(config.dtype)
+        from .quant import quant_bits as _qb
+
+        quant_bits = _qb(config.quantization)
         if params is None:
-            if config.quantization == "int8":
+            if quant_bits is not None:
                 from .quant import init_params_quantized
 
                 params = init_params_quantized(
-                    self.model_config, jax.random.PRNGKey(seed), self.dtype)
+                    self.model_config, jax.random.PRNGKey(seed), self.dtype,
+                    bits=quant_bits)
             else:
                 params = llama.init_params(
                     self.model_config, jax.random.PRNGKey(seed), self.dtype)
-        elif config.quantization == "int8" and not isinstance(
+        elif quant_bits is not None and not isinstance(
                 params.get("embed"), dict):  # already-quantized trees pass through
             from .quant import quantize_llama_params
 
-            params = quantize_llama_params(params)
+            params = quantize_llama_params(params, bits=quant_bits)
         self.params = params
         self.rope_tables = rope_frequencies(
             self.model_config.head_dim,

@@ -42,12 +42,15 @@ def _param_avals(cfg: ModelConfig, dtype, quantization: str):
     """Abstract parameter tree for the architecture (no allocation)."""
     base = jax.eval_shape(
         lambda k: llama.init_params(cfg, k, dtype), jax.random.PRNGKey(0))
-    if quantization == "int8":
+    from .quant import quant_bits
+
+    bits = quant_bits(quantization)
+    if bits is not None:
         from .quant import quantize_llama_params
 
         # shape-level quantization (init_params_quantized materializes +
         # blocks per leaf — the abstract path must stay allocation-free)
-        return jax.eval_shape(quantize_llama_params, base)
+        return jax.eval_shape(lambda p: quantize_llama_params(p, bits), base)
     return base
 
 
@@ -178,11 +181,14 @@ def export_llama_programs(
         # convention (leaf order == the lowered program's arg order).
         import numpy as np
 
-        if quantization == "int8":
+        from .quant import quant_bits as _qb
+
+        _bits = _qb(quantization)
+        if _bits is not None:
             from .quant import init_params_quantized
 
             live_params = init_params_quantized(cfg, jax.random.PRNGKey(0),
-                                                dtype)
+                                                dtype, bits=_bits)
         else:
             live_params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype)
         rng = jax.random.PRNGKey(7)
@@ -203,7 +209,15 @@ def export_llama_programs(
         for prog_name, args_tree, outs_tree in (
                 (programs[0].name, pre_in, pre_out),
                 (programs[1].name, dec_in, dec_out)):
-            in_leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(args_tree)]
+            # int4 leaves (W4 export) widen to int8 for npz storage; their
+            # indices ride the bundle so the consumer narrows them back to
+            # the artifact's s4 calling convention
+            int4_in = [i for i, x in enumerate(jax.tree_util.tree_leaves(args_tree))
+                       if getattr(x, "dtype", None) == jnp.int4]
+            in_leaves = [np.asarray(x.astype(jnp.int8))
+                         if getattr(x, "dtype", None) == jnp.int4
+                         else np.asarray(x)
+                         for x in jax.tree_util.tree_leaves(args_tree)]
             out_leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(outs_tree)]
             for leaves in (in_leaves, out_leaves):
                 for a in leaves:
@@ -215,6 +229,7 @@ def export_llama_programs(
                             f"{a.dtype} — export with dtype=float32")
             bundle[f"{prog_name}.n_in"] = np.int64(len(in_leaves))
             bundle[f"{prog_name}.n_out"] = np.int64(len(out_leaves))
+            bundle[f"{prog_name}.int4_in"] = np.asarray(int4_in, np.int64)
             for i, a in enumerate(in_leaves):
                 bundle[f"{prog_name}.in{i}"] = a
             for i, a in enumerate(out_leaves):

@@ -1,11 +1,14 @@
-"""Weight-only int8 quantization.
+"""Weight-only quantization: int8 (W8) and int4 (W4).
 
 Purpose: HBM. Decode throughput is weight-bandwidth-bound and a v5e chip holds
 16 GB — Llama-3-8B bf16 (16.1 GB) doesn't fit one chip, W8 (8.1 GB) does, and
-every decode step reads half the bytes. Symmetric per-output-channel scales; the
-int8→bf16 convert sits inside the dot's operand so XLA fuses it into the matmul
-read (weights stream from HBM as int8). Norm weights stay bf16 (tiny, and their
-statistics are precision-sensitive).
+W4 (~4.3 GB) halves decode bytes again. Symmetric per-output-channel scales;
+the intN→bf16 convert sits inside the dot's operand so XLA fuses it into the
+matmul read (weights stream from HBM narrow — XLA:TPU stores s4 packed two to
+a byte). Norm weights stay bf16 (tiny, and their statistics are
+precision-sensitive). W4 per-CHANNEL scaling is coarse for real checkpoints
+(group-wise scales are the usual fix; synthetic-weight benching is
+insensitive) — it is the bandwidth experiment, W8 the accuracy default.
 
 Quantized leaf representation: {"q": int8 [..., in, out], "s": f32 [..., out]}
 (leading stacked-layer/expert dims preserved). models/llama.py's matmul helpers
@@ -24,12 +27,25 @@ _MATMUL_LEAVES = {"wq", "wk", "wv", "wo", "gate", "up", "down",
                   "moe_gate", "moe_up", "moe_down"}
 
 
-def quantize_weight(w: jnp.ndarray) -> dict[str, jnp.ndarray]:
-    """Symmetric per-output-channel int8: scale over the contraction axis (-2)."""
+def quant_bits(quantization: str) -> int | None:
+    """EngineConfig.quantization string → bit width (None = unquantized).
+    The ONE mapping every engine/export/load path shares — unknown strings
+    fail here instead of silently serving bf16."""
+    table = {"none": None, "": None, "int8": 8, "int4": 4}
+    if quantization not in table:
+        raise ValueError(f"unknown quantization {quantization!r} "
+                         f"(supported: {sorted(k for k in table if k)})")
+    return table[quantization]
+
+
+def quantize_weight(w: jnp.ndarray, bits: int = 8) -> dict[str, jnp.ndarray]:
+    """Symmetric per-output-channel intN: scale over the contraction axis (-2)."""
+    qmax = {8: 127, 4: 7}[bits]
+    qdtype = jnp.int8 if bits == 8 else jnp.int4
     wf = w.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)  # [..., 1, out]
-    scale = jnp.maximum(absmax / 127.0, 1e-12)
-    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    scale = jnp.maximum(absmax / qmax, 1e-12)
+    q = jnp.clip(jnp.round(wf / scale), -qmax, qmax).astype(qdtype)
     return {"q": q, "s": scale[..., 0, :].astype(jnp.float32)}
 
 
@@ -37,16 +53,18 @@ def dequantize_weight(wq: dict[str, jnp.ndarray], dtype=jnp.bfloat16) -> jnp.nda
     return (wq["q"].astype(jnp.float32) * wq["s"][..., None, :]).astype(dtype)
 
 
-def quantize_llama_params(params: dict[str, Any]) -> dict[str, Any]:
-    """Quantize every matmul weight + lm_head + embed; norms stay as-is."""
+def quantize_llama_params(params: dict[str, Any], bits: int = 8) -> dict[str, Any]:
+    """Quantize every matmul weight + lm_head + embed; norms stay as-is.
+    The embed table stays int8 even at bits=4 (gather from s4 is not a
+    bandwidth-critical path and per-row int8 is accuracy-safe)."""
     out: dict[str, Any] = {"final_norm": params["final_norm"]}
     out["embed"] = _quantize_embed(params["embed"])
     if "lm_head" in params:
-        out["lm_head"] = quantize_weight(params["lm_head"])
+        out["lm_head"] = quantize_weight(params["lm_head"], bits)
     layers = {}
     for name, w in params["layers"].items():
         if name in _MATMUL_LEAVES:
-            layers[name] = quantize_weight(w)
+            layers[name] = quantize_weight(w, bits)
         else:
             layers[name] = w  # norms, router (tiny + precision-sensitive)
     out["layers"] = layers
@@ -64,10 +82,11 @@ def _quantize_embed(embed: jnp.ndarray) -> dict[str, jnp.ndarray]:
     return {"qe": q, "se": scale[:, 0].astype(jnp.float32)}
 
 
-def init_params_quantized(cfg, key: jax.Array, dtype=jnp.bfloat16) -> dict[str, Any]:
-    """Synthetic-weight init directly into W8: each leaf is sampled in bf16,
+def init_params_quantized(cfg, key: jax.Array, dtype=jnp.bfloat16,
+                          bits: int = 8) -> dict[str, Any]:
+    """Synthetic-weight init directly into W8/W4: each leaf is sampled in bf16,
     quantized, and the bf16 original freed before the next — peak HBM is the
-    int8 tree + ONE bf16 leaf, so an 8B model inits inside a 16 GB chip."""
+    intN tree + ONE bf16 leaf, so an 8B model inits inside a 16 GB chip."""
     from ..models import llama
 
     H, I, V, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
@@ -77,7 +96,7 @@ def init_params_quantized(cfg, key: jax.Array, dtype=jnp.bfloat16) -> dict[str, 
     def w(*shape):
         scale = jnp.asarray(1.0 / (shape[-2] if len(shape) > 1 else shape[-1]) ** 0.5, dtype)
         full = jax.random.normal(next(keys), shape, dtype) * scale
-        q = quantize_weight(full)
+        q = quantize_weight(full, bits)
         q["q"].block_until_ready()
         del full
         return q

@@ -109,11 +109,29 @@ class ContinuousBatchingEngine:
         _init_ctx = contextlib.ExitStack()  # rest of __init__ allocates on-device
         if device is not None:
             _init_ctx.enter_context(jax.default_device(device))
+        from .quant import quant_bits as _qb
+
+        quant_bits = _qb(config.quantization)
         if params is None:
-            params = llama.init_params(
-                self.model_config, jax.random.PRNGKey(seed), self.dtype)
-        elif device is not None:
-            params = jax.device_put(params, device)
+            if quant_bits is not None:
+                from .quant import init_params_quantized
+
+                params = init_params_quantized(
+                    self.model_config, jax.random.PRNGKey(seed), self.dtype,
+                    bits=quant_bits)
+            else:
+                params = llama.init_params(
+                    self.model_config, jax.random.PRNGKey(seed), self.dtype)
+        else:
+            if quant_bits is not None and not isinstance(
+                    params.get("embed"), dict):
+                # same pass-in semantics as InferenceEngine: a provided
+                # unquantized tree gets quantized, never silently served bf16
+                from .quant import quantize_llama_params
+
+                params = quantize_llama_params(params, bits=quant_bits)
+            if device is not None:
+                params = jax.device_put(params, device)
         self.params = params
         self.rope_tables = rope_frequencies(
             self.model_config.head_dim,

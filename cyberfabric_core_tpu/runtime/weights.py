@@ -84,12 +84,13 @@ def load_llama_params(
     shardings: Optional[dict[str, Any]] = None,
     progress: Optional[Callable[[str], None]] = None,
     quantize: bool = False,
+    quant_bits: int = 8,
 ) -> dict:
     """Load a HF llama-family safetensors checkpoint into our param tree.
 
     ``shardings``: optional map of tree paths ("layers.wq", "embed", ...) →
     jax.sharding.Sharding; tensors go straight to their sharded placement.
-    ``quantize``: int8 weight-only quantization applied PER TENSOR as it loads —
+    ``quantize``: intN (``quant_bits`` ∈ {8, 4}) weight-only quantization applied PER TENSOR as it loads —
     peak device memory is the int8 tree plus one fp tensor, so checkpoints up to
     ~2× HBM load on one chip.
     """
@@ -104,7 +105,7 @@ def load_llama_params(
         leaf_name = path.split(".")[-1]
         if quantize and (leaf_name in _MATMUL_LEAVES or path in ("lm_head", "embed")):
             dev = jnp.asarray(target)
-            q = _quantize_embed(dev) if path == "embed" else quantize_weight(dev)
+            q = _quantize_embed(dev) if path == "embed" else quantize_weight(dev, quant_bits)
             jax.tree.map(lambda a: a.block_until_ready(), q)
             del dev
             return q

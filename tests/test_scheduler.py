@@ -127,3 +127,36 @@ def test_dense_mode_rejects_seed():
         assert rid
     finally:
         sched.shutdown()
+
+
+@pytest.mark.parametrize("quant", ["int8", "int4"])
+def test_quantized_continuous_scheduler_decodes(quant):
+    """The paged scheduler honors EngineConfig.quantization end to end.
+    Regression: it used to init bf16 params regardless, and prefill_collect
+    crashed on quantized trees (dict embed has no .dtype) — so the bench's
+    int8 aggregate rung had silently never run quantized."""
+    import threading
+
+    from cyberfabric_core_tpu.runtime import EngineConfig, SamplingParams
+    from cyberfabric_core_tpu.runtime.scheduler import ContinuousBatchingEngine
+
+    cfg = EngineConfig(model="tiny-llama", max_seq_len=64, max_batch=2,
+                       decode_chunk=4, use_flash=False, quantization=quant,
+                       prefix_cache_pages=20, prefix_page_size=16)
+    sched = ContinuousBatchingEngine(cfg, seed=0)
+    try:
+        assert isinstance(sched.params["layers"]["wq"], dict)
+        done = threading.Event()
+        toks = []
+
+        def emit(ev):
+            if ev.token_id >= 0:
+                toks.append(ev.token_id)
+            if ev.finished:
+                done.set()
+
+        sched.submit([5, 6, 7], SamplingParams(max_tokens=5), emit)
+        assert done.wait(180)
+        assert len(toks) == 5
+    finally:
+        sched.shutdown()

@@ -191,3 +191,29 @@ def test_consume_detects_tampered_artifact(tmp_path):
     open(victim, "w").write(data.replace("stablehlo", "stablehlx", 1))
     with _pytest.raises(ValueError, match="digest"):
         verify_manifest(tmp_path)
+
+
+def test_int4_export_conformance_replays(tmp_path):
+    """int4 artifacts carry a MATCHING conformance bundle (regression: the
+    conformance branch used to materialize unquantized params for any
+    quantization other than int8, producing an unverifiable artifact)."""
+    import json
+    import subprocess
+    import sys
+
+    import jax.numpy as jnp
+
+    from cyberfabric_core_tpu.runtime.export import export_llama_programs
+
+    export_llama_programs("tiny-llama", tmp_path, max_seq_len=128,
+                          prefill_bucket=32, decode_chunk=4,
+                          dtype=jnp.float32, quantization="int4",
+                          conformance=True)
+    repo_root = str(Path(__file__).resolve().parents[1])
+    proc = subprocess.run(
+        [sys.executable, "-m", "cyberfabric_core_tpu.runtime.consume",
+         "--cpu", str(tmp_path)],
+        capture_output=True, text=True, timeout=600, cwd=repo_root)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["ok"], verdict
