@@ -237,14 +237,24 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
         return resolved
 
     async def _chat_once(
-        self, ctx: SecurityContext, model: ModelInfo, body: dict
+        self, ctx: SecurityContext, model: ModelInfo, body: dict,
+        mode: str = "chat",
     ) -> AsyncIterator[ChatStreamChunk]:
         """One model attempt with TTFT + total timeout enforcement
         (DESIGN.md:706-741). Managed models run on the local TPU worker;
-        external ones route through the OAGW provider adapter."""
+        external ones route through the OAGW provider adapter.
+        ``mode="completion"``: raw prompt, no chat template on the local
+        worker; external providers see it as one user message."""
         assert self.worker is not None
         external = None if model.managed else self._get_external()
-        if external is None:
+        if mode == "completion":
+            if external is None:
+                agen = self.worker.completion_stream(model, body["prompt"], body)
+            else:
+                agen = external.chat_stream(ctx, model, [
+                    {"role": "user", "content": [
+                        {"type": "text", "text": body["prompt"]}]}], body)
+        elif external is None:
             agen = self.worker.chat_stream(model, body["messages"], body)
         else:
             agen = external.chat_stream(ctx, model, body["messages"], body)
@@ -310,15 +320,41 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
             return await self._stream_response(request, ctx, body, models)
         return await self._sync_response(ctx, body, models)
 
+    async def handle_completions(self, request: web.Request):
+        """POST /v1/completions — raw text completion (the BASELINE metric
+        surface): no chat template, prompt tokens in verbatim. Shares the
+        chat path's budget/fallback/timeout/SSE machinery."""
+        body = await read_json(request, schemas.COMPLETION_REQUEST)
+        ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
+        self.usage.check_budget(ctx)
+        # same pre_call policy hook as chat (DESIGN.md:743-766) — a raw
+        # prompt must not bypass content moderation
+        hook = self._hub.try_get(LlmHookApi)
+        if hook is not None:
+            verdict = await hook.pre_call(ctx, body)
+            action = (verdict or {}).get("action", "allow")
+            if action == "block":
+                raise ProblemError.forbidden(
+                    (verdict or {}).get("reason", "blocked by pre-call hook"))
+            if action == "override":
+                body = verdict["body"]
+                validate_against(schemas.COMPLETION_REQUEST, body)
+        models = await self._resolve_with_fallback(ctx, body)
+        if body.get("stream"):
+            return await self._stream_response(request, ctx, body, models,
+                                               mode="completion")
+        return await self._sync_response(ctx, body, models, mode="completion")
+
     async def _sync_response(self, ctx: SecurityContext, body: dict,
-                             models: list[tuple[bool, ModelInfo]]) -> dict:
+                             models: list[tuple[bool, ModelInfo]],
+                             mode: str = "chat") -> dict:
         last_err: Optional[ProblemError] = None
         for is_primary, model in models:
             pieces: list[str] = []
             usage = {"input_tokens": 0, "output_tokens": 0}
             finish = "stop"
             try:
-                async for chunk in self._chat_once(ctx, model, body):
+                async for chunk in self._chat_once(ctx, model, body, mode):
                     if chunk.text:
                         pieces.append(chunk.text)
                     if chunk.finish_reason:
@@ -365,15 +401,17 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
 
     async def _stream_response(self, request: web.Request, ctx: SecurityContext,
                                body: dict,
-                               models: list[tuple[bool, ModelInfo]]) -> web.StreamResponse:
+                               models: list[tuple[bool, ModelInfo]],
+                               mode: str = "chat") -> web.StreamResponse:
         """SSE per the chunk contract: role-bearing first delta, content deltas,
         final chunk with finish_reason + usage, then data: [DONE]."""
         resp: Optional[web.StreamResponse] = None
-        completion_id = f"chatcmpl-{uuid.uuid4().hex[:20]}"
+        completion_id = (f"chatcmpl-{uuid.uuid4().hex[:20]}" if mode == "chat"
+                         else f"cmpl-{uuid.uuid4().hex[:20]}")
         last_err: Optional[ProblemError] = None
         for is_primary, model in models:
             try:
-                agen = self._chat_once(ctx, model, body)
+                agen = self._chat_once(ctx, model, body, mode)
                 first_chunk = await agen.__anext__()
             except StopAsyncIteration:
                 continue
@@ -761,6 +799,12 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability):
             .summary("Chat completion (sync, SSE stream, or async job)") \
             .request_schema(schemas.REQUEST).response_schema(schemas.RESPONSE) \
             .sse_response().handler(self.handle_chat).register()
+        openapi.register_schema("CompletionRequest", schemas.COMPLETION_REQUEST)
+        router.operation("POST", "/v1/completions", module=m).auth_required() \
+            .summary("Raw text completion (sync or SSE stream; no chat template)") \
+            .request_schema(schemas.COMPLETION_REQUEST) \
+            .response_schema(schemas.RESPONSE) \
+            .sse_response().handler(self.handle_completions).register()
         router.operation("POST", "/v1/embeddings", module=m).auth_required() \
             .summary("Text embeddings").request_schema(schemas.EMBEDDING_REQUEST) \
             .handler(self.handle_embeddings).register()

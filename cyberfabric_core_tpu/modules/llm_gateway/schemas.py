@@ -120,6 +120,25 @@ REQUEST = _schema("core", "request", {
     },
 })
 
+COMPLETION_REQUEST = _schema("core", "completion_request", {
+    # raw text completion (BASELINE metric surface: POST /v1/completions) —
+    # the prompt is tokenized verbatim, no chat template
+    "type": "object",
+    "required": ["model", "prompt"],
+    "properties": {
+        "model": {"type": "string"},
+        "prompt": {"type": "string", "minLength": 1},
+        "stream": {"type": "boolean", "default": False},
+        "fallback": FALLBACK_CONFIG,
+        "max_tokens": {"type": "integer", "minimum": 1},
+        "temperature": {"type": "number", "minimum": 0},
+        "top_p": {"type": "number", "exclusiveMinimum": 0, "maximum": 1},
+        "top_k": {"type": "integer", "minimum": 0},
+        "seed": {"type": "integer"},
+        "stop": {"type": "array", "items": {"type": "string"}, "maxItems": 8},
+    },
+})
+
 USAGE = _schema("core", "usage", {
     "type": "object",
     "required": ["input_tokens", "output_tokens"],

@@ -322,7 +322,24 @@ class LocalTpuWorker(LlmWorkerApi):
                 "text": render_tools_preamble(params["_resolved_tools"])}]}
             messages = [preamble] + list(messages)
         prompt = render_chat(messages, entry.model_family)
-        prompt_ids = entry.tokenizer.encode(prompt)
+        async for chunk in self._generate_from_ids(
+                entry, model, entry.tokenizer.encode(prompt), params):
+            yield chunk
+
+    async def completion_stream(
+        self, model: ModelInfo, prompt: str, params: dict
+    ) -> AsyncIterator[ChatStreamChunk]:
+        """Raw text completion (POST /v1/completions, the BASELINE metric
+        surface): the prompt is tokenized verbatim — no chat template."""
+        entry = await self._entry_for(model)
+        async for chunk in self._generate_from_ids(
+                entry, model, entry.tokenizer.encode(prompt), params):
+            yield chunk
+
+    async def _generate_from_ids(
+        self, entry: _EngineEntry, model: ModelInfo, prompt_ids: list[int],
+        params: dict
+    ) -> AsyncIterator[ChatStreamChunk]:
         limits_max = int(model.limits.get("max_output_tokens", 1024)) if model.limits else 1024
         sampling = SamplingParams(
             max_tokens=min(int(params.get("max_tokens", 256)), limits_max),

@@ -81,6 +81,18 @@ class LlmWorkerApi(abc.ABC):
     ) -> AsyncIterator[ChatStreamChunk]:
         ...
 
+    async def completion_stream(
+        self, model: ModelInfo, prompt: str, params: dict
+    ) -> AsyncIterator[ChatStreamChunk]:
+        """Raw text completion (POST /v1/completions). Default: wrap the
+        prompt as one user message through chat_stream, so every worker
+        implementation serves the endpoint; LocalTpuWorker overrides to skip
+        the chat template entirely."""
+        async for chunk in self.chat_stream(model, [
+                {"role": "user",
+                 "content": [{"type": "text", "text": prompt}]}], params):
+            yield chunk
+
     @abc.abstractmethod
     async def embed(self, model: ModelInfo, inputs: list[str],
                     params: dict) -> tuple[list[list[float]], int]:

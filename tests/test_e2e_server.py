@@ -109,6 +109,44 @@ def test_chat_completion_sync(server):
     assert body["finish_reason"] in ("stop", "length")
 
 
+def test_raw_completions_endpoint(server):
+    """POST /v1/completions (BASELINE metric surface): raw prompt, no chat
+    template — sync and SSE, sharing the chat path's usage accounting."""
+    status, body = req(server, "POST", "/v1/completions", json={
+        "model": "local::tiny-llama", "prompt": "Once upon a time",
+        "max_tokens": 6,
+    })
+    assert status == 200, body
+    assert body["model_used"] == "local::tiny-llama"
+    assert body["usage"]["output_tokens"] > 0
+    assert body["content"][0]["type"] == "text"
+
+    # a missing prompt is a schema violation, not a 500
+    status, body = req(server, "POST", "/v1/completions", json={
+        "model": "local::tiny-llama"})
+    assert status in (400, 422), body
+
+    loop, base = server
+
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.post(base + "/v1/completions", json={
+                "model": "local::tiny-llama", "prompt": "stream me",
+                "max_tokens": 4, "stream": True,
+            }) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                text = await r.text()
+        return text
+
+    text = loop.run_until_complete(go())
+    frames = [ln for ln in text.splitlines() if ln.startswith("data: ")]
+    assert frames[-1] == "data: [DONE]"
+    import json as _json
+    first = _json.loads(frames[0][len("data: "):])
+    assert first["id"].startswith("cmpl-")
+
+
 def test_chat_completion_sse_contract(server):
     loop, base = server
 
