@@ -48,7 +48,14 @@ while true; do
         # sweep decode_chunk on the winning model while the chip is warm
         quant=none
         echo "$headline" | grep -q int8 && quant=int8
+        echo "$headline" | grep -q int4 && quant=int4
         timeout --signal=TERM 2900 python "$REPO/bench.py" --sweep "$model" "$quant" \
+          >> /tmp/bench_auto.json 2>>/tmp/bench_auto.log
+        # the north-star surface: /v1/completions over HTTP+SSE. serve_mode
+        # records its own BENCH_HISTORY row (tpu + value>0 gated) and handles
+        # SIGTERM by stopping its server child gracefully; its internal
+        # watchdog (1500s) fires before this wrapper
+        timeout --signal=TERM 1700 python "$REPO/bench.py" --serve "$model" "$quant" \
           >> /tmp/bench_auto.json 2>>/tmp/bench_auto.log
         # north-star reached (8B headline) -> done; else keep retrying for 8B
         case "$model" in llama-3-8b*) echo done > "$STATE";; esac
