@@ -7,8 +7,9 @@ stream normalization to the StreamChunk SSE contract with `data: [DONE]`
 (DESIGN.md:289-311) → TTFT + total timeouts with fallback chains (DESIGN.md:680-741)
 → usage reporting.
 
-Endpoints (DESIGN.md:262-271): POST /v1/chat/completions, POST /v1/embeddings,
-POST/GET/DELETE /v1/jobs, POST/GET /v1/batches.
+Endpoints (DESIGN.md:262-271): POST /v1/chat/completions, POST /v1/completions
+(raw text, no chat template — the BASELINE metric surface), POST /v1/embeddings,
+POST/GET/DELETE /v1/jobs, POST/GET /v1/batches, media endpoints, GET /v1/realtime.
 """
 
 from __future__ import annotations
