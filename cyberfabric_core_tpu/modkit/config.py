@@ -39,7 +39,10 @@ def _expand(value: Any) -> Any:
     if isinstance(value, str):
         expanded = _VAR_RE.sub(lambda m: os.environ.get(m.group(1), ""), value)
         if expanded.startswith("~"):
-            expanded = os.path.expanduser(expanded)
+            try:
+                expanded = os.path.expanduser(expanded)
+            except ValueError:  # fuzz-found: "~\x00..." (embedded null byte)
+                pass
         return expanded
     if isinstance(value, dict):
         return {k: _expand(v) for k, v in value.items()}
@@ -59,10 +62,14 @@ def _deep_merge(base: dict, overlay: Mapping) -> dict:
 
 
 def _coerce_env_value(raw: str) -> Any:
-    """YAML-parse env values so ``true``/``8086``/``[a,b]`` become typed."""
+    """YAML-parse env values so ``true``/``8086``/``[a,b]`` become typed.
+    Fuzz-found escapes beyond YAMLError: PyYAML's int resolver matches
+    strings like ``0x_`` then crashes int() (ValueError), and deeply nested
+    values recurse per level (RecursionError) — any unparseable value stays
+    a string."""
     try:
         return yaml.safe_load(raw)
-    except yaml.YAMLError:
+    except (yaml.YAMLError, ValueError, RecursionError):
         return raw
 
 

@@ -33,6 +33,8 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if ROOT not in sys.path:  # direct `python fuzz/fuzz_odata.py` invocation
     sys.path.insert(0, ROOT)
 
+from cyberfabric_core_tpu.modkit import config as config_mod
+from cyberfabric_core_tpu.modkit import jwt as jwt_mod
 from cyberfabric_core_tpu.modkit import odata as odata_mod
 from cyberfabric_core_tpu.modkit.errors import ProblemError
 from cyberfabric_core_tpu.modkit.odata import (
@@ -89,6 +91,43 @@ def run_pdf(data: bytes) -> None:
     assert doc is not None
 
 
+_JWT_VALIDATOR = jwt_mod.JwtValidator({"k": jwt_mod.JwtKey(
+    kid="k", alg="HS256", secret="s")})
+
+
+def run_jwt(data: bytes) -> None:
+    """Bearer tokens are attacker-controlled bytes hitting peek_header +
+    validate on every request; the only acceptable failure is JwtError."""
+    token = _text(data)
+    try:
+        header = jwt_mod.peek_header(token)
+    except jwt_mod.JwtError:
+        return
+    assert isinstance(header, dict)
+    # a peekable token must still validate-or-JwtError, never crash
+    try:
+        _JWT_VALIDATOR.validate(token)
+    except jwt_mod.JwtError:
+        pass
+
+
+def run_config_env(data: bytes) -> None:
+    """Arbitrary operator env input through the FULL loader surface
+    (overrides + ${VAR}/~ expansion + validation): the loader either loads
+    or rejects with the typed ConfigError — anything else is a crash."""
+    text = _text(data)
+    try:
+        cfg = config_mod.AppConfig.load_or_default(environ={
+            "APP__MODULES__A__CONFIG__X": text,
+            "APP__SERVER__HOME_DIR": text[:64] or "~",
+            "APP__" + text[:40].replace("\x00", "_").replace("=", "_").upper():
+                "1"})
+    except config_mod.ConfigError:
+        return  # the loader's declared failure mode
+    assert isinstance(cfg.tree, dict)
+    assert "modules" in cfg.tree
+
+
 def _odata_dict() -> tuple[bytes, ...]:
     return (b" eq ", b" ne ", b" lt ", b" le ", b" gt ", b" ge ", b" and ",
             b" or ", b"not ", b" in ", b"(", b")", b",", b"'", b"''", b"null",
@@ -114,6 +153,24 @@ TARGETS = {
         expected=(ODataError,),
         dictionary=(b"=", b"eyJ", b"fuzzhash", b":", b"[", b"]", b'"'),
         seeds=(b"", encode_cursor(["a", 3], "fuzzhash").encode())),
+    "jwt": FuzzTarget(
+        name="jwt", run=run_jwt,
+        target_files=(jwt_mod.__file__,),
+        expected=(),  # run_jwt itself narrows to JwtError
+        dictionary=(b".", b"eyJ", b'{"alg":"HS256"}', b'{"alg":"none"}',
+                    b'{"kid":"k"}', b"==", b"-_",
+                    # peekable header segment: base64url of {"alg":"HS256","kid":"k"}
+                    jwt_mod.b64url_encode(b'{"alg":"HS256","kid":"k"}').encode()),
+        seeds=(b"", b"a.b.c",
+               jwt_mod.encode_hs256({"sub": "u", "exp": 4102444800},
+                                    "s", kid="k").encode())),
+    "config_env": FuzzTarget(
+        name="config_env", run=run_config_env,
+        target_files=(config_mod.__file__,),
+        expected=(),  # loader must never raise on env values
+        dictionary=(b"${HOME}", b"~", b"[1, 2]", b"{a: b}", b"true", b"__",
+                    b"null", b"!!python/object", b"0x10", b"- x"),
+        seeds=(b"", b"8086", b"[a, b]", b"${VAR}x")),
     "pdf": FuzzTarget(
         name="pdf", run=run_pdf,
         target_files=(fp_mod.__file__,),

@@ -73,3 +73,26 @@ def test_effective_dump_redacts():
     )
     dump = cfg.dump_effective()
     assert dump["modules"]["credstore"]["config"]["master_key"] == "***REDACTED***"
+
+
+def test_env_value_yaml_int_resolver_edge_is_a_string():
+    """Fuzz-found: PyYAML's int resolver matches "0x_" then crashes int()
+    with ValueError (not YAMLError). Such values must land as strings, never
+    crash config loading."""
+    cfg = AppConfig.load_or_default(environ={
+        "APP__MODULES__M__CONFIG__WEIRD": "0x_",
+        "APP__MODULES__M__CONFIG__PORT": "0x10",
+    })
+    section = cfg.module_config("m")
+    assert section["weird"] == "0x_"
+    assert section["port"] == 16  # valid hex still coerces
+
+    # more fuzz-found loader escapes: deep nesting (RecursionError inside
+    # PyYAML) and an embedded null byte reaching os.path.expanduser
+    cfg = AppConfig.load_or_default(environ={
+        "APP__MODULES__M__CONFIG__DEEP": "[" * 2000 + "]" * 2000,
+        "APP__MODULES__M__CONFIG__NULLHOME": "~\x00x",
+    })
+    section = cfg.module_config("m")
+    assert isinstance(section["deep"], str)
+    assert section["nullhome"].startswith("~")
