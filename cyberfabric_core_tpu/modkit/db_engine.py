@@ -480,7 +480,7 @@ class MySQLEngine(DbEngine):
             # every statement, not just CREATE TABLE (ALTER/UPDATE use the
             # same sqlite idiom) — symmetric with the PG engine's shim
             sql = _replace_datetime_now(sql, _MYSQL_NOW)
-        elif stripped.startswith("create index"):
+        if stripped.startswith("create index"):
             m = re.match(r"(?is)^\s*CREATE\s+INDEX\s+(\S+)\s+ON\s+(\S+)\s*\(([^)]*)\)\s*$", sql)
             if m:
                 idx, table, cols = m.group(1), m.group(2), m.group(3)

@@ -77,9 +77,10 @@ class JwksCache:
     _fetched_at: float = 0.0
     _last_miss_refresh: float = 0.0
     _lock: asyncio.Lock = field(default_factory=asyncio.Lock)
-    #: bumped whenever a refetch lands a DIFFERENT kid set — consumers that
-    #: cache per-token validation results key their caches on this so a key
-    #: rotation invalidates tokens signed by withdrawn kids immediately
+    #: bumped whenever a refetch lands a DIFFERENT key set (new/removed kids
+    #: OR new material under a reused kid) — consumers that cache per-token
+    #: validation results key their caches on this so a key rotation
+    #: invalidates tokens signed by withdrawn keys immediately
     generation: int = 0
 
     async def _fetch(self) -> None:
@@ -103,7 +104,14 @@ class JwksCache:
                 keys[key.kid] = key
         if not keys:
             raise JwtError(f"JWKS at {self.jwks_url} contained no usable keys")
-        if set(keys) != set(self._keys):
+        # Compare key MATERIAL, not just kid names: a rotation that reuses a
+        # kid with a new modulus must still bump the generation, or validated-
+        # token caches keyed on it would keep honoring the withdrawn key.
+        def _material(ks: dict[str, JwtKey]) -> dict[str, tuple]:
+            return {k.kid: (k.alg, k.public_key_pem, k.secret)
+                    for k in ks.values()}
+
+        if _material(keys) != _material(self._keys):
             self.generation += 1
         self._keys = keys
         self._fetched_at = time.monotonic()

@@ -13,6 +13,8 @@ gateway+plugin pattern. Plugins implemented here:
 
 from __future__ import annotations
 
+import copy
+import dataclasses
 import logging
 import time
 from typing import Any, Optional
@@ -120,7 +122,14 @@ class JwtAuthnResolver(AuthnApi):
             if hit is not None:
                 good_until, ctx = hit
                 if time.monotonic() < good_until:
-                    return ctx
+                    # Fresh claims per hit: SecurityContext is frozen but its
+                    # claims mapping is not, and handing every request the
+                    # same dict would let one handler's mutation leak into the
+                    # next request's identity. Deep copy — IdP claims nest
+                    # (realm_access.roles, aud lists), and a shallow copy
+                    # would still share those inner containers.
+                    return dataclasses.replace(
+                        ctx, claims=copy.deepcopy(ctx.claims))
                 del self._cache[bearer_token]
         try:
             if self.jwks is not None:
@@ -173,7 +182,11 @@ class JwtAuthnResolver(AuthnApi):
             if ttl > 0:
                 if len(self._cache) >= self._cache_max:
                     self._cache.clear()  # bulk reset beats per-entry LRU here
-                self._cache[bearer_token] = (time.monotonic() + ttl, ctx)
+                # Cache a PRIVATE snapshot, not the ctx we hand out — the
+                # caller owns the returned claims dict and may mutate it.
+                self._cache[bearer_token] = (
+                    time.monotonic() + ttl,
+                    dataclasses.replace(ctx, claims=copy.deepcopy(claims)))
         return ctx
 
 

@@ -65,12 +65,22 @@ def load_program(mlir_path: str | Path, client: Any = None) -> LoadedProgram:
     return LoadedProgram(loaded, client, device)
 
 
+def _artifact_path(export_dir: Path, prog: dict) -> Path:
+    """Resolve a manifest ``path`` entry against the manifest's own directory
+    so relocated/renamed bundles stay consumable; absolute paths (written by
+    pre-round-4 exporters) are honored as-is when they still exist."""
+    p = Path(prog["path"])
+    if p.is_absolute() and p.exists():
+        return p
+    return export_dir / p.name if p.is_absolute() else export_dir / p
+
+
 def verify_manifest(export_dir: str | Path) -> dict:
     """Check every artifact's bytes against the manifest sha256."""
     export_dir = Path(export_dir)
     manifest = json.loads((export_dir / "manifest.json").read_text())
     for prog in manifest["programs"]:
-        data = Path(prog["path"]).read_bytes()
+        data = _artifact_path(export_dir, prog).read_bytes()
         digest = hashlib.sha256(data).hexdigest()
         if digest != prog["sha256"]:
             raise ValueError(
@@ -107,7 +117,7 @@ def run_conformance(export_dir: str | Path, *,
             for i in bundle[f"{name}.int4_in"].tolist():
                 args[i] = jnp.asarray(args[i]).astype(jnp.int4)
         expected = [bundle[f"{name}.out{i}"] for i in range(n_out)]
-        loaded = load_program(prog["path"])
+        loaded = load_program(_artifact_path(export_dir, prog))
         got = loaded.execute(args)
         assert len(got) == len(expected), (name, len(got), len(expected))
         for i, (g, e) in enumerate(zip(got, expected)):

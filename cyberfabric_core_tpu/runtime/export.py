@@ -83,7 +83,10 @@ def _write_artifact(out_dir: Path, stem: str, text: str,
     digest = hashlib.sha256(text.encode()).hexdigest()
     path = out_dir / f"{stem}.mlir"
     _atomic_write(path, text)
-    return ExportedProgram(name=stem, path=str(path), sha256=digest,
+    # Manifest-relative path: a bundle must stay consumable after being
+    # moved/renamed (or written with a relative out_dir and consumed from a
+    # different cwd) — consumers resolve it against the manifest's directory.
+    return ExportedProgram(name=stem, path=path.name, sha256=digest,
                            size_bytes=len(text), arg_shapes=arg_shapes)
 
 
@@ -246,6 +249,10 @@ def export_llama_programs(
         "decode_chunk": decode_chunk,
         "max_seq_len": max_seq_len,
         "exported_at": time.time(),
+        # program paths are manifest-relative; export_dir records where this
+        # bundle was originally written (informational — consumers resolve
+        # against wherever they actually find the manifest)
+        "export_dir": str(out_dir.resolve()),
         "programs": [vars(p) for p in programs],
     }
     _atomic_write(out_dir / "manifest.json", json.dumps(manifest, indent=1))
@@ -288,6 +295,7 @@ def export_bert_program(
         "batch": batch,
         "seq_len": seq_len,
         "exported_at": time.time(),
+        "export_dir": str(out_dir.resolve()),
         "programs": [vars(program)],
     }
     _atomic_write(out_dir / "manifest.json", json.dumps(manifest, indent=1))

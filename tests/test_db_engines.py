@@ -376,3 +376,17 @@ def test_mysql_engine_without_driver_raises():
 
     with pytest.raises(RuntimeError, match="pymysql-style driver"):
         MySQLEngine("mysql://nowhere/db", driver=None)
+
+
+def test_mysql_create_index_prefix_with_datetime_in_name():
+    """Round-3 advisory: a CREATE INDEX whose identifier contains 'datetime'
+    (e.g. created_datetime) used to skip the TEXT(191) prefix rewrite because
+    the rewrite was elif-chained to the datetime('now') shim."""
+    from cyberfabric_core_tpu.modkit.db_engine import MySQLEngine
+
+    driver = FakeMySQLDriver()
+    eng = MySQLEngine("mysql://root@h/d", driver=driver)
+    eng._column_needs_prefix = lambda table, col: True
+    out = eng._translate(
+        "CREATE INDEX ix_created_datetime ON t (created_datetime)")
+    assert "created_datetime(191)" in out

@@ -150,8 +150,8 @@ def test_pjrt_host_builds_and_parses_signature(tmp_path):
                               dtype=jnp.float32)
     for prog in m["programs"]:
         out = subprocess.run([str(root / "pjrt_host"), "--parse-only",
-                              prog["path"]], capture_output=True, text=True,
-                             timeout=60)
+                              str(tmp_path / prog["path"])],
+                             capture_output=True, text=True, timeout=60)
         assert out.returncode == 0, out.stdout + out.stderr
         sig = json.loads(out.stdout)
         assert sig["ok"] and sig["num_args"] >= 15
@@ -182,7 +182,8 @@ def test_pjrt_host_fails_cleanly_without_device(tmp_path):
                               prefill_bucket=32, decode_chunk=4,
                               dtype=jnp.float32)
     out = subprocess.run(
-        [str(root / "pjrt_host"), str(libtpu), m["programs"][0]["path"]],
+        [str(root / "pjrt_host"), str(libtpu),
+         str(tmp_path / m["programs"][0]["path"])],
         capture_output=True, text=True, timeout=120)
     verdict = json.loads(out.stdout.strip().splitlines()[-1])
     # on a TPU host this succeeds; here it must fail with a clean error

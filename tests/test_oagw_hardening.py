@@ -413,3 +413,55 @@ def test_pdf_decompression_bomb_capped():
     from cyberfabric_core_tpu.modules.file_parser_backends import parse_pdf
     with pytest.raises(ProblemError):
         parse_pdf(pdf)
+
+
+def test_jwks_same_kid_new_material_bumps_generation(jwks_server):
+    """Round-3 advisory: a rotation that REUSES a kid with new key material
+    must bump the cache generation (the validated-token cache keys on it), or
+    tokens signed by the withdrawn key keep validating for token_cache_ttl_s."""
+    loop, url, state = jwks_server
+    from cyberfabric_core_tpu.modkit.jwks import JwksCache
+
+    cache = JwksCache(jwks_url=url, cache_ttl_s=0.0, negative_cache_s=0.0)
+    loop.run_until_complete(cache.get_key("k1"))
+    gen0 = cache.generation
+    # same kid set, same material: no bump on refetch
+    loop.run_until_complete(cache.get_key("k1"))
+    assert cache.generation == gen0
+    # same kid, NEW secret: must bump
+    state["kids"] = {"k1": "secret-two"}
+    loop.run_until_complete(cache.get_key("k1"))
+    assert cache.generation == gen0 + 1
+
+
+def test_token_cache_hit_isolates_claims():
+    """Round-3 advisory: cache hits must not hand every request the same
+    mutable claims dict — one handler's mutation would leak into the next
+    request's identity."""
+    import asyncio as _asyncio
+
+    from cyberfabric_core_tpu.modules.resolvers import JwtAuthnResolver
+
+    resolver = JwtAuthnResolver(
+        {"keys": {"k1": {"alg": "HS256", "secret": "s"}}})
+    now = int(time.time())
+    tok = encode_hs256({"sub": "u1", "tenant_id": "t1", "exp": now + 60,
+                        "extra": "orig",
+                        "realm_access": {"roles": ["user"]}}, "s", kid="k1")
+    loop = _asyncio.new_event_loop()
+    try:
+        ctx1 = loop.run_until_complete(resolver.authenticate(tok, {}))
+        ctx1.claims["extra"] = "TAMPERED"
+        ctx1.claims["injected"] = True
+        # nested containers must be isolated too (IdP claims nest)
+        ctx1.claims["realm_access"]["roles"].append("admin")
+        ctx2 = loop.run_until_complete(resolver.authenticate(tok, {}))
+        assert ctx2.claims.get("extra") == "orig"
+        assert "injected" not in ctx2.claims
+        assert ctx2.claims["realm_access"]["roles"] == ["user"]
+        # and a hit's mutations must not taint the NEXT hit either
+        ctx2.claims["realm_access"]["roles"].append("admin")
+        ctx3 = loop.run_until_complete(resolver.authenticate(tok, {}))
+        assert ctx3.claims["realm_access"]["roles"] == ["user"]
+    finally:
+        loop.close()

@@ -18,7 +18,9 @@ def test_llama_export_artifacts(tmp_path):
     names = {p["name"] for p in m["programs"]}
     assert names == {"prefill-b1x32", "decode-k4"}
     for prog in m["programs"]:
-        text = Path(prog["path"]).read_text()
+        # manifest paths are manifest-relative (relocatable bundles)
+        assert not Path(prog["path"]).is_absolute()
+        text = (tmp_path / prog["path"]).read_text()
         assert text.startswith("module @")
         assert "stablehlo." in text          # real dialect ops, not HLO text
         assert hashlib.sha256(text.encode()).hexdigest() == prog["sha256"]
@@ -52,7 +54,7 @@ def test_quantized_export_differs(tmp_path):
                               decode_chunk=4, quantization="int8")
     assert q["quantization"] == "int8"
     # int8 weights show up as i8 tensors in the program signature
-    text = Path(q["programs"][1]["path"]).read_text()
+    text = (tmp_path / "int8" / q["programs"][1]["path"]).read_text()
     assert "xi8>" in text
     assert {p["sha256"] for p in q["programs"]} != \
         {p["sha256"] for p in base["programs"]}
@@ -63,7 +65,7 @@ def test_bert_export(tmp_path):
 
     m = export_bert_program("tiny-bert", tmp_path, batch=2, seq_len=32)
     assert m["architecture"] == "bert"
-    text = Path(m["programs"][0]["path"]).read_text()
+    text = (tmp_path / m["programs"][0]["path"]).read_text()
     assert "stablehlo." in text
 
 
@@ -121,9 +123,11 @@ def test_registry_export_endpoint(tmp_path):
 
     manifest = asyncio.new_event_loop().run_until_complete(go())
     assert len(manifest["programs"]) == 2
+    export_dir = Path(manifest["export_dir"])
+    assert str(export_dir).startswith(str(tmp_path))
     for prog in manifest["programs"]:
-        path = Path(prog["path"])
-        assert path.exists() and str(path).startswith(str(tmp_path))
+        path = export_dir / prog["path"]
+        assert path.exists()
         assert "stablehlo." in path.read_text()
 
 
@@ -186,7 +190,7 @@ def test_consume_detects_tampered_artifact(tmp_path):
                               prefill_bucket=32, decode_chunk=4,
                               dtype=jnp.float32)
     verify_manifest(tmp_path)  # clean passes
-    victim = m["programs"][0]["path"]
+    victim = tmp_path / m["programs"][0]["path"]
     data = open(victim).read()
     open(victim, "w").write(data.replace("stablehlo", "stablehlx", 1))
     with _pytest.raises(ValueError, match="digest"):
@@ -217,3 +221,24 @@ def test_int4_export_conformance_replays(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     verdict = json.loads(proc.stdout.strip().splitlines()[-1])
     assert verdict["ok"], verdict
+
+
+def test_relocated_bundle_still_verifies(tmp_path):
+    """Round-3 advisory: manifest paths are manifest-relative, so a bundle
+    that is moved or renamed after export must still digest-verify and
+    conformance-replay from its new location."""
+    import shutil
+
+    import jax.numpy as jnp
+
+    from cyberfabric_core_tpu.runtime.consume import run_conformance, verify_manifest
+    from cyberfabric_core_tpu.runtime.export import export_llama_programs
+
+    export_llama_programs("tiny-llama", tmp_path / "orig", max_seq_len=128,
+                          prefill_bucket=32, decode_chunk=4,
+                          dtype=jnp.float32, conformance=True)
+    moved = tmp_path / "relocated" / "renamed-bundle"
+    shutil.move(str(tmp_path / "orig"), str(moved))
+    verify_manifest(moved)
+    verdict = run_conformance(moved)
+    assert verdict["executed"]
