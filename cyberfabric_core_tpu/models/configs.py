@@ -133,6 +133,34 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         tie_embeddings=True, hidden_act="gelu", norm_weight_offset=1.0,
         embedding_multiplier=8.0, final_logit_softcap=30.0,
     ),
+    # golden-parity configs: exact mirrors of the committed HF fixtures under
+    # tests/golden/fixtures/ (tests/golden/generate_fixtures.py) — kept in the
+    # registry so the worker's checkpoint-path flow serves them end-to-end
+    "tiny-llama-golden": ModelConfig(
+        name="tiny-llama-golden", architecture="llama", vocab_size=512,
+        hidden_size=64, intermediate_size=128, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, max_position=256, rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+    ),
+    "tiny-qwen2-golden": ModelConfig(
+        name="tiny-qwen2-golden", architecture="llama", vocab_size=512,
+        hidden_size=64, intermediate_size=128, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, max_position=256, rope_theta=1e6,
+        rms_norm_eps=1e-6, tie_embeddings=True, attention_bias=True,
+    ),
+    "tiny-gemma-golden": ModelConfig(
+        name="tiny-gemma-golden", architecture="llama", vocab_size=512,
+        hidden_size=64, intermediate_size=128, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, max_position=256, rope_theta=10000.0,
+        rms_norm_eps=1e-6, tie_embeddings=True, hidden_act="gelu_pytorch_tanh",
+        norm_weight_offset=1.0, embedding_multiplier=8.0,
+    ),
+    "tiny-mixtral-golden": ModelConfig(
+        name="tiny-mixtral-golden", architecture="llama", vocab_size=512,
+        hidden_size=64, intermediate_size=128, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, max_position=256, rope_theta=1e6,
+        rms_norm_eps=1e-5, num_experts=4, experts_per_token=2,
+    ),
     "bge-base-en": ModelConfig(
         name="bge-base-en", architecture="bert", vocab_size=30522, hidden_size=768,
         intermediate_size=3072, num_layers=12, num_heads=12, num_kv_heads=12,

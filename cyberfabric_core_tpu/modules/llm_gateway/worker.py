@@ -26,7 +26,8 @@ from typing import Any, AsyncIterator, Optional
 from ...modkit.errors import ProblemError
 from ...runtime.engine import EngineConfig, InferenceEngine, SamplingParams, StepEvent
 from ...runtime.scheduler import ContinuousBatchingEngine
-from ...runtime.tokenizer import ByteTokenizer, Tokenizer, load_tokenizer, render_chat
+from ...runtime.tokenizer import (CHAT_FAMILIES, ByteTokenizer, Tokenizer,
+                                  chat_family_for, load_tokenizer, render_chat)
 from ..sdk import ChatStreamChunk, LlmWorkerApi, ModelInfo
 
 logger = logging.getLogger("llm_worker")
@@ -238,6 +239,14 @@ class LocalTpuWorker(LlmWorkerApi):
     def _build_entry(self, model: ModelInfo) -> _EngineEntry:
         opts = dict(model.engine_options or {})
         arch_config = opts.pop("model_config", None) or model.provider_model_id
+        # registry can pin the chat template family; otherwise inferred from
+        # the architecture config name (gemma → gemma turns, qwen → ChatML)
+        chat_family = opts.pop("chat_family", None) or chat_family_for(arch_config)
+        if chat_family not in CHAT_FAMILIES:
+            # fail at engine build, not as silent generic 'role: text' prompts
+            raise ValueError(
+                f"unknown engine_options.chat_family {chat_family!r} for "
+                f"{model.canonical_id}; known: {CHAT_FAMILIES}")
         max_seq_len = int(opts.pop("max_seq_len", 2048))
         max_batch = int(opts.pop("max_batch", 8))
         page_size = int(opts.pop("prefix_page_size", 64))
@@ -293,7 +302,7 @@ class LocalTpuWorker(LlmWorkerApi):
                         model.canonical_id, arch_config, eng_cfg.max_batch,
                         eng_cfg.max_seq_len)
             return _EngineEntry(config=eng_cfg, tokenizer=tokenizer,
-                                scheduler=scheduler)
+                                scheduler=scheduler, model_family=chat_family)
         engine = InferenceEngine(eng_cfg)
         if params is not None:
             engine.params = params
@@ -303,6 +312,7 @@ class LocalTpuWorker(LlmWorkerApi):
             config=eng_cfg,
             engine=engine,
             tokenizer=tokenizer,
+            model_family=chat_family,
             batcher=_DynamicBatcher(
                 engine, self._executor,
                 window_ms=float(self._config.get("batch_window_ms", 4.0)),
@@ -322,8 +332,11 @@ class LocalTpuWorker(LlmWorkerApi):
                 "text": render_tools_preamble(params["_resolved_tools"])}]}
             messages = [preamble] + list(messages)
         prompt = render_chat(messages, entry.model_family)
+        # the rendered template carries bos/specials literally — encoding must
+        # not let a tokenizer post-processor add a second bos
         async for chunk in self._generate_from_ids(
-                entry, model, entry.tokenizer.encode(prompt), params):
+                entry, model,
+                entry.tokenizer.encode(prompt, add_specials=False), params):
             yield chunk
 
     async def completion_stream(

@@ -15,7 +15,7 @@ class Tokenizer(Protocol):
     bos_id: int
     eos_id: int
 
-    def encode(self, text: str) -> list[int]: ...
+    def encode(self, text: str, add_specials: bool = True) -> list[int]: ...
     def decode(self, ids: Sequence[int]) -> str: ...
 
 
@@ -28,8 +28,9 @@ class ByteTokenizer:
     def __init__(self, vocab_size: int = 512) -> None:
         self.vocab_size = vocab_size
 
-    def encode(self, text: str) -> list[int]:
-        return [self.bos_id] + [self._OFFSET + b for b in text.encode("utf-8")]
+    def encode(self, text: str, add_specials: bool = True) -> list[int]:
+        lead = [self.bos_id] if add_specials else []
+        return lead + [self._OFFSET + b for b in text.encode("utf-8")]
 
     def decode(self, ids: Sequence[int]) -> str:
         # ids beyond the byte range (vocab slack above 258, e.g. random-weight
@@ -60,8 +61,11 @@ class HfTokenizer:
                 return tid
         return default
 
-    def encode(self, text: str) -> list[int]:
-        return self._tok.encode(text).ids
+    def encode(self, text: str, add_specials: bool = True) -> list[int]:
+        # add_specials=False for chat-templated prompts: the rendered template
+        # already carries bos/headers literally, and a tokenizer.json whose
+        # post-processor auto-adds bos would otherwise double it.
+        return self._tok.encode(text, add_special_tokens=add_specials).ids
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
@@ -76,9 +80,45 @@ def load_tokenizer(model_dir: Optional[str | Path], vocab_size: int = 512) -> To
     return ByteTokenizer(vocab_size)
 
 
+#: families render_chat implements; worker validates engine_options.chat_family
+#: against this so a typo fails at engine build, not as silent generic prompts
+CHAT_FAMILIES = ("llama", "qwen2", "chatml", "gemma", "mistral", "generic")
+
+
+def chat_family_for(model_name: str) -> str:
+    """Model/config name → chat-template family (worker uses this when the
+    registry entry doesn't pin one explicitly via engine_options.chat_family)."""
+    n = model_name.lower()
+    if "gemma" in n:
+        return "gemma"
+    if "qwen" in n:
+        return "qwen2"
+    if "mistral" in n or "mixtral" in n:
+        return "mistral"
+    return "llama"
+
+
+def _fold_system_into_user(messages: list[tuple[str, str]],
+                           system_parts: list[tuple[int, str]]) -> list[tuple[str, str]]:
+    """Fold each system text into the user turn at its own position, or
+    insert a synthetic user turn there when the next turn isn't user — for
+    families whose published template has no system role. Chronological order
+    is preserved and no instruction is ever silently dropped."""
+    out = list(messages)
+    for idx, text in reversed(system_parts):
+        if idx < len(out) and out[idx][0] == "user":
+            out[idx] = ("user", f"{text}\n\n{out[idx][1]}")
+        else:
+            out.insert(min(idx, len(out)), ("user", text))
+    return out
+
+
 def render_chat(messages: list[dict], model_family: str = "llama") -> str:
-    """Messages → prompt text. Content is ALWAYS an array of parts per the wire
-    contract (core/message.v1.schema.json — SURVEY §8.1); text parts are joined."""
+    """Messages → prompt text, matching each family's published chat template
+    byte-for-byte (pinned against transformers' apply_chat_template in
+    tests/test_golden_parity.py). Content is ALWAYS an array of parts per the
+    wire contract (core/message.v1.schema.json — SURVEY §8.1); text parts are
+    joined."""
 
     def text_of(content) -> str:
         if isinstance(content, str):
@@ -86,10 +126,52 @@ def render_chat(messages: list[dict], model_family: str = "llama") -> str:
         return "".join(p.get("text", "") for p in content if p.get("type", "text") == "text")
 
     if model_family == "llama":
+        # Llama-3 instruct format: bos, then per-message header blocks, then
+        # the assistant generation header.
+        out = ["<|begin_of_text|>"]
+        for m in messages:
+            out.append(f"<|start_header_id|>{m['role']}<|end_header_id|>"
+                       f"\n\n{text_of(m['content']).strip()}<|eot_id|>")
+        out.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        return "".join(out)
+    if model_family in ("qwen2", "chatml"):
+        # ChatML (Qwen2 family): <|im_start|>role\ncontent<|im_end|>\n
         out = []
         for m in messages:
-            out.append(f"<|start_header_id|>{m['role']}<|end_header_id|>\n\n{text_of(m['content'])}<|eot_id|>")
-        out.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+            out.append(f"<|im_start|>{m['role']}\n"
+                       f"{text_of(m['content'])}<|im_end|>\n")
+        out.append("<|im_start|>assistant\n")
+        return "".join(out)
+    if model_family in ("gemma", "mistral"):
+        # Neither family's published template has a system role — system turns
+        # fold into the next user turn (or become one) instead of crashing the
+        # wire contract or being dropped.
+        system_parts: list[tuple[int, str]] = []
+        turns: list[tuple[str, str]] = []
+        for m in messages:
+            role = m["role"]
+            if role == "system":
+                system_parts.append((len(turns), text_of(m["content"]).strip()))
+                continue
+            turns.append((role, text_of(m["content"]).strip()))
+        turns = _fold_system_into_user(turns, system_parts)
+        if model_family == "gemma":
+            # Gemma turns: assistant renders as "model"
+            out = ["<bos>"]
+            for role, text in turns:
+                out.append(f"<start_of_turn>"
+                           f"{'model' if role == 'assistant' else role}\n"
+                           f"{text}<end_of_turn>\n")
+            out.append("<start_of_turn>model\n")
+            return "".join(out)
+        # Mistral/Mixtral [INST] format: generation continues after [/INST],
+        # so there is no generation-prompt suffix.
+        out = ["<s>"]
+        for role, text in turns:
+            if role == "user":
+                out.append(f"[INST] {text} [/INST]")
+            elif role == "assistant":
+                out.append(f"{text}</s>")
         return "".join(out)
     # generic fallback
     lines = [f"{m['role']}: {text_of(m['content'])}" for m in messages]

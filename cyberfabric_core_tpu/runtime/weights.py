@@ -43,7 +43,18 @@ _LLAMA_MAP: dict[str, tuple[str, bool]] = {
     "layers.gate": ("model.layers.{i}.mlp.gate_proj.weight", True),
     "layers.up": ("model.layers.{i}.mlp.up_proj.weight", True),
     "layers.down": ("model.layers.{i}.mlp.down_proj.weight", True),
+    # Mixtral-family MoE (present only when cfg.num_experts > 0); {e} = expert.
+    # HF w1=gate [I,H], w3=up [I,H], w2=down [H,I]; router gate [E,H].
+    "layers.router": ("model.layers.{i}.block_sparse_moe.gate.weight", True),
+    "layers.moe_gate": ("model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight", True),
+    "layers.moe_up": ("model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight", True),
+    "layers.moe_down": ("model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight", True),
 }
+
+#: leaves that exist only in one MLP variant — the loader picks per config
+_DENSE_MLP_LEAVES = ("layers.gate", "layers.up", "layers.down")
+_MOE_LEAVES = ("layers.router", "layers.moe_gate", "layers.moe_up",
+               "layers.moe_down")
 
 
 class SafetensorsIndex:
@@ -122,10 +133,23 @@ def load_llama_params(
         if leaf in ("layers.bq", "layers.bk", "layers.bv") \
                 and not cfg.attention_bias:
             continue
+        if leaf in _MOE_LEAVES and cfg.num_experts == 0:
+            continue
+        if leaf in _DENSE_MLP_LEAVES and cfg.num_experts > 0:
+            continue
         if "{i}" not in tmpl:
             t = idx.load(tmpl)
             params_leaf = t.T if transpose else t
             _set(params, leaf, put(leaf, params_leaf))
+        elif "{e}" in tmpl:
+            stack = []
+            for i in range(cfg.num_layers):
+                experts = []
+                for e in range(cfg.num_experts):
+                    t = idx.load(tmpl.format(i=i, e=e))
+                    experts.append(t.T if transpose else t)
+                stack.append(np.stack(experts))
+            _set(params, leaf, put(leaf, np.stack(stack)))  # [L, E, ...]
         else:
             stack = []
             for i in range(cfg.num_layers):
@@ -257,6 +281,13 @@ def save_llama_params(params: dict, cfg: ModelConfig, out_dir: str | Path) -> Pa
         # contiguous or the file silently holds the untransposed layout
         if "{i}" not in tmpl:
             tensors[tmpl] = np.ascontiguousarray(arr.T) if transpose else arr
+        elif "{e}" in tmpl:
+            for i in range(cfg.num_layers):
+                for e in range(cfg.num_experts):
+                    t = arr[i, e]
+                    tensors[tmpl.format(i=i, e=e)] = (
+                        np.ascontiguousarray(t.T) if transpose
+                        else np.ascontiguousarray(t))
         else:
             for i in range(cfg.num_layers):
                 t = arr[i]

@@ -1,0 +1,248 @@
+"""Generate-once golden fixtures: tiny REAL HF checkpoints + tokenizer + chat
+templates, with reference outputs produced by torch/transformers — the
+known-good implementation every numeric claim in tests/test_golden_parity.py
+is pinned against.
+
+Run from the repo root (only needed to REgenerate; artifacts are committed):
+
+    python tests/golden/generate_fixtures.py
+
+Mirrors the reference's golden discipline for its file-parser module
+(testing/e2e/modules/file_parser/generate_file_parser_golden.py — generator
+committed next to its outputs), applied to the model tier as SURVEY §4(5)
+requires ("golden-output tests for tokenization/decode parity").
+
+Why random-init instead of pretrained: this environment has zero egress, so
+no hub downloads — but parity does not care about weight VALUES, it cares
+that our loader maps/transposes every tensor correctly and our forward
+implements the same math. Seeded random weights through the real HF
+modeling code give exactly that oracle; a transposed map entry, a wrong
+norm offset, or a broken template shifts logits far beyond tolerance.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+# ----------------------------------------------------------------- models
+
+#: family → (HF config ctor kwargs, our ModelConfig kwargs). Dims are chosen
+#: tiny but non-degenerate: GQA (4q/2kv), head_dim ≠ hidden/heads nowhere,
+#: intermediate ≠ hidden, ≥2 layers so stacking order bugs show.
+SEED = 20260730
+
+
+def _conversation():
+    """The canonical chat used for template goldens (content as the wire's
+    part-array on our side; plain strings on the HF side)."""
+    return [
+        {"role": "system", "content": "Answer tersely."},
+        {"role": "user", "content": "What is a TPU?"},
+        {"role": "assistant", "content": "A matrix-multiply accelerator."},
+        {"role": "user", "content": "  And an MXU?  "},
+    ]
+
+
+def gen_checkpoints() -> None:
+    import torch
+    from transformers import (GemmaConfig, GemmaForCausalLM, LlamaConfig,
+                              LlamaForCausalLM, MixtralConfig,
+                              MixtralForCausalLM, Qwen2Config,
+                              Qwen2ForCausalLM)
+
+    families = {
+        "tiny-llama-golden": (LlamaForCausalLM, LlamaConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, max_position_embeddings=256, rope_theta=10000.0,
+            rms_norm_eps=1e-5, tie_word_embeddings=False,
+            attention_bias=False)),
+        "tiny-qwen2-golden": (Qwen2ForCausalLM, Qwen2Config(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=256, rope_theta=1e6, rms_norm_eps=1e-6,
+            tie_word_embeddings=True)),
+        "tiny-gemma-golden": (GemmaForCausalLM, GemmaConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, max_position_embeddings=256, rope_theta=10000.0,
+            rms_norm_eps=1e-6, hidden_activation="gelu_pytorch_tanh")),
+        "tiny-mixtral-golden": (MixtralForCausalLM, MixtralConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=256, rope_theta=1e6, rms_norm_eps=1e-5,
+            tie_word_embeddings=False, num_local_experts=4,
+            num_experts_per_tok=2, router_aux_loss_coef=0.0,
+            output_router_logits=False, sliding_window=None)),
+    }
+    rng = np.random.default_rng(SEED)
+    for name, (cls, hf_cfg) in families.items():
+        torch.manual_seed(SEED)
+        model = cls(hf_cfg).eval().to(torch.float32)
+        out_dir = FIXTURES / name
+        out_dir.mkdir(parents=True, exist_ok=True)
+        model.save_pretrained(out_dir, safe_serialization=True)
+        # prompt ids: deterministic, includes id 0 and near-vocab-top ids
+        ids = rng.integers(0, hf_cfg.vocab_size, size=(2, 12), dtype=np.int64)
+        ids[0, 0] = 0
+        ids[1, -1] = hf_cfg.vocab_size - 1
+        with torch.no_grad():
+            logits = model(torch.from_numpy(ids)).logits.numpy()
+            # greedy continuation, 16 tokens, batch row 0 only (full-forward
+            # greedy: recompute each step — the oracle for incremental decode)
+            seq = ids[:1].copy()
+            for _ in range(16):
+                step = model(torch.from_numpy(seq)).logits[0, -1]
+                nxt = int(torch.argmax(step))
+                seq = np.concatenate([seq, [[nxt]]], axis=1)
+        np.savez(out_dir / "golden.npz", input_ids=ids.astype(np.int32),
+                 logits=logits.astype(np.float32),
+                 greedy_ids=seq[0].astype(np.int32))
+        n_params = sum(p.numel() for p in model.parameters())
+        print(f"{name}: {n_params} params, logits {logits.shape}, "
+              f"|logit| mean {np.abs(logits).mean():.4f}")
+
+
+# -------------------------------------------------------------- tokenizer
+
+LLAMA3_TEMPLATE = (
+    "{{- bos_token }}{%- for message in messages %}"
+    "{{- '<|start_header_id|>' + message['role'] + '<|end_header_id|>\n\n' "
+    "+ message['content'] | trim + '<|eot_id|>' }}{%- endfor %}"
+    "{%- if add_generation_prompt %}"
+    "{{- '<|start_header_id|>assistant<|end_header_id|>\n\n' }}{%- endif %}")
+
+CHATML_TEMPLATE = (
+    "{%- for message in messages %}"
+    "{{ '<|im_start|>' + message['role'] + '\n' + message['content'] "
+    "+ '<|im_end|>\n' }}{%- endfor %}"
+    "{%- if add_generation_prompt %}{{ '<|im_start|>assistant\n' }}"
+    "{%- endif %}")
+
+MISTRAL_TEMPLATE = (
+    "{{ bos_token }}{%- for message in messages %}"
+    "{%- if message['role'] == 'user' %}"
+    "{{ '[INST] ' + (message['content'] | trim) + ' [/INST]' }}"
+    "{%- elif message['role'] == 'assistant' %}"
+    "{{ (message['content'] | trim) + eos_token }}"
+    "{%- endif %}{%- endfor %}")
+
+GEMMA_TEMPLATE = (
+    "{{ bos_token }}{%- for message in messages %}"
+    "{%- set role = 'model' if message['role'] == 'assistant' "
+    "else message['role'] %}"
+    "{{ '<start_of_turn>' + role + '\n' + (message['content'] | trim) "
+    "+ '<end_of_turn>\n' }}{%- endfor %}"
+    "{%- if add_generation_prompt %}{{ '<start_of_turn>model\n' }}"
+    "{%- endif %}")
+
+SPECIALS = [
+    "<|pad|>", "<|begin_of_text|>", "<|end_of_text|>", "<|start_header_id|>",
+    "<|end_header_id|>", "<|eot_id|>", "<|im_start|>", "<|im_end|>",
+    "<bos>", "<eos>", "<start_of_turn>", "<end_of_turn>",
+]
+
+CORPUS = [
+    "A TPU multiplies matrices in a systolic array.",
+    "The MXU runs bfloat16 matmuls; HBM bandwidth bounds decode.",
+    "Ring attention rotates key/value blocks over the ICI mesh.",
+    "Paged attention keeps the KV cache in fixed-size pages.",
+    "Sharding follows the mesh: dp, tp, sp, ep, pp.",
+    "jit compiles once; scan carries the cache in place.",
+    "Tokenizers split text into subword units deterministically.",
+    "def forward(params, ids): return logits",
+    "print('hello, world') # 123456789",
+]
+
+
+def gen_tokenizer() -> None:
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    out_dir = FIXTURES / "tokenizer"
+    out_dir.mkdir(parents=True, exist_ok=True)
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(vocab_size=480, special_tokens=SPECIALS)
+    tok.train_from_iterator(CORPUS, trainer)
+    tok.save(str(out_dir / "tokenizer.json"))
+
+    # golden encode/decode pairs from the tokenizers library itself
+    samples = [
+        "A TPU multiplies matrices.",
+        "hello, world",
+        "naïve café — ünïcödé",
+        "<|begin_of_text|>raw specials pass through<|eot_id|>",
+        "",
+    ]
+    pairs = []
+    for s in samples:
+        ids = tok.encode(s).ids
+        pairs.append({"text": s, "ids": ids,
+                      "decoded": tok.decode(ids, skip_special_tokens=True)})
+    (out_dir / "golden_tokenizer.json").write_text(
+        json.dumps({"vocab_size": tok.get_vocab_size(), "pairs": pairs},
+                   ensure_ascii=False, indent=1))
+    print(f"tokenizer: vocab {tok.get_vocab_size()}, {len(pairs)} golden pairs")
+
+
+def gen_chat_templates() -> None:
+    """Render the canonical conversation through transformers' OWN Jinja
+    engine (apply_chat_template) for each family's template — the golden
+    our render_chat must reproduce byte-for-byte."""
+    from tokenizers import Tokenizer as RawTok
+    from transformers import PreTrainedTokenizerFast
+
+    out_dir = FIXTURES / "tokenizer"
+    raw = RawTok.from_file(str(out_dir / "tokenizer.json"))
+    conv = [{"role": m["role"], "content": m["content"]}
+            for m in _conversation()]
+    goldens = {}
+    for family, template, bos, eos in [
+        ("llama", LLAMA3_TEMPLATE, "<|begin_of_text|>", "<|end_of_text|>"),
+        ("qwen2", CHATML_TEMPLATE, "<|im_start|>", "<|im_end|>"),
+        ("gemma", GEMMA_TEMPLATE, "<bos>", "<eos>"),
+        ("mistral", MISTRAL_TEMPLATE, "<s>", "</s>"),
+    ]:
+        t = PreTrainedTokenizerFast(tokenizer_object=raw, bos_token=bos,
+                                    eos_token=eos)
+        t.chat_template = template
+        # gemma/mistral published templates have no system role — goldens use
+        # the system-free slice; our system-folding is unit-tested separately
+        msgs = conv if family in ("llama", "qwen2") else [
+            m for m in conv if m["role"] != "system"]
+        goldens[family] = {
+            "messages": msgs,
+            "rendered": t.apply_chat_template(
+                msgs, tokenize=False, add_generation_prompt=True),
+            "template": template,
+        }
+    (out_dir / "golden_chat.json").write_text(
+        json.dumps(goldens, ensure_ascii=False, indent=1))
+    for fam, g in goldens.items():
+        print(f"chat[{fam}]: {len(g['rendered'])} chars")
+
+
+def distribute_tokenizer() -> None:
+    """Every checkpoint dir carries the tokenizer.json so the worker's
+    checkpoint-path flow (load_llama_params + load_tokenizer from the same
+    dir) exercises the HF tokenizer path end-to-end."""
+    import shutil
+
+    src = FIXTURES / "tokenizer" / "tokenizer.json"
+    for d in FIXTURES.iterdir():
+        if d.is_dir() and (d / "model.safetensors").exists():
+            shutil.copy(src, d / "tokenizer.json")
+
+
+if __name__ == "__main__":
+    gen_checkpoints()
+    gen_tokenizer()
+    gen_chat_templates()
+    distribute_tokenizer()
+    print("fixtures written to", FIXTURES)
