@@ -5,9 +5,9 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint fuzz sanitizers contracts test native
+.PHONY: safety lint fuzz sanitizers contracts test native aot-tpu
 
-safety: lint fuzz sanitizers contracts  ## the full local gate
+safety: lint fuzz sanitizers contracts aot-tpu  ## the full local gate
 
 lint:  ## architectural lints (dylint equivalent: L1-L7 incl. DE07/DE08)
 	$(PY) -m pytest tests/test_arch_lint.py -q
@@ -22,6 +22,9 @@ sanitizers:  ## TSAN/ASAN exercise of the native allocator + radix tree
 contracts:  ## OpenAPI golden gate + GTS docs validation (oasdiff equivalent)
 	$(PY) -m pytest tests/test_openapi_contract.py -q
 	$(PY) -m cyberfabric_core_tpu.apps.gts_docs_validator docs config README.md --vendor x
+
+aot-tpu:  ## TPU lowering gate: serving set compiles for v5e via topology AOT
+	$(PY) -m pytest tests/test_aot_tpu.py -q
 
 test:  ## full suite
 	$(PY) -m pytest tests/ -q

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import attention_with_cache
 from ..ops.norms import rms_norm
+from ..ops.platform import default_interpret as _default_interpret
 from ..ops.rope import apply_rope, rope_frequencies
 from .configs import ModelConfig
 
@@ -316,7 +317,7 @@ def forward(
 
             attn = flash_self_attention(
                 q, kproj, vproj, kv_len_after,
-                interpret=jax.devices()[0].platform != "tpu",
+                interpret=_default_interpret(),
                 sliding_window=cfg.sliding_window,
             )
         else:
@@ -361,7 +362,7 @@ def forward_paged_decode(
     from ..ops.paged_attention import paged_decode_attention
 
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+        interpret = _default_interpret()
     cos_t, sin_t = rope_tables
     B = input_ids.shape[0]
     Hq, D = cfg.num_heads, cfg.head_dim

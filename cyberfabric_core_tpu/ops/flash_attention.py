@@ -203,4 +203,6 @@ def flash_self_attention(
 
 
 def flash_available() -> bool:
-    return jax.devices()[0].platform == "tpu"
+    from .platform import default_interpret
+
+    return not default_interpret()

@@ -1,0 +1,43 @@
+"""Kernel lowering-mode selection.
+
+Pallas kernels run in interpret mode off-TPU (CPU tests) and compiled Mosaic
+on TPU. The default check asks the LIVE backend (``jax.devices()``) — but AOT
+compilation against a TPU *topology description* happens on a CPU host where
+that check would silently bake interpret=True into the lowered program,
+defeating the whole point of proving TPU lowering (round-3 verdict item 2).
+``compiled_kernels()`` overrides the check for the AOT path.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from contextvars import ContextVar
+
+#: ContextVar, NOT a module global: the override must be invisible to other
+#: threads (a server warmup tracing an engine while an AOT compile runs would
+#: otherwise bake interpret=False into its jit cache and crash on CPU later).
+_FORCE_COMPILED: ContextVar[bool] = ContextVar("force_compiled_kernels",
+                                               default=False)
+
+
+def default_interpret() -> bool:
+    """True → pallas interpret mode (no Mosaic). False on real TPU backends
+    and inside ``compiled_kernels()`` (AOT lowering for a TPU topology)."""
+    if _FORCE_COMPILED.get():
+        return False
+    import jax
+
+    return jax.devices()[0].platform != "tpu"
+
+
+@contextmanager
+def compiled_kernels():
+    """Force pallas kernels to lower as real Mosaic kernels even though the
+    live backend is not a TPU — used when tracing/lowering against a TPU
+    topology description (runtime/aot_tpu.py). Scoped to the current context
+    (thread/task), so concurrent tracing elsewhere keeps CPU semantics."""
+    token = _FORCE_COMPILED.set(True)
+    try:
+        yield
+    finally:
+        _FORCE_COMPILED.reset(token)

@@ -1,0 +1,350 @@
+"""AOT TPU compilation against a topology description — no chip required.
+
+Round-3 verdict item 2: the Pallas kernels had "only ever run in
+interpret/CPU mode; TPU tiling/lowering failures would be invisible today."
+This module compiles the REAL serving program set — the exact program bodies
+`runtime/scheduler.py:_build_programs` jits (bucketed flash prefill, fused
+paged-decode chunk with the ragged paged-attention kernel, int8/int4
+variants) — for a TPU topology (libtpu PJRT topology, e.g. ``v5e:2x2``) on a
+CPU-only host. Pallas kernels lower through Mosaic for real
+(`ops/platform.compiled_kernels`), XLA runs its full TPU pipeline, and the
+serialized executables mean hardware day is execution-only.
+
+SURVEY §7 stage 3 / BASELINE.json north star (llama-3-8b serving on v5e).
+CLI:
+
+    python -m cyberfabric_core_tpu.runtime.aot_tpu --model llama-3-8b \
+        --quant int8 --topology v5e:2x2 --out aot_artifacts/
+
+Reference anchor: the reference's AOT story is per-architecture artifact
+emission keyed by digest (model-registry PRD.md:200-224); here the target is
+a serialized TPU executable rather than source IR — one step further down
+the same pipeline as runtime/export.py's StableHLO artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+from ..models.configs import ModelConfig, get_config
+from ..ops.platform import compiled_kernels
+from ..ops.sampling import sample_token, sample_token_per_slot, split_keys_per_slot
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
+
+
+def tpu_topology(name: str = "v5e:2x2"):
+    """PJRT TPU topology description (no device needed). Known names include
+    v5e:1x1 … v5e:4x4 etc.; requires the libtpu wheel, present in this image."""
+    from jax.experimental import topologies
+
+    return topologies.get_topology_desc(platform="tpu", topology_name=name)
+
+
+def _replicated(topo_devices, n: int = 1):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(topo_devices[:n]).reshape(n), ("tp",))
+    return mesh, NamedSharding(mesh, P())
+
+
+def _with_sharding(tree, sharding):
+    """ShapeDtypeStruct tree pinned to a sharding (replicated by default) —
+    lowering needs a device placement to know its compile target."""
+    return jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sharding),
+        tree)
+
+
+def _abstract_params(cfg: ModelConfig, dtype, quantization: str):
+    from .quant import quant_bits, quantize_llama_params
+
+    bits = quant_bits(quantization)
+
+    def build(key):
+        p = llama.init_params(cfg, key, dtype)
+        return quantize_llama_params(p, bits) if bits else p
+
+    return jax.eval_shape(build, jax.random.PRNGKey(0))
+
+
+def serving_programs(
+    model: str,
+    *,
+    dtype=jnp.bfloat16,
+    quantization: str = "none",
+    prefill_bucket: int = 512,
+    decode_chunk: int = 16,
+    max_batch: int = 8,
+    page_size: int = 64,
+    max_seq_len: int = 2048,
+) -> dict[str, tuple[Any, tuple]]:
+    """name → (fn, abstract_args): the scheduler's program set, abstracted.
+
+    Bodies intentionally mirror runtime/scheduler.py:_build_programs — same
+    flash prefill + sample fusion, same scan-fused paged decode chunk — so a
+    lowering failure here is a lowering failure of the real serving path.
+    """
+    cfg = get_config(model)
+    if prefill_bucket > max_seq_len:
+        raise ValueError("prefill_bucket must fit max_seq_len")
+    rope = llama.rope_frequencies(cfg.head_dim, cfg.max_position, cfg.rope_theta)
+    params_abs = _abstract_params(cfg, dtype, quantization)
+    sds = jax.ShapeDtypeStruct
+
+    def prefill(params, ids, lengths, rng, temp, top_p, top_k, rope_t):
+        last_h, kv = llama.prefill_collect(params, cfg, ids, lengths, rope_t,
+                                           use_flash=True)
+        logits = llama.lm_head_logits(params, cfg, last_h)
+        rng, sub = jax.random.split(rng)
+        return sample_token(logits, sub, temp, top_p, top_k), kv, rng
+
+    key_abs = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    prefill_args = (
+        params_abs,
+        sds((1, prefill_bucket), jnp.int32),
+        sds((1,), jnp.int32),
+        key_abs,
+        sds((1,), jnp.float32),
+        sds((1,), jnp.float32),
+        sds((1,), jnp.int32),
+        jax.eval_shape(lambda: rope),
+    )
+
+    n_pages = max_batch * (-(-max_seq_len // page_size)) + 1
+    pmax = -(-max_seq_len // page_size)
+    pool_sds = sds((cfg.num_layers, n_pages, page_size, cfg.num_kv_heads,
+                    cfg.head_dim), dtype)
+
+    def paged_decode_chunk(params, k_pool, v_pool, page_table, last_tokens,
+                           lengths, keys, temp, top_p, top_k):
+        def step(carry, _):
+            pools, toks, lens, keys = carry
+            hidden, pools = llama.forward_paged_decode(
+                params, cfg, toks[:, None], pools, page_table, lens, rope)
+            logits = llama.lm_head_logits(params, cfg, hidden[:, 0, :])
+            keys, subs = split_keys_per_slot(keys)
+            nxt = sample_token_per_slot(logits, subs, temp, top_p, top_k)
+            return (pools, nxt, lens + 1, keys), nxt
+
+        (pools, last, _, keys), toks = jax.lax.scan(
+            step, ((k_pool, v_pool), last_tokens, lengths, keys),
+            None, length=decode_chunk)
+        return toks.T, pools[0], pools[1], last, keys
+
+    keys_abs = jax.eval_shape(
+        lambda: jax.random.split(jax.random.PRNGKey(0), max_batch))
+    decode_args = (
+        params_abs, pool_sds, pool_sds,
+        sds((max_batch, pmax), jnp.int32),
+        sds((max_batch,), jnp.int32),
+        sds((max_batch,), jnp.int32),
+        keys_abs,
+        sds((max_batch,), jnp.float32),
+        sds((max_batch,), jnp.float32),
+        sds((max_batch,), jnp.int32),
+    )
+    return {
+        f"prefill-flash-b1x{prefill_bucket}": (prefill, prefill_args),
+        f"paged-decode-k{decode_chunk}x{max_batch}": (paged_decode_chunk,
+                                                      decode_args),
+    }
+
+
+def tp_sharded_program(model: str, mesh, *, dtype=jnp.bfloat16,
+                       prefill_bucket: int = 512):
+    """TP-sharded prefill over the topology mesh — proves the Megatron-style
+    shardings + GSPMD collectives lower for the TPU target too."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.sharding import llama_param_shardings
+
+    cfg = get_config(model)
+    rope = llama.rope_frequencies(cfg.head_dim, cfg.max_position, cfg.rope_theta)
+    sds = jax.ShapeDtypeStruct
+    shardings = llama_param_shardings(cfg, mesh)
+    params_abs = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        jax.eval_shape(lambda k: llama.init_params(cfg, k, dtype),
+                       jax.random.PRNGKey(0)),
+        shardings)
+    repl = NamedSharding(mesh, P())
+
+    def prefill_logits(params, ids, lengths, rope_t):
+        last_h, _ = llama.prefill_collect(params, cfg, ids, lengths, rope_t,
+                                          use_flash=False)
+        return llama.lm_head_logits(params, cfg, last_h)
+
+    args = (
+        params_abs,
+        sds((1, prefill_bucket), jnp.int32, sharding=repl),
+        sds((1,), jnp.int32, sharding=repl),
+        jax.tree.map(lambda l: sds(l.shape, l.dtype, sharding=repl),
+                     jax.eval_shape(lambda: rope)),
+    )
+    return prefill_logits, args
+
+
+def aot_compile(
+    model: str,
+    *,
+    quantization: str = "none",
+    topology: str = "v5e:2x2",
+    dtype: str = "bfloat16",
+    prefill_bucket: int = 512,
+    decode_chunk: int = 16,
+    max_batch: int = 8,
+    max_seq_len: int = 2048,
+    tp: int = 0,
+    include_serving: bool = True,
+    out_dir: Optional[str | Path] = None,
+    serialize: bool = False,
+) -> dict:
+    """Compile the serving set for ``topology``; returns the evidence report.
+
+    ``serialize=True`` additionally writes serialized TPU executables (+ a
+    manifest with sha256) so a TPU host can skip compilation entirely."""
+    if serialize and out_dir is None:
+        raise ValueError("serialize=True requires out_dir (--out): the whole "
+                         "point is executables on disk for hardware day")
+    topo = tpu_topology(topology)
+    if tp and tp > len(topo.devices):
+        raise ValueError(f"tp={tp} exceeds the {len(topo.devices)} devices "
+                         f"of topology {topology!r}")
+    dt = _DTYPES[dtype]
+    mesh1, repl = _replicated(topo.devices, 1)
+    report: dict[str, Any] = {
+        "model": model, "quantization": quantization, "topology": topology,
+        "dtype": dtype, "prefill_bucket": prefill_bucket,
+        "decode_chunk": decode_chunk, "max_batch": max_batch,
+        "max_seq_len": max_seq_len, "programs": [],
+    }
+    out = Path(out_dir) if out_dir else None
+    if out:
+        out.mkdir(parents=True, exist_ok=True)
+
+    jobs = []
+    if include_serving:
+        progs = serving_programs(
+            model, dtype=dt, quantization=quantization,
+            prefill_bucket=prefill_bucket, decode_chunk=decode_chunk,
+            max_batch=max_batch, max_seq_len=max_seq_len)
+        jobs = [(name, fn, jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=repl)
+            if getattr(l, "sharding", None) is None else l, args))
+            for name, (fn, args) in progs.items()]
+    if tp:
+        from jax.sharding import Mesh
+
+        tp_mesh = Mesh(np.asarray(topo.devices[:tp]).reshape(tp), ("tp",))
+        fn, args = tp_sharded_program(model, tp_mesh, dtype=dt,
+                                      prefill_bucket=prefill_bucket)
+        jobs.append((f"prefill-tp{tp}", fn, args))
+
+    for name, fn, args in jobs:
+        t0 = time.monotonic()
+        with compiled_kernels():
+            lowered = jax.jit(fn).lower(*args)
+            compiled = lowered.compile()
+        dt_s = time.monotonic() - t0
+        entry: dict[str, Any] = {"name": name,
+                                 "compile_seconds": round(dt_s, 2)}
+        try:
+            mem = compiled.memory_analysis()
+            entry["memory"] = {
+                "argument_bytes": int(mem.argument_size_in_bytes),
+                "output_bytes": int(mem.output_size_in_bytes),
+                "temp_bytes": int(mem.temp_size_in_bytes),
+                "code_bytes": int(mem.generated_code_size_in_bytes),
+            }
+        except Exception as e:  # noqa: BLE001 — analysis is best-effort
+            entry["memory_error"] = str(e)[:200]
+        import re
+
+        hlo = lowered.as_text()
+        entry["custom_calls"] = sorted(
+            set(re.findall(r"stablehlo\.custom_call @(\w+)", hlo)))
+        entry["has_mosaic_kernel"] = "tpu_custom_call" in hlo
+        if serialize:
+            import pickle
+
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+            # self-contained artifact: deserialize_and_load needs the arg
+            # trees, so they ship inside the file, not in the caller's memory
+            blob = pickle.dumps({"format": 1, "name": name,
+                                 "payload": payload, "in_tree": in_tree,
+                                 "out_tree": out_tree})
+            path = out / f"{name}.jaxexec"
+            path.write_bytes(blob)
+            entry["executable"] = {
+                "path": path.name, "bytes": len(blob),
+                "sha256": hashlib.sha256(blob).hexdigest(),
+            }
+        report["programs"].append(entry)
+    if out:
+        (out / "aot_manifest.json").write_text(json.dumps(report, indent=1))
+    return report
+
+
+def read_serialized(path: str | Path) -> dict:
+    """Parse a .jaxexec artifact container (payload + arg trees). Structure
+    check only — loading onto devices is ``load_serialized``."""
+    import pickle
+
+    blob = pickle.loads(Path(path).read_bytes())
+    if blob.get("format") != 1 or not blob.get("payload"):
+        raise ValueError(f"{path}: not a v1 .jaxexec artifact")
+    return blob
+
+
+def load_serialized(path: str | Path, backend: str = "tpu"):
+    """Hardware-day path: deserialize a .jaxexec straight into a loaded
+    executable on the live TPU backend — no tracing, no XLA compile."""
+    from jax.experimental import serialize_executable
+
+    blob = read_serialized(path)
+    return serialize_executable.deserialize_and_load(
+        blob["payload"], blob["in_tree"], blob["out_tree"], backend=backend)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", default="llama-3-8b")
+    ap.add_argument("--quant", default="int8")
+    ap.add_argument("--topology", default="v5e:2x2")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--prefill-bucket", type=int, default=512)
+    ap.add_argument("--decode-chunk", type=int, default=16)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-seq-len", type=int, default=2048)
+    ap.add_argument("--tp", type=int, default=0)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--serialize", action="store_true")
+    args = ap.parse_args(argv)
+    # the live backend must stay CPU: topology compile needs no device, and
+    # touching the (possibly wedged) axon relay here would hang the gate
+    jax.config.update("jax_platforms", "cpu")
+    report = aot_compile(
+        args.model, quantization=args.quant, topology=args.topology,
+        dtype=args.dtype, prefill_bucket=args.prefill_bucket,
+        decode_chunk=args.decode_chunk, max_batch=args.max_batch,
+        max_seq_len=args.max_seq_len, tp=args.tp, out_dir=args.out,
+        serialize=args.serialize)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

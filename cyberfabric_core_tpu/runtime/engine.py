@@ -82,9 +82,11 @@ class EngineConfig:
     def resolve_use_flash(self) -> bool:
         if self.use_flash is not None:
             return self.use_flash
-        import jax
+        from ..ops.platform import default_interpret
 
-        return jax.devices()[0].platform == "tpu"
+        # flash defaults on whenever kernels compile for real (live TPU, or
+        # AOT lowering against a TPU topology under compiled_kernels())
+        return not default_interpret()
 
     def buckets(self) -> tuple[int, ...]:
         if self.prefill_buckets:

@@ -1,0 +1,129 @@
+"""AOT TPU lowering proof (round-3 verdict item 2): the serving program set
+compiles for a real v5e topology on the CPU host, with the Pallas flash and
+ragged paged-attention kernels lowering through Mosaic (not interpret mode).
+
+Needs only the libtpu wheel (topology description), not a TPU device — so a
+tiling/lowering bug in ops/flash_attention.py or ops/paged_attention.py fails
+CI instead of waiting for hardware day. SURVEY §7 stage 3.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+
+def _topo_or_skip(name="v5e:2x2"):
+    from cyberfabric_core_tpu.runtime.aot_tpu import tpu_topology
+
+    try:
+        return tpu_topology(name)
+    except Exception as e:  # noqa: BLE001 — no libtpu in this environment
+        pytest.skip(f"TPU topology unavailable: {e}")
+
+
+@pytest.mark.parametrize("quant", ["none", "int8", "int4"])
+def test_serving_set_compiles_for_v5e(quant):
+    """Flash prefill + fused paged-decode chunk lower for the TPU target in
+    every quantization rung, with real Mosaic kernels in the module."""
+    _topo_or_skip()
+    from cyberfabric_core_tpu.runtime.aot_tpu import aot_compile
+
+    report = aot_compile(
+        "tiny-llama", quantization=quant, topology="v5e:2x2",
+        prefill_bucket=64, decode_chunk=4, max_batch=2, max_seq_len=128)
+    names = {p["name"] for p in report["programs"]}
+    assert names == {"prefill-flash-b1x64", "paged-decode-k4x2"}
+    for prog in report["programs"]:
+        assert "memory" in prog, prog
+        # the whole point: Pallas lowered through Mosaic, not interpret mode
+        assert prog["has_mosaic_kernel"], prog["name"]
+        assert "tpu_custom_call" in prog["custom_calls"], prog["name"]
+
+
+def test_tp_sharded_prefill_compiles_for_v5e():
+    """Megatron-style TP shardings + GSPMD collectives lower for the TPU
+    mesh (tp=4 over the v5e:2x2 topology). Compiles ONLY the tp program
+    (include_serving=False) — the serving set has its own test."""
+    _topo_or_skip()
+    import jax.numpy as jnp
+
+    from cyberfabric_core_tpu.models import llama
+    from cyberfabric_core_tpu.models.configs import get_config
+    from cyberfabric_core_tpu.runtime.aot_tpu import aot_compile
+
+    report = aot_compile(
+        "tiny-llama", quantization="none", topology="v5e:2x2",
+        prefill_bucket=64, decode_chunk=4, max_batch=2, max_seq_len=128,
+        tp=4, include_serving=False)
+    (tp_prog,) = report["programs"]
+    assert tp_prog["name"] == "prefill-tp4"
+    assert "memory" in tp_prog
+    # per-device argument bytes must be well under the replicated param
+    # total (embed, lm_head and all matmul weights are tp-sharded)
+    cfg = get_config("tiny-llama")
+    params = jax.eval_shape(
+        lambda k: llama.init_params(cfg, k, jnp.bfloat16),
+        jax.random.PRNGKey(0))
+    replicated_bytes = sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize for l in jax.tree.leaves(params))
+    assert tp_prog["memory"]["argument_bytes"] < replicated_bytes
+
+
+def test_tp_exceeding_topology_is_a_clear_error():
+    _topo_or_skip()
+    from cyberfabric_core_tpu.runtime.aot_tpu import aot_compile
+
+    with pytest.raises(ValueError, match="tp=8 exceeds the 4 devices"):
+        aot_compile("tiny-llama", topology="v5e:2x2", tp=8,
+                    include_serving=False)
+
+
+def test_serialize_without_out_dir_is_a_clear_error():
+    from cyberfabric_core_tpu.runtime.aot_tpu import aot_compile
+
+    with pytest.raises(ValueError, match="serialize"):
+        aot_compile("tiny-llama", serialize=True)
+
+
+def test_serialized_executable_roundtrip(tmp_path):
+    """serialize=True writes deserializable TPU executables with digests —
+    what a TPU host loads to skip compilation entirely."""
+    _topo_or_skip()
+    import hashlib
+    import json
+
+    from cyberfabric_core_tpu.runtime.aot_tpu import aot_compile
+
+    from cyberfabric_core_tpu.runtime.aot_tpu import read_serialized
+
+    report = aot_compile(
+        "tiny-llama", quantization="int8", topology="v5e:2x2",
+        prefill_bucket=32, decode_chunk=2, max_batch=2, max_seq_len=64,
+        out_dir=tmp_path, serialize=True)
+    manifest = json.loads((tmp_path / "aot_manifest.json").read_text())
+    assert manifest == report
+    for prog in report["programs"]:
+        path = tmp_path / prog["executable"]["path"]
+        blob = path.read_bytes()
+        assert len(blob) == prog["executable"]["bytes"] > 0
+        assert hashlib.sha256(blob).hexdigest() == prog["executable"]["sha256"]
+        # container parses back: payload + the arg trees deserialize_and_load
+        # needs on the TPU host (full load requires live TPU devices)
+        parsed = read_serialized(path)
+        assert parsed["name"] == prog["name"]
+        assert len(parsed["payload"]) > 1000
+        assert parsed["in_tree"] is not None and parsed["out_tree"] is not None
+
+
+def test_compiled_kernels_context_forces_mosaic():
+    """The override that makes AOT possible: inside compiled_kernels() the
+    default interpret decision flips to compiled even on a CPU backend."""
+    from cyberfabric_core_tpu.ops.platform import (compiled_kernels,
+                                                   default_interpret)
+
+    on_cpu = jax.devices()[0].platform != "tpu"
+    assert default_interpret() is on_cpu
+    with compiled_kernels():
+        assert default_interpret() is False
+    assert default_interpret() is on_cpu
