@@ -24,7 +24,7 @@ contracts:  ## OpenAPI golden gate + GTS docs validation (oasdiff equivalent)
 	$(PY) -m cyberfabric_core_tpu.apps.gts_docs_validator docs config README.md --vendor x
 
 aot-tpu:  ## TPU lowering gate: serving set compiles for v5e via topology AOT
-	$(PY) -m pytest tests/test_aot_tpu.py -q
+	$(PY) -m pytest tests/test_aot_tpu.py tests/test_feasibility.py -q
 
 test:  ## full suite
 	$(PY) -m pytest tests/ -q

@@ -100,3 +100,62 @@ def apply_shardings(params: Any, shardings: Any):
         lambda a, s: jax.device_put(a, s), params, shardings,
         is_leaf=lambda x: not isinstance(x, dict),
     )
+
+
+def spec_for_quant_leaf(spec: P, leaf_key: str) -> P:
+    """Sharding spec for a quantized sub-leaf (runtime/quant.py layouts),
+    derived from the parent weight's spec: 'q' keeps the full spec, 's'
+    ([..., out], per-out-channel scales) drops the contraction axis (-2),
+    'qe' keeps, 'se' ([V], per-row embed scales) keeps only the row axis."""
+    if leaf_key in ("q", "qe"):
+        return spec
+    entries = tuple(spec)
+    if leaf_key == "s":
+        return P(*(entries[:-2] + entries[-1:])) if len(entries) >= 2 else spec
+    if leaf_key == "se":
+        return P(entries[0]) if entries else spec
+    raise ValueError(f"unknown quant leaf {leaf_key!r}")
+
+
+def abstract_params(cfg: ModelConfig, dtype, quantization: str = "none"):
+    """ShapeDtypeStruct tree of the (optionally quantized) param tree —
+    eval_shape over the SAME builders serving uses, zero allocation."""
+    import jax
+
+    from ..models import llama
+    from ..runtime.quant import quant_bits, quantize_llama_params
+
+    bits = quant_bits(quantization)
+
+    def build(key):
+        p = llama.init_params(cfg, key, dtype)
+        return quantize_llama_params(p, bits) if bits else p
+
+    return jax.eval_shape(build, jax.random.PRNGKey(0))
+
+
+def sharded_abstract_params(cfg: ModelConfig, mesh, dtype,
+                            quantization: str = "none",
+                            layer_axis: Any = None):
+    """Abstract param tree with every leaf pinned to its NamedSharding —
+    quantized sub-leaves ('q'/'s'/'qe'/'se') derive their spec from the
+    parent weight's via spec_for_quant_leaf. The ONE source both the AOT
+    compiler (runtime/aot_tpu.py) and the feasibility planner
+    (parallel/feasibility.py) consume, so they cannot drift."""
+    import jax
+
+    spec_tree = llama_param_shardings(cfg, mesh, layer_axis=layer_axis)
+    abstract = abstract_params(cfg, dtype, quantization)
+    sds = jax.ShapeDtypeStruct
+
+    def walk(abs_node, spec_node):
+        if isinstance(abs_node, dict) and any(
+                k in abs_node for k in ("q", "qe")):
+            return {k: sds(v.shape, v.dtype, sharding=NamedSharding(
+                mesh, spec_for_quant_leaf(spec_node.spec, k)))
+                for k, v in abs_node.items()}
+        if isinstance(abs_node, dict):
+            return {k: walk(v, spec_node[k]) for k, v in abs_node.items()}
+        return sds(abs_node.shape, abs_node.dtype, sharding=spec_node)
+
+    return walk(abstract, spec_tree)

@@ -162,27 +162,30 @@ def serving_programs(
 
 
 def tp_sharded_program(model: str, mesh, *, dtype=jnp.bfloat16,
-                       prefill_bucket: int = 512):
+                       quantization: str = "none",
+                       prefill_bucket: int = 512, use_flash: bool = False):
     """TP-sharded prefill over the topology mesh — proves the Megatron-style
-    shardings + GSPMD collectives lower for the TPU target too."""
+    shardings + GSPMD collectives lower for the TPU target too (XLA enforces
+    the per-device HBM budget at AOT compile, so this doubles as the hard
+    oracle behind parallel/feasibility.py's static plan).
+
+    ``use_flash`` defaults False: Mosaic kernels don't auto-partition under
+    GSPMD (they'd need a shard_map wrapper), and the TP serving path runs
+    the jnp attention — this program mirrors it."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from ..parallel.sharding import llama_param_shardings
+    from ..parallel.sharding import sharded_abstract_params
 
     cfg = get_config(model)
     rope = llama.rope_frequencies(cfg.head_dim, cfg.max_position, cfg.rope_theta)
     sds = jax.ShapeDtypeStruct
-    shardings = llama_param_shardings(cfg, mesh)
-    params_abs = jax.tree.map(
-        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
-        jax.eval_shape(lambda k: llama.init_params(cfg, k, dtype),
-                       jax.random.PRNGKey(0)),
-        shardings)
+    # the SAME sharded abstract tree the feasibility planner budgets with
+    params_abs = sharded_abstract_params(cfg, mesh, dtype, quantization)
     repl = NamedSharding(mesh, P())
 
     def prefill_logits(params, ids, lengths, rope_t):
         last_h, _ = llama.prefill_collect(params, cfg, ids, lengths, rope_t,
-                                          use_flash=False)
+                                          use_flash=use_flash)
         return llama.lm_head_logits(params, cfg, last_h)
 
     args = (
@@ -246,8 +249,11 @@ def aot_compile(
     if tp:
         from jax.sharding import Mesh
 
-        tp_mesh = Mesh(np.asarray(topo.devices[:tp]).reshape(tp), ("tp",))
+        # ep axis of size 1 so MoE expert shardings resolve on pure-TP meshes
+        tp_mesh = Mesh(np.asarray(topo.devices[:tp]).reshape(1, tp),
+                       ("ep", "tp"))
         fn, args = tp_sharded_program(model, tp_mesh, dtype=dt,
+                                      quantization=quantization,
                                       prefill_bucket=prefill_bucket)
         jobs.append((f"prefill-tp{tp}", fn, args))
 

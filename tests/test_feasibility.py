@@ -1,0 +1,134 @@
+"""70B TP feasibility plan (round-3 verdict item 3 / BASELINE #5): the
+llama-3-70b tp=8 sharding plan is machine-checked — per-device parameter +
+KV bytes derived from the serving spec tree itself, asserted under the v5e
+16GB HBM budget, with the per-shard safetensors read plan golden-pinned."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cyberfabric_core_tpu.parallel.feasibility import tp_plan
+
+
+def test_70b_int8_tp8_fits_v5e():
+    """BASELINE #5's actual rung: int8 70B across 8 v5e chips."""
+    plan = tp_plan("llama-3-70b", 8, quantization="int8")
+    assert plan["fits"], plan["hbm_utilization"]
+    assert plan["hbm_utilization"] < 0.85  # headroom for runtime overheads
+    # the total must be a real 70B: ~70-71 GB of int8 weights
+    assert 69e9 < plan["param_bytes_total"] < 72e9
+    # per-device params ≈ total/8 + the replicated embed slack
+    assert plan["param_bytes_per_device"] < plan["param_bytes_total"] / 8 * 1.25
+
+
+def test_70b_bf16_tp8_does_not_fit_v5e():
+    """Negative evidence matters: the planner must REJECT the bf16 rung
+    (17.6GB/device), same verdict XLA's compile-time HBM budget gives."""
+    plan = tp_plan("llama-3-70b", 8, quantization="none")
+    assert not plan["fits"]
+    assert plan["hbm_utilization"] > 1.0
+
+
+def test_70b_bf16_fits_tp16():
+    """…and the same bf16 model fits when the mesh doubles (v5e-16 slice):
+    the planner scales with tp, it is not a hardcoded verdict."""
+    plan = tp_plan("llama-3-70b", 16, quantization="none",
+                   max_batch=4)
+    assert plan["fits"], plan["hbm_utilization"]
+
+
+def test_kv_cache_shards_on_tp():
+    p4 = tp_plan("llama-3-8b", 4, quantization="int8", max_seq_len=2048)
+    p8 = tp_plan("llama-3-8b", 8, quantization="int8", max_seq_len=2048)
+    # 8 kv heads: tp=4 → 2 heads/device, tp=8 → 1 head/device
+    assert p4["kv_bytes_per_device"] == 2 * p8["kv_bytes_per_device"]
+
+
+def test_indivisible_kv_heads_rejected():
+    with pytest.raises(ValueError, match="kv_heads"):
+        tp_plan("llama-3-8b", 3)
+
+
+def test_read_plan_slice_axes():
+    """The per-shard safetensors read plan: each sharded HF tensor names the
+    axis a tp rank slices — pinned against the known Megatron layout."""
+    plan = tp_plan("llama-3-70b", 8, quantization="none")
+    by_tensor = {e["tensor"]: e for e in plan["read_plan"]}
+    # column-parallel projections: our [H, D_out] sharded on out, HF stores
+    # [D_out, H] → rank slices HF axis 0 (rows)
+    for t in ("model.layers.{i}.self_attn.q_proj.weight",
+              "model.layers.{i}.mlp.gate_proj.weight",
+              "model.layers.{i}.mlp.up_proj.weight",
+              "lm_head.weight"):
+        assert by_tensor[t]["sharded"] and by_tensor[t]["hf_slice_axis"] == 0, t
+    # extents: q_proj [8192, 8192] rows / 8 ranks; gate_proj [28672, 8192]
+    q = by_tensor["model.layers.{i}.self_attn.q_proj.weight"]
+    assert q["hf_shape"] == [8192, 8192] and q["per_rank_extent"] == 1024
+    g = by_tensor["model.layers.{i}.mlp.gate_proj.weight"]
+    assert g["hf_shape"] == [28672, 8192] and g["per_rank_extent"] == 3584
+    # row-parallel: our [D_in, H] sharded on in → HF [H, D_in] axis 1 (cols)
+    for t in ("model.layers.{i}.self_attn.o_proj.weight",
+              "model.layers.{i}.mlp.down_proj.weight"):
+        assert by_tensor[t]["sharded"] and by_tensor[t]["hf_slice_axis"] == 1, t
+    # replicated: embeddings and norms are read whole by every rank
+    for t in ("model.embed_tokens.weight", "model.norm.weight",
+              "model.layers.{i}.input_layernorm.weight"):
+        assert not by_tensor[t]["sharded"], t
+
+
+def test_tp1_equals_unsharded_bytes():
+    """tp=1 must reproduce the plain parameter byte count exactly — the
+    planner's shard math has no fudge factors."""
+    import jax
+
+    from cyberfabric_core_tpu.models import llama
+    from cyberfabric_core_tpu.models.configs import get_config
+
+    cfg = get_config("tiny-llama")
+    params = jax.eval_shape(
+        lambda k: llama.init_params(cfg, k, jnp.bfloat16),
+        jax.random.PRNGKey(0))
+    raw = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+              for l in jax.tree.leaves(params))
+    plan = tp_plan("tiny-llama", 1, max_seq_len=128, max_batch=2)
+    assert plan["param_bytes_per_device"] == plan["param_bytes_total"] == raw
+
+
+def test_planner_agrees_with_xla_memory_analysis():
+    """Cross-check the static planner against XLA's own per-device argument
+    accounting from an AOT compile of the same sharded program (tiny model,
+    tp=4) — the planner must not drift from what the compiler enforces."""
+    pytest.importorskip("libtpu")
+    from cyberfabric_core_tpu.runtime.aot_tpu import aot_compile
+
+    try:
+        report = aot_compile("llama-3-8b", quantization="int8",
+                             topology="v5e:2x2", tp=4, include_serving=False,
+                             prefill_bucket=512, max_seq_len=2048)
+    except Exception as e:  # noqa: BLE001 — lockfile contention etc.
+        pytest.skip(f"topology AOT unavailable: {e}")
+    xla_args = report["programs"][0]["memory"]["argument_bytes"]
+    plan = tp_plan("llama-3-8b", 4, quantization="int8")
+    # XLA's argument bytes = sharded params + ids/lengths/rope (small) plus
+    # TPU tiling padding — negligible at 128-aligned 8B dims (tiny models
+    # would be dominated by (8,128)-tile padding). Within 5% over.
+    assert plan["param_bytes_per_device"] <= xla_args
+    assert xla_args < plan["param_bytes_per_device"] * 1.05
+
+
+def test_moe_plans_both_axes():
+    """MoE models plan under pure TP and under expert-parallel meshes (the
+    verify drive caught the ep axis missing from tp-only plans)."""
+    tp8 = tp_plan("mixtral-8x7b", 8, quantization="int8")
+    ep8 = tp_plan("mixtral-8x7b", 1, ep=8, quantization="int8")
+    assert tp8["fits"] and ep8["fits"]
+    # ep shards only experts; attention + embed replicate per device, so the
+    # pure-TP plan must be the lighter one per device
+    assert tp8["param_bytes_per_device"] < ep8["param_bytes_per_device"]
+    # the read plan tells each ep rank which experts it reads AT ALL
+    w1 = next(e for e in ep8["read_plan"]
+              if e["tensor"].endswith("experts.{e}.w1.weight"))
+    assert w1["experts_per_rank"] == 1 and w1["ep_ranks"] == 8
+    with pytest.raises(ValueError, match="num_experts"):
+        tp_plan("mixtral-8x7b", 1, ep=3)
