@@ -19,6 +19,7 @@ from typing import Any, Awaitable, Callable, Optional
 
 from aiohttp import web
 
+from ..modkit.errcat import ERR
 from ..modkit.errors import Problem, ProblemError
 from ..modkit.security import SecurityContext
 from ..modkit.telemetry import Tracer
@@ -169,8 +170,7 @@ def build_middlewares(
                 return await handler(request)
         except asyncio.TimeoutError:
             return _problem_response(
-                Problem(status=504, title="Gateway Timeout", code="timeout",
-                        detail=f"request exceeded {timeout_secs}s"),
+                ERR.core.timeout.problem(f"request exceeded {timeout_secs}s"),
                 request.get(REQUEST_ID_KEY),
             )
 
@@ -180,8 +180,8 @@ def build_middlewares(
         cl = request.content_length
         if cl is not None and cl > max_body_bytes:
             return _problem_response(
-                Problem(status=413, title="Payload Too Large", code="body_too_large",
-                        detail=f"body exceeds {max_body_bytes} bytes"),
+                ERR.core.body_too_large.problem(
+                    f"body exceeds {max_body_bytes} bytes"),
                 request.get(REQUEST_ID_KEY),
             )
         return await handler(request)
@@ -216,9 +216,9 @@ def build_middlewares(
                 for m in spec.accepted_mime
             ):
                 return _problem_response(
-                    Problem(status=415, title="Unsupported Media Type",
-                            code="unsupported_media_type",
-                            detail=f"expected one of {list(spec.accepted_mime)}, got {ctype!r}"),
+                    ERR.core.unsupported_media_type.problem(
+                        f"expected one of {list(spec.accepted_mime)}, "
+                        f"got {ctype!r}"),
                     request.get(REQUEST_ID_KEY),
                 )
         return await handler(request)
@@ -232,15 +232,14 @@ def build_middlewares(
         bucket, sem = limiter.for_spec(spec)
         if bucket is not None and not bucket.try_acquire():
             return _problem_response(
-                Problem(status=429, title="Too Many Requests", code="rate_limited",
-                        detail="per-route rate limit exceeded"),
+                ERR.core.rate_limited.problem("per-route rate limit exceeded"),
                 request.get(REQUEST_ID_KEY),
             )
         if sem is not None:
             if sem.locked():
                 return _problem_response(
-                    Problem(status=429, title="Too Many Requests", code="too_many_in_flight",
-                            detail="per-route in-flight limit reached"),
+                    ERR.core.too_many_in_flight.problem(
+                        "per-route in-flight limit reached"),
                     request.get(REQUEST_ID_KEY),
                 )
             async with sem:
@@ -268,7 +267,7 @@ def build_middlewares(
             import logging
             logging.getLogger("gateway").exception("unhandled error in %s", request.path)
             return _problem_response(
-                Problem(status=500, title="Internal Server Error", code="internal_error"),
+                ERR.core.internal_error.problem(),
                 request.get(REQUEST_ID_KEY),
             )
 
@@ -323,9 +322,8 @@ def build_middlewares(
         if spec is not None and spec.license_feature is not None:
             sec_ctx = request.get(SECURITY_CONTEXT_KEY)
             if license_api is None or not await license_api.check_feature(sec_ctx, spec.license_feature):
-                raise ProblemError(
-                    Problem(status=403, title="Forbidden", code="license_required",
-                            detail=f"feature '{spec.license_feature}' is not licensed"))
+                raise ERR.core.license_required.error(
+                    f"feature '{spec.license_feature}' is not licensed")
         return await handler(request)
 
     # outermost → innermost; aiohttp applies the list in order around the handler

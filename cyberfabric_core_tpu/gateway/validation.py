@@ -13,6 +13,7 @@ from typing import Any, Optional
 import jsonschema
 from aiohttp import web
 
+from ..modkit.errcat import ERR
 from ..modkit.errors import ProblemError
 
 # Keyed by id(schema) but holding a strong reference to the schema itself, so a
@@ -48,7 +49,7 @@ async def read_json(request: web.Request, schema: Optional[dict] = None) -> Any:
     try:
         payload = await request.json()
     except (json.JSONDecodeError, UnicodeDecodeError) as e:
-        raise ProblemError.bad_request(f"malformed JSON body: {e}", code="malformed_json")
+        raise ERR.core.malformed_json.error(f"malformed JSON body: {e}")
     if schema is not None:
         validate_against(schema, payload)
     return payload

@@ -1,0 +1,133 @@
+"""Typed error codes compiled from the committed JSON catalog.
+
+Reference: libs/modkit-errors-macro/src/lib.rs:11-17 — `declare_errors!`
+compiles JSON error catalogs into typed error-code enums at build time, so
+codes cannot drift, collide, or be invented ad hoc at call sites. Python has
+no proc-macros; the idiomatic translation is import-time compilation of
+``modkit/catalogs/errors.json`` into attribute-access constants:
+
+    from ..modkit.errcat import ERR
+    raise ERR.model_registry.model_not_found.error(f"model {name!r} not found")
+
+Every code carries its HTTP status, title, and a GTS error-id ``type``
+(``gts://gts.x.core.<ns>.err.<code>.v1~`` — serverless ADR:2536-2556 requires
+Problem ``type`` to be a GTS id, not about:blank). An arch-lint rule
+(tests/test_arch_lint.py EC01) rejects ``code="..."`` string literals outside
+this layer, so `grep 'code="'` finds only the catalog itself.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+from .errors import Problem, ProblemError
+
+_CATALOG_PATH = Path(__file__).parent / "catalogs" / "errors.json"
+_KEY_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+class ErrorCode:
+    """One catalog entry — a typed constant, not a string."""
+
+    __slots__ = ("namespace", "key", "code", "status", "title")
+
+    def __init__(self, namespace: str, key: str, status: int, title: str,
+                 wire: Optional[str] = None) -> None:
+        self.namespace = namespace
+        self.key = key
+        self.code = wire or key          # wire: legacy spellings (e.g.
+        self.status = status             # oagw's CircuitBreakerOpen)
+        self.title = title
+
+    @property
+    def gts_type(self) -> str:
+        return f"gts://gts.x.core.{self.namespace}.err.{self.key}.v1~"
+
+    def problem(self, detail: Optional[str] = None, *,
+                errors: Optional[list[dict[str, Any]]] = None,
+                **extensions: Any) -> Problem:
+        return Problem(
+            status=self.status, title=self.title, code=self.code,
+            type=self.gts_type, detail=detail, errors=errors or [],
+            extensions=extensions)
+
+    def error(self, detail: Optional[str] = None, *,
+              errors: Optional[list[dict[str, Any]]] = None,
+              **extensions: Any) -> ProblemError:
+        return ProblemError(self.problem(detail, errors=errors, **extensions))
+
+    def __repr__(self) -> str:
+        return (f"<ErrorCode {self.namespace}.{self.key} "
+                f"{self.status} {self.code!r}>")
+
+
+class Catalog:
+    """One namespace of the catalog; codes are attributes."""
+
+    def __init__(self, name: str, codes: dict[str, ErrorCode]) -> None:
+        self._name = name
+        self._codes = codes
+
+    def __getattr__(self, key: str) -> ErrorCode:
+        try:
+            return self._codes[key]
+        except KeyError:
+            raise AttributeError(
+                f"unknown error code {self._name}.{key!r} — add it to "
+                f"modkit/catalogs/errors.json (known: {sorted(self._codes)})"
+            ) from None
+
+    def __iter__(self) -> Iterator[ErrorCode]:
+        return iter(self._codes.values())
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._codes
+
+
+class _Root:
+    def __init__(self, namespaces: dict[str, Catalog]) -> None:
+        self._namespaces = namespaces
+
+    def __getattr__(self, name: str) -> Catalog:
+        try:
+            return self._namespaces[name]
+        except KeyError:
+            raise AttributeError(
+                f"unknown error namespace {name!r} — add it to "
+                f"modkit/catalogs/errors.json (known: "
+                f"{sorted(self._namespaces)})") from None
+
+    def __iter__(self) -> Iterator[Catalog]:
+        return iter(self._namespaces.values())
+
+
+def _load() -> _Root:
+    data = json.loads(_CATALOG_PATH.read_text())
+    namespaces: dict[str, Catalog] = {}
+    for ns, entries in data.items():
+        if not _KEY_RE.match(ns):
+            raise ValueError(f"catalog namespace {ns!r} not snake_case")
+        codes: dict[str, ErrorCode] = {}
+        for key, spec in entries.items():
+            if not _KEY_RE.match(key):
+                raise ValueError(f"catalog key {ns}.{key!r} not snake_case")
+            status = spec["status"]
+            if not (isinstance(status, int) and 400 <= status <= 599):
+                raise ValueError(f"{ns}.{key}: status {status!r} not an "
+                                 "error status")
+            codes[key] = ErrorCode(ns, key, status, spec["title"],
+                                   spec.get("wire"))
+        namespaces[ns] = Catalog(ns, codes)
+    return _Root(namespaces)
+
+
+#: the compiled catalog — fails at import if the JSON is malformed
+ERR = _load()
+
+#: every wire code, for contract tests / docs generation
+ALL_WIRE_CODES: dict[str, list[str]] = {
+    cat._name: sorted(c.code for c in cat) for cat in ERR  # noqa: SLF001
+}

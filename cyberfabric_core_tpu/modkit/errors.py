@@ -48,6 +48,23 @@ class Problem:
 
     CONTENT_TYPE = "application/problem+json"
 
+    @classmethod
+    def from_dict(cls, doc: dict[str, Any]) -> "Problem":
+        """Inverse of to_dict (gRPC transport re-raises remote Problems)."""
+        known = {"type", "status", "title", "code", "detail", "instance",
+                 "trace_id", "errors"}
+        return cls(
+            status=int(doc.get("status", 500)),
+            title=doc.get("title", "Internal Server Error"),
+            code=doc.get("code", "internal_error"),
+            type=doc.get("type", "about:blank"),
+            detail=doc.get("detail"),
+            instance=doc.get("instance"),
+            trace_id=doc.get("trace_id"),
+            errors=list(doc.get("errors") or []),
+            extensions={k: v for k, v in doc.items() if k not in known},
+        )
+
 
 class ProblemError(Exception):
     """Raise anywhere below the gateway; the error-mapping middleware renders it."""
@@ -56,47 +73,59 @@ class ProblemError(Exception):
         super().__init__(f"{problem.status} {problem.code}: {problem.title}")
         self.problem = problem
 
-    # Convenience constructors for the common cases -------------------------------
+    # Convenience constructors for the common cases. Catalog-backed: the
+    # default codes resolve through modkit/catalogs/errors.json (core
+    # namespace) so their Problem ``type`` is a GTS error id. A custom
+    # ``code`` keeps the constructor's status/title (app-author escape hatch;
+    # package code uses errcat.ERR directly — arch-lint EC01 enforces it).
     @classmethod
-    def bad_request(cls, detail: str, code: str = "bad_request") -> "ProblemError":
-        return cls(Problem(status=400, title="Bad Request", code=code, detail=detail))
+    def _core(cls, default_key: str, code: Optional[str], detail: Optional[str],
+              errors: list[dict[str, Any]] | None = None) -> "ProblemError":
+        from .errcat import ERR
 
-    @classmethod
-    def unauthorized(cls, detail: str = "authentication required") -> "ProblemError":
-        return cls(Problem(status=401, title="Unauthorized", code="unauthorized", detail=detail))
-
-    @classmethod
-    def forbidden(cls, detail: str = "access denied",
-                  code: str = "forbidden") -> "ProblemError":
-        return cls(Problem(status=403, title="Forbidden", code=code, detail=detail))
-
-    @classmethod
-    def not_found(cls, detail: str, code: str = "not_found") -> "ProblemError":
-        return cls(Problem(status=404, title="Not Found", code=code, detail=detail))
-
-    @classmethod
-    def conflict(cls, detail: str, code: str = "conflict") -> "ProblemError":
-        return cls(Problem(status=409, title="Conflict", code=code, detail=detail))
-
-    @classmethod
-    def unprocessable(cls, detail: str, errors: list[dict[str, Any]] | None = None,
-                      code: str = "validation_failed") -> "ProblemError":
-        return cls(Problem(status=422, title="Unprocessable Entity", code=code,
+        base = getattr(ERR.core, default_key)
+        if code is None or code == base.code:
+            return cls(base.problem(detail, errors=errors))
+        return cls(Problem(status=base.status, title=base.title, code=code,
                            detail=detail, errors=errors or []))
 
     @classmethod
-    def too_many_requests(cls, detail: str = "rate limit exceeded") -> "ProblemError":
-        return cls(Problem(status=429, title="Too Many Requests",
-                           code="rate_limited", detail=detail))
+    def bad_request(cls, detail: str, code: Optional[str] = None) -> "ProblemError":
+        return cls._core("bad_request", code, detail)
 
     @classmethod
-    def service_unavailable(cls, detail: str, code: str = "unavailable") -> "ProblemError":
-        return cls(Problem(status=503, title="Service Unavailable", code=code, detail=detail))
+    def unauthorized(cls, detail: str = "authentication required") -> "ProblemError":
+        return cls._core("unauthorized", None, detail)
+
+    @classmethod
+    def forbidden(cls, detail: str = "access denied",
+                  code: Optional[str] = None) -> "ProblemError":
+        return cls._core("forbidden", code, detail)
+
+    @classmethod
+    def not_found(cls, detail: str, code: Optional[str] = None) -> "ProblemError":
+        return cls._core("not_found", code, detail)
+
+    @classmethod
+    def conflict(cls, detail: str, code: Optional[str] = None) -> "ProblemError":
+        return cls._core("conflict", code, detail)
+
+    @classmethod
+    def unprocessable(cls, detail: str, errors: list[dict[str, Any]] | None = None,
+                      code: Optional[str] = None) -> "ProblemError":
+        return cls._core("validation_failed", code, detail, errors)
+
+    @classmethod
+    def too_many_requests(cls, detail: str = "rate limit exceeded") -> "ProblemError":
+        return cls._core("rate_limited", None, detail)
+
+    @classmethod
+    def service_unavailable(cls, detail: str, code: Optional[str] = None) -> "ProblemError":
+        return cls._core("unavailable", code, detail)
 
     @classmethod
     def internal(cls, detail: str = "internal error") -> "ProblemError":
-        return cls(Problem(status=500, title="Internal Server Error",
-                           code="internal_error", detail=detail))
+        return cls._core("internal_error", None, detail)
 
 
 class ErrorCatalog:

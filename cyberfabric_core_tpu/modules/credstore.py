@@ -20,6 +20,7 @@ from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
 from ..modkit.plugins import GtsPluginSelector, choose_plugin_instance
 from ..modkit.context import ModuleCtx
 from ..modkit.db import ScopableEntity
+from ..modkit.errcat import ERR
 from ..modkit.errors import ProblemError
 from ..modkit.security import SecurityContext
 from ..gateway.middleware import SECURITY_CONTEXT_KEY
@@ -212,8 +213,8 @@ class CredStoreGateway(CredStoreApi):
     async def put_secret(self, ctx: SecurityContext, key: str, value: str,
                          sharing: str = "private") -> None:
         if sharing not in _SHARING_MODES:
-            raise ProblemError.bad_request(
-                f"sharing must be one of {_SHARING_MODES}", code="bad_sharing_mode")
+            raise ERR.credstore.bad_sharing_mode.error(
+                f"sharing must be one of {_SHARING_MODES}")
         (await self._plugin()).put(ctx.tenant_id, key, value, sharing)
 
     async def delete_secret(self, ctx: SecurityContext, key: str) -> bool:
@@ -259,14 +260,14 @@ class CredStoreModule(Module, DatabaseCapability, RestApiCapability):
             value = await gw.get_secret(request[SECURITY_CONTEXT_KEY],
                                         request.match_info["key"])
             if value is None:
-                raise ProblemError.not_found("secret not found", code="secret_not_found")
+                raise ERR.credstore.secret_not_found.error("secret not found")
             return {"key": request.match_info["key"], "value": value}
 
         async def delete_secret(request: web.Request):
             deleted = await gw.delete_secret(request[SECURITY_CONTEXT_KEY],
                                              request.match_info["key"])
             if not deleted:
-                raise ProblemError.not_found("secret not found", code="secret_not_found")
+                raise ERR.credstore.secret_not_found.error("secret not found")
             return None
 
         m = "credstore"

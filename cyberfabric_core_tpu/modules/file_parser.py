@@ -26,6 +26,7 @@ from ..modkit import Module, module
 from .sdk import FileParserApi
 from ..modkit.contracts import RestApiCapability
 from ..modkit.context import ModuleCtx
+from ..modkit.errcat import ERR
 from ..modkit.errors import ProblemError
 from ..gateway.middleware import SECURITY_CONTEXT_KEY
 from ..gateway.validation import read_json
@@ -152,7 +153,7 @@ def parse_json_doc(data: bytes) -> Document:
     try:
         obj = json.loads(data)
     except json.JSONDecodeError as e:
-        raise ProblemError.unprocessable(f"invalid JSON document: {e}", code="parse_failed")
+        raise ERR.file_parser.parse_failed.error(f"invalid JSON document: {e}")
     return Document(blocks=[Block("code", json.dumps(obj, indent=2)[:100_000])])
 
 
@@ -231,7 +232,7 @@ class FileParserService(FileParserApi):
             raise ProblemError.forbidden("path escapes allowed_local_base_dir",
                                          )
         if not resolved.is_file():
-            raise ProblemError.not_found(f"no such file: {path_str}", code="file_not_found")
+            raise ERR.file_parser.file_not_found.error(f"no such file: {path_str}")
         mime = _EXT_MIME.get(resolved.suffix.lower(), "application/octet-stream")
         return self.parse_bytes(resolved.read_bytes(), mime)
 

@@ -21,7 +21,8 @@ import zlib
 from typing import Optional
 from xml.etree import ElementTree
 
-from ..modkit.errors import ProblemError
+from ..modkit.errcat import ERR
+
 from .file_parser import Block, Document
 
 logger = logging.getLogger("file_parser")
@@ -46,19 +47,19 @@ def _open_zip(data: bytes, kind: str) -> zipfile.ZipFile:
     try:
         return zipfile.ZipFile(io.BytesIO(data))
     except zipfile.BadZipFile as e:
-        raise ProblemError.unprocessable(
-            f"invalid {kind} file: not a zip archive", code="parse_failed") from e
+        raise ERR.file_parser.parse_failed.error(
+            f"invalid {kind} file: not a zip archive") from e
 
 
 def _read_xml(zf: zipfile.ZipFile, name: str, kind: str) -> ElementTree.Element:
     try:
         return ElementTree.fromstring(zf.read(name))
     except KeyError as e:
-        raise ProblemError.unprocessable(
-            f"invalid {kind} file: missing {name}", code="parse_failed") from e
+        raise ERR.file_parser.parse_failed.error(
+            f"invalid {kind} file: missing {name}") from e
     except ElementTree.ParseError as e:
-        raise ProblemError.unprocessable(
-            f"invalid {kind} file: malformed {name}: {e}", code="parse_failed") from e
+        raise ERR.file_parser.parse_failed.error(
+            f"invalid {kind} file: malformed {name}: {e}") from e
 
 
 # ------------------------------------------------------------------ DOCX
@@ -69,7 +70,7 @@ def parse_docx(data: bytes) -> Document:
     root = _read_xml(zf, "word/document.xml", "docx")
     body = root.find(f"{_W}body")
     if body is None:
-        raise ProblemError.unprocessable("invalid docx: no body", code="parse_failed")
+        raise ERR.file_parser.parse_failed.error("invalid docx: no body")
 
     doc = Document()
     pending_items: list[str] = []
@@ -174,9 +175,9 @@ def parse_xlsx(data: bytes) -> Document:
                     try:
                         i = int(v.text) if v is not None and v.text else 0
                     except ValueError as e:
-                        raise ProblemError.unprocessable(
+                        raise ERR.file_parser.parse_failed.error(
                             f"invalid xlsx: non-integer shared-string index "
-                            f"{v.text!r}", code="parse_failed") from e
+                            f"{v.text!r}") from e
                     if i >= len(shared):
                         logger.warning("xlsx shared-string index %d out of "
                                        "range (%d entries) — corrupt workbook?",
@@ -297,8 +298,7 @@ def parse_pdf(data: bytes) -> Document:
     standard-encoding text PDFs the reference's pdf-extract handles; exotic
     font encodings degrade to their raw bytes."""
     if not data.startswith(b"%PDF-"):
-        raise ProblemError.unprocessable("invalid pdf: missing %PDF header",
-                                         code="parse_failed")
+        raise ERR.file_parser.parse_failed.error("invalid pdf: missing %PDF header")
     lines: list[str] = []
     cur: list[str] = []
 
@@ -315,9 +315,8 @@ def parse_pdf(data: bytes) -> Document:
             d = zlib.decompressobj()
             inflated = d.decompress(payload, max_inflate)
             if d.unconsumed_tail:
-                raise ProblemError.unprocessable(
-                    "pdf stream inflates beyond the size cap",
-                    code="parse_failed")
+                raise ERR.file_parser.parse_failed.error(
+                    "pdf stream inflates beyond the size cap")
             payload = inflated
         except zlib.error:
             pass  # uncompressed stream
@@ -430,8 +429,7 @@ def parse_image(data: bytes) -> Document:
     info = (_png_info(data) or _jpeg_info(data) or _gif_info(data)
             or _bmp_info(data) or _webp_info(data))
     if info is None:
-        raise ProblemError.unprocessable("unrecognized image format",
-                                         code="parse_failed")
+        raise ERR.file_parser.parse_failed.error("unrecognized image format")
     doc = Document(title=f"{info['format']} image")
     rows = [["property", "value"]] + [[k, str(v)] for k, v in info.items()]
     rows.append(["size_bytes", str(len(data))])

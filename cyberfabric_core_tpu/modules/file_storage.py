@@ -17,6 +17,7 @@ from aiohttp import web
 from ..modkit import Module, module
 from ..modkit.contracts import RestApiCapability
 from ..modkit.context import ModuleCtx
+from ..modkit.errcat import ERR
 from ..modkit.errors import ProblemError
 from ..modkit.security import SecurityContext
 from ..gateway.middleware import SECURITY_CONTEXT_KEY
@@ -44,7 +45,7 @@ class LocalFileStorage(FileStorageApi):
             raise ProblemError.bad_request("malformed file id")
         path = self._dir_for(ctx.tenant_id) / file_id
         if not path.exists():
-            raise ProblemError.not_found(f"file {file_id} not found", code="file_not_found")
+            raise ERR.file_storage.file_not_found.error(f"file {file_id} not found")
         return path
 
     async def store(self, ctx: SecurityContext, data: bytes, mime_type: str,

@@ -19,6 +19,7 @@ from typing import Any, AsyncIterator, Optional
 
 import aiohttp
 
+from ...modkit.errcat import ERR
 from ...modkit.errors import Problem, ProblemError
 from ...modkit.security import SecurityContext
 from ..sdk import ChatStreamChunk, ModelInfo, OagwApi, parse_sse_stream
@@ -69,9 +70,8 @@ class ExternalProviderAdapter:
             ) as resp:
                 if resp.status >= 400:
                     detail = (await resp.text())[:300]
-                    raise ProblemError(Problem(
-                        status=502, title="Bad Gateway", code="provider_error",
-                        detail=f"provider returned {resp.status}: {detail}"))
+                    raise ERR.llm.provider_error.error(
+                        f"provider returned {resp.status}: {detail}")
                 usage: Optional[dict] = None
                 finish: Optional[str] = None
                 async for event in parse_sse_stream(resp.content.iter_chunked(8192)):
@@ -99,6 +99,5 @@ class ExternalProviderAdapter:
                     request_id=request_id, finish_reason=finish or "stop",
                     usage=usage or {"input_tokens": 0, "output_tokens": n_out})
         except aiohttp.ClientError as e:
-            raise ProblemError(Problem(
-                status=502, title="Bad Gateway", code="provider_unreachable",
-                detail=f"provider {model.provider_slug}: {e}"))
+            raise ERR.llm.provider_unreachable.error(
+                f"provider {model.provider_slug}: {e}")

@@ -24,6 +24,7 @@ from typing import Any, Optional
 
 import aiohttp
 
+from ...modkit.errcat import ERR
 from ...modkit.errors import Problem, ProblemError
 from ...modkit.security import SecurityContext
 from ..sdk import FileStorageApi, ModelInfo, OagwApi
@@ -32,20 +33,18 @@ logger = logging.getLogger("llm_media")
 
 
 def _managed_unsupported(model: ModelInfo, what: str) -> ProblemError:
-    return ProblemError(Problem(
-        status=501, title="Not Implemented", code="modality_not_implemented",
-        detail=f"managed model {model.canonical_id} does not serve {what}; "
-               f"register a provider-backed model for this modality"))
+    return ERR.llm.modality_not_implemented.error(
+        f"managed model {model.canonical_id} does not serve {what}; "
+        f"register a provider-backed model for this modality")
 
 
 def _require_capability(model: ModelInfo, flag: str, what: str) -> None:
     # the flag must be declared — an empty capabilities block (the registry
     # default) means "chat only", not "everything"
     if not (model.capabilities or {}).get(flag, False):
-        raise ProblemError(Problem(
-            status=409, title="Conflict", code="capability_missing",
-            detail=f"model {model.canonical_id} does not declare the "
-                   f"{flag} capability required for {what}"))
+        raise ERR.llm.capability_missing.error(
+            f"model {model.canonical_id} does not declare the "
+            f"{flag} capability required for {what}")
 
 
 class MediaAdapter:
@@ -73,18 +72,16 @@ class MediaAdapter:
         ) as resp:
             if resp.status >= 400:
                 detail = (await resp.text())[:300]
-                raise ProblemError(Problem(
-                    status=502, title="Bad Gateway", code="provider_error",
-                    detail=f"provider returned {resp.status}: {detail}"))
+                raise ERR.llm.provider_error.error(
+                    f"provider returned {resp.status}: {detail}")
             if raw:
                 return await resp.read()
             return await resp.json(content_type=None)
 
     def _storage_required(self) -> FileStorageApi:
         if self._storage is None:
-            raise ProblemError(Problem(
-                status=503, title="Service Unavailable", code="storage_missing",
-                detail="file-storage module required for media output"))
+            raise ERR.llm.storage_missing.error(
+                "file-storage module required for media output")
         return self._storage
 
     # ------------------------------------------------------------- images
@@ -115,9 +112,8 @@ class MediaAdapter:
                 items.append({"url": entry["url"],
                               "revised_prompt": entry.get("revised_prompt")})
         if not items:
-            raise ProblemError(Problem(
-                status=502, title="Bad Gateway", code="provider_error",
-                detail="provider returned no image payloads"))
+            raise ERR.llm.provider_error.error(
+                "provider returned no image payloads")
         return {"data": items, "model_used": model.canonical_id}
 
     # ------------------------------------------------------------- video
@@ -150,20 +146,17 @@ class MediaAdapter:
         while "data" not in out:
             status = str(out.get("status", ""))
             if status in ("failed", "cancelled", "error"):
-                raise ProblemError(Problem(
-                    status=502, title="Bad Gateway", code="provider_error",
-                    detail=f"video generation {status}: "
-                           f"{str(out.get('error', ''))[:200]}"))
+                raise ERR.llm.provider_error.error(
+                    f"video generation {status}: "
+                    f"{str(out.get('error', ''))[:200]}")
             job_id = out.get("id")
             if not job_id:
-                raise ProblemError(Problem(
-                    status=502, title="Bad Gateway", code="provider_error",
-                    detail="provider returned neither video data nor a job id"))
+                raise ERR.llm.provider_error.error(
+                    "provider returned neither video data nor a job id")
             if _time.monotonic() > deadline:
-                raise ProblemError(Problem(
-                    status=504, title="Gateway Timeout", code="provider_timeout",
-                    detail=f"video job {job_id} still {status or 'pending'} "
-                           f"after {self._video_poll_timeout_s:.0f}s"))
+                raise ERR.llm.provider_timeout.error(
+                    f"video job {job_id} still {status or 'pending'} "
+                    f"after {self._video_poll_timeout_s:.0f}s")
             await asyncio.sleep(self._video_poll_interval_s)
             out = await self._provider_call(
                 ctx, model, f"videos/generations/{job_id}", method="GET")
@@ -181,9 +174,8 @@ class MediaAdapter:
                 items.append({"url": entry["url"],
                               "revised_prompt": entry.get("revised_prompt")})
         if not items:
-            raise ProblemError(Problem(
-                status=502, title="Bad Gateway", code="provider_error",
-                detail="provider returned no video payloads"))
+            raise ERR.llm.provider_error.error(
+                "provider returned no video payloads")
         return {"data": items, "model_used": model.canonical_id}
 
     # ------------------------------------------------------------- tts

@@ -20,6 +20,7 @@ from typing import Any, Optional
 
 import jsonschema
 
+from ...modkit.errcat import ERR
 from ...modkit.errors import ProblemError
 from ...modkit.security import SecurityContext
 from ..sdk import TypesRegistryApi
@@ -47,21 +48,18 @@ async def normalize_tools(
                                "parameters": schema})
         elif kind == "reference":
             if types_registry is None:
-                raise ProblemError.unprocessable(
-                    "tool_reference requires the types registry",
-                    code="tool_resolution_failed")
+                raise ERR.llm.tool_resolution_failed.error(
+                    "tool_reference requires the types registry")
             entity = await types_registry.get(ctx, tool["schema_id"])
             if entity is None:
-                raise ProblemError.unprocessable(
-                    f"tool schema {tool['schema_id']!r} not registered",
-                    code="tool_resolution_failed")
+                raise ERR.llm.tool_resolution_failed.error(
+                    f"tool schema {tool['schema_id']!r} not registered")
             normalized.append({
                 "name": entity.body.get("title") or tool["schema_id"].split(".")[-2],
                 "description": entity.description or entity.body.get("description", ""),
                 "parameters": entity.body})
         else:
-            raise ProblemError.unprocessable(f"unknown tool type {kind!r}",
-                                             code="bad_tool")
+            raise ERR.llm.bad_tool.error(f"unknown tool type {kind!r}")
     return normalized
 
 
@@ -101,16 +99,14 @@ def build_tool_calls_response(
     by_name = {t["name"]: t for t in tools}
     tool = by_name.get(call["name"])
     if tool is None:
-        raise ProblemError.unprocessable(
-            f"model called unknown tool {call['name']!r}",
-            code="unknown_tool_called")
+        raise ERR.llm.unknown_tool_called.error(
+            f"model called unknown tool {call['name']!r}")
     args = call.get("arguments", {})
     validator = jsonschema.Draft202012Validator(tool["parameters"])
     errors = [e.message for e in validator.iter_errors(args)]
     if errors:
-        raise ProblemError.unprocessable(
-            f"tool arguments failed schema validation: {errors[:3]}",
-            code="tool_arguments_invalid")
+        raise ERR.llm.tool_arguments_invalid.error(
+            f"tool arguments failed schema validation: {errors[:3]}")
     return [{
         "index": 0,
         "id": f"call-{uuid.uuid4().hex[:12]}",
@@ -124,14 +120,12 @@ def validate_structured_output(text: str, response_schema: dict) -> dict[str, An
     try:
         obj = json.loads(text)
     except json.JSONDecodeError as e:
-        raise ProblemError.unprocessable(
-            f"structured output is not valid JSON: {e}",
-            code="structured_output_invalid")
+        raise ERR.llm.structured_output_invalid.error(
+            f"structured output is not valid JSON: {e}")
     validator = jsonschema.Draft202012Validator(response_schema)
     errors = [e.message for e in validator.iter_errors(obj)]
     if errors:
-        raise ProblemError.unprocessable(
+        raise ERR.llm.structured_output_invalid.error(
             "structured output failed schema validation",
-            errors=[{"field": "output", "message": m} for m in errors[:8]],
-            code="structured_output_invalid")
+            errors=[{"field": "output", "message": m} for m in errors[:8]])
     return obj

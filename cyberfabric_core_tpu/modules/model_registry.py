@@ -19,6 +19,7 @@ from ..modkit import Module, module
 from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
 from ..modkit.context import ModuleCtx
 from ..modkit.db import ScopableEntity
+from ..modkit.errcat import ERR
 from ..modkit.errors import Problem, ProblemError
 from ..modkit.security import SecurityContext
 from .sdk import ModelInfo, ModelRegistryApi
@@ -140,10 +141,9 @@ class ModelRegistryService(ModelRegistryApi):
             anc_row = self._conn_for(ancestor, MODELS).find_one(
                 {"canonical_id": canonical})
             if anc_row is not None and not anc_row.get("shadowable", True):
-                raise ProblemError.conflict(
+                raise ERR.model_registry.shadowing_disabled.error(
                     f"model {canonical} is defined by ancestor tenant "
-                    f"{ancestor!r} with shadowing disabled",
-                    code="shadowing_disabled")
+                    f"{ancestor!r} with shadowing disabled")
         default_approval = "approved" if self._auto_approved(spec) else "pending"
         row = {
             "provider_slug": spec["provider_slug"],
@@ -183,11 +183,9 @@ class ModelRegistryService(ModelRegistryApi):
             raise ProblemError.not_found(f"model {canonical_id} not found")
         cur = row["approval_state"]
         if new_state not in _APPROVAL_TRANSITIONS.get(cur, set()):
-            raise ProblemError.conflict(
+            raise ERR.model_registry.invalid_transition.error(
                 f"approval transition {cur} -> {new_state} not allowed "
-                f"(allowed: {sorted(_APPROVAL_TRANSITIONS.get(cur, set()))})",
-                code="invalid_transition",
-            )
+                f"(allowed: {sorted(_APPROVAL_TRANSITIONS.get(cur, set()))})")
         conn.update(row["id"], {"approval_state": new_state})
         self._invalidate_all()
         row["approval_state"] = new_state
@@ -234,7 +232,7 @@ class ModelRegistryService(ModelRegistryApi):
         target = name
         for _ in range(8):
             if target in seen:
-                raise ProblemError.conflict(f"alias cycle at {target!r}", code="alias_cycle")
+                raise ERR.model_registry.alias_cycle.error(f"alias cycle at {target!r}")
             seen.add(target)
             alias_row = None
             alias_level = -1
@@ -280,7 +278,7 @@ class ModelRegistryService(ModelRegistryApi):
                 if candidates:
                     break  # ambiguous at this level — do not guess
         if row is None:
-            raise ProblemError.not_found(f"model {name!r} not found", code="model_not_found")
+            raise ERR.model_registry.model_not_found.error(f"model {name!r} not found")
         if row["approval_state"] != "approved":
             raise ProblemError.forbidden(
                 f"model {row['canonical_id']} is not approved "
@@ -292,9 +290,8 @@ class ModelRegistryService(ModelRegistryApi):
         if self.provider_health(row["provider_slug"]) == "unhealthy":
             # health-aware resolution: fallback chains route around sick
             # providers (PRD ProviderHealth + DESIGN fallback ranking)
-            raise ProblemError.service_unavailable(
-                f"provider {row['provider_slug']} is unhealthy",
-                code="provider_unhealthy")
+            raise ERR.model_registry.provider_unhealthy.error(
+                f"provider {row['provider_slug']} is unhealthy")
         return self._to_info(row)
 
     async def list_models(self, ctx: SecurityContext, filter_text: Optional[str] = None,
@@ -393,10 +390,9 @@ class ModelRegistryModule(Module, DatabaseCapability, RestApiCapability):
             sc = request[SECURITY_CONTEXT_KEY]
             info = await svc.resolve(sc, request.match_info["name"])
             if not info.managed:
-                raise ProblemError(Problem(
-                    status=409, title="Conflict", code="not_managed",
-                    detail=f"{info.canonical_id} is provider-backed; StableHLO "
-                           f"export applies to managed (local TPU) models"))
+                raise ERR.model_registry.not_managed.error(
+                    f"{info.canonical_id} is provider-backed; StableHLO "
+                    f"export applies to managed (local TPU) models")
             opts = info.engine_options or {}
             model_cfg = opts.get("model_config", info.provider_model_id)
             out_root = ctx.app_config.home_dir() / "artifacts" / "stablehlo"
@@ -412,10 +408,8 @@ class ModelRegistryModule(Module, DatabaseCapability, RestApiCapability):
             except (KeyError, ValueError) as e:
                 # unknown model_config (e.g. an HF id with no built-in config)
                 # or architecture/config mismatch — a client problem, not a 500
-                raise ProblemError(Problem(
-                    status=422, title="Unprocessable Entity",
-                    code="export_unsupported",
-                    detail=f"cannot export {info.canonical_id}: {e}")) from e
+                raise ERR.model_registry.export_unsupported.error(
+                    f"cannot export {info.canonical_id}: {e}") from e
             return manifest
 
         async def set_alias(request: web.Request):

@@ -75,12 +75,11 @@ class MonitoringModule(Module, RestApiCapability):
         # traces + XLA cost-analysis dumps are the device-side observability
         # triple; cost analysis lives on the engine, this is the trace leg)
         async def profiler_start(request: web.Request):
-            from ..modkit.errors import Problem, ProblemError
+            from ..modkit.errcat import ERR
 
             if self._profile_dir is not None:
-                raise ProblemError(Problem(
-                    status=409, title="Conflict", code="profiler_running",
-                    detail=f"trace already running at {self._profile_dir}"))
+                raise ERR.monitoring.profiler_running.error(
+                    f"trace already running at {self._profile_dir}")
             import time
 
             import jax
@@ -104,11 +103,10 @@ class MonitoringModule(Module, RestApiCapability):
             return {"status": "started", "dir": str(out)}
 
         async def profiler_stop(request: web.Request):
-            from ..modkit.errors import Problem, ProblemError
+            from ..modkit.errcat import ERR
 
             if self._profile_dir is None:
-                raise ProblemError.bad_request(
-                    "no trace running", code="profiler_not_running")
+                raise ERR.monitoring.profiler_not_running.error("no trace running")
             import jax
 
             # clear state FIRST: a failing stop_trace must not wedge the
@@ -120,9 +118,7 @@ class MonitoringModule(Module, RestApiCapability):
                 self._tracer_maybe_live = False
             except Exception as e:
                 self._tracer_maybe_live = True
-                raise ProblemError(Problem(
-                    status=500, title="Internal Server Error",
-                    code="profiler_stop_failed", detail=str(e)[:200]))
+                raise ERR.monitoring.profiler_stop_failed.error(str(e)[:200])
             files = sorted(str(p.relative_to(out))
                            for p in out.rglob("*") if p.is_file())
             return {"status": "stopped", "dir": str(out), "files": files}

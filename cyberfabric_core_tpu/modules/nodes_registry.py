@@ -19,6 +19,7 @@ from ..modkit import Module, module, node_info
 from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
 from ..modkit.context import ModuleCtx
 from ..modkit.db import ScopableEntity
+from ..modkit.errcat import ERR
 from ..modkit.errors import ProblemError
 from ..gateway.middleware import SECURITY_CONTEXT_KEY
 from ..gateway.validation import read_json
@@ -96,7 +97,7 @@ class NodesRegistryModule(Module, DatabaseCapability, RestApiCapability):
             conn = db.secure(request[SECURITY_CONTEXT_KEY], NODES)
             row = conn.get(request.match_info["node_id"])
             if row is None:
-                raise ProblemError.not_found("node not found", code="node_not_found")
+                raise ERR.nodes_registry.node_not_found.error("node not found")
             return row
 
         async def heartbeat(request: web.Request):

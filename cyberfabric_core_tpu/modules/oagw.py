@@ -30,6 +30,7 @@ from ..modkit import Module, module
 from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
 from ..modkit.context import ModuleCtx
 from ..modkit.db import ScopableEntity
+from ..modkit.errcat import ERR
 from ..modkit.errors import Problem, ProblemError
 from ..modkit.security import SecurityContext
 from ..gateway.middleware import SECURITY_CONTEXT_KEY
@@ -163,16 +164,14 @@ async def _assert_public_destination(host: str) -> None:
         try:
             infos = await loop.getaddrinfo(host, None, type=socket.SOCK_STREAM)
         except socket.gaierror as e:
-            raise ProblemError.bad_request(
-                f"upstream host {host!r} does not resolve: {e}",
-                code="upstream_unresolvable")
+            raise ERR.oagw.upstream_unresolvable.error(
+                f"upstream host {host!r} does not resolve: {e}")
         addrs = [ipaddress.ip_address(info[4][0]) for info in infos]
     for a in addrs:
         if (a.is_private or a.is_loopback or a.is_link_local or a.is_reserved
                 or a.is_multicast or a.is_unspecified):
-            raise ProblemError.forbidden(
-                f"upstream host {host!r} resolves to non-public address {a}",
-                code="upstream_forbidden")
+            raise ERR.oagw.upstream_forbidden.error(
+                f"upstream host {host!r} resolves to non-public address {a}")
 
 
 class OagwService(OagwApi):
@@ -216,9 +215,9 @@ class OagwService(OagwApi):
         base_url = spec["base_url"]
         if base_url.startswith("http://"):
             if not self.allow_insecure_http:
-                raise ProblemError.bad_request(
+                raise ERR.oagw.insecure_upstream.error(
                     "base_url must be https (set oagw.allow_insecure_http for "
-                    "dev environments)", code="insecure_upstream")
+                    "dev environments)")
         elif not base_url.startswith("https://"):
             raise ProblemError.bad_request("base_url must be http(s)")
         auth = spec.get("auth") or {}
@@ -236,8 +235,8 @@ class OagwService(OagwApi):
             # scheme rules as base_url or it becomes an SSRF side door
             if auth["token_url"].startswith("http://"):
                 if not self.allow_insecure_http:
-                    raise ProblemError.bad_request(
-                        "token_url must be https", code="insecure_upstream")
+                    raise ERR.oagw.insecure_upstream.error(
+                        "token_url must be https")
             elif not auth["token_url"].startswith("https://"):
                 raise ProblemError.bad_request("token_url must be http(s)")
         conn = self._db.secure(ctx, UPSTREAMS)
@@ -285,8 +284,7 @@ class OagwService(OagwApi):
     def _get_route(self, ctx: SecurityContext, slug: str) -> dict:
         row = self._db.secure(ctx, ROUTES).find_one({"slug": slug})
         if row is None or not row.get("enabled"):
-            raise ProblemError.not_found(f"route {slug!r} not found",
-                                         code="route_not_found")
+            raise ERR.oagw.route_not_found.error(f"route {slug!r} not found")
         return row
 
     def list_upstreams(self, ctx: SecurityContext) -> list[dict]:
@@ -305,8 +303,7 @@ class OagwService(OagwApi):
     def _get_upstream(self, ctx: SecurityContext, slug: str) -> dict:
         row = self._db.secure(ctx, UPSTREAMS).find_one({"slug": slug})
         if row is None or not row.get("enabled"):
-            raise ProblemError.not_found(f"upstream {slug!r} not found",
-                                         code="upstream_not_found")
+            raise ERR.oagw.upstream_not_found.error(f"upstream {slug!r} not found")
         return row
 
     def _breaker_for(self, ctx: SecurityContext, upstream: dict) -> CircuitBreaker:
@@ -351,9 +348,8 @@ class OagwService(OagwApi):
         if self._credstore is not None:
             secret = await self._credstore.get_secret(ctx, auth["secret_ref"])
         if secret is None:
-            raise ProblemError(Problem(
-                status=502, title="Bad Gateway", code="credential_missing",
-                detail=f"secret {auth['secret_ref']!r} not found in credstore"))
+            raise ERR.oagw.credential_missing.error(
+                f"secret {auth['secret_ref']!r} not found in credstore")
         if auth["type"] == "bearer":
             headers["Authorization"] = f"Bearer {secret}"
         elif auth["type"] == "oauth2":
@@ -383,9 +379,7 @@ class OagwService(OagwApi):
             try:
                 headers["Authorization"] = f"Bearer {await source.get_token()}"
             except OAuth2Error as e:
-                raise ProblemError(Problem(
-                    status=502, title="Bad Gateway", code="oauth2_token_error",
-                    detail=str(e)))
+                raise ERR.oagw.oauth2_token_error.error(str(e))
         else:
             headers[auth.get("header_name", "X-Api-Key")] = secret
 
@@ -399,9 +393,8 @@ class OagwService(OagwApi):
 
         breaker = self._breaker_for(ctx, upstream)
         if not breaker.allow():
-            raise ProblemError(Problem(
-                status=503, title="Service Unavailable", code="CircuitBreakerOpen",
-                detail=f"circuit breaker open for upstream {slug}"))
+            raise ERR.oagw.circuit_open.error(
+                f"circuit breaker open for upstream {slug}")
 
         # header hygiene + credential injection
         strip = set(_STRIP_REQUEST_HEADERS)
@@ -442,9 +435,7 @@ class OagwService(OagwApi):
                 return out
         except aiohttp.ClientError as e:
             breaker.record_failure()
-            raise ProblemError(Problem(
-                status=502, title="Bad Gateway", code="upstream_error",
-                detail=f"upstream {slug}: {e}"))
+            raise ERR.oagw.upstream_error.error(f"upstream {slug}: {e}")
 
     def open_upstream_stream(self, ctx: SecurityContext, slug: str, path: str,
                              *, method: str = "POST", json_body: Any = None,
@@ -461,10 +452,8 @@ class OagwService(OagwApi):
             self._acquire_rate(ctx, upstream)
             breaker = self._breaker_for(ctx, upstream)
             if not breaker.allow():
-                raise ProblemError(Problem(
-                    status=503, title="Service Unavailable",
-                    code="CircuitBreakerOpen",
-                    detail=f"circuit breaker open for upstream {slug}"))
+                raise ERR.oagw.circuit_open.error(
+                    f"circuit breaker open for upstream {slug}")
             hdrs = dict(headers or {})
             await self._inject_credentials(ctx, upstream, hdrs)
             if not self.allow_private_upstreams:
@@ -488,9 +477,7 @@ class OagwService(OagwApi):
                         breaker.record_success()
             except aiohttp.ClientError as e:
                 breaker.record_failure()
-                raise ProblemError(Problem(
-                    status=502, title="Bad Gateway", code="upstream_error",
-                    detail=f"upstream {slug}: {e}"))
+                raise ERR.oagw.upstream_error.error(f"upstream {slug}: {e}")
 
         return cm()
 
@@ -501,9 +488,8 @@ class OagwService(OagwApi):
         route = self._get_route(ctx, route_slug)
         methods = route.get("methods") or []
         if methods and request.method.upper() not in methods:
-            raise ProblemError(Problem(
-                status=405, title="Method Not Allowed", code="method_not_allowed",
-                detail=f"route {route_slug} allows {methods}"))
+            raise ERR.oagw.method_not_allowed.error(
+                f"route {route_slug} allows {methods}")
         prefix = route.get("path_prefix") or ""
         full_tail = f"{prefix}/{tail.lstrip('/')}".strip("/") if prefix else tail
         return await self.proxy(request, ctx, route["upstream_slug"],

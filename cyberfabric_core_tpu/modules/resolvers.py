@@ -22,6 +22,7 @@ from typing import Any, Optional
 from ..modkit import Module, module
 from ..modkit.contracts import SystemCapability
 from ..modkit.context import ModuleCtx
+from ..modkit.errcat import ERR
 from ..modkit.errors import Problem, ProblemError
 from ..modkit.security import AccessScope, Dimension, ScopeFilter, SecretString, SecurityContext
 from ..gateway.middleware import AuthnApi, AuthzApi
@@ -140,10 +141,8 @@ class JwtAuthnResolver(AuthnApi):
                     except JwtError:
                         raise
                     except Exception as e:  # noqa: BLE001 — IdP down, no cache
-                        raise ProblemError(Problem(
-                            status=503, title="Service Unavailable",
-                            code="authn_unavailable",
-                            detail=f"JWKS endpoint unreachable: {e}"))
+                        raise ERR.core.authn_unavailable.error(
+                            f"JWKS endpoint unreachable: {e}")
                     self.validator.keys = {**self._static_keys, key.kid: key}
             claims = self.validator.validate(bearer_token)
         except JwtError as e:

@@ -38,6 +38,7 @@ from ..modkit.contracts import (
 )
 from ..modkit.context import ModuleCtx
 from ..modkit.db import ScopableEntity
+from ..modkit.errcat import ERR
 from ..modkit.errors import ProblemError
 from ..modkit.lifecycle import ReadySignal
 from ..modkit.security import SecurityContext
@@ -245,19 +246,16 @@ class ServerlessService(ServerlessApi):
         if kind == "function":
             fn = definition.get("function")
             if fn not in self._functions:
-                raise ProblemError.unprocessable(
-                    f"unknown function {fn!r}; available: {sorted(self._functions)}",
-                    code="unknown_function")
+                raise ERR.serverless.unknown_function.error(
+                    f"unknown function {fn!r}; available: {sorted(self._functions)}")
         else:
             steps = definition.get("steps") or []
             if not steps:
-                raise ProblemError.unprocessable("workflow needs steps",
-                                                 code="empty_workflow")
+                raise ERR.serverless.empty_workflow.error("workflow needs steps")
             for s in steps:
                 if s.get("function") not in self._functions:
-                    raise ProblemError.unprocessable(
-                        f"step uses unknown function {s.get('function')!r}",
-                        code="unknown_function")
+                    raise ERR.serverless.unknown_function.error(
+                        f"step uses unknown function {s.get('function')!r}")
         conn = self._db.secure(ctx, ENTRYPOINTS)
         existing = conn.select(where={"name": name}, order_by="version", descending=True)
         version = (existing[0]["version"] + 1) if existing else 1
@@ -280,8 +278,8 @@ class ServerlessService(ServerlessApi):
         allowed_csv, new_status = _STATUS_ACTIONS[action]
         row = self._resolve_ep(ctx, name, version, any_status=True)
         if row["status"] not in allowed_csv.split(","):
-            raise ProblemError.conflict(
-                f"cannot {action} from status {row['status']}", code="invalid_transition")
+            raise ERR.serverless.invalid_transition.error(
+                f"cannot {action} from status {row['status']}")
         conn = self._db.secure(ctx, ENTRYPOINTS)
         if action == "activate":
             # only one active version per name
@@ -301,8 +299,8 @@ class ServerlessService(ServerlessApi):
         if not any_status:
             rows = [r for r in rows if r["status"] == "active"] or rows
         if not rows:
-            raise ProblemError.not_found(f"entrypoint {name!r} not found",
-                                         code="entrypoint_not_found")
+            raise ERR.serverless.entrypoint_not_found.error(
+                f"entrypoint {name!r} not found")
         return rows[0]
 
     def _ep_view(self, row: dict) -> dict:
@@ -321,9 +319,8 @@ class ServerlessService(ServerlessApi):
             raise ProblemError.bad_request("entrypoint required")
         ep = self._resolve_ep(ctx, name, request.get("version"))
         if ep["status"] not in ("active", "deprecated"):
-            raise ProblemError.conflict(
-                f"entrypoint {name} is {ep['status']}, not invocable",
-                code="not_invocable")
+            raise ERR.serverless.not_invocable.error(
+                f"entrypoint {name} is {ep['status']}, not invocable")
         params = request.get("params") or {}
         mode = request.get("mode", "sync")
         dry_run = bool(request.get("dry_run"))
@@ -540,8 +537,7 @@ class ServerlessService(ServerlessApi):
     async def get_invocation(self, ctx: SecurityContext, invocation_id: str) -> dict:
         row = self._db.secure(ctx, INVOCATIONS).get(invocation_id)
         if row is None:
-            raise ProblemError.not_found("invocation not found",
-                                         code="invocation_not_found")
+            raise ERR.serverless.invocation_not_found.error("invocation not found")
         return self._inv_view(row)
 
     async def list_invocations(self, ctx: SecurityContext, **kw) -> Any:

@@ -18,6 +18,7 @@ from aiohttp import web
 from ..modkit import Module, module
 from ..modkit.contracts import RestApiCapability, SystemCapability
 from ..modkit.context import ModuleCtx
+from ..modkit.errcat import ERR
 from ..modkit.errors import ProblemError
 from ..modkit.security import SecurityContext
 from ..gateway.middleware import SECURITY_CONTEXT_KEY
@@ -44,10 +45,8 @@ def gts_uuid(gts_id: str) -> str:
 def validate_gts_id(gts_id: str) -> re.Match:
     m = _GTS_ID_RE.match(gts_id)
     if m is None:
-        raise ProblemError.unprocessable(
-            f"malformed GTS id {gts_id!r} (expected gts.vendor.pkg.ns.name.vN~[instance])",
-            code="bad_gts_id",
-        )
+        raise ERR.types_registry.bad_gts_id.error(
+            f"malformed GTS id {gts_id!r} (expected gts.vendor.pkg.ns.name.vN~[instance])")
     return m
 
 
@@ -64,8 +63,7 @@ class TypesRegistryService(TypesRegistryApi):
 
     def _gate(self) -> None:
         if not self._ready:
-            raise ProblemError.service_unavailable(
-                "types registry not ready", code="not_ready")
+            raise ERR.types_registry.not_ready.error("types registry not ready")
 
     async def register(self, ctx: SecurityContext, entity: GtsEntity) -> GtsEntity:
         m = validate_gts_id(entity.gts_id)
@@ -81,22 +79,20 @@ class TypesRegistryService(TypesRegistryApi):
             try:
                 jsonschema.Draft202012Validator.check_schema(entity.body)
             except jsonschema.SchemaError as e:
-                raise ProblemError.unprocessable(f"invalid JSON Schema: {e.message}",
-                                                 code="bad_schema")
+                raise ERR.types_registry.bad_schema.error(
+                    f"invalid JSON Schema: {e.message}")
         if entity.kind == "instance":
             base_id = entity.gts_id.split("~")[0] + "~"
             schema = self._entities.get(base_id)
             if schema is not None:
                 errors = await self.validate_instance(ctx, base_id, entity.body)
                 if errors:
-                    raise ProblemError.unprocessable(
+                    raise ERR.types_registry.instance_invalid.error(
                         "instance does not validate against its schema",
-                        errors=[{"field": "body", "message": e} for e in errors[:8]],
-                        code="instance_invalid",
-                    )
+                        errors=[{"field": "body", "message": e} for e in errors[:8]])
         if entity.gts_id in self._entities:
-            raise ProblemError.conflict(f"{entity.gts_id} already registered",
-                                        code="gts_exists")
+            raise ERR.types_registry.gts_exists.error(
+                f"{entity.gts_id} already registered")
         self._entities[entity.gts_id] = entity
         return entity
 
@@ -152,7 +148,7 @@ class TypesRegistryModule(Module, RestApiCapability, SystemCapability):
             gts_id = request.query.get("id", "")
             entity = await svc.get(request[SECURITY_CONTEXT_KEY], gts_id)
             if entity is None:
-                raise ProblemError.not_found(f"{gts_id} not registered", code="gts_not_found")
+                raise ERR.types_registry.gts_not_found.error(f"{gts_id} not registered")
             return {"gts_id": entity.gts_id, "kind": entity.kind, "body": entity.body,
                     "vendor": entity.vendor, "uuid": gts_uuid(entity.gts_id)}
 

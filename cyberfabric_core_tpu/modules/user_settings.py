@@ -18,6 +18,7 @@ from ..modkit import Module, module
 from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
 from ..modkit.context import ModuleCtx
 from ..modkit.db import ScopableEntity
+from ..modkit.errcat import ERR
 from ..modkit.errors import ProblemError
 from ..modkit.sse import SseBroadcaster
 from ..gateway.middleware import SECURITY_CONTEXT_KEY
@@ -103,7 +104,7 @@ class UserSettingsModule(Module, DatabaseCapability, RestApiCapability):
             row = conn(request).find_one({"user_id": sc.subject,
                                           "key": request.match_info["key"]})
             if row is None:
-                raise ProblemError.not_found("setting not found", code="setting_not_found")
+                raise ERR.user_settings.setting_not_found.error("setting not found")
             return {"key": row["key"], "value": row["value"]}
 
         async def list_settings(request: web.Request):
@@ -119,7 +120,7 @@ class UserSettingsModule(Module, DatabaseCapability, RestApiCapability):
             row = c.find_one({"user_id": sc.subject,
                               "key": request.match_info["key"]})
             if row is None or not c.delete(row["id"]):
-                raise ProblemError.not_found("setting not found", code="setting_not_found")
+                raise ERR.user_settings.setting_not_found.error("setting not found")
             self._publish(sc.tenant_id, {
                 "type": "setting.deleted", "key": row["key"],
                 "user_id": sc.subject})

@@ -215,3 +215,50 @@ def test_L7_rest_route_conventions():
                 if not seg_re.match(seg):
                     violations.append((*where, f"bad segment {seg!r}"))
     assert not violations, f"REST convention violations: {violations}"
+
+
+def test_EC01_error_codes_come_from_the_catalog():
+    """EC01 (declare_errors! parity): Problem/ProblemError call sites must not
+    invent error codes as string literals — codes live in
+    modkit/catalogs/errors.json and are referenced as typed constants
+    (modkit/errcat.ERR). Allowed exceptions: the catalog layer itself
+    (errcat.py) and the convenience-constructor plumbing in errors.py."""
+    allowed = {PKG / "modkit" / "errcat.py", PKG / "modkit" / "errors.py"}
+    violations = []
+    for path in sorted(PKG.rglob("*.py")):
+        if path in allowed:
+            continue
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else "")
+            is_problem_call = name in ("Problem", "ProblemError") or (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "ProblemError")
+            if not is_problem_call:
+                continue
+            for kw in node.keywords:
+                if kw.arg == "code" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    violations.append(
+                        f"{path.relative_to(PKG)}:{node.lineno} "
+                        f"literal code={kw.value.value!r}")
+    assert not violations, (
+        "error codes must come from modkit/catalogs/errors.json via "
+        f"errcat.ERR — literal codes found: {violations}")
+
+
+def test_EC01_catalog_codes_are_actually_used():
+    """The inverse direction: every catalog namespace is referenced somewhere
+    (a dead namespace means the catalog and the code drifted apart)."""
+    import json
+
+    catalog = json.loads(
+        (PKG / "modkit" / "catalogs" / "errors.json").read_text())
+    source = "\n".join(p.read_text() for p in PKG.rglob("*.py"))
+    unused = [ns for ns in catalog if f"ERR.{ns}." not in source]
+    assert not unused, f"catalog namespaces never referenced: {unused}"

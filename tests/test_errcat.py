@@ -1,0 +1,77 @@
+"""Typed error catalog (round-3 verdict item 4): codes compile from the
+committed JSON at import, carry GTS error-id types, and cannot collide or
+be invented ad hoc (arch-lint EC01 enforces call-site usage)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from cyberfabric_core_tpu.modkit.errcat import ALL_WIRE_CODES, ERR, ErrorCode
+from cyberfabric_core_tpu.modkit.errors import ProblemError
+
+CATALOG = Path(__file__).resolve().parents[1] / "cyberfabric_core_tpu" / \
+    "modkit" / "catalogs" / "errors.json"
+
+
+def test_codes_are_typed_constants():
+    code = ERR.model_registry.model_not_found
+    assert isinstance(code, ErrorCode)
+    assert code.status == 404 and code.code == "model_not_found"
+    assert code.gts_type == \
+        "gts://gts.x.core.model_registry.err.model_not_found.v1~"
+
+
+def test_problem_rendering_carries_gts_type():
+    p = ERR.llm.budget_exceeded.problem("out of tokens", used=10)
+    doc = p.to_dict()
+    assert doc["type"].startswith("gts://gts.x.core.llm.err.budget_exceeded")
+    assert doc["status"] == 429 and doc["code"] == "budget_exceeded"
+    assert doc["used"] == 10  # extensions flow through
+
+
+def test_error_raises_problem_error():
+    with pytest.raises(ProblemError) as e:
+        raise ERR.types_registry.gts_not_found.error("nope")
+    assert e.value.problem.status == 404
+    assert e.value.problem.code == "gts_not_found"
+
+
+def test_wire_spelling_override():
+    """Legacy wire spellings (oagw's CircuitBreakerOpen) keep their exact
+    on-wire code while the catalog key stays snake_case."""
+    c = ERR.oagw.circuit_open
+    assert c.key == "circuit_open" and c.code == "CircuitBreakerOpen"
+    assert "CircuitBreakerOpen" in ALL_WIRE_CODES["oagw"]
+
+
+def test_unknown_code_and_namespace_fail_loudly():
+    with pytest.raises(AttributeError, match="errors.json"):
+        ERR.llm.no_such_code
+    with pytest.raises(AttributeError, match="namespace"):
+        ERR.no_such_namespace
+
+
+def test_convenience_constructors_are_catalog_backed():
+    """ProblemError.not_found et al. resolve through the core namespace —
+    their Problem type is a GTS id, not about:blank."""
+    p = ProblemError.not_found("missing").problem
+    assert p.type == "gts://gts.x.core.core.err.not_found.v1~"
+    assert p.code == "not_found" and p.status == 404
+    # custom code keeps the constructor's status/title (app escape hatch)
+    p = ProblemError.not_found("missing", code="thing_missing").problem
+    assert p.code == "thing_missing" and p.status == 404
+
+
+def test_catalog_json_is_well_formed():
+    data = json.loads(CATALOG.read_text())
+    assert len(data) >= 10
+    for ns, entries in data.items():
+        for key, spec in entries.items():
+            assert 400 <= spec["status"] <= 599, (ns, key)
+            assert spec["title"], (ns, key)
+    # no duplicate wire codes WITHIN a namespace (cross-namespace reuse like
+    # model_not_found in both llm and model_registry is intentional — the
+    # GTS type disambiguates)
+    for ns, codes in ALL_WIRE_CODES.items():
+        assert len(codes) == len(set(codes)), ns
