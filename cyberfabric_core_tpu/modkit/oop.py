@@ -135,22 +135,31 @@ async def run_oop_module(module_name: str) -> None:
     port = await server.start("127.0.0.1:0")
     endpoint = f"127.0.0.1:{port}"
     directory = DirectoryClient(directory_endpoint)
-    instance_id = await directory.register(
-        service_name=f"module.{module_name}", endpoint=endpoint,
-        module_name=module_name)
-    logger.info("oop %s serving at %s (instance %s)", module_name, endpoint, instance_id)
+    # advertise every service the module actually registered (canonical IDL
+    # names like calculator.v1.CalculatorService); modules exposing no gRPC
+    # service still register under the module.<name> convention so the host
+    # can see them alive
+    service_names = server.service_names() or [f"module.{module_name}"]
+    instance_ids = [
+        await directory.register(service_name=sn, endpoint=endpoint,
+                                 module_name=module_name)
+        for sn in service_names]
+    logger.info("oop %s serving %s at %s (instances %s)",
+                module_name, service_names, endpoint, instance_ids)
 
     try:
         while not token.is_cancelled:
             await token.run_until_cancelled(asyncio.sleep(3.0))
             if token.is_cancelled:
                 break
-            await directory.heartbeat(instance_id)
+            for instance_id in instance_ids:
+                await directory.heartbeat(instance_id)
     finally:
-        try:
-            await directory.deregister(instance_id)
-        except Exception:  # noqa: BLE001 — the hub may already be gone
-            pass
+        for instance_id in instance_ids:
+            try:
+                await directory.deregister(instance_id)
+            except Exception:  # noqa: BLE001 — the hub may already be gone
+                pass
         await directory.close()
         await server.stop()
 

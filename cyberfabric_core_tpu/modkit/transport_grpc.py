@@ -29,6 +29,44 @@ from grpc import aio as grpc_aio
 logger = logging.getLogger("transport_grpc")
 
 Handler = Callable[[dict], Awaitable[dict]]
+#: server-streaming handler: request dict → async iterator of chunk dicts
+StreamHandler = Callable[[dict], Any]
+
+#: abort-details marker carrying a serialized RFC-9457 problem — a remote
+#: worker's typed 4xx must re-raise as the SAME ProblemError on the caller,
+#: or the "cannot tell remote from in-process" contract breaks on every
+#: error path (a remote 422 would read as a local 500)
+_PROBLEM_MARK = "problem+json:"
+
+_STATUS_TO_GRPC = {
+    400: grpc.StatusCode.INVALID_ARGUMENT,
+    401: grpc.StatusCode.UNAUTHENTICATED,
+    403: grpc.StatusCode.PERMISSION_DENIED,
+    404: grpc.StatusCode.NOT_FOUND,
+    409: grpc.StatusCode.ABORTED,
+    422: grpc.StatusCode.INVALID_ARGUMENT,
+    429: grpc.StatusCode.RESOURCE_EXHAUSTED,
+    503: grpc.StatusCode.UNAVAILABLE,
+    504: grpc.StatusCode.DEADLINE_EXCEEDED,
+}
+
+
+async def _abort_problem(context, exc) -> None:
+    problem = exc.problem
+    await context.abort(
+        _STATUS_TO_GRPC.get(problem.status, grpc.StatusCode.INTERNAL),
+        _PROBLEM_MARK + json.dumps(problem.to_dict()))
+
+
+def raise_remote_problem(e: "grpc_aio.AioRpcError") -> None:
+    """If the server aborted with a serialized Problem, re-raise it typed;
+    otherwise return (caller re-raises the AioRpcError)."""
+    details = e.details() or ""
+    if details.startswith(_PROBLEM_MARK):
+        from .errors import Problem, ProblemError
+
+        raise ProblemError(Problem.from_dict(
+            json.loads(details[len(_PROBLEM_MARK):]))) from e
 
 
 def _ser(obj: dict) -> bytes:
@@ -89,46 +127,115 @@ def directory_codecs() -> dict[str, ProtoCodec]:
     }
 
 
+def calculator_codecs() -> dict[str, ProtoCodec]:
+    """CalculatorService codecs from proto/calculator/v1/calculator.proto."""
+    from .gen.calculator.v1 import calculator_pb2 as pb
+
+    return {
+        "Add": ProtoCodec(pb.BinaryOp, pb.OpResult),
+        "Mul": ProtoCodec(pb.BinaryOp, pb.OpResult),
+    }
+
+
+def llm_worker_codecs() -> dict[str, ProtoCodec]:
+    """LlmWorkerService codecs from proto/llmworker/v1/llm_worker.proto.
+    Streaming methods' response_cls encodes EACH chunk."""
+    from .gen.llmworker.v1 import llm_worker_pb2 as pb
+
+    return {
+        "ChatStream": ProtoCodec(pb.ChatRequest, pb.StreamChunk),
+        "Completion": ProtoCodec(pb.CompletionRequest, pb.StreamChunk),
+        "Embed": ProtoCodec(pb.EmbedRequest, pb.EmbedResponse),
+        "Health": ProtoCodec(pb.HealthRequest, pb.HealthResponse),
+    }
+
+
 class JsonGrpcServer:
     """grpc.aio server hosting JSON-unary services registered at runtime."""
 
     def __init__(self) -> None:
         self._services: dict[str, dict[str, Handler]] = {}
+        self._streams: dict[str, dict[str, StreamHandler]] = {}
         self._codecs: dict[str, dict[str, ProtoCodec]] = {}
         self._server: Optional[grpc_aio.Server] = None
         self.bound_port: Optional[int] = None
 
+    def service_names(self) -> list[str]:
+        """Every service registered on this server (unary or streaming) —
+        what an OoP bootstrap advertises to the directory."""
+        return sorted(set(self._services) | set(self._streams))
+
     def add_service(self, service_name: str, methods: dict[str, Handler],
-                    codecs: Optional[dict[str, "ProtoCodec"]] = None) -> None:
+                    codecs: Optional[dict[str, "ProtoCodec"]] = None,
+                    streams: Optional[dict[str, "StreamHandler"]] = None) -> None:
         self._services.setdefault(service_name, {}).update(methods)
+        if streams:
+            # server-streaming methods: handler is an async generator of
+            # chunk dicts (the llm-worker token-stream pattern)
+            self._streams.setdefault(service_name, {}).update(streams)
         if codecs:
             self._codecs.setdefault(service_name, {}).update(codecs)
 
     def _build(self) -> grpc_aio.Server:
         server = grpc_aio.server()
-        for service_name, methods in self._services.items():
+        all_services = set(self._services) | set(self._streams)
+        for service_name in sorted(all_services):
             handlers = {}
-            for method_name, fn in methods.items():
+            for method_name, fn in self._services.get(service_name, {}).items():
                 codec = self._codecs.get(service_name, {}).get(method_name)
 
                 async def unary(request: bytes, context, _fn=fn,
-                                _codec=codec) -> bytes:
+                                _codec=codec, _sn=service_name,
+                                _mn=method_name) -> bytes:
+                    from .errors import ProblemError
+
                     try:
                         req = (_codec.decode_request(request) if _codec
                                else _de(request))
                         out = await _fn(req)
                         return (_codec.encode_response(out) if _codec
                                 else _ser(out))
+                    except ProblemError as e:
+                        await _abort_problem(context, e)
                     except KeyError as e:
                         await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
                     except ValueError as e:
                         await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                     except Exception as e:  # noqa: BLE001
-                        logger.exception("rpc %s/%s failed", service_name, method_name)
+                        logger.exception("rpc %s/%s failed", _sn, _mn)
                         await context.abort(grpc.StatusCode.INTERNAL, str(e)[:300])
 
                 handlers[method_name] = grpc.unary_unary_rpc_method_handler(
                     unary,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                )
+            for method_name, gen in self._streams.get(service_name, {}).items():
+                codec = self._codecs.get(service_name, {}).get(method_name)
+
+                async def stream(request: bytes, context, _gen=gen,
+                                 _codec=codec, _sn=service_name,
+                                 _mn=method_name):
+                    from .errors import ProblemError
+
+                    try:
+                        req = (_codec.decode_request(request) if _codec
+                               else _de(request))
+                        async for chunk in _gen(req):
+                            yield (_codec.encode_response(chunk) if _codec
+                                   else _ser(chunk))
+                    except ProblemError as e:
+                        await _abort_problem(context, e)
+                    except KeyError as e:
+                        await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+                    except ValueError as e:
+                        await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                    except Exception as e:  # noqa: BLE001
+                        logger.exception("rpc %s/%s (stream) failed", _sn, _mn)
+                        await context.abort(grpc.StatusCode.INTERNAL, str(e)[:300])
+
+                handlers[method_name] = grpc.unary_stream_rpc_method_handler(
+                    stream,
                     request_deserializer=lambda b: b,
                     response_serializer=lambda b: b,
                 )
@@ -162,6 +269,10 @@ class GrpcClientConfig:
 
     connect_timeout_s: float = 5.0
     call_timeout_s: float = 30.0
+    #: server-stream deadline — covers the WHOLE stream, so it must dominate
+    #: the longest generation (gateway total_timeout default 600s), not the
+    #: unary call budget; None = no deadline
+    stream_timeout_s: Optional[float] = 900.0
     max_retries: int = 3
     retry_backoff_s: float = 0.1
     backoff_multiplier: float = 2.0
@@ -199,12 +310,37 @@ class JsonGrpcClient:
                 resp = await rpc(wire, timeout=self.config.call_timeout_s)
                 return codec.decode_response(resp) if codec else _de(resp)
             except grpc_aio.AioRpcError as e:
+                raise_remote_problem(e)  # typed server Problems re-raise as-is
                 if e.code() not in self._RETRYABLE or attempt == self.config.max_retries:
                     raise
                 last = e
                 await asyncio.sleep(delay)
                 delay *= self.config.backoff_multiplier
         raise last  # pragma: no cover
+
+    async def call_stream(self, service: str, method: str, payload: dict,
+                          codec: Optional[ProtoCodec] = None):
+        """Server-streaming call: yields chunk dicts. No automatic retry —
+        replaying a partially-consumed token stream would duplicate output;
+        callers own stream-level recovery."""
+        channel = await self._ensure_channel()
+        rpc = channel.unary_stream(
+            f"/{service}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        wire = codec.encode_request(payload) if codec else _ser(payload)
+
+        async def gen():
+            try:
+                async for resp in rpc(wire,
+                                      timeout=self.config.stream_timeout_s):
+                    yield codec.decode_response(resp) if codec else _de(resp)
+            except grpc_aio.AioRpcError as e:
+                raise_remote_problem(e)
+                raise
+
+        return gen()
 
     async def close(self) -> None:
         if self._channel is not None:

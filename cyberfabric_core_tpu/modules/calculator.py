@@ -16,9 +16,12 @@ from typing import Any, Optional
 from ..modkit import Module, module
 from ..modkit.contracts import GrpcServiceCapability
 from ..modkit.context import ModuleCtx
-from ..modkit.transport_grpc import DirectoryService, JsonGrpcClient
+from ..modkit.transport_grpc import (DirectoryService, JsonGrpcClient,
+                                     calculator_codecs)
 
-CALCULATOR_SERVICE = "module.calculator"
+#: canonical proto service path (proto/calculator/v1/calculator.proto) — the
+#: route /<service>/<method> on the wire matches the IDL package
+CALCULATOR_SERVICE = "calculator.v1.CalculatorService"
 
 
 class CalculatorApi(abc.ABC):
@@ -44,6 +47,7 @@ class GrpcCalculatorClient(CalculatorApi):
     def __init__(self, directory: DirectoryService) -> None:
         self._directory = directory
         self._client: Optional[JsonGrpcClient] = None
+        self._codecs = calculator_codecs()
 
     async def _ensure(self) -> JsonGrpcClient:
         if self._client is None:
@@ -55,11 +59,15 @@ class GrpcCalculatorClient(CalculatorApi):
 
     async def add(self, a: float, b: float) -> float:
         client = await self._ensure()
-        return (await client.call(CALCULATOR_SERVICE, "Add", {"a": a, "b": b}))["result"]
+        out = await client.call(CALCULATOR_SERVICE, "Add", {"a": a, "b": b},
+                                codec=self._codecs["Add"])
+        return out["result"]
 
     async def mul(self, a: float, b: float) -> float:
         client = await self._ensure()
-        return (await client.call(CALCULATOR_SERVICE, "Mul", {"a": a, "b": b}))["result"]
+        out = await client.call(CALCULATOR_SERVICE, "Mul", {"a": a, "b": b},
+                                codec=self._codecs["Mul"])
+        return out["result"]
 
 
 @module(name="calculator", capabilities=["grpc"])
@@ -81,4 +89,6 @@ class CalculatorModule(Module, GrpcServiceCapability):
         async def mul(req: dict) -> dict:
             return {"result": await svc.mul(float(req["a"]), float(req["b"]))}
 
-        server.add_service(CALCULATOR_SERVICE, {"Add": add, "Mul": mul})
+        # typed wire contract: requests/responses are calculator.v1 protobuf
+        server.add_service(CALCULATOR_SERVICE, {"Add": add, "Mul": mul},
+                           codecs=calculator_codecs())

@@ -1,0 +1,238 @@
+"""LlmWorkerService over gRPC — the worker SDK surface as a typed wire
+contract (round-3 verdict item 4).
+
+The in-process path stays ClientHub DI (zero serialization); this module is
+the OUT-of-process leg: a host can run the TPU worker in another process (or
+on another machine) and the llm-gateway consumes it through the committed
+IDL (proto/llmworker/v1/llm_worker.proto) — exactly how the reference's OoP
+modules speak typed tonic services (libs/modkit-transport-grpc/src/client.rs:180,
+proto/directory/v1/directory.proto pattern). Token streams ride gRPC
+server-streaming; open-world option maps ride google.protobuf.Struct.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Optional
+
+from ...modkit.transport_grpc import (DirectoryService, JsonGrpcClient,
+                                      llm_worker_codecs)
+from ..sdk import ChatStreamChunk, LlmWorkerApi, ModelInfo
+
+#: canonical proto service path (proto/llmworker/v1/llm_worker.proto)
+LLM_WORKER_SERVICE = "llmworker.v1.LlmWorkerService"
+
+
+# ------------------------------------------------------------ conversions
+
+def _destruct(value: Any) -> Any:
+    """Normalize google.protobuf.Struct decoding artifacts: Struct stores all
+    numbers as doubles, so integral floats come back as ints (max_tokens=2.0
+    → 2 — what the JSON path and in-process path deliver); containers recurse."""
+    if isinstance(value, float) and value.is_integer():
+        return int(value)
+    if isinstance(value, dict):
+        return {k: _destruct(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_destruct(v) for v in value]
+    return value
+
+
+def _normalize_messages(messages: list[dict]) -> list[dict]:
+    """Wire → in-process shape: content parts are Structs (full fidelity for
+    every schema variant incl. tool_result/base64 data), so only proto3
+    envelope defaults need dropping (name=""/tool_calls=[] on messages that
+    never carried them) plus Struct number normalization."""
+    out = []
+    for m in messages:
+        msg: dict[str, Any] = {"role": m.get("role", ""),
+                               "content": _destruct(list(m.get("content", [])))}
+        if m.get("name"):
+            msg["name"] = m["name"]
+        if m.get("tool_calls"):
+            msg["tool_calls"] = _destruct(list(m["tool_calls"]))
+        out.append(msg)
+    return out
+
+def model_ref_dict(model: ModelInfo) -> dict:
+    """ModelInfo → ModelRef proto-dict (the fields a remote worker needs to
+    build an engine; registry-plane metadata like cost stays home)."""
+    return {
+        "canonical_id": model.canonical_id,
+        "provider_slug": model.provider_slug,
+        "provider_model_id": model.provider_model_id,
+        "managed": model.managed,
+        "architecture": model.architecture or "",
+        "checkpoint_path": model.checkpoint_path or "",
+        "engine_options": model.engine_options or {},
+        "limits": model.limits or {},
+        "capabilities": model.capabilities or {},
+    }
+
+
+def model_from_ref(ref: dict) -> ModelInfo:
+    return ModelInfo(
+        canonical_id=ref["canonical_id"],
+        provider_slug=ref.get("provider_slug", ""),
+        provider_model_id=ref.get("provider_model_id", ""),
+        managed=bool(ref.get("managed")),
+        architecture=ref.get("architecture") or None,
+        checkpoint_path=ref.get("checkpoint_path") or None,
+        engine_options=_destruct(ref.get("engine_options") or {}),
+        limits=_destruct(ref.get("limits") or {}),
+        capabilities=_destruct(ref.get("capabilities") or {}),
+    )
+
+
+def chunk_dict(c: ChatStreamChunk) -> dict:
+    """ChatStreamChunk → StreamChunk proto-dict. token_id=0 is a real id, so
+    presence rides the has_token_id flag (proto3 scalar defaults)."""
+    out: dict[str, Any] = {
+        "request_id": c.request_id,
+        "text": c.text,
+        "token_id": c.token_id or 0,
+        "has_token_id": c.token_id is not None,
+        "finish_reason": c.finish_reason or "",
+    }
+    if c.usage:
+        out["usage"] = {"input_tokens": int(c.usage.get("input_tokens", 0)),
+                        "output_tokens": int(c.usage.get("output_tokens", 0))}
+    return out
+
+
+def chunk_from_dict(d: dict) -> ChatStreamChunk:
+    usage = d.get("usage") or None
+    if usage is not None:
+        usage = {"input_tokens": int(usage.get("input_tokens", 0)),
+                 "output_tokens": int(usage.get("output_tokens", 0))}
+    return ChatStreamChunk(
+        request_id=d.get("request_id", ""),
+        text=d.get("text", ""),
+        token_id=int(d["token_id"]) if d.get("has_token_id") else None,
+        finish_reason=d.get("finish_reason") or None,
+        usage=usage,
+    )
+
+
+# ---------------------------------------------------------------- server
+
+def register_llm_worker_service(server: Any, worker: LlmWorkerApi) -> None:
+    """Expose ``worker`` as llmworker.v1.LlmWorkerService on a JsonGrpcServer
+    with the typed codecs — ChatStream/Completion are server-streaming."""
+
+    async def chat_stream(req: dict) -> AsyncIterator[dict]:
+        model = model_from_ref(req["model"])
+        async for chunk in worker.chat_stream(
+                model, _normalize_messages(req.get("messages", [])),
+                _destruct(dict(req.get("params") or {}))):
+            yield chunk_dict(chunk)
+
+    async def completion(req: dict) -> AsyncIterator[dict]:
+        model = model_from_ref(req["model"])
+        async for chunk in worker.completion_stream(
+                model, req.get("prompt", ""),
+                _destruct(dict(req.get("params") or {}))):
+            yield chunk_dict(chunk)
+
+    async def embed(req: dict) -> dict:
+        model = model_from_ref(req["model"])
+        vectors, total = await worker.embed(model, list(req.get("inputs", [])),
+                                            _destruct(dict(req.get("params") or {})))
+        return {"embeddings": [{"values": [float(x) for x in v]}
+                               for v in vectors],
+                "total_tokens": int(total)}
+
+    async def health(_req: dict) -> dict:
+        detail = await worker.health()
+        return {"status": str(detail.get("status", "ok")), "detail": detail}
+
+    server.add_service(
+        LLM_WORKER_SERVICE,
+        {"Embed": embed, "Health": health},
+        streams={"ChatStream": chat_stream, "Completion": completion},
+        codecs=llm_worker_codecs(),
+    )
+
+
+# ---------------------------------------------------------------- client
+
+class GrpcLlmWorkerClient(LlmWorkerApi):
+    """LlmWorkerApi over the typed wire — resolves the worker endpoint via
+    the directory (same SDK pattern as GrpcCalculatorClient) and speaks
+    llmworker.v1 protobuf. Drop-in for ClientHub: the llm-gateway cannot
+    tell a remote worker from the in-process one."""
+
+    def __init__(self, directory: Optional[DirectoryService] = None,
+                 endpoint: Optional[str] = None) -> None:
+        if directory is None and endpoint is None:
+            raise ValueError("need a directory or an explicit endpoint")
+        self._directory = directory
+        self._endpoint = endpoint
+        self._client: Optional[JsonGrpcClient] = None
+        self._codecs = llm_worker_codecs()
+
+    async def _ensure(self) -> JsonGrpcClient:
+        if self._client is None:
+            endpoint = self._endpoint
+            if endpoint is None:
+                inst = self._directory.resolve(LLM_WORKER_SERVICE)
+                if inst is None:
+                    raise ConnectionError(
+                        f"no live instance of {LLM_WORKER_SERVICE}")
+                endpoint = inst.endpoint
+            self._client = JsonGrpcClient(endpoint)
+        return self._client
+
+    @staticmethod
+    def _wire_params(params: Optional[dict]) -> dict:
+        """Strip the request fields that already travel as typed proto
+        (messages, model) — otherwise multimodal payloads (inlined document
+        text / base64 images) would cross the wire TWICE per call inside the
+        params Struct (review finding)."""
+        return {k: v for k, v in (params or {}).items()
+                if k not in ("messages", "model", "prompt")}
+
+    async def chat_stream(self, model: ModelInfo, messages: list[dict],
+                          params: dict) -> AsyncIterator[ChatStreamChunk]:
+        client = await self._ensure()
+        stream = await client.call_stream(
+            LLM_WORKER_SERVICE, "ChatStream",
+            {"model": model_ref_dict(model), "messages": messages,
+             "params": self._wire_params(params)},
+            codec=self._codecs["ChatStream"])
+        async for d in stream:
+            yield chunk_from_dict(d)
+
+    async def completion_stream(self, model: ModelInfo, prompt: str,
+                                params: dict) -> AsyncIterator[ChatStreamChunk]:
+        client = await self._ensure()
+        stream = await client.call_stream(
+            LLM_WORKER_SERVICE, "Completion",
+            {"model": model_ref_dict(model), "prompt": prompt,
+             "params": self._wire_params(params)},
+            codec=self._codecs["Completion"])
+        async for d in stream:
+            yield chunk_from_dict(d)
+
+    async def embed(self, model: ModelInfo, inputs: list[str],
+                    params: dict) -> tuple[list[list[float]], int]:
+        client = await self._ensure()
+        out = await client.call(
+            LLM_WORKER_SERVICE, "Embed",
+            {"model": model_ref_dict(model), "inputs": inputs,
+             "params": self._wire_params(params)},
+            codec=self._codecs["Embed"])
+        vectors = [[float(x) for x in e.get("values", [])]
+                   for e in out.get("embeddings", [])]
+        return vectors, int(out.get("total_tokens", 0))
+
+    async def health(self) -> dict[str, Any]:
+        client = await self._ensure()
+        out = await client.call(LLM_WORKER_SERVICE, "Health", {},
+                                codec=self._codecs["Health"])
+        return _destruct(
+            dict(out.get("detail") or {"status": out.get("status", "ok")}))
+
+    async def close(self) -> None:
+        if self._client is not None:
+            await self._client.close()
+            self._client = None

@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, AsyncIterator, Optional
 
+from ...modkit.errcat import ERR
 from ...modkit.errors import ProblemError
 from ...runtime.engine import EngineConfig, InferenceEngine, SamplingParams, StepEvent
 from ...runtime.scheduler import ContinuousBatchingEngine
@@ -363,16 +364,12 @@ class LocalTpuWorker(LlmWorkerApi):
         )
         max_input = int(model.limits.get("max_input_tokens", 0)) if model.limits else 0
         if max_input and len(prompt_ids) > max_input:
-            raise ProblemError.unprocessable(
-                f"prompt of {len(prompt_ids)} tokens exceeds model limit {max_input}",
-                code="context_length_exceeded",
-            )
+            raise ERR.llm.context_length_exceeded.error(
+                f"prompt of {len(prompt_ids)} tokens exceeds model limit {max_input}")
         if len(prompt_ids) >= entry.config.max_seq_len:
-            raise ProblemError.unprocessable(
+            raise ERR.llm.context_length_exceeded.error(
                 f"prompt of {len(prompt_ids)} tokens exceeds engine window "
-                f"{entry.config.max_seq_len}",
-                code="context_length_exceeded",
-            )
+                f"{entry.config.max_seq_len}")
 
         request_id = f"chat-{uuid.uuid4().hex[:20]}"
         queue: asyncio.Queue = asyncio.Queue()
@@ -394,7 +391,7 @@ class LocalTpuWorker(LlmWorkerApi):
             except ValueError as e:
                 # e.g. seed on the dense scheduler: a client-fixable request
                 # shape, not a server fault
-                raise ProblemError.bad_request(str(e), code="unsupported_param")
+                raise ERR.llm.unsupported_param.error(str(e))
         else:
             assert entry.batcher is not None
             await entry.batcher.submit(req)

@@ -1,0 +1,338 @@
+"""Typed protobuf contracts for application module services (round-3 verdict
+item 3): calculator + llm-worker speak committed IDL
+(proto/calculator/v1/calculator.proto, proto/llmworker/v1/llm_worker.proto)
+over gRPC — not ad-hoc JSON — and the JSON codec path agrees with the proto
+path wherever both exist."""
+
+import asyncio
+
+import pytest
+
+from cyberfabric_core_tpu.modkit.transport_grpc import (JsonGrpcClient,
+                                                        JsonGrpcServer,
+                                                        calculator_codecs,
+                                                        llm_worker_codecs)
+from cyberfabric_core_tpu.modules.sdk import ChatStreamChunk, ModelInfo
+
+
+def _loop():
+    return asyncio.new_event_loop()
+
+
+def test_calculator_wire_is_protobuf():
+    """The calculator RPC bytes on the wire ARE calculator.v1 protobuf:
+    encode via codec, decode with the generated class, and confirm the
+    payload is not JSON."""
+    import json
+
+    from cyberfabric_core_tpu.modkit.gen.calculator.v1 import calculator_pb2 as pb
+
+    codec = calculator_codecs()["Add"]
+    wire = codec.encode_request({"a": 2.5, "b": 4.0})
+    msg = pb.BinaryOp.FromString(wire)
+    assert msg.a == 2.5 and msg.b == 4.0
+    with pytest.raises(ValueError):
+        json.loads(wire.decode("utf-8", "replace"))
+
+
+def test_calculator_grpc_end_to_end_typed():
+    """Real grpc.aio server+client through the typed codecs, exactly as the
+    OoP calculator module wires them."""
+    from cyberfabric_core_tpu.modules.calculator import (CALCULATOR_SERVICE,
+                                                         LocalCalculator)
+
+    loop = _loop()
+    svc = LocalCalculator()
+    server = JsonGrpcServer()
+
+    async def add(req):
+        return {"result": await svc.add(float(req["a"]), float(req["b"]))}
+
+    server.add_service(CALCULATOR_SERVICE, {"Add": add},
+                       codecs=calculator_codecs())
+
+    async def go():
+        port = await server.start("127.0.0.1:0")
+        client = JsonGrpcClient(f"127.0.0.1:{port}")
+        try:
+            out = await client.call(CALCULATOR_SERVICE, "Add",
+                                    {"a": 20.0, "b": 22.0},
+                                    codec=calculator_codecs()["Add"])
+            return out
+        finally:
+            await client.close()
+            await server.stop()
+
+    try:
+        out = loop.run_until_complete(go())
+    finally:
+        loop.close()
+    assert out["result"] == 42.0
+
+
+def test_json_and_proto_paths_agree():
+    """Contract pin: the same handler served WITHOUT codecs (JSON wire) and
+    WITH codecs (proto wire) returns identical dicts to the caller."""
+    from cyberfabric_core_tpu.modules.calculator import LocalCalculator
+
+    loop = _loop()
+    svc = LocalCalculator()
+
+    async def add(req):
+        return {"result": await svc.add(float(req["a"]), float(req["b"]))}
+
+    json_server, proto_server = JsonGrpcServer(), JsonGrpcServer()
+    json_server.add_service("calc.json", {"Add": add})
+    proto_server.add_service("calculator.v1.CalculatorService", {"Add": add},
+                             codecs=calculator_codecs())
+
+    async def go():
+        jp = await json_server.start("127.0.0.1:0")
+        pp = await proto_server.start("127.0.0.1:0")
+        jc, pc = JsonGrpcClient(f"127.0.0.1:{jp}"), JsonGrpcClient(f"127.0.0.1:{pp}")
+        try:
+            payload = {"a": 1.25, "b": 2.5}
+            j = await jc.call("calc.json", "Add", payload)
+            p = await pc.call("calculator.v1.CalculatorService", "Add", payload,
+                              codec=calculator_codecs()["Add"])
+            return j, p
+        finally:
+            await jc.close()
+            await pc.close()
+            await json_server.stop()
+            await proto_server.stop()
+
+    try:
+        j, p = loop.run_until_complete(go())
+    finally:
+        loop.close()
+    assert j == p == {"result": 3.75}
+
+
+class _FakeWorker:
+    """Records what arrived over the wire; emits a deterministic stream."""
+
+    def __init__(self):
+        self.seen_models: list[ModelInfo] = []
+        self.seen_messages = None
+        self.seen_prompt = None
+        self.seen_params = None
+
+    async def chat_stream(self, model, messages, params):
+        self.seen_models.append(model)
+        self.seen_messages = messages
+        self.seen_params = params
+        yield ChatStreamChunk(request_id="r1", text="hel", token_id=0)
+        yield ChatStreamChunk(request_id="r1", text="lo", token_id=42)
+        yield ChatStreamChunk(request_id="r1", finish_reason="stop",
+                              usage={"input_tokens": 3, "output_tokens": 2})
+
+    async def completion_stream(self, model, prompt, params):
+        self.seen_models.append(model)
+        self.seen_prompt = prompt
+        yield ChatStreamChunk(request_id="r2", text=prompt.upper())
+        yield ChatStreamChunk(request_id="r2", finish_reason="length",
+                              usage={"input_tokens": 1, "output_tokens": 1})
+
+    async def embed(self, model, inputs, params):
+        self.seen_models.append(model)
+        return [[0.5, -1.5]] * len(inputs), 7
+
+    async def health(self):
+        return {"status": "ok", "engines": 2}
+
+
+def test_llm_worker_service_typed_roundtrip():
+    """LlmWorkerService e2e over real gRPC: streaming chat (token-id
+    presence semantics incl. the id-0 edge), raw completion, embeddings and
+    health — ModelRef fields (engine_options Struct included) survive the
+    typed wire."""
+    from cyberfabric_core_tpu.modules.llm_gateway.grpc_service import (
+        GrpcLlmWorkerClient, register_llm_worker_service)
+
+    worker = _FakeWorker()
+    server = JsonGrpcServer()
+    register_llm_worker_service(server, worker)
+    model = ModelInfo(
+        canonical_id="local::tiny-llama", provider_slug="local",
+        provider_model_id="tiny-llama", managed=True, architecture="llama",
+        engine_options={"model_config": "tiny-llama", "max_seq_len": 128},
+        limits={"max_output_tokens": 64})
+    messages = [{"role": "user",
+                 "content": [{"type": "text", "text": "hi"}]}]
+
+    async def go():
+        port = await server.start("127.0.0.1:0")
+        client = GrpcLlmWorkerClient(endpoint=f"127.0.0.1:{port}")
+        try:
+            chat = [c async for c in client.chat_stream(
+                model, messages, {"temperature": 0.0, "max_tokens": 2})]
+            comp = [c async for c in client.completion_stream(
+                model, "abc", {})]
+            vectors, total = await client.embed(model, ["x", "y"], {})
+            health = await client.health()
+            return chat, comp, vectors, total, health
+        finally:
+            await client.close()
+            await server.stop()
+
+    loop = _loop()
+    try:
+        chat, comp, vectors, total, health = loop.run_until_complete(go())
+    finally:
+        loop.close()
+
+    # stream fidelity, including token_id=0 ≠ absent
+    assert [c.text for c in chat] == ["hel", "lo", ""]
+    assert [c.token_id for c in chat] == [0, 42, None]
+    assert chat[-1].finish_reason == "stop"
+    assert chat[-1].usage == {"input_tokens": 3, "output_tokens": 2}
+    assert [c.text for c in comp] == ["ABC", ""]
+    assert comp[-1].finish_reason == "length"
+    assert vectors == [[0.5, -1.5], [0.5, -1.5]] and total == 7
+    assert health["status"] == "ok" and health["engines"] == 2
+
+    # what the remote worker SAW is a faithful ModelInfo reconstruction
+    seen = worker.seen_models[0]
+    assert seen.canonical_id == "local::tiny-llama" and seen.managed
+    assert seen.engine_options == {"model_config": "tiny-llama",
+                                   "max_seq_len": 128}
+    assert worker.seen_messages == messages
+    # Struct numbers normalize: integral floats arrive as ints (2.0 → 2)
+    assert worker.seen_params == {"temperature": 0, "max_tokens": 2}
+    assert worker.seen_prompt == "abc"
+
+
+def test_stream_chunk_wire_is_protobuf():
+    from cyberfabric_core_tpu.modkit.gen.llmworker.v1 import llm_worker_pb2 as pb
+    from cyberfabric_core_tpu.modules.llm_gateway.grpc_service import (
+        chunk_dict, chunk_from_dict)
+
+    codec = llm_worker_codecs()["ChatStream"]
+    chunk = ChatStreamChunk(request_id="r", text="tok", token_id=7,
+                            usage={"input_tokens": 1, "output_tokens": 2})
+    wire = codec.encode_response(chunk_dict(chunk))
+    msg = pb.StreamChunk.FromString(wire)
+    assert msg.text == "tok" and msg.token_id == 7 and msg.has_token_id
+    back = chunk_from_dict(codec.decode_response(wire))
+    assert back == chunk
+
+
+def test_worker_errors_surface_as_grpc_status():
+    """A worker-side failure aborts the stream with INTERNAL, not a hang."""
+    import grpc
+
+    from cyberfabric_core_tpu.modules.llm_gateway.grpc_service import (
+        GrpcLlmWorkerClient, register_llm_worker_service)
+
+    class _Boom(_FakeWorker):
+        async def chat_stream(self, model, messages, params):
+            raise RuntimeError("engine exploded")
+            yield  # pragma: no cover
+
+    server = JsonGrpcServer()
+    register_llm_worker_service(server, _Boom())
+
+    async def go():
+        port = await server.start("127.0.0.1:0")
+        client = GrpcLlmWorkerClient(endpoint=f"127.0.0.1:{port}")
+        try:
+            with pytest.raises(grpc.aio.AioRpcError) as e:
+                async for _ in client.chat_stream(
+                        ModelInfo(canonical_id="a::b", provider_slug="a",
+                                  provider_model_id="b"), [], {}):
+                    pass
+            return e.value.code()
+        finally:
+            await client.close()
+            await server.stop()
+
+    loop = _loop()
+    try:
+        code = loop.run_until_complete(go())
+    finally:
+        loop.close()
+    assert code == grpc.StatusCode.INTERNAL
+
+
+def test_remote_problem_errors_stay_typed():
+    """Review finding: a remote worker's typed 4xx must re-raise as the SAME
+    ProblemError on the caller — remote and in-process workers must be
+    indistinguishable on error paths too."""
+    from cyberfabric_core_tpu.modkit.errcat import ERR
+    from cyberfabric_core_tpu.modkit.errors import ProblemError
+    from cyberfabric_core_tpu.modules.llm_gateway.grpc_service import (
+        GrpcLlmWorkerClient, register_llm_worker_service)
+
+    class _TooLong(_FakeWorker):
+        async def chat_stream(self, model, messages, params):
+            raise ERR.llm.context_length_exceeded.error("prompt too long")
+            yield  # pragma: no cover
+
+    server = JsonGrpcServer()
+    register_llm_worker_service(server, _TooLong())
+
+    async def go():
+        port = await server.start("127.0.0.1:0")
+        client = GrpcLlmWorkerClient(endpoint=f"127.0.0.1:{port}")
+        try:
+            with pytest.raises(ProblemError) as e:
+                async for _ in client.chat_stream(
+                        ModelInfo(canonical_id="a::b", provider_slug="a",
+                                  provider_model_id="b"), [], {}):
+                    pass
+            return e.value.problem
+        finally:
+            await client.close()
+            await server.stop()
+
+    loop = _loop()
+    try:
+        problem = loop.run_until_complete(go())
+    finally:
+        loop.close()
+    assert problem.status == 422
+    assert problem.code == "context_length_exceeded"
+    assert problem.type.startswith("gts://gts.x.core.llm.err.")
+
+
+def test_tool_messages_cross_the_wire():
+    """Review finding: tool_calls / tool_result / image-detail parts are in
+    the REST schema's open world — they must survive the typed wire."""
+    from cyberfabric_core_tpu.modules.llm_gateway.grpc_service import (
+        GrpcLlmWorkerClient, register_llm_worker_service)
+
+    worker = _FakeWorker()
+    server = JsonGrpcServer()
+    register_llm_worker_service(server, worker)
+    messages = [
+        {"role": "assistant",
+         "content": [{"type": "text", "text": "calling"}],
+         "tool_calls": [{"id": "c1", "name": "lookup",
+                         "arguments": {"q": "tpu", "n": 3}}]},
+        {"role": "tool", "name": "lookup",
+         "content": [{"type": "tool_result", "tool_call_id": "c1",
+                      "result": {"rows": [1, 2]}}]},
+        {"role": "user",
+         "content": [{"type": "image", "url": "file://x.png",
+                      "detail": "high"}]},
+    ]
+
+    async def go():
+        port = await server.start("127.0.0.1:0")
+        client = GrpcLlmWorkerClient(endpoint=f"127.0.0.1:{port}")
+        try:
+            async for _ in client.chat_stream(
+                    ModelInfo(canonical_id="a::b", provider_slug="a",
+                              provider_model_id="b"), messages, {}):
+                pass
+        finally:
+            await client.close()
+            await server.stop()
+
+    loop = _loop()
+    try:
+        loop.run_until_complete(go())
+    finally:
+        loop.close()
+    assert worker.seen_messages == messages
