@@ -21,6 +21,7 @@ benchmark numbers — BASELINE.json.published = {}).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import signal
@@ -179,16 +180,38 @@ def single(model: str, quant: str) -> int:
     prompt_len = 128 if on_tpu else 16
     gen_tokens = 256 if on_tpu else 16
     chunk = int(os.environ.get("BENCH_DECODE_CHUNK", "0")) or (64 if on_tpu else 4)
-    spec = os.environ.get("BENCH_SPEC", "0") == "1"
+    # BENCH_SPEC: 0 (off) | 1/ngram (prompt-lookup) | draft (self-draft:
+    # the model drafts for itself — an honest UPPER BOUND on draft-model
+    # speculation, since a real small draft trades acceptance for cheaper
+    # proposal steps)
+    spec_mode = os.environ.get("BENCH_SPEC", "0")
+    spec = spec_mode not in ("0", "", "off")
+    speculative = ("draft" if spec_mode == "draft" and quant == "none"
+                   else "ngram" if spec else "off")  # quantized trees can't
+    #                                                  round-trip as draft ckpt
     cfg = EngineConfig(model=model, max_seq_len=max_seq, max_batch=1,
                        decode_chunk=chunk, quantization=quant,
-                       speculative="ngram" if spec else "off")
+                       speculative=speculative,
+                       draft_model=model if speculative == "draft" else "")
 
     try:
         t0 = time.monotonic()
         engine = InferenceEngine(cfg, seed=0)
         jax.block_until_ready(engine.params)
         log(f"{model}/{quant}: weights materialized in {time.monotonic()-t0:.1f}s")
+        ddir = None
+        if speculative == "draft":
+            # self-draft: persist the engine's own weights as the draft ckpt
+            # (removed in the epilogue below — an 8B bf16 tree is ~16GB and
+            # the autobench loop would otherwise fill /tmp)
+            import tempfile as _tf
+
+            from cyberfabric_core_tpu.runtime.weights import save_llama_params
+
+            ddir = _tf.mkdtemp(prefix="bench-draft-")
+            save_llama_params(engine.params, engine.model_config, ddir)
+            engine.config = dataclasses.replace(engine.config,
+                                                draft_checkpoint=ddir)
 
         rng = np.random.default_rng(0)
         prompt = rng.integers(3, engine.model_config.vocab_size, prompt_len).tolist()
@@ -233,8 +256,14 @@ def single(model: str, quant: str) -> int:
                           "detail": msg[:300]}), flush=True)
         return 7 if kind == "oom" else 1
 
+    if ddir is not None:
+        import shutil as _sh
+
+        _sh.rmtree(ddir, ignore_errors=True)
     precision = f"{quant}-weights" if quant in ("int8", "int4") else "bf16"
-    spec_label = ", ngram-speculative" if spec else ""
+    spec_label = ("" if not spec else
+                  ", self-draft-speculative (upper bound)"
+                  if speculative == "draft" else ", ngram-speculative")
     result = {
         "metric": f"{model} greedy decode tokens/sec/chip "
                   f"({'TPU v5e-1' if on_tpu else 'cpu'}, {precision}, bs=1, "
@@ -409,6 +438,16 @@ def main() -> int:
             record_history("speculative", out)
             log(f"speculative variant: {out['value']} tok/s "
                 f"(vs headline {result['value']})")
+        # draft-model variant (self-draft = honest upper bound; bf16 only —
+        # quantized trees can't round-trip as a draft checkpoint)
+        if quant == "none" and hard_deadline - time.monotonic() > 300:
+            out = run_attempt(model, quant,
+                              min(420.0, hard_deadline - time.monotonic() - 70),
+                              env=dict(os.environ, BENCH_SPEC="draft"))
+            if out and "error" not in out and out.get("tpu"):
+                record_history("speculative_draft", out)
+                log(f"draft-speculative variant: {out['value']} tok/s "
+                    f"(vs headline {result['value']})")
     return 0
 
 

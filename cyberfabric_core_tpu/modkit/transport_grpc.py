@@ -157,8 +157,18 @@ class JsonGrpcServer:
         self._services: dict[str, dict[str, Handler]] = {}
         self._streams: dict[str, dict[str, StreamHandler]] = {}
         self._codecs: dict[str, dict[str, ProtoCodec]] = {}
+        self._auth_tokens: dict[str, str] = {}
         self._server: Optional[grpc_aio.Server] = None
         self.bound_port: Optional[int] = None
+
+    async def _check_auth(self, service_name: str, context) -> None:
+        want = self._auth_tokens.get(service_name)
+        if want is None:
+            return
+        meta = dict(context.invocation_metadata() or ())
+        if meta.get("authorization") != f"Bearer {want}":
+            await context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                                f"{service_name} requires a bearer token")
 
     def service_names(self) -> list[str]:
         """Every service registered on this server (unary or streaming) —
@@ -167,7 +177,11 @@ class JsonGrpcServer:
 
     def add_service(self, service_name: str, methods: dict[str, Handler],
                     codecs: Optional[dict[str, "ProtoCodec"]] = None,
-                    streams: Optional[dict[str, "StreamHandler"]] = None) -> None:
+                    streams: Optional[dict[str, "StreamHandler"]] = None,
+                    auth_token: Optional[str] = None) -> None:
+        """``auth_token``: require `authorization: Bearer <token>` metadata on
+        every call to this service (UNAUTHENTICATED otherwise) — the minimum
+        bar for exposing an inference plane beyond loopback."""
         self._services.setdefault(service_name, {}).update(methods)
         if streams:
             # server-streaming methods: handler is an async generator of
@@ -175,6 +189,8 @@ class JsonGrpcServer:
             self._streams.setdefault(service_name, {}).update(streams)
         if codecs:
             self._codecs.setdefault(service_name, {}).update(codecs)
+        if auth_token:
+            self._auth_tokens[service_name] = auth_token
 
     def _build(self) -> grpc_aio.Server:
         server = grpc_aio.server()
@@ -190,11 +206,14 @@ class JsonGrpcServer:
                     from .errors import ProblemError
 
                     try:
+                        await self._check_auth(_sn, context)
                         req = (_codec.decode_request(request) if _codec
                                else _de(request))
                         out = await _fn(req)
                         return (_codec.encode_response(out) if _codec
                                 else _ser(out))
+                    except grpc_aio.AbortError:
+                        raise  # auth (or nested) abort already terminated us
                     except ProblemError as e:
                         await _abort_problem(context, e)
                     except KeyError as e:
@@ -219,11 +238,14 @@ class JsonGrpcServer:
                     from .errors import ProblemError
 
                     try:
+                        await self._check_auth(_sn, context)
                         req = (_codec.decode_request(request) if _codec
                                else _de(request))
                         async for chunk in _gen(req):
                             yield (_codec.encode_response(chunk) if _codec
                                    else _ser(chunk))
+                    except grpc_aio.AbortError:
+                        raise
                     except ProblemError as e:
                         await _abort_problem(context, e)
                     except KeyError as e:
@@ -284,10 +306,14 @@ class JsonGrpcClient:
 
     _RETRYABLE = {grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED}
 
-    def __init__(self, target: str, config: Optional[GrpcClientConfig] = None) -> None:
+    def __init__(self, target: str, config: Optional[GrpcClientConfig] = None,
+                 auth_token: Optional[str] = None) -> None:
         self.target = target
         self.config = config or GrpcClientConfig()
         self._channel: Optional[grpc_aio.Channel] = None
+        #: sent as `authorization: Bearer <token>` metadata on every call
+        self._metadata = ((("authorization", f"Bearer {auth_token}"),)
+                          if auth_token else None)
 
     async def _ensure_channel(self) -> grpc_aio.Channel:
         if self._channel is None:
@@ -307,7 +333,8 @@ class JsonGrpcClient:
         last: Optional[grpc_aio.AioRpcError] = None
         for attempt in range(self.config.max_retries + 1):
             try:
-                resp = await rpc(wire, timeout=self.config.call_timeout_s)
+                resp = await rpc(wire, timeout=self.config.call_timeout_s,
+                                 metadata=self._metadata)
                 return codec.decode_response(resp) if codec else _de(resp)
             except grpc_aio.AioRpcError as e:
                 raise_remote_problem(e)  # typed server Problems re-raise as-is
@@ -334,7 +361,8 @@ class JsonGrpcClient:
         async def gen():
             try:
                 async for resp in rpc(wire,
-                                      timeout=self.config.stream_timeout_s):
+                                      timeout=self.config.stream_timeout_s,
+                                      metadata=self._metadata):
                     yield codec.decode_response(resp) if codec else _de(resp)
             except grpc_aio.AioRpcError as e:
                 raise_remote_problem(e)

@@ -115,26 +115,40 @@ def chunk_from_dict(d: dict) -> ChatStreamChunk:
 
 # ---------------------------------------------------------------- server
 
-def register_llm_worker_service(server: Any, worker: LlmWorkerApi) -> None:
+def register_llm_worker_service(server: Any, worker: LlmWorkerApi,
+                                auth_token: Optional[str] = None) -> None:
     """Expose ``worker`` as llmworker.v1.LlmWorkerService on a JsonGrpcServer
-    with the typed codecs — ChatStream/Completion are server-streaming."""
+    with the typed codecs — ChatStream/Completion are server-streaming.
+
+    TRUST BOUNDARY: this is the intra-cluster worker plane, not a user
+    surface — tenant auth/budgets are enforced by the CONSUMING gateway's
+    REST stack before any call lands here. Pass ``auth_token`` whenever the
+    grpc hub binds beyond loopback so arbitrary peers cannot run unmetered
+    inference."""
+
+    def _model(req: dict) -> ModelInfo:
+        if "model" not in req or not req["model"].get("canonical_id"):
+            # ValueError → INVALID_ARGUMENT (a malformed request must not
+            # read as NOT_FOUND routing noise — review finding)
+            raise ValueError("request requires model.canonical_id")
+        return model_from_ref(req["model"])
 
     async def chat_stream(req: dict) -> AsyncIterator[dict]:
-        model = model_from_ref(req["model"])
+        model = _model(req)
         async for chunk in worker.chat_stream(
                 model, _normalize_messages(req.get("messages", [])),
                 _destruct(dict(req.get("params") or {}))):
             yield chunk_dict(chunk)
 
     async def completion(req: dict) -> AsyncIterator[dict]:
-        model = model_from_ref(req["model"])
+        model = _model(req)
         async for chunk in worker.completion_stream(
                 model, req.get("prompt", ""),
                 _destruct(dict(req.get("params") or {}))):
             yield chunk_dict(chunk)
 
     async def embed(req: dict) -> dict:
-        model = model_from_ref(req["model"])
+        model = _model(req)
         vectors, total = await worker.embed(model, list(req.get("inputs", [])),
                                             _destruct(dict(req.get("params") or {})))
         return {"embeddings": [{"values": [float(x) for x in v]}
@@ -150,6 +164,7 @@ def register_llm_worker_service(server: Any, worker: LlmWorkerApi) -> None:
         {"Embed": embed, "Health": health},
         streams={"ChatStream": chat_stream, "Completion": completion},
         codecs=llm_worker_codecs(),
+        auth_token=auth_token,
     )
 
 
@@ -162,11 +177,13 @@ class GrpcLlmWorkerClient(LlmWorkerApi):
     tell a remote worker from the in-process one."""
 
     def __init__(self, directory: Optional[DirectoryService] = None,
-                 endpoint: Optional[str] = None) -> None:
+                 endpoint: Optional[str] = None,
+                 auth_token: Optional[str] = None) -> None:
         if directory is None and endpoint is None:
             raise ValueError("need a directory or an explicit endpoint")
         self._directory = directory
         self._endpoint = endpoint
+        self._auth_token = auth_token
         self._client: Optional[JsonGrpcClient] = None
         self._codecs = llm_worker_codecs()
 
@@ -179,7 +196,8 @@ class GrpcLlmWorkerClient(LlmWorkerApi):
                     raise ConnectionError(
                         f"no live instance of {LLM_WORKER_SERVICE}")
                 endpoint = inst.endpoint
-            self._client = JsonGrpcClient(endpoint)
+            self._client = JsonGrpcClient(endpoint,
+                                          auth_token=self._auth_token)
         return self._client
 
     @staticmethod

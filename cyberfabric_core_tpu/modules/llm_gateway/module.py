@@ -183,7 +183,14 @@ class JobStore:
         if job is None and self._db is not None:
             row = self._db.secure(ctx, JOBS).get(job_id)
             if row is not None:
-                job = self.jobs[job_id] = row
+                now = datetime.datetime.now(datetime.timezone.utc).isoformat()
+                if row.get("expires_at", "") < now and \
+                        row["status"] not in ("pending", "running"):
+                    # expiry holds on reads too: the sweep is best-effort,
+                    # the contract is not (review finding)
+                    self._db.secure(ctx, JOBS).delete(job_id)
+                else:
+                    job = self.jobs[job_id] = row
         if job is None or job["tenant_id"] != ctx.tenant_id:
             raise ERR.llm.job_not_found.error(f"job {job_id} not found")
         return job
@@ -228,7 +235,9 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
                 # (proto/llmworker/v1/llm_worker.proto)
                 from .grpc_service import GrpcLlmWorkerClient
 
-                self.worker = GrpcLlmWorkerClient(endpoint=remote)
+                self.worker = GrpcLlmWorkerClient(
+                    endpoint=remote,
+                    auth_token=(cfg.get("worker_service") or {}).get("token"))
             else:
                 self.worker = LocalTpuWorker(cfg.get("worker", {}))
             ctx.client_hub.register(LlmWorkerApi, self.worker)
@@ -237,6 +246,11 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         self.total_timeout_s = float(cfg.get("total_timeout_s", 600.0))
         self._video_poll_interval_s = float(cfg.get("video_poll_interval_s", 2.0))
         self._video_poll_timeout_s = float(cfg.get("video_poll_timeout_s", 120.0))
+        #: worker-plane exposure policy (review finding: an inference plane
+        #: must be opt-in and tokened — see grpc_service trust boundary)
+        ws = cfg.get("worker_service") or {}
+        self._worker_service_expose = bool(ws.get("expose", False))
+        self._worker_service_token = ws.get("token")
         self._hub = ctx.client_hub  # external adapter resolves lazily (oagw may
         #                             init after this module — no dep ordering)
 
@@ -248,9 +262,10 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         at each other recurse (review finding)."""
         from .grpc_service import GrpcLlmWorkerClient, register_llm_worker_service
 
-        if self.worker is not None and \
+        if self._worker_service_expose and self.worker is not None and \
                 not isinstance(self.worker, GrpcLlmWorkerClient):
-            register_llm_worker_service(server, self.worker)
+            register_llm_worker_service(server, self.worker,
+                                        auth_token=self._worker_service_token)
 
     async def start(self, ctx: ModuleCtx, ready: ReadySignal) -> None:
         try:
@@ -735,7 +750,10 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             self._persist_batch(ctx, batch)
             sem = asyncio.Semaphore(8)
 
+            finished = 0
+
             async def one(item: dict) -> None:
+                nonlocal finished
                 if item.get("result") is not None or item.get("error"):
                     return  # finished before the restart — keep it
                 async with sem:
@@ -747,9 +765,12 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
                         item["error"] = e.problem.to_dict()
                     except Exception as e:  # noqa: BLE001
                         item["error"] = {"detail": str(e)[:500]}
-                    # per-item durability: a crash mid-batch loses at most
-                    # the in-flight items, never completed results
-                    self._persist_batch(ctx, batch)
+                    # durability checkpoint every few items (full-array
+                    # rewrite per item would be O(n^2) sqlite work — review
+                    # finding); a crash loses at most the last window
+                    finished += 1
+                    if finished % 8 == 0:
+                        self._persist_batch(ctx, batch)
 
             await asyncio.gather(*(one(it) for it in batch["requests"]))
             failed = sum(1 for it in batch["requests"] if it["error"])

@@ -267,6 +267,8 @@ class LocalTpuWorker(LlmWorkerApi):
             prefix_page_size=page_size,
             speculative=opts.pop("speculative", "off"),
             spec_k=int(opts.pop("spec_k", 8)),
+            draft_model=opts.pop("draft_model", ""),
+            draft_checkpoint=opts.pop("draft_checkpoint", ""),
         )
         params = None
         tokenizer: Tokenizer

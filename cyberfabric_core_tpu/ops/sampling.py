@@ -11,6 +11,34 @@ import jax
 import jax.numpy as jnp
 
 
+def _warp_sorted(
+    logits: jnp.ndarray,       # [B, V] f32
+    temperature: jnp.ndarray,  # [B] f32 (>0 rows only meaningful)
+    top_p: jnp.ndarray,        # [B] f32; 1 → disabled
+    top_k: jnp.ndarray,        # [B] int32; 0 → disabled
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """THE temperature/top-p/top-k warp, in sorted order: returns
+    (masked_sorted_logits, sorted_idx). Single source of truth shared by
+    sample_token (draws) and warped_probs (explicit distributions) — the
+    speculative acceptance-sampling exactness guarantee depends on both
+    using bit-identical semantics."""
+    B, V = logits.shape
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+    sorted_idx = jnp.argsort(-scaled, axis=-1)               # desc, one sort
+    sorted_logits = jnp.take_along_axis(scaled, sorted_idx, axis=-1)
+    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    cumsum = jnp.cumsum(probs_sorted, axis=-1)
+    # top-p: keep the smallest prefix with cumulative mass >= top_p
+    # (shift so the first token crossing the threshold is kept)
+    keep_p = (cumsum - probs_sorted) < top_p[:, None]
+    # top-k: keep the first k sorted entries (k==0 → all)
+    rank = jnp.arange(V, dtype=jnp.int32)[None, :]
+    keep_k = jnp.where(top_k[:, None] > 0, rank < top_k[:, None], True)
+    keep = (keep_p & keep_k).at[:, 0].set(True)  # never mask every token
+    return jnp.where(keep, sorted_logits, -jnp.inf), sorted_idx
+
+
 def sample_token(
     logits: jnp.ndarray,       # [B, V] f32
     key: jax.Array,
@@ -23,7 +51,6 @@ def sample_token(
     All-greedy batches take a sort-free fast path via lax.cond — the full-vocab
     argsort is ~ms-scale at V=128k and would otherwise run every decode step.
     """
-    B, V = logits.shape
 
     def greedy_branch(operands):
         logits, *_ = operands
@@ -32,24 +59,8 @@ def sample_token(
     def sample_branch(operands):
         logits, key, temperature, top_p, top_k = operands
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        safe_t = jnp.where(temperature > 0, temperature, 1.0)
-        scaled = logits / safe_t[:, None]
-
-        sorted_idx = jnp.argsort(-scaled, axis=-1)               # desc, one sort
-        sorted_logits = jnp.take_along_axis(scaled, sorted_idx, axis=-1)
-        probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
-        cumsum = jnp.cumsum(probs_sorted, axis=-1)
-
-        # top-p: keep the smallest prefix with cumulative mass >= top_p
-        # (shift so the first token crossing the threshold is kept)
-        keep_p = (cumsum - probs_sorted) < top_p[:, None]
-        # top-k: keep the first k sorted entries (k==0 → all)
-        rank = jnp.arange(V, dtype=jnp.int32)[None, :]
-        keep_k = jnp.where(top_k[:, None] > 0, rank < top_k[:, None], True)
-        keep = keep_p & keep_k
-        keep = keep.at[:, 0].set(True)  # never mask every token
-
-        masked_sorted = jnp.where(keep, sorted_logits, -jnp.inf)
+        masked_sorted, sorted_idx = _warp_sorted(logits, temperature,
+                                                 top_p, top_k)
         choice_in_sorted = jax.random.categorical(key, masked_sorted, axis=-1)
         sampled = jnp.take_along_axis(sorted_idx, choice_in_sorted[:, None], axis=1)[:, 0]
         return jnp.where(temperature > 0, sampled.astype(jnp.int32), greedy)
@@ -95,3 +106,24 @@ def split_keys_per_slot(keys: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """[B, 2] keys → (advanced keys [B, 2], subkeys [B, 2]), vmapped split."""
     both = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
     return both[:, 0], both[:, 1]
+
+
+def warped_probs(
+    logits: jnp.ndarray,       # [B, V] f32
+    temperature: jnp.ndarray,  # [B] f32; 0 → delta on the argmax
+    top_p: jnp.ndarray,        # [B] f32; 1 → disabled
+    top_k: jnp.ndarray,        # [B] int32; 0 → disabled
+) -> jnp.ndarray:
+    """The sampling distribution as explicit probabilities [B, V] — the same
+    temperature/top-p/top-k warp sample_token draws from, needed in closed
+    form by speculative acceptance sampling (p_target/p_draft ratios and the
+    (p_t - p_d)+ residual both require full rows, not draws). temperature=0
+    renders the greedy delta distribution."""
+    B, V = logits.shape
+    greedy = jax.nn.one_hot(jnp.argmax(logits, axis=-1), V, dtype=jnp.float32)
+    masked_sorted, sorted_idx = _warp_sorted(logits, temperature, top_p, top_k)
+    probs_sorted = jax.nn.softmax(masked_sorted, axis=-1)
+    # unsort back to vocab order
+    inv = jnp.argsort(sorted_idx, axis=-1)
+    warped = jnp.take_along_axis(probs_sorted, inv, axis=-1)
+    return jnp.where((temperature > 0)[:, None], warped, greedy)

@@ -72,12 +72,19 @@ class EngineConfig:
     #: int8 the accuracy default — see runtime/quant.py)
     quantization: str = "none"
     #: speculative decoding: "off" | "ngram" (prompt-lookup drafting + one
-    #: fused [1, k+1] verify forward; greedy bs=1 only, lossless — see
-    #: runtime/speculative.py). Non-eligible requests fall back silently.
+    #: fused [1, k+1] verify forward; greedy bs=1 only, lossless) | "draft"
+    #: (a small draft MODEL proposes k tokens; fused verify with Leviathan
+    #: acceptance sampling — distribution-preserving at any temperature,
+    #: bit-lossless at temperature 0 — see runtime/speculative.py).
+    #: Non-eligible requests fall back silently.
     speculative: str = "off"
     spec_k: int = 8
     spec_max_ngram: int = 3
     spec_min_ngram: int = 1
+    #: draft mode: config name of the proposer model (must share the target's
+    #: vocab/tokenizer) + optional checkpoint dir for its weights
+    draft_model: str = ""
+    draft_checkpoint: str = ""
 
     def resolve_use_flash(self) -> bool:
         if self.use_flash is not None:
@@ -207,6 +214,8 @@ class InferenceEngine:
         self._decode_fn = self._build_decode(max(1, config.decode_chunk))
         self._decode_tail_fn: Optional[Callable] = None  # k=1, built on demand
         self._verify_fn: Optional[Callable] = None  # spec decode, on demand
+        self._verify_accept_fn: Optional[Callable] = None  # draft mode
+        self._draft = None  # DraftModel, built on first draft-mode request
         #: cumulative speculative-decoding counters (observability surface)
         self.spec_stats = {"verify_calls": 0, "drafted": 0, "accepted": 0,
                            "spec_tokens": 0, "fallback_steps": 0}
@@ -326,6 +335,37 @@ class InferenceEngine:
                 total_ms=timing["total_ms"],
             )
         return [results[i] for i in range(len(prompts))]
+
+    def _ensure_draft(self, spec_k: int):
+        """Build the draft model once per engine: weights from
+        ``draft_checkpoint`` when given (the real deployment shape — e.g. a
+        1B drafting for an 8B), else seeded synthetic (mechanics-only: a
+        random draft accepts ~never but stays lossless)."""
+        if self._draft is None:
+            from pathlib import Path
+
+            from ..models.configs import get_config
+            from .speculative import DraftModel
+
+            dcfg = get_config(self.config.draft_model)
+            if dcfg.vocab_size != self.model_config.vocab_size:
+                raise ValueError(
+                    f"draft model {self.config.draft_model!r} vocab "
+                    f"{dcfg.vocab_size} != target vocab "
+                    f"{self.model_config.vocab_size} — speculation needs a "
+                    "shared tokenizer")
+            ckpt = self.config.draft_checkpoint
+            if ckpt and Path(ckpt).exists():
+                from .weights import load_llama_params
+
+                dparams = load_llama_params(ckpt, dcfg, dtype=self.dtype)
+            else:
+                dparams = llama.init_params(dcfg, jax.random.PRNGKey(7),
+                                            self.dtype)
+            self._draft = DraftModel(dcfg, dparams,
+                                     max_seq=self.config.max_seq_len,
+                                     dtype=self.dtype, k=spec_k)
+        return self._draft
 
     def generate_stream(
         self,
@@ -493,7 +533,84 @@ class InferenceEngine:
                     yield StepEvent(0, tok, fin)
             lengths_np[0] = L  # keep the shared epilogue's view consistent
 
-        if (self.config.speculative == "ngram" and B == 1
+        def draft_spec_loop():
+            """Draft-MODEL speculation (bs=1, any temperature): the small
+            draft proposes k sampled tokens, the target runs ONE fused
+            verify + acceptance-sampling pass (runtime/speculative.py) —
+            distribution-preserving always, bit-lossless at temperature 0.
+            Each round commits 1..k+1 target tokens for one big forward."""
+            nonlocal cache
+            spec_k = max(1, self.config.spec_k)
+            draft = self._ensure_draft(spec_k)
+            if self._verify_accept_fn is None:
+                from .speculative import build_verify_accept_fn
+
+                self._verify_accept_fn = build_verify_accept_fn(
+                    self.model_config, spec_k, self.rope_tables)
+            self._rng, dk = jax.random.split(self._rng)
+            draft.reset(list(prompts[0]), dk)
+            last_tok = int(cur[0])
+            L = int(lengths_np[0])
+            max_seq = self.config.max_seq_len
+
+            while not done[0] and emitted[0] < max_new[0] and L < max_seq:
+                window_ok = (L + spec_k + 1 <= max_seq
+                             and draft.len + spec_k + 1 <= draft.max_seq)
+                if not window_ok:
+                    if self._decode_tail_fn is None:
+                        self._decode_tail_fn = self._build_decode(1)
+                    self.spec_stats["fallback_steps"] += 1
+                    chunk_dev, kc, vc, _, self._rng = self._decode_tail_fn(
+                        self.params, cache[0], cache[1],
+                        jnp.asarray([last_tok], jnp.int32),
+                        jnp.asarray([L], jnp.int32),
+                        self._rng, temperature, top_p, top_k)
+                    cache = (kc, vc)
+                    toks = [int(np.asarray(chunk_dev)[0, 0])]
+                    L += 1
+                else:
+                    drafts, dists = draft.propose(last_tok, temperature,
+                                                  top_p, top_k)
+                    tokens = jnp.asarray([[last_tok] + drafts], jnp.int32)
+                    a_dev, nxt_dev, self._rng, kc, vc = self._verify_accept_fn(
+                        self.params, cache[0], cache[1], tokens,
+                        jnp.asarray([L], jnp.int32), jnp.stack(dists),
+                        self._rng, temperature[:1], top_p[:1], top_k[:1])
+                    cache = (kc, vc)
+                    a = int(a_dev)
+                    nxt = int(nxt_dev)
+                    toks = drafts[:a] + [nxt]
+                    # draft cache bookkeeping: drafting already wrote KV for
+                    # (last_tok, d1..d_{k-1}). The bonus/resampled token stays
+                    # PENDING (same convention as the target — its KV lands
+                    # when next round consumes it); on full acceptance d_k
+                    # still needs consuming first.
+                    if a < spec_k:
+                        draft.len += a + 1
+                    else:
+                        draft.len += spec_k
+                        draft.consume([drafts[-1]], temperature, top_p, top_k)
+                    self.spec_stats["verify_calls"] += 1
+                    self.spec_stats["drafted"] += spec_k
+                    self.spec_stats["accepted"] += a
+                    self.spec_stats["spec_tokens"] += len(toks)
+                    L += a + 1
+                for j, tok in enumerate(toks):
+                    if done[0]:
+                        break
+                    emitted[0] += 1
+                    last_tok = tok
+                    fin = classify(0, tok)
+                    if fin is None and j == len(toks) - 1 and L >= max_seq:
+                        fin = "length"
+                    done[0] = fin is not None
+                    yield StepEvent(0, tok, fin)
+            lengths_np[0] = L
+
+        if (self.config.speculative == "draft" and B == 1
+                and self.config.draft_model and not all(done)):
+            yield from draft_spec_loop()
+        elif (self.config.speculative == "ngram" and B == 1
                 and all(s.temperature == 0.0 for s in per_req)
                 and not all(done)):
             yield from spec_loop()

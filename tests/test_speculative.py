@@ -159,3 +159,104 @@ def test_int8_spec_parity(shared_params):
     spec = _tokens(_engine(shared_params, "ngram", quantization="int8"),
                    [5, 6, 7, 5, 6, 7, 5], max_tokens=32)
     assert spec == base
+
+
+# ------------------------------------------------------------- draft model
+
+
+@pytest.fixture(scope="module")
+def draft_ckpt(shared_params, tmp_path_factory):
+    """The target's own weights saved as a checkpoint — a perfect draft
+    (acceptance 100%), isolating the speculation MECHANICS from draft
+    quality."""
+    from cyberfabric_core_tpu.runtime.weights import save_llama_params
+
+    cfg, params = shared_params
+    out = tmp_path_factory.mktemp("draft")
+    save_llama_params(params, cfg, out)
+    return str(out)
+
+
+def _draft_engine(shared, ckpt, **kw):
+    return _engine(shared, "draft", draft_model="tiny-llama",
+                   draft_checkpoint=ckpt, **kw)
+
+
+@pytest.mark.parametrize("prompt", [
+    list(range(40, 72)),                      # NON-repetitive: ngram gets ~1.0
+    [5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6],
+])
+def test_draft_greedy_parity(shared_params, draft_ckpt, prompt):
+    """Draft-model speculation is bit-lossless at temperature 0 — identical
+    tokens and finish reason as plain decode (round-3 verdict item 8)."""
+    base_toks, base_fin = _tokens(_engine(shared_params, "off"), prompt,
+                                  max_tokens=48)
+    spec = _draft_engine(shared_params, draft_ckpt)
+    spec_toks, spec_fin = _tokens(spec, prompt, max_tokens=48)
+    assert spec_toks == base_toks
+    assert spec_fin == base_fin
+    assert spec.spec_stats["verify_calls"] > 0
+
+
+def test_draft_beats_ngram_on_nonrepetitive_text(shared_params, draft_ckpt):
+    """THE point of draft mode: on a non-repetitive prompt prompt-lookup has
+    nothing to copy (~1.0 tokens/step) while a draft model speculates
+    everywhere (here: perfect draft → ~k+1 tokens per verify)."""
+    prompt = list(range(40, 72))  # no recurring n-gram
+
+    ngram = _engine(shared_params, "ngram")
+    _tokens(ngram, prompt, max_tokens=32)
+    n_calls = ngram.spec_stats["verify_calls"] + \
+        ngram.spec_stats["fallback_steps"]
+    ngram_rate = 32 / max(1, n_calls)
+
+    draft = _draft_engine(shared_params, draft_ckpt)
+    _tokens(draft, prompt, max_tokens=32)
+    d_calls = draft.spec_stats["verify_calls"] + \
+        draft.spec_stats["fallback_steps"]
+    draft_rate = draft.spec_stats["spec_tokens"] / max(1, d_calls)
+
+    assert draft_rate > 1.5, (draft_rate, draft.spec_stats)
+    assert draft_rate > ngram_rate, (draft_rate, ngram_rate)
+
+
+def test_draft_sampled_reproducible_and_distribution_shaped(
+        shared_params, draft_ckpt):
+    """temperature > 0 runs Leviathan acceptance sampling: a fixed seed
+    reproduces the exact token stream, and the machinery commits >1 token
+    per round with a perfect draft."""
+    prompt = list(range(10, 30))
+
+    def run(seed):
+        eng = _draft_engine(shared_params, draft_ckpt)
+        [res] = eng.generate([prompt], SamplingParams(
+            temperature=0.8, top_p=0.95, seed=seed, max_tokens=24))
+        return res.token_ids, eng.spec_stats
+
+    toks1, stats1 = run(123)
+    toks2, _ = run(123)
+    toks3, _ = run(321)
+    assert toks1 == toks2                       # seeded determinism
+    assert len(toks1) > 0
+    assert toks1 != toks3 or len(set(toks1)) == 1  # seeds matter
+    assert stats1["accepted"] > 0               # sampling accepts drafts too
+
+
+def test_draft_vocab_mismatch_fails_loudly(shared_params):
+    eng = _engine(shared_params, "draft", draft_model="tiny-bert",
+                  draft_checkpoint="")
+    with pytest.raises(ValueError, match="vocab"):
+        _tokens(eng, [1, 2, 3], max_tokens=4)
+
+
+def test_random_draft_stays_lossless(shared_params):
+    """No checkpoint → synthetic draft weights that share nothing with the
+    target: acceptance ~0, throughput ~plain decode, but output parity must
+    STILL hold (the acceptance rule protects correctness, not speed)."""
+    prompt = list(range(60, 80))
+    base_toks, base_fin = _tokens(_engine(shared_params, "off"), prompt,
+                                  max_tokens=24)
+    spec = _engine(shared_params, "draft", draft_model="tiny-llama",
+                   draft_checkpoint="")
+    spec_toks, spec_fin = _tokens(spec, prompt, max_tokens=24)
+    assert spec_toks == base_toks and spec_fin == base_fin

@@ -336,3 +336,40 @@ def test_tool_messages_cross_the_wire():
     finally:
         loop.close()
     assert worker.seen_messages == messages
+
+
+def test_worker_service_bearer_auth():
+    """Review finding: an exposed inference plane must be tokened — calls
+    without the bearer token get UNAUTHENTICATED; with it they serve."""
+    import grpc
+
+    from cyberfabric_core_tpu.modules.llm_gateway.grpc_service import (
+        GrpcLlmWorkerClient, register_llm_worker_service)
+
+    worker = _FakeWorker()
+    server = JsonGrpcServer()
+    register_llm_worker_service(server, worker, auth_token="s3cret")
+
+    async def go():
+        port = await server.start("127.0.0.1:0")
+        bad = GrpcLlmWorkerClient(endpoint=f"127.0.0.1:{port}")
+        good = GrpcLlmWorkerClient(endpoint=f"127.0.0.1:{port}",
+                                   auth_token="s3cret")
+        try:
+            with pytest.raises(grpc.aio.AioRpcError) as e:
+                await bad.health()
+            code = e.value.code()
+            h = await good.health()
+            return code, h
+        finally:
+            await bad.close()
+            await good.close()
+            await server.stop()
+
+    loop = _loop()
+    try:
+        code, h = loop.run_until_complete(go())
+    finally:
+        loop.close()
+    assert code == grpc.StatusCode.UNAUTHENTICATED
+    assert h["status"] == "ok"
