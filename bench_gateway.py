@@ -214,5 +214,231 @@ def main() -> int:
     return 0 if summary["pass"] else 1
 
 
+
+
+# ---------------------------------------------------------------- scaling
+
+async def _boot_echo_stack(bind_addr: str, secret: str, reuse_port: bool):
+    """The same JWT echo-gateway stack run_bench boots, parameterized for the
+    multi-worker mode (fixed port + SO_REUSEPORT)."""
+    from cyberfabric_core_tpu.gateway.module import ApiGatewayModule
+    from cyberfabric_core_tpu.modkit import (AppConfig, ClientHub, Module,
+                                             ModuleRegistry, RestApiCapability,
+                                             RunOptions, module)
+    from cyberfabric_core_tpu.modkit.registry import Registration, _REGISTRATIONS
+    from cyberfabric_core_tpu.modkit.runtime import HostRuntime
+    from cyberfabric_core_tpu.modules.resolvers import AuthnResolverModule
+
+    _REGISTRATIONS.clear()
+
+    @module(name="echo", capabilities=["rest"])
+    class EchoModule(Module, RestApiCapability):
+        async def init(self, ctx):
+            pass
+
+        def register_rest(self, ctx, router, openapi):
+            async def echo(request):
+                return {"ok": True}
+
+            router.operation("POST", "/v1/echo", module="echo") \
+                .auth_required("bench.run") \
+                .rate_limit(rps=1e6, burst=100000, max_in_flight=4096) \
+                .handler(echo).register()
+
+    regs = [
+        Registration("api_gateway", ApiGatewayModule, (),
+                     ("rest_host", "stateful", "system")),
+        Registration("authn_resolver", AuthnResolverModule, (), ("system",)),
+    ]
+    cfg = AppConfig.load_or_default(environ={}, cli_overrides={"modules": {
+        "api_gateway": {"config": {"bind_addr": bind_addr,
+                                   "reuse_port": reuse_port}},
+        "authn_resolver": {"config": {
+            "mode": "jwt",
+            "keys": {"bench-key": {"alg": "HS256", "secret": secret}},
+            "issuer": "https://bench.test", "audience": "tpu-fabric",
+        }},
+        "echo": {},
+    }})
+    registry = ModuleRegistry.discover_and_build(extra=regs)
+    rt = HostRuntime(RunOptions(config=cfg, registry=registry,
+                                client_hub=ClientHub()))
+    await rt.run_setup_phases()
+    return rt, registry.get("api_gateway").instance.bound_port
+
+
+def worker_main(port: int, secret: str) -> int:
+    """One SO_REUSEPORT gateway worker process; serves until SIGTERM."""
+    import signal as _signal
+
+    async def serve():
+        rt, bound = await _boot_echo_stack(f"127.0.0.1:{port}", secret, True)
+        print(f"READY {bound}", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        loop.add_signal_handler(_signal.SIGTERM, stop.set)
+        loop.add_signal_handler(_signal.SIGINT, stop.set)
+        await stop.wait()
+        rt.root_token.cancel()
+        await rt.run_stop_phase()
+
+    asyncio.run(serve())
+    return 0
+
+
+def client_main(url: str, token: str, duration_s: float,
+                concurrency: int) -> int:
+    """One load-generator process: closed-loop hammering for duration_s;
+    prints one JSON line {rps, p50_ms, p99_ms, errors}."""
+    import aiohttp
+
+    async def run():
+        headers = {"Authorization": f"Bearer {token}",
+                   "Content-Type": "application/json"}
+        payload = {"messages": [{"role": "user", "content": "x" * 256}]}
+        lat: list[float] = []
+        errors = 0
+        deadline = time.perf_counter() + duration_s
+        conn = aiohttp.TCPConnector(limit=concurrency + 16)
+        async with aiohttp.ClientSession(connector=conn) as s:
+            # warmup connections
+            await asyncio.gather(*[
+                s.post(url, json=payload, headers=headers)
+                for _ in range(min(16, concurrency))])
+
+            async def loop_one():
+                nonlocal errors
+                while time.perf_counter() < deadline:
+                    t0 = time.perf_counter()
+                    try:
+                        async with s.post(url, json=payload,
+                                          headers=headers) as r:
+                            await r.read()
+                            if r.status != 200:
+                                errors += 1
+                                continue
+                    except Exception:  # noqa: BLE001
+                        errors += 1
+                        continue
+                    lat.append((time.perf_counter() - t0) * 1000.0)
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*[loop_one() for _ in range(concurrency)])
+            wall = time.perf_counter() - t0
+        lat.sort()
+
+        def pct(p):
+            return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else 0.0
+
+        print(json.dumps({
+            "rps": round(len(lat) / wall, 1), "n": len(lat),
+            "p50_ms": round(pct(0.5), 2), "p99_ms": round(pct(0.99), 2),
+            "errors": errors}), flush=True)
+
+    asyncio.run(run())
+    return 0
+
+
+def scale_main(max_workers: int = 4, n_clients: int = 4,
+               duration_s: float = 10.0) -> int:
+    """Horizontal-scaling measurement (round-3 verdict item 6): N
+    SO_REUSEPORT gateway processes behind ONE port, hammered by separate
+    load-generator processes (the measuring side must not be the
+    bottleneck). Bar: >=2x the single-process rps at the same client load,
+    with p99 under the 50 ms NFR. Writes GATEWAY_SCALE.json."""
+    import socket
+    import subprocess
+
+    secret = "bench-secret-0123456789abcdef0123456789abcdef"
+    token = make_token(secret)
+    # reserve a port: bind with SO_REUSEPORT and keep it open so workers can
+    # co-bind while nothing else grabs it
+    placeholder = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    placeholder.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    placeholder.bind(("127.0.0.1", 0))
+    port = placeholder.getsockname()[1]
+    url = f"http://127.0.0.1:{port}/v1/echo"
+    me = os.path.abspath(__file__)
+    results: dict[str, dict] = {}
+
+    def run_level(n_workers: int, total_conc: int) -> dict:
+        workers = []
+        try:
+            for _ in range(n_workers):
+                p = subprocess.Popen([sys.executable, me, "--worker",
+                                      str(port), secret],
+                                     stdout=subprocess.PIPE, text=True)
+                assert p.stdout.readline().startswith("READY")
+                workers.append(p)
+            conc_each = max(1, total_conc // n_clients)
+            clients = [subprocess.Popen(
+                [sys.executable, me, "--client", url, token,
+                 str(duration_s), str(conc_each)],
+                stdout=subprocess.PIPE, text=True)
+                for _ in range(n_clients)]
+            outs = [json.loads(c.communicate(timeout=duration_s + 120)[0]
+                               .strip().splitlines()[-1]) for c in clients]
+            agg = {
+                "workers": n_workers, "clients": n_clients,
+                "concurrency_total": conc_each * n_clients,
+                "rps": round(sum(o["rps"] for o in outs), 1),
+                "p50_ms": round(max(o["p50_ms"] for o in outs), 2),
+                "p99_ms": round(max(o["p99_ms"] for o in outs), 2),
+                "errors": sum(o["errors"] for o in outs),
+            }
+            print(f"# workers={n_workers} conc={agg['concurrency_total']}: "
+                  f"rps={agg['rps']} p99={agg['p99_ms']}ms "
+                  f"errors={agg['errors']}", file=sys.stderr, flush=True)
+            return agg
+        finally:
+            import signal as _signal
+
+            for p in workers:
+                p.send_signal(_signal.SIGTERM)
+            for p in workers:
+                try:
+                    p.wait(15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    try:
+        for n_workers, conc in [(1, 256), (max_workers, 256),
+                                (1, 1024), (max_workers, 1024)]:
+            results[f"w{n_workers}_c{conc}"] = run_level(n_workers, conc)
+    finally:
+        placeholder.close()
+
+    speedup_256 = results[f"w{max_workers}_c256"]["rps"] / \
+        max(1.0, results["w1_c256"]["rps"])
+    speedup_1024 = results[f"w{max_workers}_c1024"]["rps"] / \
+        max(1.0, results["w1_c1024"]["rps"])
+    summary = {
+        "metric": f"api-gateway horizontal scaling: {max_workers} "
+                  "SO_REUSEPORT worker processes vs 1 (jwt auth, loopback, "
+                  "no-op handler, separate load-generator processes)",
+        "nfr": ">=2x single-process rps; p99 < 50 ms (PRD.md:28 envelope)",
+        "speedup_c256": round(speedup_256, 2),
+        "speedup_c1024": round(speedup_1024, 2),
+        "scaled_p99_ms_c1024": results[f"w{max_workers}_c1024"]["p99_ms"],
+        "pass": (max(speedup_256, speedup_1024) >= 2.0
+                 and results[f"w{max_workers}_c1024"]["p99_ms"] < 50.0),
+        "levels": results,
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "GATEWAY_SCALE.json")
+    with open(out, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps(summary), flush=True)
+    return 0 if summary["pass"] else 1
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        sys.exit(worker_main(int(sys.argv[2]), sys.argv[3]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--client":
+        sys.exit(client_main(sys.argv[2], sys.argv[3],
+                             float(sys.argv[4]), int(sys.argv[5])))
+    if len(sys.argv) > 1 and sys.argv[1] == "--scale":
+        workers = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+        sys.exit(scale_main(workers))
     sys.exit(main())
