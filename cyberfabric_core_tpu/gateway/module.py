@@ -24,12 +24,14 @@ from ..modkit.context import ModuleCtx
 from ..modkit.registry import module
 from ..modkit.telemetry import Tracer
 from .middleware import (
+    BUILTIN_PUBLIC_PATHS,
     SECURITY_CONTEXT_KEY,
     AuthnApi,
     AuthzApi,
     LicenseApi,
     RateLimiterMap,
-    build_middlewares,
+    RouteStackBuilder,
+    make_router_fallback_mw,
 )
 from .openapi import OpenApiRegistry
 from .router import OperationSpec, RateLimitSpec, RestRouter
@@ -95,34 +97,7 @@ class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemC
         self.router_specs = list(router.operations)
         self.openapi_doc = openapi.build(router)
 
-        import re as _re
-
-        spec_by_key: dict[tuple[str, str], OperationSpec] = {}
-        app_routes: list[web.RouteDef] = []
-        for spec in router.operations:
-            if spec.rate_limit is None:
-                spec.rate_limit = RateLimitSpec(
-                    rps=cfg.default_rps, burst=cfg.default_burst,
-                    max_in_flight=cfg.default_in_flight,
-                )
-            # aiohttp's canonical form strips regex qualifiers: {tail:.*} -> {tail}
-            canonical = _re.sub(r"\{(\w+):[^}]*\}", r"{\1}", spec.path)
-            spec_by_key[(spec.method, canonical)] = spec
-            app_routes.append(
-                web.route(spec.method, spec.path, _wrap_handler(spec))
-            )
-
-        @web.middleware
-        async def spec_attach_mw(request: web.Request, handler):
-            # layer 0: attach the matched OperationSpec so per-route middlewares
-            # (timeout/MIME/rate/auth/license) can consult it
-            resource = request.match_info.route.resource
-            canonical = resource.canonical if resource is not None else None
-            if canonical is not None:
-                request["spec"] = spec_by_key.get((request.method, canonical))
-            return await handler(request)
-
-        middlewares = [spec_attach_mw] + build_middlewares(
+        stack = RouteStackBuilder(
             tracer=self.tracer,
             timeout_secs=cfg.timeout_secs,
             max_body_bytes=cfg.max_body_bytes,
@@ -135,12 +110,46 @@ class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemC
             limiter=RateLimiterMap(),
         )
 
-        app = web.Application(middlewares=middlewares, client_max_size=cfg.max_body_bytes)
+        app_routes: list[web.RouteDef] = []
+        for spec in router.operations:
+            if spec.rate_limit is None:
+                spec.rate_limit = RateLimitSpec(
+                    rps=cfg.default_rps, burst=cfg.default_burst,
+                    max_in_flight=cfg.default_in_flight,
+                )
+            # the full 12-layer stack is composed ONCE here, spec bound in
+            # the closures — no per-request middleware wrapping or spec lookup
+            app_routes.append(
+                web.route(spec.method, spec.path,
+                          stack.compose(spec, _wrap_handler(spec)))
+            )
+
+        # only app-level middleware left: CORS preflight + RFC-9457/metrics/
+        # trace for unmatched routes
+        app = web.Application(
+            middlewares=[make_router_fallback_mw(
+                tracer=self.tracer, cors_allow_origin=cfg.cors_allow_origin,
+                auth_disabled=cfg.auth_disabled)],
+            client_max_size=cfg.max_body_bytes)
         app.add_routes(app_routes)
-        app.router.add_get("/openapi.json", self._serve_openapi)
-        app.router.add_get("/health", self._serve_health)
-        app.router.add_get("/healthz", self._serve_healthz)
-        app.router.add_get("/docs", self._serve_docs)
+        builtin_endpoints = {
+            "/openapi.json": self._serve_openapi,
+            "/health": self._serve_health,
+            "/healthz": self._serve_healthz,
+            "/docs": self._serve_docs,
+        }
+        # BUILTIN_PUBLIC_PATHS is the source of truth for which paths may run
+        # without a SecurityContext — composing from it keeps the auth-surface
+        # audit honest (a new builtin must be added there, consciously).
+        # Hard raise, not assert: the auth-surface check must survive python -O.
+        if set(builtin_endpoints) != set(BUILTIN_PUBLIC_PATHS):
+            raise RuntimeError(
+                "builtin endpoint registrations diverge from "
+                f"BUILTIN_PUBLIC_PATHS: {sorted(builtin_endpoints)} vs "
+                f"{sorted(BUILTIN_PUBLIC_PATHS)}")
+        for path, endpoint in builtin_endpoints.items():
+            app.router.add_get(
+                path, stack.compose(None, endpoint, builtin_public=True))
         self.app = app
 
     # ------------------------------------------------------------- builtin endpoints

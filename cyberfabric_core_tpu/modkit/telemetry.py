@@ -14,13 +14,13 @@ from __future__ import annotations
 
 import contextvars
 import logging
+import os
 import random
 import re
 import time
-import uuid
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Any, Iterator, Optional
+from typing import Any, Optional
 
 logger = logging.getLogger("telemetry")
 
@@ -60,6 +60,33 @@ class SpanExporter:
         )
 
 
+class _SpanScope:
+    """Class-based span context manager — the per-request hot path avoids the
+    generator + contextlib machinery of ``@contextmanager`` (~20 µs/request
+    in the gateway overhead profile)."""
+
+    __slots__ = ("_tracer", "span", "_token")
+
+    def __init__(self, tracer: "Tracer", span: Span) -> None:
+        self._tracer = tracer
+        self.span = span
+
+    def __enter__(self) -> Span:
+        self._token = _current_span.set(self.span)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        span = self.span
+        if exc_type is not None:
+            span.status = "error"
+        _current_span.reset(self._token)
+        tracer = self._tracer
+        if tracer.enabled and span.sampled:
+            tracer.exporter.export(
+                span, (time.monotonic_ns() - span.start_ns) / 1e6)
+        return False
+
+
 class Tracer:
     """Sampling tracer (parent-based ratio sampler parity, telemetry/config.rs)."""
 
@@ -69,9 +96,8 @@ class Tracer:
         self.sample_ratio = sample_ratio
         self.exporter = exporter or SpanExporter()
 
-    @contextmanager
     def span(self, name: str, *, traceparent: Optional[str] = None,
-             **attributes: Any) -> Iterator[Span]:
+             **attributes: Any) -> _SpanScope:
         parent = _current_span.get()
         trace_id, parent_id = None, None
         if traceparent:
@@ -81,28 +107,20 @@ class Tracer:
         if trace_id is None and parent is not None:
             trace_id, parent_id = parent.trace_id, parent.span_id
         if trace_id is None:
-            trace_id = uuid.uuid4().hex
+            # os.urandom over uuid4: same 128 random bits without UUID object
+            # construction (~3x faster; spans are per-request hot-path)
+            trace_id = os.urandom(16).hex()
         # parent-based sampling: children inherit the parent's decision; only
         # root spans roll the dice, so an unsampled trace emits nothing at all
         sampled = parent.sampled if parent is not None else (random.random() < self.sample_ratio)
-        span = Span(
+        return _SpanScope(self, Span(
             name=name,
             trace_id=trace_id,
-            span_id=uuid.uuid4().hex[:16],
+            span_id=os.urandom(8).hex(),
             parent_id=parent_id,
             attributes=dict(attributes),
             sampled=sampled,
-        )
-        token = _current_span.set(span)
-        try:
-            yield span
-        except BaseException:
-            span.status = "error"
-            raise
-        finally:
-            _current_span.reset(token)
-            if self.enabled and span.sampled:
-                self.exporter.export(span, (time.monotonic_ns() - span.start_ns) / 1e6)
+        ))
 
     @staticmethod
     def current() -> Optional[Span]:

@@ -13,10 +13,10 @@ gateway+plugin pattern. Plugins implemented here:
 
 from __future__ import annotations
 
-import copy
 import dataclasses
 import logging
 import time
+from types import MappingProxyType
 from typing import Any, Optional
 
 from ..modkit import Module, module
@@ -27,6 +27,19 @@ from ..modkit.errors import Problem, ProblemError
 from ..modkit.security import AccessScope, Dimension, ScopeFilter, SecretString, SecurityContext
 from ..gateway.middleware import AuthnApi, AuthzApi
 from .sdk import TenantResolverApi
+
+
+def _deep_freeze(value: Any) -> Any:
+    """Recursively freeze a JSON-ish claims tree: dict → MappingProxyType,
+    list/tuple → tuple. The result is safely shareable across requests — the
+    validated-token cache hands out ONE instance instead of deep-copying per
+    hit, and any handler that tries to mutate identity state gets a TypeError
+    instead of silently poisoning the next request."""
+    if isinstance(value, dict):
+        return MappingProxyType({k: _deep_freeze(v) for k, v in value.items()})
+    if isinstance(value, (list, tuple)):
+        return tuple(_deep_freeze(v) for v in value)
+    return value
 
 
 class StaticTenantResolver(TenantResolverApi):
@@ -123,14 +136,14 @@ class JwtAuthnResolver(AuthnApi):
             if hit is not None:
                 good_until, ctx = hit
                 if time.monotonic() < good_until:
-                    # Fresh claims per hit: SecurityContext is frozen but its
-                    # claims mapping is not, and handing every request the
-                    # same dict would let one handler's mutation leak into the
-                    # next request's identity. Deep copy — IdP claims nest
-                    # (realm_access.roles, aud lists), and a shallow copy
-                    # would still share those inner containers.
-                    return dataclasses.replace(
-                        ctx, claims=copy.deepcopy(ctx.claims))
+                    # The cached ctx is fully immutable (frozen dataclass +
+                    # deep-frozen claims, see _deep_freeze), so handing every
+                    # request the SAME instance cannot leak one handler's
+                    # mutation into the next request's identity — mutation
+                    # attempts raise instead. Zero copies on the hot path
+                    # (the per-hit deepcopy was ~15 calls/request in the
+                    # gateway overhead profile).
+                    return ctx
                 del self._cache[bearer_token]
         try:
             if self.jwks is not None:
@@ -167,7 +180,10 @@ class JwtAuthnResolver(AuthnApi):
             roles=roles,
             access_scope=AccessScope.for_tenants([tenant]),
             bearer_token=SecretString(bearer_token),
-            claims=claims,
+            # deep-frozen once at validation: every consumer (cached hits
+            # included) shares one immutable claims tree — IdP claims nest
+            # (realm_access.roles, aud lists), so freezing recurses
+            claims=_deep_freeze(claims),
         )
         if self._cache_ttl_s > 0:
             ttl = self._cache_ttl_s
@@ -181,11 +197,7 @@ class JwtAuthnResolver(AuthnApi):
             if ttl > 0:
                 if len(self._cache) >= self._cache_max:
                     self._cache.clear()  # bulk reset beats per-entry LRU here
-                # Cache a PRIVATE snapshot, not the ctx we hand out — the
-                # caller owns the returned claims dict and may mutate it.
-                self._cache[bearer_token] = (
-                    time.monotonic() + ttl,
-                    dataclasses.replace(ctx, claims=copy.deepcopy(claims)))
+                self._cache[bearer_token] = (time.monotonic() + ttl, ctx)
         return ctx
 
 

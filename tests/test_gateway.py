@@ -227,6 +227,149 @@ def test_docs_page(gateway_app):
 
 
 def test_unknown_route_404(gateway_app):
+    # fixture runs auth_disabled=True → unmatched paths surface as 404
+    # problem documents (with auth ENABLED they fail closed as 401 — see
+    # test_unknown_route_fails_closed_with_auth)
     loop, base = gateway_app
-    status, _, _ = _req(loop, "GET", f"{base}/v1/nope")
+    status, headers, body = _req(loop, "GET", f"{base}/v1/nope")
     assert status == 404
+    # RFC-9457 document with a request id, and the miss is OBSERVED: 404s
+    # must land in http_requests_total or scanners become invisible to
+    # dashboards — under the fixed <unmatched> label, not one label per
+    # probed path (cardinality bomb; round-5 review findings)
+    assert json.loads(body)["status"] == 404
+    assert "x-request-id" in {k.lower() for k in headers}
+    from cyberfabric_core_tpu.gateway.middleware import UNMATCHED_ROUTE_LABEL
+    from cyberfabric_core_tpu.modkit.metrics import default_registry
+
+    counter = default_registry.counter("http_requests_total")
+    assert any(
+        dict(key).get("route") == UNMATCHED_ROUTE_LABEL
+        and dict(key).get("status") == "404"
+        for key in counter._values
+    )
+    assert not any(
+        dict(key).get("route") == "/v1/nope" for key in counter._values
+    )
+
+
+def test_unknown_route_fails_closed_with_auth(fresh_registry):
+    """With auth ENABLED, unmatched paths return the same 401 as
+    unauthenticated matched paths — no route enumeration via 404 vs 401
+    (round-5 review finding; old auth_mw spec-less branch parity)."""
+    from cyberfabric_core_tpu.gateway.module import ApiGatewayModule
+    from cyberfabric_core_tpu.modkit.registry import Registration
+
+    fresh_registry._REGISTRATIONS.clear()
+    gw_reg = Registration(
+        name="api_gateway", cls=ApiGatewayModule, deps=(),
+        capabilities=("rest_host", "stateful", "system"),
+    )
+
+    @module(name="sample", capabilities=["rest"])
+    class SampleModule(Module, RestApiCapability):
+        async def init(self, ctx):
+            pass
+
+        def register_rest(self, ctx, router, openapi):
+            async def whoami(request):
+                return {"ok": True}
+
+            router.operation("GET", "/v1/secured", module="sample") \
+                .auth_required().handler(whoami).register()
+
+    async def boot():
+        cfg = AppConfig.load_or_default(
+            environ={},
+            cli_overrides={"modules": {
+                "api_gateway": {"config": {"bind_addr": "127.0.0.1:0"}},
+                "sample": {},
+            }},
+        )
+        reg = ModuleRegistry.discover_and_build(extra=[gw_reg])
+        rt = HostRuntime(RunOptions(config=cfg, registry=reg))
+        await rt.run_setup_phases()
+        return rt, reg.get("api_gateway").instance
+
+    loop = asyncio.new_event_loop()
+    rt, gw = loop.run_until_complete(boot())
+    base = f"http://127.0.0.1:{gw.bound_port}"
+    try:
+        s_matched, _, _ = _req(loop, "GET", f"{base}/v1/secured")
+        s_unmatched, _, _ = _req(loop, "GET", f"{base}/v1/does-not-exist")
+        assert s_matched == 401
+        assert s_unmatched == 401  # indistinguishable from the matched route
+        # builtins stay public even with auth enabled
+        s_health, _, _ = _req(loop, "GET", f"{base}/healthz")
+        assert s_health == 200
+    finally:
+        rt.root_token.cancel()
+        loop.run_until_complete(rt.run_stop_phase())
+        loop.close()
+
+
+def test_cors_preflight_and_error_headers(fresh_registry):
+    """CORS with the pre-composed stack (round-5 review finding): browsers
+    preflight OPTIONS against routes that only register POST — that must
+    204 with CORS headers, not 405 without them; and cross-origin error
+    responses (404) need CORS headers to be readable by the caller."""
+    from cyberfabric_core_tpu.gateway.module import ApiGatewayModule
+    from cyberfabric_core_tpu.modkit.registry import Registration
+
+    fresh_registry._REGISTRATIONS.clear()
+    gw_reg = Registration(
+        name="api_gateway", cls=ApiGatewayModule, deps=(),
+        capabilities=("rest_host", "stateful", "system"),
+    )
+
+    @module(name="sample", capabilities=["rest"])
+    class SampleModule(Module, RestApiCapability):
+        async def init(self, ctx):
+            pass
+
+        def register_rest(self, ctx, router, openapi):
+            async def echo(request):
+                return {"ok": True}
+
+            router.operation("POST", "/v1/only-post", module="sample") \
+                .public().handler(echo).register()
+
+    async def boot():
+        cfg = AppConfig.load_or_default(
+            environ={},
+            cli_overrides={"modules": {
+                "api_gateway": {"config": {
+                    "bind_addr": "127.0.0.1:0", "auth_disabled": True,
+                    "cors_allow_origin": "https://app.example"}},
+                "sample": {},
+            }},
+        )
+        reg = ModuleRegistry.discover_and_build(extra=[gw_reg])
+        rt = HostRuntime(RunOptions(config=cfg, registry=reg))
+        await rt.run_setup_phases()
+        return rt, reg.get("api_gateway").instance
+
+    loop = asyncio.new_event_loop()
+    rt, gw = loop.run_until_complete(boot())
+    base = f"http://127.0.0.1:{gw.bound_port}"
+    try:
+        # preflight against a POST-only route: 204 + CORS headers
+        status, headers, _ = _req(loop, "OPTIONS", f"{base}/v1/only-post")
+        assert status == 204
+        assert headers.get("Access-Control-Allow-Origin") == "https://app.example"
+        # preflight against an unknown path behaves the same (old layer-5)
+        status, headers, _ = _req(loop, "OPTIONS", f"{base}/does/not/exist")
+        assert status == 204
+        assert headers.get("Access-Control-Allow-Origin") == "https://app.example"
+        # normal responses carry the header via the per-route layer
+        status, headers, _ = _req(loop, "POST", f"{base}/v1/only-post", json={})
+        assert status == 200
+        assert headers.get("Access-Control-Allow-Origin") == "https://app.example"
+        # 404 problem documents are readable cross-origin too
+        status, headers, _ = _req(loop, "GET", f"{base}/missing")
+        assert status == 404
+        assert headers.get("Access-Control-Allow-Origin") == "https://app.example"
+    finally:
+        rt.root_token.cancel()
+        loop.run_until_complete(rt.run_stop_phase())
+        loop.close()

@@ -46,13 +46,15 @@ def test_jwt_token_cache_respects_exp():
         good_until, cached = r._cache[tok]
         # ttl capped by exp (~2s), not the 120s config
         assert good_until - _time.monotonic() < 5.0
-        # prove the next authenticate is a HIT (not a re-validation) by
-        # marking the cached snapshot; hits hand out claim-isolated COPIES
-        # (round-3 advisory), so the marker flows through but the object is new
-        cached.claims["_cache_marker"] = True
+        # prove the next authenticate is a HIT (not a re-validation): hits
+        # hand out the SAME deep-frozen instance (zero-copy, round-5), and
+        # mutation attempts raise instead of tainting shared identity
         ctx2 = loop.run_until_complete(r.authenticate(tok, {}))
-        assert ctx2 is not cached
-        assert ctx2.claims.pop("_cache_marker") is True
+        assert ctx2 is cached
+        import pytest as _pytest
+
+        with _pytest.raises(TypeError):
+            ctx2.claims["_cache_marker"] = True
         assert (ctx2.subject, ctx2.tenant_id) == (cached.subject,
                                                   cached.tenant_id)
         # expire it: revalidation happens (and fails once exp passes)

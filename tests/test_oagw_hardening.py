@@ -435,10 +435,14 @@ def test_jwks_same_kid_new_material_bumps_generation(jwks_server):
 
 
 def test_token_cache_hit_isolates_claims():
-    """Round-3 advisory: cache hits must not hand every request the same
-    mutable claims dict — one handler's mutation would leak into the next
-    request's identity."""
+    """Round-3 advisory, strengthened in round 5: one handler's claims
+    mutation must never leak into the next request's identity. The claims
+    tree is now deep-frozen at validation (MappingProxyType + tuples), so
+    mutation attempts RAISE instead of being absorbed by a per-hit deepcopy
+    — stronger isolation at zero per-request copy cost."""
     import asyncio as _asyncio
+
+    import pytest
 
     from cyberfabric_core_tpu.modules.resolvers import JwtAuthnResolver
 
@@ -451,17 +455,19 @@ def test_token_cache_hit_isolates_claims():
     loop = _asyncio.new_event_loop()
     try:
         ctx1 = loop.run_until_complete(resolver.authenticate(tok, {}))
-        ctx1.claims["extra"] = "TAMPERED"
-        ctx1.claims["injected"] = True
-        # nested containers must be isolated too (IdP claims nest)
-        ctx1.claims["realm_access"]["roles"].append("admin")
+        with pytest.raises(TypeError):
+            ctx1.claims["extra"] = "TAMPERED"
+        with pytest.raises(TypeError):
+            ctx1.claims["injected"] = True
+        # nested containers must be frozen too (IdP claims nest)
+        with pytest.raises((TypeError, AttributeError)):
+            ctx1.claims["realm_access"]["roles"].append("admin")
         ctx2 = loop.run_until_complete(resolver.authenticate(tok, {}))
         assert ctx2.claims.get("extra") == "orig"
         assert "injected" not in ctx2.claims
-        assert ctx2.claims["realm_access"]["roles"] == ["user"]
-        # and a hit's mutations must not taint the NEXT hit either
-        ctx2.claims["realm_access"]["roles"].append("admin")
+        assert tuple(ctx2.claims["realm_access"]["roles"]) == ("user",)
+        # a cache HIT hands out the same frozen identity, still untainted
         ctx3 = loop.run_until_complete(resolver.authenticate(tok, {}))
-        assert ctx3.claims["realm_access"]["roles"] == ["user"]
+        assert tuple(ctx3.claims["realm_access"]["roles"]) == ("user",)
     finally:
         loop.close()
