@@ -9,8 +9,8 @@ export JAX_PLATFORMS ?= cpu
 
 safety: lint fuzz sanitizers contracts aot-tpu  ## the full local gate
 
-lint:  ## architectural lints (dylint equivalent: L1-L7 incl. DE07/DE08)
-	$(PY) -m pytest tests/test_arch_lint.py -q
+lint:  ## architectural lints (dylint equivalent: all 8 families, DE01-DE13 + EC01) + license audit (deny.toml parity)
+	$(PY) -m pytest tests/test_arch_lint.py tests/test_license_audit.py -q
 
 fuzz:  ## parser fuzzing: property layer + coverage-guided mutation w/ corpus
 	FUZZ_EXAMPLES=2000 $(PY) -m pytest tests/test_odata_fuzz.py -q

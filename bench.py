@@ -47,8 +47,54 @@ def log(msg: str) -> None:
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
+#: TPU v5e single-chip peaks (public spec): bf16 matmul FLOP/s and HBM BW.
+#: MFU and the bandwidth roofline are reported NEXT TO every measurement so
+#: the first real-TPU row in BENCH_HISTORY.jsonl directly answers "is this
+#: actually fast?" (round-4 verdict item 10).
+V5E_PEAK_BF16_FLOPS = 197e12
+V5E_HBM_BYTES_PER_S = 819e9
+
+
+def host_evidence() -> dict:
+    """Host contention evidence attached to every bench row: a regression is
+    only a regression if the host was comparable (round-4 verdict item 2)."""
+    try:
+        la = [round(x, 2) for x in os.getloadavg()]
+    except OSError:
+        la = []
+    return {"cores": os.cpu_count(),
+            "affinity": len(os.sched_getaffinity(0)),
+            "loadavg": la}
+
+
+def await_quiet(max_wait_s: float = 90.0, thresh: float = 0.8) -> dict:
+    """Wait (bounded) for the 1-min loadavg to drop below ``thresh`` before a
+    CPU canary run — on the 1-core bench hosts a concurrently running test
+    suite halves the number and reads as a fake regression. Returns what
+    happened so the artifact shows whether the run was clean."""
+    t0 = time.monotonic()
+    while True:
+        try:
+            load1 = os.getloadavg()[0]
+        except OSError:
+            return {"waited_s": 0.0, "loadavg_at_start": None, "quiet": True}
+        if load1 < thresh:
+            return {"waited_s": round(time.monotonic() - t0, 1),
+                    "load1": round(load1, 2), "quiet": True}
+        if time.monotonic() - t0 >= max_wait_s:
+            return {"waited_s": round(time.monotonic() - t0, 1),
+                    "load1": round(load1, 2), "quiet": False}
+        log(f"host loaded (load1={load1:.2f} >= {thresh}); waiting...")
+        time.sleep(5.0)
+
+
 HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_HISTORY.jsonl")
+#: CPU canary evidence (separate from BENCH_HISTORY, which is TPU-only by
+#: policy): every canary run appends {value, spread, load} so round-over-round
+#: deltas are attributable (round-4 verdict item 2)
+CANARY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "CANARY_HISTORY.jsonl")
 
 
 def record_history(kind: str, entry: dict) -> None:
@@ -194,12 +240,12 @@ def single(model: str, quant: str) -> int:
                        speculative=speculative,
                        draft_model=model if speculative == "draft" else "")
 
+    ddir = None
     try:
         t0 = time.monotonic()
         engine = InferenceEngine(cfg, seed=0)
         jax.block_until_ready(engine.params)
         log(f"{model}/{quant}: weights materialized in {time.monotonic()-t0:.1f}s")
-        ddir = None
         if speculative == "draft":
             # self-draft: persist the engine's own weights as the draft ckpt
             # (removed in the epilogue below — an 8B bf16 tree is ~16GB and
@@ -255,11 +301,13 @@ def single(model: str, quant: str) -> int:
         print(json.dumps({"error": kind, "model": model, "quant": quant,
                           "detail": msg[:300]}), flush=True)
         return 7 if kind == "oom" else 1
+    finally:
+        # failure paths too: a crashed/OOM'd attempt must not leak a ~16GB
+        # draft tree into /tmp across autobench retries (round-4 advisory)
+        if ddir is not None:
+            import shutil as _sh
 
-    if ddir is not None:
-        import shutil as _sh
-
-        _sh.rmtree(ddir, ignore_errors=True)
+            _sh.rmtree(ddir, ignore_errors=True)
     precision = f"{quant}-weights" if quant in ("int8", "int4") else "bf16"
     spec_label = ("" if not spec else
                   ", self-draft-speculative (upper bound)"
@@ -275,7 +323,34 @@ def single(model: str, quant: str) -> int:
         "decode_chunk": cfg.decode_chunk,
         "north_star": "p50 TTFT < 100 ms (BASELINE.json); vs_baseline = 100/ttft_p50",
         "tpu": on_tpu,
+        "host": host_evidence(),
     }
+    # MFU + HBM roofline next to the measurement (round-4 verdict item 10):
+    # XLA's own cost model for the fused decode chunk gives flops/bytes per
+    # token; MFU = achieved flops ÷ chip peak, roofline = BW ÷ bytes/token.
+    if os.environ.get("BENCH_COST", "1") != "0":
+        try:
+            t0 = time.monotonic()
+            cost = engine.decode_cost_analysis(batch=1)
+            fpt, bpt = cost.get("flops_per_token"), cost.get("bytes_per_token")
+            roof: dict = {}
+            if fpt:
+                roof["flops_per_token"] = round(fpt)
+                if on_tpu:
+                    roof["mfu_pct"] = round(
+                        100.0 * fpt * tps / V5E_PEAK_BF16_FLOPS, 2)
+            if bpt:
+                roof["bytes_per_token"] = round(bpt)
+                if on_tpu:
+                    roof["roofline_tok_s_at_819GBps"] = round(
+                        V5E_HBM_BYTES_PER_S / bpt, 1)
+                    roof["hbm_roofline_pct"] = round(
+                        100.0 * tps * bpt / V5E_HBM_BYTES_PER_S, 2)
+            if roof:
+                result["roofline"] = roof
+            log(f"cost analysis in {time.monotonic()-t0:.1f}s: {roof}")
+        except Exception as e:  # noqa: BLE001 — roofline is evidence, not gate
+            log(f"cost analysis unavailable: {e}")
     print(json.dumps(result), flush=True)
     return 0
 
@@ -298,24 +373,118 @@ def main() -> int:
         # honestly labeled; the pipeline itself is exercised (the child selects
         # CPU itself via config.update — env alone can't, sitecustomize re-pins)
         env = dict(os.environ, JAX_PLATFORMS="cpu")
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--single", "tiny-llama", "none"],
-                capture_output=True, text=True, timeout=900, env=env)
-            sys.stderr.write(proc.stderr)
-            result = json.loads(proc.stdout.strip().splitlines()[-1])
-        except Exception as e:  # noqa: BLE001 — one JSON line, no matter what
-            result = {"metric": f"cpu fallback failed ({type(e).__name__})",
-                      "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0}
+
+        def one_run() -> dict | None:
+            load_before = host_evidence()["loadavg"]
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--single",
+                     "tiny-llama", "none"],
+                    capture_output=True, text=True, timeout=900, env=env)
+                sys.stderr.write(proc.stderr)
+                out = json.loads(proc.stdout.strip().splitlines()[-1])
+                # per-run load bracket: a diverging run must be attributable
+                out["loadavg_bracket"] = [load_before,
+                                          host_evidence()["loadavg"]]
+                return out
+            except Exception as e:  # noqa: BLE001
+                log(f"cpu canary run failed: {e}")
+                return None
+
+        # the canary is the only perf instrument while the chip is down, so it
+        # must be REPRODUCIBLE (round-4 verdict item 2): quiesce the host,
+        # run TWICE, report the spread, and track round-over-round deltas in
+        # CANARY_HISTORY.jsonl. Deliberate dev runs (JAX_PLATFORMS=cpu) keep
+        # the old single fast run.
         if deliberate_cpu:
-            result["metric"] = str(result.get("metric", "")).replace("(cpu", "(cpu-dev")
-        else:
-            result["tpu_unavailable"] = probe_detail
-            # a CPU TTFT against the 100 ms TPU north-star reads like "90×
-            # baseline" while measuring nothing real (round-2 verdict weak #8)
-            result["vs_baseline"] = 0.0
-            result["vs_baseline_suppressed"] = "cpu fallback; north-star ratio is TPU-only"
+            result = one_run() or {
+                "metric": "cpu fallback failed", "value": 0.0,
+                "unit": "tokens/sec/chip", "vs_baseline": 0.0}
+            result["metric"] = str(result.get("metric", "")).replace(
+                "(cpu", "(cpu-dev")
+            print(json.dumps(result), flush=True)
+            return 0
+
+        quiesce = await_quiet(90.0)
+        # run until TWO CONSECUTIVE runs agree within 5% (max 4 attempts):
+        # on a shared 1-core host any co-tenant process halves a run, so a
+        # single diverging run is evidence of contention, not a regression —
+        # the agreeing pair is the measurement (round-4 verdict item 2)
+        runs: list[dict] = []
+        agreed: list[float] = []
+        for _ in range(4):
+            r = one_run()
+            if r and r.get("value"):
+                runs.append(r)
+            if len(runs) >= 2:
+                a, b = runs[-2]["value"], runs[-1]["value"]
+                if abs(a - b) / max(a, b) <= 0.05:
+                    agreed = [a, b]
+                    break
+        if not runs:
+            result = {"metric": "cpu fallback failed", "value": 0.0,
+                      "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+                      "tpu_unavailable": probe_detail}
+            print(json.dumps(result), flush=True)
+            return 0
+        values = [r["value"] for r in runs]
+        mean_v = (sum(agreed) / 2 if agreed
+                  else sum(values) / len(values))
+        spread_pct = (100.0 * (max(values) - min(values))
+                      / (sum(values) / len(values)) if len(values) > 1 else 0.0)
+        canary = {
+            "runs": values,
+            "run_load_brackets": [r.get("loadavg_bracket") for r in runs],
+            "spread_pct_all": round(spread_pct, 1),
+            "stable": bool(agreed),
+            "agreed_pair": agreed or None,
+            "quiesce": quiesce,
+            "host": host_evidence(),
+        }
+        # round-over-round gate: compare to the last committed canary row
+        try:
+            with open(CANARY_PATH) as f:
+                prev_rows = []
+                for ln in f:
+                    # a run killed mid-append leaves a partial line — skip
+                    # it, never crash the one-JSON-line contract
+                    try:
+                        if ln.strip():
+                            prev_rows.append(json.loads(ln))
+                    except ValueError:
+                        continue
+            prev = next((r for r in reversed(prev_rows) if r.get("value")), None)
+            if prev:
+                canary["delta_vs_prev_pct"] = round(
+                    100.0 * (mean_v - prev["value"]) / prev["value"], 1)
+                canary["prev"] = {"value": prev["value"], "ts": prev.get("ts")}
+                canary["regression_gate"] = (
+                    "pass" if abs(canary["delta_vs_prev_pct"]) <= 10.0
+                    else "investigate")
+        except OSError:
+            pass
+        try:
+            with open(CANARY_PATH, "a") as f:
+                f.write(json.dumps({
+                    "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "value": round(mean_v, 1), **canary}) + "\n")
+        except OSError as e:
+            log(f"canary history append failed: {e}")
+
+        result = runs[-1]
+        result["value"] = round(mean_v, 2)
+        result["canary"] = canary
+        result["tpu_unavailable"] = probe_detail
+        # a CPU TTFT against the 100 ms TPU north-star reads like "90×
+        # baseline" while measuring nothing real (round-2 verdict weak #8)
+        result["vs_baseline"] = 0.0
+        result["vs_baseline_suppressed"] = "cpu fallback; north-star ratio is TPU-only"
         print(json.dumps(result), flush=True)
+        # cross-model speculation evidence runs even without the chip — the
+        # artifact (SPEC_CROSS.json) carries acceptance/uplift mechanics; the
+        # TPU history row lands when the ladder runs on hardware
+        if os.environ.get("BENCH_SPEC_CROSS", "1") != "0":
+            _run_spec_cross(timeout_s=600.0, env=env)
         return 0
 
     # TPU ladder: per-attempt budget covers init (~90s) + compile (~60s) +
@@ -448,6 +617,13 @@ def main() -> int:
                 record_history("speculative_draft", out)
                 log(f"draft-speculative variant: {out['value']} tok/s "
                     f"(vs headline {result['value']})")
+
+    # cross-model draft speculation with real rejections (round-4 verdict
+    # item 3): tiny trained pair, so it runs even when the big ladder won on
+    # a quantized rung; history row is the acceptance-evidence artifact
+    if os.environ.get("BENCH_SPEC_CROSS", "1") != "0" and \
+            hard_deadline - time.monotonic() > 300:
+        _run_spec_cross(min(600.0, hard_deadline - time.monotonic() - 70))
     return 0
 
 
@@ -752,7 +928,180 @@ def sweep(model: str, quant: str) -> int:
     return 0 if rows else 1
 
 
+def spec_cross_mode() -> int:
+    """Cross-model draft speculation with REAL rejections (round-4 verdict
+    item 3): train an 8-layer target and an INDEPENDENT 2-layer draft on the
+    same Markov-structured corpus (models/toytrain.py), so their next-token
+    distributions overlap without matching — acceptance lands strictly
+    between 0 and 100%, the regime self-draft (always 100%) cannot measure.
+
+    Measures, end-to-end through the engine:
+      - plain greedy decode tokens/sec on the target
+      - draft-speculative tokens/sec at temp 0 (must be bit-lossless) and
+        temp 0.8 (acceptance sampling with real rejections)
+      - acceptance rate, tokens/round, and the acceptance-length histogram
+
+    Writes SPEC_CROSS.json; prints one JSON line. Exit 1 only on mechanics
+    failure (lossless check or no measurement) — a small uplift on CPU is a
+    result, not an error."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax.numpy as jnp
+
+        from cyberfabric_core_tpu.models import get_config
+        from cyberfabric_core_tpu.models.toytrain import (cast_params,
+                                                          markov_sampler,
+                                                          train_lm)
+        from cyberfabric_core_tpu.runtime import (EngineConfig,
+                                                  InferenceEngine,
+                                                  SamplingParams)
+        from cyberfabric_core_tpu.runtime.weights import save_llama_params
+
+        on_tpu = jax.devices()[0].platform != "cpu"
+        target_cfg = get_config("tiny-llama-8l")
+        draft_cfg = get_config("tiny-llama")
+        steps = int(os.environ.get("BENCH_SPEC_CROSS_STEPS", "300"))
+        t0 = time.monotonic()
+        target_params, tloss = train_lm(
+            target_cfg, steps=steps, param_seed=0, data_seed=1234, log=log)
+        draft_params, dloss = train_lm(
+            draft_cfg, steps=steps, param_seed=99, data_seed=1234, log=log)
+        log(f"trained target(8l) loss={tloss:.3f} draft(2l) loss={dloss:.3f} "
+            f"in {time.monotonic()-t0:.1f}s")
+
+        serve_dtype = jnp.bfloat16
+        target_params = cast_params(target_params, serve_dtype)
+        gen = 256
+        prompt_rng = np.random.default_rng(7)
+        sample = markov_sampler(target_cfg.vocab_size, seed=1234)
+        prompt = sample(1, 32, prompt_rng)[0].tolist()
+
+        def measure(engine, temp: float) -> tuple[float, list[int]]:
+            sp = SamplingParams(max_tokens=gen, temperature=temp, seed=11)
+            toks: list[int] = []
+            # warmup/compile outside the clock
+            engine.generate([prompt], SamplingParams(max_tokens=8,
+                                                     temperature=temp, seed=11))
+            t0 = time.monotonic()
+            first = None
+            for ev in engine.generate_stream([prompt], sp):
+                if first is None:
+                    first = time.monotonic()
+                toks.append(ev.token_id)
+            dt = time.monotonic() - first
+            return (len(toks) - 1) / dt if dt > 0 else 0.0, toks
+
+        ddir = tempfile.mkdtemp(prefix="spec-cross-draft-")
+        try:
+            save_llama_params(cast_params(draft_params, serve_dtype),
+                              draft_cfg, ddir)
+            plain_cfg = EngineConfig(model="tiny-llama-8l", max_seq_len=512,
+                                     max_batch=1, decode_chunk=4)
+            spec_cfg = EngineConfig(model="tiny-llama-8l", max_seq_len=512,
+                                    max_batch=1, decode_chunk=4,
+                                    speculative="draft",
+                                    draft_model="tiny-llama",
+                                    draft_checkpoint=ddir, spec_k=8)
+            plain = InferenceEngine(plain_cfg, params=target_params, seed=3)
+            tps_plain, toks_plain = measure(plain, 0.0)
+
+            spec = InferenceEngine(spec_cfg, params=target_params, seed=3)
+            tps_spec0, toks_spec0 = measure(spec, 0.0)
+            stats0 = dict(spec.spec_stats, accept_hist=dict(
+                sorted(spec.spec_stats["accept_hist"].items())))
+            lossless = toks_spec0 == toks_plain
+
+            spec_t = InferenceEngine(spec_cfg, params=target_params, seed=3)
+            tps_spec8, _ = measure(spec_t, 0.8)
+            stats8 = dict(spec_t.spec_stats, accept_hist=dict(
+                sorted(spec_t.spec_stats["accept_hist"].items())))
+        finally:
+            import shutil
+
+            shutil.rmtree(ddir, ignore_errors=True)
+
+        def summarize(stats: dict) -> dict:
+            drafted = max(1, stats["drafted"])
+            calls = max(1, stats["verify_calls"])
+            return {"acceptance_pct": round(100.0 * stats["accepted"] / drafted, 1),
+                    "tokens_per_round": round(stats["spec_tokens"] / calls, 2),
+                    "verify_calls": stats["verify_calls"],
+                    "fallback_steps": stats["fallback_steps"],
+                    "accept_hist": stats["accept_hist"]}
+
+        result = {
+            "kind": "speculative_cross",
+            "metric": "draft-model speculation, CROSS-model (2-layer draft vs "
+                      "8-layer target, both trained on one Markov corpus; "
+                      "real rejections)",
+            "tokens_per_sec_plain": round(tps_plain, 1),
+            "tokens_per_sec_spec_temp0": round(tps_spec0, 1),
+            "tokens_per_sec_spec_temp0.8": round(tps_spec8, 1),
+            "uplift_temp0": round(tps_spec0 / tps_plain, 2) if tps_plain else 0,
+            "uplift_temp0.8": round(tps_spec8 / tps_plain, 2) if tps_plain else 0,
+            "lossless_at_temp0": lossless,
+            "temp0": summarize(stats0),
+            "temp0.8": summarize(stats8),
+            "train_steps": steps, "gen_tokens": gen,
+            "tpu": on_tpu,
+            "host": host_evidence(),
+        }
+        ok = (lossless and result["temp0"]["acceptance_pct"] < 100.0
+              and result["temp0"]["verify_calls"] > 0)
+        result["mechanics_ok"] = ok
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "SPEC_CROSS.json"), "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result), flush=True)
+        return 0 if ok else 1
+    except Exception as e:  # noqa: BLE001 — clean exit releases the relay claim
+        print(json.dumps({"error": str(e)[:300], "kind": "speculative_cross"}),
+              flush=True)
+        return 1
+
+
+def _run_spec_cross(timeout_s: float, env: dict | None = None) -> dict | None:
+    """Run --spec-cross in a fresh subprocess (relay-safe); record the row."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--spec-cross"]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=sys.stderr,
+                            text=True, env=env)
+    _LIVE_CHILDREN.append(proc)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        line = out.strip().splitlines()[-1] if out.strip() else None
+    except subprocess.TimeoutExpired:
+        log("spec-cross exceeded budget — terminating")
+        _terminate_gracefully(proc)
+        return None
+    finally:
+        _LIVE_CHILDREN.remove(proc)
+    if not line:
+        return None
+    try:
+        row = json.loads(line)
+    except json.JSONDecodeError:
+        return None
+    if "error" in row:
+        log(f"spec-cross failed: {row['error']}")
+        return None
+    log(f"spec-cross: plain={row['tokens_per_sec_plain']} "
+        f"spec@0={row['tokens_per_sec_spec_temp0']} "
+        f"acceptance={row['temp0']['acceptance_pct']}%")
+    if row.get("tpu"):
+        record_history("speculative_cross", row)
+    return row
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--spec-cross":
+        sys.exit(spec_cross_mode())
     if len(sys.argv) > 3 and sys.argv[1] == "--single":
         sys.exit(single(sys.argv[2], sys.argv[3]))
     if len(sys.argv) > 3 and sys.argv[1] == "--aggregate":

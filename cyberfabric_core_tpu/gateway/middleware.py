@@ -482,30 +482,35 @@ def make_router_fallback_mw(*, tracer: Tracer,
         "http_request_duration_seconds", "Request latency")
 
     def _observe(request: web.Request, resp: web.StreamResponse,
-                 start: float, rid: str) -> web.StreamResponse:
-        with tracer.span(
+                 start_ns: int, rid: str) -> web.StreamResponse:
+        scope = tracer.span(
             f"http {request.method} {request.path}",
             traceparent=request.headers.get("traceparent"),
             method=request.method, path=request.path, request_id=rid,
-        ) as span:
+        )
+        # backdate to middleware entry so the exported span carries the real
+        # request duration, not the microseconds this epilogue takes
+        elapsed_ns = time.monotonic_ns() - start_ns
+        scope.span.start_ns -= elapsed_ns
+        scope.span.start_unix_ns -= elapsed_ns
+        with scope as span:
             span.set_attribute("status", resp.status)
         resp.headers[REQUEST_ID_HEADER] = rid
         req_counter.inc(route=UNMATCHED_ROUTE_LABEL, method=request.method,
                         status=str(resp.status))
-        req_latency.observe(time.monotonic() - start,
-                            route=UNMATCHED_ROUTE_LABEL)
+        req_latency.observe(elapsed_ns / 1e9, route=UNMATCHED_ROUTE_LABEL)
         return resp
 
     @web.middleware
     async def router_fallback_mw(request: web.Request, handler):
-        start = time.monotonic()
+        start_ns = time.monotonic_ns()
         if cors_allow_origin is not None and request.method == "OPTIONS":
             rid = request.headers.get(REQUEST_ID_HEADER) or os.urandom(16).hex()
             request[REQUEST_ID_KEY] = rid
             return _observe(
                 request,
                 _apply_cors_headers(web.Response(status=204), cors_allow_origin),
-                start, rid)
+                start_ns, rid)
         try:
             return await handler(request)
         except web.HTTPException as e:
@@ -525,6 +530,6 @@ def make_router_fallback_mw(*, tracer: Tracer,
             resp = _problem_response(problem, rid)
             if cors_allow_origin is not None:
                 _apply_cors_headers(resp, cors_allow_origin)
-            return _observe(request, resp, start, rid)
+            return _observe(request, resp, start_ns, rid)
 
     return router_fallback_mw

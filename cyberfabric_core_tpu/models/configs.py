@@ -95,6 +95,14 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         intermediate_size=8192, num_layers=32, num_heads=32, num_kv_heads=32,
         head_dim=96, max_position=4096, rope_theta=10000.0,
     ),
+    "tiny-llama-8l": ModelConfig(
+        # 8-layer big sibling of tiny-llama: the TARGET of the cross-model
+        # speculation benchmark (2-layer draft vs 8-layer target, round-4
+        # verdict item 3) — same vocab so the pair shares a tokenizer
+        name="tiny-llama-8l", architecture="llama", vocab_size=512,
+        hidden_size=64, intermediate_size=128, num_layers=8, num_heads=4,
+        num_kv_heads=2, head_dim=16, max_position=256, rope_theta=10000.0,
+    ),
     "tiny-moe": ModelConfig(
         name="tiny-moe", architecture="llama", vocab_size=512, hidden_size=64,
         intermediate_size=96, num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,

@@ -166,7 +166,12 @@ class JsonGrpcServer:
         if want is None:
             return
         meta = dict(context.invocation_metadata() or ())
-        if meta.get("authorization") != f"Bearer {want}":
+        # constant-time compare: the worker plane may bind beyond loopback,
+        # and a plain != on secrets is a timing side channel (round-4 advisory)
+        import hmac as _hmac
+
+        if not _hmac.compare_digest(meta.get("authorization", ""),
+                                    f"Bearer {want}"):
             await context.abort(grpc.StatusCode.UNAUTHENTICATED,
                                 f"{service_name} requires a bearer token")
 

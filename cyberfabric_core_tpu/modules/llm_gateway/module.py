@@ -97,7 +97,40 @@ def _migrate_0001(c):
     c.execute("CREATE INDEX idx_llm_batches ON llm_batches (tenant_id, status)")
 
 
-_MIGRATIONS = [Migration("0001_llm_jobs", _migrate_0001)]
+def _migrate_0002(c):
+    # round-4 advisory: recovery ran durable work as tenant-anonymous,
+    # dropping the submitter's roles/scopes — persist the minimal principal
+    # with the row so recovery reconstructs the submitting identity
+    c.execute("ALTER TABLE llm_jobs ADD COLUMN principal TEXT")
+    c.execute("ALTER TABLE llm_batches ADD COLUMN principal TEXT")
+
+
+_MIGRATIONS = [Migration("0001_llm_jobs", _migrate_0001),
+               Migration("0002_job_principal", _migrate_0002)]
+
+
+def _principal_of(ctx: SecurityContext) -> dict:
+    """Minimal durable identity: enough to reconstruct authorization-relevant
+    state (subject, roles, token scopes) without persisting the bearer token."""
+    return {"subject": ctx.subject, "roles": list(ctx.roles),
+            "scopes": list(ctx.token_scopes)}
+
+
+def _ctx_from_principal(tenant_id: str, principal: Optional[dict]) -> SecurityContext:
+    """Rebuild the submitter's SecurityContext at recovery. Rows written
+    before the principal column existed fall back to tenant-scoped anonymous
+    (the pre-round-5 behavior, now the exception rather than the rule)."""
+    from ...modkit.security import AccessScope
+
+    if not principal:
+        return SecurityContext.anonymous(tenant_id)
+    return SecurityContext(
+        subject=principal.get("subject") or "anonymous",
+        tenant_id=tenant_id,
+        token_scopes=tuple(principal.get("scopes") or ()),
+        roles=tuple(principal.get("roles") or ()),
+        access_scope=AccessScope.for_tenants([tenant_id]),
+    )
 
 #: durable async-job state (round-3 verdict item 7: DESIGN.md:884-889 expects
 #: job state in a distributed cache — here the module's own DB, like the
@@ -107,15 +140,17 @@ JOBS = ScopableEntity(
     table="llm_jobs",
     field_map={"id": "id", "tenant_id": "tenant_id", "status": "status",
                "request": "request", "result": "result", "error": "error",
-               "created_at": "created_at", "expires_at": "expires_at"},
-    json_cols=("request", "result", "error"),
+               "created_at": "created_at", "expires_at": "expires_at",
+               "principal": "principal"},
+    json_cols=("request", "result", "error", "principal"),
 )
 
 BATCHES = ScopableEntity(
     table="llm_batches",
     field_map={"id": "id", "tenant_id": "tenant_id", "status": "status",
-               "requests": "requests", "created_at": "created_at"},
-    json_cols=("requests",),
+               "requests": "requests", "created_at": "created_at",
+               "principal": "principal"},
+    json_cols=("requests", "principal"),
 )
 
 
@@ -171,6 +206,7 @@ class JobStore:
         job = {
             "id": job_id, "tenant_id": ctx.tenant_id, "status": "pending",
             "request": request, "result": None, "error": None,
+            "principal": _principal_of(ctx),
             "created_at": now.isoformat(),
             "expires_at": (now + datetime.timedelta(hours=24)).isoformat(),
         }
@@ -197,7 +233,8 @@ class JobStore:
 
     def public_view(self, job: dict) -> dict:
         return {k: v for k, v in job.items()
-                if k != "tenant_id" and not k.startswith("_") and v is not None}
+                if k not in ("tenant_id", "principal")
+                and not k.startswith("_") and v is not None}
 
 
 @module(name="llm_gateway", deps=["model_registry"],
@@ -304,7 +341,12 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         for row in jobs_conn.select(where={"status": "pending"}):
             if row["id"] in self.jobs.jobs:
                 continue  # owned by this process, not a crash leftover
-            tenant_ctx = SecurityContext.anonymous(row["tenant_id"])
+            # recovered work runs AS the submitter (persisted principal), not
+            # tenant-anonymous — resolution/tool access that becomes
+            # role-gated later must see the same identity as the original
+            # request (round-4 advisory)
+            tenant_ctx = _ctx_from_principal(
+                row["tenant_id"], row.get("principal"))
             self.jobs.jobs[row["id"]] = row
             # per-row isolation: one malformed leftover must not strand the
             # rest of the queue in 'pending' forever (review finding)
@@ -326,7 +368,8 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
                 batches_conn.select(where={"status": "in_progress"}):
             if row["id"] in self.batches:
                 continue
-            tenant_ctx = SecurityContext.anonymous(row["tenant_id"])
+            tenant_ctx = _ctx_from_principal(
+                row["tenant_id"], row.get("principal"))
             self.batches[row["id"]] = row
             try:
                 self._run_batch(tenant_ctx, row)
@@ -708,6 +751,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             "requests": [{"custom_id": it["custom_id"], "request": it["request"],
                           "result": None, "error": None}
                          for it in body["requests"]],
+            "principal": _principal_of(ctx),
             "created_at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
         }
         self.batches[batch_id] = batch
@@ -793,7 +837,8 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
 
     @staticmethod
     def _batch_view(batch: dict) -> dict:
-        return {k: v for k, v in batch.items() if k != "tenant_id"}
+        return {k: v for k, v in batch.items()
+                if k not in ("tenant_id", "principal")}
 
     async def handle_embeddings(self, request: web.Request):
         body = await read_json(request, schemas.EMBEDDING_REQUEST)

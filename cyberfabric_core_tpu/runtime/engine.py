@@ -216,9 +216,12 @@ class InferenceEngine:
         self._verify_fn: Optional[Callable] = None  # spec decode, on demand
         self._verify_accept_fn: Optional[Callable] = None  # draft mode
         self._draft = None  # DraftModel, built on first draft-mode request
-        #: cumulative speculative-decoding counters (observability surface)
+        #: cumulative speculative-decoding counters (observability surface);
+        #: accept_hist[a] counts verify rounds that accepted exactly a drafts
+        #: (the acceptance-length distribution the perf claim rests on)
         self.spec_stats = {"verify_calls": 0, "drafted": 0, "accepted": 0,
-                           "spec_tokens": 0, "fallback_steps": 0}
+                           "spec_tokens": 0, "fallback_steps": 0,
+                           "accept_hist": {}}
         self.last_prefill_compile_s: float = 0.0
 
     # ------------------------------------------------------------------ jit builders
@@ -355,7 +358,15 @@ class InferenceEngine:
                     f"{self.model_config.vocab_size} — speculation needs a "
                     "shared tokenizer")
             ckpt = self.config.draft_checkpoint
-            if ckpt and Path(ckpt).exists():
+            if ckpt:
+                if not Path(ckpt).exists():
+                    # never fall back silently: a typo'd path would yield a
+                    # random draft with ~zero acceptance — output stays
+                    # lossless, so the severe throughput regression would
+                    # surface nowhere (round-4 advisory, medium)
+                    raise ValueError(
+                        f"draft_checkpoint {ckpt!r} does not exist; unset it "
+                        "to run with synthetic draft weights (test mode)")
                 from .weights import load_llama_params
 
                 dparams = load_llama_params(ckpt, dcfg, dtype=self.dtype)
@@ -519,6 +530,8 @@ class InferenceEngine:
                     self.spec_stats["drafted"] += spec_k
                     self.spec_stats["accepted"] += a
                     self.spec_stats["spec_tokens"] += len(toks)
+                    hist = self.spec_stats["accept_hist"]
+                    hist[a] = hist.get(a, 0) + 1
                     L += a + 1
                 proposer.extend(toks)
                 for j, tok in enumerate(toks):
@@ -594,6 +607,8 @@ class InferenceEngine:
                     self.spec_stats["drafted"] += spec_k
                     self.spec_stats["accepted"] += a
                     self.spec_stats["spec_tokens"] += len(toks)
+                    hist = self.spec_stats["accept_hist"]
+                    hist[a] = hist.get(a, 0) + 1
                     L += a + 1
                 for j, tok in enumerate(toks):
                     if done[0]:

@@ -204,6 +204,13 @@ class DraftModel:
         # recompile on every new prompt length (seconds of TTFT on TPU).
         # Padded positions write garbage KV beyond len — masked (causal /
         # kv-length) until the sequential consume steps overwrite them.
+        if len(prompt_ids) > self.max_seq:
+            # public class: the engine guards this, direct callers deserve a
+            # clear error instead of an opaque JAX shape failure at
+            # ids.at[...].set (round-4 advisory)
+            raise ValueError(
+                f"prompt of {len(prompt_ids)} tokens exceeds the draft "
+                f"model's max_seq {self.max_seq}")
         n = max(1, len(prompt_ids))
         bucket = 16
         while bucket < n:

@@ -1,7 +1,13 @@
 """Architectural lint (dylint-equivalent enforcement, SURVEY §2.5).
 
-Reference analogue: dylint_lints/ (8 custom lint crates — DE01 contract
-purity, DE02 DTO containment, …). Python-tier rules enforced by AST scan:
+Reference analogue: dylint_lints/ — ALL 8 shipped families have a rule here
+(round-4 verdict item 5): DE01/DE02 (layer purity, L1-L5), DE03 (domain
+purity + domain-model marker), DE05 (client naming + contract versioning),
+DE07 (security, L6), DE08 (REST conventions, L7), DE09 (GTS id usage in
+source; the docs leg is apps/gts_docs_validator), DE13 (common patterns:
+no print in production code), plus EC01 (error catalog). Every new family
+carries a failing fixture (dylint ui-test parity). Python-tier rules
+enforced by AST scan:
 
 L1  modkit (the substrate) never imports upward (gateway/, modules/).
 L2  sqlite3 is touched ONLY by modkit/db.py — "no plain SQL outside the
@@ -262,3 +268,302 @@ def test_EC01_catalog_codes_are_actually_used():
     source = "\n".join(p.read_text() for p in PKG.rglob("*.py"))
     unused = [ns for ns in catalog if f"ERR.{ns}." not in source]
     assert not unused, f"catalog namespaces never referenced: {unused}"
+
+
+# --------------------------------------------------------------------------
+# DE03 — domain purity (round-4 verdict item 5).
+# Reference: dylint_lints/de03_domain_layer: DE0301 no-infra-in-domain,
+# DE0308 no-http-in-domain, DE0309 must-have-domain-model. The Python-tier
+# domain is the device/compute stack (runtime/, models/, ops/, parallel/):
+# pure serving logic that must stay transport- and storage-agnostic so it can
+# run under a gRPC worker, the REST host, or a bare script identically.
+
+_DOMAIN_TIERS = ("runtime", "models", "ops", "parallel")
+_TRANSPORT_TOPLEVEL = {"aiohttp", "grpc"}       # DE0308: HTTP/RPC types
+_INFRA_TOPLEVEL = {"sqlite3", "psycopg", "pymysql"}  # DE0301: storage drivers
+
+
+def _de03_violations(scan):
+    out = []
+    for path, mod, _ in scan:
+        top = mod.split(".")[0]
+        if top in _TRANSPORT_TOPLEVEL:
+            out.append((str(path), mod, "DE0308 transport type in domain"))
+        if top in _INFRA_TOPLEVEL:
+            out.append((str(path), mod, "DE0301 infrastructure in domain"))
+    return out
+
+
+def test_DE03_domain_tiers_are_transport_and_infra_free():
+    for tier in _DOMAIN_TIERS:
+        bad = _de03_violations(_scan(PKG / tier))
+        assert not bad, f"domain tier {tier}/ violates DE03: {bad}"
+
+
+def test_DE03_fixture_fails():
+    """The rule actually fires (dylint ui-test parity): a domain file that
+    imports aiohttp or sqlite3 must be flagged."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        bad_file = Path(d) / "domain_mod.py"
+        bad_file.write_text("import aiohttp\nimport sqlite3\n")
+        scan = [(bad_file, mod, names)
+                for level, mod, names in _imports(bad_file)]
+        bad = _de03_violations(scan)
+        assert len(bad) == 2, bad
+
+
+def _de03_model_violations(paths):
+    """DE0309 equivalent: domain DATA types (classes named *Config, *Params,
+    *Result, *Event, *Stats) must be @dataclass — the marker that keeps them
+    plain data, mirrors the reference's #[domain_model] attribute."""
+    suffixes = ("Config", "Params", "Result", "Event", "Stats")
+    out = []
+    for path in paths:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not node.name.endswith(suffixes):
+                continue
+            deco_names = {
+                (d.id if isinstance(d, ast.Name)
+                 else d.func.id if isinstance(d, ast.Call)
+                 and isinstance(d.func, ast.Name)
+                 else d.attr if isinstance(d, ast.Attribute) else "")
+                for d in node.decorator_list}
+            if not deco_names & {"dataclass"}:
+                out.append((str(path.name), node.name))
+    return out
+
+
+def test_DE03_domain_data_types_are_dataclasses():
+    paths = [p for tier in _DOMAIN_TIERS for p in (PKG / tier).rglob("*.py")]
+    bad = _de03_model_violations(paths)
+    assert not bad, f"domain data types missing @dataclass (DE0309): {bad}"
+
+
+def test_DE03_model_fixture_fails():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        f = Path(d) / "m.py"
+        f.write_text("class FooConfig:\n    pass\n")
+        assert _de03_model_violations([f]) == [("m.py", "FooConfig")]
+
+
+# --------------------------------------------------------------------------
+# DE05 — client naming + versioning (round-4 verdict item 5).
+# Reference: dylint_lints/de05_client_layer: DE0503 (client trait suffix
+# consistency in sdk crates), DE0504 (versioned public contracts). Here the
+# ClientHub-wired trait surface lives in modules/sdk.py with the *Api suffix
+# convention, and gRPC service contracts carry proto-style versioned names.
+
+
+def _de05_trait_suffix_violations(path):
+    """Every trait-like class (defines methods, not a @dataclass DTO) in the
+    SDK surface must use the Api suffix; mixed suffixes make the ClientHub
+    registry unreadable (DE0503 rationale)."""
+    out = []
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        deco = {(d.id if isinstance(d, ast.Name) else "")
+                for d in node.decorator_list}
+        if "dataclass" in deco:
+            continue  # DTOs are data, not client traits
+        has_methods = any(isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                          for n in node.body)
+        if has_methods and not node.name.endswith("Api"):
+            out.append(node.name)
+    return out
+
+
+def test_DE05_sdk_traits_use_the_api_suffix():
+    bad = _de05_trait_suffix_violations(PKG / "modules" / "sdk.py")
+    assert not bad, f"SDK traits without the Api suffix (DE0503): {bad}"
+
+
+def test_DE05_suffix_fixture_fails():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        f = Path(d) / "sdk.py"
+        f.write_text("class ThingPluginClient:\n    def call(self): ...\n")
+        assert _de05_trait_suffix_violations(f) == ["ThingPluginClient"]
+
+
+def test_DE05_hub_resolution_uses_contract_types():
+    """hub.get/try_get must resolve *Api contract types only — resolving a
+    concrete class through the hub bypasses the SDK seam."""
+    violations = []
+    for path in sorted((PKG / "modules").rglob("*.py")) + \
+            sorted((PKG / "gateway").rglob("*.py")):
+        for call in _calls(path):
+            fn = call.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in ("get", "try_get")):
+                continue
+            holder = fn.value
+            holder_name = (holder.id if isinstance(holder, ast.Name)
+                           else holder.attr if isinstance(holder, ast.Attribute)
+                           else "")
+            if "hub" not in holder_name:
+                continue
+            if not call.args:
+                continue
+            arg = call.args[0]
+            if isinstance(arg, ast.Name) and not arg.id.endswith("Api"):
+                violations.append(
+                    (str(path.relative_to(PKG)), call.lineno, arg.id))
+    assert not violations, (
+        f"ClientHub resolution of non-contract types (DE0503): {violations}")
+
+
+def _de05_service_version_violations(paths):
+    """DE0504 equivalent: every *_SERVICE contract name is versioned
+    (pkg.vN.Service) so parallel versions/upgrades stay expressible."""
+    import re as _re
+
+    pat = _re.compile(r"^[a-z][\w.]*\.v\d+\.\w+$")
+    out = []
+    for path in paths:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id.endswith("_SERVICE") \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str) \
+                        and not pat.match(node.value.value):
+                    out.append((str(path.name), tgt.id, node.value.value))
+    return out
+
+
+def test_DE05_grpc_service_contracts_are_versioned():
+    bad = _de05_service_version_violations(sorted(PKG.rglob("*.py")))
+    assert not bad, f"unversioned gRPC service contracts (DE0504): {bad}"
+
+
+def test_DE05_version_fixture_fails():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        f = Path(d) / "svc.py"
+        f.write_text('FOO_SERVICE = "foo.FooService"\n')
+        assert _de05_service_version_violations([f]) == [
+            ("svc.py", "FOO_SERVICE", "foo.FooService")]
+
+
+# --------------------------------------------------------------------------
+# DE09 — GTS identifier usage in source (round-4 verdict item 5).
+# Reference: dylint_lints/de09_gts_layer DE0901 (validate every GTS-looking
+# string literal in source). The docs leg (DE0903) is apps/gts_docs_validator.
+
+
+def _de09_gts_literal_violations(paths):
+    from cyberfabric_core_tpu.apps.gts_docs_validator import validate_gts_id
+
+    out = []
+    for path in paths:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        joined_consts = {
+            id(c) for node in ast.walk(tree) if isinstance(node, ast.JoinedStr)
+            for c in ast.walk(node) if isinstance(c, ast.Constant)}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Constant) or id(node) in joined_consts:
+                continue
+            v = node.value
+            if not isinstance(v, str):
+                continue
+            raw = v[6:] if v.startswith("gts://") else v
+            # complete-looking ids only: fragments/prefixes/regexes are not
+            # identifiers (the docs validator applies the same candidate rule)
+            if not raw.startswith("gts.") or raw.count(".") < 4 \
+                    or "*" in raw or "[" in raw or " " in raw:
+                continue
+            errors = validate_gts_id(raw)
+            if errors:
+                out.append((str(path.name), node.lineno, v, errors))
+    return out
+
+
+def test_DE09_gts_literals_in_source_are_valid():
+    paths = [p for p in sorted(PKG.rglob("*.py"))
+             if "gts_docs_validator" not in p.name]
+    bad = _de09_gts_literal_violations(paths)
+    assert not bad, f"malformed GTS identifiers in source (DE0901): {bad}"
+
+
+def test_DE09_fixture_fails():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        f = Path(d) / "g.py"
+        f.write_text('X = "gts.x.core.Bad_Vendor.thing.v1~"\n')
+        bad = _de09_gts_literal_violations([f])
+        assert bad and bad[0][2] == "gts.x.core.Bad_Vendor.thing.v1~"
+
+
+# --------------------------------------------------------------------------
+# DE13 — common patterns (round-4 verdict item 5).
+# Reference: dylint_lints/de13_common_patterns DE1301 no-print-macros:
+# production code logs through the logging host (per-module files, levels,
+# redaction) — a bare print() bypasses all of it.
+
+_DE13_EXEMPT_FILES = {"server.py", "__main__.py"}
+
+
+def _de13_print_violations(paths, pkg_root):
+    out = []
+    for path in paths:
+        if path.name in _DE13_EXEMPT_FILES or "apps" in path.parts:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        # statements under `if __name__ == "__main__":` and inside a
+        # top-level `def main(...)` CLI entry point are the sanctioned print
+        # surface (JSON-line tools; reference exempts bins the same way)
+        main_ranges = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.If):
+                t = node.test
+                if (isinstance(t, ast.Compare)
+                        and isinstance(t.left, ast.Name)
+                        and t.left.id == "__name__"):
+                    main_ranges.append((node.lineno, node.end_lineno))
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == "main":
+                main_ranges.append((node.lineno, node.end_lineno))
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                if any(a <= node.lineno <= b for a, b in main_ranges):
+                    continue
+                try:
+                    rel = str(path.relative_to(pkg_root))
+                except ValueError:
+                    rel = str(path.name)
+                out.append((rel, node.lineno))
+    return out
+
+
+def test_DE13_no_print_in_production_code():
+    bad = _de13_print_violations(sorted(PKG.rglob("*.py")), PKG)
+    assert not bad, (
+        f"print() in production code — use logging (DE1301): {bad}")
+
+
+def test_DE13_fixture_fails():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        f = Path(d) / "p.py"
+        f.write_text(
+            'print("leak")\n'
+            'if __name__ == "__main__":\n    print("ok: CLI surface")\n')
+        bad = _de13_print_violations([f], Path(d))
+        assert bad == [("p.py", 1)]

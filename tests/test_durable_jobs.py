@@ -120,10 +120,15 @@ def test_jobs_and_batches_survive_restart(tmp_path):
         "INSERT INTO llm_jobs (id, tenant_id, status, request, created_at, "
         "expires_at) VALUES ('job-interrupted', 'default', 'running', ?, "
         "'2026-01-01T00:00:00', '2099-01-01T00:00:00')", (req,))
+    # pending leftover carries the submitter's durable principal (round-4
+    # advisory: recovery must run AS the submitter, not tenant-anonymous)
+    principal = json.dumps({"subject": "user-42", "roles": ["llm-user"],
+                            "scopes": ["llm.run"]})
     conn.execute(
         "INSERT INTO llm_jobs (id, tenant_id, status, request, created_at, "
-        "expires_at) VALUES ('job-pending', 'default', 'pending', ?, "
-        "'2026-01-01T00:00:00', '2099-01-01T00:00:00')", (req,))
+        "expires_at, principal) VALUES ('job-pending', 'default', 'pending', "
+        "?, '2026-01-01T00:00:00', '2099-01-01T00:00:00', ?)",
+        (req, principal))
     reqs = json.dumps([
         {"custom_id": "done", "request": json.loads(req),
          "result": {"content": [{"type": "text", "text": "KEPT"}]},
@@ -184,3 +189,32 @@ def test_jobs_and_batches_survive_restart(tmp_path):
         loop.run_until_complete(second_boot())
     finally:
         loop.close()
+
+    # the submit path persisted a principal with the durable row (round-4
+    # advisory) — check the first boot's job row directly
+    conn = sqlite3.connect(db_file)
+    row = conn.execute("SELECT principal FROM llm_jobs WHERE id=?",
+                       (job_id,)).fetchone()
+    conn.close()
+    assert row is not None and row[0] is not None
+    assert json.loads(row[0])["subject"] == "anonymous"
+
+
+def test_ctx_from_principal_reconstruction():
+    """Recovery rebuilds the submitter's identity from the persisted
+    principal; legacy rows (no principal) fall back to tenant-anonymous."""
+    from cyberfabric_core_tpu.modules.llm_gateway.module import (
+        _ctx_from_principal, _principal_of)
+    from cyberfabric_core_tpu.modkit.security import SecurityContext
+
+    ctx = SecurityContext(subject="user-42", tenant_id="acme",
+                          token_scopes=("llm.run",), roles=("llm-user",))
+    rebuilt = _ctx_from_principal("acme", _principal_of(ctx))
+    assert rebuilt.subject == "user-42"
+    assert rebuilt.tenant_id == "acme"
+    assert rebuilt.roles == ("llm-user",)
+    assert rebuilt.token_scopes == ("llm.run",)
+    # tenant scoping still enforced — no bearer token is resurrected
+    assert rebuilt.bearer_token is None
+    legacy = _ctx_from_principal("acme", None)
+    assert legacy.subject == "anonymous" and legacy.tenant_id == "acme"

@@ -15,6 +15,7 @@ from cyberfabric_core_tpu.runtime import EngineConfig, InferenceEngine, Sampling
 from cyberfabric_core_tpu.runtime.speculative import NgramProposer, accept_length
 
 import jax
+import jax.numpy as jnp
 
 
 # ------------------------------------------------------------------- proposer
@@ -247,6 +248,70 @@ def test_draft_vocab_mismatch_fails_loudly(shared_params):
                   draft_checkpoint="")
     with pytest.raises(ValueError, match="vocab"):
         _tokens(eng, [1, 2, 3], max_tokens=4)
+
+
+def test_cross_model_draft_preserves_sampling_distribution(
+        shared_params, tmp_path):
+    """Leviathan acceptance with a CROSS-model draft (draft weights differ
+    from the target — real rejections, acceptance strictly between 0 and
+    100%) must leave the TARGET's sampling distribution intact: the
+    second-token marginal with speculation on matches plain decode under a
+    two-sample chi-square bound (round-4 verdict item 3)."""
+    from cyberfabric_core_tpu.runtime.weights import save_llama_params
+
+    cfg, params = shared_params
+    # cross draft = PERTURBED target (the distilled/quantized-draft regime:
+    # correlated but different — ~37% acceptance with rejections at every
+    # length). An independent random draft shares no top-k support with the
+    # target at these widths, so acceptance would be 0 and the sampler's
+    # correction path untested.
+    eps = 0.03
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(jax.random.PRNGKey(4242), len(leaves))
+    draft_params = jax.tree_util.tree_unflatten(treedef, [
+        l + eps * jnp.std(l) * jax.random.normal(k, l.shape, l.dtype)
+        for l, k in zip(leaves, keys)])
+    ckpt = tmp_path / "cross-draft"
+    save_llama_params(draft_params, cfg, ckpt)
+
+    prompt = list(range(20, 36))
+    N = 220
+    kw = dict(temperature=0.7, top_k=4, max_tokens=2)
+
+    def marginal(engine):
+        counts: dict[int, int] = {}
+        for seed in range(N):
+            [res] = engine.generate([prompt],
+                                    SamplingParams(seed=seed, **kw))
+            tok = res.token_ids[1]
+            counts[tok] = counts.get(tok, 0) + 1
+        return counts
+
+    plain_counts = marginal(_engine(shared_params, "off"))
+    spec = _draft_engine(shared_params, str(ckpt))
+    spec_counts = marginal(spec)
+
+    # the cross pair must actually reject: acceptance in (0, 100)
+    drafted = spec.spec_stats["drafted"]
+    accepted = spec.spec_stats["accepted"]
+    assert spec.spec_stats["verify_calls"] > 0
+    assert 0 < accepted < drafted, spec.spec_stats
+    # acceptance-length histogram is populated (observability surface)
+    assert sum(spec.spec_stats["accept_hist"].values()) == \
+        spec.spec_stats["verify_calls"]
+
+    # two-sample chi-square over the union support; threshold ~p=0.001 for
+    # the handful of top_k-limited categories so seeds can't flake the test
+    support = sorted(set(plain_counts) | set(spec_counts))
+    stat = 0.0
+    for t in support:
+        a, b = plain_counts.get(t, 0), spec_counts.get(t, 0)
+        exp = (a + b) / 2.0
+        if exp > 0:
+            stat += (a - exp) ** 2 / exp + (b - exp) ** 2 / exp
+    # dof ≈ |support|-1 (small); 40 is far beyond p=0.001 for dof<=12 —
+    # distribution drift (e.g. committing raw draft samples) blows well past
+    assert stat < 40.0, (stat, plain_counts, spec_counts)
 
 
 def test_random_draft_stays_lossless(shared_params):
