@@ -645,7 +645,8 @@ def cost_mode(model: str, quant: str) -> int:
         out = engine.decode_cost_analysis(batch=1)
         bpt = out.get("bytes_per_token")
         if bpt:
-            out["roofline_tok_s_at_819GBps"] = round(819e9 / bpt, 1)
+            out["roofline_tok_s_at_819GBps"] = round(
+                V5E_HBM_BYTES_PER_S / bpt, 1)
         print(json.dumps(out), flush=True)
         return 0
     except Exception as e:  # noqa: BLE001 — clean exit releases the relay claim
@@ -986,9 +987,13 @@ def spec_cross_mode() -> int:
         def measure(engine, temp: float) -> tuple[float, list[int]]:
             sp = SamplingParams(max_tokens=gen, temperature=temp, seed=11)
             toks: list[int] = []
-            # warmup/compile outside the clock
+            # warmup/compile outside the clock — and outside the EVIDENCE:
+            # reset the cumulative spec counters so the reported acceptance
+            # histogram covers exactly the labeled gen_tokens run
             engine.generate([prompt], SamplingParams(max_tokens=8,
                                                      temperature=temp, seed=11))
+            for k in engine.spec_stats:
+                engine.spec_stats[k] = {} if k == "accept_hist" else 0
             t0 = time.monotonic()
             first = None
             for ev in engine.generate_stream([prompt], sp):

@@ -150,6 +150,15 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         num_kv_heads=2, head_dim=16, max_position=256, rope_theta=10000.0,
         rms_norm_eps=1e-5,
     ),
+    "tiny-llama-outlier": ModelConfig(
+        # tiny-llama-golden geometry with OUTLIER-INJECTED fixture weights
+        # (tests/golden/generate_fixtures.py): the non-Gaussian heavy-tail
+        # regime the quantization accuracy bounds are proven on
+        name="tiny-llama-outlier", architecture="llama", vocab_size=512,
+        hidden_size=64, intermediate_size=128, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, max_position=256, rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+    ),
     "tiny-qwen2-golden": ModelConfig(
         name="tiny-qwen2-golden", architecture="llama", vocab_size=512,
         hidden_size=64, intermediate_size=128, num_layers=2, num_heads=4,

@@ -50,7 +50,7 @@ def _walk(tree: dict, prefix: str = ""):
 
 
 def tp_plan(
-    model: str,
+    model: str | ModelConfig,
     tp: int,
     *,
     ep: int = 1,
@@ -70,7 +70,10 @@ def tp_plan(
     """
     from .sharding import sharded_abstract_params
 
-    cfg = get_config(model)
+    # a ModelConfig passes through directly — the load rehearsal plans over
+    # scaled geometries that aren't registry entries
+    cfg = model if isinstance(model, ModelConfig) else get_config(model)
+    model = cfg.name
     if cfg.num_kv_heads % tp and tp % cfg.num_kv_heads:
         raise ValueError(
             f"{model}: num_kv_heads={cfg.num_kv_heads} and tp={tp} divide "

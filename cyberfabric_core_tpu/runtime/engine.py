@@ -222,6 +222,16 @@ class InferenceEngine:
         self.spec_stats = {"verify_calls": 0, "drafted": 0, "accepted": 0,
                            "spec_tokens": 0, "fallback_steps": 0,
                            "accept_hist": {}}
+
+    def _record_spec_round(self, a: int, spec_k: int, committed: int) -> None:
+        """One verify round's evidence — shared by the ngram and draft paths
+        so the acceptance stats can never drift between them."""
+        s = self.spec_stats
+        s["verify_calls"] += 1
+        s["drafted"] += spec_k
+        s["accepted"] += a
+        s["spec_tokens"] += committed
+        s["accept_hist"][a] = s["accept_hist"].get(a, 0) + 1
         self.last_prefill_compile_s: float = 0.0
 
     # ------------------------------------------------------------------ jit builders
@@ -526,12 +536,7 @@ class InferenceEngine:
                     outs = np.asarray(outs_dev, np.int32)[0].tolist()
                     a = accept_length(drafts, outs)
                     toks = drafts[:a] + [outs[a]]
-                    self.spec_stats["verify_calls"] += 1
-                    self.spec_stats["drafted"] += spec_k
-                    self.spec_stats["accepted"] += a
-                    self.spec_stats["spec_tokens"] += len(toks)
-                    hist = self.spec_stats["accept_hist"]
-                    hist[a] = hist.get(a, 0) + 1
+                    self._record_spec_round(a, spec_k, len(toks))
                     L += a + 1
                 proposer.extend(toks)
                 for j, tok in enumerate(toks):
@@ -603,12 +608,7 @@ class InferenceEngine:
                     else:
                         draft.len += spec_k
                         draft.consume([drafts[-1]], temperature, top_p, top_k)
-                    self.spec_stats["verify_calls"] += 1
-                    self.spec_stats["drafted"] += spec_k
-                    self.spec_stats["accepted"] += a
-                    self.spec_stats["spec_tokens"] += len(toks)
-                    hist = self.spec_stats["accept_hist"]
-                    hist[a] = hist.get(a, 0) + 1
+                    self._record_spec_round(a, spec_k, len(toks))
                     L += a + 1
                 for j, tok in enumerate(toks):
                     if done[0]:
