@@ -5,12 +5,15 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint fuzz sanitizers contracts test native aot-tpu
+.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu
 
-safety: lint fuzz sanitizers contracts aot-tpu  ## the full local gate
+safety: lint modelcheck fuzz sanitizers contracts aot-tpu  ## the full local gate
 
 lint:  ## architectural lints (dylint equivalent: all 8 families, DE01-DE13 + EC01) + license audit (deny.toml parity)
 	$(PY) -m pytest tests/test_arch_lint.py tests/test_license_audit.py -q
+
+modelcheck:  ## bounded model checking of the paged-pool ownership protocol (kani parity)
+	$(PY) -m pytest tests/test_model_check_pool.py -q
 
 fuzz:  ## parser fuzzing: property layer + coverage-guided mutation w/ corpus
 	FUZZ_EXAMPLES=2000 $(PY) -m pytest tests/test_odata_fuzz.py -q

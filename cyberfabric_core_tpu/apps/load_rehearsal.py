@@ -107,12 +107,19 @@ def main(argv: list[str] | None = None) -> int:
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         proc = subprocess.run([sys.executable, "-c", code], env=env,
                               capture_output=True, text=True, timeout=1800)
+        manifest_file = stage / "manifest.jsonl"
         report["interrupted_leg"] = {
             "exit_code": proc.returncode,
             "crashed_as_planned": proc.returncode == 41,
-            "manifest_lines_surviving": sum(
-                1 for _ in open(stage / "manifest.jsonl")),
+            "manifest_lines_surviving": (
+                sum(1 for _ in open(manifest_file))
+                if manifest_file.exists() else 0),
         }
+        if proc.returncode != 41:
+            # the real cause must land in the artifact, not vanish with the
+            # captured pipe — an undiagnosable LOAD_70B.json helps no one
+            report["interrupted_leg"]["stderr_tail"] = \
+                (proc.stderr or "")[-400:]
 
         # ---- leg 2: resume in THIS process: skips completed work, reads
         # the rest, then the landed bytes must match the plan exactly

@@ -222,6 +222,7 @@ class InferenceEngine:
         self.spec_stats = {"verify_calls": 0, "drafted": 0, "accepted": 0,
                            "spec_tokens": 0, "fallback_steps": 0,
                            "accept_hist": {}}
+        self.last_prefill_compile_s: float = 0.0
 
     def _record_spec_round(self, a: int, spec_k: int, committed: int) -> None:
         """One verify round's evidence — shared by the ngram and draft paths
@@ -232,7 +233,6 @@ class InferenceEngine:
         s["accepted"] += a
         s["spec_tokens"] += committed
         s["accept_hist"][a] = s["accept_hist"].get(a, 0) + 1
-        self.last_prefill_compile_s: float = 0.0
 
     # ------------------------------------------------------------------ jit builders
     def _build_prefill(self) -> Callable:

@@ -272,25 +272,36 @@ class PrefixKVPool:
         full = T // self.page_size
         tail = T - full * self.page_size
         chain = self.store_prefill(prompt_ids, cached_pages, kv)
-        private: list[int] = []
+        # Ref IMMEDIATELY, before any further allocation: the tail/private
+        # allocs below can trigger tree eviction, and on a full pool the
+        # evictor may pick THIS request's just-inserted (unpinned) entry —
+        # un-ref'd, its pages would free and re-allocate into the same
+        # chain as the tail page (chain [p, p]: the slot then decodes over
+        # its own prefix KV). Found by the bounded model checker
+        # (tests/test_model_check_pool.py, invariant I5).
+        self.ref_pages(chain)
+        refed = list(chain)
         try:
             if len(chain) < full:
                 # tree store skipped (pool pressure): hold the remaining full
                 # pages privately so the slot can still decode
                 missing = full - len(chain)
                 ids = self._alloc(missing)
-                private.extend(ids)
+                self.ref_pages(ids)
+                refed.extend(ids)
                 self._scatter_full_pages(kv, ids, len(chain) * self.page_size)
                 chain = chain + ids
             if tail:
                 tid = self._alloc(1)[0]
-                private.append(tid)
+                self.ref_pages([tid])
+                refed.append(tid)
                 self.scatter_tail(kv, full * self.page_size, tid)
                 chain = chain + [tid]
         except Exception:
-            self.allocator.free([p - self._page_offset for p in private])
+            # unref everything this admission holds — tree-owned pages stay
+            # cached, private ones return to the allocator
+            self.unref_pages(refed)
             raise
-        self.ref_pages(chain)
         return chain
 
     def extend_chain(self, chain: list[int], length_needed: int) -> list[int]:
