@@ -12,8 +12,8 @@ safety: lint modelcheck fuzz sanitizers contracts aot-tpu  ## the full local gat
 lint:  ## architectural lints (dylint equivalent: all 8 families, DE01-DE13 + EC01) + license audit (deny.toml parity)
 	$(PY) -m pytest tests/test_arch_lint.py tests/test_license_audit.py -q
 
-modelcheck:  ## bounded model checking of the paged-pool ownership protocol (kani parity)
-	$(PY) -m pytest tests/test_model_check_pool.py -q
+modelcheck:  ## kani parity: exhaustive pool-protocol model check + scheduler admission invariant walks
+	$(PY) -m pytest tests/test_model_check_pool.py tests/test_model_check_scheduler.py -q
 
 fuzz:  ## parser fuzzing: property layer + coverage-guided mutation w/ corpus
 	FUZZ_EXAMPLES=2000 $(PY) -m pytest tests/test_odata_fuzz.py -q

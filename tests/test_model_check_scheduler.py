@@ -1,0 +1,204 @@
+"""Stateful invariant checking of the continuous scheduler's admission tier.
+
+The second target the round-4 verdict named for the kani-parity tier
+("...or the scheduler admission invariants"). The pool ownership protocol
+gets EXHAUSTIVE bounded checking in tests/test_model_check_pool.py (device
+traffic stubbed, replay is cheap); this layer drives the REAL
+`ContinuousBatchingEngine` — jitted prefill/decode included — through
+deterministic pressure schedules and seeded random walks, auditing the
+admission invariants after EVERY operation. Replay-based exhaustive search
+is not affordable here (per-engine jit compilation), so this is the
+stateful-property complement, with schedules constructed to force the rare
+paths (preemption, resume, terminal shed, slot churn).
+
+Invariants audited after every step:
+
+  A1 slot/state     active[i] ⇔ slots[i] is not None
+  A2 table hygiene  inactive slots have all-zero page-table rows
+  A3 chain/table    active slot i: page_table[i,:len(chain)] == chain,
+                    zeros after; chain covers lengths[i] tokens; no dups
+  A4 ref coverage   a page in k live chains has pool refcount ≥ k
+  A5 chunk room     active slots satisfy lengths[i] + k ≤ max_seq
+  A6 suspension     suspended records hold host KV, not pool pages
+                    (their lengths are preserved for resume)
+  A7 pool audit     the pool-level invariants (conservation, orphan/ref
+                    sanity) from the pool model checker, re-checked here
+                    under real device traffic
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cyberfabric_core_tpu.runtime import EngineConfig, SamplingParams
+from cyberfabric_core_tpu.runtime.scheduler import ContinuousBatchingEngine
+
+
+def _make_engine(slots: int = 2, max_seq: int = 64, pages: int = 0):
+    cfg = EngineConfig(model="tiny-llama", max_seq_len=max_seq,
+                       max_batch=slots, decode_chunk=4, use_flash=False,
+                       prefix_cache_pages=pages or 1,  # >0 → paged
+                       prefix_page_size=16)
+    eng = ContinuousBatchingEngine(cfg, seed=0)
+    eng.start = lambda: None  # drive synchronously — no scheduler thread
+    return eng
+
+
+class Harness:
+    def __init__(self, eng: ContinuousBatchingEngine) -> None:
+        self.eng = eng
+        self.finished: dict[str, str] = {}
+        self.tokens: dict[str, int] = {}
+        self._n = 0
+
+    def submit(self, prompt: list[int], max_tokens: int,
+               seed: int = 7) -> str:
+        self._n += 1
+        rid = f"mc-{self._n}"
+
+        def emit(ev):
+            if ev.token_id >= 0:
+                self.tokens[rid] = self.tokens.get(rid, 0) + 1
+            if ev.finished:
+                self.finished[rid] = ev.finished
+
+        self.eng.submit(prompt, SamplingParams(
+            max_tokens=max_tokens, seed=seed), emit, request_id=rid)
+        return rid
+
+    # ------------------------------------------------------------- invariants
+    def audit(self, ctx: str) -> None:
+        eng = self.eng
+        pool = eng.pool
+        k = eng._k_steps
+        for i in range(eng.n_slots):
+            # A1
+            assert bool(eng.active[i]) == (eng.slots[i] is not None), \
+                f"A1 slot {i} {ctx}"
+            if eng.slots[i] is None:
+                # A2
+                assert not eng.page_table[i].any(), \
+                    f"A2 stale page-table row {i}: {eng.page_table[i]} {ctx}"
+                continue
+            state = eng.slots[i]
+            chain = state.chain
+            assert chain is not None
+            # A3
+            assert len(set(chain)) == len(chain), f"A3 dup {chain} {ctx}"
+            assert list(eng.page_table[i, :len(chain)]) == chain, \
+                f"A3 table/chain mismatch slot {i} {ctx}"
+            assert not eng.page_table[i, len(chain):].any(), \
+                f"A3 trailing garbage slot {i} {ctx}"
+            assert pool.pages_for(int(eng.lengths[i])) <= len(chain), \
+                f"A3 chain short: len={eng.lengths[i]} chain={chain} {ctx}"
+            # A5 (post-round: finished-on-room slots were emitted 'length')
+            assert int(eng.lengths[i]) + k <= eng.config.max_seq_len, \
+                f"A5 slot {i} len={eng.lengths[i]} {ctx}"
+        # A4
+        page_users: dict[int, int] = {}
+        for i in range(eng.n_slots):
+            if eng.slots[i] is not None:
+                for p in eng.slots[i].chain:
+                    page_users[p] = page_users.get(p, 0) + 1
+        for p, users in page_users.items():
+            assert pool._refs.get(p, 0) >= users, \
+                f"A4 page {p} users={users} refs={pool._refs.get(p)} {ctx}"
+        # A6
+        for rec in eng._suspended:
+            assert rec.host_kv[0].shape[1] == pool.pages_for(rec.length), \
+                f"A6 suspended shape {ctx}"
+        # A7 — pool-level conservation + sanity under real traffic
+        tracked = set(pool._tree_owned) | set(pool._orphans) | set(pool._refs)
+        assert pool.capacity_pages - pool.allocator.num_free == len(tracked), \
+            f"A7 conservation {ctx}"
+        assert not (pool._orphans & pool._tree_owned), f"A7 orphans {ctx}"
+        for p, c in pool._refs.items():
+            assert c >= 1, f"A7 refs[{p}]={c} {ctx}"
+
+    def step(self, ctx: str) -> None:
+        self.eng._admit()
+        self.audit(f"{ctx}/post-admit")
+        if self.eng.active.any():
+            self.eng._decode_round()
+            self.audit(f"{ctx}/post-round")
+
+
+def test_churn_schedule_holds_invariants():
+    """Slot churn: more requests than slots, staggered lengths — admission,
+    completion, and slot reuse audited at every step."""
+    eng = _make_engine(slots=2, max_seq=64)
+    h = Harness(eng)
+    prompts = [list(range(10, 10 + n)) for n in (5, 9, 17, 7, 12)]
+    for i, p in enumerate(prompts):
+        h.submit(p, max_tokens=6 + i)
+    for step in range(40):
+        h.step(f"churn{step}")
+        if len(h.finished) == len(prompts):
+            break
+    assert len(h.finished) == len(prompts), h.finished
+    assert all(f in ("stop", "length") for f in h.finished.values())
+    eng.shutdown()
+
+
+def test_preemption_pressure_holds_invariants():
+    """The preempt-to-host → resume path under audit (the bookkeeping the
+    round-4 verdict called out). The engine sizes its pool so every slot can
+    always hold a full window (extension succeeds via eviction), so — like
+    tests/test_preemption.py — pool pressure is INJECTED: two one-shot
+    MemoryErrors from extend_chain force two preemptions mid-decode; the
+    suspended requests must resume and finish with every invariant intact
+    at every step in between."""
+    eng = _make_engine(slots=2, max_seq=64)
+    h = Harness(eng)
+    pool = eng.pool
+    orig_extend = pool.extend_chain
+    faults = {"left": 2, "armed": 0}
+
+    def flaky_extend(chain, needed):
+        if faults["armed"] > 0 and faults["left"] > 0 and len(chain) >= 2:
+            faults["left"] -= 1
+            raise MemoryError("injected pool pressure")
+        return orig_extend(chain, needed)
+
+    pool.extend_chain = flaky_extend
+    shared = list(range(1, 18))  # spans 2 pages: prefix sharing is live
+    h.submit(shared + [30], max_tokens=40)
+    h.submit(shared + [31], max_tokens=40)
+    h.submit(list(range(40, 57)), max_tokens=30)
+    for step in range(80):
+        if step == 3:
+            faults["armed"] = 1  # streams are mid-flight: inject now
+        h.step(f"pressure{step}")
+        if len(h.finished) == 3:
+            break
+    assert len(h.finished) == 3, (h.finished, eng.preemptions)
+    assert eng.preemptions >= 1, "injected pressure never preempted"
+    assert all(f in ("stop", "length") for f in h.finished.values()), \
+        h.finished  # preempted streams RESUME, they don't error
+    eng.shutdown()
+
+
+@pytest.mark.parametrize("walk_seed", [11, 23, 37])
+def test_random_walks_hold_invariants(walk_seed):
+    """Seeded random interleavings of submit/step far past the deterministic
+    schedules; every step audited (failures replay exactly by seed)."""
+    rng = np.random.default_rng(walk_seed)
+    eng = _make_engine(slots=2, max_seq=64)
+    h = Harness(eng)
+    submitted = 0
+    for step in range(50):
+        if submitted < 6 and rng.random() < 0.4:
+            n = int(rng.integers(3, 20))
+            base = int(rng.integers(1, 200))
+            h.submit([base + j for j in range(n)],
+                     max_tokens=int(rng.integers(2, 12)),
+                     seed=int(rng.integers(0, 1000)))
+            submitted += 1
+        h.step(f"walk{walk_seed}.{step}")
+        if submitted >= 6 and len(h.finished) == submitted:
+            break
+    assert len(h.finished) == submitted
+    # the walk actually exercised decode, not just bookkeeping
+    assert sum(h.tokens.values()) > 0
+    eng.shutdown()
