@@ -61,6 +61,10 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.fh_cache_insert.restype = ctypes.c_int32
             lib.fh_cache_insert.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int32,
                                             i32p, ctypes.c_int32]
+            lib.fh_cache_insert2.restype = ctypes.c_int32
+            lib.fh_cache_insert2.argtypes = [ctypes.c_void_p, i32p,
+                                             ctypes.c_int32, i32p,
+                                             ctypes.c_int32, i32p, i32p]
             lib.fh_cache_evict.restype = ctypes.c_int32
             lib.fh_cache_evict.argtypes = [ctypes.c_void_p, ctypes.c_int32, i32p]
             lib.fh_cache_stats.argtypes = [ctypes.c_void_p, i64p]
@@ -193,13 +197,36 @@ class PrefixCache:
         return
 
     def insert(self, tokens: list[int], pages: list[int]) -> int:
+        """Record ``pages`` for ``tokens``; returns the count newly taken.
+        Count-only fast path (no unused-output buffer) — callers that must
+        know WHICH pages were declined use insert_tracked."""
         if self._lib is not None:
             t, p = _as_i32(tokens), _as_i32(pages)
             return self._lib.fh_cache_insert(self._handle, _ptr(t), len(t),
                                              _ptr(p), len(p))
+        added, _ = self.insert_tracked(tokens, pages)
+        return added
+
+    def insert_tracked(self, tokens: list[int],
+                       pages: list[int]) -> tuple[int, list[int]]:
+        """Insert and report (added, unused_pages): the tree consumes a
+        caller page only at positions it creates a node for, so pages at
+        already-cached positions come back in ``unused`` — the caller owns
+        freeing them. A bare count cannot express WHICH pages were taken
+        when another insert raced the same prefix (the sanitizer exercise
+        leaked pages under exactly that interleaving)."""
+        if self._lib is not None:
+            t, p = _as_i32(tokens), _as_i32(pages)
+            out = np.empty(max(1, len(p)), np.int32)
+            n_unused = np.zeros(1, np.int32)
+            added = self._lib.fh_cache_insert2(
+                self._handle, _ptr(t), len(t), _ptr(p), len(p),
+                _ptr(out), _ptr(n_unused))
+            return int(added), out[: int(n_unused[0])].tolist()
         toks = list(tokens)
         usable = min(len(toks) // self.page_size, len(pages))
         node, added = self._root, 0
+        unused: list[int] = []
         self._clock += 1
         for i in range(usable):
             key = tuple(toks[i * self.page_size:(i + 1) * self.page_size])
@@ -212,8 +239,10 @@ class PrefixCache:
                 self._stats[0] += 1
             else:
                 child["used"] = self._clock
+                unused.append(pages[i])
             node = child
-        return added
+        unused.extend(pages[usable:])  # past the usable span: never candidates
+        return added, unused
 
     def evict(self, target_pages: int) -> list[int]:
         if self._lib is not None:

@@ -220,8 +220,17 @@ class PrefixKVPool:
             self.allocator.free([p - self._page_offset for p in new_ids])
             raise
         chain = list(cached_pages) + new_ids
-        self.tree.insert(prompt_ids[: total_pages * self.page_size], chain)
-        self._tree_owned.update(new_ids)
+        _, unused = self.tree.insert_tracked(
+            prompt_ids[: total_pages * self.page_size], chain)
+        # Single-threaded (match pinned the prefix just above) the tree
+        # consumes exactly new_ids and ``unused`` == cached_pages. Handle
+        # the general contract anyway: a new page the tree declined (the
+        # position was already cached) stays PRIVATE to this chain —
+        # refcounted by the slot, never tree-owned — instead of being
+        # mislabeled as shared (insert_tracked exists because a count-only
+        # contract leaked pages in the sanitizer exercise).
+        declined = set(unused)
+        self._tree_owned.update(p for p in new_ids if p not in declined)
         self.admissions += 1
         return chain
 

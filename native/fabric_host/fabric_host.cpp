@@ -113,11 +113,16 @@ int32_t fh_cache_match(void* c_, const int32_t* tokens, int32_t n,
         auto it = node->children.find(key);
         if (it == node->children.end()) break;
         Node* child = it->second.get();
+        // stop at REPORT capacity, not just silently truncate: callers
+        // release by the returned page count, so a node matched-but-not-
+        // reported would stay pinned forever (a pin leak the sanitizer
+        // exercise hit via a mismatched prototype passing garbage max_out)
+        if (out_n + static_cast<int32_t>(child->pages.size()) > max_out) break;
         pos += c->page_size;
         node = child;
         path.push_back(child);
         for (int32_t p : child->pages) {
-            if (out_n < max_out) out_pages[out_n++] = p;
+            out_pages[out_n++] = p;
         }
         child->last_used = c->clock;
     }
@@ -145,9 +150,19 @@ void fh_cache_release(void* c_, const int32_t* tokens, int32_t n) {
 
 // Insert the page list for tokens[0..n) (n must be a multiple of page_size for
 // full coverage; trailing partial pages are not cached). Existing shared
-// prefixes are deduplicated structurally. Returns pages newly recorded.
-int32_t fh_cache_insert(void* c_, const int32_t* tokens, int32_t n,
-                        const int32_t* pages, int32_t n_pages) {
+// prefixes are deduplicated structurally.
+//
+// The tree consumes pages[i] only at positions it CREATES a node for; at
+// positions that already exist (another request cached the same prefix) the
+// caller's page is NOT consumed and the caller must free it. Under
+// concurrent same-prefix inserts that consumed set is an arbitrary subset of
+// the caller's list, so a count alone cannot tell the caller what to free —
+// that contract unsoundness leaked pages in the sanitizer exercise. insert2
+// therefore reports the unconsumed pages explicitly (out_unused must have
+// room for n_pages entries); returns the number of pages newly recorded.
+int32_t fh_cache_insert2(void* c_, const int32_t* tokens, int32_t n,
+                         const int32_t* pages, int32_t n_pages,
+                         int32_t* out_unused, int32_t* n_unused) {
     auto* c = static_cast<PrefixCache*>(c_);
     std::lock_guard<std::mutex> lock(c->mu);
     c->clock++;
@@ -157,12 +172,14 @@ int32_t fh_cache_insert(void* c_, const int32_t* tokens, int32_t n,
     usable_tokens = usable_pages * c->page_size;
 
     Node* node = &c->root;
-    int32_t pos = 0, page_idx = 0, added = 0;
+    int32_t pos = 0, page_idx = 0, added = 0, unused = 0;
     while (pos < usable_tokens) {
         std::vector<int32_t> key(tokens + pos, tokens + pos + c->page_size);
         auto it = node->children.find(key);
         if (it != node->children.end()) {
             Node* child = it->second.get();
+            if (out_unused != nullptr) out_unused[unused] = pages[page_idx];
+            unused++;
             pos += c->page_size;
             page_idx += 1;
             node = child;
@@ -182,7 +199,20 @@ int32_t fh_cache_insert(void* c_, const int32_t* tokens, int32_t n,
         added++;
         c->cached_pages++;
     }
+    // pages past the usable token span were never candidates — unconsumed too
+    for (int32_t i = usable_pages; i < n_pages; ++i) {
+        if (out_unused != nullptr) out_unused[unused] = pages[i];
+        unused++;
+    }
+    if (n_unused != nullptr) *n_unused = unused;
     return added;
+}
+
+// Legacy entry point: count only (callers that track consumption themselves,
+// e.g. the single-threaded host where match immediately precedes insert).
+int32_t fh_cache_insert(void* c_, const int32_t* tokens, int32_t n,
+                        const int32_t* pages, int32_t n_pages) {
+    return fh_cache_insert2(c_, tokens, n, pages, n_pages, nullptr, nullptr);
 }
 
 // LRU-evict unpinned leaf pages until target_pages reclaimed; freed page ids are

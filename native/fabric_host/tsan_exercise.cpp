@@ -27,10 +27,11 @@ int32_t fh_alloc_num_free(void* a);
 void* fh_cache_new(int32_t page_size);
 void fh_cache_free(void* c);
 int32_t fh_cache_match(void* c, const int32_t* tokens, int32_t n,
-                       int32_t* out_pages);
+                       int32_t* out_pages, int32_t max_out);
 void fh_cache_release(void* c, const int32_t* tokens, int32_t n);
-int32_t fh_cache_insert(void* c, const int32_t* tokens, int32_t n,
-                        const int32_t* pages, int32_t n_pages);
+int32_t fh_cache_insert2(void* c, const int32_t* tokens, int32_t n,
+                         const int32_t* pages, int32_t n_pages,
+                         int32_t* out_unused, int32_t* n_unused);
 int32_t fh_cache_evict(void* c, int32_t target_pages, int32_t* out_pages);
 void fh_cache_stats(void* c, int64_t* out4);
 }
@@ -80,11 +81,18 @@ void hammer_cache(void* cache, void* alloc, unsigned seed) {
             if (n_ev > 0) fh_free_pages(alloc, evicted, n_ev);
             continue;
         }
-        int32_t kept = fh_cache_insert(cache, tokens.data(), n_tok, pages, npages);
-        if (kept < npages) {  // duplicate suffix: surplus pages come back
-            fh_free_pages(alloc, pages + kept, npages - kept);
+        // insert2 reports exactly which pages the tree did NOT consume —
+        // under concurrent same-prefix inserts the consumed positions are an
+        // arbitrary subset, so freeing by count (the old contract) freed
+        // tree-owned pages and leaked ours
+        int32_t unused[8];
+        int32_t n_unused = 0;
+        fh_cache_insert2(cache, tokens.data(), n_tok, pages, npages,
+                         unused, &n_unused);
+        if (n_unused > 0) {
+            fh_free_pages(alloc, unused, n_unused);
         }
-        int32_t hits = fh_cache_match(cache, tokens.data(), n_tok, matched);
+        int32_t hits = fh_cache_match(cache, tokens.data(), n_tok, matched, 64);
         if (hits < 0 || hits > npages) {
             std::fprintf(stderr, "match returned %d for %d pages\n", hits, npages);
             failures.fetch_add(1);
@@ -117,8 +125,10 @@ int main() {
     int32_t free_pages = fh_alloc_num_free(alloc);
     int64_t stats[4];
     fh_cache_stats(cache, stats);
-    std::printf("tsan exercise: free=%d/%d evicted_at_end=%d failures=%d\n",
-                free_pages, kPages, n_ev, failures.load());
+    std::printf("tsan exercise: free=%d/%d evicted_at_end=%d failures=%d "
+                "cached_after_drain=%lld\n",
+                free_pages, kPages, n_ev, failures.load(),
+                static_cast<long long>(stats[0]));
 
     fh_cache_free(cache);
     fh_alloc_free(alloc);

@@ -98,7 +98,14 @@ def test_native_python_parity():
         n_pages = len(seq) // 4
         pages = list(range(page, page + n_pages))
         page += n_pages
-        assert nat.insert(seq, pages) == pyt.insert(seq, pages)
+        # insert_tracked parity covers the OWNERSHIP-critical surface: the
+        # unused list tells store_prefill which pages the tree declined —
+        # a native/fallback divergence here mislabels page ownership
+        a1, u1 = nat.insert_tracked(seq, pages)
+        a2, u2 = pyt.insert_tracked(seq, pages)
+        assert (a1, u1) == (a2, u2), f"insert_tracked diverged for {seq}"
+        # every caller page is either consumed or reported back — never both
+        assert a1 + len(u1) == len(pages), (a1, u1, pages)
     assert nat.stats()["cached_pages"] == pyt.stats()["cached_pages"]
 
 
