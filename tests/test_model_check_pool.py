@@ -189,9 +189,13 @@ def _replay(trace: tuple) -> Model:
 
 
 def test_exhaustive_bounded_model_check():
-    """Every op interleaving to depth 5, invariants audited at every state —
-    within the bound, a proof over the real allocator/tree/refcount code."""
-    depth = 5
+    """Every op interleaving to the depth bound, invariants audited at every
+    state — within the bound, a proof over the real allocator/tree/refcount
+    code. CI runs depth 5 (~3k states, seconds); MODELCHECK_DEPTH=6 is the
+    deeper offline bound (~25k states)."""
+    import os
+
+    depth = int(os.environ.get("MODELCHECK_DEPTH", "5"))
     frontier: list[tuple] = [()]
     states = 0
     for _ in range(depth):
