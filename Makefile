@@ -9,8 +9,14 @@ export JAX_PLATFORMS ?= cpu
 
 safety: lint modelcheck fuzz sanitizers contracts aot-tpu  ## the full local gate
 
-lint:  ## architectural lints (dylint equivalent: all 8 families, DE01-DE13 + EC01) + license audit (deny.toml parity)
-	$(PY) -m pytest tests/test_arch_lint.py tests/test_license_audit.py -q
+LINT_SARIF ?= build/fabric_lint.sarif
+
+lint:  ## fabric-lint (AS/JP/LK + migrated DE01-DE13 + EC01 families, SARIF artifact) + pytest driver + license audit (deny.toml parity)
+	@mkdir -p $(dir $(LINT_SARIF))
+	$(PY) -m cyberfabric_core_tpu.apps.fabric_lint cyberfabric_core_tpu \
+		--format sarif --output $(LINT_SARIF)
+	$(PY) -m pytest tests/test_arch_lint.py tests/test_fabric_lint.py \
+		tests/test_license_audit.py -q -m "not slow"
 
 modelcheck:  ## kani parity: exhaustive pool-protocol model check + scheduler admission invariant walks
 	$(PY) -m pytest tests/test_model_check_pool.py tests/test_model_check_scheduler.py -q

@@ -1,0 +1,40 @@
+"""fabric-lint — standalone AST/dataflow analyzer for the fabric codebase.
+
+Reference analogue: the dylint workspace (8 custom families denied
+workspace-wide on top of clippy pedantic). The grep/AST tier in
+tests/test_arch_lint.py enforced layer purity but could not see *inside*
+``async def`` bodies or ``jax.jit``-traced functions, where the real serving
+hazards live. fabric-lint is the engine those checks migrated onto, plus
+three semantic families the old tier could not express:
+
+- **AS — async-safety**: blocking calls on the event loop, fire-and-forget
+  tasks that black-hole exceptions, ``await`` under a sync lock.
+- **JP — jit-purity**: host side effects (print/logging), host ``np.*`` on
+  traced arguments, and captured-state mutation inside jit-traced functions.
+- **LK — lock-discipline**: writes to lock-guarded attributes of the
+  scheduler/pool classes outside their declared lock scopes.
+- **DE/EC — design/error-catalog**: the migrated DE01–DE13 + EC01 families.
+
+Usage:
+    python -m cyberfabric_core_tpu.apps.fabric_lint PATH...
+        [--select AS,JP01] [--format text|json|sarif] [--output FILE]
+        [--baseline FILE] [--no-default-baseline] [--list-rules]
+
+Findings are suppressed inline with::
+
+    # fabric-lint: waive AS01 reason=sync engine thread by design
+
+or collectively through a committed baseline file
+(config/fabric_lint_baseline.json).
+"""
+
+from .engine import (  # noqa: F401
+    Engine,
+    FileContext,
+    Finding,
+    ProjectContext,
+    Rule,
+    all_rules,
+    load_baseline,
+    register,
+)

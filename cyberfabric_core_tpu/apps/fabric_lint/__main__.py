@@ -1,0 +1,111 @@
+"""CLI: ``python -m cyberfabric_core_tpu.apps.fabric_lint PATH...``.
+
+Exit codes: 0 clean (or fully waived/baselined), 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .emitters import emit_json, emit_sarif, emit_text
+from .engine import Engine, all_rules, dump_baseline, load_baseline
+
+#: baseline committed next to the other gate configs; resolved against the
+#: repo root (parent of the scanned package) so the CLI works from anywhere
+DEFAULT_BASELINE = Path("config") / "fabric_lint_baseline.json"
+
+
+def _find_default_baseline(target: Path) -> Path | None:
+    for root in (Path.cwd(), target.resolve().parent):
+        cand = root / DEFAULT_BASELINE
+        if cand.is_file():
+            return cand
+    return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="fabric_lint",
+        description="AST/dataflow analyzer: async-safety (AS), jit-purity "
+                    "(JP), lock-discipline (LK), design (DE) and "
+                    "error-catalog (EC) rule families.")
+    parser.add_argument("paths", nargs="*", type=Path,
+                        help="files or package roots to lint")
+    parser.add_argument("--select", default="",
+                        help="comma list of rule ids/families (e.g. AS,JP02)")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text")
+    parser.add_argument("--output", type=Path, default=None,
+                        help="write the report here instead of stdout")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help=f"baseline file (default: {DEFAULT_BASELINE} "
+                             "next to the scanned package, when present)")
+    parser.add_argument("--no-default-baseline", action="store_true",
+                        help="ignore the committed baseline")
+    parser.add_argument("--write-baseline", type=Path, default=None,
+                        metavar="FILE",
+                        help="snapshot current unwaived findings as the new "
+                             "baseline and exit 0")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for rid, rule in sorted(rules.items()):
+            print(f"{rid}  [{rule.family}/{rule.severity}]  {rule.description}")
+        return 0
+    if not args.paths:
+        parser.error("no paths given")
+
+    baseline = {}
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_default_baseline:
+        baseline_path = _find_default_baseline(args.paths[0])
+    if baseline_path is not None and not args.write_baseline:
+        try:
+            baseline = load_baseline(baseline_path)
+        except FileNotFoundError:
+            print(f"fabric-lint: baseline not found: {baseline_path}",
+                  file=sys.stderr)
+            return 2
+
+    engine = Engine(rules, baseline)
+    if args.select:
+        engine = engine.select(p.strip() for p in args.select.split(",") if p.strip())
+
+    findings = []
+    for path in args.paths:
+        if not path.exists():
+            print(f"fabric-lint: no such path: {path}", file=sys.stderr)
+            return 2
+        findings.extend(engine.run(path))
+
+    if args.write_baseline:
+        args.write_baseline.parent.mkdir(parents=True, exist_ok=True)
+        args.write_baseline.write_text(dump_baseline(findings))
+        print(f"fabric-lint: baseline written to {args.write_baseline}")
+        return 0
+
+    if args.format == "sarif":
+        report = emit_sarif(findings, engine.rules)
+    elif args.format == "json":
+        report = emit_json(findings)
+    else:
+        report = emit_text(findings)
+
+    if args.output:
+        args.output.parent.mkdir(parents=True, exist_ok=True)
+        args.output.write_text(report)
+        blocking = [f for f in findings if not f.suppressed]
+        print(f"fabric-lint: {len(blocking)} blocking finding(s); report "
+              f"written to {args.output}")
+    else:
+        sys.stdout.write(report)
+        blocking = [f for f in findings if not f.suppressed]
+    return 1 if blocking else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

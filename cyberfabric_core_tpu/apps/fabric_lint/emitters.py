@@ -1,0 +1,95 @@
+"""Finding emitters: human text, JSON lines, SARIF 2.1.0.
+
+SARIF is the CI artifact format (GitHub code-scanning ingests it directly);
+JSON is the machine seam for scripts; text is the default console surface.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+from .engine import Finding, Rule
+
+_SARIF_LEVEL = {"error": "error", "warning": "warning"}
+
+
+def emit_text(findings: Iterable[Finding]) -> str:
+    lines = []
+    counts = {"error": 0, "warning": 0, "waived": 0, "baselined": 0}
+    for f in findings:
+        if f.waived:
+            counts["waived"] += 1
+            continue
+        if f.baselined:
+            counts["baselined"] += 1
+            tag = "baselined"
+        else:
+            counts[f.severity] += 1
+            tag = f.severity
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} [{tag}] {f.message}")
+    lines.append(
+        f"fabric-lint: {counts['error']} error(s), {counts['warning']} "
+        f"warning(s), {counts['baselined']} baselined, "
+        f"{counts['waived']} waived")
+    return "\n".join(lines) + "\n"
+
+
+def emit_json(findings: Iterable[Finding]) -> str:
+    return json.dumps({"findings": [f.to_dict() for f in findings]},
+                      indent=2) + "\n"
+
+
+def emit_sarif(findings: Iterable[Finding], rules: dict[str, Rule]) -> str:
+    """Minimal valid SARIF 2.1.0 run. Waived/baselined findings are included
+    with ``suppressions`` so the debt stays visible in the scanning UI."""
+    rule_descriptors = [
+        {
+            "id": rid,
+            "shortDescription": {"text": rule.description or rid},
+            "defaultConfiguration": {
+                "level": _SARIF_LEVEL.get(rule.severity, "warning")},
+            "properties": {"family": rule.family},
+        }
+        for rid, rule in sorted(rules.items())
+    ]
+    index = {rid: i for i, rid in enumerate(sorted(rules))}
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+            }],
+        }
+        if f.rule in index:
+            result["ruleIndex"] = index[f.rule]
+        if f.waived:
+            result["suppressions"] = [{
+                "kind": "inSource",
+                "justification": f.waive_reason}]
+        elif f.baselined:
+            result["suppressions"] = [{
+                "kind": "external",
+                "justification": "accepted in committed baseline"}]
+        results.append(result)
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                    "master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "fabric-lint",
+                "informationUri": "docs/ARCHITECTURE.md",
+                "rules": rule_descriptors,
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2) + "\n"

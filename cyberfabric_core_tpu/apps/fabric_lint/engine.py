@@ -1,0 +1,532 @@
+"""fabric-lint engine: rule registry, per-file walk, waivers, baseline.
+
+The engine makes one ``ast`` pass per file. During the walk it maintains the
+scope context that the semantic rule families need and the old grep tier
+could not see:
+
+- the enclosing function stack (and whether the *innermost* frame is async);
+- the stack of sync-lock ``with`` blocks currently open in this frame;
+- the set of functions that are jit-traced (decorated with ``jax.jit`` /
+  ``partial(jax.jit, ...)`` or passed to a ``jax.jit(fn)`` call);
+- the class stack and the module tier (first path segment under the package).
+
+Rules subscribe to AST node types and receive ``(node, scope, ctx)``;
+project-level rules see every file at once (cross-file checks like catalog
+usage). Findings carry a rule id + severity and flow through inline waivers
+(``# fabric-lint: waive RULE reason=...``) and the committed baseline before
+they can fail the build.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+__all__ = [
+    "Engine", "FileContext", "Finding", "ProjectContext", "Rule",
+    "Scope", "all_rules", "load_baseline", "register",
+]
+
+SEVERITIES = ("error", "warning")
+
+#: ``# fabric-lint: waive AS01 reason=...`` — also accepts a comma list of
+#: rule ids. The reason is mandatory; a reasonless waiver is itself a finding
+#: (WV01) and does not suppress anything.
+_WAIVE_RE = re.compile(
+    r"#\s*fabric-lint:\s*waive\s+(?P<rules>[A-Z]{2}\d{2}(?:\s*,\s*[A-Z]{2}\d{2})*)"
+    r"(?:\s+reason=(?P<reason>\S.*))?")
+
+
+# --------------------------------------------------------------------- model
+
+
+@dataclass
+class Finding:
+    """One diagnostic: a rule firing at a location."""
+
+    rule: str
+    severity: str
+    path: str            # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    waived: bool = False
+    waive_reason: str = ""
+    baselined: bool = False
+
+    @property
+    def suppressed(self) -> bool:
+        return self.waived or self.baselined
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "rule": self.rule, "severity": self.severity, "path": self.path,
+            "line": self.line, "col": self.col, "message": self.message,
+            "waived": self.waived, "waive_reason": self.waive_reason,
+            "baselined": self.baselined,
+        }
+
+
+@dataclass
+class Scope:
+    """Walk-time context handed to every rule visit."""
+
+    func_stack: list[ast.AST] = field(default_factory=list)
+    class_stack: list[ast.ClassDef] = field(default_factory=list)
+    #: sync-lock ``with`` blocks open in the CURRENT function frame only
+    #: (a nested ``def`` body executes later, outside the lock)
+    lock_stack: list[ast.With] = field(default_factory=list)
+
+    @property
+    def in_async(self) -> bool:
+        """True when the innermost function frame is ``async def``."""
+        return bool(self.func_stack) and isinstance(
+            self.func_stack[-1], ast.AsyncFunctionDef)
+
+    @property
+    def current_function(self) -> Optional[ast.AST]:
+        return self.func_stack[-1] if self.func_stack else None
+
+    @property
+    def current_class(self) -> Optional[ast.ClassDef]:
+        return self.class_stack[-1] if self.class_stack else None
+
+    def in_jit(self, ctx: "FileContext") -> bool:
+        """True when any enclosing function frame is jit-traced (nested defs
+        inside a traced function are traced with it, e.g. scan bodies)."""
+        return any(id(f) in ctx.jit_funcs for f in self.func_stack)
+
+    def jit_params(self, ctx: "FileContext") -> set[str]:
+        """Parameter names of every frame from the outermost jit function
+        inward — the names that carry traced values."""
+        names: set[str] = set()
+        seen_jit = False
+        for f in self.func_stack:
+            if id(f) in ctx.jit_funcs:
+                seen_jit = True
+            if seen_jit:
+                names |= _param_names(f)
+        return names
+
+
+class FileContext:
+    """Everything the engine precomputes about one file before the walk."""
+
+    def __init__(self, path: Path, root: Path, source: Optional[str] = None):
+        self.path = path
+        self.root = root
+        try:
+            self.relpath = path.relative_to(root).as_posix()
+        except ValueError:
+            self.relpath = path.name
+        self.source = path.read_text() if source is None else source
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        #: first path segment under the scan root ("modules", "runtime", ...)
+        parts = Path(self.relpath).parts
+        self.tier = parts[0] if len(parts) > 1 else ""
+        self.imports = list(self._iter_imports())
+        self.jit_funcs = _collect_jit_funcs(self.tree)
+        self.waivers = _parse_waivers(self.lines)
+
+    def _iter_imports(self) -> Iterator[tuple[ast.AST, int, str, list[str], str]]:
+        """Yield (node, level, module, names, resolved_absolute_module)."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                yield (node, node.level, mod, [a.name for a in node.names],
+                       self._resolve(node.level, mod))
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    yield node, 0, a.name, [], a.name
+
+    def _resolve(self, level: int, module: str) -> str:
+        if level == 0:
+            return module
+        parts = Path(self.relpath).with_suffix("").parts
+        base = list((self.root.name,) + parts[:-1])
+        up = base[: len(base) - (level - 1)] if level > 1 else base
+        return ".".join(up + ([module] if module else []))
+
+
+class ProjectContext:
+    """All file contexts of one run, for cross-file rules."""
+
+    def __init__(self, root: Path, files: list[FileContext]):
+        self.root = root
+        self.files = files
+
+
+# --------------------------------------------------------------------- rules
+
+
+class Rule:
+    """Base class: subclass, set the class attributes, implement ``visit``
+    (per-node), ``check_file`` (whole-file) and/or ``check_project``."""
+
+    id: str = ""
+    family: str = ""
+    severity: str = "error"
+    description: str = ""
+    #: AST node types ``visit`` subscribes to; empty = never called per-node
+    node_types: tuple[type, ...] = ()
+    #: restrict per-file callbacks to these tiers; None = every tier
+    tiers: Optional[frozenset[str]] = None
+
+    def applies(self, ctx: FileContext) -> bool:
+        return self.tiers is None or ctx.tier in self.tiers
+
+    def visit(self, node: ast.AST, scope: Scope,
+              ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        return ()
+
+    # helpers for subclasses; the engine binds _ctx to the file being walked
+    _ctx: Optional[FileContext] = None
+
+    def finding(self, node_or_line, message: str) -> Finding:
+        assert self._ctx is not None, "finding() outside a file walk"
+        return self.finding_in(self._ctx, node_or_line, message)
+
+    def finding_in(self, ctx: FileContext, node_or_line,
+                   message: str) -> Finding:
+        if isinstance(node_or_line, int):
+            line, col = node_or_line, 0
+        else:
+            line = getattr(node_or_line, "lineno", 1)
+            col = getattr(node_or_line, "col_offset", 0)
+        return Finding(self.id, self.severity, ctx.relpath, line, col, message)
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(rule_cls: type) -> type:
+    """Class decorator: instantiate and register a rule by id."""
+    rule = rule_cls()
+    if not rule.id:
+        raise ValueError(f"rule {rule_cls.__name__} has no id")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    if rule.severity not in SEVERITIES:
+        raise ValueError(f"rule {rule.id}: bad severity {rule.severity!r}")
+    _REGISTRY[rule.id] = rule
+    return rule_cls
+
+
+def all_rules() -> dict[str, Rule]:
+    """The full registry (rule modules imported on first use)."""
+    from . import rules as _rules  # noqa: F401  (import side effect: register)
+    return dict(_REGISTRY)
+
+
+# ------------------------------------------------------------------- helpers
+
+
+def _param_names(func: ast.AST) -> set[str]:
+    if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return set()
+    a = func.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``jax.jit`` -> "jax.jit"; non-name chains collapse to ""."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+_JIT_NAMES = {"jit", "jax.jit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def _is_jit_expr(expr: ast.AST) -> bool:
+    """Decorator/callable expression that means "jit-trace this": ``jit``,
+    ``jax.jit``, or ``partial(jax.jit, ...)`` in either spelling."""
+    if dotted_name(expr) in _JIT_NAMES:
+        return True
+    if isinstance(expr, ast.Call):
+        if dotted_name(expr.func) in _JIT_NAMES:
+            return True
+        if dotted_name(expr.func) in _PARTIAL_NAMES and expr.args \
+                and dotted_name(expr.args[0]) in _JIT_NAMES:
+            return True
+    return False
+
+
+def _collect_jit_funcs(tree: ast.AST) -> set[int]:
+    """ids() of FunctionDef nodes that are jit-traced, via decorator or by
+    being passed to a ``jax.jit(fn)`` call by name."""
+    jit_ids: set[int] = set()
+    jit_called_names: set[str] = set()
+    funcs_by_name: dict[str, list[ast.AST]] = {}
+    # ``jax.jit(name)`` references a local def, never a method (a method
+    # reference would be spelled self.name) — a method sharing the local
+    # def's name must not be swept in
+    method_ids = {id(n) for node in ast.walk(tree)
+                  if isinstance(node, ast.ClassDef) for n in node.body
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs_by_name.setdefault(node.name, []).append(node)
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                jit_ids.add(id(node))
+        elif isinstance(node, ast.Call) and dotted_name(node.func) in _JIT_NAMES:
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    jit_called_names.add(arg.id)
+    for name in jit_called_names:
+        for f in funcs_by_name.get(name, ()):
+            if id(f) not in method_ids:
+                jit_ids.add(id(f))
+    return jit_ids
+
+
+def _parse_waivers(lines: list[str]) -> dict[int, list[tuple[str, str]]]:
+    """line(1-based) -> [(rule_id, reason)]. A waiver on a code line covers
+    that line; a standalone comment line covers the next line. A reasonless
+    waiver is recorded with reason "" (rule WV01 reports it; it suppresses
+    nothing)."""
+    out: dict[int, list[tuple[str, str]]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _WAIVE_RE.search(text)
+        if not m:
+            continue
+        reason = (m.group("reason") or "").strip()
+        target = i + 1 if text.lstrip().startswith("#") else i
+        for rule_id in re.split(r"\s*,\s*", m.group("rules")):
+            # a reasonless waiver suppresses nothing; it is recorded at the
+            # comment line itself so WV01 can point at it
+            out.setdefault(target if reason else i, []).append((rule_id, reason))
+    return out
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def load_baseline(path: Path) -> dict[tuple[str, str], int]:
+    """Committed debt ledger: {(relpath, rule): tolerated_count}. Count-based
+    fingerprints survive line drift; the gate only fails on NEW findings."""
+    data = json.loads(path.read_text())
+    out: dict[tuple[str, str], int] = {}
+    for entry in data.get("findings", []):
+        out[(entry["path"], entry["rule"])] = int(entry.get("count", 1))
+    return out
+
+
+def dump_baseline(findings: Iterable[Finding]) -> str:
+    counts: dict[tuple[str, str], int] = {}
+    for f in findings:
+        if not f.waived:
+            key = (f.path, f.rule)
+            counts[key] = counts.get(key, 0) + 1
+    entries = [{"path": p, "rule": r, "count": n}
+               for (p, r), n in sorted(counts.items())]
+    return json.dumps({"version": 1, "findings": entries}, indent=2) + "\n"
+
+
+# -------------------------------------------------------------------- engine
+
+
+class _Walker(ast.NodeVisitor):
+    """One pass over a file's AST, maintaining Scope and dispatching to the
+    rules subscribed to each node type."""
+
+    def __init__(self, rules: list[Rule], ctx: FileContext,
+                 sink: Callable[[Finding], None]):
+        self.rules = rules
+        self.ctx = ctx
+        self.sink = sink
+        self.scope = Scope()
+
+    def _dispatch(self, node: ast.AST) -> None:
+        for rule in self.rules:
+            if rule.node_types and isinstance(node, rule.node_types):
+                for f in rule.visit(node, self.scope, self.ctx):
+                    self.sink(f)
+
+    def generic_visit(self, node: ast.AST) -> None:
+        self._dispatch(node)
+        super().generic_visit(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._dispatch(node)
+        self.scope.class_stack.append(node)
+        try:
+            super().generic_visit(node)
+        finally:
+            self.scope.class_stack.pop()
+
+    def _visit_func(self, node: ast.AST) -> None:
+        self._dispatch(node)
+        # decorators/defaults evaluate in the ENCLOSING frame
+        for expr in getattr(node, "decorator_list", []):
+            self.visit(expr)
+        self.visit(node.args)
+        saved_locks = self.scope.lock_stack
+        self.scope.lock_stack = []      # locks don't span into nested bodies
+        self.scope.func_stack.append(node)
+        try:
+            for child in node.body:
+                self.visit(child)
+        finally:
+            self.scope.func_stack.pop()
+            self.scope.lock_stack = saved_locks
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_With(self, node: ast.With) -> None:
+        self._dispatch(node)
+        is_lock = any(_is_sync_lock_expr(item.context_expr)
+                      for item in node.items)
+        for item in node.items:
+            self.visit(item)
+        if is_lock:
+            self.scope.lock_stack.append(node)
+        try:
+            for child in node.body:
+                self.visit(child)
+        finally:
+            if is_lock:
+                self.scope.lock_stack.pop()
+
+
+def _is_sync_lock_expr(expr: ast.AST) -> bool:
+    """``with self._lock:`` / ``with pool_lock:`` — terminal name mentions a
+    lock. ``async with`` never reaches here (different node type)."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func  # with lock_for(key): / with self._lock.acquire():
+    name = dotted_name(expr)
+    terminal = name.rsplit(".", 1)[-1].lower()
+    return "lock" in terminal or "mutex" in terminal
+
+
+class Engine:
+    """Run a rule set over paths; apply waivers and the baseline."""
+
+    def __init__(self, rules: Optional[dict[str, Rule]] = None,
+                 baseline: Optional[dict[tuple[str, str], int]] = None):
+        self.rules = dict(rules if rules is not None else all_rules())
+        self.baseline = dict(baseline or {})
+        # the baseline budget is consumed ACROSS runs of this engine — the
+        # CLI lints each path argument in its own run(), and a per-run copy
+        # would multiply the tolerated debt by the number of paths
+        self._budget = dict(self.baseline)
+
+    def select(self, patterns: Iterable[str]) -> "Engine":
+        """Keep rules whose id or family matches any pattern ("AS", "JP02")."""
+        pats = list(patterns)
+        kept = {rid: r for rid, r in self.rules.items()
+                if any(rid == p or rid.startswith(p) or r.family == p
+                       for p in pats)}
+        return Engine(kept, self.baseline)
+
+    # -- running ----------------------------------------------------------
+
+    def run_source(self, source: str, relpath: str = "<memory>.py",
+                   tier: str = "") -> list[Finding]:
+        """Lint an in-memory snippet (fixture tests)."""
+        ctx = FileContext(Path(relpath), Path("."), source=source)
+        ctx.relpath, ctx.tier = relpath, tier
+        return self._finish([ctx], self._lint_file(ctx))
+
+    def run(self, root: Path, paths: Optional[Iterable[Path]] = None
+            ) -> list[Finding]:
+        root = root.resolve()
+        if paths is None:
+            paths = sorted(root.rglob("*.py")) if root.is_dir() else [root]
+            # re-root a single file or package SUBdirectory at its package
+            # root so relpath/tier match a whole-package scan — otherwise
+            # tier-gated rules silently never fire (or mis-fire)
+            base = root if root.is_dir() else root.parent
+            if (base / "__init__.py").is_file():
+                while (base.parent / "__init__.py").is_file():
+                    base = base.parent
+                root = base
+            elif root.is_file():
+                root = base
+        findings: list[Finding] = []
+        contexts: list[FileContext] = []
+        for path in paths:
+            if "__pycache__" in path.parts:
+                continue
+            try:
+                ctx = FileContext(path, root)
+            except SyntaxError as e:
+                findings.append(Finding(
+                    "XX00", "error", str(path), e.lineno or 1, 0,
+                    f"syntax error: {e.msg}"))
+                continue
+            contexts.append(ctx)
+            findings.extend(self._lint_file(ctx))
+        return self._finish(contexts, findings)
+
+    def _lint_file(self, ctx: FileContext) -> list[Finding]:
+        active = [r for r in self.rules.values() if r.applies(ctx)]
+        out: list[Finding] = []
+        for rule in active:
+            rule._ctx = ctx
+        try:
+            walker = _Walker([r for r in active if r.node_types], ctx,
+                             out.append)
+            walker.visit(ctx.tree)
+            for rule in active:
+                out.extend(rule.check_file(ctx))
+        finally:
+            for rule in active:
+                rule._ctx = None
+        # WV01: waiver hygiene is engine-level, not a registered rule, so it
+        # cannot itself be waived away
+        for line, entries in sorted(ctx.waivers.items()):
+            for rule_id, reason in entries:
+                if not reason:
+                    out.append(Finding(
+                        "WV01", "error", ctx.relpath, line, 0,
+                        f"waiver for {rule_id} has no reason= — it suppresses "
+                        "nothing; write `# fabric-lint: waive "
+                        f"{rule_id} reason=<why>`"))
+        return out
+
+    def _finish(self, contexts: list[FileContext],
+                findings: list[Finding]) -> list[Finding]:
+        for rule in self.rules.values():
+            findings.extend(rule.check_project(
+                ProjectContext(contexts[0].root if contexts else Path("."),
+                               contexts)))
+        waiver_by_path = {c.relpath: c.waivers for c in contexts}
+        budget = self._budget
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+            if f.rule == "WV01":
+                continue  # waiver hygiene cannot be waived or baselined away
+            for rule_id, reason in waiver_by_path.get(f.path, {}).get(f.line, []):
+                if rule_id == f.rule and reason:
+                    f.waived, f.waive_reason = True, reason
+                    break
+            if not f.waived:
+                key = (f.path, f.rule)
+                if budget.get(key, 0) > 0:
+                    budget[key] -= 1
+                    f.baselined = True
+        return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -1,0 +1,6 @@
+"""Rule families. Importing this package registers every rule."""
+
+from . import async_safety  # noqa: F401
+from . import design        # noqa: F401
+from . import jit_purity    # noqa: F401
+from . import lock_discipline  # noqa: F401

@@ -1,0 +1,128 @@
+"""AS — async-safety. The serving tiers (modkit/, modules/, gateway/) run on
+one asyncio event loop; a blocked loop stalls every in-flight request, and a
+fire-and-forget task swallows its exception at GC time. These hazards live
+*inside* ``async def`` bodies, which the old grep tier could not see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import FileContext, Finding, Rule, Scope, dotted_name, register
+
+SERVING_TIERS = frozenset({"modkit", "modules", "gateway", "apps", ""})
+
+#: dotted call names that block the calling thread. ``open`` is deliberately
+#: NOT here: config/startup reads from async hooks are idiomatic and small;
+#: sustained file streaming goes through executors anyway.
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "sqlite3.connect",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "requests.get", "requests.post", "requests.put", "requests.patch",
+    "requests.delete", "requests.head", "requests.request",
+    "requests.Session",
+    "urllib.request.urlopen",
+    "socket.create_connection",
+}
+
+_SPAWN_CALLS = {"asyncio.ensure_future", "asyncio.create_task",
+                "ensure_future", "create_task"}
+
+
+def _is_spawn_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if name in _SPAWN_CALLS:
+        return True
+    # loop.create_task(...) — but NOT tg.create_task(...) (TaskGroup retains
+    # the task and propagates its exception; that is the recommended safe
+    # pattern) and not unrelated domain APIs sharing the method name
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "create_task":
+        holder = dotted_name(node.func.value).rsplit(".", 1)[-1].lower()
+        return "loop" in holder
+    return False
+
+
+@register
+class AS01(Rule):
+    id = "AS01"
+    family = "AS"
+    severity = "error"
+    description = ("blocking call on the serving path: inside async def, or "
+                   "time.sleep anywhere in a serving tier")
+    node_types = (ast.Call,)
+    tiers = SERVING_TIERS
+
+    def visit(self, node: ast.Call, scope: Scope,
+              ctx: FileContext) -> Iterable[Finding]:
+        name = dotted_name(node.func)
+        if name not in _BLOCKING_CALLS:
+            return
+        if scope.in_async:
+            yield self.finding(
+                node, f"blocking call {name}() inside async def "
+                f"{getattr(scope.current_function, 'name', '?')} stalls the "
+                "event loop — await the async equivalent or push it to an "
+                "executor")
+        elif name == "time.sleep":
+            # even in sync code, sleeping a serving-tier thread is suspect:
+            # most sync helpers here are called from the loop. Sanctioned
+            # engine-thread retry loops carry a waiver.
+            yield self.finding(
+                node, "time.sleep() in a serving tier — if this runs on the "
+                "event loop it stalls every request; waive only for "
+                "dedicated sync threads")
+
+
+@register
+class AS02(Rule):
+    id = "AS02"
+    family = "AS"
+    severity = "error"
+    description = ("fire-and-forget task: ensure_future/create_task result "
+                   "neither retained nor given a done-callback")
+    node_types = (ast.Expr, ast.Assign)
+
+    def visit(self, node: ast.AST, scope: Scope,
+              ctx: FileContext) -> Iterable[Finding]:
+        if isinstance(node, ast.Expr):
+            value = node.value
+            discarded = True
+        else:  # Assign — only `_ = ...` is still a discard
+            value = node.value
+            targets = node.targets
+            discarded = all(isinstance(t, ast.Name) and t.id == "_"
+                            for t in targets)
+        if not discarded or not isinstance(value, ast.Call):
+            return
+        if _is_spawn_call(value):
+            yield self.finding(
+                value, "fire-and-forget task: the loop holds only a weak "
+                "reference, and an exception in it is silently dropped at GC "
+                "time — retain the task and attach a done-callback that logs "
+                "failures (see modkit.logging_host.observe_task)")
+
+
+@register
+class AS03(Rule):
+    id = "AS03"
+    family = "AS"
+    severity = "error"
+    description = "await while holding a sync (threading) lock"
+    node_types = (ast.Await,)
+
+    def visit(self, node: ast.Await, scope: Scope,
+              ctx: FileContext) -> Iterable[Finding]:
+        if scope.lock_stack:
+            lock = scope.lock_stack[-1]
+            held = ", ".join(
+                dotted_name(item.context_expr) or
+                dotted_name(getattr(item.context_expr, "func", item.context_expr))
+                for item in lock.items) or "lock"
+            yield self.finding(
+                node, f"await while holding sync lock ({held}): the lock "
+                "stays held across the suspension, so any other coroutine "
+                "or thread contending for it deadlocks the loop — release "
+                "before awaiting, or use asyncio.Lock")

@@ -1,0 +1,453 @@
+"""DE/EC — the design and error-catalog families migrated from the grep/AST
+tier in tests/test_arch_lint.py onto the engine. Same semantics, now with
+rule ids, locations, waivers and SARIF like every other family; the old test
+file remains as a thin pytest driver over these rules.
+
+Reference mapping (dylint families):
+  DE01 layer purity        L1 modkit never imports upward; L3 compute tier
+                           (models/ops/parallel) never imports serving
+  DE02 data boundary       L2 sqlite3 only inside modkit db.py/db_engine.py
+  DE03 domain purity       DE0301 no infra / DE0308 no transport imports in
+                           runtime/models/ops/parallel; DE0309 domain data
+                           types (*Config/Params/Result/Event/Stats) are
+                           @dataclass
+  DE04 gateway seams       L4 modules use only gateway.middleware/validation
+                           (+ *Api contract types from gateway.module)
+  DE05 client layer        DE0503 SDK traits carry the Api suffix and hub
+                           resolution stays on *Api contracts; DE0504
+                           versioned *_SERVICE contracts; L5 cross-module
+                           imports go through the .sdk seam
+  DE07 security            raw connection escape hatches confined to the DB
+                           boundary; SecretString.expose() never formatted
+  DE08 REST conventions    verbs, /v1/ rooting, no trailing slash, segment
+                           casing
+  DE09 GTS identifiers     every complete GTS-looking literal validates
+  DE13 common patterns     no print() in production code
+  EC01 error catalog       no literal error codes; every catalog namespace
+                           referenced
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from typing import Iterable
+
+from ..engine import (FileContext, Finding, ProjectContext, Rule, Scope,
+                      register)
+
+_DOMAIN_TIERS = frozenset({"runtime", "models", "ops", "parallel"})
+_COMPUTE_TIERS = frozenset({"models", "ops", "parallel"})
+_TRANSPORT_TOPLEVEL = {"aiohttp", "grpc"}
+_INFRA_TOPLEVEL = {"sqlite3", "psycopg", "pymysql"}
+
+
+@register
+class DE01(Rule):
+    id = "DE01"
+    family = "DE"
+    severity = "error"
+    description = ("layer purity: modkit never imports upward; the compute "
+                   "tier never imports the serving tier")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node, _level, _mod, _names, resolved in ctx.imports:
+            if ctx.tier == "modkit" and (
+                    ".gateway" in resolved or ".modules" in resolved):
+                yield self.finding(
+                    node, f"modkit (the substrate) imports upward: {resolved}")
+            if ctx.tier in _COMPUTE_TIERS and any(
+                    s in resolved for s in (".modules", ".gateway", ".modkit")):
+                yield self.finding(
+                    node, f"compute tier {ctx.tier}/ imports the serving "
+                    f"tier: {resolved} — kernels stay host-framework-free")
+
+
+@register
+class DE02(Rule):
+    id = "DE02"
+    family = "DE"
+    severity = "error"
+    description = "data boundary: sqlite3 only inside modkit db.py/db_engine.py"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path.name in ("db.py", "db_engine.py"):
+            return
+        for node, _level, _mod, _names, resolved in ctx.imports:
+            if resolved.split(".")[0] == "sqlite3":
+                yield self.finding(
+                    node, "sqlite3 outside the modkit DB boundary "
+                    "(db.py/db_engine.py) — no plain SQL outside the "
+                    "secure ORM")
+
+
+_DATA_SUFFIXES = ("Config", "Params", "Result", "Event", "Stats")
+
+
+@register
+class DE03(Rule):
+    id = "DE03"
+    family = "DE"
+    severity = "error"
+    description = ("domain purity: no transport/infra imports in the domain "
+                   "tiers; domain data types are @dataclass")
+    tiers = _DOMAIN_TIERS
+    node_types = (ast.ClassDef,)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node, _level, _mod, _names, resolved in ctx.imports:
+            top = resolved.split(".")[0]
+            if top in _TRANSPORT_TOPLEVEL:
+                yield self.finding(
+                    node, f"DE0308 transport type in domain tier "
+                    f"{ctx.tier}/: {resolved}")
+            if top in _INFRA_TOPLEVEL:
+                yield self.finding(
+                    node, f"DE0301 infrastructure in domain tier "
+                    f"{ctx.tier}/: {resolved}")
+
+    def visit(self, node: ast.ClassDef, scope: Scope,
+              ctx: FileContext) -> Iterable[Finding]:
+        if not node.name.endswith(_DATA_SUFFIXES):
+            return
+        deco_names = {
+            (d.id if isinstance(d, ast.Name)
+             else d.func.id if isinstance(d, ast.Call)
+             and isinstance(d.func, ast.Name)
+             else d.attr if isinstance(d, ast.Attribute) else "")
+            for d in node.decorator_list}
+        if not deco_names & {"dataclass"}:
+            yield self.finding(
+                node, f"DE0309 domain data type {node.name} is not a "
+                "@dataclass — the marker that keeps domain models plain data")
+
+
+@register
+class DE04(Rule):
+    id = "DE04"
+    family = "DE"
+    severity = "error"
+    description = ("gateway seams: modules import only gateway.middleware/"
+                   "gateway.validation (or *Api contracts from gateway.module)")
+    tiers = frozenset({"modules"})
+
+    _ALLOWED = {"cyberfabric_core_tpu.gateway.middleware",
+                "cyberfabric_core_tpu.gateway.validation"}
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path.name == "__init__.py":
+            return  # registration re-export is the sanctioned exception
+        for node, _level, _mod, names, resolved in ctx.imports:
+            if ".gateway" not in resolved:
+                continue
+            if resolved in self._ALLOWED:
+                continue
+            if resolved == "cyberfabric_core_tpu.gateway.module" and names \
+                    and all(n.endswith("Api") for n in names):
+                continue  # contract ABCs only
+            yield self.finding(
+                node, f"module imports gateway internals: {resolved} "
+                f"{names} — only middleware/validation (or *Api contracts) "
+                "are public seams")
+
+
+@register
+class DE05(Rule):
+    id = "DE05"
+    family = "DE"
+    severity = "error"
+    description = ("client layer: Api-suffixed SDK traits, contract-typed "
+                   "hub resolution, versioned service names, cross-module "
+                   "calls through .sdk")
+
+    _VERSION_PAT = re.compile(r"^[a-z][\w.]*\.v\d+\.\w+$")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        # DE0503: trait suffix consistency in the SDK surface
+        if ctx.relpath == "modules/sdk.py" or ctx.path.name == "sdk.py" \
+                and ctx.tier == "modules":
+            for node in ctx.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                deco = {(d.id if isinstance(d, ast.Name) else "")
+                        for d in node.decorator_list}
+                if "dataclass" in deco:
+                    continue  # DTOs are data, not client traits
+                has_methods = any(
+                    isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    for n in node.body)
+                if has_methods and not node.name.endswith("Api"):
+                    yield self.finding(
+                        node, f"DE0503 SDK trait {node.name} missing the Api "
+                        "suffix — mixed suffixes make the ClientHub registry "
+                        "unreadable")
+
+        # DE0504: versioned *_SERVICE contracts (any tier)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id.endswith("_SERVICE") \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str) \
+                        and not self._VERSION_PAT.match(node.value.value):
+                    yield self.finding(
+                        node, f"DE0504 unversioned service contract "
+                        f"{tgt.id} = {node.value.value!r} — use "
+                        "pkg.vN.Service so parallel versions stay expressible")
+
+        # hub.get/try_get resolve *Api contract types only
+        if ctx.tier in ("modules", "gateway"):
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("get", "try_get")):
+                    continue
+                holder = node.func.value
+                holder_name = (holder.id if isinstance(holder, ast.Name)
+                               else holder.attr if isinstance(holder, ast.Attribute)
+                               else "")
+                if "hub" not in holder_name or not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and not arg.id.endswith("Api"):
+                    yield self.finding(
+                        node, f"DE0503 hub resolution of non-contract type "
+                        f"{arg.id} — resolving a concrete class bypasses the "
+                        "SDK seam")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        # L5: modules talk to each other through ClientHub SDK traits (.sdk)
+        module_files = {c.path.stem for c in project.files
+                        if c.tier == "modules"
+                        and len(c.relpath.split("/")) == 2} - {"__init__", "sdk"}
+        for ctx in project.files:
+            if ctx.tier != "modules" or ctx.path.name == "__init__.py":
+                continue
+            for node, _level, _mod, _names, resolved in ctx.imports:
+                parts = resolved.split(".")
+                if not (len(parts) >= 3 and parts[-2] == "modules"
+                        and parts[-1] in module_files and parts[-1] != "sdk"):
+                    continue
+                target = parts[-1]
+                # same-family implementation detail files are allowed
+                if target.startswith(ctx.path.stem) \
+                        or ctx.path.stem.startswith(target):
+                    continue
+                yield self.finding_in(
+                    ctx, node,
+                    f"cross-module implementation import {resolved} — "
+                    "modules talk through ClientHub SDK traits (.sdk)")
+
+
+@register
+class DE07(Rule):
+    id = "DE07"
+    family = "DE"
+    severity = "error"
+    description = ("security: raw DB connections confined to the modkit DB "
+                   "boundary; SecretString.expose() never string-formatted")
+    node_types = (ast.Call, ast.JoinedStr, ast.BinOp)
+
+    _RAW = ("raw_connection", "raw_for_migrations")
+
+    @staticmethod
+    def _has_expose(node: ast.AST) -> bool:
+        return any(
+            isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute)
+            and v.func.attr == "expose"
+            for v in ast.walk(node))
+
+    def visit(self, node: ast.AST, scope: Scope,
+              ctx: FileContext) -> Iterable[Finding]:
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in self._RAW \
+                    and ctx.path.name not in ("db.py", "db_engine.py"):
+                yield self.finding(
+                    node, f"raw DB connection access ({fn.attr}) outside "
+                    "modkit/db — no plain SQL outside migrations")
+            if isinstance(fn, ast.Attribute) and fn.attr == "format":
+                for a in list(node.args) + [k.value for k in node.keywords]:
+                    if self._has_expose(a):
+                        yield self.finding(
+                            node, "SecretString revealed inside .format() — "
+                            "a rendered string can reach logs")
+                        break
+        elif isinstance(node, ast.JoinedStr):
+            if self._has_expose(node):
+                yield self.finding(
+                    node, "SecretString revealed inside an f-string — a "
+                    "rendered string can reach logs")
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+            if self._has_expose(node.right):
+                yield self.finding(
+                    node, "SecretString revealed inside %-formatting — a "
+                    "rendered string can reach logs")
+
+
+@register
+class DE08(Rule):
+    id = "DE08"
+    family = "DE"
+    severity = "error"
+    description = ("REST conventions: known verbs, /v1/ rooting, no trailing "
+                   "slash, lowercase segments, {snake_case} params")
+    node_types = (ast.Call,)
+
+    _INFRA = {"/metrics", "/health", "/healthz", "/openapi.json", "/docs"}
+    _VERBS = {"GET", "POST", "PUT", "PATCH", "DELETE"}
+    _SEG = re.compile(r"^(?:[a-z0-9][a-z0-9_\-.]*|\{[a-z][a-z0-9_]*\})$")
+
+    def visit(self, node: ast.Call, scope: Scope,
+              ctx: FileContext) -> Iterable[Finding]:
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "operation"):
+            return
+        if len(node.args) < 2:
+            return
+        method, route = node.args[0], node.args[1]
+        if not (isinstance(method, ast.Constant)
+                and isinstance(route, ast.Constant)):
+            return
+        m, r = method.value, route.value
+        if m not in self._VERBS:
+            yield self.finding(node, f"unknown HTTP verb {m!r} on {r!r}")
+            return
+        if r in self._INFRA:
+            return
+        if not r.startswith("/v1/"):
+            yield self.finding(node, f"route {r!r} not rooted at /v1/")
+        if r != "/" and r.endswith("/"):
+            yield self.finding(node, f"route {r!r} has a trailing slash")
+        for seg in r.strip("/").split("/")[1:]:
+            if seg.startswith(":"):
+                continue  # :control-style action segments
+            if not self._SEG.match(seg):
+                yield self.finding(
+                    node, f"route {r!r} has bad segment {seg!r} — lowercase "
+                    "kebab/snake or {snake_case} params only")
+
+
+@register
+class DE09(Rule):
+    id = "DE09"
+    family = "DE"
+    severity = "error"
+    description = "GTS identifiers: every complete gts.* literal validates"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if "gts_docs_validator" in ctx.path.name:
+            return  # the validator's own fixtures exercise malformed ids
+        from ...gts_docs_validator import validate_gts_id
+
+        joined_consts = {
+            id(c) for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.JoinedStr)
+            for c in ast.walk(node) if isinstance(c, ast.Constant)}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Constant) or id(node) in joined_consts:
+                continue
+            v = node.value
+            if not isinstance(v, str):
+                continue
+            raw = v[6:] if v.startswith("gts://") else v
+            # complete-looking ids only: fragments/prefixes/regexes are not
+            # identifiers (the docs validator applies the same candidate rule)
+            if not raw.startswith("gts.") or raw.count(".") < 4 \
+                    or "*" in raw or "[" in raw or " " in raw:
+                continue
+            errors = validate_gts_id(raw)
+            if errors:
+                yield self.finding(
+                    node, f"malformed GTS identifier {v!r}: {'; '.join(errors)}")
+
+
+@register
+class DE13(Rule):
+    id = "DE13"
+    family = "DE"
+    severity = "error"
+    description = "common patterns: no print() in production code"
+
+    _EXEMPT_FILES = {"server.py", "__main__.py"}
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path.name in self._EXEMPT_FILES \
+                or "apps" in ctx.relpath.split("/"):
+            return
+        # statements under `if __name__ == "__main__":` and inside a
+        # top-level `def main(...)` CLI entry point are the sanctioned print
+        # surface (JSON-line tools; reference exempts bins the same way)
+        main_ranges = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.If):
+                t = node.test
+                if (isinstance(t, ast.Compare)
+                        and isinstance(t.left, ast.Name)
+                        and t.left.id == "__name__"):
+                    main_ranges.append((node.lineno, node.end_lineno))
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == "main":
+                main_ranges.append((node.lineno, node.end_lineno))
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                if any(a <= node.lineno <= b for a, b in main_ranges):
+                    continue
+                yield self.finding(
+                    node, "print() in production code bypasses the logging "
+                    "host (per-module files, levels, redaction) — log "
+                    "through modkit/logging_host")
+
+
+@register
+class EC01(Rule):
+    id = "EC01"
+    family = "EC"
+    severity = "error"
+    description = ("error catalog: codes come from modkit/catalogs/errors.json "
+                   "via errcat.ERR, never string literals; every namespace "
+                   "is referenced")
+    node_types = (ast.Call,)
+
+    _ALLOWED = {"modkit/errcat.py", "modkit/errors.py"}
+
+    def visit(self, node: ast.Call, scope: Scope,
+              ctx: FileContext) -> Iterable[Finding]:
+        if ctx.relpath in self._ALLOWED:
+            return
+        fn = node.func
+        name = (fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else "")
+        is_problem_call = name in ("Problem", "ProblemError") or (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "ProblemError")
+        if not is_problem_call:
+            return
+        for kw in node.keywords:
+            if kw.arg == "code" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                yield self.finding(
+                    node, f"literal error code {kw.value.value!r} — codes "
+                    "live in modkit/catalogs/errors.json and are referenced "
+                    "as errcat.ERR constants")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        catalog_path = project.root / "modkit" / "catalogs" / "errors.json"
+        if not catalog_path.is_file():
+            return  # fixture runs outside the real package
+        if not any(c.relpath == "modkit/errcat.py" for c in project.files):
+            return  # partial scan: usage evidence is incomplete by design
+        catalog = json.loads(catalog_path.read_text())
+        source = "\n".join(c.source for c in project.files)
+        for ns in catalog:
+            if f"ERR.{ns}." not in source:
+                yield Finding(
+                    self.id, self.severity, "modkit/catalogs/errors.json", 1,
+                    0, f"catalog namespace {ns!r} is never referenced — the "
+                    "catalog and the code drifted apart")

@@ -1,0 +1,164 @@
+"""JP — jit-purity. A function handed to ``jax.jit`` runs ONCE as a Python
+trace; everything that is not a jax op is baked into the compiled TPU program
+or silently executed at trace time only. A ``print`` that "works" in eager
+mode vanishes under jit; ``np.*`` on a traced argument either crashes or
+freezes a constant; mutating captured state desyncs host and device.
+
+Scope: the compute tiers (runtime/, ops/, models/, parallel/) where every
+jit boundary in the codebase lives. Detection covers both decorator
+spellings (``@jax.jit``, ``@partial(jax.jit, ...)``) and the local-def
+pattern ``self._fn = jax.jit(fn)`` that the scheduler/engine use.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import FileContext, Finding, Rule, Scope, dotted_name, register
+
+COMPUTE_TIERS = frozenset({"runtime", "ops", "models", "parallel"})
+
+_HOST_NP_BASES = {"np", "numpy", "onp"}
+_LOG_BASES = {"logging", "logger", "log"}
+_MUTATING_METHODS = {"append", "extend", "add", "update", "insert", "remove",
+                     "discard", "setdefault", "clear", "pop", "popitem",
+                     "appendleft", "extendleft"}
+
+
+@register
+class JP01(Rule):
+    id = "JP01"
+    family = "JP"
+    severity = "error"
+    description = "print/logging call inside a jit-traced function"
+    node_types = (ast.Call,)
+    tiers = COMPUTE_TIERS
+
+    def visit(self, node: ast.Call, scope: Scope,
+              ctx: FileContext) -> Iterable[Finding]:
+        if not scope.in_jit(ctx):
+            return
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "print":
+            yield self.finding(
+                node, "print() inside a jit-traced function executes at "
+                "trace time only (then never again) — use jax.debug.print "
+                "for traced values")
+        elif isinstance(fn, ast.Attribute):
+            base = fn.value
+            base_name = base.id if isinstance(base, ast.Name) else ""
+            if base_name in _LOG_BASES:
+                yield self.finding(
+                    node, f"host logging ({dotted_name(fn)}) inside a "
+                    "jit-traced function fires at trace time only — move it "
+                    "outside the traced body or use jax.debug.print")
+
+
+@register
+class JP02(Rule):
+    id = "JP02"
+    family = "JP"
+    severity = "error"
+    description = "host np.* call on a traced argument inside jit"
+    node_types = (ast.Call,)
+    tiers = COMPUTE_TIERS
+
+    def visit(self, node: ast.Call, scope: Scope,
+              ctx: FileContext) -> Iterable[Finding]:
+        if not scope.in_jit(ctx):
+            return
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in _HOST_NP_BASES):
+            return
+        # np.* on static values (shapes, python config) is legitimate trace
+        # arithmetic; only a call whose arguments reference a traced
+        # parameter is a hazard
+        traced = scope.jit_params(ctx)
+        args = list(node.args) + [k.value for k in node.keywords]
+        for a in args:
+            if any(isinstance(n, ast.Name) and n.id in traced
+                   for n in ast.walk(a)):
+                yield self.finding(
+                    node, f"host {dotted_name(fn)}() applied to traced "
+                    "argument(s) inside jit — it either fails on the tracer "
+                    "or silently bakes a constant; use the jnp equivalent")
+                return
+
+
+@register
+class JP03(Rule):
+    id = "JP03"
+    family = "JP"
+    severity = "error"
+    description = "mutation of captured state inside a jit-traced function"
+    node_types = (ast.Assign, ast.AugAssign, ast.Global, ast.Nonlocal, ast.Expr)
+    tiers = COMPUTE_TIERS
+
+    def visit(self, node: ast.AST, scope: Scope,
+              ctx: FileContext) -> Iterable[Finding]:
+        if not scope.in_jit(ctx):
+            return
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+            yield self.finding(
+                node, f"{kind} write inside a jit-traced function mutates "
+                "host state at trace time only — return the value instead")
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                # self.x = ... / self.x[i] = ... — mutation of the captured
+                # object; the compiled program will never see it again
+                base = t
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Attribute) \
+                        and isinstance(base.value, ast.Name) \
+                        and base.value.id == "self":
+                    yield self.finding(
+                        node, f"write to captured self.{base.attr} inside a "
+                        "jit-traced function happens at trace time only — "
+                        "thread the value through the function's returns")
+                    return
+            return
+        # mutating-method call on a name captured from the enclosing scope.
+        # Only a DISCARDED result counts: dict.update/list.append return
+        # None, while functional APIs spelled the same way (optax
+        # ``tx.update``) hand their result back — assignment means pure use.
+        call = node.value
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in _MUTATING_METHODS
+                and isinstance(call.func.value, ast.Name)):
+            return
+        name = call.func.value.id
+        if name not in self._bound_in_jit(scope, ctx):
+            yield self.finding(
+                call, f"{name}.{call.func.attr}() mutates captured host "
+                "state inside a jit-traced function — trace-time side "
+                "effects are not replayed by the compiled program")
+
+    @staticmethod
+    def _bound_in_jit(scope: Scope, ctx: FileContext) -> set[str]:
+        """Names bound inside the outermost enclosing jit function: its
+        params and every Store target in its subtree."""
+        outer = next((f for f in scope.func_stack if id(f) in ctx.jit_funcs),
+                     None)
+        if outer is None:
+            return set()
+        bound: set[str] = set()
+        for f in scope.func_stack[scope.func_stack.index(outer):]:
+            a = f.args
+            for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+                bound.add(p.arg)
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+        for n in ast.walk(outer):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                bound.add(n.id)
+        return bound

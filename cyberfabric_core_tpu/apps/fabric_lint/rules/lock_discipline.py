@@ -1,0 +1,156 @@
+"""LK — lock-discipline. The scheduler/pool classes in runtime/ are shared
+between the event loop, the scheduler thread, and callers' threads. A class
+that declares a ``threading.Lock`` attribute thereby *declares a lock scope*:
+the attributes it writes under ``with self.<lock>:`` are the shared state
+that lock protects. Writing one of those attributes anywhere else (outside
+``__init__``, which happens-before thread start) is a data race the type
+system cannot see.
+
+The guarded-attribute set is DERIVED per class, not hand-listed, so the rule
+tracks the code: add a locked write site and every unlocked write to the
+same attribute lights up.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from ..engine import FileContext, Finding, Rule, dotted_name, register
+
+RUNTIME_TIERS = frozenset({"runtime"})
+
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock", "threading.Condition",
+                   "Lock", "RLock", "Condition"}
+
+_MUTATORS = {"append", "extend", "add", "update", "insert", "remove",
+             "discard", "setdefault", "clear", "pop", "popitem"}
+
+_BLOCK_STMTS = (ast.If, ast.For, ast.AsyncFor, ast.While, ast.Try,
+                ast.AsyncWith, ast.Match)
+
+
+def _self_attr_of(expr: ast.AST) -> str | None:
+    """``self.attr`` (possibly behind subscripts) -> "attr"."""
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+def _shallow_exprs(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """Expression nodes evaluated by this statement itself — nested statement
+    blocks are walked separately (their lock context can differ)."""
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+            continue
+        yield from ast.walk(child)
+
+
+def _attrs_written(stmt: ast.stmt) -> Iterator[tuple[str, ast.AST]]:
+    """Yield (attr, node) for every write to ``self.<attr>`` this statement
+    performs: assignment targets and mutating method calls."""
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        attr = _self_attr_of(t)
+        if attr is not None:
+            yield attr, stmt
+    for expr in _shallow_exprs(stmt):
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr in _MUTATORS:
+            attr = _self_attr_of(expr.func.value)
+            if attr is not None:
+                yield attr, expr
+
+
+class _ClassAudit:
+    """One class's lock discipline: collect lock attrs, derive the guarded
+    set from locked writes, then flag unlocked writes to guarded attrs."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.lock_attrs: set[str] = set()
+        self.guarded: set[str] = set()
+        #: (attr, node, method_name) for writes outside any lock block
+        self.unlocked_writes: list[tuple[str, ast.AST, str]] = []
+        self._collect_lock_attrs()
+        if self.lock_attrs:
+            for method in self._methods():
+                self._scan(method.body, method.name, in_lock=False)
+
+    def _methods(self):
+        return [n for n in self.cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def _collect_lock_attrs(self) -> None:
+        for method in self._methods():
+            for node in ast.walk(method):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and dotted_name(node.value.func) in _LOCK_FACTORIES:
+                    for t in node.targets:
+                        attr = _self_attr_of(t)
+                        if attr is not None:
+                            self.lock_attrs.add(attr)
+
+    def _holds_our_lock(self, with_node: ast.With) -> bool:
+        for item in with_node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func
+            if _self_attr_of(expr) in self.lock_attrs:
+                return True
+        return False
+
+    def _scan(self, body: list[ast.stmt], method: str, in_lock: bool) -> None:
+        for stmt in body:
+            for attr, node in _attrs_written(stmt):
+                if in_lock:
+                    self.guarded.add(attr)
+                else:
+                    self.unlocked_writes.append((attr, node, method))
+            if isinstance(stmt, ast.With):
+                self._scan(stmt.body, method,
+                           in_lock or self._holds_our_lock(stmt))
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def's body runs later, outside the lock
+                self._scan(stmt.body, method, in_lock=False)
+            elif isinstance(stmt, _BLOCK_STMTS):
+                for blocks in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, blocks, None)
+                    if isinstance(sub, list):
+                        self._scan(sub, method, in_lock)
+                for handler in getattr(stmt, "handlers", []):
+                    self._scan(handler.body, method, in_lock)
+                for case in getattr(stmt, "cases", []):
+                    self._scan(case.body, method, in_lock)
+
+
+@register
+class LK01(Rule):
+    id = "LK01"
+    family = "LK"
+    severity = "error"
+    description = ("write to a lock-guarded attribute outside the declared "
+                   "lock scope (scheduler/pool classes)")
+    tiers = RUNTIME_TIERS
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            audit = _ClassAudit(node)
+            for attr, write, method in audit.unlocked_writes:
+                if method == "__init__" or attr not in audit.guarded:
+                    continue
+                yield self.finding(
+                    write, f"{node.name}.{method} writes self.{attr} outside "
+                    f"the lock scope that guards it elsewhere in the class "
+                    "— take the lock, or move the attribute out of the "
+                    "guarded set everywhere")

@@ -69,3 +69,29 @@ def init_panic_hook() -> None:
         sys.__excepthook__(exc_type, exc, tb)
 
     sys.excepthook = hook
+
+
+def observe_task(task, name: str, logger: Optional[str] = None):
+    """The asyncio analogue of the panic hook: a done-callback that logs the
+    exception a background task would otherwise swallow at GC time.
+
+    The event loop only weak-refs tasks, and ``Task.exception()`` is consumed
+    by nobody for fire-and-forget work — the failure surfaces (if ever) as a
+    cryptic "exception was never retrieved" at interpreter exit. Every spawn
+    site must retain the task reference AND route failures through here
+    (fabric-lint AS02 flags the discard half; this is the observe half).
+
+    Returns the task so spawn sites can chain: ``self._t = observe_task(...)``.
+    """
+
+    def _observed(t) -> None:
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is not None:
+            logging.getLogger(logger or name).error(
+                "background task %r died: %s", name, exc,
+                exc_info=(type(exc), exc, exc.__traceback__))
+
+    task.add_done_callback(_observed)
+    return task

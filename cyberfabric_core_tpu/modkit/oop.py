@@ -26,6 +26,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from .cancellation import CancellationToken
+from .logging_host import observe_task
 from .transport_grpc import DirectoryClient, JsonGrpcServer
 
 logger = logging.getLogger("oop")
@@ -68,7 +69,9 @@ class LocalProcessBackend:
             async for line in proc.stdout:
                 logger.info("[oop:%s] %s", module_name, line.decode().rstrip())
 
-        entry = OopProcess(module_name, proc, asyncio.ensure_future(forward_logs()))
+        entry = OopProcess(module_name, proc, observe_task(
+            asyncio.ensure_future(forward_logs()),
+            f"oop.log_forwarder.{module_name}", logger="modkit.oop"))
         self.processes.append(entry)
         logger.info("spawned oop module %s (pid %d)", module_name, proc.pid)
         return entry

@@ -16,6 +16,7 @@ from typing import Optional
 from ..modkit import Module, ReadySignal, module
 from ..modkit.contracts import RunnableCapability, SystemCapability
 from ..modkit.context import ModuleCtx
+from ..modkit.logging_host import observe_task
 from ..modkit.transport_grpc import (
     DIRECTORY_SERVICE,
     DirectoryService,
@@ -64,11 +65,20 @@ class GrpcHubModule(Module, SystemCapability, RunnableCapability):
         ctx.system["directory_endpoint"] = self.endpoint
 
         async def evict_loop() -> None:
+            import logging
+
             while not ctx.cancellation_token.is_cancelled:
                 await asyncio.sleep(self.config.eviction_interval_s)
-                self.directory.evict_stale()
+                try:
+                    self.directory.evict_stale()
+                except Exception:  # noqa: BLE001 — a bad tick must not end eviction
+                    logging.getLogger("grpc_hub").exception("evict tick failed")
 
-        self._evict_task = asyncio.ensure_future(evict_loop())
+        # a crash that still escapes the loop (e.g. in the sleep) would
+        # black-hole the exception — observe_task logs the death
+        self._evict_task = observe_task(asyncio.ensure_future(evict_loop()),
+                                        "grpc_hub.evict_loop",
+                                        logger="grpc_hub")
         ready.notify_ready()
 
     async def stop(self, ctx: ModuleCtx) -> None:

@@ -32,6 +32,7 @@ from ...modkit.db import ScopableEntity
 from ...modkit.errcat import ERR
 from ...modkit.errors import Problem, ProblemError
 from ...modkit.lifecycle import ReadySignal
+from ...modkit.logging_host import observe_task
 from ...modkit.security import SecurityContext
 from ...modkit.sse import SSE_DONE, format_sse_json
 from ...gateway.middleware import SECURITY_CONTEXT_KEY
@@ -710,7 +711,11 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
                 job["status"], job["error"] = "failed", {"detail": str(e)}
             self.jobs.persist(ctx, job)
 
-        task = asyncio.ensure_future(run())
+        # run() persists terminal state itself, but a failure in persist (or
+        # anything after the except arms) would be swallowed at GC time —
+        # observe_task routes it through the logging host
+        task = observe_task(asyncio.ensure_future(run()),
+                            f"llm_gateway.job.{job['id']}", logger="llm_gateway")
         job["_task"] = task
         self._job_tasks.add(task)
         task.add_done_callback(self._job_tasks.discard)
@@ -821,7 +826,9 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             batch["status"] = "failed" if failed == len(batch["requests"]) else "completed"
             self._persist_batch(ctx, batch)
 
-        task = asyncio.ensure_future(run())
+        task = observe_task(asyncio.ensure_future(run()),
+                            f"llm_gateway.batch.{batch['id']}",
+                            logger="llm_gateway")
         self._job_tasks.add(task)
         task.add_done_callback(self._job_tasks.discard)
 

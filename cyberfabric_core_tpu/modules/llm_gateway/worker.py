@@ -25,6 +25,7 @@ from typing import Any, AsyncIterator, Optional
 
 from ...modkit.errcat import ERR
 from ...modkit.errors import ProblemError
+from ...modkit.logging_host import observe_task
 from ...runtime.engine import EngineConfig, InferenceEngine, SamplingParams, StepEvent
 from ...runtime.scheduler import ContinuousBatchingEngine
 from ...runtime.tokenizer import (CHAT_FAMILIES, ByteTokenizer, Tokenizer,
@@ -84,7 +85,11 @@ class _DynamicBatcher:
 
     def ensure_running(self) -> None:
         if self._task is None or self._task.done():
-            self._task = asyncio.ensure_future(self._run())
+            # a crash in the batching loop between requests would otherwise
+            # be swallowed until close() awaits the task
+            self._task = observe_task(asyncio.ensure_future(self._run()),
+                                      "llm_gateway.batch_worker",
+                                      logger="llm_gateway")
 
     async def submit(self, req: _Request) -> None:
         self._pending.append(req)

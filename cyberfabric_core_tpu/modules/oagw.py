@@ -32,6 +32,7 @@ from ..modkit.context import ModuleCtx
 from ..modkit.db import ScopableEntity
 from ..modkit.errcat import ERR
 from ..modkit.errors import Problem, ProblemError
+from ..modkit.logging_host import observe_task
 from ..modkit.security import SecurityContext
 from ..gateway.middleware import SECURITY_CONTEXT_KEY
 from ..gateway.validation import read_json
@@ -567,16 +568,9 @@ class OagwModule(Module, DatabaseCapability, RestApiCapability):
         # phase is the first hook guaranteed to see types_registry). The task
         # ref is held on self — the loop only weak-refs tasks — and failures
         # are logged rather than dying unobserved at GC time.
-        self._gts_task = asyncio.ensure_future(self._provision_gts_types(ctx))
-
-        def _log_provision_failure(task: asyncio.Task) -> None:
-            if not task.cancelled() and task.exception() is not None:
-                import logging
-
-                logging.getLogger("oagw").error(
-                    "GTS type provisioning failed: %s", task.exception())
-
-        self._gts_task.add_done_callback(_log_provision_failure)
+        self._gts_task = observe_task(
+            asyncio.ensure_future(self._provision_gts_types(ctx)),
+            "oagw.gts_provisioning", logger="oagw")
 
         async def create_upstream(request: web.Request):
             body = await read_json(request)

@@ -41,6 +41,7 @@ from ..modkit.db import ScopableEntity
 from ..modkit.errcat import ERR
 from ..modkit.errors import ProblemError
 from ..modkit.lifecycle import ReadySignal
+from ..modkit.logging_host import observe_task
 from ..modkit.security import SecurityContext
 from ..gateway.middleware import SECURITY_CONTEXT_KEY
 from ..gateway.validation import read_json
@@ -371,7 +372,12 @@ class ServerlessService(ServerlessApi):
         return {"record": record, "dry_run": False, "cached": False}
 
     def _spawn(self, ctx: SecurityContext, ep: dict, inv: dict) -> None:
-        task = asyncio.ensure_future(self._execute(ctx, ep, inv))
+        # _execute persists failures itself; observe_task catches what slips
+        # past it (a crash in the persistence path would otherwise be
+        # swallowed at GC time)
+        task = observe_task(
+            asyncio.ensure_future(self._execute(ctx, ep, inv)),
+            f"serverless.invocation.{inv['id']}", logger="serverless")
         self._tasks[inv["id"]] = task
         self._task_tenants[inv["id"]] = ctx.tenant_id
 
@@ -781,7 +787,9 @@ class ServerlessRuntimeModule(Module, DatabaseCapability, RestApiCapability,
                     logging.getLogger("serverless").exception("scheduler tick failed")
                 await asyncio.sleep(0.25)
 
-        self._loop_task = asyncio.ensure_future(loop())
+        self._loop_task = observe_task(asyncio.ensure_future(loop()),
+                                       "serverless.scheduler_loop",
+                                       logger="serverless")
         ready.notify_ready()
 
     async def stop(self, ctx: ModuleCtx) -> None:

@@ -24,6 +24,12 @@ if not os.environ.get("RUN_TPU_TESTS"):
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running gates excluded from the tier-1 `-m 'not slow'` run")
+
+
 @pytest.fixture()
 def client_hub():
     from cyberfabric_core_tpu.modkit import ClientHub

@@ -1,569 +1,264 @@
-"""Architectural lint (dylint-equivalent enforcement, SURVEY §2.5).
+"""Architectural lint driver (dylint-equivalent enforcement, SURVEY §2.5).
 
-Reference analogue: dylint_lints/ — ALL 8 shipped families have a rule here
-(round-4 verdict item 5): DE01/DE02 (layer purity, L1-L5), DE03 (domain
-purity + domain-model marker), DE05 (client naming + contract versioning),
-DE07 (security, L6), DE08 (REST conventions, L7), DE09 (GTS id usage in
-source; the docs leg is apps/gts_docs_validator), DE13 (common patterns:
-no print in production code), plus EC01 (error catalog). Every new family
-carries a failing fixture (dylint ui-test parity). Python-tier rules
-enforced by AST scan:
+The checks themselves moved onto the fabric-lint engine
+(cyberfabric_core_tpu/apps/fabric_lint/rules/design.py) — this file is the
+thin pytest driver that keeps every family green on the live package, with
+one failing fixture per family (dylint ui-test parity). Rule mapping:
 
-L1  modkit (the substrate) never imports upward (gateway/, modules/).
-L2  sqlite3 is touched ONLY by modkit/db.py — "no plain SQL outside the
-    secure ORM" (reference: advisory_locks.rs:6-9 policy).
-L3  The compute tier (models/, ops/, parallel/) never imports the serving
-    tier (modules/, gateway/) — kernels stay host-framework-free.
-L4  Business modules use only the gateway's public seams
-    (gateway.middleware, gateway.validation); from gateway.module only
-    contract types (*Api) — router/openapi internals are off limits.
-L5  Modules talk to each other through ClientHub SDK traits (.sdk), never
-    by importing a sibling module's implementation (package-internal files
-    and __init__ re-exports excepted).
+DE01  layer purity: L1 modkit never imports upward (gateway/, modules/);
+      L3 the compute tier (models/, ops/, parallel/) never imports the
+      serving tier — kernels stay host-framework-free.
+DE02  L2 sqlite3 is touched ONLY by the modkit DB boundary — "no plain SQL
+      outside the secure ORM" (reference: advisory_locks.rs:6-9 policy).
+DE03  domain purity: DE0301 no-infra / DE0308 no-transport in runtime/,
+      models/, ops/, parallel/; DE0309 domain data types are @dataclass.
+DE04  L4 business modules use only the gateway's public seams
+      (gateway.middleware, gateway.validation; *Api contract types).
+DE05  client layer: DE0503 Api-suffixed SDK traits + contract-typed hub
+      resolution, DE0504 versioned service contracts, L5 modules talk
+      through ClientHub SDK traits (.sdk).
+DE07  security: raw-connection escape hatches confined; SecretString never
+      string-formatted.
+DE08  REST conventions. DE09 GTS identifier validity. DE13 no print().
+EC01  error codes come from the catalog; every namespace referenced.
+
+The AS/JP/LK semantic families live in tests/test_fabric_lint.py.
 """
 
-import ast
+from functools import lru_cache
 from pathlib import Path
+
+from cyberfabric_core_tpu.apps.fabric_lint import Engine, all_rules
 
 PKG = Path(__file__).resolve().parents[1] / "cyberfabric_core_tpu"
 
-
-def _imports(path: Path):
-    """Yield (level, module, names) for every import in the file."""
-    tree = ast.parse(path.read_text(), filename=str(path))
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom):
-            yield node.level, node.module or "", [a.name for a in node.names]
-        elif isinstance(node, ast.Import):
-            for a in node.names:
-                yield 0, a.name, []
+_DESIGN_FAMILIES = ("DE", "EC")
 
 
-def _resolve(path: Path, level: int, module: str) -> str:
-    """Absolute dotted module for a (possibly relative) import."""
-    if level == 0:
-        return module
-    parts = path.relative_to(PKG.parent).with_suffix("").parts
-    base = list(parts[:-1])
-    up = base[: len(base) - (level - 1)] if level > 1 else base
-    return ".".join(up + ([module] if module else []))
+@lru_cache(maxsize=1)
+def _repo_findings():
+    """One engine pass over the live package, shared by every test here."""
+    engine = Engine(all_rules()).select(_DESIGN_FAMILIES)
+    return tuple(f for f in engine.run(PKG) if not f.suppressed)
 
 
-def _scan(root: Path):
-    for path in sorted(root.rglob("*.py")):
-        for level, module, names in _imports(path):
-            yield path, _resolve(path, level, module), names
+def _findings(rule: str, contains: str = "", path_prefix: str = ""):
+    return [f for f in _repo_findings()
+            if f.rule == rule and contains in f.message
+            and f.path.startswith(path_prefix)]
+
+
+def _fmt(findings):
+    return "\n".join(f"{f.path}:{f.line} {f.rule} {f.message}"
+                     for f in findings)
+
+
+def _lint_snippet(source: str, relpath: str, tier: str, select=("DE", "EC")):
+    engine = Engine(all_rules()).select(select)
+    return [f for f in engine.run_source(source, relpath=relpath, tier=tier)
+            if not f.suppressed]
+
+
+# ----------------------------------------------------------- layer purity
 
 
 def test_L1_modkit_never_imports_upward():
-    bad = [(p, m) for p, m, _ in _scan(PKG / "modkit")
-           if ".gateway" in m or ".modules" in m]
-    assert not bad, f"modkit imports upward: {bad}"
+    bad = _findings("DE01", path_prefix="modkit/")
+    assert not bad, f"modkit imports upward:\n{_fmt(bad)}"
 
 
 def test_L2_sqlite_only_in_db():
     """Driver imports live in the engine layer only (db_engine.py owns the
     backends; db.py owns the secure ORM above them)."""
-    bad = [(p, m) for p, m, _ in _scan(PKG)
-           if m.split(".")[0] == "sqlite3"
-           and p.name not in ("db.py", "db_engine.py")]
-    assert not bad, (
-        f"sqlite3 outside the modkit DB boundary (db.py/db_engine.py): {bad}")
+    bad = _findings("DE02")
+    assert not bad, f"sqlite3 outside the modkit DB boundary:\n{_fmt(bad)}"
 
 
 def test_L3_compute_tier_is_serving_free():
     for tier in ("models", "ops", "parallel"):
-        bad = [(p, m) for p, m, _ in _scan(PKG / tier)
-               if ".modules" in m or ".gateway" in m or ".modkit" in m]
-        assert not bad, f"compute tier {tier}/ imports serving tier: {bad}"
+        bad = _findings("DE01", path_prefix=f"{tier}/")
+        assert not bad, f"compute tier {tier}/ imports serving tier:\n{_fmt(bad)}"
 
 
 def test_L4_modules_use_only_public_gateway_seams():
-    allowed_submodules = {"cyberfabric_core_tpu.gateway.middleware",
-                          "cyberfabric_core_tpu.gateway.validation"}
-    violations = []
-    for path, mod, names in _scan(PKG / "modules"):
-        if ".gateway" not in mod:
-            continue
-        if path.name == "__init__.py":
-            continue  # registration re-export is the sanctioned exception
-        if mod in allowed_submodules:
-            continue
-        if mod == "cyberfabric_core_tpu.gateway.module" and all(
-                n.endswith("Api") for n in names):
-            continue  # contract ABCs only
-        violations.append((str(path.relative_to(PKG)), mod, names))
-    assert not violations, (
+    bad = _findings("DE04")
+    assert not bad, (
         "modules may import only gateway.middleware/gateway.validation "
-        f"(or *Api contracts): {violations}")
+        f"(or *Api contracts):\n{_fmt(bad)}")
 
 
 def test_L5_cross_module_calls_go_through_sdk():
-    module_files = {p.stem for p in (PKG / "modules").glob("*.py")} - {
-        "__init__", "sdk"}
-    violations = []
-    for path, mod, names in _scan(PKG / "modules"):
-        if path.name == "__init__.py":
-            continue
-        parts = mod.split(".")
-        if (len(parts) >= 3 and parts[-2] == "modules"
-                and parts[-1] in module_files and parts[-1] != "sdk"):
-            target = parts[-1]
-            # same-family implementation detail files are allowed
-            if target.startswith(path.stem) or path.stem.startswith(target):
-                continue
-            violations.append((str(path.relative_to(PKG)), mod))
-    assert not violations, (
-        f"cross-module implementation imports (use ClientHub/.sdk): {violations}")
+    bad = _findings("DE05", contains="cross-module")
+    assert not bad, (
+        f"cross-module implementation imports (use ClientHub/.sdk):\n{_fmt(bad)}")
 
 
-def _calls(path: Path):
-    """Yield every ast.Call in a file."""
-    tree = ast.parse(path.read_text(), filename=str(path))
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            yield node
+def test_L1_fixture_fails():
+    bad = _lint_snippet(
+        "from cyberfabric_core_tpu.gateway import router\n",
+        relpath="modkit/helper.py", tier="modkit", select=("DE01",))
+    assert [f.rule for f in bad] == ["DE01"]
+
+
+# --------------------------------------------------------------- security
 
 
 def test_L6_security_raw_connection_confined():
     """DE07 equivalent (security lint): the raw-connection escape hatches
     (`raw_connection()`, `raw_for_migrations()`) are callable only inside the
-    modkit DB boundary — 'no plain SQL outside migrations'
-    (reference advisory_locks.rs:6-9, dylint DE07)."""
-    allowed = {"db.py", "db_engine.py"}
-    violations = []
-    for path in sorted(PKG.rglob("*.py")):
-        if path.name in allowed:
-            continue
-        for call in _calls(path):
-            fn = call.func
-            if (isinstance(fn, ast.Attribute)
-                    and fn.attr in ("raw_connection", "raw_for_migrations")):
-                violations.append((str(path.relative_to(PKG)), fn.attr))
-    assert not violations, (
-        f"raw DB connection access outside modkit/db: {violations}")
+    modkit DB boundary — 'no plain SQL outside migrations'."""
+    bad = _findings("DE07", contains="raw DB connection")
+    assert not bad, f"raw DB connection access outside modkit/db:\n{_fmt(bad)}"
 
 
 def test_L6_secret_string_never_interpolated():
     """DE07 equivalent: SecretString.expose() is the only sanctioned reveal,
-    and it must never feed a string-formatting expression directly (an
-    f-string / str.format / % would put the secret in a rendered string that
-    can reach logs)."""
-    violations = []
-    for path in sorted(PKG.rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            # f-string with .expose() inside
-            if isinstance(node, ast.JoinedStr):
-                for v in ast.walk(node):
-                    if (isinstance(v, ast.Call)
-                            and isinstance(v.func, ast.Attribute)
-                            and v.func.attr == "expose"):
-                        violations.append(
-                            (str(path.relative_to(PKG)), "f-string"))
-            # "...".format(x.expose())
-            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
-                    and node.func.attr == "format":
-                for a in list(node.args) + [k.value for k in node.keywords]:
-                    for v in ast.walk(a):
-                        if (isinstance(v, ast.Call)
-                                and isinstance(v.func, ast.Attribute)
-                                and v.func.attr == "expose"):
-                            violations.append(
-                                (str(path.relative_to(PKG)), ".format"))
-            # "%s" % x.expose()
-            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
-                for v in ast.walk(node.right):
-                    if (isinstance(v, ast.Call)
-                            and isinstance(v.func, ast.Attribute)
-                            and v.func.attr == "expose"):
-                        violations.append(
-                            (str(path.relative_to(PKG)), "%-format"))
-    assert not violations, (
-        f"SecretString revealed inside string formatting: {violations}")
+    and it must never feed a string-formatting expression directly."""
+    bad = _findings("DE07", contains="SecretString")
+    assert not bad, f"SecretString revealed inside string formatting:\n{_fmt(bad)}"
+
+
+def test_L6_fixture_fails():
+    bad = _lint_snippet(
+        'def show(s):\n    return f"key={s.expose()}"\n',
+        relpath="modules/m.py", tier="modules", select=("DE07",))
+    assert [f.rule for f in bad] == ["DE07"]
+
+
+# ------------------------------------------------------- REST conventions
 
 
 def test_L7_rest_route_conventions():
-    """DE08 equivalent (REST conventions lint): every registered route uses a
-    known HTTP verb, is rooted at /v1/ (or a sanctioned infra path), has no
-    trailing slash, and uses lowercase kebab/snake segments with {snake_case}
-    params."""
-    import re as _re
+    """DE08 equivalent: every registered route uses a known HTTP verb, is
+    rooted at /v1/ (or a sanctioned infra path), has no trailing slash, and
+    uses lowercase kebab/snake segments with {snake_case} params."""
+    bad = _findings("DE08")
+    assert not bad, f"REST convention violations:\n{_fmt(bad)}"
 
-    INFRA = {"/metrics", "/health", "/healthz", "/openapi.json", "/docs"}
-    VERBS = {"GET", "POST", "PUT", "PATCH", "DELETE"}
-    seg_re = _re.compile(r"^(?:[a-z0-9][a-z0-9_\-.]*|\{[a-z][a-z0-9_]*\})$")
-    violations = []
-    for path in sorted(PKG.rglob("*.py")):
-        for call in _calls(path):
-            fn = call.func
-            if not (isinstance(fn, ast.Attribute) and fn.attr == "operation"):
-                continue
-            if len(call.args) < 2:
-                continue
-            method, route = call.args[0], call.args[1]
-            if not (isinstance(method, ast.Constant) and isinstance(route, ast.Constant)):
-                continue
-            m, r = method.value, route.value
-            where = (str(path.relative_to(PKG)), m, r)
-            if m not in VERBS:
-                violations.append((*where, "unknown verb"))
-                continue
-            if r in INFRA:
-                continue
-            if not r.startswith("/v1/"):
-                violations.append((*where, "not rooted at /v1/"))
-            if r != "/" and r.endswith("/"):
-                violations.append((*where, "trailing slash"))
-            for seg in r.strip("/").split("/")[1:]:
-                if seg.startswith(":"):
-                    continue  # :control-style action segments
-                if not seg_re.match(seg):
-                    violations.append((*where, f"bad segment {seg!r}"))
-    assert not violations, f"REST convention violations: {violations}"
+
+def test_L7_fixture_fails():
+    bad = _lint_snippet(
+        'def reg(api):\n'
+        '    api.operation("GET", "/legacy/Thing/")\n',
+        relpath="modules/m.py", tier="modules", select=("DE08",))
+    assert len(bad) >= 2  # not /v1/-rooted AND trailing slash AND bad casing
+
+
+# ---------------------------------------------------------- error catalog
 
 
 def test_EC01_error_codes_come_from_the_catalog():
-    """EC01 (declare_errors! parity): Problem/ProblemError call sites must not
-    invent error codes as string literals — codes live in
-    modkit/catalogs/errors.json and are referenced as typed constants
-    (modkit/errcat.ERR). Allowed exceptions: the catalog layer itself
-    (errcat.py) and the convenience-constructor plumbing in errors.py."""
-    allowed = {PKG / "modkit" / "errcat.py", PKG / "modkit" / "errors.py"}
-    violations = []
-    for path in sorted(PKG.rglob("*.py")):
-        if path in allowed:
-            continue
-        tree = ast.parse(path.read_text())
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            name = (fn.attr if isinstance(fn, ast.Attribute)
-                    else fn.id if isinstance(fn, ast.Name) else "")
-            is_problem_call = name in ("Problem", "ProblemError") or (
-                isinstance(fn, ast.Attribute)
-                and isinstance(fn.value, ast.Name)
-                and fn.value.id == "ProblemError")
-            if not is_problem_call:
-                continue
-            for kw in node.keywords:
-                if kw.arg == "code" and isinstance(kw.value, ast.Constant) \
-                        and isinstance(kw.value.value, str):
-                    violations.append(
-                        f"{path.relative_to(PKG)}:{node.lineno} "
-                        f"literal code={kw.value.value!r}")
-    assert not violations, (
-        "error codes must come from modkit/catalogs/errors.json via "
-        f"errcat.ERR — literal codes found: {violations}")
+    """EC01 (declare_errors! parity): Problem/ProblemError call sites must
+    not invent error codes as string literals — codes live in
+    modkit/catalogs/errors.json and are referenced via errcat.ERR."""
+    bad = _findings("EC01", contains="literal error code")
+    assert not bad, f"literal error codes found:\n{_fmt(bad)}"
 
 
 def test_EC01_catalog_codes_are_actually_used():
     """The inverse direction: every catalog namespace is referenced somewhere
     (a dead namespace means the catalog and the code drifted apart)."""
-    import json
-
-    catalog = json.loads(
-        (PKG / "modkit" / "catalogs" / "errors.json").read_text())
-    source = "\n".join(p.read_text() for p in PKG.rglob("*.py"))
-    unused = [ns for ns in catalog if f"ERR.{ns}." not in source]
-    assert not unused, f"catalog namespaces never referenced: {unused}"
+    bad = _findings("EC01", contains="never referenced")
+    assert not bad, f"catalog namespaces never referenced:\n{_fmt(bad)}"
 
 
-# --------------------------------------------------------------------------
-# DE03 — domain purity (round-4 verdict item 5).
-# Reference: dylint_lints/de03_domain_layer: DE0301 no-infra-in-domain,
-# DE0308 no-http-in-domain, DE0309 must-have-domain-model. The Python-tier
-# domain is the device/compute stack (runtime/, models/, ops/, parallel/):
-# pure serving logic that must stay transport- and storage-agnostic so it can
-# run under a gRPC worker, the REST host, or a bare script identically.
-
-_DOMAIN_TIERS = ("runtime", "models", "ops", "parallel")
-_TRANSPORT_TOPLEVEL = {"aiohttp", "grpc"}       # DE0308: HTTP/RPC types
-_INFRA_TOPLEVEL = {"sqlite3", "psycopg", "pymysql"}  # DE0301: storage drivers
+def test_EC01_fixture_fails():
+    bad = _lint_snippet(
+        'def boom(Problem):\n'
+        '    raise Problem(code="made_up_code", title="nope")\n',
+        relpath="modules/m.py", tier="modules", select=("EC01",))
+    assert [f.rule for f in bad] == ["EC01"]
 
 
-def _de03_violations(scan):
-    out = []
-    for path, mod, _ in scan:
-        top = mod.split(".")[0]
-        if top in _TRANSPORT_TOPLEVEL:
-            out.append((str(path), mod, "DE0308 transport type in domain"))
-        if top in _INFRA_TOPLEVEL:
-            out.append((str(path), mod, "DE0301 infrastructure in domain"))
-    return out
+# -------------------------------------------------------------------- DE03
 
 
 def test_DE03_domain_tiers_are_transport_and_infra_free():
-    for tier in _DOMAIN_TIERS:
-        bad = _de03_violations(_scan(PKG / tier))
-        assert not bad, f"domain tier {tier}/ violates DE03: {bad}"
+    bad = _findings("DE03", contains="DE030")  # DE0301 + DE0308
+    assert not bad, f"domain tier violates DE03:\n{_fmt(bad)}"
 
 
 def test_DE03_fixture_fails():
     """The rule actually fires (dylint ui-test parity): a domain file that
     imports aiohttp or sqlite3 must be flagged."""
-    import tempfile
-
-    with tempfile.TemporaryDirectory() as d:
-        bad_file = Path(d) / "domain_mod.py"
-        bad_file.write_text("import aiohttp\nimport sqlite3\n")
-        scan = [(bad_file, mod, names)
-                for level, mod, names in _imports(bad_file)]
-        bad = _de03_violations(scan)
-        assert len(bad) == 2, bad
-
-
-def _de03_model_violations(paths):
-    """DE0309 equivalent: domain DATA types (classes named *Config, *Params,
-    *Result, *Event, *Stats) must be @dataclass — the marker that keeps them
-    plain data, mirrors the reference's #[domain_model] attribute."""
-    suffixes = ("Config", "Params", "Result", "Event", "Stats")
-    out = []
-    for path in paths:
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ClassDef):
-                continue
-            if not node.name.endswith(suffixes):
-                continue
-            deco_names = {
-                (d.id if isinstance(d, ast.Name)
-                 else d.func.id if isinstance(d, ast.Call)
-                 and isinstance(d.func, ast.Name)
-                 else d.attr if isinstance(d, ast.Attribute) else "")
-                for d in node.decorator_list}
-            if not deco_names & {"dataclass"}:
-                out.append((str(path.name), node.name))
-    return out
+    bad = _lint_snippet(
+        "import aiohttp\nimport sqlite3\n",
+        relpath="runtime/domain_mod.py", tier="runtime", select=("DE03",))
+    assert len(bad) == 2, _fmt(bad)
 
 
 def test_DE03_domain_data_types_are_dataclasses():
-    paths = [p for tier in _DOMAIN_TIERS for p in (PKG / tier).rglob("*.py")]
-    bad = _de03_model_violations(paths)
-    assert not bad, f"domain data types missing @dataclass (DE0309): {bad}"
+    bad = _findings("DE03", contains="DE0309")
+    assert not bad, f"domain data types missing @dataclass (DE0309):\n{_fmt(bad)}"
 
 
 def test_DE03_model_fixture_fails():
-    import tempfile
-
-    with tempfile.TemporaryDirectory() as d:
-        f = Path(d) / "m.py"
-        f.write_text("class FooConfig:\n    pass\n")
-        assert _de03_model_violations([f]) == [("m.py", "FooConfig")]
+    bad = _lint_snippet(
+        "class FooConfig:\n    pass\n",
+        relpath="runtime/m.py", tier="runtime", select=("DE03",))
+    assert len(bad) == 1 and "FooConfig" in bad[0].message
 
 
-# --------------------------------------------------------------------------
-# DE05 — client naming + versioning (round-4 verdict item 5).
-# Reference: dylint_lints/de05_client_layer: DE0503 (client trait suffix
-# consistency in sdk crates), DE0504 (versioned public contracts). Here the
-# ClientHub-wired trait surface lives in modules/sdk.py with the *Api suffix
-# convention, and gRPC service contracts carry proto-style versioned names.
-
-
-def _de05_trait_suffix_violations(path):
-    """Every trait-like class (defines methods, not a @dataclass DTO) in the
-    SDK surface must use the Api suffix; mixed suffixes make the ClientHub
-    registry unreadable (DE0503 rationale)."""
-    out = []
-    tree = ast.parse(path.read_text(), filename=str(path))
-    for node in tree.body:
-        if not isinstance(node, ast.ClassDef):
-            continue
-        deco = {(d.id if isinstance(d, ast.Name) else "")
-                for d in node.decorator_list}
-        if "dataclass" in deco:
-            continue  # DTOs are data, not client traits
-        has_methods = any(isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-                          for n in node.body)
-        if has_methods and not node.name.endswith("Api"):
-            out.append(node.name)
-    return out
+# -------------------------------------------------------------------- DE05
 
 
 def test_DE05_sdk_traits_use_the_api_suffix():
-    bad = _de05_trait_suffix_violations(PKG / "modules" / "sdk.py")
-    assert not bad, f"SDK traits without the Api suffix (DE0503): {bad}"
+    bad = _findings("DE05", contains="DE0503 SDK trait")
+    assert not bad, f"SDK traits without the Api suffix (DE0503):\n{_fmt(bad)}"
 
 
 def test_DE05_suffix_fixture_fails():
-    import tempfile
-
-    with tempfile.TemporaryDirectory() as d:
-        f = Path(d) / "sdk.py"
-        f.write_text("class ThingPluginClient:\n    def call(self): ...\n")
-        assert _de05_trait_suffix_violations(f) == ["ThingPluginClient"]
+    bad = _lint_snippet(
+        "class ThingPluginClient:\n    def call(self): ...\n",
+        relpath="modules/sdk.py", tier="modules", select=("DE05",))
+    assert len(bad) == 1 and "ThingPluginClient" in bad[0].message
 
 
 def test_DE05_hub_resolution_uses_contract_types():
     """hub.get/try_get must resolve *Api contract types only — resolving a
     concrete class through the hub bypasses the SDK seam."""
-    violations = []
-    for path in sorted((PKG / "modules").rglob("*.py")) + \
-            sorted((PKG / "gateway").rglob("*.py")):
-        for call in _calls(path):
-            fn = call.func
-            if not (isinstance(fn, ast.Attribute)
-                    and fn.attr in ("get", "try_get")):
-                continue
-            holder = fn.value
-            holder_name = (holder.id if isinstance(holder, ast.Name)
-                           else holder.attr if isinstance(holder, ast.Attribute)
-                           else "")
-            if "hub" not in holder_name:
-                continue
-            if not call.args:
-                continue
-            arg = call.args[0]
-            if isinstance(arg, ast.Name) and not arg.id.endswith("Api"):
-                violations.append(
-                    (str(path.relative_to(PKG)), call.lineno, arg.id))
-    assert not violations, (
-        f"ClientHub resolution of non-contract types (DE0503): {violations}")
-
-
-def _de05_service_version_violations(paths):
-    """DE0504 equivalent: every *_SERVICE contract name is versioned
-    (pkg.vN.Service) so parallel versions/upgrades stay expressible."""
-    import re as _re
-
-    pat = _re.compile(r"^[a-z][\w.]*\.v\d+\.\w+$")
-    out = []
-    for path in paths:
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Assign):
-                continue
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name) and tgt.id.endswith("_SERVICE") \
-                        and isinstance(node.value, ast.Constant) \
-                        and isinstance(node.value.value, str) \
-                        and not pat.match(node.value.value):
-                    out.append((str(path.name), tgt.id, node.value.value))
-    return out
+    bad = _findings("DE05", contains="hub resolution")
+    assert not bad, f"ClientHub resolution of non-contract types:\n{_fmt(bad)}"
 
 
 def test_DE05_grpc_service_contracts_are_versioned():
-    bad = _de05_service_version_violations(sorted(PKG.rglob("*.py")))
-    assert not bad, f"unversioned gRPC service contracts (DE0504): {bad}"
+    bad = _findings("DE05", contains="DE0504")
+    assert not bad, f"unversioned gRPC service contracts (DE0504):\n{_fmt(bad)}"
 
 
 def test_DE05_version_fixture_fails():
-    import tempfile
-
-    with tempfile.TemporaryDirectory() as d:
-        f = Path(d) / "svc.py"
-        f.write_text('FOO_SERVICE = "foo.FooService"\n')
-        assert _de05_service_version_violations([f]) == [
-            ("svc.py", "FOO_SERVICE", "foo.FooService")]
+    bad = _lint_snippet(
+        'FOO_SERVICE = "foo.FooService"\n',
+        relpath="modules/svc.py", tier="modules", select=("DE05",))
+    assert len(bad) == 1 and "FOO_SERVICE" in bad[0].message
 
 
-# --------------------------------------------------------------------------
-# DE09 — GTS identifier usage in source (round-4 verdict item 5).
-# Reference: dylint_lints/de09_gts_layer DE0901 (validate every GTS-looking
-# string literal in source). The docs leg (DE0903) is apps/gts_docs_validator.
-
-
-def _de09_gts_literal_violations(paths):
-    from cyberfabric_core_tpu.apps.gts_docs_validator import validate_gts_id
-
-    out = []
-    for path in paths:
-        tree = ast.parse(path.read_text(), filename=str(path))
-        joined_consts = {
-            id(c) for node in ast.walk(tree) if isinstance(node, ast.JoinedStr)
-            for c in ast.walk(node) if isinstance(c, ast.Constant)}
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Constant) or id(node) in joined_consts:
-                continue
-            v = node.value
-            if not isinstance(v, str):
-                continue
-            raw = v[6:] if v.startswith("gts://") else v
-            # complete-looking ids only: fragments/prefixes/regexes are not
-            # identifiers (the docs validator applies the same candidate rule)
-            if not raw.startswith("gts.") or raw.count(".") < 4 \
-                    or "*" in raw or "[" in raw or " " in raw:
-                continue
-            errors = validate_gts_id(raw)
-            if errors:
-                out.append((str(path.name), node.lineno, v, errors))
-    return out
+# -------------------------------------------------------------------- DE09
 
 
 def test_DE09_gts_literals_in_source_are_valid():
-    paths = [p for p in sorted(PKG.rglob("*.py"))
-             if "gts_docs_validator" not in p.name]
-    bad = _de09_gts_literal_violations(paths)
-    assert not bad, f"malformed GTS identifiers in source (DE0901): {bad}"
+    bad = _findings("DE09")
+    assert not bad, f"malformed GTS identifiers in source (DE0901):\n{_fmt(bad)}"
 
 
 def test_DE09_fixture_fails():
-    import tempfile
-
-    with tempfile.TemporaryDirectory() as d:
-        f = Path(d) / "g.py"
-        f.write_text('X = "gts.x.core.Bad_Vendor.thing.v1~"\n')
-        bad = _de09_gts_literal_violations([f])
-        assert bad and bad[0][2] == "gts.x.core.Bad_Vendor.thing.v1~"
+    bad = _lint_snippet(
+        'X = "gts.x.core.Bad_Vendor.thing.v1~"\n',
+        relpath="modules/g.py", tier="modules", select=("DE09",))
+    assert len(bad) == 1 and "Bad_Vendor" in bad[0].message
 
 
-# --------------------------------------------------------------------------
-# DE13 — common patterns (round-4 verdict item 5).
-# Reference: dylint_lints/de13_common_patterns DE1301 no-print-macros:
-# production code logs through the logging host (per-module files, levels,
-# redaction) — a bare print() bypasses all of it.
-
-_DE13_EXEMPT_FILES = {"server.py", "__main__.py"}
-
-
-def _de13_print_violations(paths, pkg_root):
-    out = []
-    for path in paths:
-        if path.name in _DE13_EXEMPT_FILES or "apps" in path.parts:
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        # statements under `if __name__ == "__main__":` and inside a
-        # top-level `def main(...)` CLI entry point are the sanctioned print
-        # surface (JSON-line tools; reference exempts bins the same way)
-        main_ranges = []
-        for node in ast.walk(tree):
-            if isinstance(node, ast.If):
-                t = node.test
-                if (isinstance(t, ast.Compare)
-                        and isinstance(t.left, ast.Name)
-                        and t.left.id == "__name__"):
-                    main_ranges.append((node.lineno, node.end_lineno))
-        for node in tree.body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and node.name == "main":
-                main_ranges.append((node.lineno, node.end_lineno))
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
-                    and node.func.id == "print"):
-                if any(a <= node.lineno <= b for a, b in main_ranges):
-                    continue
-                try:
-                    rel = str(path.relative_to(pkg_root))
-                except ValueError:
-                    rel = str(path.name)
-                out.append((rel, node.lineno))
-    return out
+# -------------------------------------------------------------------- DE13
 
 
 def test_DE13_no_print_in_production_code():
-    bad = _de13_print_violations(sorted(PKG.rglob("*.py")), PKG)
-    assert not bad, (
-        f"print() in production code — use logging (DE1301): {bad}")
+    bad = _findings("DE13")
+    assert not bad, f"print() in production code — use logging (DE1301):\n{_fmt(bad)}"
 
 
 def test_DE13_fixture_fails():
-    import tempfile
-
-    with tempfile.TemporaryDirectory() as d:
-        f = Path(d) / "p.py"
-        f.write_text(
-            'print("leak")\n'
-            'if __name__ == "__main__":\n    print("ok: CLI surface")\n')
-        bad = _de13_print_violations([f], Path(d))
-        assert bad == [("p.py", 1)]
+    bad = _lint_snippet(
+        'print("leak")\n'
+        'if __name__ == "__main__":\n    print("ok: CLI surface")\n',
+        relpath="modules/p.py", tier="modules", select=("DE13",))
+    assert [(f.rule, f.line) for f in bad] == [("DE13", 1)]

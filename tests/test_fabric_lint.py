@@ -1,0 +1,507 @@
+"""fabric-lint engine + AS/JP/LK rule-family tests (dylint ui-test parity).
+
+Every semantic rule carries one minimal FAILING snippet and one PASSING
+snippet (mirroring test_DE03_fixture_fails), plus engine-level coverage for
+the inline-waiver syntax, the committed baseline, and the emitters. The
+repo-wide gate (the analyzer exits 0 on cyberfabric_core_tpu) runs last —
+it is the `make lint` contract.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from cyberfabric_core_tpu.apps.fabric_lint import Engine, all_rules
+from cyberfabric_core_tpu.apps.fabric_lint.emitters import emit_json, emit_sarif
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = REPO / "cyberfabric_core_tpu"
+
+
+def lint(source: str, tier: str = "modules", select: tuple[str, ...] = ()):
+    """Run the engine over an in-memory snippet; return unwaived findings."""
+    engine = Engine(all_rules())
+    if select:
+        engine = engine.select(select)
+    findings = engine.run_source(source, relpath=f"{tier}/snippet.py",
+                                 tier=tier)
+    return [f for f in findings if not f.suppressed]
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------- AS family
+
+
+def test_AS01_blocking_call_in_async_def_fails():
+    bad = lint(
+        "import time\n"
+        "async def handler():\n"
+        "    time.sleep(1)\n", select=("AS01",))
+    assert rule_ids(bad) == ["AS01"] and bad[0].line == 3
+
+
+def test_AS01_sleep_in_sync_serving_code_fails():
+    # even outside async def: serving-tier sync helpers run on the loop
+    bad = lint("import time\n"
+               "def helper():\n"
+               "    time.sleep(0.1)\n", select=("AS01",))
+    assert rule_ids(bad) == ["AS01"]
+
+
+def test_AS01_async_sleep_passes():
+    ok = lint(
+        "import asyncio\n"
+        "async def handler():\n"
+        "    await asyncio.sleep(1)\n", select=("AS01",))
+    assert ok == []
+
+
+def test_AS01_compute_tier_sleep_passes():
+    # runtime/ spins dedicated scheduler threads; AS01 is a serving-tier rule
+    ok = lint("import time\n"
+              "def loop():\n"
+              "    time.sleep(0.01)\n", tier="runtime", select=("AS01",))
+    assert ok == []
+
+
+def test_AS02_fire_and_forget_fails():
+    bad = lint(
+        "import asyncio\n"
+        "async def go(coro):\n"
+        "    asyncio.ensure_future(coro)\n", select=("AS02",))
+    assert rule_ids(bad) == ["AS02"]
+
+
+def test_AS02_underscore_discard_fails():
+    bad = lint(
+        "import asyncio\n"
+        "async def go(coro):\n"
+        "    _ = asyncio.create_task(coro)\n", select=("AS02",))
+    assert rule_ids(bad) == ["AS02"]
+
+
+def test_AS02_taskgroup_spawn_passes():
+    # TaskGroup retains its children and propagates their exceptions — the
+    # recommended safe pattern must not be flagged
+    ok = lint(
+        "import asyncio\n"
+        "async def go(work):\n"
+        "    async with asyncio.TaskGroup() as tg:\n"
+        "        tg.create_task(work())\n", select=("AS02",))
+    assert ok == []
+
+
+def test_AS02_loop_create_task_fails():
+    bad = lint(
+        "import asyncio\n"
+        "async def go(work):\n"
+        "    loop = asyncio.get_running_loop()\n"
+        "    loop.create_task(work())\n", select=("AS02",))
+    assert rule_ids(bad) == ["AS02"]
+
+
+def test_AS02_retained_task_passes():
+    ok = lint(
+        "import asyncio\n"
+        "class M:\n"
+        "    async def go(self, coro):\n"
+        "        self._task = asyncio.ensure_future(coro)\n", select=("AS02",))
+    assert ok == []
+
+
+def test_AS03_await_under_sync_lock_fails():
+    bad = lint(
+        "class M:\n"
+        "    async def go(self):\n"
+        "        with self._lock:\n"
+        "            await self.flush()\n", select=("AS03",))
+    assert rule_ids(bad) == ["AS03"] and bad[0].line == 4
+
+
+def test_AS03_async_lock_passes():
+    ok = lint(
+        "class M:\n"
+        "    async def go(self):\n"
+        "        async with self._lock:\n"
+        "            await self.flush()\n", select=("AS03",))
+    assert ok == []
+
+
+def test_AS03_nested_def_resets_lock_context():
+    # the nested coroutine body runs AFTER the with-block exits
+    ok = lint(
+        "class M:\n"
+        "    def go(self):\n"
+        "        with self._lock:\n"
+        "            async def later():\n"
+        "                await self.flush()\n"
+        "            return later\n", select=("AS03",))
+    assert ok == []
+
+
+# ---------------------------------------------------------------- JP family
+
+
+def test_JP01_print_in_jit_fails():
+    bad = lint(
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    print(x)\n"
+        "    return x\n", tier="runtime", select=("JP01",))
+    assert rule_ids(bad) == ["JP01"]
+
+
+def test_JP01_logging_in_jit_fails():
+    bad = lint(
+        "import jax, logging\n"
+        "logger = logging.getLogger(__name__)\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    logger.info('tracing %s', x)\n"
+        "    return x\n", tier="runtime", select=("JP01",))
+    assert rule_ids(bad) == ["JP01"]
+
+
+def test_JP01_print_outside_jit_passes():
+    ok = lint(
+        "import jax\n"
+        "def host_side(x):\n"
+        "    return x\n"
+        "def report(x):\n"
+        "    print(x)\n", tier="runtime", select=("JP01",))
+    assert ok == []
+
+
+def test_JP02_host_np_on_traced_arg_fails():
+    bad = lint(
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return np.sum(x)\n", tier="ops", select=("JP02",))
+    assert rule_ids(bad) == ["JP02"]
+
+
+def test_JP02_np_on_static_config_passes():
+    # trace-time shape arithmetic on python values is legitimate
+    ok = lint(
+        "import jax\n"
+        "import numpy as np\n"
+        "SHAPE = (8, 128)\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    n = np.prod(SHAPE)\n"
+        "    return x * n\n", tier="ops", select=("JP02",))
+    assert ok == []
+
+
+def test_JP02_jit_call_pattern_detected():
+    # the scheduler spelling: local def handed to jax.jit(fn)
+    bad = lint(
+        "import jax\n"
+        "import numpy as np\n"
+        "def build():\n"
+        "    def decode(tokens):\n"
+        "        return np.argmax(tokens)\n"
+        "    return jax.jit(decode)\n", tier="runtime", select=("JP02",))
+    assert rule_ids(bad) == ["JP02"]
+
+
+def test_JP03_self_mutation_in_jit_fails():
+    bad = lint(
+        "import jax\n"
+        "from functools import partial\n"
+        "class Engine:\n"
+        "    @partial(jax.jit, static_argnums=(0,))\n"
+        "    def step(self, x):\n"
+        "        self.cache = x\n"
+        "        return x\n", tier="runtime", select=("JP03",))
+    assert rule_ids(bad) == ["JP03"]
+
+
+def test_JP03_captured_list_append_fails():
+    bad = lint(
+        "import jax\n"
+        "trace_log = []\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    trace_log.append(x)\n"
+        "    return x\n", tier="runtime", select=("JP03",))
+    assert rule_ids(bad) == ["JP03"]
+
+
+def test_JP03_functional_update_passes():
+    # optax-style pure tx.update: the result is consumed, not a mutation
+    ok = lint(
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(tx, grads, opt_state, params):\n"
+        "    updates, opt_state = tx.update(grads, opt_state, params)\n"
+        "    local = []\n"
+        "    local.append(updates)\n"
+        "    return local, opt_state\n", tier="parallel", select=("JP03",))
+    assert ok == []
+
+
+def test_JP_method_sharing_local_def_name_not_marked():
+    # regression: jax.jit(prefill) on a LOCAL def must not mark the METHOD
+    # prefill (speculative.py pattern) — methods are referenced as self.name
+    ok = lint(
+        "import jax\n"
+        "class Draft:\n"
+        "    def __init__(self):\n"
+        "        def prefill(x):\n"
+        "            return x\n"
+        "        self._prefill = jax.jit(prefill)\n"
+        "    def prefill(self, ids):\n"
+        "        self.cache = ids\n", tier="runtime", select=("JP03",))
+    assert ok == []
+
+
+# ---------------------------------------------------------------- LK family
+
+_LK_CLASS = (
+    "import threading\n"
+    "class Pool:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._requests = {}\n"          # init writes are exempt
+    "    def submit(self, rid, req):\n"
+    "        with self._lock:\n"
+    "            self._requests[rid] = req\n"
+)
+
+
+def test_LK01_unlocked_write_to_guarded_attr_fails():
+    bad = lint(
+        _LK_CLASS +
+        "    def drop(self, rid):\n"
+        "        self._requests.pop(rid, None)\n",   # no lock!
+        tier="runtime", select=("LK01",))
+    assert rule_ids(bad) == ["LK01"]
+    assert "drop" in bad[0].message
+
+
+def test_LK01_locked_writes_pass():
+    ok = lint(
+        _LK_CLASS +
+        "    def drop(self, rid):\n"
+        "        with self._lock:\n"
+        "            self._requests.pop(rid, None)\n",
+        tier="runtime", select=("LK01",))
+    assert ok == []
+
+
+def test_LK01_unguarded_attrs_are_free():
+    # attrs never written under the lock are not part of the declared scope
+    ok = lint(
+        _LK_CLASS +
+        "    def bump(self):\n"
+        "        self.stats_counter = 1\n",
+        tier="runtime", select=("LK01",))
+    assert ok == []
+
+
+def test_LK01_only_applies_to_runtime_tier():
+    ok = lint(
+        _LK_CLASS +
+        "    def drop(self, rid):\n"
+        "        self._requests.pop(rid, None)\n",
+        tier="modules", select=("LK01",))
+    assert ok == []
+
+
+# ------------------------------------------------------- waivers + baseline
+
+
+def test_waiver_suppresses_finding():
+    findings = Engine(all_rules()).select(["AS01"]).run_source(
+        "import time\n"
+        "def helper():\n"
+        "    # fabric-lint: waive AS01 reason=dedicated sync thread\n"
+        "    time.sleep(0.1)\n",
+        relpath="modules/snippet.py", tier="modules")
+    assert [f.rule for f in findings] == ["AS01"]
+    assert findings[0].waived and findings[0].waive_reason == \
+        "dedicated sync thread"
+
+
+def test_waiver_same_line_suppresses():
+    findings = Engine(all_rules()).select(["AS01"]).run_source(
+        "import time\n"
+        "def helper():\n"
+        "    time.sleep(0.1)  # fabric-lint: waive AS01 reason=sync thread\n",
+        relpath="modules/snippet.py", tier="modules")
+    assert findings[0].waived
+
+
+def test_waiver_for_other_rule_does_not_suppress():
+    bad = lint(
+        "import time\n"
+        "def helper():\n"
+        "    time.sleep(0.1)  # fabric-lint: waive AS03 reason=wrong rule\n",
+        select=("AS01",))
+    assert rule_ids(bad) == ["AS01"]
+
+
+def test_waiver_without_reason_is_WV01_and_suppresses_nothing():
+    findings = Engine(all_rules()).select(["AS01"]).run_source(
+        "import time\n"
+        "def helper():\n"
+        "    time.sleep(0.1)  # fabric-lint: waive AS01\n",
+        relpath="modules/snippet.py", tier="modules")
+    ids = [f.rule for f in findings if not f.suppressed]
+    assert "AS01" in ids and "WV01" in ids
+
+
+def test_baseline_respected():
+    baseline = {("modules/snippet.py", "AS01"): 1}
+    findings = Engine(all_rules(), baseline).select(["AS01"]).run_source(
+        "import time\n"
+        "def helper():\n"
+        "    time.sleep(0.1)\n",
+        relpath="modules/snippet.py", tier="modules")
+    assert findings[0].baselined and findings[0].suppressed
+
+
+def test_baseline_budget_is_finite():
+    # one baselined slot does not absorb a SECOND new finding
+    baseline = {("modules/snippet.py", "AS01"): 1}
+    findings = Engine(all_rules(), baseline).select(["AS01"]).run_source(
+        "import time\n"
+        "def helper():\n"
+        "    time.sleep(0.1)\n"
+        "    time.sleep(0.2)\n",
+        relpath="modules/snippet.py", tier="modules")
+    assert [f.baselined for f in findings] == [True, False]
+
+
+def test_WV01_cannot_be_waived_or_baselined():
+    # waiver hygiene is engine-level: neither an inline waiver nor a
+    # baseline slot may silence it
+    baseline = {("modules/snippet.py", "WV01"): 5}
+    findings = Engine(all_rules(), baseline).select(["AS01"]).run_source(
+        "import time\n"
+        "def helper():\n"
+        "    # fabric-lint: waive WV01 reason=shush\n"
+        "    time.sleep(0.1)  # fabric-lint: waive AS01\n",
+        relpath="modules/snippet.py", tier="modules")
+    wv = [f for f in findings if f.rule == "WV01"]
+    assert wv and all(not f.suppressed for f in wv)
+
+
+def test_baseline_budget_shared_across_runs():
+    # the CLI lints each path argument in its own run(); the committed
+    # budget must not be replenished per run
+    baseline = {("modules/snippet.py", "AS01"): 1}
+    engine = Engine(all_rules(), baseline).select(["AS01"])
+    src = "import time\ndef helper():\n    time.sleep(0.1)\n"
+    first = engine.run_source(src, relpath="modules/snippet.py", tier="modules")
+    second = engine.run_source(src, relpath="modules/snippet.py", tier="modules")
+    assert first[0].baselined and not second[0].baselined
+
+
+def test_subdirectory_run_keeps_package_tier():
+    """Regression: scanning a package SUBdirectory must apply the same
+    tier-gated rules as a whole-package scan."""
+    engine = Engine(all_rules()).select(["AS01", "JP", "LK"])
+    findings = [f for f in engine.run(PKG / "runtime") if not f.suppressed]
+    assert findings == []  # and NOT false AS01s on scheduler-thread sleeps
+    # tier must resolve to "runtime", not ""
+    from cyberfabric_core_tpu.apps.fabric_lint.engine import FileContext
+    resolved = FileContext(PKG / "runtime" / "scheduler.py", PKG)
+    assert resolved.tier == "runtime"
+
+
+def test_single_file_run_keeps_package_tier():
+    """Regression: linting one file must apply the same tier-gated rules as
+    a whole-package scan (a lone runtime/ file must not draw serving-tier
+    AS01 findings, and must still get runtime-tier rules)."""
+    engine = Engine(all_rules()).select(["AS01"])
+    findings = engine.run(PKG / "runtime" / "scheduler.py")
+    assert [f for f in findings if f.rule == "AS01"] == []
+    # and a serving-tier file linted alone still carries its waived findings
+    engine = Engine(all_rules()).select(["AS01"])
+    findings = engine.run(PKG / "modkit" / "db_engine.py")
+    assert len([f for f in findings if f.waived]) == 2
+
+
+def test_committed_baseline_parses():
+    from cyberfabric_core_tpu.apps.fabric_lint import load_baseline
+
+    baseline = load_baseline(REPO / "config" / "fabric_lint_baseline.json")
+    assert baseline == {}, "committed baseline must stay empty — fix or " \
+        "waive findings instead of baselining new debt"
+
+
+# --------------------------------------------------------------- emitters
+
+
+def test_sarif_emitter_shape():
+    findings = Engine(all_rules()).select(["AS01"]).run_source(
+        "import time\n"
+        "async def h():\n"
+        "    time.sleep(1)\n",
+        relpath="modules/snippet.py", tier="modules")
+    doc = json.loads(emit_sarif(findings, all_rules()))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "fabric-lint"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} >= {"AS01", "LK01"}
+    res = run["results"][0]
+    assert res["ruleId"] == "AS01"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "modules/snippet.py"
+    assert loc["region"]["startLine"] == 3
+
+
+def test_json_emitter_roundtrip():
+    findings = Engine(all_rules()).select(["AS02"]).run_source(
+        "import asyncio\n"
+        "async def go(c):\n"
+        "    asyncio.ensure_future(c)\n",
+        relpath="modules/snippet.py", tier="modules")
+    doc = json.loads(emit_json(findings))
+    assert doc["findings"][0]["rule"] == "AS02"
+    assert doc["findings"][0]["waived"] is False
+
+
+# ------------------------------------------------------------- repo gates
+
+
+@pytest.mark.slow
+def test_cli_exits_zero_on_repo():
+    """The acceptance gate: zero unwaivered findings across the package."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "cyberfabric_core_tpu.apps.fabric_lint",
+         str(PKG)],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_engine_clean_on_repo_semantic_families():
+    """In-process equivalent for the new families (fast enough for tier-1):
+    AS/JP/LK produce no unwaived findings on the live package."""
+    engine = Engine(all_rules()).select(["AS", "JP", "LK"])
+    findings = [f for f in engine.run(PKG) if not f.suppressed]
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line} {f.rule} {f.message}" for f in findings)
+
+
+def test_db_engine_waivers_are_canonical():
+    """The two sanctioned retry-loop sleeps carry reasoned waivers — the
+    documented example of the waiver syntax."""
+    engine = Engine(all_rules()).select(["AS01"])
+    findings = engine.run(PKG, [PKG / "modkit" / "db_engine.py"])
+    waived = [f for f in findings if f.waived]
+    assert len(waived) == 2
+    assert all("sync engine thread" in f.waive_reason for f in waived)
