@@ -699,7 +699,11 @@ def embed_bench() -> int:
 
 def aggregate(model_name: str, quant: str) -> int:
     """8 concurrent streams through the continuous scheduler (paged KV pool +
-    ragged paged decode attention). Prints aggregate steady-state tokens/s."""
+    ragged paged decode attention), with STAGGERED arrivals — the pattern the
+    overlapped decode pipeline (lookahead + prefill budgeting) exists for.
+    Prints aggregate steady-state tokens/s plus inter-token latency p50/p99,
+    TTFT p50, and the scheduler's overlap ratio, so a pipeline regression is
+    visible in BENCH_*.json, not just in end-to-end throughput."""
     import threading
 
     import numpy as np
@@ -718,10 +722,15 @@ def aggregate(model_name: str, quant: str) -> int:
         # BENCH_SLOTS=64 runs BASELINE config #2 at full concurrency when the
         # chip has the HBM for it (GQA models only: 64 slots of MHA ≈ 13 GB).
         slots = int(os.environ.get("BENCH_SLOTS", "8"))
+        # BENCH_LOOKAHEAD=0 pins the synchronous scheduler — the pre/post
+        # comparison knob for the pipeline win
+        lookahead = os.environ.get("BENCH_LOOKAHEAD", "1") != "0"
+        stagger_s = float(os.environ.get("BENCH_STAGGER_S", "0.1"))
         cfg = EngineConfig(model=model_name, max_seq_len=512, max_batch=slots,
                            decode_chunk=32, quantization=quant,
                            prefix_cache_pages=slots * 8 + 33,
-                           prefix_page_size=64)
+                           prefix_page_size=64,
+                           decode_lookahead=lookahead)
         sched = ContinuousBatchingEngine(cfg, seed=0)
         rng = np.random.default_rng(1)
         n_req, gen = slots, 192
@@ -729,35 +738,77 @@ def aggregate(model_name: str, quant: str) -> int:
         lock = threading.Lock()
         state = {"finished": 0, "tokens": 0, "first": None, "last": None,
                  "errors": 0}
+        # per-request arrival/first/last + inter-token deltas (seconds)
+        reqs = [{"t_submit": 0.0, "t_first": None, "t_prev": None,
+                 "deltas": []} for _ in range(n_req)]
 
-        def emit(ev):
-            now = time.monotonic()
-            with lock:
-                if ev.token_id >= 0:
-                    state["tokens"] += 1
-                    state["first"] = state["first"] or now
-                    state["last"] = now
-                if ev.finished:
-                    if ev.finished == "error":
-                        state["errors"] += 1
-                    state["finished"] += 1
-                    if state["finished"] == n_req:
-                        done.set()
+        def mk_emit(i):
+            def emit(ev):
+                now = time.monotonic()
+                with lock:
+                    if ev.token_id >= 0:
+                        state["tokens"] += 1
+                        state["first"] = state["first"] or now
+                        state["last"] = now
+                        r = reqs[i]
+                        if r["t_first"] is None:
+                            r["t_first"] = now
+                        else:
+                            r["deltas"].append(now - r["t_prev"])
+                        r["t_prev"] = now
+                    if ev.finished:
+                        if ev.finished == "error":
+                            state["errors"] += 1
+                        state["finished"] += 1
+                        if state["finished"] == n_req:
+                            done.set()
+            return emit
 
         for i in range(n_req):
             prompt = rng.integers(3, 1000, 96 + 8 * i).tolist()
-            sched.submit(prompt, SamplingParams(max_tokens=gen), emit)
+            reqs[i]["t_submit"] = time.monotonic()
+            sched.submit(prompt, SamplingParams(max_tokens=gen), mk_emit(i))
+            if stagger_s and i < n_req - 1:
+                time.sleep(stagger_s)  # staggered arrivals, not one batch
         ok = done.wait(300)
+        stats = sched.stats()
         sched.shutdown()
         span = (state["last"] - state["first"]) if state["first"] else 0.0
         agg = state["tokens"] / span if span > 0 else 0.0
+        deltas_ms = sorted(d * 1000.0
+                           for r in reqs for d in r["deltas"])
+        ttfts_ms = sorted((r["t_first"] - r["t_submit"]) * 1000.0
+                          for r in reqs if r["t_first"] is not None)
+
+        def pct(sorted_vals, q):
+            if not sorted_vals:
+                return 0.0
+            idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
+            return round(sorted_vals[idx], 2)
+
+        pipe = stats.get("pipeline", {})
         log(f"aggregate: {state['tokens']} tokens over {span:.1f}s = {agg:.1f} tok/s"
-            f" (complete={ok})")
+            f" (complete={ok}, overlap={pipe.get('overlap_ratio')}, "
+            f"itl p50/p99={pct(deltas_ms, 0.5)}/{pct(deltas_ms, 0.99)} ms)")
         print(json.dumps({"tokens_per_sec": round(agg, 1), "slots": slots,
                           "model": model_name, "quant": quant,
                           "gen_tokens_per_req": gen, "complete": ok,
                           "errors": state["errors"],
-                          "paged_decode": True}), flush=True)
+                          "paged_decode": True,
+                          "staggered_arrival_s": stagger_s,
+                          "itl_p50_ms": pct(deltas_ms, 0.5),
+                          "itl_p99_ms": pct(deltas_ms, 0.99),
+                          "ttft_p50_ms": pct(ttfts_ms, 0.5),
+                          "decode_lookahead": lookahead,
+                          "overlap_ratio": pipe.get("overlap_ratio", 0.0),
+                          "queue_wait_p50_ms":
+                              stats.get("queue_wait_ms", {}).get("p50", 0.0),
+                          "round_ms_p50": {
+                              k: pipe.get(k, 0.0)
+                              for k in ("admit_ms_p50", "dispatch_ms_p50",
+                                        "sync_wait_ms_p50",
+                                        "host_emit_ms_p50")},
+                          }), flush=True)
         return 0 if state["tokens"] > 0 else 7
     except Exception as e:  # noqa: BLE001 — clean exit releases the relay claim
         print(json.dumps({"error": str(e)[:300]}), flush=True)

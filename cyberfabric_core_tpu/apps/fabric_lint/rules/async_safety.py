@@ -7,6 +7,7 @@ fire-and-forget task swallows its exception at GC time. These hazards live
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterable
 
 from ..engine import FileContext, Finding, Rule, Scope, dotted_name, register
@@ -103,6 +104,66 @@ class AS02(Rule):
                 "reference, and an exception in it is silently dropped at GC "
                 "time — retain the task and attach a done-callback that logs "
                 "failures (see modkit.logging_host.observe_task)")
+
+
+#: host<-device sync entry points: each blocks the scheduler thread until the
+#: device drains, serializing host and device work (the pipelining the
+#: overlapped decode loop exists to avoid)
+_DEVICE_SYNC_CALLS = {"np.asarray", "numpy.asarray", "jax.device_get"}
+
+#: decode-hot-loop method names of a scheduler-thread class (one that defines
+#: ``_run_loop``): the steady-state path that runs once per decode chunk.
+#: Admission/preemption helpers (rare, inherently synchronizing) are excluded.
+_HOT_LOOP_RE = re.compile(
+    r"^(_loop_body|_decode_round\w*|_emit_\w+|_dispatch_\w+|_commit_\w+"
+    r"|_read_chunk)$")
+
+#: the sanctioned sync carries this marker in a trailing comment — exactly one
+#: deliberate readback per decode round, named at the call site
+_SYNC_POINT_MARKER = "sync-point:"
+
+
+@register
+class AS04(Rule):
+    id = "AS04"
+    family = "AS"
+    severity = "error"
+    description = ("host-blocking device sync (np.asarray / jax.device_get / "
+                   ".block_until_ready) inside a scheduler decode-loop method "
+                   "outside the one sanctioned `# sync-point:` readback")
+    node_types = (ast.Call,)
+    tiers = frozenset({"runtime"})
+
+    def _in_hot_loop(self, scope: Scope) -> bool:
+        cls = scope.current_class
+        if cls is None:
+            return False
+        has_run_loop = any(
+            isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name == "_run_loop" for n in cls.body)
+        if not has_run_loop:
+            return False
+        return any(
+            isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and _HOT_LOOP_RE.match(f.name) for f in scope.func_stack)
+
+    def visit(self, node: ast.Call, scope: Scope,
+              ctx: FileContext) -> Iterable[Finding]:
+        name = dotted_name(node.func)
+        is_sync = name in _DEVICE_SYNC_CALLS or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready")
+        if not is_sync or not self._in_hot_loop(scope):
+            return
+        line_text = ctx.lines[node.lineno - 1] if node.lineno <= len(ctx.lines) else ""
+        if _SYNC_POINT_MARKER in line_text:
+            return  # the one sanctioned readback of the decode round
+        yield self.finding(
+            node, f"host-blocking device sync {name or node.func.attr}() in "
+            "a scheduler hot-loop method: it stalls the host until the device "
+            "drains, breaking decode/emit overlap — route the value through "
+            "the round's single `# sync-point:` readback, or waive with the "
+            "reason the extra sync is unavoidable")
 
 
 @register

@@ -270,6 +270,15 @@ class LocalTpuWorker(LlmWorkerApi):
             quantization=opts.pop("quantization", "none"),
             prefix_cache_pages=int(opts.pop("prefix_cache_pages", default_pages)),
             prefix_page_size=page_size,
+            # scheduler pipeline knobs (docs/ARCHITECTURE.md "Scheduler
+            # pipeline"): lookahead overlap, Sarathi-style admission budget,
+            # cold-prefill coalescing. Registry options can arrive as strings
+            # — bool("false") is True, so parse the words, not the truthiness.
+            decode_lookahead=str(opts.pop("decode_lookahead", True)
+                                 ).strip().lower() not in ("0", "false", "no",
+                                                           "off"),
+            prefill_budget_tokens=int(opts.pop("prefill_budget_tokens", 512)),
+            prefill_coalesce=int(opts.pop("prefill_coalesce", 4)),
             speculative=opts.pop("speculative", "off"),
             spec_k=int(opts.pop("spec_k", 8)),
             draft_model=opts.pop("draft_model", ""),

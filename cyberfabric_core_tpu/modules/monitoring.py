@@ -63,6 +63,44 @@ class MonitoringModule(Module, RestApiCapability):
             "llm_batch_active_slots", "Active continuous-batching slots"
         ).set_function(active_slots)
 
+        def _schedulers():
+            worker = hub.try_get(LlmWorkerApi)
+            for entry in getattr(worker, "_entries", {}).values():
+                sched = getattr(entry, "scheduler", None)
+                if sched is not None:
+                    yield sched
+
+        # scheduler pipeline health (the overlapped-decode tentpole): fraction
+        # of decode rounds served by a pre-dispatched lookahead chunk, and how
+        # long admitted requests waited in the pending queue
+        def decode_overlap_ratio() -> float:
+            rounds = ahead = 0
+            for sched in _schedulers():
+                rounds += sched.decode_rounds
+                ahead += sched.lookahead_rounds
+            return ahead / rounds if rounds else 0.0
+
+        self.registry.gauge(
+            "llm_decode_overlap_ratio",
+            "Decode rounds served by a lookahead-dispatched chunk (0..1)"
+        ).set_function(decode_overlap_ratio)
+
+        def queue_wait_p50_ms() -> float:
+            waits: list[float] = []
+            for sched in _schedulers():
+                try:
+                    waits.extend(sched.queue_wait_samples)
+                except RuntimeError:
+                    pass  # deque mutated mid-iteration: advisory metric
+            if not waits:
+                return 0.0
+            return float(sorted(waits)[len(waits) // 2])
+
+        self.registry.gauge(
+            "llm_queue_wait_p50_ms",
+            "p50 pending-queue wait of admitted requests (ms)"
+        ).set_function(queue_wait_p50_ms)
+
     def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
         async def metrics(request: web.Request):
             return web.Response(text=self.registry.render(),

@@ -33,6 +33,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+#: jax >= 0.5 renamed TPUCompilerParams -> CompilerParams; accept either so
+#: the kernel loads against whichever toolchain the image bakes in
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 _NEG_INF = -1e30
 _LANES = 128  # f32 lane width; m/l scratch is lane-replicated
 
@@ -192,7 +197,7 @@ def flash_self_attention(
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),

@@ -33,6 +33,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+#: jax >= 0.5 renamed TPUCompilerParams -> CompilerParams; accept either so
+#: the kernel loads against whichever toolchain the image bakes in
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 _NEG_INF = -1e30
 _LANES = 128
 
@@ -160,7 +165,7 @@ def paged_decode_attention(
                           sliding_window=sliding_window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

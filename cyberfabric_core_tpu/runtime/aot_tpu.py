@@ -127,7 +127,7 @@ def serving_programs(
                     cfg.head_dim), dtype)
 
     def paged_decode_chunk(params, k_pool, v_pool, page_table, last_tokens,
-                           lengths, keys, temp, top_p, top_k):
+                           lengths, active, keys, temp, top_p, top_k):
         def step(carry, _):
             pools, toks, lens, keys = carry
             hidden, pools = llama.forward_paged_decode(
@@ -137,10 +137,11 @@ def serving_programs(
             nxt = sample_token_per_slot(logits, subs, temp, top_p, top_k)
             return (pools, nxt, lens + 1, keys), nxt
 
-        (pools, last, _, keys), toks = jax.lax.scan(
+        (pools, last, lens, keys), toks = jax.lax.scan(
             step, ((k_pool, v_pool), last_tokens, lengths, keys),
             None, length=decode_chunk)
-        return toks.T, pools[0], pools[1], last, keys
+        lens = jnp.where(active, lens, 0)
+        return toks.T, pools[0], pools[1], last, keys, lens
 
     keys_abs = jax.eval_shape(
         lambda: jax.random.split(jax.random.PRNGKey(0), max_batch))
@@ -149,6 +150,7 @@ def serving_programs(
         sds((max_batch, pmax), jnp.int32),
         sds((max_batch,), jnp.int32),
         sds((max_batch,), jnp.int32),
+        sds((max_batch,), jnp.bool_),
         keys_abs,
         sds((max_batch,), jnp.float32),
         sds((max_batch,), jnp.float32),

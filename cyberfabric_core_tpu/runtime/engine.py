@@ -85,6 +85,23 @@ class EngineConfig:
     #: vocab/tokenizer) + optional checkpoint dir for its weights
     draft_model: str = ""
     draft_checkpoint: str = ""
+    #: continuous scheduler (paged mode only): dispatch decode chunk N+1 —
+    #: which depends only on device-resident state — BEFORE host-processing
+    #: chunk N's tokens, so the host emit loop overlaps the device chunk.
+    #: Falls back to a synchronous round whenever a slot finishes, a request
+    #: is admitted/resumed, or a slot is preempted, so emitted streams are
+    #: byte-identical to the synchronous scheduler.
+    decode_lookahead: bool = True
+    #: continuous scheduler: per-round prefill admission budget in prompt
+    #: tokens (Sarathi-style interleave). A burst of arrivals no longer drains
+    #: the whole queue with back-to-back prefills before decode resumes; at
+    #: least one request is always admitted per round so big prompts cannot
+    #: starve. 0 = unbounded drain (pre-pipeline behavior).
+    prefill_budget_tokens: int = 512
+    #: continuous scheduler: coalesce up to this many COLD (no prefix hit)
+    #: same-bucket pending requests into one multi-row prefill dispatch.
+    #: 1 = off (every prefill is its own batch-1 dispatch).
+    prefill_coalesce: int = 4
 
     def resolve_use_flash(self) -> bool:
         if self.use_flash is not None:

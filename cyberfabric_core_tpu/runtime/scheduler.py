@@ -8,9 +8,35 @@ free immediately for the next waiting request. Unlike the lockstep batcher
 
 Device programs (all jitted, caches donated):
 - prefill_collect: one request's prompt → last hidden + its kv [L, 1, T, Hkv, D]
+- batched prefill: up to ``prefill_coalesce`` COLD pending requests in one
+  multi-row dispatch (per-row key streams — coalescing never changes tokens)
 - insert_slot_kv:  scatter that kv into the pool at the slot index
 - decode chunk:    k fused steps over all slots (inactive slots compute garbage
   that is masked host-side — the static shape is the price of zero recompiles)
+
+The decode loop is PIPELINED (paged mode): host work and device work overlap
+instead of alternating.
+
+- One-chunk lookahead: chunk N+1 depends only on device-resident state
+  (last_tokens / keys / pools / page table / lengths), so it is dispatched
+  BEFORE chunk N's tokens are read back and emitted — the host emit loop runs
+  while the device computes the next chunk. Any state change (a slot finished,
+  a request admitted/resumed, a preemption) bumps ``_epoch`` and the stale
+  speculative chunk is discarded; the fallback synchronous round recomputes
+  from committed state, so emitted streams are byte-identical to the
+  synchronous scheduler. (Discarded chunks are harmless: their KV writes land
+  past every committed length and are either rewritten identically or masked
+  by attention-length bounds; pages they touched of freed slots are fully
+  rescattered by the next owner.)
+- Prefill admission budget: ``prefill_budget_tokens`` caps prompt tokens
+  admitted per round (Sarathi-style interleave) so an arrival burst no longer
+  stalls every in-flight decode behind an unbounded prefill drain.
+- Device-resident sampling state: temp/top_p/top_k/lengths/active live on
+  device and only CHANGED rows are patched at admission/finish/preempt/resume;
+  the page table patches changed rows instead of re-uploading.
+
+The one sanctioned host<-device sync of the decode loop is the chunk readback
+(fabric-lint AS04 enforces this; see the ``sync-point:`` markers).
 
 The reference's analogue is request-level tokio concurrency + per-route in-flight
 semaphores (SURVEY §2.6); there is no model-execution scheduler to mirror, so this
@@ -35,7 +61,7 @@ import numpy as np
 from ..models import llama
 from ..models.configs import ModelConfig, get_config
 from ..ops.rope import rope_frequencies
-from ..ops.sampling import sample_token
+from ..ops.sampling import sample_token, sample_token_per_slot, split_keys_per_slot
 from .engine import EngineConfig, SamplingParams, StepEvent, build_decode_chunk_fn
 
 logger = logging.getLogger("scheduler")
@@ -65,6 +91,9 @@ class _Pending:
     sampling: SamplingParams
     emit: Callable[[StepEvent], None]
     enqueued_at: float = field(default_factory=time.monotonic)
+    #: paged mode: per-request PRNG key, assigned at TAKE time in FIFO order so
+    #: coalescing/partitioning can never reorder the shared-rng split sequence
+    key: Any = None
 
 
 @dataclass
@@ -79,6 +108,23 @@ class _Suspended:
     last_token: int
     slot_key: Any  # per-slot RNG key (reproducibility across the suspend)
     suspended_at: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _InflightChunk:
+    """A dispatched-but-unread decode chunk (the lookahead unit).
+
+    ``epoch`` is the scheduler state epoch at dispatch; any admission /
+    finish / preemption / resume bumps the engine epoch, invalidating the
+    chunk — its tokens are discarded and a synchronous round recomputes from
+    committed state. The device outputs here are FUTURES: nothing blocks
+    until the chunk readback."""
+
+    chunk_dev: Any        # [N, k] int32 tokens
+    last: Any             # [N] last tokens after the chunk
+    keys: Any             # [N, 2] per-slot key streams after the chunk
+    lengths_dev: Any      # [N] lengths after the chunk (inactive rows pinned 0)
+    epoch: int
 
 
 class ContinuousBatchingEngine:
@@ -141,7 +187,7 @@ class ContinuousBatchingEngine:
         self.n_slots = config.max_batch
         self._rng = jax.random.PRNGKey(seed)
 
-        # host-side slot state
+        # host-side slot state (mirrors of the device-resident rows)
         self.slots: list[Optional[_SlotState]] = [None] * self.n_slots
         self.lengths = np.zeros(self.n_slots, np.int32)
         self.active = np.zeros(self.n_slots, bool)
@@ -175,10 +221,17 @@ class ContinuousBatchingEngine:
                 page_size=page, dtype=self.dtype)
             self.page_table = np.zeros((self.n_slots, self.pmax), np.int32)
             self._page_table_dev = jnp.asarray(self.page_table)
-            self._pt_dirty = False
+            self._pt_dirty_rows: set[int] = set()
             self.cache = None  # no dense pool — HBM belongs to the paged pool
             self._slot_keys = jax.random.split(
                 jax.random.PRNGKey(seed ^ 0x5EED), self.n_slots)
+            # device-resident per-slot sampling/length state: patched row-wise
+            # at admission/finish/preempt/resume, never re-uploaded per round
+            self._temp_dev = jnp.zeros((self.n_slots,), jnp.float32)
+            self._top_p_dev = jnp.ones((self.n_slots,), jnp.float32)
+            self._top_k_dev = jnp.zeros((self.n_slots,), jnp.int32)
+            self._lengths_dev = jnp.zeros((self.n_slots,), jnp.int32)
+            self._active_dev = jnp.zeros((self.n_slots,), bool)
         else:
             self.cache = llama.init_cache(
                 self.model_config, self.n_slots, config.max_seq_len, self.dtype)
@@ -187,20 +240,35 @@ class ContinuousBatchingEngine:
 
         self._pending: _queue.Queue[_Pending] = _queue.Queue()
         self._suspended: "_deque[_Suspended]" = _deque()
+        #: O(1) slot allocation: maintained at admit/finish/preempt/resume —
+        #: invariant: set(_free_slots) == {i | not active[i]}
+        self._free_slots: "_deque[int]" = _deque(range(self.n_slots))
         self.preemptions = 0
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._thread_lock = threading.Lock()
         self._broken: Optional[str] = None
+        #: state epoch: bumped on admission/finish/preempt/resume — an
+        #: in-flight speculative chunk dispatched at an older epoch is stale
+        self._epoch = 0
+        self._inflight: Optional[_InflightChunk] = None
         self._build_programs()
 
-        # metrics (BASELINE observability: batch occupancy, tokens/sec)
+        # metrics (BASELINE observability: batch occupancy, tokens/sec, and
+        # the per-round pipeline breakdown the overlap claim rests on)
         from collections import deque
 
         self.tokens_emitted = 0
         self.requests_completed = 0
+        self.decode_rounds = 0
+        self.lookahead_rounds = 0
+        self.coalesced_prefills = 0
         self.occupancy_samples: "deque[int]" = deque(maxlen=1000)
+        self.round_timings: "deque[dict]" = deque(maxlen=512)
+        self.queue_wait_samples: "deque[float]" = deque(maxlen=2048)
+        self._lookahead_stats = {"dispatched": 0, "used": 0, "discarded": 0}
+        self._last_admit_ms = 0.0
         _init_ctx.close()
 
     # ------------------------------------------------------------------ programs
@@ -239,14 +307,31 @@ class ContinuousBatchingEngine:
         self._suffix_prefill_fn = jax.jit(suffix_prefill)
 
         if self.paged:
-            from ..ops.sampling import sample_token_per_slot, split_keys_per_slot
-
             rope = self.rope_tables
 
+            def batch_prefill(params, ids, lengths, keys, temp, top_p, top_k,
+                              rope_t):
+                """Coalesced COLD prefill: B pending requests, one dispatch.
+                Per-row key streams advance exactly as the single-request path
+                (split, then sample with the subkey) so coalescing never
+                changes any request's tokens."""
+                last_h, kv = llama.prefill_collect(params, cfg, ids, lengths,
+                                                   rope_t, use_flash=use_flash)
+                logits = llama.lm_head_logits(params, cfg, last_h)
+                keys, subs = split_keys_per_slot(keys)
+                first = sample_token_per_slot(logits, subs, temp, top_p, top_k)
+                return first, kv, keys
+
+            self._batch_prefill_fn = jax.jit(batch_prefill)
+
             def paged_decode_chunk(params, k_pool, v_pool, page_table,
-                                   last_tokens, lengths, keys, temp, top_p, top_k):
+                                   last_tokens, lengths, active, keys,
+                                   temp, top_p, top_k):
                 """k fused paged decode steps; per-slot key streams so each
-                request's seed reproduces its tokens (round-1 advisory)."""
+                request's seed reproduces its tokens (round-1 advisory).
+                Lengths are device-resident: active rows advance by k inside
+                the program; inactive rows pin back to 0 so garbage positions
+                never creep past the rope table / page chain bounds."""
 
                 def step(carry, _):
                     pools, toks, lens, keys = carry
@@ -257,10 +342,11 @@ class ContinuousBatchingEngine:
                     nxt = sample_token_per_slot(logits, subs, temp, top_p, top_k)
                     return (pools, nxt, lens + 1, keys), nxt
 
-                (pools, last, _, keys), toks = jax.lax.scan(
+                (pools, last, lens, keys), toks = jax.lax.scan(
                     step, ((k_pool, v_pool), last_tokens, lengths, keys),
                     None, length=k_steps)
-                return toks.T, pools[0], pools[1], last, keys
+                lens = jnp.where(active, lens, 0)
+                return toks.T, pools[0], pools[1], last, keys, lens
 
             self._paged_decode_fn = jax.jit(paged_decode_chunk,
                                             donate_argnums=(1, 2))
@@ -325,8 +411,38 @@ class ContinuousBatchingEngine:
     def active_slots(self) -> int:
         return int(self.active.sum())
 
+    @staticmethod
+    def _p50(samples: list) -> float:
+        if not samples:
+            return 0.0
+        s = sorted(samples)
+        return float(s[len(s) // 2])
+
     def stats(self) -> dict[str, Any]:
         occ = sum(self.occupancy_samples) / max(1, len(self.occupancy_samples))
+        # snapshot deques the scheduler thread appends to (advisory metrics —
+        # a torn read under contention degrades to zeros, never crashes)
+        try:
+            timings = list(self.round_timings)
+            waits = list(self.queue_wait_samples)
+        except RuntimeError:
+            timings, waits = [], []
+        pipeline = {
+            "rounds": self.decode_rounds,
+            "lookahead_rounds": self.lookahead_rounds,
+            "overlap_ratio": round(
+                self.lookahead_rounds / max(1, self.decode_rounds), 3),
+            "admit_ms_p50": round(self._p50(
+                [t["admit_ms"] for t in timings]), 3),
+            "dispatch_ms_p50": round(self._p50(
+                [t["dispatch_ms"] for t in timings]), 3),
+            "sync_wait_ms_p50": round(self._p50(
+                [t["sync_wait_ms"] for t in timings]), 3),
+            "host_emit_ms_p50": round(self._p50(
+                [t["host_emit_ms"] for t in timings]), 3),
+            "lookahead": dict(self._lookahead_stats),
+            "coalesced_prefills": self.coalesced_prefills,
+        }
         return {
             "broken": self._broken,
             "prefix_cache": self.pool.stats() if self.pool is not None else None,
@@ -338,12 +454,19 @@ class ContinuousBatchingEngine:
             "tokens_emitted": self.tokens_emitted,
             "requests_completed": self.requests_completed,
             "mean_occupancy": round(occ, 2),
+            "pipeline": pipeline,
+            "queue_wait_ms": {
+                "p50": round(self._p50(waits), 3),
+                "max": round(max(waits), 3) if waits else 0.0,
+                "count": len(waits),
+            },
         }
 
     # ------------------------------------------------------------------ loop
     def _run_loop(self) -> None:
-        logger.info("continuous scheduler up: %d slots, chunk %d",
-                    self.n_slots, self._k_steps)
+        logger.info("continuous scheduler up: %d slots, chunk %d, lookahead %s",
+                    self.n_slots, self._k_steps,
+                    self.paged and self.config.decode_lookahead)
         with self._device_ctx():
             self._loop_body()
 
@@ -360,6 +483,7 @@ class ContinuousBatchingEngine:
             except Exception as e:  # noqa: BLE001 — device errors must not hang clients
                 logger.exception("scheduler loop failed; failing in-flight requests")
                 self._broken = str(e)[:500]
+                self._inflight = None
                 for slot in range(self.n_slots):
                     state = self.slots[slot]
                     if state is not None:
@@ -383,20 +507,75 @@ class ContinuousBatchingEngine:
                         break
                 return
 
-    def _free_slot(self) -> Optional[int]:
-        for i in range(self.n_slots):
-            if not self.active[i]:
-                return i
-        return None
+    # ------------------------------------------------------------ slot accounting
+    def _take_free_slot(self) -> Optional[int]:
+        """O(1) slot allocation off the free-slot deque (the old O(n_slots)
+        linear scan ran once per admission attempt)."""
+        if not self._free_slots:
+            return None
+        return self._free_slots.popleft()
 
+    def _release_free_slot(self, slot: int) -> None:
+        self._free_slots.append(slot)
+
+    def _reclaim_failed_admission(self, slot: int) -> bool:
+        """After an admission exception: return the slot to the free deque
+        ONLY if activation never completed. A client emit callback that raises
+        on the first token surfaces here AFTER _activate_slot marked the slot
+        live — releasing it then would hand the same slot to a second request
+        (stream hijack + leaked page chain). Returns True when the request was
+        NOT admitted (caller should emit its error event)."""
+        if self.active[slot] or self.slots[slot] is not None:
+            return False  # activation completed; the slot is serving
+        if slot not in self._free_slots:  # first-token finish already freed it
+            self._release_free_slot(slot)
+        return True
+
+    # ------------------------------------------------------------ device patches
+    def _patch_slot_device(self, slot: int, temp: float, top_p: float,
+                           top_k: int, length: int, active: bool) -> None:
+        """Patch ONE slot's device-resident rows (admission/resume). A dynamic
+        scalar index keeps this a single cached program, not one per slot."""
+        i = jnp.asarray(slot, jnp.int32)
+        self._temp_dev = self._temp_dev.at[i].set(jnp.float32(temp))
+        self._top_p_dev = self._top_p_dev.at[i].set(jnp.float32(top_p))
+        self._top_k_dev = self._top_k_dev.at[i].set(jnp.int32(top_k))
+        self._lengths_dev = self._lengths_dev.at[i].set(jnp.int32(length))
+        self._active_dev = self._active_dev.at[i].set(jnp.bool_(active))
+
+    def _deactivate_slot_device(self, slot: int) -> None:
+        i = jnp.asarray(slot, jnp.int32)
+        self._lengths_dev = self._lengths_dev.at[i].set(jnp.int32(0))
+        self._active_dev = self._active_dev.at[i].set(jnp.bool_(False))
+
+    def _mark_pt_row(self, slot: int) -> None:
+        self._pt_dirty_rows.add(slot)
+
+    def _flush_pt_patches(self) -> None:
+        """Patch only the CHANGED page-table rows to device — the full
+        [n_slots, pmax] table is never re-uploaded in steady state. The row
+        count pads to a power of two (bounded scatter variants); pad rows
+        rewrite a real row with its own current value, which is harmless."""
+        if not self._pt_dirty_rows:
+            return
+        rows = sorted(self._pt_dirty_rows)
+        self._pt_dirty_rows.clear()
+        np2 = 1
+        while np2 < len(rows):
+            np2 *= 2
+        rows = rows + [rows[0]] * (np2 - len(rows))
+        idx = jnp.asarray(rows, jnp.int32)
+        self._page_table_dev = self._page_table_dev.at[idx].set(
+            jnp.asarray(self.page_table[rows]))
+
+    # ------------------------------------------------------------ admission
     def _resume_suspended(self) -> int:
         """Restore preempted requests (FIFO) while slots AND pool space allow.
         Suspended requests outrank new admissions — their prefill is already
         paid and a client is mid-stream."""
         resumed = 0
         while self._suspended:
-            slot = self._free_slot()
-            if slot is None:
+            if not self._free_slots:
                 break
             rec = self._suspended[0]
             try:
@@ -433,6 +612,8 @@ class ContinuousBatchingEngine:
                     continue
                 break  # still no room; stay suspended
             self._suspended.popleft()
+            slot = self._take_free_slot()
+            assert slot is not None  # guarded by the _free_slots check above
             state = rec.state
             state.chain = chain
             self.slots[slot] = state
@@ -442,35 +623,197 @@ class ContinuousBatchingEngine:
             self._temp[slot] = s.temperature
             self._top_p[slot] = s.top_p
             self._top_k[slot] = s.top_k
-            self._last_tokens = self._last_tokens.at[slot].set(rec.last_token)
-            self._slot_keys = self._slot_keys.at[slot].set(
+            self._patch_slot_device(slot, s.temperature, s.top_p, s.top_k,
+                                    rec.length, True)
+            i = jnp.asarray(slot, jnp.int32)
+            self._last_tokens = self._last_tokens.at[i].set(rec.last_token)
+            self._slot_keys = self._slot_keys.at[i].set(
                 jnp.asarray(rec.slot_key))
             self.page_table[slot, :] = 0
             self.page_table[slot, : len(chain)] = chain
-            self._pt_dirty = True
+            self._mark_pt_row(slot)
+            self._epoch += 1
             resumed += 1
             logger.info("resumed %s into slot %d (len=%d)",
                         state.request_id, slot, rec.length)
         return resumed
 
     def _admit(self) -> int:
+        """Admit pending requests under the per-round prefill token budget.
+
+        The old unbounded drain ran batch-1 synchronous prefills for the WHOLE
+        queue before any decode resumed — head-of-line blocking for every
+        active stream during an arrival burst. Now at most
+        ``prefill_budget_tokens`` prompt tokens are admitted per round (always
+        at least one request, so big prompts cannot starve), and COLD
+        same-bucket requests coalesce into one multi-row prefill dispatch."""
+        t0 = time.monotonic()
         admitted = self._resume_suspended() if self.paged else 0
-        while True:
-            slot = self._free_slot()
-            if slot is None:
-                return admitted
+        budget = self.config.prefill_budget_tokens
+        taken: list[_Pending] = []
+        spent = 0
+        while len(taken) < len(self._free_slots):
+            if budget > 0 and spent >= budget and taken:
+                break
             try:
                 req = self._pending.get_nowait()
             except _queue.Empty:
-                return admitted
-            try:
-                self._prefill_into_slot(slot, req)
-                admitted += 1
-            except Exception as e:  # noqa: BLE001
-                logger.exception("prefill failed for %s", req.request_id)
-                req.emit(StepEvent(0, -1, "error"))
+                break
+            taken.append(req)
+            spent += len(req.prompt_ids)
+            self.queue_wait_samples.append(
+                (time.monotonic() - req.enqueued_at) * 1000.0)
+        if taken:
+            admitted += self._place(taken)
+        self._last_admit_ms = round((time.monotonic() - t0) * 1000.0, 3)
+        return admitted
 
-    def _prefill_into_slot(self, slot: int, req: _Pending) -> None:
+    def _assign_keys(self, reqs: list[_Pending]) -> None:
+        """Assign per-request key streams in FIFO order BEFORE partitioning,
+        so coalescing can never reorder the shared-rng split sequence."""
+        for req in reqs:
+            if req.key is None:
+                if req.sampling.seed is not None:
+                    req.key = jax.random.PRNGKey(req.sampling.seed)
+                else:
+                    self._rng, req.key = jax.random.split(self._rng)
+
+    def _place(self, reqs: list[_Pending]) -> int:
+        """Partition taken requests into prefix-hit singles and coalesced cold
+        groups, then prefill them into slots."""
+        placed = 0
+        #: (request, prematched): the ONE radix match per request — its pin is
+        #: held from the probe here until _prefill_into_slot's release, so the
+        #: cold batches admitted below cannot evict a just-classified prefix
+        singles: list[tuple[_Pending, Optional[tuple[list[int], int]]]] = []
+        cold: dict[int, list[_Pending]] = {}
+        coalesce = self.config.prefill_coalesce if self.paged else 1
+        if self.paged:
+            self._assign_keys(reqs)
+        if coalesce > 1 and self.pool is not None:
+            for req in reqs:
+                match = self.pool.match_prefix(req.prompt_ids)
+                if match[0]:
+                    singles.append((req, match))  # hit: suffix-prefill path
+                else:
+                    # LOAD-BEARING release: a fully-cached prompt matches (and
+                    # pins) tree nodes but match_prefix trims its page list to
+                    # empty — this is the only unpin for those nodes (the cold
+                    # prefill path skips release for prematched requests)
+                    self.pool.release(req.prompt_ids)
+                    cold.setdefault(
+                        self._bucket_for(len(req.prompt_ids)), []).append(req)
+        else:
+            singles = [(req, None) for req in reqs]
+        for bucket in sorted(cold):
+            group = cold[bucket]
+            while group:
+                batch, group = group[:coalesce], group[coalesce:]
+                if len(batch) == 1:
+                    singles.extend((req, ([], 0)) for req in batch)
+                    continue
+                placed += self._prefill_batch(batch, bucket)
+        for i, (req, match) in enumerate(singles):
+            slot = self._take_free_slot()
+            if slot is None:  # unreachable: takes are bounded by free slots
+                for dropped, d_match in singles[i:]:  # requeue EVERY one
+                    logger.error("no free slot for %s; requeueing",
+                                 dropped.request_id)
+                    if d_match and d_match[0]:
+                        self.pool.release(dropped.prompt_ids)
+                    self._pending.put(dropped)
+                break
+            try:
+                self._prefill_into_slot(slot, req, prematched=match)
+                placed += 1
+            except Exception:  # noqa: BLE001
+                logger.exception("prefill failed for %s", req.request_id)
+                if self._reclaim_failed_admission(slot):
+                    try:
+                        req.emit(StepEvent(0, -1, "error"))
+                    except Exception:  # noqa: BLE001 — emit itself may be the fault
+                        pass
+                else:
+                    placed += 1  # admitted; the emit callback raised post-hoc
+        return placed
+
+    def _prefill_batch(self, reqs: list[_Pending], bucket: int) -> int:
+        """One multi-row prefill dispatch for coalesced COLD requests (paged
+        mode). Rows pad to a power-of-two batch (bounded compile variants);
+        pad rows replay row 0 under a dummy key and are discarded."""
+        B = len(reqs)
+        Bp = 1
+        while Bp < B:
+            Bp *= 2
+        ids = np.zeros((Bp, bucket), np.int32)
+        lengths = np.zeros(Bp, np.int32)
+        temp = np.zeros(Bp, np.float32)
+        top_p = np.ones(Bp, np.float32)
+        top_k = np.zeros(Bp, np.int32)
+        keys = np.zeros((Bp, 2), np.uint32)
+        for i, req in enumerate(reqs):
+            T = len(req.prompt_ids)
+            ids[i, :T] = req.prompt_ids
+            lengths[i] = T
+            s = req.sampling
+            temp[i], top_p[i], top_k[i] = s.temperature, s.top_p, s.top_k
+            keys[i] = np.asarray(req.key, np.uint32)
+        for i in range(B, Bp):
+            ids[i] = ids[0]
+            lengths[i] = lengths[0]
+        try:
+            first, kv, keys_out = self._batch_prefill_fn(
+                self.params, jnp.asarray(ids), jnp.asarray(lengths),
+                jnp.asarray(keys), jnp.asarray(temp), jnp.asarray(top_p),
+                jnp.asarray(top_k), self.rope_tables)
+            first_host = np.asarray(first, np.int32)
+        except Exception:  # noqa: BLE001 — the whole dispatch failed
+            logger.exception("coalesced prefill failed (%d reqs, bucket %d)",
+                             B, bucket)
+            for req in reqs:
+                req.emit(StepEvent(0, -1, "error"))
+            return 0
+        placed = 0
+        for i, req in enumerate(reqs):
+            slot = self._take_free_slot()
+            if slot is None:  # unreachable: takes bounded by free slots
+                for dropped in reqs[i:]:  # requeue EVERY unplaced request
+                    logger.error("no free slot for %s; requeueing",
+                                 dropped.request_id)
+                    self._pending.put(dropped)
+                break
+            chain: Optional[list[int]] = None
+            try:
+                kv_row = (kv[0][:, i:i + 1], kv[1][:, i:i + 1])
+                chain = self.pool.admit_slot(req.prompt_ids, [], kv_row)
+                self._activate_slot(slot, req, chain, int(first_host[i]),
+                                    keys_out[i])
+                placed += 1
+            except Exception:  # noqa: BLE001
+                logger.exception("prefill failed for %s", req.request_id)
+                if self._reclaim_failed_admission(slot):
+                    # not admitted: the chain (if any) belongs to no one
+                    if chain is not None:
+                        self.pool.release_slot(chain)
+                        self.page_table[slot, :] = 0
+                        self._mark_pt_row(slot)
+                    try:
+                        req.emit(StepEvent(0, -1, "error"))
+                    except Exception:  # noqa: BLE001 — emit itself may be the fault
+                        pass
+                else:
+                    placed += 1  # admitted; the emit callback raised post-hoc
+        if placed:
+            self.coalesced_prefills += 1
+        return placed
+
+    def _prefill_into_slot(self, slot: int, req: _Pending,
+                           prematched: Optional[tuple[list[int], int]] = None
+                           ) -> None:
+        """``prematched`` carries _place's probe result (pages, cached_len):
+        the ONE radix match for this request, its pin still held on a hit —
+        no second tree walk, and no probe/admit window where the classified
+        prefix could be evicted."""
         T = len(req.prompt_ids)
         bucket = self._bucket_for(T)
         s = req.sampling
@@ -482,16 +825,22 @@ class ContinuousBatchingEngine:
         # an explicit seed reproduces the whole generation (first token
         # included) regardless of batch composition (round-1 advisory)
         if self.paged:
-            if s.seed is not None:
-                req_key = jax.random.PRNGKey(s.seed)
-            else:
-                self._rng, req_key = jax.random.split(self._rng)
+            self._assign_keys([req])
+            req_key = req.key
         else:
             req_key = None
 
         cached_pages: list[int] = []
+        cached_len = 0
+        pin_held = False  # exactly ONE release per held pin — a spare release
+        #                   can steal a same-prefix peer's pin (pins floor at 0)
         if self.pool is not None:
-            cached_pages, cached_len = self.pool.match_prefix(req.prompt_ids)
+            if prematched is None:
+                cached_pages, cached_len = self.pool.match_prefix(req.prompt_ids)
+                pin_held = True
+            else:
+                cached_pages, cached_len = prematched
+                pin_held = bool(cached_pages)  # cold probes already released
             if cached_pages:
                 # the suffix insert at offset cached_len must fit the prefill
                 # cache entirely (dynamic_update_slice clamps, which would
@@ -504,6 +853,7 @@ class ContinuousBatchingEngine:
                         if b >= cached_len + suf_bucket))
                 else:
                     self.pool.release(req.prompt_ids)
+                    pin_held = False
                     cached_pages = []
         chain: Optional[list[int]] = None
         if cached_pages:
@@ -527,6 +877,7 @@ class ContinuousBatchingEngine:
                 chain = self.pool.admit_slot(req.prompt_ids, cached_pages, kv)
             finally:
                 self.pool.release(req.prompt_ids)
+                pin_held = False
         else:
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :T] = req.prompt_ids
@@ -542,16 +893,11 @@ class ContinuousBatchingEngine:
                 try:
                     chain = self.pool.admit_slot(req.prompt_ids, [], kv)
                 finally:
-                    self.pool.release(req.prompt_ids)
+                    if pin_held:
+                        self.pool.release(req.prompt_ids)
+                        pin_held = False
         try:
-            if self.paged:
-                assert chain is not None
-                self.page_table[slot, :] = 0
-                self.page_table[slot, : len(chain)] = chain
-                self._pt_dirty = True
-                # continue this request's key stream (advanced by prefill)
-                self._slot_keys = self._slot_keys.at[slot].set(req_key)
-            else:
+            if not self.paged:
                 # dense mode: scatter the collected kv into the slot's cache rows
                 self.cache = self._insert_fn(
                     self.cache[0], self.cache[1], kv[0], kv[1],
@@ -563,9 +909,27 @@ class ContinuousBatchingEngine:
             if chain is not None:
                 self.pool.release_slot(chain)
                 self.page_table[slot, :] = 0
-                self._pt_dirty = True
+                self._mark_pt_row(slot)
             raise
+        if self.paged:
+            assert chain is not None
+        self._activate_slot(slot, req, chain, tok, req_key)
 
+    def _activate_slot(self, slot: int, req: _Pending,
+                       chain: Optional[list[int]], tok: int,
+                       slot_key: Any) -> None:
+        """Commit an admitted request into its slot: host mirrors, device-row
+        patches, page-table row, first-token emission."""
+        s = req.sampling
+        if self.paged:
+            self.page_table[slot, :] = 0
+            self.page_table[slot, : len(chain)] = chain
+            self._mark_pt_row(slot)
+            # continue this request's key stream (advanced by prefill)
+            i = jnp.asarray(slot, jnp.int32)
+            self._slot_keys = self._slot_keys.at[i].set(slot_key)
+            self._patch_slot_device(slot, s.temperature, s.top_p, s.top_k,
+                                    len(req.prompt_ids), True)
         state = _SlotState(
             request_id=req.request_id,
             emit=req.emit,
@@ -573,13 +937,16 @@ class ContinuousBatchingEngine:
             stops=frozenset(s.stop_token_ids) | frozenset(self.config.eos_token_ids),
             chain=chain,
         )
+        T = len(req.prompt_ids)
         self.slots[slot] = state
         self.lengths[slot] = T
         self.active[slot] = True
         self._temp[slot] = s.temperature
         self._top_p[slot] = s.top_p
         self._top_k[slot] = s.top_k
-        self._last_tokens = self._last_tokens.at[slot].set(tok)
+        self._last_tokens = self._last_tokens.at[
+            jnp.asarray(slot, jnp.int32)].set(jnp.int32(tok))
+        self._epoch += 1
         # invariant: an active slot can ALWAYS fit a full decode chunk — slots
         # that can't are finished here/at chunk end, so decode never clamp-writes
         no_room = T + self._k_steps > self.config.max_seq_len
@@ -603,32 +970,55 @@ class ContinuousBatchingEngine:
             self.active[slot] = False
             self.slots[slot] = None
             self.requests_completed += 1
-            if self.paged and state.chain is not None:
-                self.pool.release_slot(state.chain)
-                self.page_table[slot, :] = 0
-                self._pt_dirty = True
+            self._release_free_slot(slot)
+            self._epoch += 1
+            if self.paged:
+                self._deactivate_slot_device(slot)
+                if state.chain is not None:
+                    self.pool.release_slot(state.chain)
+                    self.page_table[slot, :] = 0
+                    self._mark_pt_row(slot)
 
-    def _ensure_chunk_capacity(self) -> None:
+    # ------------------------------------------------------------ decode round
+    def _ensure_chunk_capacity(self, horizon: Optional[int] = None) -> None:
         """Paged mode: before a chunk, every active slot's chain must cover its
-        length + k tokens (a chunk may cross a page boundary mid-flight; page
-        allocation is host-side, so it happens here, never inside jit). Slots
-        the pool cannot serve are preempted to host and resumed by _admit when
-        space frees; a request even an idle pool can't hold is terminal-shed
-        there (bounded — no infinite retry)."""
+        length + horizon tokens (a chunk may cross a page boundary mid-flight;
+        page allocation is host-side, so it happens here, never inside jit).
+        With lookahead the horizon is 2·k so the speculative chunk's positions
+        are covered too. Slots the pool cannot serve are preempted to host and
+        resumed by _admit when space frees; a request even an idle pool can't
+        hold is terminal-shed there (bounded — no infinite retry)."""
+        horizon = horizon if horizon is not None else self._k_steps
         for slot in range(self.n_slots):
             state = self.slots[slot]
             if state is None or not self.active[slot]:
                 continue
             chain = state.chain
             assert chain is not None
-            needed = int(self.lengths[slot]) + self._k_steps
+            L = int(self.lengths[slot])
+            needed = min(L + horizon, self.config.max_seq_len)
             if self.pool.pages_for(needed) <= len(chain):
                 continue
             try:
                 before = len(chain)
                 self.pool.extend_chain(chain, needed)
                 self.page_table[slot, before: len(chain)] = chain[before:]
-                self._pt_dirty = True
+                self._mark_pt_row(slot)
+                continue
+            except MemoryError:
+                # the 2·k lookahead horizon is OPPORTUNISTIC — a slot that can
+                # still cover its mandatory chunk must not be preempted for it
+                # (preempting on the optimistic ask would livelock: resume only
+                # restores length+k, the next round asks 2·k again, and the
+                # request round-trips its KV forever without emitting a token)
+                mandatory = min(L + self._k_steps, self.config.max_seq_len)
+                if self.pool.pages_for(mandatory) <= len(chain):
+                    continue  # enough for the chunk; lookahead will just skip
+            try:
+                before = len(chain)
+                self.pool.extend_chain(chain, mandatory)
+                self.page_table[slot, before: len(chain)] = chain[before:]
+                self._mark_pt_row(slot)
             except MemoryError:
                 # preempt-to-host, don't shed: save the chain's KV, free the
                 # pages, and park the request — _admit resumes it when space
@@ -645,41 +1035,107 @@ class ContinuousBatchingEngine:
                 self.preemptions += 1
                 self.active[slot] = False
                 self.slots[slot] = None
+                self._release_free_slot(slot)
+                self._deactivate_slot_device(slot)
+                self._epoch += 1
                 self.pool.release_slot(chain)
                 self.page_table[slot, :] = 0
-                self._pt_dirty = True
+                self._mark_pt_row(slot)
 
-    def _decode_round(self) -> None:
-        self.occupancy_samples.append(self.active_slots)
-        if self.paged:
-            self._ensure_chunk_capacity()
-            if not self.active.any():
-                return
-            if self._pt_dirty:
-                self._page_table_dev = jnp.asarray(self.page_table)
-                self._pt_dirty = False
-            lengths_dev = jnp.asarray(self.lengths)
-            chunk_dev, k_pool, v_pool, last, self._slot_keys = self._paged_decode_fn(
-                self.params, self.pool.k_pool, self.pool.v_pool,
-                self._page_table_dev, self._last_tokens, lengths_dev,
-                self._slot_keys, jnp.asarray(self._temp),
-                jnp.asarray(self._top_p), jnp.asarray(self._top_k))
-            self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
+    def _dispatch_chunk(self, after: Optional[_InflightChunk]) -> _InflightChunk:
+        """One fused-chunk dispatch (async — the return holds futures).
+        ``after`` chains the dispatch onto a still-unread chunk's device
+        outputs: that is the one-chunk lookahead."""
+        self._flush_pt_patches()
+        if after is None:
+            last, keys, lengths = (self._last_tokens, self._slot_keys,
+                                   self._lengths_dev)
         else:
-            lengths_dev = jnp.asarray(self.lengths)
-            chunk_dev, k_cache, v_cache, last, self._rng = self._decode_fn(
-                self.params, self.cache[0], self.cache[1], self._last_tokens,
-                lengths_dev, self._rng,
-                jnp.asarray(self._temp), jnp.asarray(self._top_p),
-                jnp.asarray(self._top_k))
-            self.cache = (k_cache, v_cache)
-        self._last_tokens = last
-        chunk = np.asarray(chunk_dev, np.int32)  # [N, k]
+            last, keys, lengths = after.last, after.keys, after.lengths_dev
+        chunk_dev, k_pool, v_pool, last_o, keys_o, lens_o = self._paged_decode_fn(
+            self.params, self.pool.k_pool, self.pool.v_pool,
+            self._page_table_dev, last, lengths, self._active_dev, keys,
+            self._temp_dev, self._top_p_dev, self._top_k_dev)
+        self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
+        return _InflightChunk(chunk_dev, last_o, keys_o, lens_o, self._epoch)
+
+    def _can_lookahead(self, inflight: _InflightChunk) -> bool:
+        """Dispatch chunk N+1 before reading chunk N only when the speculation
+        is likely to survive: no admission/resume can occur next round, no
+        slot predictably finishes inside chunk N, and every chain pre-extends
+        to cover the extra chunk WITHOUT preempting (a failed extension just
+        skips the lookahead; the next synchronous round preempts properly).
+        Stop-token finishes stay unpredictable — the epoch check after
+        emission discards the stale chunk in that case."""
+        if self._stop.is_set() or inflight.epoch != self._epoch:
+            return False
+        if self._free_slots and (self._suspended or not self._pending.empty()):
+            return False  # an admission next round would invalidate it
         k = self._k_steps
-        # active slots advance by k; inactive slots pin to 0 so their garbage
-        # positions never run past the rope table / cache bounds
+        max_seq = self.config.max_seq_len
+        for slot in range(self.n_slots):
+            state = self.slots[slot]
+            if state is None or not self.active[slot]:
+                continue
+            L = int(self.lengths[slot])
+            if L + 2 * k > max_seq:
+                return False  # finishes with 'length' inside chunk N
+            if state.emitted + k >= state.sampling.max_tokens:
+                return False  # hits max_tokens inside chunk N
+            chain = state.chain
+            if self.pool.pages_for(L + 2 * k) > len(chain):
+                try:
+                    before = len(chain)
+                    self.pool.extend_chain(chain, L + 2 * k)
+                    self.page_table[slot, before: len(chain)] = chain[before:]
+                    self._mark_pt_row(slot)
+                except MemoryError:
+                    return False
+        return True
+
+    def _discard_inflight(self, rec: _InflightChunk) -> None:
+        """Drop a stale speculative chunk. Committed state (last_tokens /
+        keys / lengths) was never advanced past the last emitted chunk, so
+        nothing needs restoring; the chunk's only lasting effect is KV written
+        past every committed length — rewritten identically by the synchronous
+        fallback for surviving slots, masked by attention-length bounds, or
+        fully rescattered by the next owner of a freed slot's pages."""
+        self._lookahead_stats["discarded"] += 1
+
+    def _commit_chunk(self, rec: _InflightChunk) -> np.ndarray:
+        """Adopt a read chunk's device outputs as committed state; advance the
+        host length mirror. Returns the pre-chunk lengths for the emit loop."""
+        self._last_tokens = rec.last
+        self._slot_keys = rec.keys
+        self._lengths_dev = rec.lengths_dev
+        return self._advance_lengths()
+
+    def _advance_lengths(self) -> np.ndarray:
+        """Shared by the paged and dense rounds: active slots advance by k;
+        inactive slots pin to 0 so their garbage positions never run past the
+        rope table / cache bounds. Returns the pre-chunk lengths."""
         old_lengths = self.lengths.copy()
-        self.lengths = np.where(self.active, self.lengths + k, 0).astype(np.int32)
+        self.lengths = np.where(self.active, self.lengths + self._k_steps,
+                                0).astype(np.int32)
+        return old_lengths
+
+    def _record_round(self, dispatch_ms: float, sync_wait_ms: float,
+                      host_emit_ms: float, lookahead: bool) -> None:
+        """One timing-schema owner for both decode modes — the stats()
+        percentile keys cannot drift between paged and dense."""
+        self.decode_rounds += 1
+        if lookahead:
+            self.lookahead_rounds += 1
+        self.round_timings.append({
+            "admit_ms": self._last_admit_ms,
+            "dispatch_ms": round(dispatch_ms, 3),
+            "sync_wait_ms": round(sync_wait_ms, 3),
+            "host_emit_ms": round(host_emit_ms, 3),
+            "lookahead": lookahead,
+        })
+
+    def _emit_chunk(self, chunk: np.ndarray, old_lengths: np.ndarray) -> None:
+        k = self._k_steps
         for j in range(k):
             last_of_chunk = j == k - 1
             for slot in range(self.n_slots):
@@ -691,3 +1147,58 @@ class ContinuousBatchingEngine:
                 self._emit_token(
                     slot, int(chunk[slot, j]),
                     force_length=last_of_chunk and next_chunk_overflows)
+
+    def _decode_round(self) -> None:
+        self.occupancy_samples.append(self.active_slots)
+        if not self.paged:
+            self._decode_round_dense()
+            return
+        t0 = time.monotonic()
+        lookahead_on = self.config.decode_lookahead
+        inflight, self._inflight = self._inflight, None
+        if inflight is not None and inflight.epoch != self._epoch:
+            self._discard_inflight(inflight)
+            inflight = None
+        used_lookahead = inflight is not None
+        if used_lookahead:
+            self._lookahead_stats["used"] += 1
+        else:
+            self._ensure_chunk_capacity(
+                self._k_steps * (2 if lookahead_on else 1))
+            if not self.active.any():
+                return  # everyone got preempted
+            inflight = self._dispatch_chunk(after=None)
+        t1 = time.monotonic()
+        if lookahead_on and self._can_lookahead(inflight):
+            self._inflight = self._dispatch_chunk(after=inflight)
+            self._lookahead_stats["dispatched"] += 1
+        t2 = time.monotonic()
+        chunk = np.asarray(inflight.chunk_dev, np.int32)  # sync-point: the ONE sanctioned decode-loop readback (AS04)
+        t3 = time.monotonic()
+        old_lengths = self._commit_chunk(inflight)
+        self._emit_chunk(chunk, old_lengths)
+        t4 = time.monotonic()
+        # a finish just changed the world — the speculative chunk is stale
+        if self._inflight is not None and self._inflight.epoch != self._epoch:
+            self._discard_inflight(self._inflight)
+            self._inflight = None
+        self._record_round((t2 - t0) * 1000.0, (t3 - t2) * 1000.0,
+                           (t4 - t3) * 1000.0, used_lookahead)
+
+    def _decode_round_dense(self) -> None:
+        t0 = time.monotonic()
+        lengths_dev = jnp.asarray(self.lengths)
+        chunk_dev, k_cache, v_cache, last, self._rng = self._decode_fn(
+            self.params, self.cache[0], self.cache[1], self._last_tokens,
+            lengths_dev, self._rng,
+            jnp.asarray(self._temp), jnp.asarray(self._top_p),
+            jnp.asarray(self._top_k))
+        self.cache = (k_cache, v_cache)
+        self._last_tokens = last
+        t1 = time.monotonic()
+        chunk = np.asarray(chunk_dev, np.int32)  # sync-point: dense-mode chunk readback (AS04)
+        t2 = time.monotonic()
+        self._emit_chunk(chunk, self._advance_lengths())
+        t3 = time.monotonic()
+        self._record_round((t1 - t0) * 1000.0, (t2 - t1) * 1000.0,
+                           (t3 - t2) * 1000.0, lookahead=False)

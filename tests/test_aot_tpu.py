@@ -12,6 +12,11 @@ import pytest
 
 import jax
 
+#: whole-module slow gate: every case here drives the full TPU AOT compiler
+#: (libtpu topology + Mosaic kernel lowering), minutes-scale per program —
+#: the AOT_TPU.json artifact and the TPU-day gate own this, not tier-1
+pytestmark = pytest.mark.slow
+
 
 def _topo_or_skip(name="v5e:2x2"):
     from cyberfabric_core_tpu.runtime.aot_tpu import tpu_topology

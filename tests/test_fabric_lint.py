@@ -147,6 +147,73 @@ def test_AS03_nested_def_resets_lock_context():
     assert ok == []
 
 
+_AS04_CLASS = (
+    "import numpy as np\n"
+    "class Sched:\n"
+    "    def _run_loop(self):\n"
+    "        while True:\n"
+    "            self._decode_round()\n"
+)
+
+
+def test_AS04_unsanctioned_sync_in_decode_loop_fails():
+    bad = lint(
+        _AS04_CLASS +
+        "    def _decode_round(self):\n"
+        "        chunk = np.asarray(self._chunk_dev)\n",
+        tier="runtime", select=("AS04",))
+    assert rule_ids(bad) == ["AS04"]
+    assert "sync-point" in bad[0].message
+
+
+def test_AS04_block_until_ready_in_emit_fails():
+    bad = lint(
+        _AS04_CLASS +
+        "    def _emit_chunk(self, chunk):\n"
+        "        chunk.block_until_ready()\n",
+        tier="runtime", select=("AS04",))
+    assert rule_ids(bad) == ["AS04"]
+
+
+def test_AS04_sanctioned_sync_point_passes():
+    ok = lint(
+        _AS04_CLASS +
+        "    def _decode_round(self):\n"
+        "        chunk = np.asarray(self._chunk_dev)  # sync-point: one read per round\n",
+        tier="runtime", select=("AS04",))
+    assert ok == []
+
+
+def test_AS04_sync_outside_loop_methods_passes():
+    # admission-path syncs (first-token readback) are inherent, not hot-loop
+    ok = lint(
+        _AS04_CLASS +
+        "    def _prefill_into_slot(self, slot, req):\n"
+        "        tok = int(np.asarray(self._first)[0])\n",
+        tier="runtime", select=("AS04",))
+    assert ok == []
+
+
+def test_AS04_requires_scheduler_class():
+    # a _decode_round on a class WITHOUT _run_loop is not a scheduler thread
+    ok = lint(
+        "import numpy as np\n"
+        "class Helper:\n"
+        "    def _decode_round(self):\n"
+        "        return np.asarray(self.x)\n",
+        tier="runtime", select=("AS04",))
+    assert ok == []
+
+
+def test_AS04_only_applies_to_runtime_tier():
+    ok = lint(
+        _AS04_CLASS +
+        "    def _decode_round(self):\n"
+        "        chunk = np.asarray(self._chunk_dev)\n",
+        tier="modules", select=("AS04",))
+    assert ok == []
+
+
 # ---------------------------------------------------------------- JP family
 
 

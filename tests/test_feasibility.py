@@ -95,6 +95,9 @@ def test_tp1_equals_unsharded_bytes():
     assert plan["param_bytes_per_device"] == plan["param_bytes_total"] == raw
 
 
+@pytest.mark.slow  # the only tier-1 test that touched the TPU AOT compiler:
+# its once-per-process init is minutes-scale — it belongs to the same slow
+# gate as tests/test_aot_tpu.py
 def test_planner_agrees_with_xla_memory_analysis():
     """Cross-check the static planner against XLA's own per-device argument
     accounting from an AOT compile of the same sharded program (tiny model,

@@ -153,11 +153,13 @@ def test_preemption_pressure_holds_invariants():
     h = Harness(eng)
     pool = eng.pool
     orig_extend = pool.extend_chain
-    faults = {"left": 2, "armed": 0}
+    faults = {"armed": 0}
 
     def flaky_extend(chain, needed):
-        if faults["armed"] > 0 and faults["left"] > 0 and len(chain) >= 2:
-            faults["left"] -= 1
+        # fail until two preemptions have landed (optimistic 2·k-horizon
+        # failures are absorbed without preempting, so a fixed fire count
+        # would be consumed gracefully and never force the path under test)
+        if faults["armed"] > 0 and eng.preemptions < 2 and len(chain) >= 2:
             raise MemoryError("injected pool pressure")
         return orig_extend(chain, needed)
 

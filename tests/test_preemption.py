@@ -56,8 +56,10 @@ def test_preempted_request_resumes_bit_exact():
         first_tok = threading.Event()
 
         def flaky_extend(chain, needed):
-            # after the stream starts, fail ONE extension to force preemption
-            if first_tok.is_set() and fired["n"] == 0:
+            # after the stream starts, fail extensions until a preemption
+            # actually lands (the 2·k lookahead horizon absorbs optimistic
+            # failures gracefully; only a mandatory-chunk failure preempts)
+            if first_tok.is_set() and sched.preemptions == 0:
                 fired["n"] += 1
                 raise MemoryError("injected pool pressure")
             return orig_extend(chain, needed)
@@ -77,7 +79,7 @@ def test_preempted_request_resumes_bit_exact():
 
         sched.submit(prompt, SamplingParams(max_tokens=16, temperature=0.0), emit)
         assert done.wait(120), (out, sched.stats())
-        assert fired["n"] == 1, "fault never fired"
+        assert fired["n"] >= 1, "fault never fired"
         st = sched.stats()
         assert st["preemptions"] == 1
         assert out["finish"] in ("stop", "length")
@@ -96,12 +98,12 @@ def test_suspended_request_outranks_new_admissions():
     try:
         pool = sched.pool
         orig_extend = pool.extend_chain
-        state = {"fired": False}
         started = threading.Event()
 
         def flaky_extend(chain, needed):
-            if started.is_set() and not state["fired"]:
-                state["fired"] = True
+            # persist until the preemption lands (optimistic-horizon failures
+            # are absorbed without preempting)
+            if started.is_set() and sched.preemptions == 0:
                 raise MemoryError("injected")
             return orig_extend(chain, needed)
 
