@@ -5,9 +5,9 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu
+.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos
 
-safety: lint modelcheck fuzz sanitizers contracts aot-tpu  ## the full local gate
+safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
 
@@ -34,6 +34,10 @@ contracts:  ## OpenAPI golden gate + GTS docs validation (oasdiff equivalent)
 
 aot-tpu:  ## TPU lowering gate: serving set compiles for v5e via topology AOT
 	$(PY) -m pytest tests/test_aot_tpu.py tests/test_feasibility.py -q
+
+chaos:  ## faultlab: deterministic seeded chaos-scenario suite (every failpoint exercised, invariants green, repeat-stable)
+	$(PY) -m pytest tests/test_faultlab.py -q
+	$(PY) -m cyberfabric_core_tpu.apps.faultlab --repeat 2 > /dev/null
 
 test:  ## full suite
 	$(PY) -m pytest tests/ -q

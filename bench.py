@@ -697,6 +697,70 @@ def embed_bench() -> int:
         return 1
 
 
+def faultlab_guard() -> int:
+    """Disabled-mode overhead guard for the failpoint subsystem (faultlab).
+
+    A/B: the --aggregate workload with the failpoint machinery LIVE but
+    disarmed (the production state) vs with the call sites stubbed to bare
+    no-ops (``BENCH_FAILPOINTS_OFF=1`` — the closest Python gets to
+    "compiled out"). Interleaved A/B/B/A child runs decorrelate host drift;
+    medians per arm. Evidence lands in BENCH_FAULTLAB.json with a pass flag
+    at the <1% tok/s bar (plus the run spread, so a noisy host reads as
+    noise, not as regression).
+    """
+    reps = int(os.environ.get("BENCH_FAULTLAB_REPS", "2"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_COST="0")
+
+    def one(off: str) -> float | None:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--aggregate",
+             "tiny-llama", "none"],
+            capture_output=True, text=True, timeout=900,
+            env=dict(env, BENCH_FAILPOINTS_OFF=off))
+        sys.stderr.write(proc.stderr[-2000:])
+        try:
+            return float(json.loads(
+                proc.stdout.strip().splitlines()[-1])["tokens_per_sec"])
+        except Exception as e:  # noqa: BLE001
+            log(f"faultlab guard child failed: {e}")
+            return None
+
+    arms: dict[str, list[float]] = {"disarmed": [], "stubbed": []}
+    # ABBA ordering, `reps` runs per arm, so slow host drift cancels
+    order = (["disarmed", "stubbed", "stubbed", "disarmed"]
+             * ((reps + 1) // 2))[: 2 * reps]
+    for label in order:
+        v = one("0" if label == "disarmed" else "1")
+        if v is not None:
+            arms[label].append(v)
+
+    # per-arm BEST run: on a shared host, co-tenant contention only ever
+    # slows a run down, so the max is the least-contaminated measurement of
+    # each arm (the CPU-canary "agreeing pair" logic's cheaper cousin)
+    disarmed = max(arms["disarmed"], default=0.0)
+    stubbed = max(arms["stubbed"], default=0.0)
+    delta_pct = ((stubbed - disarmed) / stubbed * 100.0) if stubbed else 0.0
+    spread = {k: (round(max(v) / max(1e-9, min(v)) - 1.0, 4) if v else None)
+              for k, v in arms.items()}
+    report = {
+        "note": ("failpoints disabled-mode overhead: --aggregate tok/s with "
+                 "the registry live-but-disarmed vs call sites stubbed to "
+                 "no-ops (compiled-out equivalent); interleaved ABBA runs, "
+                 "best run per arm (contention only slows runs down)"),
+        "runs": arms,
+        "disarmed_tok_s": round(disarmed, 1),
+        "stubbed_tok_s": round(stubbed, 1),
+        "overhead_pct": round(delta_pct, 3),
+        "within_run_spread": spread,
+        "pass": bool(disarmed and stubbed and delta_pct < 1.0),
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_FAULTLAB.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return 0 if report["pass"] else 1
+
+
 def aggregate(model_name: str, quant: str) -> int:
     """8 concurrent streams through the continuous scheduler (paged KV pool +
     ragged paged decode attention), with STAGGERED arrivals — the pattern the
@@ -715,6 +779,14 @@ def aggregate(model_name: str, quant: str) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("BENCH_FAILPOINTS_OFF") == "1":
+        # the faultlab guard's "compiled out" arm: replace the scheduler's
+        # failpoint binding with a bare no-op (the closest Python gets to
+        # removing the call sites) so the A/B isolates the registry's
+        # disabled-mode cost
+        import cyberfabric_core_tpu.runtime.scheduler as _sched_mod
+
+        _sched_mod.failpoint = lambda name: None
     try:
         # max_seq 512 covers the workload (prompt <=160 + 192 generated); the
         # paged pool scales with num_pages × layers × kv-heads, and MHA models
@@ -1162,6 +1234,8 @@ if __name__ == "__main__":
         sys.exit(single(sys.argv[2], sys.argv[3]))
     if len(sys.argv) > 3 and sys.argv[1] == "--aggregate":
         sys.exit(aggregate(sys.argv[2], sys.argv[3]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--faultlab-guard":
+        sys.exit(faultlab_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--embed":
         sys.exit(embed_bench())
     if len(sys.argv) > 3 and sys.argv[1] == "--cost":

@@ -2,5 +2,6 @@
 
 from . import async_safety  # noqa: F401
 from . import design        # noqa: F401
+from . import failpoints    # noqa: F401
 from . import jit_purity    # noqa: F401
 from . import lock_discipline  # noqa: F401

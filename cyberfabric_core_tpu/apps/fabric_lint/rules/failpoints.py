@@ -1,0 +1,110 @@
+"""FP — failpoint discipline.
+
+FP01: every ``failpoint("name")`` / ``failpoint_async("name")`` call site
+must (a) pass a string LITERAL (the catalog, the docs table, and the
+monitoring REST surface are keyed on literal names — a computed name is
+invisible to all three), (b) use a name registered in
+``modkit.failpoints.FAILPOINT_CATALOG``, and (c) own that name exclusively —
+one call site per name, so arming a point fires exactly one known location
+and the docs table row maps 1:1 to code.
+
+The catalog is read from the scanned project itself: any ``FAILPOINT_CATALOG
+= {...}`` dict literal in the scanned files (fixtures define their own); when
+the scan doesn't include one (e.g. linting a single file), the real package
+catalog is imported as the authority.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..engine import (FileContext, Finding, ProjectContext, Rule,
+                      dotted_name, register)
+
+_CALL_NAMES = {"failpoint", "failpoint_async"}
+
+
+def _failpoint_calls(ctx: FileContext):
+    """Yield (node, literal-or-None) for every failpoint evaluation call."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        terminal = dotted_name(node.func).rsplit(".", 1)[-1]
+        if terminal not in _CALL_NAMES:
+            continue
+        if not node.args:
+            yield node, None
+            continue
+        arg = node.args[0]
+        literal = arg.value if (isinstance(arg, ast.Constant)
+                                and isinstance(arg.value, str)) else None
+        yield node, literal
+
+
+def _catalog_from_project(project: ProjectContext) -> Optional[set[str]]:
+    """Names from any ``FAILPOINT_CATALOG = {...}`` literal in the scan."""
+    names: set[str] = set()
+    found = False
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if "FAILPOINT_CATALOG" not in targets:
+                continue
+            if isinstance(node.value, ast.Dict):
+                found = True
+                for key in node.value.keys:
+                    if isinstance(key, ast.Constant) and \
+                            isinstance(key.value, str):
+                        names.add(key.value)
+    return names if found else None
+
+
+@register
+class FP01(Rule):
+    id = "FP01"
+    family = "FP"
+    severity = "error"
+    description = ("failpoint call sites use unique, catalog-registered "
+                   "literal names")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        catalog = _catalog_from_project(project)
+        if catalog is None:
+            try:
+                from ....modkit.failpoints import FAILPOINT_CATALOG
+
+                catalog = set(FAILPOINT_CATALOG)
+            except Exception:  # noqa: BLE001 — standalone lint install
+                catalog = set()
+        #: name -> first call site (relpath, line) seen
+        owners: dict[str, tuple[str, int]] = {}
+        for ctx in project.files:
+            if ctx.path.name == "failpoints.py" and "modkit" in ctx.relpath:
+                continue  # the registry's own definitions, not call sites
+            for node, literal in _failpoint_calls(ctx):
+                if literal is None:
+                    yield self.finding_in(
+                        ctx, node,
+                        "failpoint name must be a string literal from "
+                        "FAILPOINT_CATALOG — a computed name can't be "
+                        "catalogued, documented, or armed by name")
+                    continue
+                if catalog and literal not in catalog:
+                    yield self.finding_in(
+                        ctx, node,
+                        f"failpoint {literal!r} is not registered in "
+                        "FAILPOINT_CATALOG — add it (with layer + "
+                        "description) before wiring the call site")
+                    continue
+                owner = owners.get(literal)
+                if owner is not None:
+                    yield self.finding_in(
+                        ctx, node,
+                        f"failpoint {literal!r} already has a call site at "
+                        f"{owner[0]}:{owner[1]} — one call site per name, "
+                        "so arming a point fires exactly one location")
+                else:
+                    owners[literal] = (ctx.relpath, node.lineno)

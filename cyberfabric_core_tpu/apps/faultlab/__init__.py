@@ -1,0 +1,31 @@
+"""faultlab — deterministic chaos-scenario runner over the failpoint registry.
+
+The stack carries real resilience machinery (retry budgets, circuit breakers,
+mid-stream replica failover, preempt/suspend/resume, serverless retry /
+dead-letter) — faultlab is what *exercises* it. A scenario is a small dict
+(or YAML file): a load profile, a fault schedule keyed on failpoint names
+(modkit.failpoints.FAILPOINT_CATALOG), and a seed. The runner drives the real
+engine / pool / gateway in-process, injects the scheduled faults, and runs
+invariant checkers:
+
+- no request is lost or double-terminated;
+- token streams stay bit-identical across injected preempt and failover
+  (greedy decode — the checkers compare against an unfaulted baseline);
+- slot / page-refcount accounting leaks nothing after the storm drains;
+- circuit breakers open under injected upstream faults and then recover.
+
+Entry points: ``run_scenario(spec)``, ``run_all(seed=...)``, and the CLI
+``python -m cyberfabric_core_tpu.apps.faultlab`` (used by ``make chaos``).
+Live-server rehearsals arm the same failpoints over the guarded monitoring
+REST endpoints (``/v1/monitoring/failpoints``); :func:`arm_over_rest` is the
+client-side helper.
+"""
+
+from .invariants import CHECKERS
+from .runner import ScenarioResult, arm_over_rest, run_all, run_scenario
+from .scenarios import BUILTIN_SCENARIOS, load_scenario_file
+
+__all__ = [
+    "BUILTIN_SCENARIOS", "CHECKERS", "ScenarioResult", "arm_over_rest",
+    "load_scenario_file", "run_all", "run_scenario",
+]

@@ -1,0 +1,183 @@
+"""Invariant checkers — what must stay true while faults are injected.
+
+Each checker takes the scenario *evidence* (a dict the runner fills) and
+returns a list of problem strings; an empty list is green. The runner maps
+checker names to findings in the ScenarioResult, and the scenario verdict is
+"every requested checker returned no problems".
+
+Evidence keys (filled per scenario kind; checkers tolerate absence of keys
+they don't need by failing loudly — a scenario that requests a checker must
+provide its evidence):
+
+- ``streams``:   {request_index: StreamRecord} from the faulted run
+- ``baseline``:  {request_index: StreamRecord} from the unfaulted run
+- ``engine``:    the ContinuousBatchingEngine after the run drained
+- ``pool``:      the DataParallelServingPool after the run drained
+- ``breaker_trace``: ordered breaker-state observations
+- ``expect_error``: request indices that are EXPECTED to error-terminate
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+__all__ = ["CHECKERS", "StreamRecord", "run_checkers"]
+
+
+@dataclass
+class StreamRecord:
+    """Everything one client observed for one request."""
+
+    tokens: list[int] = field(default_factory=list)
+    terminals: list[str] = field(default_factory=list)  # finish reasons seen
+    tokens_after_terminal: int = 0
+
+    @property
+    def finished(self) -> bool:
+        return bool(self.terminals)
+
+    @property
+    def errored(self) -> bool:
+        return bool(self.terminals) and self.terminals[0] == "error"
+
+
+def record_event(rec: StreamRecord, token_id: int, finished: Any) -> None:
+    """The one emit-callback body every scenario uses (kept here so the
+    accounting the checkers rely on cannot drift between scenario kinds)."""
+    if rec.terminals and token_id >= 0:
+        rec.tokens_after_terminal += 1
+    elif token_id >= 0:
+        rec.tokens.append(token_id)
+    if finished:
+        rec.terminals.append(finished)
+
+
+def check_exactly_one_terminal(evidence: dict) -> list[str]:
+    """No request lost (zero terminals) and none double-emitted (two
+    terminals, or tokens arriving after the stream ended)."""
+    problems = []
+    for idx, rec in sorted(evidence["streams"].items()):
+        if len(rec.terminals) == 0:
+            problems.append(f"request {idx}: no terminal event (lost)")
+        elif len(rec.terminals) > 1:
+            problems.append(
+                f"request {idx}: {len(rec.terminals)} terminal events "
+                f"{rec.terminals} (double-terminated)")
+        if rec.tokens_after_terminal:
+            problems.append(
+                f"request {idx}: {rec.tokens_after_terminal} tokens after "
+                "the terminal event")
+    return problems
+
+
+def check_streams_match_baseline(evidence: dict) -> list[str]:
+    """Surviving streams are bit-identical to the unfaulted baseline run
+    (greedy decode: preemption, resume, and failover must not change a
+    single token). Requests listed in ``expect_error`` are exempt."""
+    problems = []
+    baseline = evidence["baseline"]
+    exempt = set(evidence.get("expect_error", ()))
+    for idx, rec in sorted(evidence["streams"].items()):
+        if idx in exempt:
+            continue
+        base = baseline[idx]
+        if rec.terminals != base.terminals:
+            problems.append(
+                f"request {idx}: finish {rec.terminals} != baseline "
+                f"{base.terminals}")
+        if rec.tokens != base.tokens:
+            diff = next((i for i, (a, b) in
+                         enumerate(zip(rec.tokens, base.tokens)) if a != b),
+                        min(len(rec.tokens), len(base.tokens)))
+            problems.append(
+                f"request {idx}: stream diverges from baseline at token "
+                f"{diff} ({len(rec.tokens)} vs {len(base.tokens)} tokens)")
+    return problems
+
+
+def check_expected_errors(evidence: dict) -> list[str]:
+    """Requests the fault schedule targets must error; no others may."""
+    problems = []
+    expected = set(evidence.get("expect_error", ()))
+    for idx, rec in sorted(evidence["streams"].items()):
+        if idx in expected and not rec.errored:
+            problems.append(
+                f"request {idx}: expected an error terminal, got "
+                f"{rec.terminals}")
+        if idx not in expected and rec.errored:
+            problems.append(f"request {idx}: unexpected error terminal")
+    return problems
+
+
+def check_engine_accounting(evidence: dict) -> list[str]:
+    """After the storm drains: every slot free, no pending/suspended
+    leftovers, and the paged pool holds zero slot references or orphans —
+    nothing leaked across admissions, faults, preempts, and resumes."""
+    engine = evidence["engine"]
+    problems = []
+    if len(engine._free_slots) != engine.n_slots:
+        problems.append(
+            f"free-slot leak: {len(engine._free_slots)}/{engine.n_slots} "
+            "slots on the free deque")
+    if any(s is not None for s in engine.slots):
+        problems.append("slot-state leak: a drained engine still holds "
+                        "_SlotState records")
+    if engine.active.any():
+        problems.append("active-mask leak: slots still active after drain")
+    if engine._pending.qsize():
+        problems.append(f"pending leak: {engine._pending.qsize()} queued")
+    if engine._suspended:
+        problems.append(f"suspended leak: {len(engine._suspended)} parked")
+    if engine.pool is not None:
+        stats = engine.pool.stats()
+        if stats.get("pages_referenced", 0):
+            problems.append(
+                f"page-refcount leak: {stats['pages_referenced']} pages "
+                "still referenced after drain")
+        if stats.get("orphan_pages", 0):
+            problems.append(f"orphan-page leak: {stats['orphan_pages']}")
+    return problems
+
+
+def check_pool_clean(evidence: dict) -> list[str]:
+    """The serving pool dropped every tracking record (a leaked record pins
+    the request's prompt + emitted tokens in host memory forever)."""
+    pool = evidence["pool"]
+    problems = []
+    if pool._requests:
+        problems.append(
+            f"tracking-record leak: {sorted(pool._requests)} still held")
+    return problems
+
+
+def check_breaker_recovered(evidence: dict) -> list[str]:
+    """The breaker must have OPENED under the injected upstream faults and
+    then RECOVERED to closed once the faults stopped."""
+    trace = evidence["breaker_trace"]
+    problems = []
+    if "open" not in trace:
+        problems.append(f"breaker never opened under faults (trace={trace})")
+    if not trace or trace[-1] != "closed":
+        problems.append(f"breaker did not recover to closed (trace={trace})")
+    return problems
+
+
+CHECKERS: dict[str, Callable[[dict], list[str]]] = {
+    "exactly_one_terminal": check_exactly_one_terminal,
+    "streams_match_baseline": check_streams_match_baseline,
+    "expected_errors": check_expected_errors,
+    "engine_accounting": check_engine_accounting,
+    "pool_clean": check_pool_clean,
+    "breaker_recovered": check_breaker_recovered,
+}
+
+
+def run_checkers(names: list[str], evidence: dict) -> dict[str, list[str]]:
+    out: dict[str, list[str]] = {}
+    for name in names:
+        try:
+            out[name] = CHECKERS[name](evidence)
+        except Exception as e:  # noqa: BLE001 — a crashed checker is a red
+            out[name] = [f"checker crashed: {type(e).__name__}: {e}"]
+    return out

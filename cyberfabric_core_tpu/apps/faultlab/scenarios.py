@@ -1,0 +1,253 @@
+"""The builtin chaos-scenario catalog + the scenario file format.
+
+A scenario is a dict:
+
+.. code-block:: yaml
+
+    name: forced-preempt            # unique scenario name
+    kind: engine                    # engine|pool|http_retry|db_commit|
+                                    #   server_breaker|server_gateway|
+                                    #   serverless|worker|grpc_evict
+    seed: 1234                      # drives load gen + probability modes
+    engine: {max_batch: 2, ...}     # EngineConfig overrides (engine/pool)
+    load: {requests: 4, prompt_len: [4, 10], max_tokens: 10}
+    faults:                         # the fault schedule, keyed on failpoint
+      - point: scheduler.page_alloc #   names (modkit.failpoints catalog)
+        spec: "1*raise(MemoryError)"  # fail-crate-style action spec
+    invariants: [exactly_one_terminal, streams_match_baseline,
+                 engine_accounting]
+    expect_error: [0]               # request indices that MUST error
+    expect_stats: {preemptions: [1, null]}   # [min, max] bounds
+
+``spec`` strings: ``raise`` / ``raise(MemoryError)`` / ``delay(0.01)`` /
+``return(503)`` / ``2*raise`` (first two hits) / ``3:raise`` (every 3rd) /
+``25%raise`` (probability, deterministic under the scenario seed); dicts with
+the Action fields also work. YAML files with a top-level ``scenarios:`` list
+load via :func:`load_scenario_file`.
+
+Every failpoint in ``modkit.failpoints.FAILPOINT_CATALOG`` is covered by at
+least one builtin scenario below — tests/test_faultlab.py asserts that, so a
+new failpoint cannot land without a chaos scenario exercising it.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+__all__ = ["BUILTIN_SCENARIOS", "load_scenario_file", "scenario_by_name"]
+
+#: shared tiny-engine shape: one prefill bucket (prompts <= 10 → bucket 16),
+#: paged pool, greedy decode — a handful of compiled programs serve every
+#: engine/pool scenario, and the baseline cache is shared across them
+_TINY = {"model": "tiny-llama", "max_seq_len": 64, "max_batch": 2,
+         "decode_chunk": 4, "prefix_cache_pages": 64, "prefix_page_size": 16,
+         "use_flash": False}
+_LOAD = {"requests": 4, "prompt_len": [4, 10], "max_tokens": 10}
+
+BUILTIN_SCENARIOS: list[dict[str, Any]] = [
+    # ---- runtime / scheduler ------------------------------------------
+    {
+        "name": "readback-crash",
+        "kind": "engine",
+        "seed": 101,
+        "engine": _TINY,
+        "load": _LOAD,
+        # fires on the 3rd decode-chunk readback: every stream is mid-flight
+        # (max_tokens 10 needs ~3 chunks), so ALL requests must error-
+        # terminate exactly once — none lost, none double-emitted
+        "faults": [{"point": "scheduler.readback",
+                    "spec": {"kind": "raise", "mode": "once", "after": 2}}],
+        "invariants": ["exactly_one_terminal"],
+        "expect_error": [0, 1, 2, 3],
+        "deterministic_tokens": False,
+    },
+    {
+        "name": "prefill-fault",
+        "kind": "engine",
+        "seed": 102,
+        # coalesce off so the FIFO-first request deterministically takes the
+        # single-prefill path where the fault is injected
+        "engine": {**_TINY, "prefill_coalesce": 1},
+        "load": _LOAD,
+        "faults": [{"point": "scheduler.prefill", "spec": "1*raise"}],
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "engine_accounting"],
+        "expect_error": [0],
+    },
+    {
+        "name": "admit-delay",
+        "kind": "engine",
+        "seed": 103,
+        "engine": _TINY,
+        "load": _LOAD,
+        # a slow admission path must change NOTHING but latency
+        "faults": [{"point": "scheduler.admit", "spec": "delay(0.002)"}],
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "engine_accounting"],
+    },
+    {
+        "name": "forced-preempt",
+        "kind": "engine",
+        "seed": 104,
+        "engine": _TINY,
+        "load": _LOAD,
+        # injected MemoryError on one page-chain extension forces a
+        # preempt-to-host + resume round-trip with NO real pool pressure;
+        # the resumed stream must be bit-identical to the unfaulted run
+        "faults": [{"point": "scheduler.page_alloc",
+                    "spec": "1*raise(MemoryError)"}],
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "engine_accounting"],
+        "expect_stats": {"preemptions": [1, None]},
+    },
+    {
+        "name": "resume-crash",
+        "kind": "engine",
+        "seed": 105,
+        "engine": _TINY,
+        "load": _LOAD,
+        # first force a preemption, then crash the resume: the engine breaks
+        # mid-recovery and every stream (parked ones included) must still
+        # get exactly one terminal event
+        "faults": [{"point": "scheduler.page_alloc",
+                    "spec": "1*raise(MemoryError)"},
+                   {"point": "scheduler.resume", "spec": "1*raise"}],
+        "invariants": ["exactly_one_terminal"],
+        "expect_stats": {"preemptions": [1, None]},
+        "deterministic_tokens": False,
+    },
+    # ---- runtime / replica pool ---------------------------------------
+    {
+        "name": "replica-failover",
+        "kind": "pool",
+        "seed": 201,
+        "replicas": 2,
+        "engine": _TINY,
+        "load": {**_LOAD, "max_tokens": 12},
+        # one replica dies at its 2nd readback; its in-flight requests fail
+        # over mid-stream and the continuation (greedy) must reproduce the
+        # single-engine baseline token-for-token
+        "faults": [{"point": "scheduler.readback",
+                    "spec": {"kind": "raise", "mode": "once", "after": 1}}],
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "pool_clean"],
+        "expect_stats": {"failovers": [1, None], "healthy": [1, 1]},
+    },
+    {
+        "name": "pool-submit-reject",
+        "kind": "pool",
+        "seed": 202,
+        "replicas": 2,
+        "engine": _TINY,
+        "load": _LOAD,
+        "faults": [{"point": "replicas.submit", "spec": "1*raise"}],
+        # the rejected request never enters the pool (caller sees the raise,
+        # no tracking record leaks); the rest stream normally
+        "invariants": ["exactly_one_terminal", "streams_match_baseline",
+                       "pool_clean"],
+        "expect_error": [0],
+        "expect_submit_errors": 1,
+    },
+    {
+        "name": "failover-denied",
+        "kind": "pool",
+        "seed": 203,
+        "replicas": 2,
+        "engine": _TINY,
+        "load": _LOAD,
+        # every readback dies AND the failover path itself faults: requests
+        # must surface clean errors (no hang, no double terminal)
+        "faults": [{"point": "scheduler.readback", "spec": "raise"},
+                   {"point": "replicas.failover", "spec": "raise"}],
+        "invariants": ["exactly_one_terminal", "pool_clean"],
+        "expect_error": [0, 1, 2, 3],
+        "expect_stats": {"failovers_failed": [1, None]},
+        "deterministic_tokens": False,
+    },
+    # ---- modkit -------------------------------------------------------
+    {
+        "name": "http-retry-storm",
+        "kind": "http_retry",
+        "seed": 301,
+        # first attempt dies in transport; the retry layer (budget-guarded)
+        # must recover and the upstream must see exactly one request
+        "faults": [{"point": "http_client.request",
+                    "spec": "1*raise(ClientError)"}],
+        "expect_injected": 1,
+    },
+    {
+        "name": "db-commit-fault",
+        "kind": "db_commit",
+        "seed": 302,
+        "faults": [{"point": "db_engine.commit", "spec": "1*raise"}],
+    },
+    # ---- gateway + modules over the live REST surface -----------------
+    {
+        "name": "oagw-breaker-recovery",
+        "kind": "server_breaker",
+        "seed": 401,
+        "fault_spec": "2*raise(ClientError)",
+    },
+    {
+        "name": "gateway-request-fault",
+        "kind": "server_gateway",
+        "seed": 402,
+    },
+    {
+        "name": "serverless-retry-deadletter",
+        "kind": "serverless",
+        "seed": 403,
+    },
+    {
+        "name": "worker-job-crash",
+        "kind": "worker",
+        "seed": 404,
+    },
+    {
+        "name": "grpc-evict-tick",
+        "kind": "grpc_evict",
+        "seed": 405,
+    },
+]
+
+
+def scenario_by_name(name: str) -> dict[str, Any]:
+    for spec in BUILTIN_SCENARIOS:
+        if spec["name"] == name:
+            return spec
+    raise KeyError(f"unknown scenario {name!r}; builtin: "
+                   f"{[s['name'] for s in BUILTIN_SCENARIOS]}")
+
+
+def load_scenario_file(path: str | Path) -> list[dict[str, Any]]:
+    """Load scenarios from a YAML (or JSON — valid YAML) file with a
+    top-level ``scenarios:`` list."""
+    import yaml
+
+    doc = yaml.safe_load(Path(path).read_text())
+    scenarios = doc.get("scenarios") if isinstance(doc, dict) else doc
+    if not isinstance(scenarios, list):
+        raise ValueError(f"{path}: expected a top-level 'scenarios:' list")
+    return scenarios
+
+
+def covered_points(specs: list[dict[str, Any]] | None = None) -> set[str]:
+    """Failpoint names exercised by the given (default: builtin) scenarios.
+    tests/test_faultlab.py asserts this covers the whole catalog."""
+    specs = BUILTIN_SCENARIOS if specs is None else specs
+    out: set[str] = set()
+    for spec in specs:
+        for fault in spec.get("faults", []):
+            out.add(fault["point"])
+        if spec.get("kind") == "server_breaker":
+            out.add("oagw.upstream")
+        if spec.get("kind") == "server_gateway":
+            out.add("gateway.request")
+        if spec.get("kind") == "serverless":
+            out.update({"serverless.invoke", "serverless.tick"})
+        if spec.get("kind") == "worker":
+            out.add("llm_gateway.worker_stream")
+        if spec.get("kind") == "grpc_evict":
+            out.add("grpc_hub.evict")
+    return out

@@ -38,6 +38,7 @@ else:  # pragma: no cover — interpreter-version dependent
 
 from ..modkit.errcat import ERR
 from ..modkit.errors import Problem, ProblemError
+from ..modkit.failpoints import failpoint_async
 from ..modkit.security import SecurityContext
 from ..modkit.telemetry import Tracer
 from .router import AuthPolicy, OperationSpec, RateLimitSpec
@@ -113,9 +114,19 @@ class RateLimiterMap:
 def _problem_response(problem: Problem, request_id: Optional[str] = None) -> web.Response:
     if request_id and problem.trace_id is None:
         problem.trace_id = request_id
-    return web.json_response(
+    resp = web.json_response(
         problem.to_dict(), status=problem.status, content_type=Problem.CONTENT_TYPE
     )
+    # backpressure contract: a 429 carries Retry-After so well-behaved
+    # clients pace instead of hammering (scheduler saturation, rate limits);
+    # the hint rides in the problem's extensions as ``retry_after_s``
+    if problem.status == 429:
+        retry_after = problem.extensions.get("retry_after_s", 1)
+        try:
+            resp.headers["Retry-After"] = str(max(1, int(float(retry_after))))
+        except (TypeError, ValueError):
+            resp.headers["Retry-After"] = "1"
+    return resp
 
 
 #: next-layer type: the composed chain passes only the request
@@ -177,7 +188,7 @@ class RouteStackBuilder:
         h = self._license_layer(spec, h)          # 11
         h = self._policy_layer(spec, h)           # 10
         h = self._auth_layer(spec, h, builtin_public)  # 9
-        h = self._error_layer(h)                  # 8
+        h = self._error_layer(spec, h)            # 8
         h = self._rate_layer(spec, h)             # 7
         h = self._mime_layer(spec, h)             # 6
         h = self._cors_layer(h)                   # 5
@@ -334,10 +345,23 @@ class RouteStackBuilder:
 
         return rate_limit
 
-    def _error_layer(self, inner: Handler) -> Handler:
+    def _error_layer(self, spec: Optional[OperationSpec],
+                     inner: Handler) -> Handler:
         # layer 8: error mapping → RFC-9457 (libs/modkit/src/api/error_layer.rs)
+        # The failpoint control plane is EXEMPT from its own fault injection:
+        # arming gateway.request with an always-raise must never brick the
+        # disarm/reset endpoints an operator needs to recover a live server.
+        faultable = not (spec is not None
+                         and spec.path.startswith("/v1/monitoring/failpoints"))
+
         async def error_mapping(request: web.Request) -> web.StreamResponse:
             try:
+                # chaos rehearsals arm this to fault/delay live requests
+                # INSIDE the error-mapping boundary: an injected raise comes
+                # back as an RFC-9457 5xx, an injected delay hits the timeout
+                # layer — exactly what a misbehaving handler would do
+                if faultable:
+                    await failpoint_async("gateway.request")
                 return await inner(request)
             except ProblemError as e:
                 return _problem_response(e.problem, request.get(REQUEST_ID_KEY))

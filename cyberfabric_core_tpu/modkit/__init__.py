@@ -17,6 +17,7 @@ from .client_hub import ClientHub, ClientScope
 from .config import AppConfig, ConfigError
 from .context import ModuleCtx
 from .errors import Problem, ProblemError, declare_errors
+from .failpoints import failpoint, failpoint_async
 from .lifecycle import ReadySignal, Status, WithLifecycle
 from .registry import ModuleRegistry, module, clear_registrations
 from .runtime import HostRuntime, RunOptions, Runner
@@ -46,5 +47,7 @@ __all__ = [
     "WithLifecycle",
     "clear_registrations",
     "declare_errors",
+    "failpoint",
+    "failpoint_async",
     "module",
 ]

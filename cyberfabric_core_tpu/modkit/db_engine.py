@@ -35,6 +35,8 @@ import time
 from pathlib import Path
 from typing import Any, Iterator, Optional, Sequence
 
+from .failpoints import failpoint
+
 
 class ExecResult:
     """Uniform result: materialized dict rows + rowcount."""
@@ -108,6 +110,18 @@ class SqliteEngine(DbEngine):
 
     def execute(self, sql: str, params: Sequence[Any] = ()) -> ExecResult:
         with self._lock:
+            # mutating statements only — arming "commit error" must not fail
+            # every read in the process (catalog row: commit of a MUTATING
+            # statement). Injection is ATOMIC: it fires before the statement
+            # runs (autocommit would otherwise persist the row before a
+            # post-execute fault) and rolls back any open transaction.
+            if sql.lstrip()[:6].upper() not in ("SELECT", "PRAGMA"):
+                try:
+                    failpoint("db_engine.commit")
+                except Exception:
+                    if self._conn.in_transaction:
+                        self._conn.rollback()
+                    raise
             cur = self._conn.execute(sql, list(params))
             rows = [dict(r) for r in cur.fetchall()] if cur.description else []
             rowcount = cur.rowcount

@@ -39,7 +39,12 @@ from typing import Any, Optional
 
 import aiohttp
 
+from .failpoints import failpoint_async, register_exception
 from .telemetry import Tracer
+
+# allowlist transport faults for the failpoint machinery: a chaos rehearsal
+# arms http_client.request with ClientError to exercise the retry layers
+register_exception("ClientError", aiohttp.ClientError)
 
 #: RFC 9110 idempotent methods (config.rs is_idempotent_method)
 IDEMPOTENT_METHODS = frozenset({"GET", "HEAD", "PUT", "DELETE", "OPTIONS", "TRACE"})
@@ -281,6 +286,10 @@ class HttpClient:
         session = await self._ensure_session()
 
         async def attempt() -> HttpResponse:
+            # per-attempt fault injection: an armed raise (ClientError /
+            # TimeoutError) counts as THIS attempt failing, so the retry
+            # triggers, backoff, and the retry budget are exercised for real
+            await failpoint_async("http_client.request")
             # redirects are followed MANUALLY: each hop gets the literal-IP
             # check, and non-GET/HEAD hops never re-send the body (a 307/308
             # from a token endpoint must not leak credentials — the reference

@@ -151,3 +151,14 @@ class MetricsRegistry:
 
 #: process-global default registry (modules grab it via ClientHub or directly)
 default_registry = MetricsRegistry()
+
+
+def bump_counter(name: str, help: str = "", **labels: str) -> None:
+    """Fire-and-forget counter increment on the default registry: never
+    raises (telemetry must not fail a serving/recovery path). Declare the
+    metric's help text ONCE at pre-registration (monitoring module) — the
+    registry keeps the first help it sees, so hot-path callers pass none."""
+    try:
+        default_registry.counter(name, help).inc(**labels)
+    except Exception:  # noqa: BLE001
+        pass

@@ -16,6 +16,7 @@ from typing import Optional
 from ..modkit import Module, ReadySignal, module
 from ..modkit.contracts import RunnableCapability, SystemCapability
 from ..modkit.context import ModuleCtx
+from ..modkit.failpoints import failpoint
 from ..modkit.logging_host import observe_task
 from ..modkit.transport_grpc import (
     DIRECTORY_SERVICE,
@@ -70,7 +71,7 @@ class GrpcHubModule(Module, SystemCapability, RunnableCapability):
             while not ctx.cancellation_token.is_cancelled:
                 await asyncio.sleep(self.config.eviction_interval_s)
                 try:
-                    self.directory.evict_stale()
+                    self._evict_tick()
                 except Exception:  # noqa: BLE001 — a bad tick must not end eviction
                     logging.getLogger("grpc_hub").exception("evict tick failed")
 
@@ -80,6 +81,12 @@ class GrpcHubModule(Module, SystemCapability, RunnableCapability):
                                         "grpc_hub.evict_loop",
                                         logger="grpc_hub")
         ready.notify_ready()
+
+    def _evict_tick(self) -> None:
+        """One directory staleness sweep; the loop survives a failing tick
+        (chaos rehearsals arm grpc_hub.evict to prove it)."""
+        failpoint("grpc_hub.evict")
+        self.directory.evict_stale()
 
     async def stop(self, ctx: ModuleCtx) -> None:
         if self._evict_task is not None:

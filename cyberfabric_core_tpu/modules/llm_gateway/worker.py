@@ -25,8 +25,10 @@ from typing import Any, AsyncIterator, Optional
 
 from ...modkit.errcat import ERR
 from ...modkit.errors import ProblemError
+from ...modkit.failpoints import failpoint_async
 from ...modkit.logging_host import observe_task
-from ...runtime.engine import EngineConfig, InferenceEngine, SamplingParams, StepEvent
+from ...runtime.engine import (EngineConfig, InferenceEngine, SamplingParams,
+                               SchedulerSaturated, StepEvent)
 from ...runtime.scheduler import ContinuousBatchingEngine
 from ...runtime.tokenizer import (CHAT_FAMILIES, ByteTokenizer, Tokenizer,
                                   chat_family_for, load_tokenizer, render_chat)
@@ -279,6 +281,9 @@ class LocalTpuWorker(LlmWorkerApi):
                                                            "off"),
             prefill_budget_tokens=int(opts.pop("prefill_budget_tokens", 512)),
             prefill_coalesce=int(opts.pop("prefill_coalesce", 4)),
+            # admission backpressure bound (faultlab satellite): overflow
+            # surfaces as 429 + Retry-After instead of unbounded queueing
+            max_pending=int(opts.pop("max_pending", 2048)),
             speculative=opts.pop("speculative", "off"),
             spec_k=int(opts.pop("spec_k", 8)),
             draft_model=opts.pop("draft_model", ""),
@@ -370,6 +375,9 @@ class LocalTpuWorker(LlmWorkerApi):
         self, entry: _EngineEntry, model: ModelInfo, prompt_ids: list[int],
         params: dict
     ) -> AsyncIterator[ChatStreamChunk]:
+        # chaos rehearsals arm this to crash a job at the worker boundary,
+        # before the engine sees it (the reference's "provider adapter died")
+        await failpoint_async("llm_gateway.worker_stream")
         limits_max = int(model.limits.get("max_output_tokens", 1024)) if model.limits else 1024
         sampling = SamplingParams(
             max_tokens=min(int(params.get("max_tokens", 256)), limits_max),
@@ -404,6 +412,13 @@ class LocalTpuWorker(LlmWorkerApi):
                         queue.put_nowait, ev),
                     request_id=request_id,
                 )
+            except SchedulerSaturated as e:
+                # admission backpressure: the pending queue is at
+                # max_pending. 429 + Retry-After (the gateway's problem
+                # renderer turns retry_after_s into the header) beats
+                # unbounded queue growth under an arrival storm.
+                raise ERR.llm.scheduler_saturated.error(
+                    str(e), retry_after_s=e.retry_after_s)
             except ValueError as e:
                 # e.g. seed on the dense scheduler: a client-fixable request
                 # shape, not a server fault

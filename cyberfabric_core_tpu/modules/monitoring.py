@@ -14,6 +14,7 @@ from ..modkit import Module, module
 from ..modkit.contracts import RestApiCapability
 from ..modkit.context import ModuleCtx
 from ..modkit.metrics import MetricsRegistry, default_registry
+from ..gateway.validation import read_json
 from .sdk import LlmWorkerApi
 
 
@@ -29,6 +30,24 @@ class MonitoringModule(Module, RestApiCapability):
     async def init(self, ctx: ModuleCtx) -> None:
         ctx.client_hub.register(MetricsRegistry, self.registry)
         hub = ctx.client_hub
+        #: fault-injection arming over REST is opt-in per deployment — a soak
+        #: rehearsal flips `monitoring: {allow_fault_injection: true}`;
+        #: production configs leave it off and the arming endpoints 403
+        self._allow_fault_injection = bool(
+            ctx.raw_config().get("allow_fault_injection", False))
+
+        # pre-register the faultlab metric families so they render (at zero)
+        # before the first injection/failover — dashboards can alert on them
+        # from the first scrape
+        self.registry.counter(
+            "fault_injected_total",
+            "Faults injected via armed failpoints").inc(0.0)
+        self.registry.histogram(
+            "fault_recovery_seconds",
+            "Recovery-path latency (preempt/resume, failover) in seconds")
+        self.registry.counter(
+            "llm_replica_failovers_total",
+            "Mid-stream requests resubmitted to another replica").inc(0.0)
 
         # device gauges, evaluated at scrape time
         def device_count() -> float:
@@ -169,3 +188,75 @@ class MonitoringModule(Module, RestApiCapability):
                          module="monitoring").auth_required() \
             .summary("Stop the device trace; returns the dump location") \
             .handler(profiler_stop).register()
+
+        # ---- failpoint control plane (faultlab): soak rehearsals arm/disarm
+        # fault injection against a LIVE server. Reads are always allowed;
+        # arming is gated behind `monitoring: {allow_fault_injection: true}`
+        # so a production deployment cannot be chaos-tested by accident.
+        from ..modkit import failpoints as fp
+        from ..modkit.errcat import ERR
+
+        def _require_faultlab() -> None:
+            if not self._allow_fault_injection:
+                raise ERR.monitoring.faultlab_disabled.error(
+                    "fault injection is disabled; set monitoring."
+                    "allow_fault_injection: true for chaos rehearsals")
+
+        async def list_failpoints(request: web.Request):
+            return {
+                "enabled": self._allow_fault_injection,
+                "catalog": {name: {"layer": layer, "description": desc}
+                            for name, (layer, desc)
+                            in sorted(fp.FAILPOINT_CATALOG.items())},
+                "armed": {name: action.__dict__
+                          for name, action in fp.armed().items()},
+                "stats": fp.stats(),
+            }
+
+        async def arm_failpoint(request: web.Request):
+            _require_faultlab()
+            name = request.match_info["name"]
+            body = await read_json(request, {
+                "type": "object",
+                "properties": {"spec": {"type": ["string", "object"]},
+                               "seed": {"type": "integer"}},
+                "additionalProperties": False})
+            if "seed" in body:
+                fp.configure(int(body["seed"]))
+            try:
+                fp.arm(name, body.get("spec", "raise"))
+            except KeyError:
+                raise ERR.monitoring.unknown_failpoint.error(
+                    f"unknown failpoint {name!r}")
+            except (ValueError, TypeError) as e:
+                raise ERR.monitoring.bad_failpoint_spec.error(str(e)[:200])
+            return {"armed": name, "stats": fp.stats()}
+
+        async def disarm_failpoint(request: web.Request):
+            _require_faultlab()
+            name = request.match_info["name"]
+            if name not in fp.FAILPOINT_CATALOG:
+                raise ERR.monitoring.unknown_failpoint.error(
+                    f"unknown failpoint {name!r}")
+            return {"disarmed": fp.disarm(name)}
+
+        async def reset_failpoints(request: web.Request):
+            _require_faultlab()
+            fp.reset()
+            return {"reset": True}
+
+        router.operation("GET", "/v1/monitoring/failpoints",
+                         module="monitoring").auth_required() \
+            .summary("Failpoint catalog, armed actions, and fault stats") \
+            .handler(list_failpoints).register()
+        router.operation("PUT", "/v1/monitoring/failpoints/{name}",
+                         module="monitoring").auth_required() \
+            .summary("Arm a failpoint (guarded: allow_fault_injection)") \
+            .handler(arm_failpoint).register()
+        router.operation("DELETE", "/v1/monitoring/failpoints/{name}",
+                         module="monitoring").auth_required() \
+            .summary("Disarm a failpoint").handler(disarm_failpoint).register()
+        router.operation("DELETE", "/v1/monitoring/failpoints",
+                         module="monitoring").auth_required() \
+            .summary("Disarm every failpoint and clear fault counters") \
+            .handler(reset_failpoints).register()

@@ -29,6 +29,7 @@ from aiohttp import web
 from ..modkit import Module, module
 from ..modkit.contracts import DatabaseCapability, Migration, RestApiCapability
 from ..modkit.context import ModuleCtx
+from ..modkit.failpoints import failpoint_async
 from ..modkit.db import ScopableEntity
 from ..modkit.errcat import ERR
 from ..modkit.errors import Problem, ProblemError
@@ -418,6 +419,10 @@ class OagwService(OagwApi):
 
         session = await self.session()
         try:
+            # chaos rehearsals arm this to model the upstream dying: the
+            # injected ClientError lands in the except below, so it counts as
+            # a real upstream failure and trips the circuit breaker
+            await failpoint_async("oagw.upstream")
             # redirects are NEVER followed: a 3xx from the upstream could
             # point anywhere (incl. private ranges) — pass it through instead
             async with session.request(request.method, url, headers=headers,

@@ -40,6 +40,7 @@ from ..modkit.context import ModuleCtx
 from ..modkit.db import ScopableEntity
 from ..modkit.errcat import ERR
 from ..modkit.errors import ProblemError
+from ..modkit.failpoints import failpoint_async
 from ..modkit.lifecycle import ReadySignal
 from ..modkit.logging_host import observe_task
 from ..modkit.security import SecurityContext
@@ -437,6 +438,9 @@ class ServerlessService(ServerlessApi):
 
     async def _run_definition(self, ctx: SecurityContext, ep: dict, params: dict,
                               inv_id: str, timeline: list) -> Any:
+        # armed raise crashes the attempt inside _execute's retry loop, so
+        # retry/backoff and dead-letter are exercised by real failures
+        await failpoint_async("serverless.invoke")
         definition = ep["definition"] or {}
         if ep["kind"] == "function":
             handler = self._functions[definition["function"]]
@@ -658,6 +662,9 @@ class ServerlessService(ServerlessApi):
     async def scheduler_tick(self) -> int:
         """Fire due schedules; returns count fired. Driven by the module's
         background loop (fire accuracy bar: within 1s — PRD.md:37; loop at 250ms)."""
+        # armed raise fails THIS tick; the module's loop logs and keeps
+        # ticking, so a due schedule still fires on the next pass
+        await failpoint_async("serverless.tick")
         sysctx = SecurityContext.system()
         conn = self._db.secure(sysctx, SCHEDULES)
         now = time.time()

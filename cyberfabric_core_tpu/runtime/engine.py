@@ -35,6 +35,18 @@ from ..ops.rope import rope_frequencies
 from ..ops.sampling import sample_token
 
 
+class SchedulerSaturated(RuntimeError):
+    """``submit()`` rejected: the pending queue is at ``max_pending``.
+
+    Serving layers map this to HTTP 429 + ``Retry-After`` — backpressure at
+    admission instead of unbounded host memory growth under an arrival storm.
+    """
+
+    def __init__(self, detail: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(detail)
+        self.retry_after_s = retry_after_s
+
+
 @dataclass
 class SamplingParams:
     """Per-request decode parameters (llm-gateway request schema surface)."""
@@ -102,6 +114,12 @@ class EngineConfig:
     #: same-bucket pending requests into one multi-row prefill dispatch.
     #: 1 = off (every prefill is its own batch-1 dispatch).
     prefill_coalesce: int = 4
+    #: continuous scheduler: bound on the pending (not-yet-admitted) queue.
+    #: ``submit`` raises :class:`SchedulerSaturated` at the bound — the
+    #: gateway maps it to 429 + Retry-After — instead of queueing without
+    #: limit (unbounded host memory + unbounded queue latency under a
+    #: storm). 0 = unbounded (pre-faultlab behavior).
+    max_pending: int = 2048
 
     def resolve_use_flash(self) -> bool:
         if self.use_flash is not None:

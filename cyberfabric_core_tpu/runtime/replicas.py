@@ -26,12 +26,15 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import uuid
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 import jax
 
+from ..modkit.failpoints import failpoint, record_recovery
+from ..modkit.metrics import bump_counter
 from .engine import EngineConfig, SamplingParams, StepEvent
 from .scheduler import ContinuousBatchingEngine
 
@@ -69,6 +72,8 @@ class DataParallelServingPool:
         self.max_retries = max_retries
         self._lock = threading.Lock()
         self._requests: dict[str, _Tracked] = {}
+        self.failovers = 0        # successful mid-stream resubmissions
+        self.failovers_failed = 0  # failover attempts that could not resubmit
         self.replicas: list[ContinuousBatchingEngine] = []
         self.devices = devices[:n_replicas]
         for dev in self.devices:
@@ -104,14 +109,25 @@ class DataParallelServingPool:
         emit: Callable[[StepEvent], None],
         request_id: Optional[str] = None,
     ) -> str:
+        # armed raise rejects the request before any replica sees it (the
+        # faultlab pool scenario asserts no tracking record leaks)
+        failpoint("replicas.submit")
         idx = self._pick()
         tracked = _Tracked(list(prompt_ids), sampling, emit, [], idx,
                            self.max_retries)
         rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
-        self.replicas[idx].submit(prompt_ids, sampling,
-                                  self._wrap(rid, tracked), rid)
+        # register BEFORE submitting: the scheduler thread may finish the
+        # request (and fire the tracking-record cleanup) before this thread
+        # returns from submit — inserting after would leak the record
         with self._lock:
             self._requests[rid] = tracked
+        try:
+            self.replicas[idx].submit(prompt_ids, sampling,
+                                      self._wrap(rid, tracked), rid)
+        except Exception:
+            with self._lock:
+                self._requests.pop(rid, None)
+            raise
         return rid
 
     def _wrap(self, rid: str, tracked: _Tracked) -> Callable[[StepEvent], None]:
@@ -136,9 +152,12 @@ class DataParallelServingPool:
     def _failover(self, rid: str, tracked: _Tracked) -> bool:
         """Resubmit on another healthy replica, carrying emitted tokens as
         prompt continuation (remaining budget shrinks accordingly)."""
+        t0 = time.monotonic()
         try:
+            failpoint("replicas.failover")
             idx = self._pick()
-        except RuntimeError:
+        except Exception:  # noqa: BLE001 — incl. injected faults: no replica
+            self.failovers_failed += 1
             return False
         remaining = tracked.sampling.max_tokens - len(tracked.emitted)
         if remaining <= 0:
@@ -155,10 +174,14 @@ class DataParallelServingPool:
         try:
             self.replicas[idx].submit(cont_prompt, cont_sampling,
                                       self._wrap(rid, tracked))
-            return True
         except Exception:  # noqa: BLE001 — fall through to the error event
             logger.exception("failover resubmission failed")
+            self.failovers_failed += 1
             return False
+        self.failovers += 1
+        record_recovery("replicas.failover", time.monotonic() - t0)
+        bump_counter("llm_replica_failovers_total")
+        return True
 
     # ------------------------------------------------------------------ admin
     def stats(self) -> dict[str, Any]:
@@ -166,6 +189,8 @@ class DataParallelServingPool:
         return {
             "replicas": len(self.replicas),
             "healthy": len(self._healthy()),
+            "failovers": self.failovers,
+            "failovers_failed": self.failovers_failed,
             "active": sum(s["active"] for s in per),
             "pending": sum(s["pending"] for s in per),
             "tokens_emitted": sum(s["tokens_emitted"] for s in per),

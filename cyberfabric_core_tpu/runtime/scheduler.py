@@ -60,9 +60,11 @@ import numpy as np
 
 from ..models import llama
 from ..models.configs import ModelConfig, get_config
+from ..modkit.failpoints import failpoint, record_recovery
 from ..ops.rope import rope_frequencies
 from ..ops.sampling import sample_token, sample_token_per_slot, split_keys_per_slot
-from .engine import EngineConfig, SamplingParams, StepEvent, build_decode_chunk_fn
+from .engine import (EngineConfig, SamplingParams, SchedulerSaturated,
+                     StepEvent, build_decode_chunk_fn)
 
 logger = logging.getLogger("scheduler")
 
@@ -239,6 +241,8 @@ class ContinuousBatchingEngine:
         from collections import deque as _deque
 
         self._pending: _queue.Queue[_Pending] = _queue.Queue()
+        #: serializes submit()'s bound check-and-put (many gateway threads)
+        self._submit_lock = threading.Lock()
         self._suspended: "_deque[_Suspended]" = _deque()
         #: O(1) slot allocation: maintained at admit/finish/preempt/resume —
         #: invariant: set(_free_slots) == {i | not active[i]}
@@ -261,6 +265,8 @@ class ContinuousBatchingEngine:
 
         self.tokens_emitted = 0
         self.requests_completed = 0
+        self.rejected_saturated = 0
+        self.resume_latency_samples: "deque[float]" = deque(maxlen=512)
         self.decode_rounds = 0
         self.lookahead_rounds = 0
         self.coalesced_prefills = 0
@@ -402,7 +408,20 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 "SamplingParams.seed requires the paged scheduler "
                 "(prefix_cache_pages > 0); dense mode shares one RNG stream")
-        self._pending.put(_Pending(rid, list(prompt_ids), sampling, emit))
+        with self._submit_lock:
+            # check-and-put under one lock: concurrent gateway threads must
+            # not overshoot the bound between qsize() and put() (the
+            # scheduler-side requeue paths bypass the bound by design —
+            # those requests were already admitted once)
+            if self.config.max_pending and \
+                    self._pending.qsize() >= self.config.max_pending:
+                # backpressure at admission: reject NOW (callers map this to
+                # 429 + Retry-After) instead of growing the queue unbounded
+                self.rejected_saturated += 1
+                raise SchedulerSaturated(
+                    f"pending queue full ({self.config.max_pending} "
+                    "requests); retry later")
+            self._pending.put(_Pending(rid, list(prompt_ids), sampling, emit))
         self._wake.set()
         self.start()
         return rid
@@ -425,8 +444,9 @@ class ContinuousBatchingEngine:
         try:
             timings = list(self.round_timings)
             waits = list(self.queue_wait_samples)
+            resumes = list(self.resume_latency_samples)
         except RuntimeError:
-            timings, waits = [], []
+            timings, waits, resumes = [], [], []
         pipeline = {
             "rounds": self.decode_rounds,
             "lookahead_rounds": self.lookahead_rounds,
@@ -459,6 +479,14 @@ class ContinuousBatchingEngine:
                 "p50": round(self._p50(waits), 3),
                 "max": round(max(waits), 3) if waits else 0.0,
                 "count": len(waits),
+            },
+            "rejected_saturated": self.rejected_saturated,
+            # preempt→resume recovery latency (the stream-pause a client
+            # actually experiences); also exported device-wide as the
+            # fault_recovery_seconds{point=scheduler.resume} histogram
+            "resume_recovery_ms": {
+                "p50": round(self._p50(resumes) * 1000.0, 3),
+                "count": len(resumes),
             },
         }
 
@@ -578,6 +606,10 @@ class ContinuousBatchingEngine:
             if not self._free_slots:
                 break
             rec = self._suspended[0]
+            # armed raise here error-terminates the engine mid-recovery (the
+            # faultlab resume-crash scenario asserts every client still gets
+            # exactly one terminal event)
+            failpoint("scheduler.resume")
             try:
                 chain = self.pool.restore_chain_from_host(rec.host_kv)
                 try:
@@ -634,8 +666,11 @@ class ContinuousBatchingEngine:
             self._mark_pt_row(slot)
             self._epoch += 1
             resumed += 1
-            logger.info("resumed %s into slot %d (len=%d)",
-                        state.request_id, slot, rec.length)
+            pause_s = time.monotonic() - rec.suspended_at
+            self.resume_latency_samples.append(pause_s)
+            record_recovery("scheduler.resume", pause_s)
+            logger.info("resumed %s into slot %d (len=%d, paused %.3fs)",
+                        state.request_id, slot, rec.length, pause_s)
         return resumed
 
     def _admit(self) -> int:
@@ -648,6 +683,7 @@ class ContinuousBatchingEngine:
         at least one request, so big prompts cannot starve), and COLD
         same-bucket requests coalesce into one multi-row prefill dispatch."""
         t0 = time.monotonic()
+        failpoint("scheduler.admit")
         admitted = self._resume_suspended() if self.paged else 0
         budget = self.config.prefill_budget_tokens
         taken: list[_Pending] = []
@@ -814,6 +850,9 @@ class ContinuousBatchingEngine:
         the ONE radix match for this request, its pin still held on a hit —
         no second tree walk, and no probe/admit window where the classified
         prefix could be evicted."""
+        # armed raise exercises the failed-admission reclaim path: _place
+        # catches, reclaims the slot, and error-terminates only this request
+        failpoint("scheduler.prefill")
         T = len(req.prompt_ids)
         bucket = self._bucket_for(T)
         s = req.sampling
@@ -993,54 +1032,68 @@ class ContinuousBatchingEngine:
             state = self.slots[slot]
             if state is None or not self.active[slot]:
                 continue
-            chain = state.chain
-            assert chain is not None
-            L = int(self.lengths[slot])
-            needed = min(L + horizon, self.config.max_seq_len)
-            if self.pool.pages_for(needed) <= len(chain):
-                continue
             try:
-                before = len(chain)
-                self.pool.extend_chain(chain, needed)
-                self.page_table[slot, before: len(chain)] = chain[before:]
-                self._mark_pt_row(slot)
-                continue
+                # an armed MemoryError here forces the preempt-to-host path
+                # without real pool pressure (deterministic faultlab preempt
+                # scenarios; streams must stay bit-identical across it)
+                failpoint("scheduler.page_alloc")
+                self._grow_chain(slot, state, horizon)
             except MemoryError:
-                # the 2·k lookahead horizon is OPPORTUNISTIC — a slot that can
-                # still cover its mandatory chunk must not be preempted for it
-                # (preempting on the optimistic ask would livelock: resume only
-                # restores length+k, the next round asks 2·k again, and the
-                # request round-trips its KV forever without emitting a token)
-                mandatory = min(L + self._k_steps, self.config.max_seq_len)
-                if self.pool.pages_for(mandatory) <= len(chain):
-                    continue  # enough for the chunk; lookahead will just skip
-            try:
-                before = len(chain)
-                self.pool.extend_chain(chain, mandatory)
-                self.page_table[slot, before: len(chain)] = chain[before:]
-                self._mark_pt_row(slot)
-            except MemoryError:
-                # preempt-to-host, don't shed: save the chain's KV, free the
-                # pages, and park the request — _admit resumes it when space
-                # frees (no recompute; the stream pauses, never errors)
-                logger.warning("pool exhausted; preempting %s to host "
-                               "(len=%d, %d pages)", state.request_id,
-                               int(self.lengths[slot]), len(chain))
-                host_kv = self.pool.save_chain_to_host(chain)
-                self._suspended.append(_Suspended(
-                    state=state, host_kv=host_kv,
-                    length=int(self.lengths[slot]),
-                    last_token=int(np.asarray(self._last_tokens)[slot]),
-                    slot_key=np.asarray(self._slot_keys[slot])))
-                self.preemptions += 1
-                self.active[slot] = False
-                self.slots[slot] = None
-                self._release_free_slot(slot)
-                self._deactivate_slot_device(slot)
-                self._epoch += 1
-                self.pool.release_slot(chain)
-                self.page_table[slot, :] = 0
-                self._mark_pt_row(slot)
+                self._preempt_slot(slot, state)
+
+    def _grow_chain(self, slot: int, state: _SlotState, horizon: int) -> None:
+        """Extend one slot's chain to cover length + horizon. Raises
+        MemoryError only when even the MANDATORY chunk (length + k) cannot be
+        covered — the caller preempts then."""
+        chain = state.chain
+        assert chain is not None
+        L = int(self.lengths[slot])
+        needed = min(L + horizon, self.config.max_seq_len)
+        if self.pool.pages_for(needed) <= len(chain):
+            return
+        try:
+            before = len(chain)
+            self.pool.extend_chain(chain, needed)
+            self.page_table[slot, before: len(chain)] = chain[before:]
+            self._mark_pt_row(slot)
+            return
+        except MemoryError:
+            # the 2·k lookahead horizon is OPPORTUNISTIC — a slot that can
+            # still cover its mandatory chunk must not be preempted for it
+            # (preempting on the optimistic ask would livelock: resume only
+            # restores length+k, the next round asks 2·k again, and the
+            # request round-trips its KV forever without emitting a token)
+            mandatory = min(L + self._k_steps, self.config.max_seq_len)
+            if self.pool.pages_for(mandatory) <= len(chain):
+                return  # enough for the chunk; lookahead will just skip
+        before = len(chain)
+        self.pool.extend_chain(chain, mandatory)  # MemoryError → preempt
+        self.page_table[slot, before: len(chain)] = chain[before:]
+        self._mark_pt_row(slot)
+
+    def _preempt_slot(self, slot: int, state: _SlotState) -> None:
+        """Preempt-to-host, don't shed: save the chain's KV, free the pages,
+        and park the request — _admit resumes it when space frees (no
+        recompute; the stream pauses, never errors)."""
+        chain = state.chain
+        logger.warning("pool exhausted; preempting %s to host "
+                       "(len=%d, %d pages)", state.request_id,
+                       int(self.lengths[slot]), len(chain))
+        host_kv = self.pool.save_chain_to_host(chain)
+        self._suspended.append(_Suspended(
+            state=state, host_kv=host_kv,
+            length=int(self.lengths[slot]),
+            last_token=int(np.asarray(self._last_tokens)[slot]),
+            slot_key=np.asarray(self._slot_keys[slot])))
+        self.preemptions += 1
+        self.active[slot] = False
+        self.slots[slot] = None
+        self._release_free_slot(slot)
+        self._deactivate_slot_device(slot)
+        self._epoch += 1
+        self.pool.release_slot(chain)
+        self.page_table[slot, :] = 0
+        self._mark_pt_row(slot)
 
     def _dispatch_chunk(self, after: Optional[_InflightChunk]) -> _InflightChunk:
         """One fused-chunk dispatch (async — the return holds futures).
@@ -1173,6 +1226,10 @@ class ContinuousBatchingEngine:
             self._inflight = self._dispatch_chunk(after=inflight)
             self._lookahead_stats["dispatched"] += 1
         t2 = time.monotonic()
+        # armed raise here models a device fault at the chunk readback: the
+        # loop-body handler breaks the engine and error-terminates every
+        # stream (the replica pool's failover trigger)
+        failpoint("scheduler.readback")
         chunk = np.asarray(inflight.chunk_dev, np.int32)  # sync-point: the ONE sanctioned decode-loop readback (AS04)
         t3 = time.monotonic()
         old_lengths = self._commit_chunk(inflight)

@@ -387,6 +387,56 @@ def test_LK01_only_applies_to_runtime_tier():
     assert ok == []
 
 
+# ---------------------------------------------------------------- FP family
+
+
+def test_FP01_unregistered_name_fails():
+    # no local catalog in the fixture: the real package catalog is the
+    # authority, and "totally.made_up" is not in it
+    bad = lint("from cyberfabric_core_tpu.modkit.failpoints import failpoint\n"
+               "def f():\n"
+               "    failpoint('totally.made_up')\n", select=("FP01",))
+    assert rule_ids(bad) == ["FP01"] and "not registered" in bad[0].message
+
+
+def test_FP01_duplicate_call_site_fails():
+    bad = lint("FAILPOINT_CATALOG = {'a.b': ('modules', 'x')}\n"
+               "def f():\n"
+               "    failpoint('a.b')\n"
+               "def g():\n"
+               "    failpoint('a.b')\n", select=("FP01",))
+    assert rule_ids(bad) == ["FP01"]
+    assert len(bad) == 1 and bad[0].line == 5  # the SECOND site is the error
+    assert "already has a call site" in bad[0].message
+
+
+def test_FP01_non_literal_name_fails():
+    bad = lint("FAILPOINT_CATALOG = {'a.b': ('modules', 'x')}\n"
+               "def f(name):\n"
+               "    failpoint(name)\n", select=("FP01",))
+    assert rule_ids(bad) == ["FP01"] and "literal" in bad[0].message
+
+
+def test_FP01_registered_unique_call_site_passes():
+    ok = lint("FAILPOINT_CATALOG = {'a.b': ('modules', 'x')}\n"
+              "async def f():\n"
+              "    await failpoint_async('a.b')\n", select=("FP01",))
+    assert ok == []
+
+
+def test_FP01_repo_catalog_and_call_sites_agree():
+    """Every catalog name has exactly one call site in the package and the
+    repo gate is clean (the docs table maps 1:1 to code)."""
+    from cyberfabric_core_tpu.modkit.failpoints import FAILPOINT_CATALOG
+
+    engine = Engine(all_rules()).select(["FP01"])
+    findings = [f for f in engine.run(PKG) if not f.suppressed]
+    assert findings == [], [f.to_dict() for f in findings]
+    assert len(FAILPOINT_CATALOG) >= 12
+    assert {layer for layer, _ in FAILPOINT_CATALOG.values()} >= {
+        "runtime", "gateway", "modkit", "modules"}
+
+
 # ------------------------------------------------------- waivers + baseline
 
 
