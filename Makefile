@@ -5,9 +5,9 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos
+.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard
 
-safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos  ## the full local gate
+safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
 
@@ -38,6 +38,10 @@ aot-tpu:  ## TPU lowering gate: serving set compiles for v5e via topology AOT
 chaos:  ## faultlab: deterministic seeded chaos-scenario suite (every failpoint exercised, invariants green, repeat-stable)
 	$(PY) -m pytest tests/test_faultlab.py -q
 	$(PY) -m cyberfabric_core_tpu.apps.faultlab --repeat 2 > /dev/null
+
+trace-guard:  ## request observability: flight-recorder/telemetry tests + the tracing disabled-mode overhead A/B (BENCH_TRACE.json, <1% bar)
+	$(PY) -m pytest tests/test_flight_recorder.py tests/test_telemetry_export.py -q
+	$(PY) bench.py --trace-guard > /dev/null
 
 test:  ## full suite
 	$(PY) -m pytest tests/ -q

@@ -761,6 +761,68 @@ def faultlab_guard() -> int:
     return 0 if report["pass"] else 1
 
 
+def trace_guard() -> int:
+    """Disabled-mode overhead guard for request tracing + the flight recorder.
+
+    A/B: the --aggregate workload with tracing LIVE but every request
+    carrying an UNSAMPLED traceparent (the production steady state under a
+    ratio sampler: flight-recorder events recorded, span guard checked and
+    skipped per chunk) vs the machinery stubbed to no-ops
+    (``BENCH_TRACE=off`` — the compiled-out equivalent). Same ABBA
+    interleave + best-run-per-arm policy as the faultlab guard. Evidence
+    lands in BENCH_TRACE.json with a pass flag at the <1% tok/s bar.
+    """
+    reps = int(os.environ.get("BENCH_TRACE_REPS", "2"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_COST="0")
+
+    def one(mode: str) -> float | None:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--aggregate",
+             "tiny-llama", "none"],
+            capture_output=True, text=True, timeout=900,
+            env=dict(env, BENCH_TRACE=mode))
+        sys.stderr.write(proc.stderr[-2000:])
+        try:
+            return float(json.loads(
+                proc.stdout.strip().splitlines()[-1])["tokens_per_sec"])
+        except Exception as e:  # noqa: BLE001
+            log(f"trace guard child failed: {e}")
+            return None
+
+    arms: dict[str, list[float]] = {"unsampled": [], "stubbed": []}
+    order = (["unsampled", "stubbed", "stubbed", "unsampled"]
+             * ((reps + 1) // 2))[: 2 * reps]
+    for label in order:
+        v = one("unsampled" if label == "unsampled" else "off")
+        if v is not None:
+            arms[label].append(v)
+
+    unsampled = max(arms["unsampled"], default=0.0)
+    stubbed = max(arms["stubbed"], default=0.0)
+    delta_pct = ((stubbed - unsampled) / stubbed * 100.0) if stubbed else 0.0
+    spread = {k: (round(max(v) / max(1e-9, min(v)) - 1.0, 4) if v else None)
+              for k, v in arms.items()}
+    report = {
+        "note": ("request-tracing disabled-mode overhead: --aggregate tok/s "
+                 "with the flight recorder live and every request carrying "
+                 "an UNSAMPLED traceparent (span guard exercised per chunk) "
+                 "vs record_event stubbed to a no-op and tracing disabled "
+                 "(compiled-out equivalent); interleaved ABBA runs, best run "
+                 "per arm (contention only slows runs down)"),
+        "runs": arms,
+        "unsampled_tok_s": round(unsampled, 1),
+        "stubbed_tok_s": round(stubbed, 1),
+        "overhead_pct": round(delta_pct, 3),
+        "within_run_spread": spread,
+        "pass": bool(unsampled and stubbed and delta_pct < 1.0),
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_TRACE.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return 0 if report["pass"] else 1
+
+
 def aggregate(model_name: str, quant: str) -> int:
     """8 concurrent streams through the continuous scheduler (paged KV pool +
     ragged paged decode attention), with STAGGERED arrivals — the pattern the
@@ -787,6 +849,18 @@ def aggregate(model_name: str, quant: str) -> int:
         import cyberfabric_core_tpu.runtime.scheduler as _sched_mod
 
         _sched_mod.failpoint = lambda name: None
+    #: trace-guard A/B arms (BENCH_TRACE.json): "off" stubs the flight
+    #: recorder + disables tracing (compiled-out equivalent); "unsampled"
+    #: submits every request with an unsampled traceparent so the per-chunk
+    #: span guard and the recorder both run in their production steady state
+    trace_mode = os.environ.get("BENCH_TRACE", "")
+    if trace_mode == "off":
+        import cyberfabric_core_tpu.runtime.scheduler as _sched_mod
+        from cyberfabric_core_tpu.modkit.telemetry import (Tracer,
+                                                           set_global_tracer)
+
+        _sched_mod.record_event = lambda rid, kind, **attrs: None
+        set_global_tracer(Tracer(enabled=False))
     try:
         # max_seq 512 covers the workload (prompt <=160 + 192 generated); the
         # paged pool scales with num_pages × layers × kv-heads, and MHA models
@@ -839,7 +913,10 @@ def aggregate(model_name: str, quant: str) -> int:
         for i in range(n_req):
             prompt = rng.integers(3, 1000, 96 + 8 * i).tolist()
             reqs[i]["t_submit"] = time.monotonic()
-            sched.submit(prompt, SamplingParams(max_tokens=gen), mk_emit(i))
+            trace = (f"00-{os.urandom(16).hex()}-{os.urandom(8).hex()}-00"
+                     if trace_mode == "unsampled" else None)
+            sched.submit(prompt, SamplingParams(max_tokens=gen), mk_emit(i),
+                         trace=trace)
             if stagger_s and i < n_req - 1:
                 time.sleep(stagger_s)  # staggered arrivals, not one batch
         ok = done.wait(300)
@@ -1236,6 +1313,8 @@ if __name__ == "__main__":
         sys.exit(aggregate(sys.argv[2], sys.argv[3]))
     if len(sys.argv) > 1 and sys.argv[1] == "--faultlab-guard":
         sys.exit(faultlab_guard())
+    if len(sys.argv) > 1 and sys.argv[1] == "--trace-guard":
+        sys.exit(trace_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--embed":
         sys.exit(embed_bench())
     if len(sys.argv) > 3 and sys.argv[1] == "--cost":

@@ -5,3 +5,4 @@ from . import design        # noqa: F401
 from . import failpoints    # noqa: F401
 from . import jit_purity    # noqa: F401
 from . import lock_discipline  # noqa: F401
+from . import telemetry     # noqa: F401

@@ -1,0 +1,58 @@
+"""TL — telemetry discipline.
+
+TL01: flight-recorder emits in ``runtime/`` must go through the
+never-raises module helper ``record_event`` (modkit/flight_recorder.py) —
+the ``bump_counter`` pattern. The scheduler thread and replica pool sit on
+serving and RECOVERY paths: a direct ``FlightRecorder.record(...)`` /
+``default_recorder.record(...)`` call that raises (full ring lock poisoned,
+attr typo, monkeypatched recorder) would take down the decode loop or a
+failover mid-flight, turning an observability bug into an outage. The helper
+swallows everything; direct method calls don't.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import FileContext, Finding, Rule, dotted_name, register
+
+RUNTIME_TIERS = frozenset({"runtime"})
+
+#: FlightRecorder's mutating surface — reads (inflight/lookup/stats) are
+#: monitoring-plane and may raise to their caller
+_EMIT_METHODS = frozenset({"record"})
+
+
+@register
+class TL01(Rule):
+    id = "TL01"
+    family = "TL"
+    severity = "error"
+    tiers = RUNTIME_TIERS
+    description = ("flight-recorder emits in runtime/ go through the "
+                   "never-raises record_event helper")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        # the recorder's own module is the helper's home, not a call site
+        if ctx.path.name == "flight_recorder.py":
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                    node.func, ast.Attribute):
+                continue
+            if node.func.attr not in _EMIT_METHODS:
+                continue
+            base = dotted_name(node.func.value)
+            # FlightRecorder instances are recognizable by name, not type:
+            # the module global (default_recorder), a qualified import
+            # (flight_recorder.default_recorder), or any *recorder* local
+            if base.rsplit(".", 1)[-1].endswith("recorder") or \
+                    "flight_recorder" in base:
+                yield self.finding_in(
+                    ctx, node,
+                    f"direct flight-recorder emit `{base}.{node.func.attr}"
+                    "(...)` on a runtime serving path — use the never-raises "
+                    "`record_event(...)` helper (modkit.flight_recorder), "
+                    "so an observability failure cannot break decode or "
+                    "recovery")

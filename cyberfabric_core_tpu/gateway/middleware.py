@@ -40,7 +40,7 @@ from ..modkit.errcat import ERR
 from ..modkit.errors import Problem, ProblemError
 from ..modkit.failpoints import failpoint_async
 from ..modkit.security import SecurityContext
-from ..modkit.telemetry import Tracer
+from ..modkit.telemetry import (Tracer, reset_log_context, set_log_context)
 from .router import AuthPolicy, OperationSpec, RateLimitSpec
 
 REQUEST_ID_HEADER = "x-request-id"
@@ -230,7 +230,15 @@ class RouteStackBuilder:
                 request_id=request.get(REQUEST_ID_KEY),
             ) as span:
                 request["trace_id"] = span.trace_id
-                resp = await inner(request)
+                # log correlation for every line this request's task emits
+                # (handlers, llm_gateway worker, module code) — the scheduler
+                # thread sets its own context per request operation
+                log_token = set_log_context(request.get(REQUEST_ID_KEY),
+                                            span.trace_id)
+                try:
+                    resp = await inner(request)
+                finally:
+                    reset_log_context(log_token)
                 span.set_attribute("status", resp.status)
                 route = route_label if route_label is not None else request.path
                 counter.inc(

@@ -82,10 +82,23 @@ class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemC
         self._hub = ctx.client_hub
         # app-level tracing section: sampler + optional OTLP/HTTP export
         tracing_cfg = dict(ctx.app_config.section("tracing") or {})
-        if tracing_cfg:
-            from ..modkit.telemetry import tracer_from_config
+        from ..modkit.telemetry import Tracer, tracer_from_config
 
+        if tracing_cfg:
             self.tracer = tracer_from_config(tracing_cfg)
+        else:
+            # no tracing section at all: fail SAFE — the default
+            # enabled/ratio-1.0 tracer would mark every request sampled and
+            # pay per-chunk span emission in the decode hot loop for an
+            # exporter nobody configured (the config-defaults tree carries
+            # {enabled: false}; this covers hand-built AppConfigs too)
+            self.tracer = Tracer(enabled=False)
+        # the scheduler thread and replica pool emit llm.* spans through the
+        # global tracer — installing the gateway's tracer here means one
+        # exporter pipeline (and one OTLP endpoint) covers HTTP → tokens
+        from ..modkit.telemetry import set_global_tracer
+
+        set_global_tracer(self.tracer)
 
     # ------------------------------------------------------------- rest host
     def rest_prepare(self, ctx: ModuleCtx) -> tuple[RestRouter, OpenApiRegistry]:

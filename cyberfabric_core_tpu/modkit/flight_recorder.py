@@ -1,0 +1,315 @@
+"""Per-request flight recorder — bounded ring of request lifecycle timelines.
+
+The serving path (gateway → llm_gateway worker → replicas pool → continuous
+scheduler) emits one event per lifecycle transition, keyed by ``request_id``:
+
+    enqueued → admitted → prefill → decode_chunk* → (preempted → resumed)*
+             → (failover)* → finished | error
+
+Each event is ``(unix_ts, kind, attrs)``. From the timeline the recorder
+derives the figures aggregate ``stats()`` p50s cannot answer per request:
+ttft_ms, queue_wait_ms, itl_ms (mean inter-chunk gap / chunk size),
+recovery_ms (preempt→resume pauses), e2e_ms. RTP-LLM treats exactly this
+per-request phase timeline as a first-class serving primitive; APEX makes the
+same point for host/device overlap — aggregates can't localize a stall.
+
+Design constraints (mirrors modkit/failpoints.py):
+
+- **Hot-loop cheap.** The decode loop emits one ``decode_chunk`` event per
+  active slot per *chunk* (k fused tokens), never per token; ``record_event``
+  is the bump_counter-style never-raises helper (fabric-lint TL01 requires
+  runtime/ call sites to use it) and one lock acquire per event.
+- **Bounded.** Live table capped at ``max_live`` (oldest live record is
+  force-finished as ``evicted`` — a leak in the emitting layer must not
+  become unbounded host memory); finished ring capped at ``max_finished``;
+  per-record event list capped at ``max_events`` (the middle of a very long
+  decode is dropped, first/last events always survive).
+- **Prometheus-fed.** Terminal events observe the ``llm_ttft_seconds``,
+  ``llm_itl_seconds`` and ``llm_queue_wait_seconds`` histograms, so the
+  dashboards derive from the same timeline the REST surface shows
+  (no ad-hoc sampling drift).
+
+REST surface (monitoring module): ``GET /v1/monitoring/requests`` (live
+in-flight table), ``GET /v1/monitoring/requests/{id}`` (full timeline, incl.
+recently finished).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Optional
+
+__all__ = ["FlightRecorder", "RequestRecord", "default_recorder",
+           "record_event"]
+
+#: event kinds → the phase a request is in after the event
+_PHASE_AFTER = {
+    "enqueued": "queued",
+    "admitted": "prefill",
+    "prefill": "decode",
+    "first_token": "decode",
+    "decode_chunk": "decode",
+    "preempted": "preempted",
+    "resumed": "decode",
+    "failover": "failover",
+    "finished": "finished",
+    "error": "error",
+    "evicted": "evicted",
+}
+
+_TERMINAL = frozenset({"finished", "error", "evicted"})
+
+
+class RequestRecord:
+    """One request's timeline. Mutated only under the recorder's lock."""
+
+    __slots__ = ("request_id", "trace_id", "created_at", "phase", "slot",
+                 "tokens", "prompt_tokens", "events", "_dropped",
+                 "finished_at")
+
+    def __init__(self, request_id: str) -> None:
+        self.request_id = request_id
+        self.trace_id: Optional[str] = None
+        self.created_at = time.time()
+        self.phase = "queued"
+        self.slot: Optional[int] = None
+        self.tokens = 0
+        self.prompt_tokens = 0
+        self.events: list[tuple[float, str, dict]] = []
+        self._dropped = 0  # mid-timeline events dropped by the per-record cap
+        self.finished_at: Optional[float] = None
+
+    # ------------------------------------------------------------- derived
+    def _first(self, kind: str) -> Optional[float]:
+        for ts, k, _ in self.events:
+            if k == kind:
+                return ts
+        return None
+
+    def derived(self) -> dict[str, Any]:
+        """ttft/queue-wait/itl/recovery/e2e in ms, None where not reached."""
+        enq = self._first("enqueued") or self.created_at
+        adm = self._first("admitted")
+        # the first token is emitted at the end of prefill (the prefill
+        # program samples it) — ttft anchors there
+        first_tok = self._first("prefill") or self._first("first_token")
+        out: dict[str, Any] = {
+            "queue_wait_ms": _ms(enq, adm),
+            "ttft_ms": _ms(enq, first_tok),
+            "e2e_ms": _ms(enq, self.finished_at),
+        }
+        # mean inter-token latency from decode_chunk events: each event
+        # carries the chunk's token count; gaps between consecutive chunk
+        # timestamps average out to per-token latency
+        chunk_ts = [(ts, ev.get("tokens", 1)) for ts, k, ev in self.events
+                    if k == "decode_chunk"]
+        if len(chunk_ts) >= 2:
+            span = chunk_ts[-1][0] - chunk_ts[0][0]
+            toks = sum(n for _, n in chunk_ts[1:])
+            out["itl_ms"] = round(span / max(1, toks) * 1000.0, 3)
+        else:
+            out["itl_ms"] = None
+        pauses = [ev.get("pause_ms") for ts, k, ev in self.events
+                  if k == "resumed" and ev.get("pause_ms") is not None]
+        out["recovery_ms"] = round(sum(pauses), 3) if pauses else None
+        return out
+
+    def summary(self) -> dict[str, Any]:
+        """One row of the live in-flight table."""
+        return {
+            "request_id": self.request_id,
+            "trace_id": self.trace_id,
+            "phase": self.phase,
+            "slot": self.slot,
+            "age_s": round(time.time() - self.created_at, 3),
+            "tokens": self.tokens,
+            "prompt_tokens": self.prompt_tokens,
+            "events": len(self.events) + self._dropped,
+        }
+
+    def timeline(self) -> dict[str, Any]:
+        """The full record: every retained event + derived figures."""
+        return {
+            **self.summary(),
+            "dropped_events": self._dropped,
+            "derived": self.derived(),
+            "timeline": [
+                {"ts": round(ts, 6), "event": kind, **attrs}
+                for ts, kind, attrs in self.events
+            ],
+        }
+
+
+def _ms(a: Optional[float], b: Optional[float]) -> Optional[float]:
+    if a is None or b is None:
+        return None
+    return round((b - a) * 1000.0, 3)
+
+
+class FlightRecorder:
+    """Bounded live table + finished ring of :class:`RequestRecord`."""
+
+    def __init__(self, max_live: int = 4096, max_finished: int = 256,
+                 max_events: int = 512) -> None:
+        self.max_live = max_live
+        self.max_finished = max_finished
+        self.max_events = max_events
+        self._lock = threading.Lock()
+        #: insertion-ordered so eviction drops the oldest live record
+        self._live: "OrderedDict[str, RequestRecord]" = OrderedDict()
+        self._finished: "OrderedDict[str, RequestRecord]" = OrderedDict()
+        self.evicted_live = 0  # live records force-closed by the bound
+
+    # -------------------------------------------------------------- record
+    def record(self, request_id: str, kind: str, **attrs: Any) -> None:
+        """Append one event; creates the record on first sight (so a layer
+        that never saw ``enqueued`` — e.g. a failover wrapper — still lands
+        its events somewhere visible)."""
+        now = time.time()
+        with self._lock:
+            rec = self._live.get(request_id)
+            if rec is None:
+                closed = self._finished.get(request_id)
+                if closed is not None and kind in _TERMINAL:
+                    return  # duplicate terminal for a closed record
+                if closed is not None and kind == "failover":
+                    # REOPEN — only for the failover continuation: the
+                    # replica pool resubmits under the original request_id,
+                    # so the timeline reads error → failover → enqueued → …
+                    # as ONE story. Any other post-terminal event (e.g. a
+                    # client retry reusing a finished X-Request-Id) starts a
+                    # FRESH record — merging two requests would corrupt the
+                    # derived figures.
+                    self._finished.pop(request_id)
+                    closed.finished_at = None
+                    rec = closed
+                    self._live[request_id] = rec
+                else:
+                    rec = RequestRecord(request_id)
+                    rec.created_at = now
+                    self._live[request_id] = rec
+                while len(self._live) > self.max_live:
+                    _, old = self._live.popitem(last=False)
+                    self._close(old, now, "evicted", {})
+                    self.evicted_live += 1
+            self._append(rec, now, kind, attrs)
+            # denormalized columns the live table sorts/filters on
+            rec.phase = _PHASE_AFTER.get(kind, rec.phase)
+            if "slot" in attrs:
+                rec.slot = attrs["slot"]
+            if "trace_id" in attrs and attrs["trace_id"]:
+                rec.trace_id = attrs["trace_id"]
+            if "prompt_tokens" in attrs:
+                rec.prompt_tokens = int(attrs["prompt_tokens"])
+            if kind in ("prefill", "first_token"):
+                rec.tokens += 1
+            elif kind == "decode_chunk":
+                rec.tokens += int(attrs.get("tokens", 1))
+            if kind in _TERMINAL:
+                self._live.pop(request_id, None)
+                self._close(rec, now, None, None)
+        # only CLEAN completions feed the latency histograms: an 'error'
+        # terminal may be followed by a failover reopen (same derived values
+        # would be observed twice), and failed/evicted requests would skew
+        # the percentiles exactly when dashboards matter most
+        if kind == "finished":
+            self._observe_histograms(rec)
+
+    def _append(self, rec: RequestRecord, now: float, kind: str,
+                attrs: dict) -> None:
+        if len(rec.events) >= self.max_events:
+            # drop from the MIDDLE: the enqueue/admit/prefill head and the
+            # most recent tail both matter more than chunk #250
+            del rec.events[self.max_events // 2]
+            rec._dropped += 1
+        rec.events.append((now, kind, attrs))
+
+    def _close(self, rec: RequestRecord, now: float,
+               extra_kind: Optional[str], extra_attrs: Optional[dict]) -> None:
+        """Under lock: move a record to the finished ring."""
+        if extra_kind is not None:
+            self._append(rec, now, extra_kind, extra_attrs or {})
+            rec.phase = _PHASE_AFTER.get(extra_kind, rec.phase)
+        rec.finished_at = now
+        self._finished[rec.request_id] = rec
+        while len(self._finished) > self.max_finished:
+            self._finished.popitem(last=False)
+
+    def _observe_histograms(self, rec: RequestRecord) -> None:
+        """Terminal event → feed the Prometheus latency histograms from the
+        timeline itself. TTFT is observed by the llm_gateway at first chunk
+        (labeled by model, derived from THIS record's timeline when managed)
+        — observing it here too would double-count the series."""
+        try:
+            from .metrics import default_registry
+
+            d = rec.derived()
+            if d["queue_wait_ms"] is not None:
+                default_registry.histogram(
+                    "llm_queue_wait_seconds",
+                    "Pending-queue wait before admission"
+                ).observe(d["queue_wait_ms"] / 1000.0)
+            if d["itl_ms"] is not None:
+                default_registry.histogram(
+                    "llm_itl_seconds", "Mean inter-token latency per request",
+                    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                             0.25, 0.5, 1.0),
+                ).observe(d["itl_ms"] / 1000.0)
+        except Exception:  # noqa: BLE001 — telemetry must never fail serving
+            pass
+
+    # --------------------------------------------------------------- reads
+    def is_live(self, request_id: str) -> bool:
+        """True while a record with this id is in flight — admission layers
+        use it to de-collide client-supplied request ids."""
+        with self._lock:
+            return request_id in self._live
+
+    def inflight(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return [rec.summary() for rec in self._live.values()]
+
+    def lookup(self, request_id: str) -> Optional[dict[str, Any]]:
+        with self._lock:
+            rec = self._live.get(request_id) or self._finished.get(request_id)
+            return rec.timeline() if rec is not None else None
+
+    def recent(self, limit: int = 50) -> list[dict[str, Any]]:
+        """Most recently finished records, newest first. ``limit<=0`` means
+        none (the ``[-0:]`` slice would mean ALL — same zero semantics as the
+        rounds export)."""
+        if limit <= 0:
+            return []
+        with self._lock:
+            recs = list(self._finished.values())[-limit:]
+        return [rec.summary() for rec in reversed(recs)]
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {"live": len(self._live), "finished": len(self._finished),
+                    "evicted_live": self.evicted_live}
+
+    def reset(self) -> None:
+        """Test ergonomics — drop everything."""
+        with self._lock:
+            self._live.clear()
+            self._finished.clear()
+            self.evicted_live = 0
+
+
+#: process-global recorder (the monitoring module reads it; serving layers
+#: write through record_event)
+default_recorder = FlightRecorder()
+
+
+def record_event(request_id: str, kind: str, **attrs: Any) -> None:
+    """Fire-and-forget flight-recorder emit on the default recorder: never
+    raises (observability must not fail a serving/recovery path). fabric-lint
+    TL01 requires runtime/ emit sites to use this helper, mirroring
+    ``bump_counter`` for metrics."""
+    try:
+        default_recorder.record(request_id, kind, **attrs)
+    except Exception:  # noqa: BLE001
+        pass

@@ -23,13 +23,30 @@ import sys
 from pathlib import Path
 from typing import Any, Optional
 
-_FORMAT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+#: trace/request correlation rides in every line: scheduler and worker
+#: records carry the ids of the request they serve (telemetry
+#: TraceContextFilter fills the fields; "-" outside any request context), so
+#: `grep <trace_id> server.log` reconstructs one request's story across
+#: layers without timestamps-and-guesswork
+_FORMAT = ("%(asctime)s %(levelname)-7s %(name)s "
+           "[req=%(request_id)s trace=%(trace_id)s]: %(message)s")
+
+
+def _trace_filter() -> logging.Filter:
+    from .telemetry import TraceContextFilter
+
+    return TraceContextFilter()
 
 
 def init_logging_unified(config: dict[str, Any]) -> None:
     root_level = getattr(logging, str(config.get("level", "info")).upper(),
                          logging.INFO)
     logging.basicConfig(level=root_level, format=_FORMAT)
+    # the filter must sit on HANDLERS (filters on loggers don't see records
+    # propagated from child loggers); basicConfig just created/kept the root
+    # console handler
+    for handler in logging.getLogger().handlers:
+        handler.addFilter(_trace_filter())
 
     log_dir = config.get("dir")
     if log_dir is not None:
@@ -48,6 +65,7 @@ def init_logging_unified(config: dict[str, Any]) -> None:
                 log_dir / f"{module_name}.log",
                 maxBytes=max_bytes, backupCount=backups)
             handler.setFormatter(logging.Formatter(_FORMAT))
+            handler.addFilter(_trace_filter())
             module_logger.addHandler(handler)
 
     if log_dir is not None:
@@ -55,6 +73,7 @@ def init_logging_unified(config: dict[str, Any]) -> None:
         handler = logging.handlers.RotatingFileHandler(
             log_dir / "server.log", maxBytes=max_bytes, backupCount=backups)
         handler.setFormatter(logging.Formatter(_FORMAT))
+        handler.addFilter(_trace_filter())
         logging.getLogger().addHandler(handler)
 
     init_panic_hook()

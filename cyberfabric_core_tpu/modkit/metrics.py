@@ -30,14 +30,23 @@ class Counter:
     name: str
     help: str
     _values: dict[tuple, float] = field(default_factory=dict)
+    # per-metric lock: inc/set/observe are read-modify-write on shared dicts
+    # hit concurrently by the scheduler thread, worker threads, and scrapes —
+    # unlocked, increments under contention are silently lost. One acquire
+    # per hot-path call.
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         key = tuple(sorted(labels.items()))
-        self._values[key] = self._values.get(key, 0.0) + amount
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for key, v in sorted(self._values.items()):
+        with self._lock:
+            values = sorted(self._values.items())
+        for key, v in values:
             out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
         return out
 
@@ -47,21 +56,32 @@ class Gauge:
     name: str
     help: str
     _values: dict[tuple, float] = field(default_factory=dict)
-    _fn: Optional[callable] = None
+    #: scrape-time functions per label set (the labeled variant keeps e.g.
+    #: per-device HBM gauges off the unlabeled () key)
+    _fns: dict[tuple, "callable"] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def set(self, value: float, **labels: str) -> None:
-        self._values[tuple(sorted(labels.items()))] = float(value)
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = float(value)
 
-    def set_function(self, fn) -> None:
-        """Lazily evaluated at scrape time (e.g. HBM stats)."""
-        self._fn = fn
+    def set_function(self, fn, **labels: str) -> None:
+        """Lazily evaluated at scrape time (e.g. HBM stats). With labels, the
+        sample renders under that label set instead of the bare metric name."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._fns[key] = fn
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        values = dict(self._values)
-        if self._fn is not None:
+        with self._lock:
+            values = dict(self._values)
+            fns = list(self._fns.items())
+        for key, fn in fns:
             try:
-                values[()] = float(self._fn())
+                values[key] = float(fn())
             except Exception:  # noqa: BLE001 — scrape must not fail
                 pass
         for key, v in sorted(values.items()):
@@ -77,24 +97,28 @@ class Histogram:
     _counts: dict[tuple, list] = field(default_factory=dict)
     _sums: dict[tuple, float] = field(default_factory=dict)
     _totals: dict[tuple, int] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def observe(self, value: float, **labels: str) -> None:
         key = tuple(sorted(labels.items()))
-        counts = self._counts.setdefault(key, [0] * len(self.buckets))
         idx = bisect.bisect_left(self.buckets, value)
-        for i in range(idx, len(self.buckets)):
-            counts[i] += 1
-        self._sums[key] = self._sums.get(key, 0.0) + value
-        self._totals[key] = self._totals.get(key, 0) + 1
+        with self._lock:  # one acquire covers counts + sum + total
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i in range(idx, len(self.buckets)):
+                counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
 
     def quantile(self, q: float, **labels: str) -> Optional[float]:
         """Approximate quantile from bucket counts (upper bound of the bucket)."""
         key = tuple(sorted(labels.items()))
-        total = self._totals.get(key, 0)
-        if total == 0:
-            return None
-        target = q * total
-        counts = self._counts[key]
+        with self._lock:
+            total = self._totals.get(key, 0)
+            if total == 0:
+                return None
+            target = q * total
+            counts = list(self._counts[key])
         for i, c in enumerate(counts):
             if c >= target:
                 return self.buckets[i]
@@ -102,17 +126,19 @@ class Histogram:
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        for key in sorted(self._counts):
+        with self._lock:
+            snapshot = [(key, list(self._counts[key]), self._sums[key],
+                         self._totals[key]) for key in sorted(self._counts)]
+        for key, counts, total_sum, total in snapshot:
             labels = dict(key)
-            counts = self._counts[key]
             for bound, c in zip(self.buckets, counts):
                 out.append(
                     f"{self.name}_bucket{_fmt_labels({**labels, 'le': str(bound)})} {c}")
             out.append(
                 f"{self.name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} "
-                f"{self._totals[key]}")
-            out.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sums[key]}")
-            out.append(f"{self.name}_count{_fmt_labels(labels)} {self._totals[key]}")
+                f"{total}")
+            out.append(f"{self.name}_sum{_fmt_labels(labels)} {total_sum}")
+            out.append(f"{self.name}_count{_fmt_labels(labels)} {total}")
         return out
 
 

@@ -47,7 +47,11 @@ class Span:
         self.attributes[key] = value
 
     def traceparent(self) -> str:
-        return f"00-{self.trace_id}-{self.span_id}-01"
+        # the flags byte carries the sampling decision downstream: a worker
+        # thread holding only this string can decide "emit nothing" without
+        # consulting the tracer (W3C trace-context §3.2.3.3)
+        return (f"00-{self.trace_id}-{self.span_id}-"
+                f"{'01' if self.sampled else '00'}")
 
 
 class SpanExporter:
@@ -100,19 +104,28 @@ class Tracer:
              **attributes: Any) -> _SpanScope:
         parent = _current_span.get()
         trace_id, parent_id = None, None
+        flag_sampled: Optional[bool] = None
         if traceparent:
             m = _TRACEPARENT_RE.match(traceparent.strip())
             if m:
                 trace_id, parent_id = m.group(1), m.group(2)
+                flag_sampled = bool(int(m.group(3), 16) & 1)
         if trace_id is None and parent is not None:
             trace_id, parent_id = parent.trace_id, parent.span_id
         if trace_id is None:
             # os.urandom over uuid4: same 128 random bits without UUID object
             # construction (~3x faster; spans are per-request hot-path)
             trace_id = os.urandom(16).hex()
-        # parent-based sampling: children inherit the parent's decision; only
-        # root spans roll the dice, so an unsampled trace emits nothing at all
-        sampled = parent.sampled if parent is not None else (random.random() < self.sample_ratio)
+        # parent-based sampling: children inherit the parent's decision — the
+        # in-context parent's bit, else the traceparent flags byte (a remote
+        # or cross-thread parent); only true roots roll the dice, so an
+        # unsampled trace emits nothing at all
+        if parent is not None:
+            sampled = parent.sampled
+        elif flag_sampled is not None:
+            sampled = flag_sampled
+        else:
+            sampled = random.random() < self.sample_ratio
         return _SpanScope(self, Span(
             name=name,
             trace_id=trace_id,
@@ -122,9 +135,60 @@ class Tracer:
             sampled=sampled,
         ))
 
+    def emit_span(self, name: str, *, traceparent: Optional[str] = None,
+                  start_unix_ns: Optional[int] = None, duration_ms: float = 0.0,
+                  status: str = "ok", **attributes: Any) -> Optional[Span]:
+        """Export one retrospective span without touching the contextvar.
+
+        Built for the scheduler thread: device work is timed first, then the
+        span is emitted after the fact with explicit timestamps (the same
+        backdating trick as the gateway's unmatched-route epilogue). The
+        sampling decision comes from the traceparent flags byte — an
+        unsampled parent means this returns None before allocating anything.
+        """
+        if not self.enabled:
+            return None
+        trace_id = parent_id = None
+        sampled = True
+        if traceparent:
+            m = _TRACEPARENT_RE.match(traceparent.strip())
+            if m:
+                trace_id, parent_id = m.group(1), m.group(2)
+                sampled = bool(int(m.group(3), 16) & 1)
+        if trace_id is None:
+            trace_id = os.urandom(16).hex()
+            sampled = random.random() < self.sample_ratio
+        if not sampled:
+            return None
+        span = Span(name=name, trace_id=trace_id,
+                    span_id=os.urandom(8).hex(), parent_id=parent_id,
+                    attributes=dict(attributes), status=status)
+        if start_unix_ns is not None:
+            span.start_unix_ns = int(start_unix_ns)
+        self.exporter.export(span, duration_ms)
+        return span
+
     @staticmethod
     def current() -> Optional[Span]:
         return _current_span.get()
+
+
+#: process-global tracer: the gateway installs its configured tracer here at
+#: init so off-loop layers (scheduler thread, replicas pool) export child
+#: spans through the SAME exporter pipeline as the HTTP spans — one OTLP
+#: trace covers gateway → prefill → decode chunks. Defaults to a log-exporter
+#: tracer so library use without a gateway still works.
+_global_tracer = Tracer()
+
+
+def set_global_tracer(tracer: Tracer) -> Tracer:
+    global _global_tracer
+    _global_tracer = tracer
+    return tracer
+
+
+def get_global_tracer() -> Tracer:
+    return _global_tracer
 
 
 class OtlpHttpExporter(SpanExporter):
@@ -263,6 +327,53 @@ def tracer_from_config(cfg: dict) -> Tracer:
     return Tracer(enabled=bool(cfg.get("enabled", True)),
                   sample_ratio=float(cfg.get("sample_ratio", 1.0)),
                   exporter=exporter)
+
+
+def traceparent_ids(traceparent: Optional[str]) -> tuple[Optional[str], bool]:
+    """(trace_id, sampled) from a W3C traceparent; (None, False) if invalid.
+    Parsed ONCE at request submission so the decode hot loop's span guard is
+    a single bool attribute check, never a regex."""
+    if not traceparent:
+        return None, False
+    m = _TRACEPARENT_RE.match(traceparent.strip())
+    if not m:
+        return None, False
+    return m.group(1), bool(int(m.group(3), 16) & 1)
+
+
+#: (request_id, trace_id) for log correlation. A contextvar covers BOTH
+#: worlds: asyncio handlers inherit it through task context, and the
+#: scheduler/worker threads each see their own default — set_log_context
+#: scopes it around per-request operations on those threads.
+_log_ctx: contextvars.ContextVar[tuple[str, str]] = contextvars.ContextVar(
+    "log_request_ctx", default=("-", "-"))
+
+
+def set_log_context(request_id: Optional[str],
+                    trace_id: Optional[str]) -> contextvars.Token:
+    """Bind request/trace ids for log records emitted by this context; returns
+    the token for ``reset_log_context``. Never raises."""
+    return _log_ctx.set((request_id or "-", trace_id or "-"))
+
+
+def reset_log_context(token: contextvars.Token) -> None:
+    try:
+        _log_ctx.reset(token)
+    except Exception:  # noqa: BLE001 — cross-context reset: leave as-is
+        pass
+
+
+class TraceContextFilter(logging.Filter):
+    """Injects ``%(request_id)s`` / ``%(trace_id)s`` into every log record so
+    scheduler and worker lines become greppable by trace. Installed on the
+    logging-host handlers (modkit/logging_host.py); always passes the record
+    through — it annotates, never filters."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        rid, tid = _log_ctx.get()
+        record.request_id = rid
+        record.trace_id = tid
+        return True
 
 
 class ThrottledLog:

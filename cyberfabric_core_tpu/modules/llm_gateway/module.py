@@ -504,14 +504,34 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
                     f"model {model.canonical_id} "
                     f"{'TTFT' if first else 'total'} timeout")
             if first:
-                from ...modkit.metrics import default_registry
-
-                default_registry.histogram(
-                    "llm_ttft_seconds", "Time to first token").observe(
-                    asyncio.get_event_loop().time() - t_start,
-                    model=model.canonical_id)
+                self._observe_ttft(
+                    model, body, asyncio.get_event_loop().time() - t_start)
             first = False
             yield chunk
+
+    @staticmethod
+    def _observe_ttft(model: ModelInfo, body: dict, wall_s: float) -> None:
+        """llm_ttft_seconds{model=…}: derived from the flight-recorder
+        timeline when this request has one (managed models — enqueued →
+        prefill, the engine truth instead of ad-hoc wall-clock sampling);
+        external providers never touch the recorder, so their sample stays
+        the gateway-side wall clock."""
+        from ...modkit.flight_recorder import default_recorder
+        from ...modkit.metrics import default_registry
+
+        ttft_s = wall_s
+        rid = body.get("_request_id")
+        if model.managed and rid:
+            try:
+                rec = default_recorder.lookup(rid)
+                derived = (rec or {}).get("derived", {}).get("ttft_ms")
+                if derived is not None:
+                    ttft_s = derived / 1000.0
+            except Exception:  # noqa: BLE001 — telemetry must not fail serving
+                pass
+        default_registry.histogram(
+            "llm_ttft_seconds", "Time to first token").observe(
+            ttft_s, model=model.canonical_id)
 
     # ------------------------------------------------------------- REST handlers
     async def handle_chat(self, request: web.Request):
@@ -538,6 +558,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
 
             body["_resolved_tools"] = await normalize_tools(
                 ctx, body["tools"], self._hub.try_get(TypesRegistryApi))
+        self._inject_observability(request, body)
         models = await self._resolve_with_fallback(ctx, body)
 
         if body.get("async"):
@@ -567,11 +588,30 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             if action == "override":
                 body = verdict["body"]
                 validate_against(schemas.COMPLETION_REQUEST, body)
+        self._inject_observability(request, body)
         models = await self._resolve_with_fallback(ctx, body)
         if body.get("stream"):
             return await self._stream_response(request, ctx, body, models,
                                                mode="completion")
         return await self._sync_response(ctx, body, models, mode="completion")
+
+    @staticmethod
+    def _inject_observability(request: web.Request, body: dict) -> None:
+        """Thread the gateway's X-Request-Id and the live HTTP span's
+        traceparent into the worker params (underscore keys ride beside
+        ``_resolved_tools``): the engine keys its flight-recorder timeline by
+        the id the client already holds, and scheduler spans join the HTTP
+        trace — one OTLP trace from socket to tokens."""
+        from ...modkit.telemetry import Tracer
+
+        rid = request.get("request_id")
+        if rid and "_request_id" not in body:
+            body["_request_id"] = rid
+        span = Tracer.current()
+        if span is not None:
+            body["_traceparent"] = span.traceparent()
+        elif request.headers.get("traceparent"):
+            body["_traceparent"] = request.headers["traceparent"]
 
     async def _sync_response(self, ctx: SecurityContext, body: dict,
                              models: list[tuple[bool, ModelInfo]],
@@ -646,11 +686,19 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             except ProblemError as e:
                 last_err = e
                 continue  # fallback BEFORE the stream starts; after TTFT we're committed
-            resp = web.StreamResponse(headers={
+            headers = {
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "X-Model-Used": model.canonical_id,
-            })
+            }
+            # the request-id middleware echoes X-Request-Id AFTER the handler
+            # returns — too late for an SSE response that is already prepared
+            # and streamed; set it here so streaming clients can correlate
+            # with GET /v1/monitoring/requests/{id}
+            rid = request.get("request_id")
+            if rid:
+                headers["X-Request-Id"] = rid
+            resp = web.StreamResponse(headers=headers)
             await resp.prepare(request)
 
             async def send(payload: dict) -> None:

@@ -395,7 +395,19 @@ class LocalTpuWorker(LlmWorkerApi):
                 f"prompt of {len(prompt_ids)} tokens exceeds engine window "
                 f"{entry.config.max_seq_len}")
 
-        request_id = f"chat-{uuid.uuid4().hex[:20]}"
+        # the gateway threads its X-Request-Id through (``_request_id``), so
+        # the engine-side flight-recorder timeline is addressable by the id
+        # the client already holds (GET /v1/monitoring/requests/{id});
+        # ``_traceparent`` joins engine spans to the gateway's HTTP span.
+        # The header is CLIENT-CONTROLLED: a reused id while the original is
+        # still in flight gets a suffix, so one request can never close or
+        # pollute another's live timeline.
+        request_id = params.get("_request_id") or f"chat-{uuid.uuid4().hex[:20]}"
+        from ...modkit.flight_recorder import default_recorder
+
+        if default_recorder.is_live(request_id):
+            request_id = f"{request_id}-{uuid.uuid4().hex[:8]}"
+        trace = params.get("_traceparent")
         queue: asyncio.Queue = asyncio.Queue()
         req = _Request(
             prompt_ids=prompt_ids,
@@ -411,6 +423,7 @@ class LocalTpuWorker(LlmWorkerApi):
                     emit=lambda ev: loop.call_soon_threadsafe(
                         queue.put_nowait, ev),
                     request_id=request_id,
+                    trace=trace,
                 )
             except SchedulerSaturated as e:
                 # admission backpressure: the pending queue is at

@@ -18,6 +18,50 @@ from ..gateway.validation import read_json
 from .sdk import LlmWorkerApi
 
 
+#: the four stages of one scheduler round, rendered as one Perfetto track
+#: each — the admit → dispatch → sync-wait → host-emit pipeline from the
+#: overlapped-decode stats becomes visually inspectable
+_ROUND_STAGES = ("admit", "dispatch", "sync_wait", "host_emit")
+
+
+def _chrome_trace(per_model: dict[str, list[dict]]) -> dict:
+    """Scheduler round timings → Chrome trace-event JSON (the format Perfetto
+    and chrome://tracing load directly). One process per engine, one thread
+    track per pipeline stage, "X" complete events in µs."""
+    events: list[dict] = []
+    for pid, name in enumerate(sorted(per_model), start=1):
+        events.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": f"scheduler {name}"}})
+        for tid, stage in enumerate(_ROUND_STAGES, start=1):
+            events.append({"ph": "M", "pid": pid, "tid": tid,
+                           "name": "thread_name", "args": {"name": stage}})
+        for r in per_model[name]:
+            ts = r.get("ts")
+            if ts is None:  # entry predating the wall-clock column
+                continue
+            round_us = ts * 1e6
+            # admission ran just BEFORE the round's dispatch; the remaining
+            # stages are sequential from the round start
+            starts_us = (
+                round_us - r["admit_ms"] * 1000.0,
+                round_us,
+                round_us + r["dispatch_ms"] * 1000.0,
+                round_us + (r["dispatch_ms"] + r["sync_wait_ms"]) * 1000.0,
+            )
+            durs_ms = (r["admit_ms"], r["dispatch_ms"], r["sync_wait_ms"],
+                       r["host_emit_ms"])
+            for tid, (stage, start_us, dur_ms) in enumerate(
+                    zip(_ROUND_STAGES, starts_us, durs_ms), start=1):
+                events.append({
+                    "name": stage, "ph": "X", "pid": pid, "tid": tid,
+                    "ts": round(start_us, 1),
+                    "dur": round(max(0.0, dur_ms) * 1000.0, 1),
+                    "args": {"lookahead": bool(r.get("lookahead")),
+                             "active_slots": r.get("active")},
+                })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
 @module(name="monitoring", capabilities=["rest"])
 class MonitoringModule(Module, RestApiCapability):
     def __init__(self) -> None:
@@ -244,6 +288,90 @@ class MonitoringModule(Module, RestApiCapability):
             _require_faultlab()
             fp.reset()
             return {"reset": True}
+
+        # ---- request flight recorder: live in-flight introspection + full
+        # per-request phase timelines (enqueued → prefill → decode chunks →
+        # preempt/resume → finished), keyed by the X-Request-Id the client
+        # already holds. Recently finished requests stay queryable from the
+        # recorder's bounded ring.
+        from ..modkit.flight_recorder import default_recorder
+
+        def _int_param(request: web.Request, name: str, default: int) -> int:
+            raw = request.query.get(name)
+            if raw is None:
+                return default
+            try:
+                value = int(raw)
+            except ValueError:
+                raise ERR.core.bad_request.error(
+                    f"query parameter {name!r} must be an integer, "
+                    f"got {raw!r}")
+            if value < 0:
+                raise ERR.core.bad_request.error(
+                    f"query parameter {name!r} must be >= 0")
+            return value
+
+        async def list_requests(request: web.Request):
+            rows = default_recorder.inflight()
+            rows.sort(key=lambda r: -r["age_s"])
+            return {
+                "in_flight": rows,
+                "recent": default_recorder.recent(
+                    _int_param(request, "recent", 20)),
+                "recorder": default_recorder.stats(),
+            }
+
+        async def get_request_timeline(request: web.Request):
+            rid = request.match_info["request_id"]
+            rec = default_recorder.lookup(rid)
+            if rec is None:
+                raise ERR.monitoring.unknown_request.error(
+                    f"no flight record for request {rid!r} (live table + "
+                    "finished ring miss — it may have aged out)")
+            return rec
+
+        def _schedulers_named():
+            worker = ctx.client_hub.try_get(LlmWorkerApi)
+            for name, entry in getattr(worker, "_entries", {}).items():
+                sched = getattr(entry, "scheduler", None)
+                if sched is not None:
+                    yield name, sched
+
+        async def export_rounds(request: web.Request):
+            fmt = request.query.get("format", "json")
+            if fmt not in ("json", "chrome-trace"):
+                raise ERR.monitoring.bad_export_format.error(
+                    f"format {fmt!r} not supported; use json or chrome-trace")
+            limit = _int_param(request, "limit", 512)
+            per_model: dict[str, list[dict]] = {}
+            for name, sched in _schedulers_named():
+                try:  # snapshot a deque the scheduler thread appends to
+                    rounds = list(sched.round_timings)
+                except RuntimeError:
+                    rounds = []
+                rounds = rounds[-limit:] if limit else []
+                per_model[name] = rounds
+            if fmt == "json":
+                return {"rounds": per_model}
+            return web.json_response(
+                _chrome_trace(per_model),
+                headers={"Content-Disposition":
+                         'attachment; filename="scheduler-rounds.json"'})
+
+        router.operation("GET", "/v1/monitoring/requests",
+                         module="monitoring").auth_required() \
+            .summary("Live in-flight request table (flight recorder)") \
+            .handler(list_requests).register()
+        router.operation("GET", "/v1/monitoring/requests/{request_id}",
+                         module="monitoring").auth_required() \
+            .summary("Full phase timeline of one request (incl. recently "
+                     "finished)") \
+            .handler(get_request_timeline).register()
+        router.operation("GET", "/v1/monitoring/rounds",
+                         module="monitoring").auth_required() \
+            .summary("Recent scheduler rounds; ?format=chrome-trace exports "
+                     "Perfetto-loadable trace events") \
+            .handler(export_rounds).register()
 
         router.operation("GET", "/v1/monitoring/failpoints",
                          module="monitoring").auth_required() \

@@ -34,6 +34,7 @@ from typing import Any, Callable, Optional
 import jax
 
 from ..modkit.failpoints import failpoint, record_recovery
+from ..modkit.flight_recorder import record_event
 from ..modkit.metrics import bump_counter
 from .engine import EngineConfig, SamplingParams, StepEvent
 from .scheduler import ContinuousBatchingEngine
@@ -51,6 +52,7 @@ class _Tracked:
     replica: int
     retries_left: int
     done: bool = False
+    trace: Optional[str] = None  # W3C traceparent, carried across failover
 
 
 class DataParallelServingPool:
@@ -108,13 +110,14 @@ class DataParallelServingPool:
         sampling: SamplingParams,
         emit: Callable[[StepEvent], None],
         request_id: Optional[str] = None,
+        trace: Optional[str] = None,
     ) -> str:
         # armed raise rejects the request before any replica sees it (the
         # faultlab pool scenario asserts no tracking record leaks)
         failpoint("replicas.submit")
         idx = self._pick()
         tracked = _Tracked(list(prompt_ids), sampling, emit, [], idx,
-                           self.max_retries)
+                           self.max_retries, trace=trace)
         rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
         # register BEFORE submitting: the scheduler thread may finish the
         # request (and fire the tracking-record cleanup) before this thread
@@ -123,7 +126,8 @@ class DataParallelServingPool:
             self._requests[rid] = tracked
         try:
             self.replicas[idx].submit(prompt_ids, sampling,
-                                      self._wrap(rid, tracked), rid)
+                                      self._wrap(rid, tracked), rid,
+                                      trace=trace)
         except Exception:
             with self._lock:
                 self._requests.pop(rid, None)
@@ -171,9 +175,15 @@ class DataParallelServingPool:
         logger.warning("failover: replica %d broke; resuming request on %d "
                        "(%d tokens emitted, %d budget left)",
                        old, idx, len(tracked.emitted), remaining)
+        # timeline: the failover lands on the SAME request_id, so the
+        # /v1/monitoring/requests/{id} record shows error → failover →
+        # enqueued (attempt 2) as one story
+        record_event(rid, "failover", from_replica=old, to_replica=idx,
+                     tokens_carried=len(tracked.emitted))
         try:
             self.replicas[idx].submit(cont_prompt, cont_sampling,
-                                      self._wrap(rid, tracked))
+                                      self._wrap(rid, tracked), rid,
+                                      trace=tracked.trace)
         except Exception:  # noqa: BLE001 — fall through to the error event
             logger.exception("failover resubmission failed")
             self.failovers_failed += 1

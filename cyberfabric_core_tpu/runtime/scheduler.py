@@ -61,6 +61,9 @@ import numpy as np
 from ..models import llama
 from ..models.configs import ModelConfig, get_config
 from ..modkit.failpoints import failpoint, record_recovery
+from ..modkit.flight_recorder import record_event
+from ..modkit.telemetry import (get_global_tracer, reset_log_context,
+                                set_log_context, traceparent_ids)
 from ..ops.rope import rope_frequencies
 from ..ops.sampling import sample_token, sample_token_per_slot, split_keys_per_slot
 from .engine import (EngineConfig, SamplingParams, SchedulerSaturated,
@@ -84,6 +87,12 @@ class _SlotState:
     emitted: int = 0
     request_index: int = 0  # external correlation id
     chain: Optional[list[int]] = None  # paged mode: page ids held by this slot
+    #: W3C traceparent the gateway propagated through submit; trace_sampled is
+    #: parsed ONCE at submission — the decode hot loop's span guard is a
+    #: single bool check (the disarmed-failpoint pattern), so an unsampled
+    #: trace costs ~nothing per chunk
+    trace: Optional[str] = None
+    trace_sampled: bool = False
 
 
 @dataclass
@@ -96,6 +105,7 @@ class _Pending:
     #: paged mode: per-request PRNG key, assigned at TAKE time in FIFO order so
     #: coalescing/partitioning can never reorder the shared-rng split sequence
     key: Any = None
+    trace: Optional[str] = None  # W3C traceparent from the gateway span
 
 
 @dataclass
@@ -110,6 +120,9 @@ class _Suspended:
     last_token: int
     slot_key: Any  # per-slot RNG key (reproducibility across the suspend)
     suspended_at: float = field(default_factory=time.monotonic)
+    #: wall-clock twin of suspended_at: the llm.preempt span emitted at
+    #: resume is backdated to this (OTLP timestamps are unix-epoch ns)
+    suspended_wall: float = field(default_factory=time.time)
 
 
 @dataclass
@@ -395,9 +408,12 @@ class ContinuousBatchingEngine:
         sampling: SamplingParams,
         emit: Callable[[StepEvent], None],
         request_id: Optional[str] = None,
+        trace: Optional[str] = None,
     ) -> str:
         """Enqueue a request; ``emit`` receives StepEvents from the scheduler
-        thread (request_index is unused here — events are per-request already)."""
+        thread (request_index is unused here — events are per-request already).
+        ``trace`` is the caller's W3C traceparent: lifecycle spans
+        (llm.prefill / llm.decode_chunk / llm.preempt) join that trace."""
         rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
         self._bucket_for(len(prompt_ids))  # validate early, in caller context
         if not self.paged and sampling.seed is not None:
@@ -421,7 +437,14 @@ class ContinuousBatchingEngine:
                 raise SchedulerSaturated(
                     f"pending queue full ({self.config.max_pending} "
                     "requests); retry later")
-            self._pending.put(_Pending(rid, list(prompt_ids), sampling, emit))
+            # recorded BEFORE the put: once the request is visible to the
+            # scheduler thread it can be admitted (and even finished)
+            # immediately — a late 'enqueued' would arrive out of order and
+            # reopen a ghost record
+            record_event(rid, "enqueued", prompt_tokens=len(prompt_ids),
+                         trace_id=traceparent_ids(trace)[0])
+            self._pending.put(_Pending(rid, list(prompt_ids), sampling, emit,
+                                       trace=trace))
         self._wake.set()
         self.start()
         return rid
@@ -515,6 +538,12 @@ class ContinuousBatchingEngine:
                 for slot in range(self.n_slots):
                     state = self.slots[slot]
                     if state is not None:
+                        # record BEFORE emit: the replica pool's failover
+                        # wrapper resubmits synchronously inside emit — the
+                        # terminal must close THIS attempt's record, not the
+                        # fresh one the resubmission just opened
+                        record_event(state.request_id, "error",
+                                     detail="scheduler loop failed")
                         try:
                             state.emit(StepEvent(0, -1, "error"))
                         except Exception:
@@ -523,6 +552,8 @@ class ContinuousBatchingEngine:
                 self.active[:] = False
                 while self._suspended:  # preempted requests fail too
                     rec = self._suspended.popleft()
+                    record_event(rec.state.request_id, "error",
+                                 detail="scheduler loop failed while suspended")
                     try:
                         rec.state.emit(StepEvent(0, -1, "error"))
                     except Exception:
@@ -530,6 +561,8 @@ class ContinuousBatchingEngine:
                 while True:  # drain queued requests too
                     try:
                         req = self._pending.get_nowait()
+                        record_event(req.request_id, "error",
+                                     detail="scheduler loop failed while queued")
                         req.emit(StepEvent(0, -1, "error"))
                     except _queue.Empty:
                         break
@@ -640,6 +673,9 @@ class ContinuousBatchingEngine:
                         "request %s (len=%d) %s; finishing with 'length'",
                         rec.state.request_id, rec.length, reason)
                     rec.state.emit(StepEvent(0, -1, "length"))
+                    record_event(rec.state.request_id, "finished",
+                                 reason="length", shed=True,
+                                 tokens=rec.state.emitted)
                     self.requests_completed += 1
                     continue
                 break  # still no room; stay suspended
@@ -669,8 +705,23 @@ class ContinuousBatchingEngine:
             pause_s = time.monotonic() - rec.suspended_at
             self.resume_latency_samples.append(pause_s)
             record_recovery("scheduler.resume", pause_s)
-            logger.info("resumed %s into slot %d (len=%d, paused %.3fs)",
-                        state.request_id, slot, rec.length, pause_s)
+            record_event(state.request_id, "resumed", slot=slot,
+                         pause_ms=round(pause_s * 1000.0, 3))
+            if state.trace_sampled:
+                # the pause a client stream actually experienced, as a span
+                # in the request's trace (backdated to the preemption)
+                get_global_tracer().emit_span(
+                    "llm.preempt", traceparent=state.trace,
+                    start_unix_ns=int(rec.suspended_wall * 1e9),
+                    duration_ms=pause_s * 1000.0,
+                    request_id=state.request_id, slot=slot)
+            token = set_log_context(state.request_id,
+                                    traceparent_ids(state.trace)[0])
+            try:
+                logger.info("resumed %s into slot %d (len=%d, paused %.3fs)",
+                            state.request_id, slot, rec.length, pause_s)
+            finally:
+                reset_log_context(token)
         return resumed
 
     def _admit(self) -> int:
@@ -697,8 +748,10 @@ class ContinuousBatchingEngine:
                 break
             taken.append(req)
             spent += len(req.prompt_ids)
-            self.queue_wait_samples.append(
-                (time.monotonic() - req.enqueued_at) * 1000.0)
+            wait_ms = (time.monotonic() - req.enqueued_at) * 1000.0
+            self.queue_wait_samples.append(wait_ms)
+            record_event(req.request_id, "admitted",
+                         queue_wait_ms=round(wait_ms, 3))
         if taken:
             admitted += self._place(taken)
         self._last_admit_ms = round((time.monotonic() - t0) * 1000.0, 3)
@@ -763,8 +816,15 @@ class ContinuousBatchingEngine:
                 self._prefill_into_slot(slot, req, prematched=match)
                 placed += 1
             except Exception:  # noqa: BLE001
-                logger.exception("prefill failed for %s", req.request_id)
+                log_tok = set_log_context(req.request_id,
+                                          traceparent_ids(req.trace)[0])
+                try:
+                    logger.exception("prefill failed for %s", req.request_id)
+                finally:
+                    reset_log_context(log_tok)
                 if self._reclaim_failed_admission(slot):
+                    record_event(req.request_id, "error",
+                                 detail="prefill failed")
                     try:
                         req.emit(StepEvent(0, -1, "error"))
                     except Exception:  # noqa: BLE001 — emit itself may be the fault
@@ -797,6 +857,8 @@ class ContinuousBatchingEngine:
         for i in range(B, Bp):
             ids[i] = ids[0]
             lengths[i] = lengths[0]
+        t_pf = time.monotonic()
+        wall_pf = time.time()
         try:
             first, kv, keys_out = self._batch_prefill_fn(
                 self.params, jnp.asarray(ids), jnp.asarray(lengths),
@@ -808,6 +870,8 @@ class ContinuousBatchingEngine:
                              B, bucket)
             for req in reqs:
                 req.emit(StepEvent(0, -1, "error"))
+                record_event(req.request_id, "error",
+                             detail="coalesced prefill failed")
             return 0
         placed = 0
         for i, req in enumerate(reqs):
@@ -822,6 +886,17 @@ class ContinuousBatchingEngine:
             try:
                 kv_row = (kv[0][:, i:i + 1], kv[1][:, i:i + 1])
                 chain = self.pool.admit_slot(req.prompt_ids, [], kv_row)
+                dur_ms = (time.monotonic() - t_pf) * 1000.0
+                record_event(req.request_id, "prefill", slot=slot,
+                             coalesced=True, batch=B, cached_len=0,
+                             prompt_tokens=len(req.prompt_ids),
+                             dur_ms=round(dur_ms, 3))
+                if req.trace:
+                    get_global_tracer().emit_span(
+                        "llm.prefill", traceparent=req.trace,
+                        start_unix_ns=int(wall_pf * 1e9), duration_ms=dur_ms,
+                        request_id=req.request_id, slot=slot, coalesced=True,
+                        batch=B, prompt_tokens=len(req.prompt_ids))
                 self._activate_slot(slot, req, chain, int(first_host[i]),
                                     keys_out[i])
                 placed += 1
@@ -833,6 +908,8 @@ class ContinuousBatchingEngine:
                         self.pool.release_slot(chain)
                         self.page_table[slot, :] = 0
                         self._mark_pt_row(slot)
+                    record_event(req.request_id, "error",
+                                 detail="coalesced admission failed")
                     try:
                         req.emit(StepEvent(0, -1, "error"))
                     except Exception:  # noqa: BLE001 — emit itself may be the fault
@@ -853,6 +930,8 @@ class ContinuousBatchingEngine:
         # armed raise exercises the failed-admission reclaim path: _place
         # catches, reclaims the slot, and error-terminates only this request
         failpoint("scheduler.prefill")
+        t_pf = time.monotonic()
+        wall_pf = time.time()
         T = len(req.prompt_ids)
         bucket = self._bucket_for(T)
         s = req.sampling
@@ -952,6 +1031,18 @@ class ContinuousBatchingEngine:
             raise
         if self.paged:
             assert chain is not None
+        dur_ms = (time.monotonic() - t_pf) * 1000.0
+        # recorded BEFORE activation: the first token emitted there may finish
+        # the request, and a terminal event must be the timeline's last
+        record_event(req.request_id, "prefill", slot=slot, coalesced=False,
+                     cached_len=cached_len, prompt_tokens=T,
+                     dur_ms=round(dur_ms, 3))
+        if req.trace:
+            get_global_tracer().emit_span(
+                "llm.prefill", traceparent=req.trace,
+                start_unix_ns=int(wall_pf * 1e9), duration_ms=dur_ms,
+                request_id=req.request_id, slot=slot, prompt_tokens=T,
+                cached_len=cached_len)
         self._activate_slot(slot, req, chain, tok, req_key)
 
     def _activate_slot(self, slot: int, req: _Pending,
@@ -975,6 +1066,8 @@ class ContinuousBatchingEngine:
             sampling=s,
             stops=frozenset(s.stop_token_ids) | frozenset(self.config.eos_token_ids),
             chain=chain,
+            trace=req.trace,
+            trace_sampled=traceparent_ids(req.trace)[1],
         )
         T = len(req.prompt_ids)
         self.slots[slot] = state
@@ -1006,6 +1099,8 @@ class ContinuousBatchingEngine:
         state.emit(StepEvent(0, tok, fin))
         self.tokens_emitted += 1
         if fin is not None:
+            record_event(state.request_id, "finished", reason=fin,
+                         tokens=state.emitted)
             self.active[slot] = False
             self.slots[slot] = None
             self.requests_completed += 1
@@ -1076,9 +1171,16 @@ class ContinuousBatchingEngine:
         and park the request — _admit resumes it when space frees (no
         recompute; the stream pauses, never errors)."""
         chain = state.chain
-        logger.warning("pool exhausted; preempting %s to host "
-                       "(len=%d, %d pages)", state.request_id,
-                       int(self.lengths[slot]), len(chain))
+        token = set_log_context(state.request_id,
+                                traceparent_ids(state.trace)[0])
+        try:
+            logger.warning("pool exhausted; preempting %s to host "
+                           "(len=%d, %d pages)", state.request_id,
+                           int(self.lengths[slot]), len(chain))
+        finally:
+            reset_log_context(token)
+        record_event(state.request_id, "preempted", slot=slot,
+                     length=int(self.lengths[slot]))
         host_kv = self.pool.save_chain_to_host(chain)
         self._suspended.append(_Suspended(
             state=state, host_kv=host_kv,
@@ -1173,22 +1275,35 @@ class ContinuousBatchingEngine:
         return old_lengths
 
     def _record_round(self, dispatch_ms: float, sync_wait_ms: float,
-                      host_emit_ms: float, lookahead: bool) -> None:
+                      host_emit_ms: float, lookahead: bool,
+                      ts: Optional[float] = None) -> None:
         """One timing-schema owner for both decode modes — the stats()
-        percentile keys cannot drift between paged and dense."""
+        percentile keys cannot drift between paged and dense. ``ts`` is the
+        round's wall-clock start; /v1/monitoring/rounds exports these entries
+        as Chrome trace events, which need absolute timestamps."""
         self.decode_rounds += 1
         if lookahead:
             self.lookahead_rounds += 1
         self.round_timings.append({
+            "ts": round(ts if ts is not None else time.time(), 6),
             "admit_ms": self._last_admit_ms,
             "dispatch_ms": round(dispatch_ms, 3),
             "sync_wait_ms": round(sync_wait_ms, 3),
             "host_emit_ms": round(host_emit_ms, 3),
             "lookahead": lookahead,
+            "active": self.active_slots,
         })
 
     def _emit_chunk(self, chunk: np.ndarray, old_lengths: np.ndarray) -> None:
         k = self._k_steps
+        # one flight-recorder event per active slot per CHUNK (k fused
+        # tokens), never per token — the per-round cost is a handful of
+        # lock-once appends against a whole device dispatch
+        for slot in range(self.n_slots):
+            state = self.slots[slot]
+            if state is not None and self.active[slot]:
+                record_event(state.request_id, "decode_chunk", slot=slot,
+                             tokens=k)
         for j in range(k):
             last_of_chunk = j == k - 1
             for slot in range(self.n_slots):
@@ -1207,6 +1322,7 @@ class ContinuousBatchingEngine:
             self._decode_round_dense()
             return
         t0 = time.monotonic()
+        wall0 = time.time()
         lookahead_on = self.config.decode_lookahead
         inflight, self._inflight = self._inflight, None
         if inflight is not None and inflight.epoch != self._epoch:
@@ -1233,6 +1349,7 @@ class ContinuousBatchingEngine:
         chunk = np.asarray(inflight.chunk_dev, np.int32)  # sync-point: the ONE sanctioned decode-loop readback (AS04)
         t3 = time.monotonic()
         old_lengths = self._commit_chunk(inflight)
+        self._emit_decode_spans(wall0, (t3 - t0) * 1000.0, used_lookahead)
         self._emit_chunk(chunk, old_lengths)
         t4 = time.monotonic()
         # a finish just changed the world — the speculative chunk is stale
@@ -1240,10 +1357,30 @@ class ContinuousBatchingEngine:
             self._discard_inflight(self._inflight)
             self._inflight = None
         self._record_round((t2 - t0) * 1000.0, (t3 - t2) * 1000.0,
-                           (t4 - t3) * 1000.0, used_lookahead)
+                           (t4 - t3) * 1000.0, used_lookahead, ts=wall0)
+
+    def _emit_decode_spans(self, wall0: float, dur_ms: float,
+                           lookahead: bool) -> None:
+        """llm.decode_chunk spans for SAMPLED in-flight requests — called
+        before the emit loop (a mid-chunk finish clears the slot state). The
+        guard is one bool attribute per slot: an unsampled or traceless
+        request pays nothing here (the disarmed-failpoint pattern; the
+        bench.py --trace-guard A/B holds this under 1% tok/s)."""
+        k = self._k_steps
+        start_ns = int(wall0 * 1e9)
+        for slot in range(self.n_slots):
+            state = self.slots[slot]
+            if state is None or not state.trace_sampled or not self.active[slot]:
+                continue
+            get_global_tracer().emit_span(
+                "llm.decode_chunk", traceparent=state.trace,
+                start_unix_ns=start_ns, duration_ms=dur_ms,
+                request_id=state.request_id, slot=slot, tokens=k,
+                lookahead=lookahead)
 
     def _decode_round_dense(self) -> None:
         t0 = time.monotonic()
+        wall0 = time.time()
         lengths_dev = jnp.asarray(self.lengths)
         chunk_dev, k_cache, v_cache, last, self._rng = self._decode_fn(
             self.params, self.cache[0], self.cache[1], self._last_tokens,
@@ -1255,7 +1392,8 @@ class ContinuousBatchingEngine:
         t1 = time.monotonic()
         chunk = np.asarray(chunk_dev, np.int32)  # sync-point: dense-mode chunk readback (AS04)
         t2 = time.monotonic()
+        self._emit_decode_spans(wall0, (t2 - t0) * 1000.0, lookahead=False)
         self._emit_chunk(chunk, self._advance_lengths())
         t3 = time.monotonic()
         self._record_round((t1 - t0) * 1000.0, (t2 - t1) * 1000.0,
-                           (t3 - t2) * 1000.0, lookahead=False)
+                           (t3 - t2) * 1000.0, lookahead=False, ts=wall0)

@@ -10,6 +10,10 @@ import aiohttp
 import pytest
 
 BASE_CONFIG = {
+    # sampled tracing: the observability e2e asserts one trace covers the
+    # gateway HTTP span and the scheduler's llm.* spans (log exporter — the
+    # tests swap in a collecting exporter)
+    "tracing": {"enabled": True, "sample_ratio": 1.0},
     "modules": {
         # auth_disabled stays False: requests flow through the accept_all authn
         # resolver plugin, which takes the tenant from x-tenant-id (default acme)
@@ -48,7 +52,9 @@ BASE_CONFIG = {
         "credstore": {},
         "file_parser": {},
         "serverless_runtime": {},
-        "monitoring": {},
+        # fault injection armed over REST: the observability e2e rehearses an
+        # injected preempt/resume and reads it back from the flight recorder
+        "monitoring": {"config": {"allow_fault_injection": True}},
         "user_settings": {},
     }
 }
@@ -552,6 +558,131 @@ def test_metrics_endpoint(server):
     assert "llm_ttft_seconds_bucket" in text
     assert "tpu_devices" in text
     assert "llm_batch_active_slots" in text
+
+
+def test_flight_recorder_trace_e2e(server):
+    """ISSUE-4 acceptance: ONE request through the HTTP gateway yields ONE
+    trace containing the gateway span + llm.prefill + llm.decode_chunk, and
+    the flight-recorder timeline is addressable by the client's request id."""
+    from cyberfabric_core_tpu.modkit.telemetry import get_global_tracer
+
+    tracer = get_global_tracer()
+    spans = []
+
+    class _Collect:
+        def export(self, span, duration_ms):
+            spans.append(span)
+
+    old_exporter, tracer.exporter = tracer.exporter, _Collect()
+    try:
+        status, body = req(server, "POST", "/v1/chat/completions", json={
+            "model": "default-chat",
+            "messages": [{"role": "user",
+                          "content": [{"type": "text", "text": "trace me"}]}],
+            "max_tokens": 10,
+        }, headers={"x-request-id": "e2e-flight-1"})
+    finally:
+        tracer.exporter = old_exporter
+    assert status == 200, body
+
+    names = {s.name for s in spans}
+    assert "llm.prefill" in names and "llm.decode_chunk" in names, names
+    gateway_spans = [s for s in spans
+                     if s.name == "http POST /v1/chat/completions"]
+    assert gateway_spans, names
+    llm_trace_ids = {s.trace_id for s in spans if s.name.startswith("llm.")}
+    # single trace covers HTTP → tokens
+    assert llm_trace_ids == {gateway_spans[0].trace_id}
+
+    # the engine keyed its timeline by the id the client sent
+    status, rec = req(server, "GET", "/v1/monitoring/requests/e2e-flight-1")
+    assert status == 200, rec
+    kinds = [e["event"] for e in rec["timeline"]]
+    for expected in ("enqueued", "admitted", "prefill", "decode_chunk",
+                     "finished"):
+        assert expected in kinds, kinds
+    assert rec["trace_id"] == gateway_spans[0].trace_id
+    assert rec["derived"]["ttft_ms"] is not None
+
+    # live table endpoint: well-formed, this request now in the recent ring
+    status, table = req(server, "GET", "/v1/monitoring/requests")
+    assert status == 200
+    assert {"in_flight", "recent", "recorder"} <= set(table)
+    assert any(r["request_id"] == "e2e-flight-1" for r in table["recent"])
+
+
+def test_flight_recorder_injected_preempt_in_timeline(server):
+    """Faultlab-armed pool pressure over REST: the preempt/resume pair must
+    land in the request's phase timeline."""
+    status, _ = req(server, "PUT",
+                    "/v1/monitoring/failpoints/scheduler.page_alloc",
+                    json={"spec": "2*raise(MemoryError)"})
+    assert status == 200
+    try:
+        status, body = req(server, "POST", "/v1/chat/completions", json={
+            "model": "default-chat",
+            "messages": [{"role": "user",
+                          "content": [{"type": "text", "text": "pressure"}]}],
+            "max_tokens": 24,
+        }, headers={"x-request-id": "e2e-preempt-1"})
+        assert status == 200, body
+    finally:
+        status, _ = req(server, "DELETE", "/v1/monitoring/failpoints")
+        assert status == 200
+    status, rec = req(server, "GET", "/v1/monitoring/requests/e2e-preempt-1")
+    assert status == 200, rec
+    kinds = [e["event"] for e in rec["timeline"]]
+    assert "preempted" in kinds and "resumed" in kinds, kinds
+    assert kinds.index("preempted") < kinds.index("resumed")
+    assert rec["derived"]["recovery_ms"] is not None
+    # unknown ids 404 as an RFC-9457 problem
+    status, prob = req(server, "GET", "/v1/monitoring/requests/nope-404")
+    assert status == 404 and prob["code"] == "unknown_request"
+
+
+def test_monitoring_rounds_chrome_trace_export(server):
+    """?format=chrome-trace emits Perfetto-loadable trace-event JSON for the
+    scheduler rounds the requests above just produced."""
+    status, doc = req(server, "GET",
+                      "/v1/monitoring/rounds?format=chrome-trace")
+    assert status == 200
+    events = doc["traceEvents"]
+    assert events, "no scheduler rounds exported"
+    slices = [e for e in events if e["ph"] == "X"]
+    assert slices
+    for e in slices:
+        assert {"name", "ph", "pid", "tid", "ts", "dur"} <= set(e)
+        assert e["name"] in ("admit", "dispatch", "sync_wait", "host_emit")
+        assert e["dur"] >= 0
+    assert any(e.get("ph") == "M" and e.get("name") == "process_name"
+               for e in events)
+    # raw JSON variant stays available for tooling
+    status, raw = req(server, "GET", "/v1/monitoring/rounds")
+    assert status == 200 and "rounds" in raw
+    assert any(raw["rounds"].values())
+
+
+def test_sse_stream_carries_request_id_header(server):
+    """Streaming responses are prepared before the middleware epilogue runs —
+    the SSE handler must stamp X-Request-Id itself so clients can correlate
+    with /v1/monitoring/requests/{id}."""
+    loop, base = server
+
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.post(base + "/v1/chat/completions", json={
+                "model": "default-chat", "stream": True,
+                "messages": [{"role": "user",
+                              "content": [{"type": "text", "text": "hi"}]}],
+                "max_tokens": 4,
+            }, headers={"x-request-id": "e2e-sse-rid"}) as r:
+                assert r.status == 200
+                assert r.headers.get("X-Request-Id") == "e2e-sse-rid"
+                await r.read()
+
+    loop.run_until_complete(go())
+    status, rec = req(server, "GET", "/v1/monitoring/requests/e2e-sse-rid")
+    assert status == 200 and rec["phase"] == "finished"
 
 
 def test_user_settings_crud(server):

@@ -437,6 +437,51 @@ def test_FP01_repo_catalog_and_call_sites_agree():
         "runtime", "gateway", "modkit", "modules"}
 
 
+# ---------------------------------------------------------------- TL family
+
+
+def test_TL01_direct_recorder_emit_in_runtime_fails():
+    bad = lint("from cyberfabric_core_tpu.modkit.flight_recorder import default_recorder\n"
+               "def loop(rid):\n"
+               "    default_recorder.record(rid, 'decode_chunk', tokens=8)\n",
+               tier="runtime", select=("TL01",))
+    assert rule_ids(bad) == ["TL01"] and bad[0].line == 3
+    assert "record_event" in bad[0].message
+
+
+def test_TL01_qualified_module_emit_fails():
+    bad = lint("from cyberfabric_core_tpu.modkit import flight_recorder\n"
+               "def loop(rid):\n"
+               "    flight_recorder.default_recorder.record(rid, 'finished')\n",
+               tier="runtime", select=("TL01",))
+    assert rule_ids(bad) == ["TL01"]
+
+
+def test_TL01_record_event_helper_passes():
+    ok = lint("from cyberfabric_core_tpu.modkit.flight_recorder import record_event\n"
+              "def loop(rid):\n"
+              "    record_event(rid, 'decode_chunk', tokens=8)\n",
+              tier="runtime", select=("TL01",))
+    assert ok == []
+
+
+def test_TL01_outside_runtime_passes():
+    # the monitoring module READS the recorder and may call methods directly
+    ok = lint("from cyberfabric_core_tpu.modkit.flight_recorder import default_recorder\n"
+              "def scrape(rid):\n"
+              "    default_recorder.record(rid, 'enqueued')\n",
+              tier="modules", select=("TL01",))
+    assert ok == []
+
+
+def test_TL01_repo_runtime_tier_clean():
+    """The gate: every flight-recorder emit under runtime/ goes through the
+    never-raises helper."""
+    engine = Engine(all_rules()).select(["TL01"])
+    findings = [f for f in engine.run(PKG) if not f.suppressed]
+    assert findings == [], [f.to_dict() for f in findings]
+
+
 # ------------------------------------------------------- waivers + baseline
 
 

@@ -246,7 +246,8 @@ def test_failover_increments_prometheus_counter():
         def stats(self):
             return {"broken": None, "active": 0, "pending": 0}
 
-        def submit(self, prompt_ids, sampling, emit, request_id=None):
+        def submit(self, prompt_ids, sampling, emit, request_id=None,
+                   trace=None):
             self.submitted.append(list(prompt_ids))
             return "rid"
 
