@@ -5,9 +5,9 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard
+.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard
 
-safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard  ## the full local gate
+safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
 
@@ -42,6 +42,13 @@ chaos:  ## faultlab: deterministic seeded chaos-scenario suite (every failpoint 
 trace-guard:  ## request observability: flight-recorder/telemetry tests + the tracing disabled-mode overhead A/B (BENCH_TRACE.json, <1% bar)
 	$(PY) -m pytest tests/test_flight_recorder.py tests/test_telemetry_export.py -q
 	$(PY) bench.py --trace-guard > /dev/null
+
+doctor:  ## fabric-doctor: SLO engine/watchdog/state-machine tests + the burn-rate and stall chaos scenarios
+	$(PY) -m pytest tests/test_doctor.py -q
+	$(PY) -m cyberfabric_core_tpu.apps.doctor --scenarios > /dev/null
+
+doctor-guard:  ## fabric-doctor armed-vs-stubbed overhead A/B under the aggregate workload (BENCH_DOCTOR.json, <1% bar)
+	$(PY) bench.py --doctor-guard > /dev/null
 
 test:  ## full suite
 	$(PY) -m pytest tests/ -q

@@ -697,130 +697,112 @@ def embed_bench() -> int:
         return 1
 
 
-def faultlab_guard() -> int:
-    """Disabled-mode overhead guard for the failpoint subsystem (faultlab).
+def _ab_guard(name: str, env_var: str, live_label: str, live_value: str,
+              stubbed_value: str, reps_var: str, out_file: str,
+              note: str) -> int:
+    """Shared subsystem-overhead A/B harness (faultlab / trace / doctor).
 
-    A/B: the --aggregate workload with the failpoint machinery LIVE but
-    disarmed (the production state) vs with the call sites stubbed to bare
-    no-ops (``BENCH_FAILPOINTS_OFF=1`` — the closest Python gets to
-    "compiled out"). Interleaved A/B/B/A child runs decorrelate host drift;
-    medians per arm. Evidence lands in BENCH_FAULTLAB.json with a pass flag
-    at the <1% tok/s bar (plus the run spread, so a noisy host reads as
-    noise, not as regression).
+    Runs the --aggregate workload in child processes with ``env_var`` set to
+    ``live_value`` (machinery on, the production state) vs ``stubbed_value``
+    (stubbed to no-ops — the compiled-out equivalent). Interleaved A/B/B/A
+    ordering decorrelates slow host drift; per-arm BEST run, because on a
+    shared host co-tenant contention only ever slows a run down, so the max
+    is the least-contaminated measurement of each arm (the CPU-canary
+    "agreeing pair" logic's cheaper cousin). Evidence lands in ``out_file``
+    with a pass flag at the <1% tok/s bar (plus the run spread, so a noisy
+    host reads as noise, not as regression).
     """
-    reps = int(os.environ.get("BENCH_FAULTLAB_REPS", "2"))
+    reps = int(os.environ.get(reps_var, "2"))
     env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_COST="0")
 
-    def one(off: str) -> float | None:
+    def one(value: str) -> float | None:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--aggregate",
              "tiny-llama", "none"],
             capture_output=True, text=True, timeout=900,
-            env=dict(env, BENCH_FAILPOINTS_OFF=off))
+            env=dict(env, **{env_var: value}))
         sys.stderr.write(proc.stderr[-2000:])
         try:
             return float(json.loads(
                 proc.stdout.strip().splitlines()[-1])["tokens_per_sec"])
         except Exception as e:  # noqa: BLE001
-            log(f"faultlab guard child failed: {e}")
+            log(f"{name} guard child failed: {e}")
             return None
 
-    arms: dict[str, list[float]] = {"disarmed": [], "stubbed": []}
-    # ABBA ordering, `reps` runs per arm, so slow host drift cancels
-    order = (["disarmed", "stubbed", "stubbed", "disarmed"]
+    arms: dict[str, list[float]] = {live_label: [], "stubbed": []}
+    order = ([live_label, "stubbed", "stubbed", live_label]
              * ((reps + 1) // 2))[: 2 * reps]
     for label in order:
-        v = one("0" if label == "disarmed" else "1")
+        v = one(live_value if label == live_label else stubbed_value)
         if v is not None:
             arms[label].append(v)
 
-    # per-arm BEST run: on a shared host, co-tenant contention only ever
-    # slows a run down, so the max is the least-contaminated measurement of
-    # each arm (the CPU-canary "agreeing pair" logic's cheaper cousin)
-    disarmed = max(arms["disarmed"], default=0.0)
+    live = max(arms[live_label], default=0.0)
     stubbed = max(arms["stubbed"], default=0.0)
-    delta_pct = ((stubbed - disarmed) / stubbed * 100.0) if stubbed else 0.0
+    delta_pct = ((stubbed - live) / stubbed * 100.0) if stubbed else 0.0
     spread = {k: (round(max(v) / max(1e-9, min(v)) - 1.0, 4) if v else None)
               for k, v in arms.items()}
     report = {
-        "note": ("failpoints disabled-mode overhead: --aggregate tok/s with "
-                 "the registry live-but-disarmed vs call sites stubbed to "
-                 "no-ops (compiled-out equivalent); interleaved ABBA runs, "
-                 "best run per arm (contention only slows runs down)"),
+        "note": note,
         "runs": arms,
-        "disarmed_tok_s": round(disarmed, 1),
+        f"{live_label}_tok_s": round(live, 1),
         "stubbed_tok_s": round(stubbed, 1),
         "overhead_pct": round(delta_pct, 3),
         "within_run_spread": spread,
-        "pass": bool(disarmed and stubbed and delta_pct < 1.0),
+        "pass": bool(live and stubbed and delta_pct < 1.0),
     }
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_FAULTLAB.json"), "w") as f:
+                           out_file), "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report))
     return 0 if report["pass"] else 1
+
+
+def faultlab_guard() -> int:
+    """Disabled-mode overhead guard for the failpoint subsystem: registry
+    LIVE but disarmed (the production state) vs call sites stubbed to bare
+    no-ops (``BENCH_FAILPOINTS_OFF=1`` — the closest Python gets to
+    "compiled out")."""
+    return _ab_guard(
+        "faultlab", "BENCH_FAILPOINTS_OFF", "disarmed", "0", "1",
+        "BENCH_FAULTLAB_REPS", "BENCH_FAULTLAB.json",
+        "failpoints disabled-mode overhead: --aggregate tok/s with "
+        "the registry live-but-disarmed vs call sites stubbed to "
+        "no-ops (compiled-out equivalent); interleaved ABBA runs, "
+        "best run per arm (contention only slows runs down)")
 
 
 def trace_guard() -> int:
-    """Disabled-mode overhead guard for request tracing + the flight recorder.
+    """Disabled-mode overhead guard for request tracing + the flight
+    recorder: tracing LIVE but every request carrying an UNSAMPLED
+    traceparent (the production steady state under a ratio sampler:
+    flight-recorder events recorded, span guard checked and skipped per
+    chunk) vs the machinery stubbed to no-ops (``BENCH_TRACE=off``)."""
+    return _ab_guard(
+        "trace", "BENCH_TRACE", "unsampled", "unsampled", "off",
+        "BENCH_TRACE_REPS", "BENCH_TRACE.json",
+        "request-tracing disabled-mode overhead: --aggregate tok/s "
+        "with the flight recorder live and every request carrying "
+        "an UNSAMPLED traceparent (span guard exercised per chunk) "
+        "vs record_event stubbed to a no-op and tracing disabled "
+        "(compiled-out equivalent); interleaved ABBA runs, best run "
+        "per arm (contention only slows runs down)")
 
-    A/B: the --aggregate workload with tracing LIVE but every request
-    carrying an UNSAMPLED traceparent (the production steady state under a
-    ratio sampler: flight-recorder events recorded, span guard checked and
-    skipped per chunk) vs the machinery stubbed to no-ops
-    (``BENCH_TRACE=off`` — the compiled-out equivalent). Same ABBA
-    interleave + best-run-per-arm policy as the faultlab guard. Evidence
-    lands in BENCH_TRACE.json with a pass flag at the <1% tok/s bar.
-    """
-    reps = int(os.environ.get("BENCH_TRACE_REPS", "2"))
-    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_COST="0")
 
-    def one(mode: str) -> float | None:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--aggregate",
-             "tiny-llama", "none"],
-            capture_output=True, text=True, timeout=900,
-            env=dict(env, BENCH_TRACE=mode))
-        sys.stderr.write(proc.stderr[-2000:])
-        try:
-            return float(json.loads(
-                proc.stdout.strip().splitlines()[-1])["tokens_per_sec"])
-        except Exception as e:  # noqa: BLE001
-            log(f"trace guard child failed: {e}")
-            return None
-
-    arms: dict[str, list[float]] = {"unsampled": [], "stubbed": []}
-    order = (["unsampled", "stubbed", "stubbed", "unsampled"]
-             * ((reps + 1) // 2))[: 2 * reps]
-    for label in order:
-        v = one("unsampled" if label == "unsampled" else "off")
-        if v is not None:
-            arms[label].append(v)
-
-    unsampled = max(arms["unsampled"], default=0.0)
-    stubbed = max(arms["stubbed"], default=0.0)
-    delta_pct = ((stubbed - unsampled) / stubbed * 100.0) if stubbed else 0.0
-    spread = {k: (round(max(v) / max(1e-9, min(v)) - 1.0, 4) if v else None)
-              for k, v in arms.items()}
-    report = {
-        "note": ("request-tracing disabled-mode overhead: --aggregate tok/s "
-                 "with the flight recorder live and every request carrying "
-                 "an UNSAMPLED traceparent (span guard exercised per chunk) "
-                 "vs record_event stubbed to a no-op and tracing disabled "
-                 "(compiled-out equivalent); interleaved ABBA runs, best run "
-                 "per arm (contention only slows runs down)"),
-        "runs": arms,
-        "unsampled_tok_s": round(unsampled, 1),
-        "stubbed_tok_s": round(stubbed, 1),
-        "overhead_pct": round(delta_pct, 3),
-        "within_run_spread": spread,
-        "pass": bool(unsampled and stubbed and delta_pct < 1.0),
-    }
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_TRACE.json"), "w") as f:
-        json.dump(report, f, indent=1)
-    print(json.dumps(report))
-    return 0 if report["pass"] else 1
+def doctor_guard() -> int:
+    """Armed-mode overhead guard for the fabric-doctor: SLO evaluators +
+    watchdogs ARMED on a 0.25s cadence (recorder listener attached, all four
+    objectives + all three watchdogs — 4x the 1s production rate) vs the
+    doctor stubbed out entirely (``BENCH_DOCTOR=off``, the pre-doctor
+    baseline)."""
+    return _ab_guard(
+        "doctor", "BENCH_DOCTOR", "armed", "on", "off",
+        "BENCH_DOCTOR_REPS", "BENCH_DOCTOR.json",
+        "fabric-doctor armed-mode overhead: --aggregate tok/s with "
+        "the SLO evaluators + watchdogs live on a 0.25s cadence "
+        "(4x the production rate) vs the doctor stubbed out "
+        "entirely; interleaved ABBA runs, best run per arm "
+        "(contention only slows runs down)")
 
 
 def aggregate(model_name: str, quant: str) -> int:
@@ -878,6 +860,19 @@ def aggregate(model_name: str, quant: str) -> int:
                            prefix_page_size=64,
                            decode_lookahead=lookahead)
         sched = ContinuousBatchingEngine(cfg, seed=0)
+        #: doctor-guard A/B arm (BENCH_DOCTOR.json): "on" arms the fabric-
+        #: doctor against this engine — recorder listener ingesting every
+        #: terminal, all four SLO objectives + all three watchdogs on a
+        #: 0.25s cadence (4x the production default). "off"/unset = the
+        #: pre-doctor baseline (nothing attached, nothing started).
+        if os.environ.get("BENCH_DOCTOR") == "on":
+            from cyberfabric_core_tpu.modkit.doctor import (DoctorConfig,
+                                                            default_doctor)
+
+            default_doctor.configure(DoctorConfig(eval_interval_s=0.25))
+            default_doctor.set_scheduler_provider(
+                lambda: [(model_name, sched)])
+            default_doctor.ensure_started()
         rng = np.random.default_rng(1)
         n_req, gen = slots, 192
         done = threading.Event()
@@ -1311,6 +1306,8 @@ if __name__ == "__main__":
         sys.exit(single(sys.argv[2], sys.argv[3]))
     if len(sys.argv) > 3 and sys.argv[1] == "--aggregate":
         sys.exit(aggregate(sys.argv[2], sys.argv[3]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--doctor-guard":
+        sys.exit(doctor_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--faultlab-guard":
         sys.exit(faultlab_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--trace-guard":

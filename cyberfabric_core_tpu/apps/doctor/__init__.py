@@ -1,0 +1,12 @@
+"""fabric-doctor CLI — probe a live server's health surfaces, or run the
+doctor chaos scenarios locally.
+
+The evaluation engine itself lives in ``modkit/doctor.py`` (SLO burn rates,
+stall watchdogs, degradation state machine); this package is the operator
+tool that reads it back: ``/healthz`` (liveness), ``/readyz`` (readiness),
+and the guarded ``/v1/monitoring/slo`` (objective table + state history).
+"""
+
+from .__main__ import main
+
+__all__ = ["main"]

@@ -1,0 +1,132 @@
+"""fabric-doctor CLI.
+
+Usage:
+    python -m cyberfabric_core_tpu.apps.doctor --base http://HOST:8086
+    python -m cyberfabric_core_tpu.apps.doctor --base ... --token BEARER
+    python -m cyberfabric_core_tpu.apps.doctor --base ... --watch 2
+    python -m cyberfabric_core_tpu.apps.doctor --scenarios   # local chaos
+
+Probe mode fetches /healthz, /readyz and (with auth, or auth-disabled
+deployments) /v1/monitoring/slo, prints one JSON health document, and exits
+with a state-shaped code:
+
+    0  live + ready (healthy/degraded/recovering)
+    1  live but NOT ready (shedding)
+    2  liveness failed or the server is unreachable
+
+``--watch N`` repeats every N seconds until interrupted (a poor man's
+`kubectl get -w` for the degradation state machine). ``--scenarios`` runs
+the two doctor faultlab scenarios (slo-burn-shed-recover,
+stream-stall-watchdog) in-process — the `make doctor` leg.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def _get(base: str, path: str, token: str | None,
+         timeout: float = 10.0) -> tuple[int | None, dict]:
+    req = urllib.request.Request(base.rstrip("/") + path)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            return e.code, {}
+    except Exception as e:  # noqa: BLE001 — unreachable/timeout/refused
+        return None, {"error": str(e)[:200]}
+
+
+def probe(base: str, token: str | None) -> tuple[int, dict]:
+    """One probe pass → (exit_code, document)."""
+    live_status, live = _get(base, "/healthz", token)
+    ready_status, ready = _get(base, "/readyz", token)
+    slo_status, slo = _get(base, "/v1/monitoring/slo", token)
+    # http_status is its own key: the body carries a "status" of its own
+    # ("ok"/"ready") which must not mask the code the exit status derives from
+    doc = {
+        "base": base,
+        "liveness": {"http_status": live_status, **live},
+        "readiness": {"http_status": ready_status, **ready},
+    }
+    if slo_status == 200:
+        doc["slo"] = {
+            "state": slo.get("state"),
+            "watchdog_trips": slo.get("watchdog_trips"),
+            "objectives": [
+                {k: row.get(k) for k in ("name", "verdict", "burn_fast",
+                                         "burn_slow", "samples_fast")}
+                for row in (slo.get("last_eval") or {}).get("objectives", [])
+            ],
+            "state_history": slo.get("state_history", [])[-5:],
+        }
+    else:
+        doc["slo"] = {"http_status": slo_status,
+                      "note": "guarded endpoint; pass --token or enable "
+                              "auth_disabled to read the objective table"}
+    if live_status != 200:
+        return 2, doc
+    if ready_status != 200:
+        return 1, doc
+    return 0, doc
+
+
+def run_scenarios() -> int:
+    """The `make doctor` leg: both doctor chaos scenarios, verdicts green
+    (delegates to the faultlab runner — same seeds, same fingerprints)."""
+    from ..faultlab.runner import run_scenario
+    from ..faultlab.scenarios import scenario_by_name
+
+    ok = True
+    results = []
+    for name in ("slo-burn-shed-recover", "stream-stall-watchdog"):
+        result = run_scenario(scenario_by_name(name))
+        results.append(result.to_dict())
+        ok = ok and result.verdict
+    print(json.dumps({"pass": ok, "scenarios": results}, indent=1))
+    return 0 if ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="doctor")
+    ap.add_argument("--base", help="server base URL, e.g. http://host:8086")
+    ap.add_argument("--token", help="bearer token for /v1/monitoring/slo")
+    ap.add_argument("--watch", type=float, metavar="SECONDS",
+                    help="repeat the probe every N seconds")
+    ap.add_argument("--scenarios", action="store_true",
+                    help="run the doctor faultlab scenarios locally")
+    args = ap.parse_args(argv)
+
+    if args.scenarios:
+        # CPU pinning before any jax-touching import (the faultlab pattern)
+        import os
+
+        if not os.environ.get("RUN_TPU_TESTS"):
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        return run_scenarios()
+
+    if not args.base:
+        ap.error("--base is required (or use --scenarios)")
+    while True:
+        code, doc = probe(args.base, args.token)
+        print(json.dumps(doc, indent=1), flush=True)
+        if not args.watch:
+            return code
+        time.sleep(args.watch)  # fabric-lint: waive AS01 reason=interactive CLI polling loop; no event loop in this process
+
+
+if __name__ == "__main__":
+    sys.exit(main())

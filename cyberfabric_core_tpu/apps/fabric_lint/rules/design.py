@@ -296,7 +296,8 @@ class DE08(Rule):
                    "slash, lowercase segments, {snake_case} params")
     node_types = (ast.Call,)
 
-    _INFRA = {"/metrics", "/health", "/healthz", "/openapi.json", "/docs"}
+    _INFRA = {"/metrics", "/health", "/healthz", "/readyz",
+              "/openapi.json", "/docs"}
     _VERBS = {"GET", "POST", "PUT", "PATCH", "DELETE"}
     _SEG = re.compile(r"^(?:[a-z0-9][a-z0-9_\-.]*|\{[a-z][a-z0-9_]*\})$")
 

@@ -1,0 +1,119 @@
+"""WD — doctor evaluator discipline.
+
+WD01: the fabric-doctor's evaluator and watchdog callbacks (``evaluate*`` /
+``on_record`` / ``ingest*`` / ``_check_*`` methods of classes named
+``*Doctor*`` / ``*Watchdog*``) must be **non-blocking** and must route every
+emit through a **never-raises helper** — mirroring TL01 for the flight
+recorder and the ``bump_counter`` pattern for metrics.
+
+The evaluation pass runs on a fixed cadence on a dedicated thread and is the
+thing that DECLARES the server unhealthy: if it can block (network, DB,
+subprocess, ``time.sleep``, a device sync) it can itself stall — a health
+monitor that hangs exactly when the host is struggling reports "healthy"
+forever; if an emit can raise (direct ``recorder.record``, direct
+``counter().inc``), an observability bug silently kills the loop that feeds
+/readyz. ``await`` is banned outright: the evaluator contract is sync
+(asyncio integration goes through the heartbeat/readiness surfaces, never
+into the evaluator).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import FileContext, Finding, Rule, dotted_name, register
+
+#: exact dotted calls that block the evaluator thread
+_BLOCKING_EXACT = frozenset({
+    "time.sleep", "jax.block_until_ready", "jax.device_get",
+    "np.asarray", "numpy.asarray", "jnp.asarray",
+})
+#: module prefixes whose calls do network/disk/process work
+_BLOCKING_PREFIXES = ("socket.", "requests.", "urllib.", "subprocess.",
+                      "sqlite3.", "http.client.")
+
+#: method names that directly mutate a metric object (the RMW surface that
+#: must go through bump_counter-style helpers)
+_METRIC_RMW = frozenset({"inc", "observe", "set"})
+_METRIC_FACTORIES = frozenset({"counter", "histogram", "gauge"})
+
+_CALLBACK_PREFIXES = ("evaluate", "_evaluate", "on_record", "ingest",
+                      "_check_")
+
+
+def _is_doctor_class(node: ast.ClassDef) -> bool:
+    return "Doctor" in node.name or "Watchdog" in node.name
+
+
+def _is_callback(fn: ast.AST) -> bool:
+    return isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+        fn.name.startswith(_CALLBACK_PREFIXES)
+
+
+@register
+class WD01(Rule):
+    id = "WD01"
+    family = "WD"
+    severity = "error"
+    description = ("doctor evaluator/watchdog callbacks are non-blocking "
+                   "and emit through never-raises helpers")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef) or not _is_doctor_class(cls):
+                continue
+            for fn in cls.body:
+                if not _is_callback(fn):
+                    continue
+                yield from self._check_callback(ctx, fn)
+
+    def _check_callback(self, ctx: FileContext,
+                        fn: ast.AST) -> Iterable[Finding]:
+        where = f"doctor callback `{fn.name}`"
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Await):
+                yield self.finding_in(
+                    ctx, node,
+                    f"`await` inside {where} — the evaluator contract is "
+                    "synchronous and non-blocking; awaiting network/db "
+                    "work here stalls the health loop exactly when the "
+                    "host is struggling")
+            elif isinstance(node, ast.Call):
+                yield from self._check_call(ctx, node, where)
+
+    def _check_call(self, ctx: FileContext, node: ast.Call,
+                    where: str) -> Iterable[Finding]:
+        dotted = dotted_name(node.func)
+        if dotted in _BLOCKING_EXACT or \
+                dotted.startswith(_BLOCKING_PREFIXES):
+            yield self.finding_in(
+                ctx, node,
+                f"blocking call `{dotted}(...)` inside {where} — a health "
+                "evaluator that can block reports 'healthy' forever while "
+                "it hangs; move the work off the evaluation pass")
+            return
+        if not isinstance(node.func, ast.Attribute):
+            return
+        attr = node.func.attr
+        recv = node.func.value
+        if attr == "record":
+            base = dotted_name(recv)
+            if base.rsplit(".", 1)[-1].endswith("recorder") or \
+                    "flight_recorder" in base:
+                yield self.finding_in(
+                    ctx, node,
+                    f"direct flight-recorder emit `{base}.record(...)` "
+                    f"inside {where} — use the never-raises "
+                    "`record_event(...)` helper (or the doctor's "
+                    "`_emit_stalled`), so an observability failure cannot "
+                    "kill the health loop (TL01's discipline)")
+        elif attr in _METRIC_RMW and isinstance(recv, ast.Call) and \
+                isinstance(recv.func, ast.Attribute) and \
+                recv.func.attr in _METRIC_FACTORIES:
+            yield self.finding_in(
+                ctx, node,
+                f"direct metric mutate `...{recv.func.attr}(...)"
+                f".{attr}(...)` inside {where} — use the never-raises "
+                "`bump_counter`/`_gauge_set` helpers (the bump_counter "
+                "pattern), so a registry error cannot kill the health loop")

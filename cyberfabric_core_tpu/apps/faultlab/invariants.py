@@ -151,6 +151,36 @@ def check_pool_clean(evidence: dict) -> list[str]:
     return problems
 
 
+def check_state_sequence(evidence: dict) -> list[str]:
+    """The doctor's degradation state machine visited the expected states in
+    order (default: the full healthy → degraded → shedding → recovering →
+    healthy cycle). Extra intermediate entries are allowed — only the ORDER
+    is the contract (hysteresis may bounce degraded↔healthy at the edges of
+    a window)."""
+    seq = list(evidence["state_sequence"])
+    expect = list(evidence.get("expect_state_sequence") or
+                  ["healthy", "degraded", "shedding", "recovering", "healthy"])
+    it = iter(seq)
+    missing = [want for want in expect
+               if not any(got == want for got in it)]
+    if missing:
+        return [f"state sequence {seq} is missing {missing} "
+                f"(expected subsequence {expect})"]
+    return []
+
+
+def check_watchdogs_tripped(evidence: dict) -> list[str]:
+    """Every watchdog the scenario targets tripped at least once (counter
+    evidence comes from the scenario's own Doctor instance)."""
+    trips = evidence["watchdog_trips"]
+    problems = []
+    for name in evidence.get("expect_watchdogs", ()):
+        if not trips.get(name):
+            problems.append(f"watchdog {name!r} never tripped "
+                            f"(trips={trips})")
+    return problems
+
+
 def check_breaker_recovered(evidence: dict) -> list[str]:
     """The breaker must have OPENED under the injected upstream faults and
     then RECOVERED to closed once the faults stopped."""
@@ -170,6 +200,8 @@ CHECKERS: dict[str, Callable[[dict], list[str]]] = {
     "engine_accounting": check_engine_accounting,
     "pool_clean": check_pool_clean,
     "breaker_recovered": check_breaker_recovered,
+    "state_sequence": check_state_sequence,
+    "watchdogs_tripped": check_watchdogs_tripped,
 }
 
 

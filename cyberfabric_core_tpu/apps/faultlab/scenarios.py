@@ -209,6 +209,39 @@ BUILTIN_SCENARIOS: list[dict[str, Any]] = [
         "kind": "grpc_evict",
         "seed": 405,
     },
+    # ---- fabric-doctor (SLO engine + watchdogs + degradation machine) --
+    {
+        # delay on every decode readback (armed over the guarded REST
+        # control plane against a REAL gateway+llm stack) blows the itl
+        # burn rate: /readyz flips 200→503→200 through the full healthy →
+        # degraded → shedding → recovering → healthy cycle, shedding 429s
+        # NEW requests pre-enqueue (Retry-After), and streams already in
+        # flight finish bit-identically to the unfaulted baseline
+        "name": "slo-burn-shed-recover",
+        "kind": "slo_burn",
+        "seed": 501,
+        "delay_spec": "delay(0.5)",   # ≈62 ms/token ≫ the 30 ms objective
+        "itl_threshold_ms": 30.0,
+    },
+    {
+        # same seed/engine/load as admit-delay so the cached unfaulted
+        # baseline is shared; a 0.35 s delay per readback makes every round
+        # glacial without changing a token — all three stall watchdogs
+        # (scheduler_round / stream_stall / queue_age) must trip, stalled
+        # streams must be marked in the flight recorder's live table, and
+        # the state machine must walk back to healthy after the drain
+        "name": "stream-stall-watchdog",
+        "kind": "stall",
+        "seed": 103,
+        "engine": _TINY,
+        "load": _LOAD,
+        "faults": [{"point": "scheduler.readback", "spec": "delay(0.35)"}],
+        "invariants": ["exactly_one_terminal", "streams_match_baseline",
+                       "engine_accounting", "state_sequence",
+                       "watchdogs_tripped"],
+        "expect_watchdogs": ["scheduler_round", "stream_stall", "queue_age"],
+        "expect_state_sequence": ["healthy", "degraded", "healthy"],
+    },
 ]
 
 
@@ -250,4 +283,6 @@ def covered_points(specs: list[dict[str, Any]] | None = None) -> set[str]:
             out.add("llm_gateway.worker_stream")
         if spec.get("kind") == "grpc_evict":
             out.add("grpc_hub.evict")
+        if spec.get("kind") == "slo_burn":
+            out.add("scheduler.readback")  # armed over REST, not via faults
     return out

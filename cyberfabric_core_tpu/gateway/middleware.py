@@ -45,9 +45,12 @@ from .router import AuthPolicy, OperationSpec, RateLimitSpec
 
 REQUEST_ID_HEADER = "x-request-id"
 #: endpoints served by the gateway itself, always public (module.rs /docs,
-#: /openapi.json, /health, /healthz). Source of truth for the auth surface:
-#: module.py asserts its builtin registrations match this set exactly.
-BUILTIN_PUBLIC_PATHS = frozenset({"/health", "/healthz", "/openapi.json", "/docs"})
+#: /openapi.json, /health, /healthz; /readyz is the doctor's readiness
+#: surface — load balancers probe it unauthenticated). Source of truth for
+#: the auth surface: module.py asserts its builtin registrations match this
+#: set exactly.
+BUILTIN_PUBLIC_PATHS = frozenset({"/health", "/healthz", "/readyz",
+                                  "/openapi.json", "/docs"})
 SPEC_KEY = web.AppKey("operation_spec", object)
 SECURITY_CONTEXT_KEY = "security_context"
 REQUEST_ID_KEY = "request_id"

@@ -149,6 +149,7 @@ class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemC
             "/openapi.json": self._serve_openapi,
             "/health": self._serve_health,
             "/healthz": self._serve_healthz,
+            "/readyz": self._serve_readyz,
             "/docs": self._serve_docs,
         }
         # BUILTIN_PUBLIC_PATHS is the source of truth for which paths may run
@@ -175,7 +176,35 @@ class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemC
         return web.json_response(detail)
 
     async def _serve_healthz(self, request: web.Request) -> web.Response:
-        return web.Response(text="ok")
+        # LIVENESS (fabric-doctor): the process is up and the asyncio loop
+        # still schedules. The lag is read BEFORE touching the heartbeat so
+        # the gap since the last heartbeat-task tick stays visible in the
+        # document (and can 503 when the task died or the loop was wedged
+        # past loop_stall_s); serving this request then counts as fresh
+        # loop-liveness evidence for the next probe, so a single stale
+        # probe self-heals rather than flapping. Orthogonal to /readyz — a
+        # shedding server is still LIVE; restarting it would only lose the
+        # in-flight streams it is protecting.
+        from ..modkit.doctor import default_doctor
+
+        live, detail = default_doctor.liveness()
+        default_doctor.touch_event_loop()
+        return web.json_response(detail, status=200 if live else 503)
+
+    async def _serve_readyz(self, request: web.Request) -> web.Response:
+        # READINESS (fabric-doctor): 503 + the violated objectives/tripped
+        # watchdogs while the degradation state machine says ``shedding`` —
+        # the load-balancer signal to route around this replica. degraded/
+        # recovering stay 200: a slow replica beats a mass eviction.
+        from ..modkit.doctor import default_doctor
+        from ..modkit.errcat import ERR
+
+        ready, state, reasons = default_doctor.readiness()
+        if not ready:
+            raise ERR.monitoring.not_ready.error(
+                f"serving state is {state!r}", state=state, reasons=reasons)
+        return web.json_response(
+            {"status": "ready", "state": state, "reasons": reasons})
 
     async def _serve_docs(self, request: web.Request) -> web.Response:
         # offline-friendly minimal docs page (reference embeds UI assets)
@@ -207,9 +236,27 @@ class ApiGatewayModule(Module, ApiGatewayCapability, RunnableCapability, SystemC
         server = self._site._server  # noqa: SLF001 — aiohttp exposes no public accessor
         if server and server.sockets:
             self.bound_port = server.sockets[0].getsockname()[1]
+        # event-loop heartbeat: /healthz liveness reads the age of the last
+        # touch — a wedged loop (sync handler gone rogue, executor deadlock)
+        # shows up as a stale heartbeat even before requests visibly hang
+        from ..modkit.doctor import default_doctor
+        from ..modkit.logging_host import observe_task
+
+        async def _heartbeat() -> None:
+            while True:
+                default_doctor.touch_event_loop()
+                await asyncio.sleep(1.0)
+
+        self._hb_task = observe_task(asyncio.ensure_future(_heartbeat()),
+                                     "api_gateway.loop_heartbeat",
+                                     logger="gateway")
         ready.notify_ready()
 
     async def stop(self, ctx: ModuleCtx) -> None:
+        hb = getattr(self, "_hb_task", None)
+        if hb is not None:
+            hb.cancel()
+            self._hb_task = None
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None

@@ -41,8 +41,8 @@ import time
 from collections import OrderedDict
 from typing import Any, Optional
 
-__all__ = ["FlightRecorder", "RequestRecord", "default_recorder",
-           "record_event"]
+__all__ = ["FlightRecorder", "RequestRecord", "annotate_request",
+           "default_recorder", "record_event"]
 
 #: event kinds → the phase a request is in after the event
 _PHASE_AFTER = {
@@ -57,7 +57,15 @@ _PHASE_AFTER = {
     "finished": "finished",
     "error": "error",
     "evicted": "evicted",
+    #: a watchdog marked the stream stalled (doctor); the next progress
+    #: event (decode_chunk/resumed/…) clears the phase back
+    "stalled": "stalled",
 }
+
+#: events that prove the stream is moving again — they clear a watchdog's
+#: ``stalled`` mark (and phase) so the live table reflects recovery
+_PROGRESS = frozenset({"admitted", "prefill", "first_token", "decode_chunk",
+                       "resumed", "finished"})
 
 _TERMINAL = frozenset({"finished", "error", "evicted"})
 
@@ -67,7 +75,7 @@ class RequestRecord:
 
     __slots__ = ("request_id", "trace_id", "created_at", "phase", "slot",
                  "tokens", "prompt_tokens", "events", "_dropped",
-                 "finished_at")
+                 "finished_at", "model", "stalled", "last_event_at")
 
     def __init__(self, request_id: str) -> None:
         self.request_id = request_id
@@ -80,6 +88,9 @@ class RequestRecord:
         self.events: list[tuple[float, str, dict]] = []
         self._dropped = 0  # mid-timeline events dropped by the per-record cap
         self.finished_at: Optional[float] = None
+        self.model: Optional[str] = None  # set by annotate() at the worker
+        self.stalled = False  # a stall watchdog flagged this stream
+        self.last_event_at = self.created_at
 
     # ------------------------------------------------------------- derived
     def _first(self, kind: str) -> Optional[float]:
@@ -118,12 +129,16 @@ class RequestRecord:
 
     def summary(self) -> dict[str, Any]:
         """One row of the live in-flight table."""
+        now = time.time()
         return {
             "request_id": self.request_id,
             "trace_id": self.trace_id,
+            "model": self.model,
             "phase": self.phase,
             "slot": self.slot,
-            "age_s": round(time.time() - self.created_at, 3),
+            "age_s": round(now - self.created_at, 3),
+            "last_event_age_s": round(now - self.last_event_at, 3),
+            "stalled": self.stalled,
             "tokens": self.tokens,
             "prompt_tokens": self.prompt_tokens,
             "events": len(self.events) + self._dropped,
@@ -161,6 +176,9 @@ class FlightRecorder:
         self._live: "OrderedDict[str, RequestRecord]" = OrderedDict()
         self._finished: "OrderedDict[str, RequestRecord]" = OrderedDict()
         self.evicted_live = 0  # live records force-closed by the bound
+        #: terminal-event subscribers (the doctor's SLO sample feed) —
+        #: called OUTSIDE the lock, each wrapped never-raises
+        self._listeners: list = []
 
     # -------------------------------------------------------------- record
     def record(self, request_id: str, kind: str, **attrs: Any) -> None:
@@ -170,6 +188,14 @@ class FlightRecorder:
         now = time.time()
         with self._lock:
             rec = self._live.get(request_id)
+            if rec is None and kind == "stalled":
+                # A watchdog annotation racing a terminal: the stream
+                # finished between the doctor's inflight() snapshot and this
+                # emit. Creating a record here would leave a phase='stalled'
+                # ghost nothing ever closes — which reads as a permanent
+                # stall and pins the state machine degraded. Stalled marks
+                # go on LIVE records only.
+                return
             if rec is None:
                 closed = self._finished.get(request_id)
                 if closed is not None and kind in _TERMINAL:
@@ -197,6 +223,11 @@ class FlightRecorder:
             self._append(rec, now, kind, attrs)
             # denormalized columns the live table sorts/filters on
             rec.phase = _PHASE_AFTER.get(kind, rec.phase)
+            rec.last_event_at = now
+            if kind == "stalled":
+                rec.stalled = True
+            elif kind in _PROGRESS:
+                rec.stalled = False  # the stream moved again
             if "slot" in attrs:
                 rec.slot = attrs["slot"]
             if "trace_id" in attrs and attrs["trace_id"]:
@@ -207,15 +238,35 @@ class FlightRecorder:
                 rec.tokens += 1
             elif kind == "decode_chunk":
                 rec.tokens += int(attrs.get("tokens", 1))
+            payload = None
             if kind in _TERMINAL:
                 self._live.pop(request_id, None)
                 self._close(rec, now, None, None)
+                # snapshot the derived figures UNDER the lock: a failover
+                # reopen on another thread may start appending to this very
+                # record's events list the moment we release it
+                derived = rec.derived()
+                if self._listeners:
+                    payload = {
+                        "request_id": rec.request_id, "kind": kind,
+                        "model": rec.model, "tokens": rec.tokens,
+                        "prompt_tokens": rec.prompt_tokens,
+                        "derived": derived,
+                    }
         # only CLEAN completions feed the latency histograms: an 'error'
         # terminal may be followed by a failover reopen (same derived values
         # would be observed twice), and failed/evicted requests would skew
         # the percentiles exactly when dashboards matter most
         if kind == "finished":
-            self._observe_histograms(rec)
+            self._observe_histograms(derived)
+        if payload is not None:
+            # listener CALLS stay outside the lock — observers must not be
+            # able to deadlock or slow the serving path's next record()
+            for listener in list(self._listeners):
+                try:
+                    listener(payload)
+                except Exception:  # noqa: BLE001 — observers never fail serving
+                    pass
 
     def _append(self, rec: RequestRecord, now: float, kind: str,
                 attrs: dict) -> None:
@@ -237,15 +288,15 @@ class FlightRecorder:
         while len(self._finished) > self.max_finished:
             self._finished.popitem(last=False)
 
-    def _observe_histograms(self, rec: RequestRecord) -> None:
+    def _observe_histograms(self, d: dict) -> None:
         """Terminal event → feed the Prometheus latency histograms from the
-        timeline itself. TTFT is observed by the llm_gateway at first chunk
-        (labeled by model, derived from THIS record's timeline when managed)
-        — observing it here too would double-count the series."""
+        derived figures (snapshotted under the record lock). TTFT is observed
+        by the llm_gateway at first chunk (labeled by model, derived from
+        THIS record's timeline when managed) — observing it here too would
+        double-count the series."""
         try:
             from .metrics import default_registry
 
-            d = rec.derived()
             if d["queue_wait_ms"] is not None:
                 default_registry.histogram(
                     "llm_queue_wait_seconds",
@@ -260,6 +311,33 @@ class FlightRecorder:
         except Exception:  # noqa: BLE001 — telemetry must never fail serving
             pass
 
+    # ----------------------------------------------------------- observers
+    def add_listener(self, fn) -> None:
+        """Subscribe to terminal events: ``fn(payload)`` with request_id,
+        kind, model, tokens, and the derived figures. Idempotent."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def annotate(self, request_id: str, model: Optional[str] = None) -> None:
+        """Set denormalized columns on an EXISTING record (live or recently
+        finished) without appending an event. The worker stamps the model
+        here after submit — the scheduler, which emits the lifecycle
+        events, does not know which model entry owns it. A miss is a no-op:
+        annotation must never create a record the scheduler will not
+        close."""
+        with self._lock:
+            rec = self._live.get(request_id) or self._finished.get(request_id)
+            if rec is None:
+                return
+            if model is not None:
+                rec.model = model
+
     # --------------------------------------------------------------- reads
     def is_live(self, request_id: str) -> bool:
         """True while a record with this id is in flight — admission layers
@@ -267,9 +345,13 @@ class FlightRecorder:
         with self._lock:
             return request_id in self._live
 
-    def inflight(self) -> list[dict[str, Any]]:
+    def inflight(self, stalled_only: bool = False) -> list[dict[str, Any]]:
+        """Live-table rows; ``stalled_only`` filters to streams a stall
+        watchdog flagged (the ``?stalled=true`` triage view)."""
         with self._lock:
-            return [rec.summary() for rec in self._live.values()]
+            recs = [rec for rec in self._live.values()
+                    if not stalled_only or rec.stalled]
+            return [rec.summary() for rec in recs]
 
     def lookup(self, request_id: str) -> Optional[dict[str, Any]]:
         with self._lock:
@@ -311,5 +393,14 @@ def record_event(request_id: str, kind: str, **attrs: Any) -> None:
     ``bump_counter`` for metrics."""
     try:
         default_recorder.record(request_id, kind, **attrs)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def annotate_request(request_id: str, model: Optional[str] = None) -> None:
+    """Never-raises :meth:`FlightRecorder.annotate` on the default recorder
+    (the worker's model stamp sits on the serving path)."""
+    try:
+        default_recorder.annotate(request_id, model=model)
     except Exception:  # noqa: BLE001
         pass

@@ -256,6 +256,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         self._video_poll_interval_s = 2.0
         self._video_poll_timeout_s = 120.0
         self._external = None
+        self._doctor = None  # hub-resolved lazily (fabric-doctor admission)
         self._db = None
         self._job_tasks: set[asyncio.Task] = set()
 
@@ -534,9 +535,37 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             ttft_s, model=model.canonical_id)
 
     # ------------------------------------------------------------- REST handlers
+    def _get_doctor(self):
+        """The fabric-doctor, hub-resolved (the monitoring module registers
+        it; it may init after this module — no dep ordering, the oagw
+        pattern). Stacks that never boot monitoring have no doctor and
+        therefore never shed — admission policy belongs to deployments that
+        actually run the evaluator."""
+        if getattr(self, "_doctor", None) is None and \
+                getattr(self, "_hub", None) is not None:
+            from ..sdk import DoctorApi
+
+            self._doctor = self._hub.try_get(DoctorApi)
+        return getattr(self, "_doctor", None)
+
+    def _check_load_shed(self) -> None:
+        """fabric-doctor admission gate: while the degradation state machine
+        is ``shedding``, reject BEFORE enqueue with 429 + Retry-After (the
+        scheduler_saturated problem-response path renders the header from
+        ``retry_after_s``). Pre-enqueue is the point: streams already in
+        flight keep decoding untouched; only NEW work is turned away while
+        the burn subsides."""
+        doctor = self._get_doctor()
+        retry_after = doctor.shed_retry_after() if doctor is not None else None
+        if retry_after is not None:
+            raise ERR.llm.load_shed.error(
+                "serving is load-shedding (SLO burn/stall watchdogs); "
+                "retry later", retry_after_s=retry_after, state="shedding")
+
     async def handle_chat(self, request: web.Request):
         body = await read_json(request, schemas.REQUEST)
         ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
+        self._check_load_shed()
         self.usage.check_budget(ctx)
         # pre_call hook: allow / block / override (DESIGN.md:743-766)
         hook = self._hub.try_get(LlmHookApi)
@@ -575,6 +604,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         chat path's budget/fallback/timeout/SSE machinery."""
         body = await read_json(request, schemas.COMPLETION_REQUEST)
         ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
+        self._check_load_shed()
         self.usage.check_budget(ctx)
         # same pre_call policy hook as chat (DESIGN.md:743-766) — a raw
         # prompt must not bypass content moderation
@@ -992,6 +1022,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             event_id = frame.get("id") or f"rt-{uuid.uuid4().hex[:12]}"
             try:
                 validate_against(schemas.REQUEST, body)
+                self._check_load_shed()
                 self.usage.check_budget(ctx)
                 models = await self._resolve_with_fallback(ctx, body)
                 _, model = models[0]

@@ -436,6 +436,13 @@ class LocalTpuWorker(LlmWorkerApi):
                 # e.g. seed on the dense scheduler: a client-fixable request
                 # shape, not a server fault
                 raise ERR.llm.unsupported_param.error(str(e))
+            # stamp the owning model onto the flight record (the scheduler
+            # emits the lifecycle events but does not know which registry
+            # entry owns it) — the doctor's per-model SLO overrides and the
+            # live table's model column read this
+            from ...modkit.flight_recorder import annotate_request
+
+            annotate_request(request_id, model=model.canonical_id)
         else:
             assert entry.batcher is not None
             await entry.batcher.submit(req)
@@ -581,6 +588,12 @@ class LocalTpuWorker(LlmWorkerApi):
         return entry
 
     # ------------------------------------------------------------------ health
+    def schedulers(self) -> list[tuple[str, Any]]:
+        # snapshot: called from the doctor's evaluation thread while the
+        # event loop may be admitting/evicting entries
+        return [(name, e.scheduler) for name, e in list(self._entries.items())
+                if e.scheduler is not None]
+
     async def health(self) -> dict[str, Any]:
         import jax
 

@@ -11,8 +11,9 @@ from __future__ import annotations
 from aiohttp import web
 
 from ..modkit import Module, module
-from ..modkit.contracts import RestApiCapability
+from ..modkit.contracts import RestApiCapability, RunnableCapability
 from ..modkit.context import ModuleCtx
+from ..modkit.lifecycle import ReadySignal
 from ..modkit.metrics import MetricsRegistry, default_registry
 from ..gateway.validation import read_json
 from .sdk import LlmWorkerApi
@@ -62,8 +63,8 @@ def _chrome_trace(per_model: dict[str, list[dict]]) -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
-@module(name="monitoring", capabilities=["rest"])
-class MonitoringModule(Module, RestApiCapability):
+@module(name="monitoring", capabilities=["rest", "stateful"])
+class MonitoringModule(Module, RestApiCapability, RunnableCapability):
     def __init__(self) -> None:
         self.registry = default_registry
         self._profile_dir = None
@@ -79,6 +80,47 @@ class MonitoringModule(Module, RestApiCapability):
         #: production configs leave it off and the arming endpoints 403
         self._allow_fault_injection = bool(
             ctx.raw_config().get("allow_fault_injection", False))
+
+        # fabric-doctor: configure the process-global health evaluator from
+        # `monitoring.doctor` (objectives/windows/watchdog knobs), point its
+        # watchdogs at the live scheduler pool, and start the evaluation
+        # thread. configure() resets the state machine — every boot starts
+        # healthy.
+        from ..modkit.doctor import DoctorConfig, default_doctor
+        from .sdk import DoctorApi
+
+        doctor_cfg = DoctorConfig.from_config(
+            ctx.raw_config().get("doctor", {}))
+        default_doctor.configure(doctor_cfg)
+        # hub-registered under the SDK contract so the llm-gateway admission
+        # layer sheds only in stacks that actually run the evaluator
+        # (contract-typed resolution, the MetricsRegistry pattern)
+        ctx.client_hub.register(DoctorApi, default_doctor)
+
+        def _doctor_schedulers():
+            worker = hub.try_get(LlmWorkerApi)
+            return worker.schedulers() if worker is not None else []
+
+        default_doctor.set_scheduler_provider(_doctor_schedulers)
+        self.doctor = default_doctor
+
+        # pre-register the doctor metric families so dashboards can alert
+        # on them from the first scrape
+        self.registry.counter(
+            "watchdog_trips_total",
+            "Stall-watchdog trips (scheduler_round/stream_stall/queue_age)"
+        ).inc(0.0)
+        self.registry.gauge(
+            "slo_burn_rate",
+            "SLO error-budget burn rate per objective and window")
+        self.registry.gauge(
+            "serving_state",
+            "Degradation state (0 healthy, 1 degraded, 2 shedding, "
+            "3 recovering)").set(0.0)
+        self.registry.gauge("llm_queue_depth",
+                            "Pending scheduler queue depth")
+        self.registry.gauge("llm_queue_oldest_age_seconds",
+                            "Age of the oldest pending request")
 
         # pre-register the faultlab metric families so they render (at zero)
         # before the first injection/failover — dashboards can alert on them
@@ -115,12 +157,8 @@ class MonitoringModule(Module, RestApiCapability):
 
         def active_slots() -> float:
             worker = hub.try_get(LlmWorkerApi)
-            total = 0
-            for entry in getattr(worker, "_entries", {}).values():
-                sched = getattr(entry, "scheduler", None)
-                if sched is not None:
-                    total += sched.active_slots
-            return float(total)
+            pairs = worker.schedulers() if worker is not None else []
+            return float(sum(s.active_slots for _, s in pairs))
 
         self.registry.gauge(
             "llm_batch_active_slots", "Active continuous-batching slots"
@@ -128,10 +166,9 @@ class MonitoringModule(Module, RestApiCapability):
 
         def _schedulers():
             worker = hub.try_get(LlmWorkerApi)
-            for entry in getattr(worker, "_entries", {}).values():
-                sched = getattr(entry, "scheduler", None)
-                if sched is not None:
-                    yield sched
+            for _name, sched in (worker.schedulers()
+                                 if worker is not None else []):
+                yield sched
 
         # scheduler pipeline health (the overlapped-decode tentpole): fraction
         # of decode rounds served by a pre-dispatched lookahead chunk, and how
@@ -163,6 +200,23 @@ class MonitoringModule(Module, RestApiCapability):
             "llm_queue_wait_p50_ms",
             "p50 pending-queue wait of admitted requests (ms)"
         ).set_function(queue_wait_p50_ms)
+
+    async def start(self, ctx: ModuleCtx, ready: ReadySignal) -> None:
+        # the evaluation thread spins up in start (not init) so its lifetime
+        # matches the stack's: stop() below is the teardown
+        self.doctor.ensure_started()
+        ready.notify_ready()
+
+    async def stop(self, ctx: ModuleCtx) -> None:
+        # the doctor thread and its scheduler-provider closure must not
+        # outlive this stack — a leaked evaluator watching a dead worker's
+        # schedulers would keep tripping watchdogs and shed a healthy NEXT
+        # stack booted in the same process
+        doctor = getattr(self, "doctor", None)
+        if doctor is not None:
+            doctor.stop()
+            doctor.set_scheduler_provider(None)
+            doctor.detach_recorder()
 
     def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
         async def metrics(request: web.Request):
@@ -312,7 +366,16 @@ class MonitoringModule(Module, RestApiCapability):
             return value
 
         async def list_requests(request: web.Request):
-            rows = default_recorder.inflight()
+            # ?stalled=true narrows to streams a stall watchdog flagged —
+            # operators triage watchdog trips from the same table (each row
+            # carries age_s + last_event_age_s for the how-stuck reading)
+            stalled_raw = request.query.get("stalled", "")
+            if stalled_raw.lower() not in ("", "true", "false", "1", "0"):
+                raise ERR.core.bad_request.error(
+                    "query parameter 'stalled' must be true or false, "
+                    f"got {stalled_raw!r}")
+            stalled_only = stalled_raw.lower() in ("true", "1")
+            rows = default_recorder.inflight(stalled_only=stalled_only)
             rows.sort(key=lambda r: -r["age_s"])
             return {
                 "in_flight": rows,
@@ -372,6 +435,18 @@ class MonitoringModule(Module, RestApiCapability):
             .summary("Recent scheduler rounds; ?format=chrome-trace exports "
                      "Perfetto-loadable trace events") \
             .handler(export_rounds).register()
+
+        # ---- fabric-doctor: the full SLO/state document behind the public
+        # /readyz verdict — objective table with fast/slow burn rates,
+        # watchdog trip counters, and the degradation state history ring
+        async def get_slo(request: web.Request):
+            return self.doctor.report()
+
+        router.operation("GET", "/v1/monitoring/slo",
+                         module="monitoring").auth_required() \
+            .summary("SLO objective table, burn rates, watchdog trips, and "
+                     "degradation state history (fabric-doctor)") \
+            .handler(get_slo).register()
 
         router.operation("GET", "/v1/monitoring/failpoints",
                          module="monitoring").auth_required() \

@@ -14,6 +14,12 @@ from typing import Any, AsyncIterator, Optional, Sequence
 
 from ..modkit.security import SecurityContext
 
+#: fabric-doctor contract: the health evaluator the monitoring module
+#: registers and the llm-gateway admission layer consults (shed_retry_after /
+#: readiness / report). The implementation lives a layer DOWN (modkit), like
+#: MetricsRegistry — the SDK alias is the hub-resolution contract name.
+from ..modkit.doctor import Doctor as DoctorApi  # noqa: E402
+
 
 # ----------------------------------------------------------------- model registry
 @dataclass
@@ -101,6 +107,13 @@ class LlmWorkerApi(abc.ABC):
     @abc.abstractmethod
     async def health(self) -> dict[str, Any]:
         ...
+
+    def schedulers(self) -> list[tuple[str, Any]]:
+        """Live ``(model_key, continuous-scheduler)`` pairs — the doctor's
+        watchdog and queue-gauge surface, and the monitoring module's
+        per-scheduler metric source. Default: none (external-provider
+        workers have no local scheduler)."""
+        return []
 
 
 class LlmHookApi(abc.ABC):

@@ -288,6 +288,9 @@ class ContinuousBatchingEngine:
         self.queue_wait_samples: "deque[float]" = deque(maxlen=2048)
         self._lookahead_stats = {"dispatched": 0, "used": 0, "discarded": 0}
         self._last_admit_ms = 0.0
+        #: round heartbeat (monotonic): the doctor's scheduler-round
+        #: watchdog reads this to notice a wedged decode loop
+        self.last_round_at = time.monotonic()
         _init_ctx.close()
 
     # ------------------------------------------------------------------ programs
@@ -424,6 +427,19 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 "SamplingParams.seed requires the paged scheduler "
                 "(prefix_cache_pages > 0); dense mode shares one RNG stream")
+        if not self.active_slots and not self._suspended \
+                and self._pending.qsize() == 0:
+            # idle→busy: restart the round-stall clock. last_round_at is
+            # otherwise only refreshed by COMPLETED rounds, so after an
+            # idle gap the doctor's scheduler_round watchdog would read
+            # the whole gap as stall age and trip on the first request —
+            # degrading a healthy server during warmup. Age must measure
+            # time-with-work-but-no-round, not time-since-last-round.
+            # Advisory snapshot + GIL-atomic float store, deliberately
+            # outside _submit_lock (matching the scheduler thread's own
+            # unguarded per-round write): a racing refresh lands on ~now
+            # either way.
+            self.last_round_at = time.monotonic()
         with self._submit_lock:
             # check-and-put under one lock: concurrent gateway threads must
             # not overshoot the bound between qsize() and put() (the
@@ -452,6 +468,43 @@ class ContinuousBatchingEngine:
     @property
     def active_slots(self) -> int:
         return int(self.active.sum())
+
+    # -------------------------------------------------------- health surface
+    def pending_depth(self) -> int:
+        """Live pending-queue depth (llm_queue_depth{model=} gauge)."""
+        return self._pending.qsize()
+
+    def pending_oldest_age_s(self) -> Optional[float]:
+        """Age of the oldest pending request, or None when the queue is
+        empty — the doctor's queue-age watchdog input. Peeks the queue head
+        under its own mutex (advisory read, one lock acquire)."""
+        with self._pending.mutex:
+            head = self._pending.queue[0] if self._pending.queue else None
+        if head is None:
+            return None
+        return time.monotonic() - head.enqueued_at
+
+    def heartbeat(self) -> dict[str, Any]:
+        """Round-liveness snapshot for the doctor's watchdogs: how long ago
+        the last decode round completed, the recent p95 round time, and
+        whether there is work the loop OUGHT to be making progress on."""
+        try:  # advisory snapshot of a deque the scheduler thread appends to
+            durations = sorted(
+                t["dispatch_ms"] + t["sync_wait_ms"] + t["host_emit_ms"]
+                for t in list(self.round_timings))
+        except RuntimeError:
+            durations = []
+        p95 = durations[int(0.95 * (len(durations) - 1))] if durations else 0.0
+        return {
+            "last_round_age_s": round(time.monotonic() - self.last_round_at, 3),
+            "round_p95_ms": round(p95, 3),
+            "rounds": self.decode_rounds,
+            "active": self.active_slots,
+            "pending": self._pending.qsize(),
+            "suspended": len(self._suspended),
+            "oldest_pending_age_s": self.pending_oldest_age_s(),
+            "broken": self._broken,
+        }
 
     @staticmethod
     def _p50(samples: list) -> float:
@@ -1284,6 +1337,7 @@ class ContinuousBatchingEngine:
         self.decode_rounds += 1
         if lookahead:
             self.lookahead_rounds += 1
+        self.last_round_at = time.monotonic()
         self.round_timings.append({
             "ts": round(ts if ts is not None else time.time(), 6),
             "admit_ms": self._last_admit_ms,

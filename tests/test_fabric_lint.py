@@ -482,6 +482,87 @@ def test_TL01_repo_runtime_tier_clean():
     assert findings == [], [f.to_dict() for f in findings]
 
 
+# ---------------------------------------------------------------- WD family
+
+
+def test_WD01_blocking_sleep_in_evaluator_fails():
+    bad = lint("import time\n"
+               "class Doctor:\n"
+               "    def evaluate(self):\n"
+               "        time.sleep(0.1)\n",
+               tier="modkit", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and bad[0].line == 4
+    assert "blocking call" in bad[0].message
+
+
+def test_WD01_network_call_in_watchdog_check_fails():
+    bad = lint("import urllib.request\n"
+               "class StallWatchdog:\n"
+               "    def _check_round(self, url):\n"
+               "        urllib.request.urlopen(url)\n",
+               tier="modkit", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"]
+
+
+def test_WD01_await_in_evaluator_fails():
+    bad = lint("class Doctor:\n"
+               "    async def evaluate(self, db):\n"
+               "        await db.fetch('select 1')\n",
+               tier="modkit", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and "await" in bad[0].message
+
+
+def test_WD01_direct_recorder_emit_fails():
+    bad = lint("class Doctor:\n"
+               "    def _check_stream(self, recorder, rid):\n"
+               "        recorder.record(rid, 'stalled')\n",
+               tier="modkit", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and "record_event" in bad[0].message
+
+
+def test_WD01_direct_metric_mutate_fails():
+    bad = lint("class Doctor:\n"
+               "    def evaluate(self, registry):\n"
+               "        registry.counter('watchdog_trips_total')"
+               ".inc(watchdog='x')\n",
+               tier="modkit", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and "bump_counter" in bad[0].message
+
+
+def test_WD01_never_raises_helpers_pass():
+    ok = lint("from cyberfabric_core_tpu.modkit.metrics import bump_counter\n"
+              "from cyberfabric_core_tpu.modkit.flight_recorder import "
+              "record_event\n"
+              "import time\n"
+              "class Doctor:\n"
+              "    def evaluate(self):\n"
+              "        now = time.time()\n"
+              "        bump_counter('watchdog_trips_total', watchdog='x')\n"
+              "        record_event('rid', 'stalled')\n"
+              "        return now\n"
+              "    def _loop(self):\n"
+              "        self._stop.wait(1.0)\n",
+              tier="modkit", select=("WD01",))
+    assert ok == []
+
+
+def test_WD01_outside_doctor_classes_passes():
+    # the rule targets the evaluator contract, not every sleep in modkit
+    ok = lint("import time\n"
+              "class RetryHelper:\n"
+              "    def evaluate(self):\n"
+              "        time.sleep(0.1)\n",
+              tier="modkit", select=("WD01",))
+    assert ok == []
+
+
+def test_WD01_repo_gate_clean():
+    """The gate: the shipped doctor's evaluators hold their own contract."""
+    engine = Engine(all_rules()).select(["WD01"])
+    findings = [f for f in engine.run(PKG) if not f.suppressed]
+    assert findings == [], [f.to_dict() for f in findings]
+
+
 # ------------------------------------------------------- waivers + baseline
 
 

@@ -121,9 +121,22 @@ def test_scenario(name):
 
 
 @pytest.mark.parametrize("name", ["db-commit-fault", "http-retry-storm",
-                                  "grpc-evict-tick", "forced-preempt"])
+                                  "grpc-evict-tick", "forced-preempt",
+                                  "stream-stall-watchdog"])
 def test_scenario_repeatable_same_seed_same_fingerprint(name):
     spec = scenario_by_name(name)
+    a = run_scenario(spec)
+    b = run_scenario(spec)
+    assert a.verdict and b.verdict
+    assert a.fingerprint == b.fingerprint
+
+
+@pytest.mark.slow
+def test_slo_burn_repeatable_same_seed_same_fingerprint():
+    """The acceptance-cycle scenario is deterministic end to end: two boots
+    of the faulted server walk the same state sequence and produce the same
+    fingerprint (also held by the CI `faultlab --repeat 2` leg)."""
+    spec = scenario_by_name("slo-burn-shed-recover")
     a = run_scenario(spec)
     b = run_scenario(spec)
     assert a.verdict and b.verdict

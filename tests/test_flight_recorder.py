@@ -173,6 +173,22 @@ def test_client_retry_of_finished_id_starts_fresh_record():
     assert rec.stats() == {"live": 1, "finished": 1, "evicted_live": 0}
 
 
+def test_stalled_emit_never_creates_a_record():
+    """A watchdog ``stalled`` emit racing a terminal (the stream finished
+    between the doctor's inflight() snapshot and the emit) must not build a
+    fresh live record: nothing would ever close it, and a phase='stalled'
+    ghost reads as a permanent stall that pins the state machine degraded."""
+    rec = FlightRecorder()
+    rec.record("r", "enqueued")
+    rec.record("r", "finished", reason="stop")
+    rec.record("r", "stalled", watchdog="stream_stall")  # lost the race
+    assert rec.stats() == {"live": 0, "finished": 1, "evicted_live": 0}
+    # a stalled emit for an id the recorder never saw is dropped too
+    rec.record("ghost", "stalled", watchdog="stream_stall")
+    assert rec.stats() == {"live": 0, "finished": 1, "evicted_live": 0}
+    assert rec.lookup("r")["timeline"][-1]["event"] == "finished"
+
+
 def test_error_terminal_does_not_feed_latency_histograms():
     from cyberfabric_core_tpu.modkit.metrics import default_registry
 

@@ -122,8 +122,23 @@ def test_health_and_healthz(gateway_app):
     loop, base = gateway_app
     status, _, body = _req(loop, "GET", f"{base}/health")
     assert status == 200 and json.loads(body)["status"] == "ok"
+    # /healthz is the doctor's LIVENESS document now: process uptime +
+    # event-loop heartbeat lag (the gateway's heartbeat task feeds it)
     status, _, body = _req(loop, "GET", f"{base}/healthz")
-    assert status == 200 and body == b"ok"
+    doc = json.loads(body)
+    assert status == 200 and doc["status"] == "ok" and "uptime_s" in doc
+    # /readyz is public (load balancers probe unauthenticated) and reads
+    # the degradation state machine. This gateway-only stack never booted
+    # the monitoring module, so pin the process-global doctor to a fresh
+    # config — earlier test files may have driven it through a chaos cycle
+    from cyberfabric_core_tpu.modkit.doctor import (DoctorConfig,
+                                                    default_doctor)
+
+    default_doctor.configure(DoctorConfig())
+    status, _, body = _req(loop, "GET", f"{base}/readyz")
+    doc = json.loads(body)
+    assert status == 200 and doc["status"] == "ready"
+    assert doc["state"] == "healthy" and doc["reasons"] == []
 
 
 def test_echo_and_request_id(gateway_app):
