@@ -5,9 +5,9 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard
+.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench
 
-safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard  ## the full local gate
+safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
 
@@ -49,6 +49,10 @@ doctor:  ## fabric-doctor: SLO engine/watchdog/state-machine tests + the burn-ra
 
 doctor-guard:  ## fabric-doctor armed-vs-stubbed overhead A/B under the aggregate workload (BENCH_DOCTOR.json, <1% bar)
 	$(PY) bench.py --doctor-guard > /dev/null
+
+ragged-bench:  ## ragged mixed-batch kernel/scheduler tests + the mixed-vs-phase-separated A/B (BENCH_RAGGED.json: itl_p99 + ttft must improve)
+	$(PY) -m pytest tests/test_ragged_attention.py tests/test_mixed_batch.py -q
+	$(PY) bench.py --ragged-bench > /dev/null
 
 test:  ## full suite
 	$(PY) -m pytest tests/ -q

@@ -66,9 +66,12 @@ BUILTIN_SCENARIOS: list[dict[str, Any]] = [
         "name": "prefill-fault",
         "kind": "engine",
         "seed": 102,
-        # coalesce off so the FIFO-first request deterministically takes the
-        # single-prefill path where the fault is injected
-        "engine": {**_TINY, "prefill_coalesce": 1},
+        # phase-separated mode (scheduler.prefill lives on that path — mixed
+        # batching has no prefill dispatch; its faults are covered by
+        # mixed-prefill-preempt) with coalesce off, so the FIFO-first request
+        # deterministically takes the single-prefill path where the fault is
+        # injected
+        "engine": {**_TINY, "prefill_coalesce": 1, "mixed_batch": False},
         "load": _LOAD,
         "faults": [{"point": "scheduler.prefill", "spec": "1*raise"}],
         "invariants": ["exactly_one_terminal", "expected_errors",
@@ -97,6 +100,26 @@ BUILTIN_SCENARIOS: list[dict[str, Any]] = [
         # the resumed stream must be bit-identical to the unfaulted run
         "faults": [{"point": "scheduler.page_alloc",
                     "spec": "1*raise(MemoryError)"}],
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "engine_accounting"],
+        "expect_stats": {"preemptions": [1, None]},
+    },
+    {
+        "name": "mixed-prefill-preempt",
+        "kind": "engine",
+        "seed": 107,
+        # budget 3 forces every 4-10 token prompt through >= 2 mixed-batch
+        # prefill chunks; the 3rd chunk-growth hit lands MID-prefill of a
+        # partially-prefilled request (its first chunk already in pool pages)
+        "engine": {**_TINY, "prefill_budget_tokens": 3},
+        "load": _LOAD,
+        # injected MemoryError on a prefill-chunk page growth preempts the
+        # request mid-chunked-prefill; resume must continue chunking from the
+        # saved position and reproduce the unfaulted stream bit-for-bit,
+        # with no page refs or orphans leaked
+        "faults": [{"point": "scheduler.prefill_chunk",
+                    "spec": {"kind": "raise", "exc": "MemoryError",
+                             "mode": "once", "after": 1}}],
         "invariants": ["exactly_one_terminal", "expected_errors",
                        "streams_match_baseline", "engine_accounting"],
         "expect_stats": {"preemptions": [1, None]},

@@ -406,6 +406,74 @@ def forward_paged_decode(
     return h, (k_pool, v_pool)
 
 
+def forward_paged_mixed(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,    # [B, Qmax] int32 — per-row query span, padded
+    pools: PagedPools,
+    page_table: jnp.ndarray,   # [B, Pmax] int32 physical page ids per slot
+    hist: jnp.ndarray,         # [B] int32 kv tokens BEFORE each row's span
+    q_lens: jnp.ndarray,       # [B] int32 span length (0 = idle row)
+    rope_tables: tuple[jnp.ndarray, jnp.ndarray],
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, PagedPools]:
+    """One ragged mixed-batch step over the paged KV pool: decode rows
+    (q_len=1) and chunked-prefill rows (q_len=chunk) in one dispatch.
+    Returns (hidden [B, Qmax, H], pools).
+
+    Row b's span tokens land at absolute positions hist[b] .. hist[b]+q_len-1
+    of its page chain (a chunk may cross page boundaries — per-token page
+    resolution); attention runs the ragged paged kernel, causal relative to
+    each row's own history. Padding positions scatter to scratch page 0 and
+    produce garbage hidden states that nothing downstream reads.
+    """
+    from ..ops.paged_attention import ragged_paged_attention
+
+    if interpret is None:
+        interpret = _default_interpret()
+    cos_t, sin_t = rope_tables
+    B, Qmax = input_ids.shape
+    Hq, D = cfg.num_heads, cfg.head_dim
+    page_size = pools[0].shape[2]
+
+    offs = jnp.arange(Qmax, dtype=jnp.int32)[None, :]          # [1, Qmax]
+    valid = offs < q_lens[:, None]                             # [B, Qmax]
+    positions = jnp.where(valid, hist[:, None] + offs, 0)
+    # per-token write targets; padding targets scratch page 0 (harmless)
+    pid = jnp.where(
+        valid,
+        jnp.take_along_axis(page_table, positions // page_size, axis=1), 0)
+    off = jnp.where(valid, positions % page_size, 0)
+
+    h = _embed_scale(embed_lookup(params["embed"], input_ids,
+                                  params["final_norm"].dtype), cfg)
+
+    def layer_body(carry, xs):
+        h, k_pool, v_pool = carry
+        lp, layer = xs
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_weight_offset)
+        q, kproj, vproj = _qkv_proj(lp, x, cfg, positions, cos_t, sin_t)
+
+        # scatter the span's k/v BEFORE attending: within-span causality then
+        # reads the chunk's earlier tokens back through the page chain
+        k_pool = k_pool.at[layer, pid, off].set(kproj.astype(k_pool.dtype))
+        v_pool = v_pool.at[layer, pid, off].set(vproj.astype(v_pool.dtype))
+
+        attn = ragged_paged_attention(
+            q, k_pool[layer], v_pool[layer], page_table, hist, q_lens,
+            interpret=interpret, sliding_window=cfg.sliding_window)
+        h = _attn_out(lp, h, attn.reshape(B, Qmax, Hq * D))
+        h = _mlp_residual(lp, h, cfg)
+        return (h, k_pool, v_pool), None
+
+    k_pool, v_pool = pools
+    (h, k_pool, v_pool), _ = jax.lax.scan(
+        layer_body, (h, k_pool, v_pool),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, cfg.norm_weight_offset)
+    return h, (k_pool, v_pool)
+
+
 def prefill_collect(
     params: Params,
     cfg: ModelConfig,

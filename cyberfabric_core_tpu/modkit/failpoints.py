@@ -65,6 +65,10 @@ FAILPOINT_CATALOG: dict[str, tuple[str, str]] = {
     "scheduler.page_alloc": (
         "runtime", "KV page-chain extension; an injected MemoryError forces "
         "the preempt-to-host path without real pool pressure"),
+    "scheduler.prefill_chunk": (
+        "runtime", "mixed-batch prefill-chunk page growth; an injected "
+        "MemoryError preempts the request MID-chunked-prefill (resume "
+        "continues chunking from the saved position)"),
     "scheduler.resume": (
         "runtime", "suspended-request resume; a raise error-terminates the "
         "engine mid-recovery"),

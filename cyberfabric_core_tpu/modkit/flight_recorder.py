@@ -48,6 +48,9 @@ __all__ = ["FlightRecorder", "RequestRecord", "annotate_request",
 _PHASE_AFTER = {
     "enqueued": "queued",
     "admitted": "prefill",
+    #: one per piggybacked mixed-batch prompt chunk (mirrors decode_chunk):
+    #: the live table shows interleaved prefill progress, phase stays prefill
+    "prefill_chunk": "prefill",
     "prefill": "decode",
     "first_token": "decode",
     "decode_chunk": "decode",
@@ -64,8 +67,8 @@ _PHASE_AFTER = {
 
 #: events that prove the stream is moving again — they clear a watchdog's
 #: ``stalled`` mark (and phase) so the live table reflects recovery
-_PROGRESS = frozenset({"admitted", "prefill", "first_token", "decode_chunk",
-                       "resumed", "finished"})
+_PROGRESS = frozenset({"admitted", "prefill", "prefill_chunk", "first_token",
+                       "decode_chunk", "resumed", "finished"})
 
 _TERMINAL = frozenset({"finished", "error", "evicted"})
 

@@ -281,6 +281,11 @@ class LocalTpuWorker(LlmWorkerApi):
                                                            "off"),
             prefill_budget_tokens=int(opts.pop("prefill_budget_tokens", 512)),
             prefill_coalesce=int(opts.pop("prefill_coalesce", 4)),
+            # ragged mixed-batch rounds: prefill chunks piggyback into decode
+            # rounds (one dispatch) instead of a blocking cold-prefill phase
+            mixed_batch=str(opts.pop("mixed_batch", True)
+                            ).strip().lower() not in ("0", "false", "no",
+                                                      "off"),
             # admission backpressure bound (faultlab satellite): overflow
             # surfaces as 429 + Retry-After instead of unbounded queueing
             max_pending=int(opts.pop("max_pending", 2048)),

@@ -134,6 +134,9 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
         self.registry.counter(
             "llm_replica_failovers_total",
             "Mid-stream requests resubmitted to another replica").inc(0.0)
+        self.registry.counter(
+            "llm_cache_aware_placements_total",
+            "Requests routed by the prefix-cache affinity hint").inc(0.0)
 
         # device gauges, evaluated at scrape time
         def device_count() -> float:
@@ -184,6 +187,47 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
             "llm_decode_overlap_ratio",
             "Decode rounds served by a lookahead-dispatched chunk (0..1)"
         ).set_function(decode_overlap_ratio)
+
+        # prefix-cache effectiveness (ROADMAP item 1's metrics half): the
+        # fraction of prefill tokens the radix cache let admission skip, and
+        # the cumulative tokens saved — both read straight off the pools'
+        # stats() so the REST surface and the dashboards cannot drift
+        def _pool_stats():
+            for sched in _schedulers():
+                pool = getattr(sched, "pool", None)
+                if pool is not None:
+                    yield pool.stats()
+
+        def prefix_hit_rate() -> float:
+            saved = total = 0
+            for st in _pool_stats():
+                saved += st.get("prefill_tokens_saved", 0)
+                total += st.get("prefill_tokens_total", 0)
+            return saved / total if total else 0.0
+
+        self.registry.gauge(
+            "llm_prefix_cache_hit_rate",
+            "Cached vs total prefill tokens across paged pools (0..1)"
+        ).set_function(prefix_hit_rate)
+
+        def prefill_tokens_saved() -> float:
+            return float(sum(st.get("prefill_tokens_saved", 0)
+                             for st in _pool_stats()))
+
+        self.registry.gauge(
+            "llm_prefill_tokens_saved_total",
+            "Prefill tokens skipped via prefix-cache hits (cumulative)"
+        ).set_function(prefill_tokens_saved)
+
+        def mixed_chunk_tokens() -> float:
+            return float(sum(getattr(s, "chunked_prefill_tokens", 0)
+                             for s in _schedulers()))
+
+        self.registry.gauge(
+            "llm_prefill_chunk_tokens_total",
+            "Prompt tokens prefilled via mixed-batch chunks piggybacked "
+            "into decode rounds (cumulative)"
+        ).set_function(mixed_chunk_tokens)
 
         def queue_wait_p50_ms() -> float:
             waits: list[float] = []

@@ -173,6 +173,185 @@ def paged_decode_attention(
       q, k_pool, v_pool)
 
 
+def _ragged_kernel(pt_ref, hist_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, page_size: int, q_block: int,
+                   sliding_window: int | None = None):
+    """One (slot, q-block, page) program of the ragged mixed-batch kernel.
+
+    Refs:
+      pt_ref:   [B, Pmax] int32 SMEM — page table
+      hist_ref: [B] int32 SMEM — kv tokens BEFORE this row's query span
+      qlen_ref: [B] int32 SMEM — query-span length (0 = idle row)
+      q_ref:    [1, Qb, Hq, D] VMEM; k_ref/v_ref: [1, page, Hkv, D] VMEM
+      o_ref:    [1, Qb, Hq, D] VMEM
+      acc_ref:  [Hq*Qb, D] f32; m_ref/l_ref: [Hq*Qb, LANES] f32
+
+    Each query row qi of the block sits at absolute position hist + q0 + qi
+    and attends causally over its row's paged KV chain (history AND the
+    span's earlier tokens — prefill-chunk self attention). Rows are flat
+    r = h*Qb + qi so the GQA dot keeps the decode kernel's head grouping.
+    """
+    b = pl.program_id(0)
+    qb = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    hist = hist_ref[b]
+    qlen = qlen_ref[b]
+    q0 = qb * q_block
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    k_start = j * page_size
+    # last absolute query position this block serves: keys past it are
+    # causally invisible to every row of the block, so the page is skipped
+    q_hi = hist + jnp.minimum(qlen, q0 + q_block) - 1
+    relevant = jnp.logical_and(q0 < qlen, k_start <= q_hi)
+    if sliding_window is not None:
+        # earliest window start across the block's queries
+        relevant = jnp.logical_and(
+            relevant, k_start + page_size - 1 > hist + q0 - sliding_window)
+
+    @pl.when(relevant)
+    def _compute():
+        q = q_ref[0]          # [Qb, Hq, D]
+        k = k_ref[0]          # [page, Hkv, D]
+        v = v_ref[0]
+        Qb, Hq, D = q.shape
+        Hkv = k.shape[1]
+        G = Hq // Hkv
+
+        # head-major rows: r = h*Qb + qi (h = kv*G + g), so the GQA grouping
+        # matches the decode kernel's reshape(Hkv, G, D) exactly
+        qt = jnp.transpose(q, (1, 0, 2)).reshape(Hkv, G * Qb, D)
+        kt = jnp.transpose(k, (1, 2, 0))        # [Hkv, D, page]
+        scores = jax.lax.dot_general(
+            qt, kt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)  # [Hkv, G*Qb, page]
+        R = Hq * Qb
+        scores = scores.reshape(R, page_size) * (1.0 / (D ** 0.5))
+
+        qi = jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 0) % Qb
+        q_idx = q0 + qi                          # index within the span
+        q_abs = hist + q_idx                     # absolute position
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (R, page_size), 1)
+        # causal within the row's own history: k <= this query's position
+        # (subsumes k < hist + qlen); padding query rows mask out entirely
+        mask = (q_idx < qlen) & (k_pos <= q_abs)
+        if sliding_window is not None:
+            mask = mask & (k_pos > q_abs - sliding_window)
+        scores = jnp.where(mask, scores, _NEG_INF)
+
+        m_prev = m_ref[...]
+        m_blk = jnp.max(scores, axis=1, keepdims=True)      # [R, 1]
+        m_new = jnp.maximum(m_prev, jax.lax.broadcast_in_dim(
+            m_blk, m_prev.shape, (0, 1)))
+        m_ref[...] = m_new
+        # a row with no visible key yet has m_prev == m_new == -inf; the raw
+        # exp would be exp(nan) and poison acc/l for the rest of the walk —
+        # such rows carry no mass, so their correction is 0 (this keeps
+        # padding query rows inside a partially-valid block at exactly 0.0
+        # in the output, the documented contract, instead of NaN)
+        correction = jnp.where(jnp.isfinite(m_new),
+                               jnp.exp(m_prev - m_new), 0.0)  # [R, LANES]
+        p = jnp.exp(scores - m_new[:, :1])                  # [R, page]
+        p = jnp.where(mask, p, 0.0)
+        l_blk = jnp.sum(p, axis=1, keepdims=True)
+        l_ref[...] = l_ref[...] * correction + jax.lax.broadcast_in_dim(
+            l_blk, m_prev.shape, (0, 1))
+        pg = p.reshape(Hkv, G * Qb, page_size)
+        vt = jnp.transpose(v, (1, 0, 2))                    # [Hkv, page, D]
+        pv = jax.lax.dot_general(
+            pg, vt.astype(pg.dtype), (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)             # [Hkv, G*Qb, D]
+        acc_ref[...] = acc_ref[...] * correction[:, :1] + pv.reshape(R, D)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        Qb = q_ref.shape[1]
+        Hq, D = q_ref.shape[2], q_ref.shape[3]
+        denom = jnp.maximum(l_ref[...][:, :1], 1e-30)
+        out = (acc_ref[...] / denom).reshape(Hq, Qb, D)
+        o_ref[0] = jnp.transpose(out, (1, 0, 2)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("q_block", "interpret",
+                                             "sliding_window"))
+def ragged_paged_attention(
+    q: jnp.ndarray,           # [B, Qmax, Hq, D] — per-row query span, padded
+    k_pool: jnp.ndarray,      # [N, page, Hkv, D] — one layer's page pool
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, Pmax] int32 physical page ids
+    hist: jnp.ndarray,        # [B] int32 kv tokens BEFORE the span
+    q_lens: jnp.ndarray,      # [B] int32 span length (0 = idle row)
+    q_block: int = 8,
+    interpret: bool = False,
+    sliding_window: int | None = None,
+) -> jnp.ndarray:
+    """Ragged mixed-batch paged attention: one dispatch where each batch row
+    attends a variable-length query span over its paged KV chain with causal
+    masking relative to its own history. Decode rows (q_len=1) and
+    chunked-prefill rows (q_len=chunk) share the batch; idle rows (q_len=0)
+    cost one scratch-page read. Returns [B, Qmax, Hq, D]; positions past a
+    row's q_len are zeros (their softmax mass is empty).
+
+    The span's own KV must already be present in the pool (the caller
+    scatters the chunk's k/v before attending — within-span causality then
+    reads the earlier chunk tokens through the page chain)."""
+    B, Qmax, Hq, D = q.shape
+    _, page_size, Hkv, _ = k_pool.shape
+    Pmax = page_table.shape[1]
+    if Qmax % q_block:
+        raise ValueError(f"Qmax {Qmax} must be a multiple of q_block {q_block}")
+
+    def _page_index(b, qb, j, pt_ref, hist_ref, qlen_ref):
+        # clamp j into the pages this (row, q-block) can actually see so
+        # skipped programs revisit the resident page and the DMA is elided
+        hist_b = hist_ref[b]
+        qlen = qlen_ref[b]
+        q_hi = hist_b + jnp.minimum(qlen, (qb + 1) * q_block) - 1
+        last = jnp.maximum(q_hi // page_size, 0)
+        jj = jnp.minimum(j, last)
+        if sliding_window is not None:
+            lo = jnp.maximum(
+                (hist_b + qb * q_block - sliding_window) // page_size, 0)
+            jj = jnp.maximum(jj, jnp.minimum(lo, last))
+        return (pt_ref[b, jj], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, Qmax // q_block, Pmax),
+        in_specs=[
+            pl.BlockSpec((1, q_block, Hq, D),
+                         lambda b, qb, j, pt, hh, ql: (b, qb, 0, 0)),
+            pl.BlockSpec((1, page_size, Hkv, D), _page_index),
+            pl.BlockSpec((1, page_size, Hkv, D), _page_index),
+        ],
+        out_specs=pl.BlockSpec((1, q_block, Hq, D),
+                               lambda b, qb, j, pt, hh, ql: (b, qb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hq * q_block, D), jnp.float32),
+            pltpu.VMEM((Hq * q_block, _LANES), jnp.float32),
+            pltpu.VMEM((Hq * q_block, _LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_ragged_kernel, page_size=page_size,
+                          q_block=q_block, sliding_window=sliding_window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Qmax, Hq, D), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), hist.astype(jnp.int32),
+      q_lens.astype(jnp.int32), q, k_pool, v_pool)
+
+
 def paged_gather_dense(k_pool, v_pool, page_table):
     """Reference helper: materialize each slot's paged KV as a dense cache
     [B, Pmax*page, Hkv, D] (tests / CPU fallback only — O(pool) reads)."""

@@ -114,6 +114,15 @@ class EngineConfig:
     #: same-bucket pending requests into one multi-row prefill dispatch.
     #: 1 = off (every prefill is its own batch-1 dispatch).
     prefill_coalesce: int = 4
+    #: continuous scheduler (paged mode): Sarathi-style mixed-batch rounds —
+    #: pending prompts are split into prefill chunks (sized by
+    #: ``prefill_budget_tokens``) that piggyback INTO decode rounds through
+    #: the ragged paged-attention kernel (one dispatch serves decode rows at
+    #: q_len=1 and prefill-chunk rows at q_len=chunk), instead of running a
+    #: blocking phase-separated cold prefill that stalls every decode stream.
+    #: False restores the phase-separated path (the A/B baseline; also what
+    #: dense mode always uses).
+    mixed_batch: bool = True
     #: continuous scheduler: bound on the pending (not-yet-admitted) queue.
     #: ``submit`` raises :class:`SchedulerSaturated` at the bound — the
     #: gateway maps it to 429 + Retry-After — instead of queueing without

@@ -20,6 +20,7 @@ entries. Page id 0 is a scratch page: bucket padding scatters land there.
 from __future__ import annotations
 
 import logging
+import threading
 from functools import partial
 from typing import Any, Optional
 
@@ -62,7 +63,18 @@ class PrefixKVPool:
         self.allocator = BlockAllocator(num_pages - 1, force_python=force_python_native)
         self._page_offset = 1
         self.tree = PrefixCache(page_size, force_python=force_python_native)
+        #: serializes radix-tree access: the tree has no internal lock and its
+        #: pin counters / native handle are read-modify-write, so the replica
+        #: pool's cache-affinity probe (``peek_prefix_len``, gateway threads)
+        #: and monitoring's ``stats()`` scrape must not interleave with the
+        #: scheduler thread's match/insert/evict/release
+        self._tree_lock = threading.Lock()
         self.prefill_tokens_saved = 0
+        #: hit-rate inputs: every match_prefix probe counts its prompt tokens;
+        #: hits are probes that returned at least one cached page
+        self.prefill_tokens_total = 0
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
         self.admissions = 0
         # paged-decode bookkeeping: pages referenced by live slots must survive
         # tree eviction (the tree can drop a page from the *cache* while a slot
@@ -135,7 +147,8 @@ class PrefixKVPool:
             try:
                 return [p + self._page_offset for p in self.allocator.alloc(n)]
             except MemoryError:
-                freed = self.tree.evict(n)
+                with self._tree_lock:
+                    freed = self.tree.evict(n)
                 if not freed:
                     raise
                 now_free = []
@@ -170,15 +183,34 @@ class PrefixKVPool:
         """Returns (pinned page ids, cached token count). Never returns the FULL
         prompt as cached — at least one token must go through prefill so the
         model produces the first-token logits."""
-        pages = self.tree.match(prompt_ids)
+        with self._tree_lock:
+            pages = self.tree.match(prompt_ids)
         cached = len(pages) * self.page_size
         if cached >= len(prompt_ids):
             drop = (cached - len(prompt_ids)) // self.page_size + 1
             pages = pages[:-drop] if drop <= len(pages) else []
             cached = len(pages) * self.page_size
+        self.prefix_lookups += 1
+        self.prefill_tokens_total += len(prompt_ids)
         if pages:
+            self.prefix_hits += 1
             self.prefill_tokens_saved += cached
         return pages, cached
+
+    def peek_prefix_len(self, prompt_ids: list[int]) -> int:
+        """Non-pinning probe: how many head tokens of ``prompt_ids`` this
+        pool could serve from cache right now. Used as a placement HINT
+        (cache-aware routing in runtime/replicas.py) — it must not pin pages
+        or skew the hit-rate stats, so it walks the tree and releases
+        immediately."""
+        with self._tree_lock:
+            pages = self.tree.match(prompt_ids)
+            try:
+                return min(len(pages) * self.page_size,
+                           max(len(prompt_ids) - 1, 0))
+            finally:
+                if pages is not None:
+                    self.tree.release(prompt_ids)
 
     def gather_for_prefill(self, page_ids: list[int], seq_bucket: int,
                            cache: tuple) -> tuple:
@@ -220,8 +252,9 @@ class PrefixKVPool:
             self.allocator.free([p - self._page_offset for p in new_ids])
             raise
         chain = list(cached_pages) + new_ids
-        _, unused = self.tree.insert_tracked(
-            prompt_ids[: total_pages * self.page_size], chain)
+        with self._tree_lock:
+            _, unused = self.tree.insert_tracked(
+                prompt_ids[: total_pages * self.page_size], chain)
         # Single-threaded (match pinned the prefix just above) the tree
         # consumes exactly new_ids and ``unused`` == cached_pages. Handle
         # the general contract anyway: a new page the tree declined (the
@@ -262,7 +295,8 @@ class PrefixKVPool:
             jnp.asarray(start_token, jnp.int32), jnp.asarray(page_id, jnp.int32))
 
     def release(self, prompt_ids: list[int]) -> None:
-        self.tree.release(prompt_ids)
+        with self._tree_lock:
+            self.tree.release(prompt_ids)
 
     # ------------------------------------------------------------ slot chains
     def pages_for(self, length: int) -> int:
@@ -313,6 +347,30 @@ class PrefixKVPool:
             raise
         return chain
 
+    def commit_chain(self, prompt_ids: list[int], chain: list[int]) -> None:
+        """Mixed-batch chunked prefill wrote its KV straight into the chain's
+        pages (no scatter pass) — after the final chunk, record the prompt's
+        FULL pages in the radix tree so later requests share them zero-copy.
+        Pages the tree declines (a racing same-prefix admission already
+        cached those positions) simply stay private to the chain, exactly
+        like store_prefill's general contract."""
+        total_pages = len(prompt_ids) // self.page_size
+        if total_pages <= 0:
+            return
+        with self._tree_lock:
+            _, unused = self.tree.insert_tracked(
+                prompt_ids[: total_pages * self.page_size],
+                chain[:total_pages])
+        declined = set(unused)
+        for p in chain[:total_pages]:
+            if p not in declined:
+                self._tree_owned.add(p)
+                # a page the tree evicted mid-prefill (slot refs kept it
+                # alive as an orphan) is tree-owned again — unmark it, or
+                # the orphan stat leaks and unref would double-account
+                self._orphans.discard(p)
+        self.admissions += 1
+
     def extend_chain(self, chain: list[int], length_needed: int) -> list[int]:
         """Grow a slot's chain (private decode pages) to cover length_needed
         tokens. Returns the same list, extended in place."""
@@ -341,6 +399,8 @@ class PrefixKVPool:
         request suspended. Restored pages are private (shared-prefix structure
         is not reconstructed; correctness is unaffected)."""
         n = host_kv[0].shape[1]
+        if n == 0:  # a prefill-phase preempt before any chunk landed
+            return []
         ids = self._alloc(n)
         self.ref_pages(ids)
         idx = jnp.asarray(ids, jnp.int32)
@@ -351,12 +411,22 @@ class PrefixKVPool:
         return ids
 
     def stats(self) -> dict[str, Any]:
+        with self._tree_lock:
+            tree_stats = self.tree.stats()
         return {
-            **self.tree.stats(),
+            **tree_stats,
             "pages_free": self.allocator.num_free,
             "pages_total": self.num_pages - 1,
             "pages_referenced": len(self._refs),
             "orphan_pages": len(self._orphans),  # evicted but still slot-held
             "prefill_tokens_saved": self.prefill_tokens_saved,
+            "prefill_tokens_total": self.prefill_tokens_total,
+            # cached vs total prefill tokens: the fraction of prompt tokens
+            # the cache let _admit skip entirely
+            "hit_rate": round(
+                self.prefill_tokens_saved / self.prefill_tokens_total, 4)
+            if self.prefill_tokens_total else 0.0,
+            "lookups": self.prefix_lookups,
+            "hits": self.prefix_hits,
             "native": self.tree.native,
         }

@@ -58,6 +58,11 @@ class _Tracked:
 class DataParallelServingPool:
     """N continuous-batching replicas behind one submit()."""
 
+    #: class-level defaults so stats()/_pick work on bare instances built
+    #: via __new__ (tests/test_faultlab.py constructs doubles that way)
+    placement_hint_hits = 0
+    cache_affinity_slack = 1
+
     def __init__(
         self,
         config: EngineConfig,
@@ -76,6 +81,12 @@ class DataParallelServingPool:
         self._requests: dict[str, _Tracked] = {}
         self.failovers = 0        # successful mid-stream resubmissions
         self.failovers_failed = 0  # failover attempts that could not resubmit
+        #: cache-aware routing: requests placed on (or confirmed at) the
+        #: replica whose prefix cache already held their prompt head
+        self.placement_hint_hits = 0
+        #: how much extra load (active+pending) a cache-affinity hit may
+        #: carry over the least-loaded replica before load wins
+        self.cache_affinity_slack = max(1, config.max_batch // 2)
         self.replicas: list[ContinuousBatchingEngine] = []
         self.devices = devices[:n_replicas]
         for dev in self.devices:
@@ -92,16 +103,43 @@ class DataParallelServingPool:
     def _healthy(self) -> list[int]:
         return [i for i, r in enumerate(self.replicas) if r.stats()["broken"] is None]
 
-    def _pick(self) -> int:
-        """Least-loaded healthy replica (active slots + pending queue)."""
+    def _pick(self, prompt_ids: Optional[list[int]] = None) -> int:
+        """Least-loaded healthy replica (active slots + pending queue) —
+        unless another replica's prefix cache already holds this prompt's
+        head (RTP-LLM's cache-aware routing recipe): route there while its
+        load stays within ``cache_affinity_slack`` of the least-loaded, so
+        affinity exploits KV reuse but never overrides real imbalance."""
         best, best_load = None, None
+        loads: dict[int, int] = {}
         for i in self._healthy():
             s = self.replicas[i].stats()
-            load = s["active"] + s["pending"]
-            if best_load is None or load < best_load:
-                best, best_load = i, load
+            # prefilling slots occupy capacity too (mixed batching admits
+            # into prefill-phase slots that are neither active nor pending)
+            loads[i] = s["active"] + s["pending"] + s.get("prefilling", 0)
+            if best_load is None or loads[i] < best_load:
+                best, best_load = i, loads[i]
         if best is None:
             raise RuntimeError("no healthy replicas")
+        if prompt_ids and len(loads) > 1:
+            hint, hint_len = None, 0
+            for i in loads:
+                pool = getattr(self.replicas[i], "pool", None)
+                if pool is None:
+                    continue
+                try:
+                    n = pool.peek_prefix_len(list(prompt_ids))
+                except Exception:  # noqa: BLE001 — a probe must never route-fail
+                    n = 0
+                if n > hint_len:
+                    hint, hint_len = i, n
+            if (hint is not None and hint != best
+                    and loads[hint] - best_load <= self.cache_affinity_slack):
+                self.placement_hint_hits += 1
+                bump_counter("llm_cache_aware_placements_total")
+                return hint
+            if hint is not None and hint == best and hint_len > 0:
+                self.placement_hint_hits += 1
+                bump_counter("llm_cache_aware_placements_total")
         return best
 
     def submit(
@@ -115,7 +153,7 @@ class DataParallelServingPool:
         # armed raise rejects the request before any replica sees it (the
         # faultlab pool scenario asserts no tracking record leaks)
         failpoint("replicas.submit")
-        idx = self._pick()
+        idx = self._pick(prompt_ids)
         tracked = _Tracked(list(prompt_ids), sampling, emit, [], idx,
                            self.max_retries, trace=trace)
         rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
@@ -159,7 +197,7 @@ class DataParallelServingPool:
         t0 = time.monotonic()
         try:
             failpoint("replicas.failover")
-            idx = self._pick()
+            idx = self._pick(tracked.prompt_ids + tracked.emitted)
         except Exception:  # noqa: BLE001 — incl. injected faults: no replica
             self.failovers_failed += 1
             return False
@@ -201,6 +239,7 @@ class DataParallelServingPool:
             "healthy": len(self._healthy()),
             "failovers": self.failovers,
             "failovers_failed": self.failovers_failed,
+            "placement_hint_hits": self.placement_hint_hits,
             "active": sum(s["active"] for s in per),
             "pending": sum(s["pending"] for s in per),
             "tokens_emitted": sum(s["tokens_emitted"] for s in per),

@@ -93,6 +93,20 @@ class _SlotState:
     #: trace costs ~nothing per chunk
     trace: Optional[str] = None
     trace_sampled: bool = False
+    #: mixed-batch chunked prefill (paged mode): a slot is admitted in
+    #: "prefill" phase with NO device work done yet — its prompt is consumed
+    #: chunk-by-chunk inside decode rounds (the ragged dispatch) and the slot
+    #: flips to "decode" when the last chunk lands. ``prefill_key`` holds the
+    #: request's untouched PRNG key until the final chunk samples the first
+    #: token (so intervening decode rounds can't advance its stream).
+    phase: str = "decode"
+    prompt_ids: Optional[list[int]] = None
+    prefill_pos: int = 0
+    cached_len: int = 0
+    prefill_key: Any = None
+    prefill_chunks: int = 0
+    prefill_t0: float = 0.0
+    prefill_wall: float = 0.0
 
 
 @dataclass
@@ -116,9 +130,9 @@ class _Suspended:
 
     state: _SlotState
     host_kv: tuple  # (k, v) numpy [L, n_pages, page, Hkv, D]
-    length: int
-    last_token: int
-    slot_key: Any  # per-slot RNG key (reproducibility across the suspend)
+    length: int  # decode: valid kv length; prefill phase: prefill_pos
+    last_token: int  # meaningless for a prefill-phase suspend (no sample yet)
+    slot_key: Any  # per-slot RNG key (None for prefill phase: key untouched)
     suspended_at: float = field(default_factory=time.monotonic)
     #: wall-clock twin of suspended_at: the llm.preempt span emitted at
     #: resume is backdated to this (OTLP timestamps are unix-epoch ns)
@@ -257,6 +271,12 @@ class ContinuousBatchingEngine:
         #: serializes submit()'s bound check-and-put (many gateway threads)
         self._submit_lock = threading.Lock()
         self._suspended: "_deque[_Suspended]" = _deque()
+        #: mixed-batch chunked prefill (Sarathi-style piggybacking through the
+        #: ragged kernel) — paged mode only; dense mode has no page chains
+        self.mixed = self.paged and config.mixed_batch
+        #: slots currently in "prefill" phase, FIFO by admission — the chunk
+        #: planner fills the per-round token budget in this order
+        self._prefill_slots: "_deque[int]" = _deque()
         #: O(1) slot allocation: maintained at admit/finish/preempt/resume —
         #: invariant: set(_free_slots) == {i | not active[i]}
         self._free_slots: "_deque[int]" = _deque(range(self.n_slots))
@@ -283,6 +303,9 @@ class ContinuousBatchingEngine:
         self.decode_rounds = 0
         self.lookahead_rounds = 0
         self.coalesced_prefills = 0
+        self.mixed_rounds = 0
+        self.prefill_chunks = 0
+        self.chunked_prefill_tokens = 0
         self.occupancy_samples: "deque[int]" = deque(maxlen=1000)
         self.round_timings: "deque[dict]" = deque(maxlen=512)
         self.queue_wait_samples: "deque[float]" = deque(maxlen=2048)
@@ -372,6 +395,34 @@ class ContinuousBatchingEngine:
 
             self._paged_decode_fn = jax.jit(paged_decode_chunk,
                                             donate_argnums=(1, 2))
+
+            def mixed_step(params, k_pool, v_pool, page_table, q_ids, q_lens,
+                           prefill_hist, last_tokens, lengths, active,
+                           sample_mask, keys, temp, top_p, top_k):
+                """One ragged mixed-batch round: decode rows (q_len=1) take
+                their next token while prefill rows consume a prompt chunk —
+                one dispatch, no phase separation. ``sample_mask`` rows
+                (decode + final-chunk prefill) draw from their key stream;
+                everyone else's key is untouched, so a mid-prefill request's
+                seed reproduces exactly the phase-separated stream."""
+                q_ids = q_ids.at[:, 0].set(
+                    jnp.where(active, last_tokens, q_ids[:, 0]))
+                hist = jnp.where(active, lengths, prefill_hist)
+                hidden, pools = llama.forward_paged_mixed(
+                    params, cfg, q_ids, (k_pool, v_pool), page_table,
+                    hist, q_lens, rope)
+                last_h = llama.gather_last_hidden(hidden, q_lens)
+                logits = llama.lm_head_logits(params, cfg, last_h)
+                keys2, subs = split_keys_per_slot(keys)
+                nxt = sample_token_per_slot(logits, subs, temp, top_p, top_k)
+                keys_out = jnp.where(sample_mask[:, None], keys2, keys)
+                new_last = jnp.where(sample_mask, nxt, last_tokens)
+                new_lens = jnp.where(active, lengths + 1, 0)
+                toks = jnp.where(sample_mask, nxt, -1)
+                return (toks, pools[0], pools[1], new_last, keys_out,
+                        new_lens)
+
+            self._mixed_step_fn = jax.jit(mixed_step, donate_argnums=(1, 2))
         else:
             def insert(k_cache, v_cache, k_new, v_new, slot):
                 return llama.insert_slot_kv((k_cache, v_cache), (k_new, v_new), slot)
@@ -428,7 +479,7 @@ class ContinuousBatchingEngine:
                 "SamplingParams.seed requires the paged scheduler "
                 "(prefix_cache_pages > 0); dense mode shares one RNG stream")
         if not self.active_slots and not self._suspended \
-                and self._pending.qsize() == 0:
+                and not self._prefill_slots and self._pending.qsize() == 0:
             # idle→busy: restart the round-stall clock. last_round_at is
             # otherwise only refreshed by COMPLETED rounds, so after an
             # idle gap the doctor's scheduler_round watchdog would read
@@ -500,6 +551,7 @@ class ContinuousBatchingEngine:
             "round_p95_ms": round(p95, 3),
             "rounds": self.decode_rounds,
             "active": self.active_slots,
+            "prefilling": len(self._prefill_slots),
             "pending": self._pending.qsize(),
             "suspended": len(self._suspended),
             "oldest_pending_age_s": self.pending_oldest_age_s(),
@@ -538,12 +590,17 @@ class ContinuousBatchingEngine:
                 [t["host_emit_ms"] for t in timings]), 3),
             "lookahead": dict(self._lookahead_stats),
             "coalesced_prefills": self.coalesced_prefills,
+            # mixed-batch chunked prefill (ragged kernel piggybacking)
+            "mixed_rounds": self.mixed_rounds,
+            "prefill_chunks": self.prefill_chunks,
+            "chunked_prefill_tokens": self.chunked_prefill_tokens,
         }
         return {
             "broken": self._broken,
             "prefix_cache": self.pool.stats() if self.pool is not None else None,
             "slots": self.n_slots,
             "active": self.active_slots,
+            "prefilling": len(self._prefill_slots),
             "pending": self._pending.qsize(),
             "suspended": len(self._suspended),
             "preemptions": self.preemptions,
@@ -578,7 +635,9 @@ class ContinuousBatchingEngine:
         while not self._stop.is_set():
             try:
                 admitted = self._admit()
-                if not self.active.any():
+                # prefilling slots are work too: mixed-batch rounds must run
+                # even before any slot reaches decode phase
+                if not self.active.any() and not self._prefill_slots:
                     if admitted == 0:
                         self._wake.wait(timeout=0.1)
                         self._wake.clear()
@@ -603,6 +662,7 @@ class ContinuousBatchingEngine:
                             pass
                         self.slots[slot] = None
                 self.active[:] = False
+                self._prefill_slots.clear()
                 while self._suspended:  # preempted requests fail too
                     rec = self._suspended.popleft()
                     record_event(rec.state.request_id, "error",
@@ -738,18 +798,28 @@ class ContinuousBatchingEngine:
             state = rec.state
             state.chain = chain
             self.slots[slot] = state
-            self.active[slot] = True
-            self.lengths[slot] = rec.length
             s = state.sampling
             self._temp[slot] = s.temperature
             self._top_p[slot] = s.top_p
             self._top_k[slot] = s.top_k
-            self._patch_slot_device(slot, s.temperature, s.top_p, s.top_k,
-                                    rec.length, True)
-            i = jnp.asarray(slot, jnp.int32)
-            self._last_tokens = self._last_tokens.at[i].set(rec.last_token)
-            self._slot_keys = self._slot_keys.at[i].set(
-                jnp.asarray(rec.slot_key))
+            if state.phase == "prefill":
+                # a mid-chunked-prefill preempt: the slot re-enters the
+                # prefill queue and keeps chunking from prefill_pos; its key
+                # stream is still untouched (no sample happened yet)
+                self.active[slot] = False
+                self.lengths[slot] = 0
+                self._patch_slot_device(slot, s.temperature, s.top_p,
+                                        s.top_k, 0, False)
+                self._prefill_slots.append(slot)
+            else:
+                self.active[slot] = True
+                self.lengths[slot] = rec.length
+                self._patch_slot_device(slot, s.temperature, s.top_p,
+                                        s.top_k, rec.length, True)
+                i = jnp.asarray(slot, jnp.int32)
+                self._last_tokens = self._last_tokens.at[i].set(rec.last_token)
+                self._slot_keys = self._slot_keys.at[i].set(
+                    jnp.asarray(rec.slot_key))
             self.page_table[slot, :] = 0
             self.page_table[slot, : len(chain)] = chain
             self._mark_pt_row(slot)
@@ -759,6 +829,7 @@ class ContinuousBatchingEngine:
             self.resume_latency_samples.append(pause_s)
             record_recovery("scheduler.resume", pause_s)
             record_event(state.request_id, "resumed", slot=slot,
+                         phase=state.phase,
                          pause_ms=round(pause_s * 1000.0, 3))
             if state.trace_sampled:
                 # the pause a client stream actually experienced, as a span
@@ -793,7 +864,9 @@ class ContinuousBatchingEngine:
         taken: list[_Pending] = []
         spent = 0
         while len(taken) < len(self._free_slots):
-            if budget > 0 and spent >= budget and taken:
+            # mixed mode admits straight into prefill-phase slots (no device
+            # work here) — the budget paces CHUNKS per round, not admissions
+            if not self.mixed and budget > 0 and spent >= budget and taken:
                 break
             try:
                 req = self._pending.get_nowait()
@@ -824,6 +897,8 @@ class ContinuousBatchingEngine:
         """Partition taken requests into prefix-hit singles and coalesced cold
         groups, then prefill them into slots."""
         placed = 0
+        if self.mixed:
+            return self._place_mixed(reqs)
         #: (request, prematched): the ONE radix match per request — its pin is
         #: held from the probe here until _prefill_into_slot's release, so the
         #: cold batches admitted below cannot evict a just-classified prefix
@@ -885,6 +960,95 @@ class ContinuousBatchingEngine:
                 else:
                     placed += 1  # admitted; the emit callback raised post-hoc
         return placed
+
+    def _place_mixed(self, reqs: list[_Pending]) -> int:
+        """Mixed-batch admission: every request — cold or prefix-hit — claims
+        a slot in PREFILL phase with zero device work; the round loop then
+        piggybacks its prompt chunks into decode rounds. A prefix hit seeds
+        the slot's chain with the cached pages, so only the uncached suffix
+        is ever chunk-prefilled."""
+        placed = 0
+        self._assign_keys(reqs)
+        for i, req in enumerate(reqs):
+            slot = self._take_free_slot()
+            if slot is None:  # unreachable: takes are bounded by free slots
+                for dropped in reqs[i:]:
+                    logger.error("no free slot for %s; requeueing",
+                                 dropped.request_id)
+                    self._pending.put(dropped)
+                break
+            try:
+                self._admit_prefill_slot(slot, req)
+                placed += 1
+            except Exception:  # noqa: BLE001
+                log_tok = set_log_context(req.request_id,
+                                          traceparent_ids(req.trace)[0])
+                try:
+                    logger.exception("mixed admission failed for %s",
+                                     req.request_id)
+                finally:
+                    reset_log_context(log_tok)
+                if self._reclaim_failed_admission(slot):
+                    record_event(req.request_id, "error",
+                                 detail="mixed admission failed")
+                    try:
+                        req.emit(StepEvent(0, -1, "error"))
+                    except Exception:  # noqa: BLE001 — emit may be the fault
+                        pass
+                else:
+                    placed += 1
+        return placed
+
+    def _admit_prefill_slot(self, slot: int, req: _Pending) -> None:
+        """Claim a slot for chunked prefill. The chain starts as the prefix
+        cache's matched pages (slot-ref'd so tree eviction orphans rather
+        than frees them — the existing ref/orphan machinery); private pages
+        are allocated chunk-by-chunk as prefill progresses."""
+        cached_pages, cached_len = self.pool.match_prefix(req.prompt_ids)
+        chain = list(cached_pages)
+        if chain:
+            # refs (not the radix pin) protect the pages from here on
+            self.pool.ref_pages(chain)
+        # LOAD-BEARING for chain == [] too: a fully-cached prompt matches
+        # (and pins) tree nodes but match_prefix trims its page list to
+        # empty — this release is the only unpin for those nodes (same
+        # contract as the phase-separated cold path)
+        self.pool.release(req.prompt_ids)
+        s = req.sampling
+        try:
+            state = _SlotState(
+                request_id=req.request_id,
+                emit=req.emit,
+                sampling=s,
+                stops=frozenset(s.stop_token_ids)
+                | frozenset(self.config.eos_token_ids),
+                chain=chain,
+                trace=req.trace,
+                trace_sampled=traceparent_ids(req.trace)[1],
+                phase="prefill",
+                prompt_ids=list(req.prompt_ids),
+                prefill_pos=cached_len,
+                cached_len=cached_len,
+                prefill_key=req.key,
+                prefill_t0=time.monotonic(),
+                prefill_wall=time.time(),
+            )
+            self.slots[slot] = state
+            self.lengths[slot] = 0
+            self._temp[slot] = s.temperature
+            self._top_p[slot] = s.top_p
+            self._top_k[slot] = s.top_k
+            self.page_table[slot, :] = 0
+            self.page_table[slot, : len(chain)] = chain
+            self._mark_pt_row(slot)
+            self._patch_slot_device(slot, s.temperature, s.top_p, s.top_k,
+                                    0, False)
+        except Exception:
+            self.pool.release_slot(chain)
+            self.slots[slot] = None
+            raise
+        self._prefill_slots.append(slot)
+        self._epoch += 1
 
     def _prefill_batch(self, reqs: list[_Pending], bucket: int) -> int:
         """One multi-row prefill dispatch for coalesced COLD requests (paged
@@ -1222,25 +1386,33 @@ class ContinuousBatchingEngine:
     def _preempt_slot(self, slot: int, state: _SlotState) -> None:
         """Preempt-to-host, don't shed: save the chain's KV, free the pages,
         and park the request — _admit resumes it when space frees (no
-        recompute; the stream pauses, never errors)."""
+        recompute; the stream pauses, never errors). Works mid-chunked-
+        prefill too: the saved pages cover prefill_pos tokens and chunking
+        continues from there on resume."""
         chain = state.chain
+        is_prefill = state.phase == "prefill"
+        length = state.prefill_pos if is_prefill else int(self.lengths[slot])
         token = set_log_context(state.request_id,
                                 traceparent_ids(state.trace)[0])
         try:
             logger.warning("pool exhausted; preempting %s to host "
-                           "(len=%d, %d pages)", state.request_id,
-                           int(self.lengths[slot]), len(chain))
+                           "(%s len=%d, %d pages)", state.request_id,
+                           state.phase, length, len(chain))
         finally:
             reset_log_context(token)
         record_event(state.request_id, "preempted", slot=slot,
-                     length=int(self.lengths[slot]))
+                     phase=state.phase, length=length)
         host_kv = self.pool.save_chain_to_host(chain)
         self._suspended.append(_Suspended(
             state=state, host_kv=host_kv,
-            length=int(self.lengths[slot]),
-            last_token=int(np.asarray(self._last_tokens)[slot]),
-            slot_key=np.asarray(self._slot_keys[slot])))
+            length=length,
+            last_token=0 if is_prefill
+            else int(np.asarray(self._last_tokens)[slot]),
+            slot_key=None if is_prefill
+            else np.asarray(self._slot_keys[slot])))
         self.preemptions += 1
+        if is_prefill:
+            self._prefill_slots.remove(slot)
         self.active[slot] = False
         self.slots[slot] = None
         self._release_free_slot(slot)
@@ -1276,6 +1448,10 @@ class ContinuousBatchingEngine:
         Stop-token finishes stay unpredictable — the epoch check after
         emission discards the stale chunk in that case."""
         if self._stop.is_set() or inflight.epoch != self._epoch:
+            return False
+        if self._prefill_slots:
+            # pending prompt chunks: the next round is a mixed round, not the
+            # speculated pure-decode chunk — deterministic fallback to sync
             return False
         if self._free_slots and (self._suspended or not self._pending.empty()):
             return False  # an admission next round would invalidate it
@@ -1329,7 +1505,9 @@ class ContinuousBatchingEngine:
 
     def _record_round(self, dispatch_ms: float, sync_wait_ms: float,
                       host_emit_ms: float, lookahead: bool,
-                      ts: Optional[float] = None) -> None:
+                      ts: Optional[float] = None,
+                      mixed: bool = False,
+                      chunk_tokens: int = 0) -> None:
         """One timing-schema owner for both decode modes — the stats()
         percentile keys cannot drift between paged and dense. ``ts`` is the
         round's wall-clock start; /v1/monitoring/rounds exports these entries
@@ -1337,6 +1515,8 @@ class ContinuousBatchingEngine:
         self.decode_rounds += 1
         if lookahead:
             self.lookahead_rounds += 1
+        if mixed:
+            self.mixed_rounds += 1
         self.last_round_at = time.monotonic()
         self.round_timings.append({
             "ts": round(ts if ts is not None else time.time(), 6),
@@ -1345,6 +1525,8 @@ class ContinuousBatchingEngine:
             "sync_wait_ms": round(sync_wait_ms, 3),
             "host_emit_ms": round(host_emit_ms, 3),
             "lookahead": lookahead,
+            "mixed": mixed,
+            "chunk_tokens": chunk_tokens,
             "active": self.active_slots,
         })
 
@@ -1370,10 +1552,197 @@ class ContinuousBatchingEngine:
                     slot, int(chunk[slot, j]),
                     force_length=last_of_chunk and next_chunk_overflows)
 
+    # ------------------------------------------------------------ mixed round
+    def _plan_prefill_chunks(self) -> list[tuple[int, _SlotState, int]]:
+        """Assign this round's prompt chunks: fill ``prefill_budget_tokens``
+        across prefilling slots FIFO (admission order). The head slot always
+        gets at least one token, so a tiny budget cannot stall prefill; a
+        budget of 0 means one unbounded chunk (whole remaining prompt)."""
+        budget = self.config.prefill_budget_tokens
+        left = budget if budget > 0 else float("inf")
+        plan: list[tuple[int, _SlotState, int]] = []
+        for slot in list(self._prefill_slots):
+            if left <= 0:
+                break
+            state = self.slots[slot]
+            if state is None or state.phase != "prefill":
+                continue  # defensive: the deque tracks prefill-phase slots
+            remaining = len(state.prompt_ids) - state.prefill_pos
+            chunk = int(min(remaining, left)) if left != float("inf") \
+                else remaining
+            if chunk <= 0:
+                continue
+            plan.append((slot, state, chunk))
+            left -= chunk
+        return plan
+
+    def _grow_chain_prefill(self, slot: int, state: _SlotState,
+                            chunk: int) -> None:
+        """Extend a prefilling slot's chain to cover its next chunk's pages
+        (a chunk may cross page boundaries). Raises MemoryError when the pool
+        cannot serve it even after eviction — the caller preempts-to-host and
+        chunking resumes where it left off."""
+        # armed MemoryError forces the preempt-mid-chunked-prefill path with
+        # no real pool pressure (faultlab mixed-prefill-preempt scenario)
+        failpoint("scheduler.prefill_chunk")
+        chain = state.chain
+        needed = state.prefill_pos + chunk
+        if self.pool.pages_for(needed) <= len(chain):
+            return
+        before = len(chain)
+        self.pool.extend_chain(chain, needed)
+        self.page_table[slot, before: len(chain)] = chain[before:]
+        self._mark_pt_row(slot)
+
+    def _finish_prefill(self, slot: int, state: _SlotState, tok: int) -> None:
+        """Flip a fully-prefilled slot to decode: commit the prompt's full
+        pages to the radix tree (later requests reuse them zero-copy),
+        activate the slot's device rows, and emit the first token (sampled
+        inside the same mixed dispatch that ran the final chunk)."""
+        T = len(state.prompt_ids)
+        try:
+            self.pool.commit_chain(state.prompt_ids, state.chain)
+        except Exception:  # noqa: BLE001 — the cache insert is best-effort
+            logger.exception("prefix-tree commit failed for %s",
+                             state.request_id)
+        state.phase = "decode"
+        self._prefill_slots.remove(slot)
+        self.lengths[slot] = T
+        self.active[slot] = True
+        s = state.sampling
+        self._patch_slot_device(slot, s.temperature, s.top_p, s.top_k, T, True)
+        self._epoch += 1
+        dur_ms = (time.monotonic() - state.prefill_t0) * 1000.0
+        # same terminal "prefill" event as the phase-separated path (ttft
+        # anchors here); the per-chunk progress lives in prefill_chunk events
+        record_event(state.request_id, "prefill", slot=slot, mixed=True,
+                     cached_len=state.cached_len, prompt_tokens=T,
+                     chunks=state.prefill_chunks, dur_ms=round(dur_ms, 3))
+        if state.trace:
+            get_global_tracer().emit_span(
+                "llm.prefill", traceparent=state.trace,
+                start_unix_ns=int(state.prefill_wall * 1e9),
+                duration_ms=dur_ms, request_id=state.request_id, slot=slot,
+                prompt_tokens=T, cached_len=state.cached_len, mixed=True,
+                chunks=state.prefill_chunks)
+        no_room = T + self._k_steps > self.config.max_seq_len
+        self._emit_token(slot, tok, force_length=no_room)
+
+    def _decode_round_mixed(self) -> None:
+        """One ragged mixed-batch round: decode rows advance ONE token while
+        this round's prompt chunks (≤ prefill_budget_tokens, FIFO across
+        prefilling slots) run in the SAME dispatch through the ragged paged
+        kernel — Sarathi-style piggybacking with no phase separation, so an
+        arrival burst never stalls in-flight streams behind a prefill drain.
+        Lookahead never spans a mixed round (_can_lookahead gates on prefill
+        work — the deterministic fallback), so any in-flight speculative
+        chunk here is stale by construction and is discarded."""
+        t0 = time.monotonic()
+        wall0 = time.time()
+        inflight, self._inflight = self._inflight, None
+        if inflight is not None:
+            self._discard_inflight(inflight)
+        # capacity: decode rows keep a full chunk of headroom (the invariant
+        # every round preserves); prefill rows cover their chunk's pages.
+        # MemoryError on either path preempts-to-host.
+        self._ensure_chunk_capacity(self._k_steps)
+        plan: list[tuple[int, _SlotState, int]] = []
+        for slot, state, chunk in self._plan_prefill_chunks():
+            try:
+                self._grow_chain_prefill(slot, state, chunk)
+                plan.append((slot, state, chunk))
+            except MemoryError:
+                self._preempt_slot(slot, state)
+        if not plan:
+            # every planned slot got preempted (or flipped): the next loop
+            # pass runs a plain decode round / resumes from host
+            return
+        n = self.n_slots
+        max_chunk = max(c for _, _, c in plan)
+        # static dispatch width: the prefill bucket covering the largest
+        # chunk, rounded to the kernel's q_block (bounded compile variants)
+        q_max = -(-self._bucket_for(max_chunk) // 8) * 8
+        q_ids = np.zeros((n, q_max), np.int32)
+        q_lens = np.zeros(n, np.int32)
+        hist = np.zeros(n, np.int32)
+        q_lens[self.active] = 1  # decode rows
+        sample = self.active.copy()
+        finals: list[tuple[int, _SlotState]] = []
+        for slot, state, chunk in plan:
+            pos = state.prefill_pos
+            q_ids[slot, :chunk] = state.prompt_ids[pos: pos + chunk]
+            q_lens[slot] = chunk
+            hist[slot] = pos
+            if pos + chunk == len(state.prompt_ids):
+                # final chunk: this dispatch samples the first token — hand
+                # the request's untouched key stream to the device row NOW
+                finals.append((slot, state))
+                sample[slot] = True
+                i = jnp.asarray(slot, jnp.int32)
+                self._slot_keys = self._slot_keys.at[i].set(
+                    jnp.asarray(state.prefill_key))
+        self._flush_pt_patches()
+        toks_dev, k_pool, v_pool, last_o, keys_o, lens_o = self._mixed_step_fn(
+            self.params, self.pool.k_pool, self.pool.v_pool,
+            self._page_table_dev, jnp.asarray(q_ids), jnp.asarray(q_lens),
+            jnp.asarray(hist), self._last_tokens, self._lengths_dev,
+            self._active_dev, jnp.asarray(sample), self._slot_keys,
+            self._temp_dev, self._top_p_dev, self._top_k_dev)
+        self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
+        t1 = time.monotonic()
+        toks = np.asarray(toks_dev, np.int32)  # sync-point: mixed-round readback (AS04)
+        t2 = time.monotonic()
+        self._last_tokens = last_o
+        self._slot_keys = keys_o
+        self._lengths_dev = lens_o
+        decode_rows = [s for s in range(n) if self.active[s]]
+        old_lengths = self.lengths.copy()
+        self.lengths = np.where(self.active, self.lengths + 1,
+                                self.lengths).astype(np.int32)
+        self._emit_decode_spans(wall0, (t2 - t0) * 1000.0, lookahead=False,
+                                rows=decode_rows, tokens=1)
+        for slot, state, chunk in plan:
+            state.prefill_pos += chunk
+            state.prefill_chunks += 1
+            self.prefill_chunks += 1
+            self.chunked_prefill_tokens += chunk
+            # one event per piggybacked chunk (mirrors decode_chunk): the
+            # request timeline shows interleaved prefill progress
+            record_event(state.request_id, "prefill_chunk", slot=slot,
+                         tokens=chunk, pos=state.prefill_pos,
+                         of=len(state.prompt_ids))
+            if state.trace_sampled:
+                get_global_tracer().emit_span(
+                    "llm.prefill_chunk", traceparent=state.trace,
+                    start_unix_ns=int(wall0 * 1e9),
+                    duration_ms=(t2 - t0) * 1000.0,
+                    request_id=state.request_id, slot=slot, tokens=chunk)
+        for slot, state in finals:
+            self._finish_prefill(slot, state, int(toks[slot]))
+        for slot in decode_rows:
+            state = self.slots[slot]
+            if state is None or not self.active[slot]:
+                continue
+            record_event(state.request_id, "decode_chunk", slot=slot,
+                         tokens=1)
+            # keep the invariant: after this token the slot must still fit a
+            # full decode chunk, else finish with 'length' now
+            no_room = (int(old_lengths[slot]) + 1 + self._k_steps
+                       > self.config.max_seq_len)
+            self._emit_token(slot, int(toks[slot]), force_length=no_room)
+        t3 = time.monotonic()
+        self._record_round((t1 - t0) * 1000.0, (t2 - t1) * 1000.0,
+                           (t3 - t2) * 1000.0, lookahead=False, ts=wall0,
+                           mixed=True,
+                           chunk_tokens=sum(c for _, _, c in plan))
+
     def _decode_round(self) -> None:
         self.occupancy_samples.append(self.active_slots)
         if not self.paged:
             self._decode_round_dense()
+            return
+        if self.mixed and self._prefill_slots:
+            self._decode_round_mixed()
             return
         t0 = time.monotonic()
         wall0 = time.time()
@@ -1414,15 +1783,17 @@ class ContinuousBatchingEngine:
                            (t4 - t3) * 1000.0, used_lookahead, ts=wall0)
 
     def _emit_decode_spans(self, wall0: float, dur_ms: float,
-                           lookahead: bool) -> None:
+                           lookahead: bool, rows: Optional[list[int]] = None,
+                           tokens: Optional[int] = None) -> None:
         """llm.decode_chunk spans for SAMPLED in-flight requests — called
         before the emit loop (a mid-chunk finish clears the slot state). The
         guard is one bool attribute per slot: an unsampled or traceless
         request pays nothing here (the disarmed-failpoint pattern; the
-        bench.py --trace-guard A/B holds this under 1% tok/s)."""
-        k = self._k_steps
+        bench.py --trace-guard A/B holds this under 1% tok/s). Mixed rounds
+        pass ``rows`` (their decode rows only) and ``tokens=1``."""
+        k = tokens if tokens is not None else self._k_steps
         start_ns = int(wall0 * 1e9)
-        for slot in range(self.n_slots):
+        for slot in (rows if rows is not None else range(self.n_slots)):
             state = self.slots[slot]
             if state is None or not state.trace_sampled or not self.active[slot]:
                 continue

@@ -13,14 +13,20 @@ paths (preemption, resume, terminal shed, slot churn).
 
 Invariants audited after every step:
 
-  A1 slot/state     active[i] ⇔ slots[i] is not None
-  A2 table hygiene  inactive slots have all-zero page-table rows
-  A3 chain/table    active slot i: page_table[i,:len(chain)] == chain,
-                    zeros after; chain covers lengths[i] tokens; no dups
+  A1 slot/state     empty slot ⇔ inactive ∧ untracked; occupied slot is
+                    either decode-phase (active) or — mixed batching —
+                    prefill-phase (inactive AND tracked in _prefill_slots,
+                    its prompt consumed chunk-by-chunk inside rounds)
+  A2 table hygiene  empty slots have all-zero page-table rows
+  A3 chain/table    occupied slot i: page_table[i,:len(chain)] == chain,
+                    zeros after; chain covers the slot's covered tokens
+                    (lengths[i] for decode, prefill_pos for prefill); no dups
   A4 ref coverage   a page in k live chains has pool refcount ≥ k
   A5 chunk room     active slots satisfy lengths[i] + k ≤ max_seq
   A6 suspension     suspended records hold host KV, not pool pages
-                    (their lengths are preserved for resume)
+                    (their lengths are preserved for resume; a mid-chunked-
+                    prefill suspend may carry pages beyond prefill_pos when
+                    chain growth outran the fault)
   A7 pool audit     the pool-level invariants (conservation, orphan/ref
                     sanity) from the pool model checker, re-checked here
                     under real device traffic
@@ -35,11 +41,12 @@ from cyberfabric_core_tpu.runtime import EngineConfig, SamplingParams
 from cyberfabric_core_tpu.runtime.scheduler import ContinuousBatchingEngine
 
 
-def _make_engine(slots: int = 2, max_seq: int = 64, pages: int = 0):
+def _make_engine(slots: int = 2, max_seq: int = 64, pages: int = 0,
+                 mixed: bool = True):
     cfg = EngineConfig(model="tiny-llama", max_seq_len=max_seq,
                        max_batch=slots, decode_chunk=4, use_flash=False,
                        prefix_cache_pages=pages or 1,  # >0 → paged
-                       prefix_page_size=16)
+                       prefix_page_size=16, mixed_batch=mixed)
     eng = ContinuousBatchingEngine(cfg, seed=0)
     eng.start = lambda: None  # drive synchronously — no scheduler thread
     return eng
@@ -72,16 +79,32 @@ class Harness:
         eng = self.eng
         pool = eng.pool
         k = eng._k_steps
+        prefilling = set(eng._prefill_slots)
         for i in range(eng.n_slots):
-            # A1
-            assert bool(eng.active[i]) == (eng.slots[i] is not None), \
-                f"A1 slot {i} {ctx}"
-            if eng.slots[i] is None:
+            state = eng.slots[i]
+            if state is None:
+                # A1 empty slot: inactive and not tracked as prefilling
+                assert not bool(eng.active[i]), \
+                    f"A1 active empty slot {i} {ctx}"
+                assert i not in prefilling, \
+                    f"A1 empty slot {i} in prefill queue {ctx}"
                 # A2
                 assert not eng.page_table[i].any(), \
                     f"A2 stale page-table row {i}: {eng.page_table[i]} {ctx}"
                 continue
-            state = eng.slots[i]
+            # A1 occupied: decode-phase ⇔ active; prefill-phase slots (mixed
+            # batching) are inactive and tracked in the prefill queue
+            if state.phase == "prefill":
+                assert not bool(eng.active[i]), \
+                    f"A1 prefill slot {i} marked active {ctx}"
+                assert i in prefilling, \
+                    f"A1 prefill slot {i} not in prefill queue {ctx}"
+                covered = state.prefill_pos
+            else:
+                assert bool(eng.active[i]), f"A1 slot {i} {ctx}"
+                assert i not in prefilling, \
+                    f"A1 decode slot {i} in prefill queue {ctx}"
+                covered = int(eng.lengths[i])
             chain = state.chain
             assert chain is not None
             # A3
@@ -90,11 +113,16 @@ class Harness:
                 f"A3 table/chain mismatch slot {i} {ctx}"
             assert not eng.page_table[i, len(chain):].any(), \
                 f"A3 trailing garbage slot {i} {ctx}"
-            assert pool.pages_for(int(eng.lengths[i])) <= len(chain), \
-                f"A3 chain short: len={eng.lengths[i]} chain={chain} {ctx}"
-            # A5 (post-round: finished-on-room slots were emitted 'length')
-            assert int(eng.lengths[i]) + k <= eng.config.max_seq_len, \
-                f"A5 slot {i} len={eng.lengths[i]} {ctx}"
+            assert pool.pages_for(covered) <= len(chain), \
+                f"A3 chain short: covered={covered} chain={chain} {ctx}"
+            # A5 (post-round: finished-on-room slots were emitted 'length');
+            # prefill-phase slots hold lengths[i] == 0 until their flip
+            if state.phase == "prefill":
+                assert int(eng.lengths[i]) == 0, \
+                    f"A5 prefill slot {i} len={eng.lengths[i]} {ctx}"
+            else:
+                assert int(eng.lengths[i]) + k <= eng.config.max_seq_len, \
+                    f"A5 slot {i} len={eng.lengths[i]} {ctx}"
         # A4
         page_users: dict[int, int] = {}
         for i in range(eng.n_slots):
@@ -106,8 +134,15 @@ class Harness:
                 f"A4 page {p} users={users} refs={pool._refs.get(p)} {ctx}"
         # A6
         for rec in eng._suspended:
-            assert rec.host_kv[0].shape[1] == pool.pages_for(rec.length), \
-                f"A6 suspended shape {ctx}"
+            pages = pool.pages_for(rec.length)
+            if rec.state.phase == "prefill":
+                # the chunk's chain growth may have outrun prefill_pos when
+                # the pressure hit — saved pages cover AT LEAST the position
+                assert rec.host_kv[0].shape[1] >= pages, \
+                    f"A6 suspended prefill shape {ctx}"
+            else:
+                assert rec.host_kv[0].shape[1] == pages, \
+                    f"A6 suspended shape {ctx}"
         # A7 — pool-level conservation + sanity under real traffic
         tracked = set(pool._tree_owned) | set(pool._orphans) | set(pool._refs)
         assert pool.capacity_pages - pool.allocator.num_free == len(tracked), \
@@ -119,15 +154,19 @@ class Harness:
     def step(self, ctx: str) -> None:
         self.eng._admit()
         self.audit(f"{ctx}/post-admit")
-        if self.eng.active.any():
+        # prefilling slots are work too: mixed-batch rounds run their chunks
+        if self.eng.active.any() or self.eng._prefill_slots:
             self.eng._decode_round()
             self.audit(f"{ctx}/post-round")
 
 
-def test_churn_schedule_holds_invariants():
+@pytest.mark.parametrize("mixed", [True, False],
+                         ids=["mixed", "phase-separated"])
+def test_churn_schedule_holds_invariants(mixed):
     """Slot churn: more requests than slots, staggered lengths — admission,
-    completion, and slot reuse audited at every step."""
-    eng = _make_engine(slots=2, max_seq=64)
+    completion, and slot reuse audited at every step (both scheduling
+    modes: mixed-batch chunked prefill and the phase-separated baseline)."""
+    eng = _make_engine(slots=2, max_seq=64, mixed=mixed)
     h = Harness(eng)
     prompts = [list(range(10, 10 + n)) for n in (5, 9, 17, 7, 12)]
     for i, p in enumerate(prompts):

@@ -94,6 +94,40 @@ def test_replicas_agree_greedy():
         pool.shutdown()
 
 
+def test_cache_aware_placement_prefers_warm_replica():
+    """RTP-LLM's routing recipe: a request whose prompt head is already in
+    one replica's prefix cache routes there (within the load slack) instead
+    of to the bare least-loaded replica — the prefill skip beats a marginal
+    load difference. Falls back to the existing policy when nothing
+    matches."""
+    cfg = _cfg(prefix_cache_pages=80, prefix_page_size=16)
+    pool = DataParallelServingPool(cfg, n_replicas=2, seed=0)
+    try:
+        rng = np.random.default_rng(4)
+        head = rng.integers(3, 900, 48).tolist()  # 3 full pages
+        first = _run(pool, head + rng.integers(3, 900, 6).tolist())
+        assert first["finish"] is not None
+        hits_before = pool.placement_hint_hits
+        # the replica that served request 1 now caches the head's pages —
+        # the probe must find it and the counter must record the hint
+        warm = [i for i, r in enumerate(pool.replicas)
+                if r.pool.peek_prefix_len(head + [999]) > 0]
+        assert len(warm) == 1, "exactly one replica should be warm"
+        second = _run(pool, head + rng.integers(3, 900, 8).tolist())
+        assert second["finish"] is not None
+        assert pool.placement_hint_hits > hits_before
+        served = pool.replicas[warm[0]].stats()
+        assert served["requests_completed"] >= 2, \
+            "second request was not routed to the warm replica"
+        assert pool.stats()["placement_hint_hits"] > hits_before
+        # a cold prompt takes the plain least-loaded path (no hint bump)
+        cold_hits = pool.placement_hint_hits
+        _run(pool, rng.integers(3, 900, 20).tolist())
+        assert pool.placement_hint_hits == cold_hits
+    finally:
+        pool.shutdown()
+
+
 def test_failover_resumes_on_survivor():
     """Breaking one replica mid-stream fails over; the client still gets a
     complete, uninterrupted token stream."""

@@ -278,16 +278,21 @@ def test_stats_surface_pipeline_breakdown():
 def test_coalesced_prefill_matches_single_prefill_streams():
     """Cold same-bucket arrivals coalesce into one multi-row prefill; per-row
     key streams must make every request's tokens identical to the
-    one-at-a-time admission path."""
+    one-at-a-time admission path. (Pins the PHASE-SEPARATED prefill path —
+    mixed_batch=False — which stays supported as the mixed-batch A/B
+    baseline; under mixed batching prompts are chunk-piggybacked instead of
+    coalesced, see tests/test_mixed_batch.py.)"""
     rng = np.random.default_rng(9)
     # same bucket (16): lengths 10..13, distinct content, seeded sampling
     prompts = [rng.integers(3, 900, 10 + i).tolist() for i in range(4)]
     samplings = [SamplingParams(max_tokens=16, temperature=0.7, seed=70 + i)
                  for i in range(4)]
     co_col, co_stats = _run_streams(
-        _cfg(prefill_coalesce=4, decode_lookahead=False), prompts, samplings)
+        _cfg(prefill_coalesce=4, decode_lookahead=False, mixed_batch=False),
+        prompts, samplings)
     single_col, _ = _run_streams(
-        _cfg(prefill_coalesce=1, decode_lookahead=False), prompts, samplings)
+        _cfg(prefill_coalesce=1, decode_lookahead=False, mixed_batch=False),
+        prompts, samplings)
     assert co_col.tokens == single_col.tokens
     assert co_stats["pipeline"]["coalesced_prefills"] >= 1, \
         "coalescing never triggered — the equivalence is vacuous"
