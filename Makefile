@@ -5,9 +5,9 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench
+.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench
 
-safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench  ## the full local gate
+safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
 
@@ -53,6 +53,10 @@ doctor-guard:  ## fabric-doctor armed-vs-stubbed overhead A/B under the aggregat
 ragged-bench:  ## ragged mixed-batch kernel/scheduler tests + the mixed-vs-phase-separated A/B (BENCH_RAGGED.json: itl_p99 + ttft must improve)
 	$(PY) -m pytest tests/test_ragged_attention.py tests/test_mixed_batch.py -q
 	$(PY) bench.py --ragged-bench > /dev/null
+
+overlap-bench:  ## deep-lookahead pipeline tests + the depth 0/1/N sweep (BENCH_OVERLAP.json: overlap_ratio > 0.85 at depth >= 2)
+	$(PY) -m pytest tests/test_scheduler_pipeline.py -q
+	$(PY) bench.py --overlap-bench > /dev/null
 
 test:  ## full suite
 	$(PY) -m pytest tests/ -q

@@ -175,7 +175,16 @@ def _run_engine_scenario(spec: dict) -> ScenarioResult:
     checkers = list(spec.get("invariants", ["exactly_one_terminal"]))
     evidence: dict[str, Any] = {"expect_error": spec.get("expect_error", [])}
     if "streams_match_baseline" in checkers:
-        evidence["baseline"] = _baseline_streams(spec, cfg, load)
+        # ``baseline_engine`` overrides the baseline run's EngineConfig on
+        # top of the faulted run's (e.g. decode_lookahead: 0 pins the fully
+        # synchronous scheduler) — the deep-lookahead scenarios use it to
+        # assert depth-N + faults ≡ depth-0 unfaulted, not just
+        # faulted ≡ unfaulted at the same depth
+        base_over = spec.get("baseline_engine")
+        base_cfg = (_engine_config({**spec, "engine": {
+            **(spec.get("engine") or {}), **base_over}})
+            if base_over else cfg)
+        evidence["baseline"] = _baseline_streams(spec, base_cfg, load)
     fp.configure(seed)
     streams, engine = _drive_engine(cfg, load, list(spec.get("faults", [])),
                                     stagger_s=float(spec.get("stagger_s", 0)))

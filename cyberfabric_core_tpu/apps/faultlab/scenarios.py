@@ -125,6 +125,43 @@ BUILTIN_SCENARIOS: list[dict[str, Any]] = [
         "expect_stats": {"preemptions": [1, None]},
     },
     {
+        "name": "deep-lookahead-fault",
+        "kind": "engine",
+        "seed": 108,
+        # a 3-deep epoch ring with device-side termination: every readback
+        # drain is delayed while up to 3 speculative chunks are in flight.
+        # Streams must stay bit-identical to the fully SYNCHRONOUS scheduler
+        # (baseline_engine pins depth 0 — the golden depth-equivalence
+        # contract, exercised under fault pressure), every client gets
+        # exactly one terminal, and nothing leaks with a ring in flight.
+        "engine": {**_TINY, "decode_lookahead": 3},
+        "baseline_engine": {"decode_lookahead": 0},
+        "load": {**_LOAD, "max_tokens": 16},
+        "faults": [{"point": "scheduler.readback", "spec": "delay(0.05)"}],
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "engine_accounting"],
+    },
+    {
+        "name": "mid-ring-preempt",
+        "kind": "engine",
+        "seed": 109,
+        # pool pressure while a 3-deep ring is in flight: armed MemoryErrors
+        # first CAP the ring (extension attempts absorb hits, no preempt),
+        # then — once the ring drains to a synchronous round — force a real
+        # preempt-to-host. 8 hits guarantee the preempt lands regardless of
+        # where the ring absorbs the early ones (ring depth ≤ 3 absorptions
+        # per drain cycle). The preempted stream must resume bit-identical
+        # to the depth-0 baseline with zero page/slot leaks.
+        "engine": {**_TINY, "decode_lookahead": 3},
+        "baseline_engine": {"decode_lookahead": 0},
+        "load": _LOAD,
+        "faults": [{"point": "scheduler.page_alloc",
+                    "spec": "8*raise(MemoryError)"}],
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "engine_accounting"],
+        "expect_stats": {"preemptions": [1, None]},
+    },
+    {
         "name": "resume-crash",
         "kind": "engine",
         "seed": 105,

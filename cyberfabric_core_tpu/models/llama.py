@@ -350,6 +350,7 @@ def forward_paged_decode(
     lengths: jnp.ndarray,      # [B] int32 current valid length (BEFORE this token)
     rope_tables: tuple[jnp.ndarray, jnp.ndarray],
     interpret: bool | None = None,
+    write_mask: jnp.ndarray | None = None,  # [B] bool; False rows → scratch
 ) -> tuple[jnp.ndarray, PagedPools]:
     """One decode step over the paged KV pool. Returns (hidden [B,1,H], pools).
 
@@ -358,6 +359,9 @@ def forward_paged_decode(
     tokens present, not n_slots × max_seq. Pages may be shared across slots
     (prefix cache) — they are only ever read here; writes target each slot's
     private tail page (admission guarantees the tail page is unshared).
+    ``write_mask`` (device-side termination): rows marked False — frozen by
+    the decode program's finished mask — redirect their k/v scatter to
+    scratch page 0 instead of re-writing position ``lengths`` of their chain.
     """
     from ..ops.paged_attention import paged_decode_attention
 
@@ -372,6 +376,9 @@ def forward_paged_decode(
     idx_page = lengths // page_size
     pid = jnp.take_along_axis(page_table, idx_page[:, None], axis=1)[:, 0]
     off = lengths % page_size
+    if write_mask is not None:
+        pid = jnp.where(write_mask, pid, 0)
+        off = jnp.where(write_mask, off, 0)
 
     h = _embed_scale(embed_lookup(params["embed"], input_ids, params["final_norm"].dtype), cfg)
 
@@ -416,6 +423,7 @@ def forward_paged_mixed(
     q_lens: jnp.ndarray,       # [B] int32 span length (0 = idle row)
     rope_tables: tuple[jnp.ndarray, jnp.ndarray],
     interpret: bool | None = None,
+    write_mask: jnp.ndarray | None = None,  # [B] bool; False rows → scratch
 ) -> tuple[jnp.ndarray, PagedPools]:
     """One ragged mixed-batch step over the paged KV pool: decode rows
     (q_len=1) and chunked-prefill rows (q_len=chunk) in one dispatch.
@@ -426,6 +434,8 @@ def forward_paged_mixed(
     resolution); attention runs the ragged paged kernel, causal relative to
     each row's own history. Padding positions scatter to scratch page 0 and
     produce garbage hidden states that nothing downstream reads.
+    ``write_mask`` rows marked False (frozen by device-side termination)
+    scatter to scratch page 0 as padding does.
     """
     from ..ops.paged_attention import ragged_paged_attention
 
@@ -438,6 +448,8 @@ def forward_paged_mixed(
 
     offs = jnp.arange(Qmax, dtype=jnp.int32)[None, :]          # [1, Qmax]
     valid = offs < q_lens[:, None]                             # [B, Qmax]
+    if write_mask is not None:
+        valid = valid & write_mask[:, None]
     positions = jnp.where(valid, hist[:, None] + offs, 0)
     # per-token write targets; padding targets scratch page 0 (harmless)
     pid = jnp.where(

@@ -39,6 +39,33 @@ logger = logging.getLogger("llm_worker")
 _STREAM_END = object()
 
 
+def _parse_lookahead(raw: Any) -> int:
+    """Registry `decode_lookahead` option → ring depth. Digits are a depth
+    (0 = synchronous, N = N-deep ring); legacy bool words map to 0 / the
+    EngineConfig default (the same True→default rule as
+    EngineConfig.resolve_lookahead_depth); unset keeps the default. An
+    unparseable string falls back to the default with a warning — registry
+    junk must not crash worker startup (the pre-ring word parser was
+    tolerant the same way)."""
+    default = EngineConfig.decode_lookahead
+    if raw is None:
+        return default
+    if isinstance(raw, bool):
+        return default if raw else 0
+    word = str(raw).strip().lower()
+    if word in ("0", "false", "no", "off"):
+        return 0
+    if word in ("true", "yes", "on", ""):
+        return default
+    try:
+        return max(0, int(float(word)))
+    except ValueError:
+        logger.warning("engine_options.decode_lookahead=%r is not a depth "
+                       "or bool word; using the default depth %d", raw,
+                       default)
+        return default
+
+
 @dataclass
 class _Request:
     prompt_ids: list[int]
@@ -273,12 +300,13 @@ class LocalTpuWorker(LlmWorkerApi):
             prefix_cache_pages=int(opts.pop("prefix_cache_pages", default_pages)),
             prefix_page_size=page_size,
             # scheduler pipeline knobs (docs/ARCHITECTURE.md "Scheduler
-            # pipeline"): lookahead overlap, Sarathi-style admission budget,
-            # cold-prefill coalescing. Registry options can arrive as strings
-            # — bool("false") is True, so parse the words, not the truthiness.
-            decode_lookahead=str(opts.pop("decode_lookahead", True)
-                                 ).strip().lower() not in ("0", "false", "no",
-                                                           "off"),
+            # pipeline"): lookahead ring depth, Sarathi-style admission
+            # budget, cold-prefill coalescing. Registry options can arrive as
+            # strings — bool("false") is True, so parse the words, not the
+            # truthiness; digits are a ring DEPTH (0=sync, N=N-deep), bool
+            # words map to off / the EngineConfig default depth.
+            decode_lookahead=_parse_lookahead(
+                opts.pop("decode_lookahead", None)),
             prefill_budget_tokens=int(opts.pop("prefill_budget_tokens", 512)),
             prefill_coalesce=int(opts.pop("prefill_coalesce", 4)),
             # ragged mixed-batch rounds: prefill chunks piggyback into decode

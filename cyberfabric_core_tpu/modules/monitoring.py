@@ -188,6 +188,40 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
             "Decode rounds served by a lookahead-dispatched chunk (0..1)"
         ).set_function(decode_overlap_ratio)
 
+        # deep lookahead (the epoch ring): mean achieved ring depth at drain
+        # time across schedulers, and what fraction of speculative dispatches
+        # were discarded as stale — both read off the same counters
+        # stats()["pipeline"] exposes, so REST and dashboards cannot drift
+        def lookahead_depth() -> float:
+            weighted = total = 0
+            for sched in _schedulers():
+                try:  # scheduler thread inserts new depth keys mid-copy
+                    hist = dict(getattr(sched, "_depth_hist", {}))
+                except RuntimeError:
+                    continue  # advisory metric: skip this scrape
+                for d, n in hist.items():
+                    weighted += int(d) * n
+                    total += n
+            return weighted / total if total else 0.0
+
+        self.registry.gauge(
+            "llm_lookahead_depth",
+            "Mean lookahead-ring depth still in flight at chunk drain time"
+        ).set_function(lookahead_depth)
+
+        def lookahead_discard_ratio() -> float:
+            dispatched = discarded = 0
+            for sched in _schedulers():
+                la = dict(getattr(sched, "_lookahead_stats", {}))
+                dispatched += la.get("dispatched", 0)
+                discarded += la.get("discarded", 0)
+            return discarded / dispatched if dispatched else 0.0
+
+        self.registry.gauge(
+            "llm_lookahead_discard_ratio",
+            "Speculative decode chunks discarded as stale / dispatched (0..1)"
+        ).set_function(lookahead_discard_ratio)
+
         # prefix-cache effectiveness (ROADMAP item 1's metrics half): the
         # fraction of prefill tokens the radix cache let admission skip, and
         # the cumulative tokens saved — both read straight off the pools'

@@ -88,6 +88,7 @@ def serving_programs(
     max_batch: int = 8,
     page_size: int = 64,
     max_seq_len: int = 2048,
+    device_stop_width: int = 8,
 ) -> dict[str, tuple[Any, tuple]]:
     """name → (fn, abstract_args): the scheduler's program set, abstracted.
 
@@ -126,22 +127,41 @@ def serving_programs(
     pool_sds = sds((cfg.num_layers, n_pages, page_size, cfg.num_kv_heads,
                     cfg.head_dim), dtype)
 
-    def paged_decode_chunk(params, k_pool, v_pool, page_table, last_tokens,
-                           lengths, active, keys, temp, top_p, top_k):
-        def step(carry, _):
-            pools, toks, lens, keys = carry
-            hidden, pools = llama.forward_paged_decode(
-                params, cfg, toks[:, None], pools, page_table, lens, rope)
-            logits = llama.lm_head_logits(params, cfg, hidden[:, 0, :])
-            keys, subs = split_keys_per_slot(keys)
-            nxt = sample_token_per_slot(logits, subs, temp, top_p, top_k)
-            return (pools, nxt, lens + 1, keys), nxt
+    # device-side termination mirror (runtime/scheduler.py): per-slot stop-id
+    # rows (-1 padded to device_stop_width — must match the serving
+    # EngineConfig or the AOT cache misses), max-tokens length limits, and a
+    # finished mask that freezes rows so the deep-lookahead ring survives
+    # finishes
+    stop_width = device_stop_width
 
-        (pools, last, lens, keys), toks = jax.lax.scan(
-            step, ((k_pool, v_pool), last_tokens, lengths, keys),
-            None, length=decode_chunk)
+    def paged_decode_chunk(params, k_pool, v_pool, page_table, last_tokens,
+                           lengths, active, finished, stop_ids, limit_lens,
+                           keys, temp, top_p, top_k):
+        def step(carry, j):
+            pools, toks, lens, fin, keys = carry
+            run = active & jnp.logical_not(fin)
+            hidden, pools = llama.forward_paged_decode(
+                params, cfg, toks[:, None], pools, page_table, lens, rope,
+                write_mask=run)
+            logits = llama.lm_head_logits(params, cfg, hidden[:, 0, :])
+            keys2, subs = split_keys_per_slot(keys)
+            nxt = sample_token_per_slot(logits, subs, temp, top_p, top_k)
+            new_lens = lens + 1
+            is_stop = jnp.any(nxt[:, None] == stop_ids, axis=1)
+            hit = (new_lens >= limit_lens) | (
+                (j == decode_chunk - 1) & (new_lens + decode_chunk
+                                           > max_seq_len))
+            emit = jnp.where(run, nxt, -1)
+            return (pools, jnp.where(run, nxt, toks),
+                    jnp.where(run, new_lens, lens),
+                    fin | (run & (is_stop | hit)),
+                    jnp.where(run[:, None], keys2, keys)), emit
+
+        (pools, last, lens, fin, keys), toks = jax.lax.scan(
+            step, ((k_pool, v_pool), last_tokens, lengths, finished, keys),
+            jnp.arange(decode_chunk, dtype=jnp.int32))
         lens = jnp.where(active, lens, 0)
-        return toks.T, pools[0], pools[1], last, keys, lens
+        return toks.T, pools[0], pools[1], last, keys, lens, fin
 
     keys_abs = jax.eval_shape(
         lambda: jax.random.split(jax.random.PRNGKey(0), max_batch))
@@ -151,6 +171,9 @@ def serving_programs(
         sds((max_batch,), jnp.int32),
         sds((max_batch,), jnp.int32),
         sds((max_batch,), jnp.bool_),
+        sds((max_batch,), jnp.bool_),
+        sds((max_batch, stop_width), jnp.int32),
+        sds((max_batch,), jnp.int32),
         keys_abs,
         sds((max_batch,), jnp.float32),
         sds((max_batch,), jnp.float32),
@@ -210,6 +233,7 @@ def aot_compile(
     decode_chunk: int = 16,
     max_batch: int = 8,
     max_seq_len: int = 2048,
+    device_stop_width: int = 8,
     tp: int = 0,
     include_serving: bool = True,
     out_dir: Optional[str | Path] = None,
@@ -243,7 +267,8 @@ def aot_compile(
         progs = serving_programs(
             model, dtype=dt, quantization=quantization,
             prefill_bucket=prefill_bucket, decode_chunk=decode_chunk,
-            max_batch=max_batch, max_seq_len=max_seq_len)
+            max_batch=max_batch, max_seq_len=max_seq_len,
+            device_stop_width=device_stop_width)
         jobs = [(name, fn, jax.tree.map(
             lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=repl)
             if getattr(l, "sharding", None) is None else l, args))
@@ -337,6 +362,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument("--decode-chunk", type=int, default=16)
     ap.add_argument("--max-batch", type=int, default=8)
     ap.add_argument("--max-seq-len", type=int, default=2048)
+    ap.add_argument("--device-stop-width", type=int, default=8)
     ap.add_argument("--tp", type=int, default=0)
     ap.add_argument("--out", default=None)
     ap.add_argument("--serialize", action="store_true")
@@ -348,7 +374,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         args.model, quantization=args.quant, topology=args.topology,
         dtype=args.dtype, prefill_bucket=args.prefill_bucket,
         decode_chunk=args.decode_chunk, max_batch=args.max_batch,
-        max_seq_len=args.max_seq_len, tp=args.tp, out_dir=args.out,
+        max_seq_len=args.max_seq_len,
+        device_stop_width=args.device_stop_width, tp=args.tp,
+        out_dir=args.out,
         serialize=args.serialize)
     print(json.dumps(report))
     return 0

@@ -97,13 +97,25 @@ class EngineConfig:
     #: vocab/tokenizer) + optional checkpoint dir for its weights
     draft_model: str = ""
     draft_checkpoint: str = ""
-    #: continuous scheduler (paged mode only): dispatch decode chunk N+1 —
-    #: which depends only on device-resident state — BEFORE host-processing
-    #: chunk N's tokens, so the host emit loop overlaps the device chunk.
-    #: Falls back to a synchronous round whenever a slot finishes, a request
-    #: is admitted/resumed, or a slot is preempted, so emitted streams are
-    #: byte-identical to the synchronous scheduler.
-    decode_lookahead: bool = True
+    #: continuous scheduler (paged mode only): lookahead DEPTH — up to this
+    #: many decode chunks are kept in flight beyond the one being drained
+    #: (an epoch ring). Each chunk chains off device-resident state, so the
+    #: host emit loop overlaps N device chunks instead of alternating.
+    #: Termination (stop tokens / max-tokens / window) is detected INSIDE
+    #: the decode program via a device-resident finished mask, so a finish
+    #: freezes its row on-device and the ring survives it; admissions,
+    #: resumes and preemptions still discard the stale ring suffix and fall
+    #: back to a synchronous round, so emitted streams are byte-identical
+    #: across any depth (0 = fully synchronous; legacy bools still parse:
+    #: True ≡ the default depth, False ≡ 0).
+    decode_lookahead: int = 2
+    #: device-side stop-token matching width: per-slot stop ids live in a
+    #: [n_slots, device_stop_width] device array (-1 padded). A request whose
+    #: stop set exceeds this falls back to host-side stop detection for that
+    #: slot (its stop finishes discard the in-flight ring, exactly the
+    #: pre-device-termination behavior); max-tokens/window bounds are always
+    #: device-resident regardless.
+    device_stop_width: int = 8
     #: continuous scheduler: per-round prefill admission budget in prompt
     #: tokens (Sarathi-style interleave). A burst of arrivals no longer drains
     #: the whole queue with back-to-back prefills before decode resumes; at
@@ -129,6 +141,18 @@ class EngineConfig:
     #: limit (unbounded host memory + unbounded queue latency under a
     #: storm). 0 = unbounded (pre-faultlab behavior).
     max_pending: int = 2048
+
+    def resolve_lookahead_depth(self) -> int:
+        """Lookahead ring depth as an int ≥ 0. Legacy bool configs parse as
+        on/off: True → the class default depth, False → 0 (synchronous) —
+        ONE rule for every entry path (direct EngineConfig, registry
+        engine_options via the worker), so the same legacy value can never
+        select different pipeline depths depending on which layer parsed
+        it."""
+        if isinstance(self.decode_lookahead, bool):
+            return EngineConfig.decode_lookahead if self.decode_lookahead \
+                else 0
+        return max(0, int(self.decode_lookahead))
 
     def resolve_use_flash(self) -> bool:
         if self.use_flash is not None:
@@ -164,11 +188,24 @@ class EngineConfig:
 
 
 def build_decode_chunk_fn(model_config: ModelConfig, k_steps: int,
-                          rope_tables) -> Callable:
+                          rope_tables, *, max_seq: Optional[int] = None,
+                          device_term: bool = False) -> Callable:
     """The shared fused decode body: k (forward T=1 → lm_head → sample) steps
     under one lax.scan. Both the lockstep engine and the continuous scheduler jit
     this same function (with their own donation specs) so the decode semantics
-    can never diverge between them."""
+    can never diverge between them.
+
+    ``device_term=True`` adds the device-resident termination machinery the
+    deep-lookahead scheduler needs: extra inputs (active, finished, stop_ids,
+    limit_lens) and extra outputs (lengths, finished). Each step matches the
+    sampled token against the row's padded stop-id set and its length limit
+    (max-tokens bound folded into ``limit_lens``; the window bound
+    ``len + k > max_seq`` is checked at the chunk's last step, mirroring the
+    host's force-length rule), and a finished row FREEZES: its last token,
+    key/rng effect, length and KV writes stop advancing, so a chunk chained
+    off this one stays valid even when a row terminates mid-chunk. Frozen
+    steps emit -1 sentinels (discarded host-side). Running rows compute
+    bit-identically to the plain body."""
 
     def decode_chunk(params, k_cache, v_cache, last_tokens, lengths, rng,
                      temperature, top_p, top_k):
@@ -187,7 +224,35 @@ def build_decode_chunk_fn(model_config: ModelConfig, k_steps: int,
             None, length=k_steps)
         return toks.T, cache[0], cache[1], last, rng  # toks: [B, k]
 
-    return decode_chunk
+    def decode_chunk_term(params, k_cache, v_cache, last_tokens, lengths, rng,
+                          temperature, top_p, top_k, active, finished,
+                          stop_ids, limit_lens):
+        def step(carry, j):
+            cache, toks, lens, fin, rng = carry
+            run = active & jnp.logical_not(fin)
+            hidden, cache = llama.forward(
+                params, model_config, toks[:, None], lens[:, None], cache, lens,
+                rope_tables)
+            logits = llama.lm_head_logits(params, model_config, hidden[:, 0, :])
+            rng, sub = jax.random.split(rng)
+            nxt = sample_token(logits, sub, temperature, top_p, top_k)
+            new_lens = lens + 1
+            is_stop = jnp.any(nxt[:, None] == stop_ids, axis=1)
+            hit = new_lens >= limit_lens
+            if max_seq is not None:
+                hit = hit | ((j == k_steps - 1) & (new_lens + k_steps > max_seq))
+            emit = jnp.where(run, nxt, -1)
+            return (cache, jnp.where(run, nxt, toks),
+                    jnp.where(run, new_lens, lens),
+                    fin | (run & (is_stop | hit)), rng), emit
+
+        (cache, last, lens, fin, rng), toks = jax.lax.scan(
+            step, ((k_cache, v_cache), last_tokens, lengths, finished, rng),
+            jnp.arange(k_steps, dtype=jnp.int32))
+        lens = jnp.where(active, lens, 0)
+        return toks.T, cache[0], cache[1], last, rng, lens, fin
+
+    return decode_chunk_term if device_term else decode_chunk
 
 
 @dataclass

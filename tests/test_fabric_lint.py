@@ -184,6 +184,61 @@ def test_AS04_sanctioned_sync_point_passes():
     assert ok == []
 
 
+def test_AS04_second_sync_point_in_one_method_fails():
+    # the deep-lookahead discipline: ONE blocking drain per round method —
+    # a second marker is an extra host<-device serialization, not a waiver
+    bad = lint(
+        _AS04_CLASS +
+        "    def _decode_round(self):\n"
+        "        a = np.asarray(self._a_dev)  # sync-point: drain oldest\n"
+        "        b = np.asarray(self._b_dev)  # sync-point: and another\n",
+        tier="runtime", select=("AS04",))
+    assert rule_ids(bad) == ["AS04"]
+    assert "second" in bad[0].message
+
+
+def test_AS04_one_sync_point_per_method_passes():
+    # separate round methods each own their single drain (paged vs mixed
+    # vs dense rounds in the real scheduler)
+    ok = lint(
+        _AS04_CLASS +
+        "    def _decode_round(self):\n"
+        "        a = np.asarray(self._a_dev)  # sync-point: paged drain\n"
+        "    def _decode_round_mixed(self):\n"
+        "        b = np.asarray(self._b_dev)  # sync-point: mixed drain\n",
+        tier="runtime", select=("AS04",))
+    assert ok == []
+
+
+def test_AS04_marker_mention_in_docstring_not_counted():
+    # a docstring/comment MENTIONING "sync-point:" is not a drain — only
+    # lines that also carry a device-sync call count toward the one-drain
+    # budget (else the real drain below would be flagged as a second one)
+    ok = lint(
+        _AS04_CLASS +
+        "    def _decode_round(self):\n"
+        '        """the one `# sync-point:` drain happens below"""\n'
+        "        # the sync-point: marker is explained here too\n"
+        "        chunk = np.asarray(self._chunk_dev)  # sync-point: drain oldest\n",
+        tier="runtime", select=("AS04",))
+    assert ok == []
+
+
+def test_AS04_nonblocking_transfer_start_passes():
+    # copy_to_host_async is a transfer ENQUEUE, not a sync: the new
+    # discipline allows starting it anywhere in the hot loop, with the
+    # blocking read only at the single sanctioned drain
+    ok = lint(
+        _AS04_CLASS +
+        "    def _dispatch_chunk(self):\n"
+        "        self._chunk_dev.copy_to_host_async()\n"
+        "    def _decode_round(self):\n"
+        "        self._dispatch_chunk()\n"
+        "        chunk = np.asarray(self._chunk_dev)  # sync-point: drain oldest\n",
+        tier="runtime", select=("AS04",))
+    assert ok == []
+
+
 def test_AS04_sync_outside_loop_methods_passes():
     # admission-path syncs (first-token readback) are inherent, not hot-loop
     ok = lint(

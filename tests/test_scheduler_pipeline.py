@@ -164,13 +164,107 @@ def test_prefill_storm_does_not_starve_decode():
     assert qw["max"] > 0 and qw["p50"] >= 0
 
 
-def test_preempt_resume_under_lookahead_bit_exact():
-    """Pool-pressure preemption while the pipeline is overlapping: the
-    preempted stream must resume bit-exact, and the run must actually have
-    used lookahead rounds before the fault."""
+def test_deep_lookahead_streams_bit_identical_across_depths():
+    """THE deep-ring golden: depths 0 (synchronous), 1 (legacy single-chunk
+    lookahead) and 3 (epoch ring) produce bit-identical per-request streams
+    for mixed greedy + seeded sampling — the ring and device-side
+    termination change WHEN device work runs, never what any request
+    receives. The deep run must actually run deep (achieved depth ≥ 2 in
+    the drain histogram) so the equivalence cannot pass vacuously."""
+    rng = np.random.default_rng(21)
+    prompts = [rng.integers(3, 900, 10 + 5 * i).tolist() for i in range(6)]
+    samplings = [SamplingParams(max_tokens=40,
+                                temperature=0.8 if i % 2 else 0.0,
+                                top_p=0.9, seed=2000 + i)
+                 for i in range(6)]
+    results = {}
+    for depth in (0, 1, 3):
+        results[depth] = _run_streams(
+            _cfg(decode_lookahead=depth, prefill_budget_tokens=64),
+            prompts, samplings)
+    for depth in (1, 3):
+        assert results[depth][0].tokens == results[0][0].tokens, \
+            f"depth {depth} streams diverged from synchronous"
+        assert results[depth][0].finishes == results[0][0].finishes
+    deep_pipe = results[3][1]["pipeline"]
+    assert deep_pipe["depth"] == 3
+    hist = {int(d): n for d, n in deep_pipe["depth_hist"].items()}
+    assert hist and max(hist) >= 2, f"ring never ran deep: {hist}"
+    sync_pipe = results[0][1]["pipeline"]
+    assert sync_pipe["lookahead_rounds"] == 0
+    assert set(sync_pipe["depth_hist"]) <= {"0"}  # never ran deep
+
+
+def test_device_termination_keeps_ring_alive_through_finish():
+    """A single request draining at depth 3: its finish (max-tokens bound)
+    is predicted ON DEVICE, so no ring entry is ever discarded — the
+    pre-ring scheduler discarded the speculative chunk at every finish.
+    Also pins the mixed→pure-decode spanning: the request admits through
+    chunked prefill, and the ring must engage with ZERO synchronous
+    fallback rounds after the flip (every post-prefill round is served by
+    a pre-dispatched chunk)."""
+    prompt = np.random.default_rng(4).integers(3, 900, 12).tolist()
+    col, stats = _run_streams(
+        _cfg(decode_lookahead=3),
+        [prompt], [SamplingParams(max_tokens=40, temperature=0.7, seed=9)])
+    assert len(col.tokens[0]) == 40
+    pipe = stats["pipeline"]
+    assert pipe["lookahead"]["discarded"] == 0, pipe
+    assert pipe["discard_ratio"] == 0.0
+    assert pipe["lookahead"]["used"] > 0
+    # mixed rounds ran (chunked admission), and every later decode round
+    # was ring-served: rounds == mixed_rounds + lookahead_rounds exactly
+    assert pipe["mixed_rounds"] >= 1
+    assert pipe["rounds"] == pipe["mixed_rounds"] + pipe["lookahead_rounds"], \
+        f"synchronous fallback round after the flip: {pipe}"
+
+
+def test_mixed_to_pure_decode_transition_bit_identical_seeded():
+    """Seeded sampled streams across the mixed→pure-decode transition:
+    ring-spanning (depth 3, chunks chained off the mixed dispatch's
+    device-computed flip state) vs the fully synchronous path — identical
+    tokens, and the spanning run really spanned (no sync round between the
+    last mixed round and the first ring-served drain)."""
+    rng = np.random.default_rng(33)
+    prompts = [rng.integers(3, 900, 20 + 7 * i).tolist() for i in range(4)]
+    samplings = [SamplingParams(max_tokens=24, temperature=0.9, top_p=0.85,
+                                seed=500 + i) for i in range(4)]
+    span_col, span_stats = _run_streams(
+        _cfg(decode_lookahead=3, prefill_budget_tokens=16), prompts,
+        samplings)
+    sync_col, _ = _run_streams(
+        _cfg(decode_lookahead=0, prefill_budget_tokens=16), prompts,
+        samplings)
+    assert span_col.tokens == sync_col.tokens
+    assert span_col.finishes == sync_col.finishes
+    pipe = span_stats["pipeline"]
+    assert pipe["mixed_rounds"] >= 2  # budget 16 forces real chunking
+    assert pipe["lookahead"]["used"] > 0
+
+
+def test_stop_finish_within_device_width_keeps_ring():
+    """A stop set that FITS device_stop_width terminates on-device: streams
+    match the synchronous scheduler AND the host classifies the same stop
+    reason the device froze on."""
+    prompt = np.random.default_rng(6).integers(3, 900, 10).tolist()
+    # temperature + a broad-but-fitting stop set: tokens 3..8 (6 ids < 8)
+    sampling = [SamplingParams(max_tokens=60, temperature=1.3, seed=77,
+                               stop_token_ids=tuple(range(3, 9)))]
+    deep_col, _ = _run_streams(_cfg(decode_lookahead=3), [prompt], sampling)
+    sync_col, _ = _run_streams(_cfg(decode_lookahead=0), [prompt], sampling)
+    assert deep_col.tokens == sync_col.tokens
+    assert deep_col.finishes == sync_col.finishes
+
+
+@pytest.mark.parametrize("depth", [1, 3])
+def test_preempt_resume_under_lookahead_bit_exact(depth):
+    """Pool-pressure preemption while the pipeline is overlapping (depth 1
+    and a 3-deep mid-ring preempt): the preempted stream must resume
+    bit-exact, and the run must actually have used lookahead rounds before
+    the fault."""
     prompt = np.random.default_rng(0).integers(3, 900, 20).tolist()
     cfg = _cfg(max_batch=2, max_seq_len=128, prefix_cache_pages=64,
-               prefix_page_size=8)
+               prefix_page_size=8, decode_lookahead=depth)
     sampling = [SamplingParams(max_tokens=40, temperature=0.0)]
 
     ref_col, _ = _run_streams(cfg, [prompt], sampling)
@@ -238,12 +332,20 @@ def test_free_slot_deque_and_device_mirrors_stay_consistent():
         # device rows mirror host rows (the patch-only-changed-rows contract)
         np.testing.assert_array_equal(
             np.asarray(sched._active_dev), sched.active)
-        # host lengths rows of finished slots stay stale until the next
-        # round's commit; the device row pins to 0 at finish — mirror through
-        # the active mask
+        # ACTIVE rows' device lengths mirror host lengths exactly. Inactive
+        # rows are DON'T-CARE under the epoch ring: the finish patch zeroes
+        # them, but a later ring-chunk commit may re-land the frozen terminal
+        # value — which the next dispatch masks (write target = zeroed page
+        # table row = scratch; chunk output pins them back to 0). What must
+        # hold for safety: no inactive device length exceeds the window, and
+        # their page-table rows are zeroed.
+        lengths_dev = np.asarray(sched._lengths_dev)
         np.testing.assert_array_equal(
-            np.asarray(sched._lengths_dev),
-            np.where(sched.active, sched.lengths, 0))
+            lengths_dev[sched.active], sched.lengths[sched.active])
+        assert (lengths_dev <= cfg.max_seq_len).all()
+        if not sched._pt_dirty_rows:
+            inactive = ~sched.active
+            assert (sched.page_table[inactive] == 0).all()
         np.testing.assert_array_equal(
             np.asarray(sched._page_table_dev),
             sched.page_table if not sched._pt_dirty_rows else
