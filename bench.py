@@ -805,6 +805,24 @@ def doctor_guard() -> int:
         "(contention only slows runs down)")
 
 
+def lifecycle_guard() -> int:
+    """Disarmed-supervisor overhead guard for the replica lifecycle: the
+    aggregate storm routed through a 1-replica serving pool with the
+    lifecycle supervisor ARMED (0.05s tick — 4x the production cadence —
+    plus the per-request routing/canary/terminal hooks; nothing ever breaks,
+    so the delta is the pure always-on cost) vs the same pool with
+    supervision disabled (``BENCH_LIFECYCLE=off``). Routing both arms
+    through the pool cancels its wrapper cost out of the comparison."""
+    return _ab_guard(
+        "lifecycle", "BENCH_LIFECYCLE", "supervised", "on", "off",
+        "BENCH_LIFECYCLE_REPS", "BENCH_LIFECYCLE.json",
+        "replica-lifecycle disarmed-supervisor overhead: --aggregate "
+        "tok/s through a 1-replica serving pool with the lifecycle "
+        "supervisor armed (0.05s tick + routing/terminal hooks, no "
+        "faults) vs the unsupervised pool; interleaved ABBA runs, "
+        "best run per arm (contention only slows runs down)")
+
+
 def ragged_bench() -> int:
     """Mixed-batch A/B (BENCH_RAGGED.json): the --aggregate staggered storm
     with ragged mixed-batch rounds ON (prefill chunks piggyback into decode
@@ -1084,7 +1102,29 @@ def aggregate(model_name: str, quant: str) -> int:
                            decode_lookahead=lookahead,
                            mixed_batch=mixed,
                            prefill_budget_tokens=budget)
-        sched = ContinuousBatchingEngine(cfg, seed=0)
+        #: lifecycle-guard A/B arms (BENCH_LIFECYCLE.json): BOTH arms route
+        #: the storm through a 1-replica DataParallelServingPool so the pool
+        #: wrapper cost cancels out of the delta — "on" arms the lifecycle
+        #: supervisor (tick thread at 4x the production cadence + the
+        #: per-request routing/terminal hooks; nothing ever breaks, so this
+        #: is the pure always-on cost), "off" pins lifecycle=None (the
+        #: pre-lifecycle pool). Unset = the plain engine path.
+        lifecycle_mode = os.environ.get("BENCH_LIFECYCLE", "")
+        pool = None
+        if lifecycle_mode:
+            from cyberfabric_core_tpu.runtime.lifecycle import LifecycleConfig
+            from cyberfabric_core_tpu.runtime.replicas import \
+                DataParallelServingPool
+
+            pool = DataParallelServingPool(
+                cfg, n_replicas=1, seed=0,
+                lifecycle=(LifecycleConfig(check_interval_s=0.05)
+                           if lifecycle_mode == "on" else None))
+            sched = pool.replicas[0]
+            submit_target = pool
+        else:
+            sched = ContinuousBatchingEngine(cfg, seed=0)
+            submit_target = sched
         #: doctor-guard A/B arm (BENCH_DOCTOR.json): "on" arms the fabric-
         #: doctor against this engine — recorder listener ingesting every
         #: terminal, all four SLO objectives + all three watchdogs on a
@@ -1154,13 +1194,13 @@ def aggregate(model_name: str, quant: str) -> int:
             reqs[i]["t_submit"] = time.monotonic()
             trace = (f"00-{os.urandom(16).hex()}-{os.urandom(8).hex()}-00"
                      if trace_mode == "unsampled" else None)
-            sched.submit(prompt, SamplingParams(max_tokens=gen), mk_emit(i),
-                         trace=trace)
+            submit_target.submit(prompt, SamplingParams(max_tokens=gen),
+                                 mk_emit(i), trace=trace)
             if stagger_s and i < n_req - 1:
                 time.sleep(stagger_s)  # staggered arrivals, not one batch
         ok = done.wait(300)
         stats = sched.stats()
-        sched.shutdown()
+        (pool if pool is not None else sched).shutdown()
         span = (state["last"] - state["first"]) if state["first"] else 0.0
         agg = state["tokens"] / span if span > 0 else 0.0
         deltas_ms = sorted(d * 1000.0
@@ -1560,6 +1600,8 @@ if __name__ == "__main__":
         sys.exit(aggregate(sys.argv[2], sys.argv[3]))
     if len(sys.argv) > 1 and sys.argv[1] == "--doctor-guard":
         sys.exit(doctor_guard())
+    if len(sys.argv) > 1 and sys.argv[1] == "--lifecycle-guard":
+        sys.exit(lifecycle_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--faultlab-guard":
         sys.exit(faultlab_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--trace-guard":

@@ -1,10 +1,13 @@
-"""WD — doctor evaluator discipline.
+"""WD — doctor evaluator / lifecycle supervisor discipline.
 
 WD01: the fabric-doctor's evaluator and watchdog callbacks (``evaluate*`` /
 ``on_record`` / ``ingest*`` / ``_check_*`` methods of classes named
-``*Doctor*`` / ``*Watchdog*``) must be **non-blocking** and must route every
-emit through a **never-raises helper** — mirroring TL01 for the flight
-recorder and the ``bump_counter`` pattern for metrics.
+``*Doctor*`` / ``*Watchdog*``) and the replica-lifecycle supervision
+callbacks (``tick*`` / ``on_terminal`` / ``on_departed`` /
+``admit_allowed`` / ``note_dispatch`` methods of classes named
+``*Supervisor*`` / ``*Lifecycle*``) must be **non-blocking** and must route
+every emit through a **never-raises helper** — mirroring TL01 for the
+flight recorder and the ``bump_counter`` pattern for metrics.
 
 The evaluation pass runs on a fixed cadence on a dedicated thread and is the
 thing that DECLARES the server unhealthy: if it can block (network, DB,
@@ -15,6 +18,15 @@ forever; if an emit can raise (direct ``recorder.record``, direct
 /readyz. ``await`` is banned outright: the evaluator contract is sync
 (asyncio integration goes through the heartbeat/readiness surfaces, never
 into the evaluator).
+
+The lifecycle supervisor holds the same contract for the same reason, one
+notch harder: its tick is the only thing that can HEAL a broken pool, and
+its routing hooks (``admit_allowed`` / ``note_dispatch`` /
+``on_terminal``) sit on the pool's submit and scheduler-emit hot paths — a
+blocking call there stalls serving itself, not just health reporting. The
+deliberate exceptions (engine close/build/start in ``_do_rebuild`` /
+``_do_drain_close``) live OUTSIDE the tick-prefixed decision pass by
+design, and the rule's per-callback scope encodes exactly that split.
 """
 
 from __future__ import annotations
@@ -39,11 +51,13 @@ _METRIC_RMW = frozenset({"inc", "observe", "set"})
 _METRIC_FACTORIES = frozenset({"counter", "histogram", "gauge"})
 
 _CALLBACK_PREFIXES = ("evaluate", "_evaluate", "on_record", "ingest",
-                      "_check_")
+                      "_check_", "tick", "_tick", "on_terminal",
+                      "on_departed", "admit_allowed", "note_dispatch")
 
 
 def _is_doctor_class(node: ast.ClassDef) -> bool:
-    return "Doctor" in node.name or "Watchdog" in node.name
+    return any(marker in node.name for marker in
+               ("Doctor", "Watchdog", "Supervisor", "Lifecycle"))
 
 
 def _is_callback(fn: ast.AST) -> bool:
@@ -56,8 +70,9 @@ class WD01(Rule):
     id = "WD01"
     family = "WD"
     severity = "error"
-    description = ("doctor evaluator/watchdog callbacks are non-blocking "
-                   "and emit through never-raises helpers")
+    description = ("doctor evaluator/watchdog and lifecycle-supervisor "
+                   "callbacks are non-blocking and emit through "
+                   "never-raises helpers")
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         for cls in ast.walk(ctx.tree):
@@ -70,7 +85,7 @@ class WD01(Rule):
 
     def _check_callback(self, ctx: FileContext,
                         fn: ast.AST) -> Iterable[Finding]:
-        where = f"doctor callback `{fn.name}`"
+        where = f"supervision callback `{fn.name}`"
         for node in ast.walk(fn):
             if isinstance(node, ast.Await):
                 yield self.finding_in(

@@ -151,6 +151,26 @@ def check_pool_clean(evidence: dict) -> list[str]:
     return problems
 
 
+def check_pool_engine_accounting(evidence: dict) -> list[str]:
+    """Engine accounting across every SERVING pool replica: after a
+    lifecycle storm (breaks, rebuilds, drains) the surviving and rebuilt
+    engines must hold zero slot/page leftovers. Retired corpses (broken or
+    closed engines awaiting rebuild) are exempt — their state died with
+    them."""
+    problems: list[str] = []
+    for i, eng in enumerate(evidence["pool"].replicas):
+        try:
+            st = eng.stats()
+        except Exception as e:  # noqa: BLE001
+            problems.append(f"replica {i}: stats() crashed: {e}")
+            continue
+        if st.get("broken") or st.get("closed"):
+            continue
+        for p in check_engine_accounting({"engine": eng}):
+            problems.append(f"replica {i}: {p}")
+    return problems
+
+
 def check_state_sequence(evidence: dict) -> list[str]:
     """The doctor's degradation state machine visited the expected states in
     order (default: the full healthy → degraded → shedding → recovering →
@@ -199,6 +219,7 @@ CHECKERS: dict[str, Callable[[dict], list[str]]] = {
     "expected_errors": check_expected_errors,
     "engine_accounting": check_engine_accounting,
     "pool_clean": check_pool_clean,
+    "pool_engine_accounting": check_pool_engine_accounting,
     "breaker_recovered": check_breaker_recovered,
     "state_sequence": check_state_sequence,
     "watchdogs_tripped": check_watchdogs_tripped,

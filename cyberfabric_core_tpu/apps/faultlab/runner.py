@@ -210,11 +210,15 @@ def _run_engine_scenario(spec: dict) -> ScenarioResult:
 
 # ----------------------------------------------------------------- pool kind
 
-def _drive_pool(cfg, load, faults: list[dict], n_replicas: int = 2):
+def _drive_pool(cfg, load, faults: list[dict], n_replicas: int = 2,
+                pool=None):
+    """``pool`` overrides construction (the lifecycle kinds pass a
+    supervised pool and keep driving it after this load drains)."""
     from ...runtime.engine import SamplingParams
     from ...runtime.replicas import DataParallelServingPool
 
-    pool = DataParallelServingPool(cfg, n_replicas=n_replicas)
+    if pool is None:
+        pool = DataParallelServingPool(cfg, n_replicas=n_replicas)
     streams = {i: StreamRecord() for i in range(len(load))}
     done = threading.Event()
     lock = threading.Lock()
@@ -297,6 +301,235 @@ def _run_pool_scenario(spec: dict) -> ScenarioResult:
                    _streams_payload(streams, tokens=deterministic_tokens),
                    stats={k: stats[k] for k in
                           ("failovers", "failovers_failed", "healthy")})
+
+
+# ------------------------------------------------- replica lifecycle kinds
+
+def _pool_probe(pool, prompt: list[int], max_tokens: int,
+                timeout_s: float = 60.0) -> StreamRecord:
+    """One greedy probe request through the pool (probation canaries and
+    rebuilt-replica bit-identity checks)."""
+    from ...runtime.engine import SamplingParams
+
+    rec = StreamRecord()
+    done = threading.Event()
+
+    def emit(ev):
+        record_event(rec, ev.token_id, ev.finished)
+        if ev.finished:
+            done.set()
+
+    pool.submit(prompt, SamplingParams(max_tokens=max_tokens), emit)
+    done.wait(timeout_s)
+    return rec
+
+
+def _wait_for(predicate, timeout_s: float) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)  # fabric-lint: waive AS01 reason=scenario driver thread polling lifecycle state; no event loop in this process path
+    return False
+
+
+def _lifecycle_pool(spec: dict, cfg, n_replicas: int):
+    """A supervised pool with scenario-speed lifecycle knobs (production
+    defaults are seconds; the state walk is identical)."""
+    from ...runtime.lifecycle import LifecycleConfig
+    from ...runtime.replicas import DataParallelServingPool
+
+    lc = LifecycleConfig(
+        check_interval_s=0.05,
+        rebuild_backoff_s=0.05,
+        rebuild_backoff_max_s=0.2,
+        max_strikes=int(spec.get("max_strikes", 2)),
+        probation_successes=1,
+        drain_deadline_s=float(spec.get("drain_deadline_s", 30.0)),
+        seed=int(spec.get("seed", 0)))
+    return DataParallelServingPool(cfg, n_replicas=n_replicas, lifecycle=lc)
+
+
+def _run_replica_crash_loop_scenario(spec: dict) -> ScenarioResult:
+    """replica-crash-loop: an injected mid-stream break under load fails the
+    victim's streams over to the survivor (bit-identical, exactly one
+    terminal each); the lifecycle supervisor's rebuilds keep failing (armed
+    ``replicas.rebuild``), so strikes walk through exponential backoff until
+    the replica is BENCHED. Disarming + an operator ``restart`` (strikes
+    cleared) rebuilds it for real, a probation canary promotes it, and the
+    pool returns to ``healthy == n_replicas`` — capacity recovered without a
+    process restart, with zero slot/page/tracking leaks."""
+    import jax
+
+    seed = int(spec.get("seed", 0))
+    n_replicas = int(spec.get("replicas", 2))
+    if len(jax.devices()) < n_replicas:
+        return ScenarioResult(
+            spec["name"], "replica_crash_loop", seed, verdict=True,
+            invariants={"skipped": []}, fingerprint="skipped",
+            details={"skipped": f"needs {n_replicas} devices"})
+    cfg = _engine_config(spec)
+    load = _make_load(spec)
+    checkers = list(spec.get("invariants", ["exactly_one_terminal"]))
+    evidence: dict[str, Any] = {"expect_error": spec.get("expect_error", [])}
+    if "streams_match_baseline" in checkers:
+        evidence["baseline"] = _baseline_streams(spec, cfg, load)
+    fp.configure(seed)
+    pool = _lifecycle_pool(spec, cfg, n_replicas)
+    lc = pool.lifecycle
+    problems: dict[str, list[str]] = {}
+    streams, pool, _errs = _drive_pool(
+        cfg, load, list(spec.get("faults", [])), n_replicas, pool=pool)
+    # the armed replicas.rebuild rejected every attempt: max_strikes
+    # failures → benched (the crash-loop backstop). Faults are already
+    # disarmed by _drive_pool's finally.
+    benched = _wait_for(lambda: lc.counts()["benched"] >= 1, 20.0)
+    problems["crash_loop_benched"] = [] if benched else [
+        f"replica never benched: {lc.status()}"]
+    problems["rebuild_retries_backed_off"] = (
+        [] if lc.rebuilds_failed >= int(spec.get("max_strikes", 2))
+        else [f"only {lc.rebuilds_failed} failed rebuild attempts"])
+    benched_idx = next(
+        (row["index"] for row in lc.status()["replicas"]
+         if row["state"] == "benched"), None)
+    recovered = False
+    probe = None
+    if benched_idx is not None:
+        lc.restart(benched_idx)
+        # the rebuilt engine counts as pool-healthy immediately; the
+        # probation canary below promotes its lifecycle state too
+        recovered = _wait_for(
+            lambda: pool.stats()["healthy"] == n_replicas, 60.0)
+        if recovered:
+            probe = _pool_probe(pool, load[0][0], load[0][1])
+            _wait_for(lambda: lc.counts()["healthy"] == n_replicas, 10.0)
+    problems["pool_recovered_to_full_capacity"] = [] if recovered else [
+        f"healthy={pool.stats()['healthy']} != {n_replicas} after "
+        f"restart ({lc.status()})"]
+    base0 = evidence.get("baseline", {}).get(0)
+    problems["rebuilt_replica_stream_bit_identical"] = (
+        [] if probe is not None and base0 is not None
+        and probe.tokens == base0.tokens
+        and probe.terminals == base0.terminals else
+        [f"probe through the rebuilt pool diverged: "
+         f"{probe and probe.terminals} vs {base0 and base0.terminals}"])
+    problems["probation_promoted"] = (
+        [] if lc.probation_promotions >= 1 and
+        lc.counts()["healthy"] == n_replicas else
+        [f"probation never promoted: {lc.counts()}"])
+    stats = pool.stats()
+    # shutdown BEFORE the accounting checkers: joining the scheduler threads
+    # guarantees the last terminal's chain release has landed (the pool kind
+    # orders it the same way)
+    pool.shutdown()
+    evidence["streams"] = streams
+    evidence["pool"] = pool
+    problems.update(run_checkers(checkers, evidence))
+    deterministic_tokens = bool(spec.get("deterministic_tokens", True))
+    return _finish(
+        spec["name"], "replica_crash_loop", seed, problems,
+        _streams_payload(streams, tokens=deterministic_tokens),
+        lifecycle={"rebuilds_ok": lc.rebuilds_ok,
+                   "rebuilds_failed": lc.rebuilds_failed,
+                   "benched_total": lc.benched_total,
+                   "promotions": lc.probation_promotions},
+        stats={k: stats[k] for k in ("failovers", "healthy", "replicas")})
+
+
+def _run_replica_drain_scenario(spec: dict) -> ScenarioResult:
+    """drain-under-load: a replica is drained WHILE its streams are mid-
+    flight. New admissions route around it instantly; past the (tiny)
+    deadline the engine is closed and the stragglers fail over to the
+    survivor — every stream still bit-identical to an undrained baseline
+    with exactly one terminal. The drained replica's episode lands in the
+    flight recorder (drain_begin → drain_end), and a restart + canary
+    returns the pool to full capacity."""
+    import jax
+
+    from ...modkit.flight_recorder import default_recorder
+    from ...runtime.engine import SamplingParams
+
+    seed = int(spec.get("seed", 0))
+    n_replicas = int(spec.get("replicas", 2))
+    if len(jax.devices()) < n_replicas:
+        return ScenarioResult(
+            spec["name"], "replica_drain", seed, verdict=True,
+            invariants={"skipped": []}, fingerprint="skipped",
+            details={"skipped": f"needs {n_replicas} devices"})
+    cfg = _engine_config(spec)
+    load = _make_load(spec)
+    checkers = list(spec.get("invariants", ["exactly_one_terminal"]))
+    evidence: dict[str, Any] = {"expect_error": spec.get("expect_error", [])}
+    if "streams_match_baseline" in checkers:
+        evidence["baseline"] = _baseline_streams(spec, cfg, load)
+    fp.configure(seed)
+    pool = _lifecycle_pool(spec, cfg, n_replicas)
+    lc = pool.lifecycle
+    streams = {i: StreamRecord() for i in range(len(load))}
+    done = threading.Event()
+    lock = threading.Lock()
+    remaining = [len(load)]
+    problems: dict[str, list[str]] = {}
+
+    def mk_emit(i):
+        def emit(ev):
+            with lock:
+                was_finished = streams[i].finished
+                record_event(streams[i], ev.token_id, ev.finished)
+                if ev.finished and not was_finished:
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        done.set()
+        return emit
+
+    faults = list(spec.get("faults", []))
+    for f in faults:
+        fp.arm(f["point"], f["spec"])
+    try:
+        rids = [pool.submit(prompt, SamplingParams(max_tokens=mt), mk_emit(i))
+                for i, (prompt, mt) in enumerate(load)]
+        time.sleep(float(spec.get("drain_after_s", 0.2)))  # fabric-lint: waive AS01 reason=scenario driver thread letting streams start before the drain; no event loop in this process path
+        with pool._lock:
+            live = next((t.replica for rid, t in pool._requests.items()
+                         if rid in rids), 0)
+        victim = int(live)
+        lc.drain(victim, deadline_s=float(spec.get("deadline_s", 0.05)))
+        drained = _wait_for(lambda: lc.counts()["drained"] >= 1, 30.0)
+        all_done = done.wait(_DRAIN_TIMEOUT_S)
+    finally:
+        for f in faults:
+            fp.disarm(f["point"])
+    problems["streams_survive_drain"] = [] if all_done else [
+        f"{remaining[0]} streams never finished after the drain"]
+    problems["drain_completed"] = [] if drained else [
+        f"replica {victim} never reached drained: {lc.status()}"]
+    episode = default_recorder.lookup(f"{lc.name}/replica{victim}/drain-1")
+    ep_events = [e["event"] for e in (episode or {}).get("timeline", ())]
+    problems["drain_episode_recorded"] = (
+        [] if ep_events[:1] == ["drain_begin"] and "drain_end" in ep_events
+        else [f"drain episode timeline {ep_events}"])
+    lc.restart(victim)
+    recovered = _wait_for(lambda: pool.stats()["healthy"] == n_replicas, 60.0)
+    if recovered:
+        _pool_probe(pool, load[0][0], load[0][1])
+        _wait_for(lambda: lc.counts()["healthy"] == n_replicas, 10.0)
+    problems["pool_recovered_after_restart"] = [] if recovered and \
+        lc.counts()["healthy"] == n_replicas else [
+        f"post-restart counts {lc.counts()}"]
+    stats = pool.stats()
+    # shutdown BEFORE the accounting checkers: joining the scheduler threads
+    # guarantees the last terminal's chain release has landed
+    pool.shutdown()
+    evidence["streams"] = streams
+    evidence["pool"] = pool
+    problems.update(run_checkers(checkers, evidence))
+    return _finish(
+        spec["name"], "replica_drain", seed, problems,
+        _streams_payload(streams, tokens=True),
+        lifecycle={"drains_clean": lc.drains_clean,
+                   "drains_killed": lc.drains_killed,
+                   "rebuilds_ok": lc.rebuilds_ok},
+        stats={k: stats[k] for k in ("failovers", "healthy", "replicas")})
 
 
 # ----------------------------------------------------------- http retry kind
@@ -1158,6 +1391,8 @@ def _run_grpc_evict_scenario(spec: dict) -> ScenarioResult:
 _KINDS = {
     "engine": _run_engine_scenario,
     "pool": _run_pool_scenario,
+    "replica_crash_loop": _run_replica_crash_loop_scenario,
+    "replica_drain": _run_replica_drain_scenario,
     "http_retry": _run_http_retry_scenario,
     "db_commit": _run_db_commit_scenario,
     "server_breaker": _run_server_breaker_scenario,

@@ -225,6 +225,51 @@ BUILTIN_SCENARIOS: list[dict[str, Any]] = [
         "expect_stats": {"failovers_failed": [1, None]},
         "deterministic_tokens": False,
     },
+    # ---- replica lifecycle (runtime/lifecycle.py) ---------------------
+    {
+        # the self-healing acceptance cycle, crash-loop leg: a mid-stream
+        # break fails streams over to the survivor (bit-identical); the
+        # supervisor's rebuilds keep failing (armed replicas.rebuild), so
+        # strikes walk through exponential backoff to BENCHED; disarm +
+        # operator restart rebuilds for real, a probation canary promotes,
+        # and the pool returns to healthy == replicas with zero
+        # slot/page/tracking leaks — no process restart anywhere
+        "name": "replica-crash-loop",
+        "kind": "replica_crash_loop",
+        "seed": 207,
+        "replicas": 2,
+        "max_strikes": 2,
+        "engine": _TINY,
+        "load": {**_LOAD, "max_tokens": 12},
+        "faults": [{"point": "scheduler.readback",
+                    "spec": {"kind": "raise", "mode": "once", "after": 1}},
+                   {"point": "replicas.rebuild", "spec": "raise"}],
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "pool_clean",
+                       "pool_engine_accounting"],
+    },
+    {
+        # graceful-drain leg: drain a replica WHILE its streams run. New
+        # admissions route around it at once; past the tiny deadline the
+        # engine closes and stragglers fail over mid-stream — every stream
+        # bit-identical to the undrained baseline, the drain episode
+        # visible in the flight recorder (drain_begin → drain_end), and a
+        # restart + canary returns the pool to full capacity
+        "name": "drain-under-load",
+        "kind": "replica_drain",
+        "seed": 208,
+        "replicas": 2,
+        "deadline_s": 0.05,
+        "drain_after_s": 0.2,
+        "engine": _TINY,
+        "load": {**_LOAD, "max_tokens": 16},
+        # the per-readback delay stretches every stream so the drain
+        # reliably lands mid-flight; greedy tokens are latency-invariant
+        "faults": [{"point": "scheduler.readback", "spec": "delay(0.05)"}],
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "pool_clean",
+                       "pool_engine_accounting"],
+    },
     # ---- modkit -------------------------------------------------------
     {
         "name": "http-retry-storm",

@@ -299,6 +299,8 @@ class Doctor:
         self._listener_attached = False
         self._scheduler_provider: Optional[
             Callable[[], Iterable[tuple[str, Any]]]] = None
+        self._capacity_provider: Optional[
+            Callable[[], dict[str, Any]]] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._started_at = time.monotonic()
@@ -344,6 +346,18 @@ class Doctor:
         pool (and clears it with ``None`` on stack teardown); scenarios wire
         a single engine."""
         self._scheduler_provider = fn
+
+    def set_capacity_provider(
+            self, fn: Optional[Callable[[], dict[str, Any]]]) -> None:
+        """``fn()`` returns the replica census (``replicas`` / ``serving`` /
+        ``healthy`` / ``benched`` / … — the worker's ``replica_capacity()``
+        shape). The doctor folds it into every evaluation: ZERO serving
+        replicas is itself a degradation reason, and the shedding threshold
+        scales with surviving capacity — a pool running at half strength
+        escalates to shedding after proportionally fewer bad evaluations,
+        because the survivors absorb the dead replicas' load on top of the
+        burn that is already visible. Cleared with ``None`` at teardown."""
+        self._capacity_provider = fn
 
     def ensure_started(self) -> None:
         """Attach the sample listener and start the evaluation thread
@@ -438,9 +452,35 @@ class Doctor:
         # dedupe: several schedulers tripping the same watchdog is one
         # reason on /readyz (per-scheduler detail lives in the log lines)
         reasons.extend(f"watchdog:{name}" for name in dict.fromkeys(trips))
+        # replica capacity (lifecycle census): zero serving capacity is a
+        # degradation reason in itself, and a partially-dead pool lowers the
+        # shedding hysteresis — survivors carry the dead replicas' load, so
+        # the same burn justifies shedding sooner
+        capacity = self._read_capacity()
+        shed_after = cfg.shed_after
+        capacity_doc: Optional[dict[str, Any]] = None
+        if capacity:
+            replicas = int(capacity.get("replicas") or 0)
+            serving = int(capacity.get("serving") or 0)
+            if replicas > 0:
+                frac = serving / replicas
+                if serving == 0:
+                    reasons.append("capacity:no_serving_replicas")
+                elif frac < 1.0:
+                    shed_after = max(1, -(-cfg.shed_after * serving
+                                          // replicas))
+                capacity_doc = {**capacity,
+                                "capacity_frac": round(frac, 3),
+                                "effective_shed_after": shed_after}
+                _gauge_set("llm_replicas_healthy",
+                           "Replicas in lifecycle state healthy",
+                           float(capacity.get("healthy", 0)))
+                _gauge_set("llm_replicas_benched",
+                           "Replicas benched after repeated strikes",
+                           float(capacity.get("benched", 0)))
         with self._lock:
             state = self._machine.step(
-                bool(reasons), reasons, cfg.shed_after, cfg.recover_after)
+                bool(reasons), reasons, shed_after, cfg.recover_after)
             self._evals += 1
             report = {
                 "ts": round(now, 3),
@@ -449,6 +489,7 @@ class Doctor:
                 "reasons": reasons,
                 "objectives": table,
                 "watchdog_trips": dict(self._watchdog_trips),
+                "capacity": capacity_doc,
                 "evals": self._evals,
             }
             self._last_report = report
@@ -525,6 +566,18 @@ class Doctor:
         bump_counter("watchdog_trips_total", watchdog=watchdog)
         logger.warning("watchdog %s tripped: %s", watchdog, detail)
         return True
+
+    def _read_capacity(self) -> Optional[dict[str, Any]]:
+        """Never-raises capacity probe (the provider is a public contract —
+        a hostile implementation must not kill the evaluation pass)."""
+        provider = self._capacity_provider
+        if provider is None:
+            return None
+        try:
+            capacity = provider()
+        except Exception:  # noqa: BLE001
+            return None
+        return capacity if isinstance(capacity, dict) else None
 
     def _check_watchdogs(self, now: float) -> list[str]:
         """All three watchdogs; returns the names that tripped this pass."""

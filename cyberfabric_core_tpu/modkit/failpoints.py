@@ -76,8 +76,14 @@ FAILPOINT_CATALOG: dict[str, tuple[str, str]] = {
         "runtime", "serving-pool request routing; a raise rejects the "
         "request before any replica sees it"),
     "replicas.failover": (
-        "runtime", "mid-stream failover resubmission; a raise fails the "
-        "failover so the client sees the original error"),
+        "runtime", "mid-stream failover resubmission (each retry attempt); "
+        "a persistent raise exhausts the jittered-backoff retries so the "
+        "client sees the original error"),
+    "replicas.rebuild": (
+        "runtime", "lifecycle replica rebuild (pool manager and the "
+        "single-engine supervisor); an armed raise models a device still "
+        "too sick to rebuild on — strikes accumulate through exponential "
+        "backoff until the replica is benched"),
     # -- gateway ----------------------------------------------------------
     "gateway.request": (
         "gateway", "per-request middleware entry (inside the error-mapping "

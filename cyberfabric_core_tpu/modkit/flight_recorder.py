@@ -63,6 +63,14 @@ _PHASE_AFTER = {
     #: a watchdog marked the stream stalled (doctor); the next progress
     #: event (decode_chunk/resumed/…) clears the phase back
     "stalled": "stalled",
+    #: replica lifecycle episodes (runtime/lifecycle.py) ride the recorder
+    #: under per-episode ids (``pool1/replica0/drain-2``): a drain shows as
+    #: a live "draining" row until its drain_end closes it, and a rebuild is
+    #: a single-shot closed record — the surface that explains a request
+    #: explains a replica too
+    "drain_begin": "draining",
+    "drain_end": "drained",
+    "replica_rebuilt": "rebuilt",
 }
 
 #: events that prove the stream is moving again — they clear a watchdog's
@@ -70,7 +78,11 @@ _PHASE_AFTER = {
 _PROGRESS = frozenset({"admitted", "prefill", "prefill_chunk", "first_token",
                        "decode_chunk", "resumed", "finished"})
 
-_TERMINAL = frozenset({"finished", "error", "evicted"})
+#: drain_end / replica_rebuilt close their episode records like request
+#: terminals do (only ``finished`` feeds the latency histograms, and the
+#: doctor's listener ignores kinds it does not ingest)
+_TERMINAL = frozenset({"finished", "error", "evicted",
+                       "drain_end", "replica_rebuilt"})
 
 
 class RequestRecord:

@@ -29,6 +29,9 @@ from ...modkit.failpoints import failpoint_async
 from ...modkit.logging_host import observe_task
 from ...runtime.engine import (EngineConfig, InferenceEngine, SamplingParams,
                                SchedulerSaturated, StepEvent)
+from ...runtime.lifecycle import (EngineSupervisor, LifecycleConfig,
+                                  LifecycleStateError, ReplicaUnavailable)
+from ...runtime.replicas import DataParallelServingPool
 from ...runtime.scheduler import ContinuousBatchingEngine
 from ...runtime.tokenizer import (CHAT_FAMILIES, ByteTokenizer, Tokenizer,
                                   chat_family_for, load_tokenizer, render_chat)
@@ -81,12 +84,22 @@ class _EngineEntry:
     engine: Optional[InferenceEngine] = None          # lockstep mode
     batcher: Optional["_DynamicBatcher"] = None       # lockstep mode
     scheduler: Optional[ContinuousBatchingEngine] = None  # continuous mode
+    #: continuous mode with engine_options.dp_replicas > 1: the request
+    #: router IS a data-parallel serving pool (replicas pinned to distinct
+    #: devices, mid-stream failover, lifecycle-supervised rebuild)
+    pool: Optional[DataParallelServingPool] = None
+    #: continuous single-engine mode: rebuild-in-place supervisor — a broken
+    #: scheduler is replaced (reusing its params) instead of 500ing forever
+    supervisor: Optional[EngineSupervisor] = None
     model_family: str = "llama"
     last_used: float = 0.0
     est_bytes: int = 0
 
     @property
     def idle(self) -> bool:
+        if self.pool is not None:
+            st = self.pool.stats()
+            return st["active"] == 0 and st["pending"] == 0
         if self.scheduler is not None:
             return self.scheduler.active_slots == 0 and \
                 self.scheduler._pending.qsize() == 0
@@ -263,6 +276,8 @@ class LocalTpuWorker(LlmWorkerApi):
             victim_key, victim = min(idle, key=lambda kv: kv[1].last_used)
             logger.info("hot-swap: evicting engine %s (idle %.1fs)", victim_key,
                         time.monotonic() - victim.last_used)
+            if victim.pool is not None:
+                victim.pool.shutdown(timeout=5.0)
             if victim.scheduler is not None:
                 victim.scheduler.shutdown(timeout=5.0)
             del self._entries[victim_key]
@@ -352,12 +367,43 @@ class LocalTpuWorker(LlmWorkerApi):
                 "scheduler: lockstep for this model or drop the option",
                 eng_cfg.speculative)
         if mode == "continuous":
+            # replica lifecycle knobs (docs/ARCHITECTURE.md "Replica
+            # lifecycle"): dp_replicas > 1 serves this model through a
+            # data-parallel pool (one engine per device, mid-stream
+            # failover, supervised rebuild + probation + drain control
+            # plane); 1 keeps the single engine but still gains a
+            # rebuild-in-place supervisor. `lifecycle` takes a bool or a
+            # LifecycleConfig-shaped dict; default supervised.
+            dp_replicas = int(opts.pop("dp_replicas", 1))
+            lc_cfg = LifecycleConfig.from_config(opts.pop("lifecycle", True))
+            if dp_replicas > 1:
+                pool = DataParallelServingPool(
+                    eng_cfg, n_replicas=dp_replicas, params=params,
+                    lifecycle=lc_cfg)
+                logger.info(
+                    "continuous pool ready for %s (%s, %d replicas, "
+                    "slots=%d each, max_seq=%d)", model.canonical_id,
+                    arch_config, dp_replicas, eng_cfg.max_batch,
+                    eng_cfg.max_seq_len)
+                return _EngineEntry(config=eng_cfg, tokenizer=tokenizer,
+                                    pool=pool, model_family=chat_family)
             scheduler = ContinuousBatchingEngine(eng_cfg, params=params)
+            supervisor = None
+            if lc_cfg.enabled:
+                def _rebuild(old: Any, _cfg=eng_cfg) -> Any:
+                    # fresh engine off the spent one's committed params —
+                    # O(scheduler start), not O(checkpoint load)
+                    return ContinuousBatchingEngine(
+                        _cfg, params=getattr(old, "params", None))
+
+                supervisor = EngineSupervisor(_rebuild, lc_cfg,
+                                              name=model.canonical_id)
             logger.info("continuous engine ready for %s (%s, slots=%d, max_seq=%d)",
                         model.canonical_id, arch_config, eng_cfg.max_batch,
                         eng_cfg.max_seq_len)
             return _EngineEntry(config=eng_cfg, tokenizer=tokenizer,
-                                scheduler=scheduler, model_family=chat_family)
+                                scheduler=scheduler, supervisor=supervisor,
+                                model_family=chat_family)
         engine = InferenceEngine(eng_cfg)
         if params is not None:
             engine.params = params
@@ -448,10 +494,25 @@ class LocalTpuWorker(LlmWorkerApi):
             queue=queue,
             stop_strings=tuple(params.get("stop", ()) or ()),
         )
-        if entry.scheduler is not None:
+        if entry.pool is not None or entry.scheduler is not None:
             loop = asyncio.get_running_loop()
+            if entry.pool is None and not entry.scheduler.servable() \
+                    and entry.supervisor is not None:
+                # single-engine self-healing: the scheduler broke (or was
+                # retired) — rebuild it in place off the event loop before
+                # admitting. Concurrent callers land in the supervisor's
+                # backoff window and surface 503 + Retry-After instead of
+                # stacking N rebuilds.
+                try:
+                    entry.scheduler = await loop.run_in_executor(
+                        self._executor, entry.supervisor.ensure,
+                        entry.scheduler)
+                except ReplicaUnavailable as e:
+                    raise ERR.llm.replica_unavailable.error(
+                        str(e), retry_after_s=e.retry_after_s)
+            target = entry.pool if entry.pool is not None else entry.scheduler
             try:
-                entry.scheduler.submit(
+                target.submit(
                     prompt_ids, sampling,
                     emit=lambda ev: loop.call_soon_threadsafe(
                         queue.put_nowait, ev),
@@ -469,6 +530,12 @@ class LocalTpuWorker(LlmWorkerApi):
                 # e.g. seed on the dense scheduler: a client-fixable request
                 # shape, not a server fault
                 raise ERR.llm.unsupported_param.error(str(e))
+            except RuntimeError as e:
+                # "no healthy replicas" (pool) / a break-or-close racing the
+                # servable() probe: a transient capacity hole while the
+                # lifecycle supervisor rebuilds — 503 + Retry-After, not 500
+                raise ERR.llm.replica_unavailable.error(
+                    str(e), retry_after_s=1.0)
             # stamp the owning model onto the flight record (the scheduler
             # emits the lifecycle events but does not know which registry
             # entry owns it) — the doctor's per-model SLO overrides and the
@@ -525,6 +592,11 @@ class LocalTpuWorker(LlmWorkerApi):
             if ev.finished or stop_hit:
                 self._requests_served += 1
                 self._tokens_out += n_tokens
+                if entry.supervisor is not None and (
+                        stop_hit or ev.finished in ("stop", "length")):
+                    # the single-engine probation pass: a clean stream off
+                    # the (possibly rebuilt) scheduler clears its strikes
+                    entry.supervisor.note_ok()
                 usage = {"input_tokens": len(prompt_ids), "output_tokens": n_tokens}
                 reason = "stop" if (stop_hit or ev.finished == "stop") else (ev.finished or "stop")
                 yield ChatStreamChunk(request_id=request_id, finish_reason=reason,
@@ -623,9 +695,147 @@ class LocalTpuWorker(LlmWorkerApi):
     # ------------------------------------------------------------------ health
     def schedulers(self) -> list[tuple[str, Any]]:
         # snapshot: called from the doctor's evaluation thread while the
-        # event loop may be admitting/evicting entries
-        return [(name, e.scheduler) for name, e in list(self._entries.items())
-                if e.scheduler is not None]
+        # event loop may be admitting/evicting entries. Pool entries expose
+        # every replica engine (watchdogs and queue gauges see each one).
+        out: list[tuple[str, Any]] = []
+        for name, e in list(self._entries.items()):
+            if e.scheduler is not None:
+                out.append((name, e.scheduler))
+            elif e.pool is not None:
+                out.extend((f"{name}[{i}]", eng)
+                           for i, eng in enumerate(e.pool.replicas))
+        return out
+
+    # -------------------------------------------------- replica control plane
+    def _replica_rows(self) -> list[tuple[dict[str, Any], Any, int]]:
+        """Flat (row, entry, replica_idx) list — the stable index space the
+        /v1/monitoring/replicas endpoints address. Pool replicas are
+        controllable (drain/undrain/restart); single-engine entries are
+        listed with their supervisor state but have no pool to drain into."""
+        rows: list[tuple[dict[str, Any], Any, int]] = []
+        for name in sorted(self._entries):
+            entry = self._entries[name]
+            if entry.pool is not None:
+                lc = entry.pool.lifecycle
+                for i, eng in enumerate(entry.pool.replicas):
+                    try:
+                        st = eng.stats()
+                        engine = {k: st.get(k) for k in
+                                  ("broken", "closed", "active", "pending",
+                                   "prefilling", "suspended")}
+                    except Exception:  # noqa: BLE001 — a dying engine
+                        engine = {"broken": "stats() failed"}
+                    # one status_row read per row: two would double the
+                    # manager-lock round-trips and could disagree with
+                    # themselves when a tick lands between them
+                    sr = lc.status_row(i) if lc is not None else None
+                    rows.append(({
+                        "index": len(rows), "model": name, "replica": i,
+                        "pool": True, "controllable": lc is not None,
+                        "state": (sr["state"] if sr is not None
+                                  else ("broken" if engine.get("broken")
+                                        else "healthy")),
+                        "lifecycle": sr,
+                        "engine": engine,
+                    }, entry, i))
+            elif entry.scheduler is not None:
+                sched = entry.scheduler
+                try:
+                    st = sched.stats()
+                    engine = {k: st.get(k) for k in
+                              ("broken", "closed", "active", "pending",
+                               "prefilling", "suspended")}
+                except Exception:  # noqa: BLE001
+                    engine = {"broken": "stats() failed"}
+                sup = entry.supervisor
+                rows.append(({
+                    "index": len(rows), "model": name, "replica": 0,
+                    "pool": False, "controllable": False,
+                    "state": ("benched" if sup is not None and sup.benched
+                              else "drained" if engine.get("closed")
+                              else "broken" if engine.get("broken")
+                              else "healthy"),
+                    "supervisor": sup.status() if sup is not None else None,
+                    "engine": engine,
+                }, entry, 0))
+        return rows
+
+    def replicas_view(self) -> list[dict[str, Any]]:
+        """GET /v1/monitoring/replicas rows."""
+        return [row for row, _, _ in self._replica_rows()]
+
+    def replica_control(self, index: int, action: str,
+                        deadline_s: Optional[float] = None,
+                        expect_model: Optional[str] = None) -> dict[str, Any]:
+        """drain / undrain / restart replica ``index`` of the flat view.
+        Raises KeyError (unknown index), LifecycleStateError (illegal from
+        the replica's current state, or not a supervised pool replica).
+
+        The flat index space shifts when model entries are built or
+        evicted between the operator's GET and this POST — pass
+        ``expect_model`` (the model the listed row named) and the action is
+        refused with a conflict instead of landing on a different
+        replica."""
+        rows = self._replica_rows()
+        if not 0 <= index < len(rows):
+            raise KeyError(
+                f"replica index {index} out of range ({len(rows)} replicas)")
+        row, entry, i = rows[index]
+        if expect_model is not None and expect_model != row["model"]:
+            raise LifecycleStateError(
+                f"replica index {index} now resolves to {row['model']!r}, "
+                f"not {expect_model!r} — the entry table changed since the "
+                "listing; re-fetch GET /v1/monitoring/replicas")
+        lc = entry.pool.lifecycle if entry.pool is not None else None
+        if lc is None:
+            raise LifecycleStateError(
+                f"replica {index} ({row['model']}) is not a supervised pool "
+                "replica; drain/undrain/restart need dp_replicas > 1 with "
+                "lifecycle enabled")
+        if action == "drain":
+            result = lc.drain(i, deadline_s=deadline_s)
+        elif action == "undrain":
+            result = lc.undrain(i)
+        elif action == "restart":
+            result = lc.restart(i)
+        else:
+            raise ValueError(f"unknown replica action {action!r}")
+        return {"index": index, "model": row["model"], "replica": i,
+                "action": action, "lifecycle": result}
+
+    def replica_capacity(self) -> dict[str, Any]:
+        """Aggregated replica census — the doctor's capacity feed (shedding
+        thresholds scale with surviving capacity) and the
+        llm_replicas_healthy / llm_replicas_benched gauge source. A
+        single-engine entry counts as one replica: serving while its
+        scheduler is servable, benched when its supervisor benched it."""
+        counts = {"replicas": 0, "serving": 0, "healthy": 0, "probation": 0,
+                  "draining": 0, "drained": 0, "quarantined": 0,
+                  "rebuilding": 0, "benched": 0}
+        for name, entry in list(self._entries.items()):
+            if entry.pool is not None and entry.pool.lifecycle is not None:
+                c = entry.pool.lifecycle.counts()
+                counts["replicas"] += c["replicas"]
+                counts["serving"] += c["serving"]
+                for k in ("healthy", "probation", "draining", "drained",
+                          "quarantined", "rebuilding", "benched"):
+                    counts[k] += c[k]
+            elif entry.pool is not None:
+                per = entry.pool.stats()
+                counts["replicas"] += per["replicas"]
+                counts["serving"] += per["healthy"]
+                counts["healthy"] += per["healthy"]
+            elif entry.scheduler is not None:
+                counts["replicas"] += 1
+                sup = entry.supervisor
+                if sup is not None and sup.benched:
+                    counts["benched"] += 1
+                elif entry.scheduler.servable():
+                    counts["serving"] += 1
+                    counts["healthy"] += 1
+                else:
+                    counts["quarantined"] += 1
+        return counts
 
     async def health(self) -> dict[str, Any]:
         import jax
@@ -636,6 +846,8 @@ class LocalTpuWorker(LlmWorkerApi):
             "loaded_models": sorted(self._entries) + sorted(self._embed_entries),
             "schedulers": {k: e.scheduler.stats() for k, e in self._entries.items()
                            if e.scheduler is not None},
+            "pools": {k: e.pool.stats() for k, e in self._entries.items()
+                      if e.pool is not None},
             "requests_served": self._requests_served,
             "tokens_out": self._tokens_out,
             "uptime_s": round(time.monotonic() - self._started_at, 1),

@@ -102,6 +102,15 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
             return worker.schedulers() if worker is not None else []
 
         default_doctor.set_scheduler_provider(_doctor_schedulers)
+
+        def _doctor_capacity():
+            # replica lifecycle census: the doctor scales its shedding
+            # hysteresis with surviving capacity, and zero serving replicas
+            # is a degradation reason in itself
+            worker = hub.try_get(LlmWorkerApi)
+            return worker.replica_capacity() if worker is not None else {}
+
+        default_doctor.set_capacity_provider(_doctor_capacity)
         self.doctor = default_doctor
 
         # pre-register the doctor metric families so dashboards can alert
@@ -137,6 +146,20 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
         self.registry.counter(
             "llm_cache_aware_placements_total",
             "Requests routed by the prefix-cache affinity hint").inc(0.0)
+
+        # replica lifecycle (self-healing pools): rebuild outcomes and the
+        # healthy/benched census — pre-registered so dashboards can alert
+        # from the first scrape; values are pushed by the lifecycle manager
+        # (counter) and the doctor's evaluation pass (gauges)
+        self.registry.counter(
+            "llm_replica_rebuilds_total",
+            "Replica rebuilds by outcome (ok/failed)").inc(0.0)
+        self.registry.gauge(
+            "llm_replicas_healthy",
+            "Replicas in lifecycle state healthy").set(0.0)
+        self.registry.gauge(
+            "llm_replicas_benched",
+            "Replicas benched after repeated strikes").set(0.0)
 
         # device gauges, evaluated at scrape time
         def device_count() -> float:
@@ -294,6 +317,7 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
         if doctor is not None:
             doctor.stop()
             doctor.set_scheduler_provider(None)
+            doctor.set_capacity_provider(None)
             doctor.detach_recorder()
 
     def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
@@ -525,6 +549,88 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
             .summary("SLO objective table, burn rates, watchdog trips, and "
                      "degradation state history (fabric-doctor)") \
             .handler(get_slo).register()
+
+        # ---- replica lifecycle control plane: the operator's rolling-
+        # restart surface. GET lists every replica (pool replicas + single
+        # engines) with lifecycle state and engine health; the POST actions
+        # drive supervised pool replicas through drain → drained → restart
+        # (restart is async: the handler walks the state machine and the
+        # lifecycle supervisor performs the close + rebuild off-thread).
+        from ..runtime.lifecycle import LifecycleStateError
+
+        async def list_replicas(request: web.Request):
+            worker = ctx.client_hub.try_get(LlmWorkerApi)
+            return {
+                "replicas": worker.replicas_view() if worker else [],
+                "capacity": worker.replica_capacity() if worker else {},
+            }
+
+        def _replica_index(request: web.Request) -> int:
+            raw = request.match_info["index"]
+            try:
+                return int(raw)
+            except ValueError:
+                raise ERR.core.bad_request.error(
+                    f"replica index must be an integer, got {raw!r}")
+
+        async def _replica_action(request: web.Request, action: str):
+            worker = ctx.client_hub.try_get(LlmWorkerApi)
+            if worker is None:
+                raise ERR.monitoring.unknown_replica.error(
+                    "no llm worker in this stack")
+            index = _replica_index(request)
+            # ?model= pins the action to the model the operator's listing
+            # showed — the flat index space shifts under entry churn, and a
+            # mismatch must 409 rather than drain the wrong replica
+            expect_model = request.query.get("model")
+            deadline_s = None
+            if action == "drain" and request.content_length:
+                body = await read_json(request, {
+                    "type": "object",
+                    "properties": {"deadline_s": {"type": "number",
+                                                  "minimum": 0}},
+                    "additionalProperties": False})
+                deadline_s = body.get("deadline_s")
+            try:
+                return worker.replica_control(index, action,
+                                              deadline_s=deadline_s,
+                                              expect_model=expect_model)
+            except (KeyError, IndexError) as e:
+                raise ERR.monitoring.unknown_replica.error(
+                    str(e).strip("'\""))
+            except LifecycleStateError as e:
+                raise ERR.monitoring.replica_conflict.error(str(e))
+
+        async def drain_replica(request: web.Request):
+            return await _replica_action(request, "drain")
+
+        async def undrain_replica(request: web.Request):
+            return await _replica_action(request, "undrain")
+
+        async def restart_replica(request: web.Request):
+            return await _replica_action(request, "restart")
+
+        router.operation("GET", "/v1/monitoring/replicas",
+                         module="monitoring").auth_required() \
+            .summary("Replica lifecycle table: per-replica state, strikes, "
+                     "rebuild counters, and the aggregated capacity census") \
+            .handler(list_replicas).register()
+        router.operation("POST", "/v1/monitoring/replicas/{index}/drain",
+                         module="monitoring").auth_required() \
+            .summary("Drain a pool replica: stop new admissions, let "
+                     "in-flight finish; past deadline_s stragglers fail "
+                     "over to surviving replicas") \
+            .handler(drain_replica).register()
+        router.operation("POST", "/v1/monitoring/replicas/{index}/undrain",
+                         module="monitoring").auth_required() \
+            .summary("Return a still-draining replica to rotation") \
+            .handler(undrain_replica).register()
+        router.operation("POST", "/v1/monitoring/replicas/{index}/restart",
+                         module="monitoring").auth_required() \
+            .summary("Close + rebuild a replica (clears strikes — the "
+                     "benched escape hatch); rebuild runs on the "
+                     "lifecycle supervisor thread") \
+            .handler(restart_replica).register()
 
         router.operation("GET", "/v1/monitoring/failpoints",
                          module="monitoring").auth_required() \

@@ -115,6 +115,25 @@ class LlmWorkerApi(abc.ABC):
         workers have no local scheduler)."""
         return []
 
+    def replicas_view(self) -> list[dict[str, Any]]:
+        """Flat replica rows (pool replicas + single engines) for
+        ``GET /v1/monitoring/replicas``. Default: none."""
+        return []
+
+    def replica_control(self, index: int, action: str,
+                        deadline_s: Optional[float] = None,
+                        expect_model: Optional[str] = None) -> dict[str, Any]:
+        """drain / undrain / restart one replica of :meth:`replicas_view`'s
+        index space (``expect_model`` guards against the flat index shifting
+        under entry churn). Default: no replicas to control."""
+        raise KeyError(f"replica index {index} out of range (no replicas)")
+
+    def replica_capacity(self) -> dict[str, Any]:
+        """Aggregated replica state census (the doctor's capacity feed and
+        the replica gauges). Default: empty — stacks without local replicas
+        never scale shedding thresholds."""
+        return {}
+
 
 class LlmHookApi(abc.ABC):
     """Pre/post interceptors for the llm-gateway (DESIGN.md:743-766): pre_call

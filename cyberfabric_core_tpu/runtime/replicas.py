@@ -25,6 +25,7 @@ down, at the model-replica tier.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 import uuid
@@ -37,6 +38,7 @@ from ..modkit.failpoints import failpoint, record_recovery
 from ..modkit.flight_recorder import record_event
 from ..modkit.metrics import bump_counter
 from .engine import EngineConfig, SamplingParams, StepEvent
+from .lifecycle import LifecycleConfig, ReplicaLifecycleManager
 from .scheduler import ContinuousBatchingEngine
 
 logger = logging.getLogger("replicas")
@@ -62,6 +64,19 @@ class DataParallelServingPool:
     #: via __new__ (tests/test_faultlab.py constructs doubles that way)
     placement_hint_hits = 0
     cache_affinity_slack = 1
+    #: replica lifecycle supervision (runtime/lifecycle.py): None = the
+    #: pre-lifecycle pool (a broken replica stays broken — tests and the
+    #: plain faultlab pool scenarios pin that behavior); pass
+    #: ``lifecycle=True`` / a LifecycleConfig to make the pool self-healing
+    lifecycle: Optional[ReplicaLifecycleManager] = None
+    #: mid-stream failover resubmission retries + jittered backoff base/cap:
+    #: a broken replica fails its whole batch at once, and the immediate
+    #: lockstep resubmission would thunder the survivors (or find none
+    #: mid-rebuild) — each retry waits base·2^n scaled by a seeded jitter
+    failover_retries = 2
+    failover_backoff_s = 0.05
+    failover_backoff_max_s = 0.5
+    _failover_rng = random.Random(0)
 
     def __init__(
         self,
@@ -70,6 +85,8 @@ class DataParallelServingPool:
         devices: Optional[list[Any]] = None,
         seed: int = 0,
         max_retries: int = 1,
+        lifecycle: Any = None,
+        params: Optional[Any] = None,
     ) -> None:
         devices = devices if devices is not None else jax.devices()
         if n_replicas > len(devices):
@@ -77,6 +94,8 @@ class DataParallelServingPool:
                 f"{n_replicas} replicas need {n_replicas} devices, have {len(devices)}")
         self.config = config
         self.max_retries = max_retries
+        self._seed = seed
+        self._failover_rng = random.Random(seed ^ 0xFA17)
         self._lock = threading.Lock()
         self._requests: dict[str, _Tracked] = {}
         self.failovers = 0        # successful mid-stream resubmissions
@@ -94,32 +113,81 @@ class DataParallelServingPool:
             # pinned there (engine `device=`); same seed → identical weights on
             # every replica (a data-parallel serving pool is N copies of ONE
             # model)
+            # an explicit params tree (checkpoint weights) is device_put to
+            # each replica's device; None re-inits from the shared seed
             self.replicas.append(
-                ContinuousBatchingEngine(config, seed=seed, device=dev))
-        logger.info("serving pool: %d replicas over %s", n_replicas,
-                    [str(d) for d in self.devices])
+                ContinuousBatchingEngine(config, params=params, seed=seed,
+                                         device=dev))
+        if lifecycle:
+            lc_cfg = LifecycleConfig.from_config(lifecycle)
+            if lc_cfg.enabled:
+                self.lifecycle = ReplicaLifecycleManager(self, lc_cfg)
+                self.lifecycle.start()
+        logger.info("serving pool: %d replicas over %s (lifecycle %s)",
+                    n_replicas, [str(d) for d in self.devices],
+                    "supervised" if self.lifecycle is not None else "off")
+
+    def build_replica(self, idx: int) -> ContinuousBatchingEngine:
+        """A fresh engine for slot ``idx`` on its pinned device, reusing the
+        retired engine's already-committed params tree — rebuild costs
+        O(scheduler start + program build), never O(weight load) (the
+        Tangram device-resident-weights recipe). Called by the lifecycle
+        manager; the caller commits it into ``replicas[idx]``."""
+        old = self.replicas[idx]
+        return ContinuousBatchingEngine(
+            self.config, params=getattr(old, "params", None),
+            seed=self._seed, device=self.devices[idx])
 
     # ------------------------------------------------------------------ routing
     def _healthy(self) -> list[int]:
-        return [i for i, r in enumerate(self.replicas) if r.stats()["broken"] is None]
+        """Replicas whose ENGINE can serve (not crashed, not retired) —
+        the stats() census. Routing additionally consults the lifecycle
+        manager (probation canary budgets, draining) via _pick."""
+        return [i for i, r in enumerate(self.replicas)
+                if (s := r.stats())["broken"] is None
+                and not s.get("closed")]
 
-    def _pick(self, prompt_ids: Optional[list[int]] = None) -> int:
-        """Least-loaded healthy replica (active slots + pending queue) —
+    def _pick(self, prompt_ids: Optional[list[int]] = None,
+              exclude: tuple[int, ...] = ()) -> int:
+        """Least-loaded admittable replica (active slots + pending queue) —
         unless another replica's prefix cache already holds this prompt's
         head (RTP-LLM's cache-aware routing recipe): route there while its
         load stays within ``cache_affinity_slack`` of the least-loaded, so
-        affinity exploits KV reuse but never overrides real imbalance."""
-        best, best_load = None, None
+        affinity exploits KV reuse but never overrides real imbalance.
+
+        ``exclude`` removes replicas by decree regardless of what their
+        stats() claim — failover passes the replica that JUST broke, whose
+        ``broken`` flag may not have flipped yet mid-teardown. With a
+        lifecycle manager attached, non-admitting states (quarantined /
+        rebuilding / draining / drained / benched) are skipped and probation
+        replicas are capped at their canary budget — but a probation replica
+        WITH budget gets a half-load head start, so an idle canary target
+        wins idle ties and actually receives the traffic its promotion
+        requires (real load still outvotes the bonus)."""
+        best, best_eff = None, None
         loads: dict[int, int] = {}
-        for i in self._healthy():
-            s = self.replicas[i].stats()
+        lc = self.lifecycle
+        for i, r in enumerate(self.replicas):
+            if i in exclude:
+                continue
+            s = r.stats()
+            if s["broken"] is not None or s.get("closed"):
+                continue
+            if lc is not None and not lc.admit_allowed(i):
+                continue
             # prefilling slots occupy capacity too (mixed batching admits
             # into prefill-phase slots that are neither active nor pending)
             loads[i] = s["active"] + s["pending"] + s.get("prefilling", 0)
-            if best_load is None or loads[i] < best_load:
-                best, best_load = i, loads[i]
+            eff = loads[i] - (0.5 if lc is not None and lc.canary_wanted(i)
+                              else 0.0)
+            if best_eff is None or eff < best_eff:
+                best, best_eff = i, eff
         if best is None:
             raise RuntimeError("no healthy replicas")
+        # the affinity slack below compares RAW loads — the canary bonus is
+        # a tie-breaker for the pick only and must not skew the documented
+        # cache_affinity_slack math
+        best_load = loads[best]
         if prompt_ids and len(loads) > 1:
             hint, hint_len = None, 0
             for i in loads:
@@ -162,15 +230,41 @@ class DataParallelServingPool:
         # returns from submit — inserting after would leak the record
         with self._lock:
             self._requests[rid] = tracked
+        self._note_dispatch(idx)
         try:
             self.replicas[idx].submit(prompt_ids, sampling,
                                       self._wrap(rid, tracked), rid,
                                       trace=trace)
         except Exception:
+            self._note_departed(idx)
             with self._lock:
                 self._requests.pop(rid, None)
             raise
         return rid
+
+    # ------------------------------------------------- lifecycle notifications
+    # (never-raises: these run on submit and scheduler-emit paths — a
+    # supervision bug must not break serving or a mid-stream failover)
+    def _note_dispatch(self, idx: int) -> None:
+        if self.lifecycle is not None:
+            try:
+                self.lifecycle.note_dispatch(idx)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _note_departed(self, idx: int) -> None:
+        if self.lifecycle is not None:
+            try:
+                self.lifecycle.on_departed(idx)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _note_terminal(self, idx: int, ok: bool) -> None:
+        if self.lifecycle is not None:
+            try:
+                self.lifecycle.on_terminal(idx, ok)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _wrap(self, rid: str, tracked: _Tracked) -> Callable[[StepEvent], None]:
         """Intercept the replica's events: record progress, fail over on error,
@@ -180,56 +274,102 @@ class DataParallelServingPool:
             if ev.finished == "error" and tracked.retries_left > 0 and not tracked.done:
                 tracked.retries_left -= 1
                 if self._failover(rid, tracked):
-                    return  # resubmitted; suppress the error event
+                    return  # resubmitted (or cleanly closed); suppress the error
             if ev.token_id >= 0:
                 tracked.emitted.append(ev.token_id)
             if ev.finished is not None:
                 tracked.done = True
                 with self._lock:
                     self._requests.pop(rid, None)
+                # probation canaries count their clean terminals here (and a
+                # canary error re-quarantines the replica immediately)
+                self._note_terminal(tracked.replica,
+                                    ev.finished != "error")
             tracked.emit(ev)
 
         return emit
 
     def _failover(self, rid: str, tracked: _Tracked) -> bool:
         """Resubmit on another healthy replica, carrying emitted tokens as
-        prompt continuation (remaining budget shrinks accordingly)."""
+        prompt continuation (remaining budget shrinks accordingly).
+
+        Returns True when the client's stream is taken care of — either
+        resubmitted, or (budget already fully served) closed with a clean
+        synthesized ``length`` terminal. The replica that just broke is
+        excluded from the pick by decree: its ``broken`` flag may not have
+        flipped yet mid-teardown, and resubmitting to the corpse would burn
+        the retry budget. Each retry backs off with seeded jitter — a
+        breaking replica fails its whole batch at once, and lockstep
+        immediate resubmission would thunder the survivors (or find none
+        during the beat a lifecycle rebuild needs to offer a target)."""
         t0 = time.monotonic()
-        try:
-            failpoint("replicas.failover")
-            idx = self._pick(tracked.prompt_ids + tracked.emitted)
-        except Exception:  # noqa: BLE001 — incl. injected faults: no replica
-            self.failovers_failed += 1
-            return False
+        old = tracked.replica
         remaining = tracked.sampling.max_tokens - len(tracked.emitted)
         if remaining <= 0:
-            return False
+            # the replica died AFTER this request's full token budget was
+            # emitted — only the terminal event was lost. There is nothing
+            # left to generate, and surfacing the break would turn a
+            # complete response into a spurious error: synthesize the clean
+            # ``length`` terminal the scheduler was about to emit.
+            tracked.done = True
+            with self._lock:
+                self._requests.pop(rid, None)
+            # reopen-then-close so the timeline reads error → failover
+            # (synthesized) → finished(length) instead of ending at the
+            # replica's error
+            record_event(rid, "failover", from_replica=old, to_replica=None,
+                         tokens_carried=len(tracked.emitted),
+                         synthesized_terminal=True)
+            record_event(rid, "finished", reason="length",
+                         tokens=len(tracked.emitted), synthesized=True)
+            # release the canary slot WITHOUT crediting a success: the
+            # replica did break — letting a synthesized terminal count as a
+            # clean canary would promote a crashing probation replica (and
+            # reset its strikes), evading the bench backstop every cycle
+            self._note_departed(old)
+            tracked.emit(StepEvent(0, -1, "length"))
+            return True
         import dataclasses
 
         cont_prompt = tracked.prompt_ids + tracked.emitted
-        cont_sampling = dataclasses.replace(tracked.sampling, max_tokens=remaining)
-        old = tracked.replica
-        tracked.replica = idx
-        logger.warning("failover: replica %d broke; resuming request on %d "
-                       "(%d tokens emitted, %d budget left)",
-                       old, idx, len(tracked.emitted), remaining)
-        # timeline: the failover lands on the SAME request_id, so the
-        # /v1/monitoring/requests/{id} record shows error → failover →
-        # enqueued (attempt 2) as one story
-        record_event(rid, "failover", from_replica=old, to_replica=idx,
-                     tokens_carried=len(tracked.emitted))
-        try:
-            self.replicas[idx].submit(cont_prompt, cont_sampling,
-                                      self._wrap(rid, tracked), rid,
-                                      trace=tracked.trace)
-        except Exception:  # noqa: BLE001 — fall through to the error event
-            logger.exception("failover resubmission failed")
-            self.failovers_failed += 1
-            return False
-        self.failovers += 1
-        record_recovery("replicas.failover", time.monotonic() - t0)
-        bump_counter("llm_replica_failovers_total")
-        return True
+        cont_sampling = dataclasses.replace(tracked.sampling,
+                                            max_tokens=remaining)
+        delay = self.failover_backoff_s
+        for attempt in range(1 + max(0, self.failover_retries)):
+            if attempt:
+                time.sleep(delay * (0.5 + self._failover_rng.random()))  # fabric-lint: waive AS01 reason=jittered failover backoff on the dying scheduler thread; no event loop here
+                delay = min(delay * 2.0, self.failover_backoff_max_s)
+            try:
+                failpoint("replicas.failover")
+                idx = self._pick(cont_prompt, exclude=(old,))
+            except Exception:  # noqa: BLE001 — incl. injected faults: retry
+                continue
+            self._note_dispatch(idx)
+            logger.warning(
+                "failover: replica %d broke; resuming request on %d "
+                "(attempt %d, %d tokens emitted, %d budget left)",
+                old, idx, attempt + 1, len(tracked.emitted), remaining)
+            # timeline: the failover lands on the SAME request_id, so the
+            # /v1/monitoring/requests/{id} record shows error → failover →
+            # enqueued (attempt 2) as one story
+            record_event(rid, "failover", from_replica=old, to_replica=idx,
+                         tokens_carried=len(tracked.emitted))
+            try:
+                self.replicas[idx].submit(cont_prompt, cont_sampling,
+                                          self._wrap(rid, tracked), rid,
+                                          trace=tracked.trace)
+            except Exception:  # noqa: BLE001 — retry, then the error event
+                logger.exception("failover resubmission failed")
+                self._note_departed(idx)
+                continue
+            tracked.replica = idx
+            self._note_departed(old)
+            self.failovers += 1
+            record_recovery("replicas.failover", time.monotonic() - t0)
+            bump_counter("llm_replica_failovers_total")
+            return True
+        self.failovers_failed += 1
+        return False
 
     # ------------------------------------------------------------------ admin
     def stats(self) -> dict[str, Any]:
@@ -245,8 +385,14 @@ class DataParallelServingPool:
             "tokens_emitted": sum(s["tokens_emitted"] for s in per),
             "requests_completed": sum(s["requests_completed"] for s in per),
             "per_replica": per,
+            # lifecycle census (None for unsupervised pools): state rows,
+            # rebuild/drain counters — the /v1/monitoring/replicas source
+            "lifecycle": (self.lifecycle.status()
+                          if self.lifecycle is not None else None),
         }
 
     def shutdown(self, timeout: float = 10.0) -> None:
+        if self.lifecycle is not None:
+            self.lifecycle.stop()  # the supervisor must not rebuild corpses
         for r in self.replicas:
             r.shutdown(timeout)

@@ -324,6 +324,11 @@ class ContinuousBatchingEngine:
         self._thread: Optional[threading.Thread] = None
         self._thread_lock = threading.Lock()
         self._broken: Optional[str] = None
+        #: set by close(): the engine was deliberately retired (drain /
+        #: rolling restart). Distinct from _broken — a closed engine is
+        #: clean but spent; submit/start reject, and a lifecycle manager
+        #: builds a FRESH engine (reusing .params) instead of restarting it
+        self._closed = False
         #: state epoch: bumped on admission/preempt/resume and host-fallback
         #: stop finishes — ring entries dispatched at an older epoch are stale
         self._epoch = 0
@@ -541,6 +546,8 @@ class ContinuousBatchingEngine:
         with self._thread_lock:
             if self._broken:
                 raise RuntimeError(f"scheduler is broken: {self._broken}")
+            if self._closed:
+                raise RuntimeError("scheduler is closed; build a fresh engine")
             if self._thread is None or not self._thread.is_alive():
                 self._stop.clear()
                 self._thread = threading.Thread(
@@ -552,6 +559,25 @@ class ContinuousBatchingEngine:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout)
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Retire the engine: stop the scheduler thread, then error-terminate
+        everything still in flight (the replica pool's failover wrapper turns
+        those errors into resubmissions elsewhere — the drain-deadline
+        "preempt and fail over" path). Callers wanting a CLEAN drain stop
+        routing new work first and wait for idle, so there is nothing left to
+        fail. Unlike a loop crash, close() never sets ``_broken`` — the
+        engine is rebuildable (a lifecycle manager constructs a fresh
+        ContinuousBatchingEngine reusing ``.params``, O(scheduler start) not
+        O(weight load)), just never restartable in place. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.shutdown(timeout)
+        # after the join the scheduler thread is gone (or wedged in a device
+        # call — in which case its future emits are deduped by the pool's
+        # done-tracking wrapper); state is ours to clean up
+        self._fail_all_inflight("replica closed")
 
     def submit(
         self,
@@ -589,6 +615,16 @@ class ContinuousBatchingEngine:
             # either way.
             self.last_round_at = time.monotonic()
         with self._submit_lock:
+            # dead-engine rejection lives UNDER the submit lock, paired with
+            # _fail_all_inflight's locked queue drain: either this put lands
+            # before the teardown drain (the request gets its error
+            # terminal) or the flag is already visible here and we reject —
+            # a request can never be stranded in a queue no loop will drain
+            if self._closed:
+                raise RuntimeError(
+                    "scheduler is closed; build a fresh engine")
+            if self._broken:
+                raise RuntimeError(f"scheduler is broken: {self._broken}")
             # check-and-put under one lock: concurrent gateway threads must
             # not overshoot the bound between qsize() and put() (the
             # scheduler-side requeue paths bypass the bound by design —
@@ -616,6 +652,12 @@ class ContinuousBatchingEngine:
     @property
     def active_slots(self) -> int:
         return int(self.active.sum())
+
+    def servable(self) -> bool:
+        """Cheap per-request admission probe (two attribute reads — no
+        stats() dict build): False once the loop crashed or close() retired
+        the engine, at which point a supervisor should rebuild it."""
+        return self._broken is None and not self._closed
 
     # -------------------------------------------------------- health surface
     def pending_depth(self) -> int:
@@ -710,6 +752,7 @@ class ContinuousBatchingEngine:
         }
         return {
             "broken": self._broken,
+            "closed": self._closed,
             "prefix_cache": self.pool.stats() if self.pool is not None else None,
             "slots": self.n_slots,
             "active": self.active_slots,
@@ -759,40 +802,61 @@ class ContinuousBatchingEngine:
             except Exception as e:  # noqa: BLE001 — device errors must not hang clients
                 logger.exception("scheduler loop failed; failing in-flight requests")
                 self._broken = str(e)[:500]
-                self._ring.clear()
-                for slot in range(self.n_slots):
-                    state = self.slots[slot]
-                    if state is not None:
-                        # record BEFORE emit: the replica pool's failover
-                        # wrapper resubmits synchronously inside emit — the
-                        # terminal must close THIS attempt's record, not the
-                        # fresh one the resubmission just opened
-                        record_event(state.request_id, "error",
-                                     detail="scheduler loop failed")
-                        try:
-                            state.emit(StepEvent(0, -1, "error"))
-                        except Exception:
-                            pass
-                        self.slots[slot] = None
-                self.active[:] = False
-                self._prefill_slots.clear()
-                while self._suspended:  # preempted requests fail too
-                    rec = self._suspended.popleft()
-                    record_event(rec.state.request_id, "error",
-                                 detail="scheduler loop failed while suspended")
-                    try:
-                        rec.state.emit(StepEvent(0, -1, "error"))
-                    except Exception:
-                        pass
-                while True:  # drain queued requests too
-                    try:
-                        req = self._pending.get_nowait()
-                        record_event(req.request_id, "error",
-                                     detail="scheduler loop failed while queued")
-                        req.emit(StepEvent(0, -1, "error"))
-                    except _queue.Empty:
-                        break
+                self._fail_all_inflight("scheduler loop failed")
                 return
+
+    def _fail_all_inflight(self, why: str) -> None:
+        """Error-terminate every in-flight, prefilling, suspended, and queued
+        request — the shared teardown of the loop-crash path (``_broken`` set
+        by the caller) and :meth:`close` (``_broken`` stays None: a closed
+        engine is SPENT, not poisoned — lifecycle managers rebuild a fresh
+        engine off its ``.params``). Single-threaded by construction: runs on
+        the scheduler thread (crash) or after the thread joined (close)."""
+        self._ring.clear()
+        for slot in range(self.n_slots):
+            state = self.slots[slot]
+            if state is not None:
+                # record BEFORE emit: the replica pool's failover
+                # wrapper resubmits synchronously inside emit — the
+                # terminal must close THIS attempt's record, not the
+                # fresh one the resubmission just opened
+                record_event(state.request_id, "error",
+                             detail=why)
+                try:
+                    state.emit(StepEvent(0, -1, "error"))
+                except Exception:
+                    pass
+                self.slots[slot] = None
+        self.active[:] = False
+        self._prefill_slots.clear()
+        while self._suspended:  # preempted requests fail too
+            rec = self._suspended.popleft()
+            record_event(rec.state.request_id, "error",
+                         detail=f"{why} while suspended")
+            try:
+                rec.state.emit(StepEvent(0, -1, "error"))
+            except Exception:
+                pass
+        # drain queued requests too — the POP runs under the submit lock, so
+        # a racing submit() either lands its put before the pop (and gets
+        # its error terminal below) or sees _closed/_broken under the same
+        # lock and rejects: a client can never be stranded on a queue no
+        # loop will serve. The EMITS run after the lock is released — a
+        # pool's failover emit submits into ANOTHER engine's _submit_lock
+        # (and sleeps its jittered backoff), so emitting under ours would
+        # deadlock two same-round teardowns against each other (ABBA) and
+        # block fast rejects behind the whole drain.
+        stranded: list[_Pending] = []
+        with self._submit_lock:
+            while True:
+                try:
+                    stranded.append(self._pending.get_nowait())
+                except _queue.Empty:
+                    break
+        for req in stranded:
+            record_event(req.request_id, "error",
+                         detail=f"{why} while queued")
+            req.emit(StepEvent(0, -1, "error"))
 
     # ------------------------------------------------------------ slot accounting
     def _take_free_slot(self) -> Optional[int]:

@@ -92,6 +92,78 @@ def test_config_rejects_bad_objective():
             .build_objectives()
 
 
+# --------------------------------------------------------------- capacity
+
+
+def _capacity(replicas, serving, healthy=None, benched=0):
+    return {"replicas": replicas, "serving": serving,
+            "healthy": serving if healthy is None else healthy,
+            "benched": benched}
+
+
+def test_capacity_zero_serving_is_a_degradation_reason():
+    doctor, _rec = _doctor()
+    doctor.set_capacity_provider(lambda: _capacity(2, 0))
+    report = doctor.evaluate()
+    assert "capacity:no_serving_replicas" in report["reasons"]
+    assert report["state"] == "degraded"
+    assert report["capacity"]["capacity_frac"] == 0.0
+    # capacity restored → the machine walks home
+    doctor.set_capacity_provider(lambda: _capacity(2, 2))
+    for _ in range(3):
+        report = doctor.evaluate()
+    assert report["state"] == "healthy"
+    assert report["capacity"]["effective_shed_after"] == 2
+
+
+def test_capacity_scales_shedding_hysteresis():
+    """At half capacity the survivors carry the dead replicas' load: the
+    same burn escalates to shedding after proportionally fewer bad
+    evaluations (shed_after 4 → 2 at 2/4 replicas)."""
+    doctor, rec = _doctor(shed_after=4)
+    doctor.set_capacity_provider(lambda: _capacity(4, 2))
+    for i in range(6):
+        _finish_request(rec, f"err-{i}", error=True)
+    report = doctor.evaluate()
+    assert report["capacity"]["effective_shed_after"] == 2
+    assert report["state"] == "degraded"
+    doctor.evaluate()
+    report = doctor.evaluate()  # 2 bad evals IN degraded suffice at half cap
+    assert report["state"] == "shedding"
+    # full capacity would still be degraded after the same walk
+    doctor2, rec2 = _doctor(shed_after=4)
+    doctor2.set_capacity_provider(lambda: _capacity(4, 4))
+    for i in range(6):
+        _finish_request(rec2, f"err-{i}", error=True)
+    for _ in range(3):
+        report2 = doctor2.evaluate()
+    assert report2["state"] == "degraded"
+    assert report2["capacity"]["effective_shed_after"] == 4
+
+
+def test_capacity_provider_is_optional_and_hostile_safe():
+    doctor, _rec = _doctor()
+    report = doctor.evaluate()
+    assert report["capacity"] is None  # no provider wired
+    doctor.set_capacity_provider(lambda: (_ for _ in ()).throw(RuntimeError))
+    report = doctor.evaluate()  # a hostile provider cannot kill the pass
+    assert report["state"] == "healthy" and report["capacity"] is None
+    doctor.set_capacity_provider(lambda: "not-a-dict")
+    assert doctor.evaluate()["capacity"] is None
+
+
+def test_capacity_feeds_replica_gauges():
+    from cyberfabric_core_tpu.modkit.metrics import default_registry
+
+    doctor, _rec = _doctor()
+    doctor.set_capacity_provider(lambda: _capacity(3, 2, healthy=2,
+                                                   benched=1))
+    doctor.evaluate()
+    text = default_registry.render()
+    assert "llm_replicas_healthy 2" in text
+    assert "llm_replicas_benched 1" in text
+
+
 # --------------------------------------------------------------- slo engine
 
 

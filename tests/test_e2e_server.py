@@ -662,6 +662,41 @@ def test_monitoring_rounds_chrome_trace_export(server):
     assert any(raw["rounds"].values())
 
 
+def test_monitoring_replicas_surface(server):
+    """Replica lifecycle control plane: the flat table lists the live
+    single-engine entry with its supervisor state, the capacity census
+    aggregates it, and the POST actions validate index/state as RFC-9457
+    problems (a single engine has no pool to drain into)."""
+    # make sure the tiny-llama engine entry exists (lazy build); earlier
+    # chaos tests may have left the doctor shedding, so tolerate a 429 —
+    # the entry was already built by the chat tests either way
+    status, _ = req(server, "POST", "/v1/completions", json={
+        "model": "local::tiny-llama", "prompt": "warm", "max_tokens": 2})
+    assert status in (200, 429)
+    status, doc = req(server, "GET", "/v1/monitoring/replicas")
+    assert status == 200, doc
+    row = next(r for r in doc["replicas"]
+               if r["model"] == "local::tiny-llama")
+    assert row["state"] == "healthy" and row["pool"] is False
+    assert row["supervisor"]["benched"] is False
+    assert row["engine"]["broken"] is None
+    cap = doc["capacity"]
+    assert cap["replicas"] >= 1 and cap["serving"] >= 1
+    status, prob = req(server, "POST",
+                       f"/v1/monitoring/replicas/{row['index']}/drain",
+                       json={"deadline_s": 1.0})
+    assert status == 409 and prob["code"] == "replica_conflict", prob
+    status, prob = req(server, "POST", "/v1/monitoring/replicas/99/restart")
+    assert status == 404 and prob["code"] == "unknown_replica", prob
+    status, prob = req(server, "POST", "/v1/monitoring/replicas/x/drain")
+    assert status == 400, prob
+    # ?model= pins the action against flat-index churn: a mismatch 409s
+    status, prob = req(
+        server, "POST",
+        f"/v1/monitoring/replicas/{row['index']}/restart?model=local::other")
+    assert status == 409 and prob["code"] == "replica_conflict", prob
+
+
 def test_sse_stream_carries_request_id_header(server):
     """Streaming responses are prepared before the middleware epilogue runs —
     the SSE handler must stamp X-Request-Id itself so clients can correlate

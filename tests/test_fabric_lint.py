@@ -611,8 +611,46 @@ def test_WD01_outside_doctor_classes_passes():
     assert ok == []
 
 
+def test_WD01_supervisor_tick_blocking_sleep_fails():
+    # the lifecycle supervisor's tick holds the same contract as the doctor
+    # evaluator: it is the only thing that can HEAL a broken pool
+    bad = lint("import time\n"
+               "class ReplicaLifecycleManager:\n"
+               "    def tick(self, now=None):\n"
+               "        time.sleep(0.1)\n",
+               tier="runtime", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and bad[0].line == 4
+
+
+def test_WD01_supervisor_terminal_hook_direct_metric_fails():
+    # on_terminal runs on scheduler-emit hot paths — a raising metric
+    # mutate there would break serving, not just supervision
+    bad = lint("class EngineSupervisor:\n"
+               "    def on_terminal(self, idx, ok, registry):\n"
+               "        registry.counter('llm_replica_rebuilds_total')"
+               ".inc(outcome='ok')\n",
+               tier="runtime", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and "bump_counter" in bad[0].message
+
+
+def test_WD01_supervisor_rebuild_helpers_exempt():
+    # the deliberately-blocking engine operations (close/build/start) live
+    # OUTSIDE the tick-prefixed decision pass — the rule's scope encodes
+    # that split, so rebuild helpers may block
+    ok = lint("import time\n"
+              "class ReplicaLifecycleManager:\n"
+              "    def _do_rebuild(self, idx):\n"
+              "        time.sleep(0.1)\n"
+              "class PoolHelper:\n"
+              "    def tick(self):\n"
+              "        time.sleep(0.1)\n",  # not a supervisor class
+              tier="runtime", select=("WD01",))
+    assert ok == []
+
+
 def test_WD01_repo_gate_clean():
-    """The gate: the shipped doctor's evaluators hold their own contract."""
+    """The gate: the shipped doctor's evaluators AND the lifecycle
+    supervisor's tick/routing callbacks hold their own contract."""
     engine = Engine(all_rules()).select(["WD01"])
     findings = [f for f in engine.run(PKG) if not f.suppressed]
     assert findings == [], [f.to_dict() for f in findings]
