@@ -5,9 +5,9 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench lifecycle-guard
+.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench lifecycle-guard cancel-guard
 
-safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench lifecycle-guard  ## the full local gate
+safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench lifecycle-guard cancel-guard  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
 
@@ -61,6 +61,10 @@ overlap-bench:  ## deep-lookahead pipeline tests + the depth 0/1/N sweep (BENCH_
 lifecycle-guard:  ## replica lifecycle tests + the disarmed-supervisor overhead A/B (BENCH_LIFECYCLE.json, <1% bar)
 	$(PY) -m pytest tests/test_lifecycle.py tests/test_replicas.py -q
 	$(PY) bench.py --lifecycle-guard > /dev/null
+
+cancel-guard:  ## end-to-end cancellation/deadline tests + the armed-but-unused deadline-sweep overhead A/B (BENCH_CANCEL.json, <1% bar)
+	$(PY) -m pytest tests/test_cancellation.py -q
+	$(PY) bench.py --cancel-guard > /dev/null
 
 test:  ## full suite
 	$(PY) -m pytest tests/ -q

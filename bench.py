@@ -823,6 +823,24 @@ def lifecycle_guard() -> int:
         "best run per arm (contention only slows runs down)")
 
 
+def cancel_guard() -> int:
+    """Armed-but-unused overhead guard for end-to-end cancellation: every
+    request carries a far-future deadline, so the scheduler's per-round
+    cancel/expiry sweep scans the pending queue and the slot table each
+    round without ever tripping (the production steady state for
+    deadline-carrying traffic) vs no deadlines at all, where the sweep
+    short-circuits on a single bool (``BENCH_CANCEL=off`` — the
+    compiled-out equivalent)."""
+    return _ab_guard(
+        "cancel", "BENCH_CANCEL", "armed", "on", "off",
+        "BENCH_CANCEL_REPS", "BENCH_CANCEL.json",
+        "cancellation/deadline armed-but-unused overhead: --aggregate "
+        "tok/s with every request carrying a far-future deadline (the "
+        "per-round expiry sweep live, never tripping) vs no deadlines "
+        "(sweep short-circuits on one bool); interleaved ABBA runs, "
+        "best run per arm (contention only slows runs down)")
+
+
 def ragged_bench() -> int:
     """Mixed-batch A/B (BENCH_RAGGED.json): the --aggregate staggered storm
     with ragged mixed-batch rounds ON (prefill chunks piggyback into decode
@@ -1138,6 +1156,12 @@ def aggregate(model_name: str, quant: str) -> int:
             default_doctor.set_scheduler_provider(
                 lambda: [(model_name, sched)])
             default_doctor.ensure_started()
+        #: cancel-guard A/B arms (BENCH_CANCEL.json): "on" submits every
+        #: request with a far-future deadline, so the scheduler's per-round
+        #: expiry sweep runs armed-but-never-tripping (the production state
+        #: for deadline-carrying traffic); "off"/unset submits none and the
+        #: sweep short-circuits on its one-bool fast path
+        cancel_mode = os.environ.get("BENCH_CANCEL", "")
         rng = np.random.default_rng(1)
         n_req, gen = slots, 192
         # BENCH_WARMUP=1 pre-compiles every program variant the storm will
@@ -1194,8 +1218,10 @@ def aggregate(model_name: str, quant: str) -> int:
             reqs[i]["t_submit"] = time.monotonic()
             trace = (f"00-{os.urandom(16).hex()}-{os.urandom(8).hex()}-00"
                      if trace_mode == "unsampled" else None)
+            extras = ({"deadline": time.monotonic() + 3600.0}
+                      if cancel_mode == "on" else {})
             submit_target.submit(prompt, SamplingParams(max_tokens=gen),
-                                 mk_emit(i), trace=trace)
+                                 mk_emit(i), trace=trace, **extras)
             if stagger_s and i < n_req - 1:
                 time.sleep(stagger_s)  # staggered arrivals, not one batch
         ok = done.wait(300)
@@ -1604,6 +1630,8 @@ if __name__ == "__main__":
         sys.exit(lifecycle_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--faultlab-guard":
         sys.exit(faultlab_guard())
+    if len(sys.argv) > 1 and sys.argv[1] == "--cancel-guard":
+        sys.exit(cancel_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--trace-guard":
         sys.exit(trace_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--ragged-bench":

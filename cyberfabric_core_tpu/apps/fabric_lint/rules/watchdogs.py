@@ -1,13 +1,16 @@
-"""WD — doctor evaluator / lifecycle supervisor discipline.
+"""WD — doctor evaluator / lifecycle supervisor / cancellation discipline.
 
 WD01: the fabric-doctor's evaluator and watchdog callbacks (``evaluate*`` /
 ``on_record`` / ``ingest*`` / ``_check_*`` methods of classes named
-``*Doctor*`` / ``*Watchdog*``) and the replica-lifecycle supervision
+``*Doctor*`` / ``*Watchdog*``), the replica-lifecycle supervision
 callbacks (``tick*`` / ``on_terminal`` / ``on_departed`` /
 ``admit_allowed`` / ``note_dispatch`` methods of classes named
-``*Supervisor*`` / ``*Lifecycle*``) must be **non-blocking** and must route
-every emit through a **never-raises helper** — mirroring TL01 for the
-flight recorder and the ``bump_counter`` pattern for metrics.
+``*Supervisor*`` / ``*Lifecycle*``), and the cancellation/expiry callbacks
+(``cancel*`` / ``_cancel*`` / ``_service_cancel*`` / ``_expire*`` methods
+of classes named ``*Engine*`` / ``*ServingPool*``) must be **non-blocking**
+and
+must route every emit through a **never-raises helper** — mirroring TL01
+for the flight recorder and the ``bump_counter`` pattern for metrics.
 
 The evaluation pass runs on a fixed cadence on a dedicated thread and is the
 thing that DECLARES the server unhealthy: if it can block (network, DB,
@@ -27,6 +30,13 @@ blocking call there stalls serving itself, not just health reporting. The
 deliberate exceptions (engine close/build/start in ``_do_rebuild`` /
 ``_do_drain_close``) live OUTSIDE the tick-prefixed decision pass by
 design, and the rule's per-callback scope encodes exactly that split.
+
+The cancellation surface inherits both halves: ``cancel()`` runs on gateway
+event-loop threads (an SSE disconnect must never block the loop on device
+work or a sleep), and the per-round cancel/expiry sweep
+(``_service_cancellations`` / ``_cancel_*``) runs on the scheduler thread
+between rounds — a blocking call there stalls every live stream, and a
+raising emit would turn a dead client's cleanup into an engine crash.
 """
 
 from __future__ import annotations
@@ -52,12 +62,18 @@ _METRIC_FACTORIES = frozenset({"counter", "histogram", "gauge"})
 
 _CALLBACK_PREFIXES = ("evaluate", "_evaluate", "on_record", "ingest",
                       "_check_", "tick", "_tick", "on_terminal",
-                      "on_departed", "admit_allowed", "note_dispatch")
+                      "on_departed", "admit_allowed", "note_dispatch",
+                      "cancel", "_cancel", "_service_cancel", "_expire")
 
 
 def _is_doctor_class(node: ast.ClassDef) -> bool:
+    # Engine/ServingPool joined for the cancellation callbacks: their other
+    # methods legitimately block on device work, but nothing named
+    # cancel*/tick*/evaluate* etc. does — the prefix × marker product
+    # stays exact
     return any(marker in node.name for marker in
-               ("Doctor", "Watchdog", "Supervisor", "Lifecycle"))
+               ("Doctor", "Watchdog", "Supervisor", "Lifecycle",
+                "Engine", "ServingPool"))
 
 
 def _is_callback(fn: ast.AST) -> bool:

@@ -74,10 +74,12 @@ def check_exactly_one_terminal(evidence: dict) -> list[str]:
 def check_streams_match_baseline(evidence: dict) -> list[str]:
     """Surviving streams are bit-identical to the unfaulted baseline run
     (greedy decode: preemption, resume, and failover must not change a
-    single token). Requests listed in ``expect_error`` are exempt."""
+    single token). Requests listed in ``expect_error`` — and deliberately
+    cancelled/lapsed ones (``expect_cancelled`` keys) — are exempt."""
     problems = []
     baseline = evidence["baseline"]
     exempt = set(evidence.get("expect_error", ()))
+    exempt |= set(evidence.get("expect_cancelled", ()) or ())
     for idx, rec in sorted(evidence["streams"].items()):
         if idx in exempt:
             continue
@@ -201,6 +203,28 @@ def check_watchdogs_tripped(evidence: dict) -> list[str]:
     return problems
 
 
+def check_cancelled_terminals(evidence: dict) -> list[str]:
+    """Every deliberately cancelled/lapsed request got exactly its expected
+    terminal (``cancelled`` or ``deadline``) — and, for deadline-in-queue
+    lapses, zero tokens: the request never occupied a slot.
+    ``expect_cancelled`` maps request index → expected terminal reason."""
+    problems = []
+    expected = dict(evidence.get("expect_cancelled") or {})
+    for idx, want in sorted(expected.items()):
+        rec = evidence["streams"].get(idx)
+        if rec is None:
+            problems.append(f"request {idx}: no stream record")
+            continue
+        if rec.terminals != [want]:
+            problems.append(
+                f"request {idx}: terminals {rec.terminals} != [{want!r}]")
+        if want == "deadline" and rec.tokens:
+            problems.append(
+                f"request {idx}: lapsed in the queue but emitted "
+                f"{len(rec.tokens)} tokens (it was admitted)")
+    return problems
+
+
 def check_breaker_recovered(evidence: dict) -> list[str]:
     """The breaker must have OPENED under the injected upstream faults and
     then RECOVERED to closed once the faults stopped."""
@@ -223,6 +247,7 @@ CHECKERS: dict[str, Callable[[dict], list[str]]] = {
     "breaker_recovered": check_breaker_recovered,
     "state_sequence": check_state_sequence,
     "watchdogs_tripped": check_watchdogs_tripped,
+    "cancelled_terminals": check_cancelled_terminals,
 }
 
 

@@ -208,6 +208,179 @@ def _run_engine_scenario(spec: dict) -> ScenarioResult:
                            "tokens_emitted", "broken") if k in stats})
 
 
+# -------------------------------------------------------- cancellation kinds
+
+def _run_cancel_storm_scenario(spec: dict) -> ScenarioResult:
+    """cancel-storm: N concurrent greedy streams, a subset cancelled
+    MID-DECODE (each victim's cancel fires from its own emit callback once
+    it has emitted ``cancel_after_tokens`` — on the scheduler thread, so the
+    application point is deterministic). Survivors must be bit-identical to
+    the uncancelled baseline, every stream gets exactly one terminal
+    (victims: ``cancelled``), and the drained engine holds zero slot /
+    page-ref / orphan leftovers — a cancel storm reclaims capacity without
+    perturbing a single live user."""
+    from ...runtime.engine import SamplingParams
+    from ...runtime.scheduler import ContinuousBatchingEngine
+
+    seed = int(spec.get("seed", 0))
+    cfg = _engine_config(spec)
+    load = _make_load(spec)
+    cancel_idx = set(spec.get("cancel", ()))
+    after_tokens = int(spec.get("cancel_after_tokens", 4))
+    checkers = list(spec.get("invariants", ["exactly_one_terminal"]))
+    evidence: dict[str, Any] = {
+        "expect_error": spec.get("expect_error", []),
+        "expect_cancelled": {i: "cancelled" for i in sorted(cancel_idx)},
+    }
+    if "streams_match_baseline" in checkers:
+        evidence["baseline"] = _baseline_streams(spec, cfg, load)
+    fp.configure(seed)
+    engine = ContinuousBatchingEngine(cfg, seed=0)
+    streams = {i: StreamRecord() for i in range(len(load))}
+    rids = {i: f"cancel-storm-{seed}-{i}" for i in range(len(load))}
+    done = threading.Event()
+    lock = threading.Lock()
+    remaining = [len(load)]
+    triggered: set[int] = set()
+
+    def mk_emit(i):
+        def emit(ev):
+            with lock:
+                was_finished = streams[i].finished
+                record_event(streams[i], ev.token_id, ev.finished)
+                if (i in cancel_idx and i not in triggered
+                        and len(streams[i].tokens) >= after_tokens):
+                    # fired on the scheduler thread inside the emit pass:
+                    # applied at the next round boundary, deterministically
+                    triggered.add(i)
+                    engine.cancel(rids[i], "storm")
+                if ev.finished and not was_finished:
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        done.set()
+        return emit
+
+    for f in spec.get("faults", []):
+        fp.arm(f["point"], f["spec"])
+    try:
+        for i, (prompt, max_tokens) in enumerate(load):
+            engine.submit(prompt, SamplingParams(max_tokens=max_tokens),
+                          mk_emit(i), request_id=rids[i])
+        done.wait(_DRAIN_TIMEOUT_S)
+    finally:
+        for f in spec.get("faults", []):
+            fp.disarm(f["point"])
+    stats = engine.stats()
+    engine.shutdown()
+    evidence["streams"] = streams
+    evidence["engine"] = engine
+    invariants = run_checkers(checkers, evidence)
+    got = stats.get("cancellations", {}).get("storm", 0)
+    invariants["cancel_count"] = (
+        [] if got == len(cancel_idx) else
+        [f"{got} cancels applied, expected {len(cancel_idx)}"])
+    invariants["budget_reclaimed"] = (
+        [] if stats.get("reclaimed_tokens", 0) > 0 else
+        ["no decode budget reclaimed by the storm"])
+    return _finish(spec["name"], "cancel_storm", seed, invariants,
+                   _streams_payload(streams, tokens=True),
+                   stats={"cancellations": stats.get("cancellations"),
+                          "reclaimed_tokens": stats.get("reclaimed_tokens")})
+
+
+def _run_deadline_scenario(spec: dict) -> ScenarioResult:
+    """deadline-under-load: both slots are pinned by long-running streams
+    while an armed ``scheduler.readback`` delay makes every round glacial —
+    then laggards arrive with tiny deadlines. They must lapse IN THE QUEUE
+    (``deadline`` terminal, zero tokens, never admitted to a slot — their
+    flight-recorder timelines show enqueued → deadline_exceeded and nothing
+    else), while the runners finish bit-identically to the unfaulted
+    baseline (the delay changes only latency)."""
+    from ...modkit.flight_recorder import default_recorder
+    from ...runtime.engine import SamplingParams
+    from ...runtime.scheduler import ContinuousBatchingEngine
+
+    seed = int(spec.get("seed", 0))
+    cfg = _engine_config(spec)
+    load = _make_load(spec)  # the runners
+    n_lag = int(spec.get("laggards", 4))
+    deadline_s = float(spec.get("deadline_ms", 150)) / 1000.0
+    checkers = list(spec.get("invariants", ["exactly_one_terminal"]))
+    lag_base = len(load)
+    evidence: dict[str, Any] = {
+        "expect_error": spec.get("expect_error", []),
+        "expect_cancelled": {lag_base + j: "deadline" for j in range(n_lag)},
+    }
+    if "streams_match_baseline" in checkers:
+        evidence["baseline"] = _baseline_streams(spec, cfg, load)
+    fp.configure(seed)
+    default_recorder.reset()  # leftover records would pollute the timelines
+    engine = ContinuousBatchingEngine(cfg, seed=0)
+    n_total = len(load) + n_lag
+    streams = {i: StreamRecord() for i in range(n_total)}
+    done = threading.Event()
+    lock = threading.Lock()
+    remaining = [n_total]
+
+    def mk_emit(i):
+        def emit(ev):
+            with lock:
+                was_finished = streams[i].finished
+                record_event(streams[i], ev.token_id, ev.finished)
+                if ev.finished and not was_finished:
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        done.set()
+        return emit
+
+    lag_rng = random.Random(seed ^ 0xDEAD)
+    lag_rids = []
+    faults = list(spec.get("faults", []))
+    for f in faults:
+        fp.arm(f["point"], f["spec"])
+    try:
+        for i, (prompt, max_tokens) in enumerate(load):
+            engine.submit(prompt, SamplingParams(max_tokens=max_tokens),
+                          mk_emit(i))
+        # wait until every slot is occupied: the laggards must pile up
+        # BEHIND the armed rounds, not find a free slot
+        deadline_poll = time.monotonic() + 30.0
+        while engine.active_slots + len(engine._prefill_slots) \
+                < cfg.max_batch and time.monotonic() < deadline_poll:
+            time.sleep(0.01)  # fabric-lint: waive AS01 reason=scenario driver thread waiting for slot occupancy; no event loop in this process path
+        for j in range(n_lag):
+            rid = f"deadline-{seed}-{j}"
+            lag_rids.append(rid)
+            prompt = [lag_rng.randrange(3, 250) for _ in range(6)]
+            engine.submit(prompt, SamplingParams(max_tokens=10),
+                          mk_emit(lag_base + j), request_id=rid,
+                          deadline=time.monotonic() + deadline_s)
+        done.wait(_DRAIN_TIMEOUT_S)
+    finally:
+        for f in faults:
+            fp.disarm(f["point"])
+    stats = engine.stats()
+    engine.shutdown()
+    evidence["streams"] = streams
+    evidence["engine"] = engine
+    invariants = run_checkers(checkers, evidence)
+    lapse_count = stats.get("cancellations", {}).get("deadline", 0)
+    invariants["all_laggards_lapsed"] = (
+        [] if lapse_count == n_lag else
+        [f"{lapse_count} deadline lapses, expected {n_lag}"])
+    timeline_problems = []
+    for rid in lag_rids:
+        rec = default_recorder.lookup(rid)
+        kinds = [e["event"] for e in (rec or {}).get("timeline", ())]
+        if kinds != ["enqueued", "deadline_exceeded"]:
+            timeline_problems.append(f"{rid}: timeline {kinds}")
+    invariants["laggards_never_admitted"] = timeline_problems
+    return _finish(spec["name"], "deadline", seed, invariants,
+                   _streams_payload(streams, tokens=True),
+                   stats={"cancellations": stats.get("cancellations"),
+                          "reclaimed_tokens": stats.get("reclaimed_tokens")})
+
+
 # ----------------------------------------------------------------- pool kind
 
 def _drive_pool(cfg, load, faults: list[dict], n_replicas: int = 2,
@@ -1390,6 +1563,8 @@ def _run_grpc_evict_scenario(spec: dict) -> ScenarioResult:
 
 _KINDS = {
     "engine": _run_engine_scenario,
+    "cancel_storm": _run_cancel_storm_scenario,
+    "deadline": _run_deadline_scenario,
     "pool": _run_pool_scenario,
     "replica_crash_loop": _run_replica_crash_loop_scenario,
     "replica_drain": _run_replica_drain_scenario,

@@ -177,6 +177,42 @@ BUILTIN_SCENARIOS: list[dict[str, Any]] = [
         "expect_stats": {"preemptions": [1, None]},
         "deterministic_tokens": False,
     },
+    # ---- end-to-end cancellation & deadlines --------------------------
+    {
+        # cancel 8 of 16 mid-decode streams (each victim's cancel fires from
+        # its own emit callback after 4 tokens — scheduler-thread
+        # deterministic): survivors bit-identical to the uncancelled
+        # baseline, exactly one terminal per stream (victims: 'cancelled'),
+        # zero slot/page-ref/orphan leaks, and real decode budget reclaimed
+        "name": "cancel-storm",
+        "kind": "cancel_storm",
+        "seed": 110,
+        "engine": {**_TINY, "max_batch": 16, "prefix_cache_pages": 80},
+        "load": {"requests": 16, "prompt_len": [4, 10], "max_tokens": 24},
+        "cancel": [1, 3, 5, 7, 9, 11, 13, 15],
+        "cancel_after_tokens": 4,
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "engine_accounting",
+                       "cancelled_terminals"],
+    },
+    {
+        # both slots pinned by long streams behind an armed readback delay;
+        # laggards with 150 ms deadlines pile up in the queue and must LAPSE
+        # there — 'deadline' terminal, zero tokens, timeline shows
+        # enqueued → deadline_exceeded with no 'admitted' in between —
+        # while the runners finish bit-identically to the unfaulted baseline
+        "name": "deadline-under-load",
+        "kind": "deadline",
+        "seed": 111,
+        "engine": _TINY,
+        "load": {"requests": 2, "prompt_len": [4, 10], "max_tokens": 24},
+        "laggards": 4,
+        "deadline_ms": 150,
+        "faults": [{"point": "scheduler.readback", "spec": "delay(0.15)"}],
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "engine_accounting",
+                       "cancelled_terminals"],
+    },
     # ---- runtime / replica pool ---------------------------------------
     {
         "name": "replica-failover",

@@ -411,13 +411,24 @@ class Doctor:
         request into the objective sample windows. Called outside the
         recorder's lock; must never raise (the recorder wraps it anyway)."""
         kind = payload.get("kind")
-        if kind not in ("finished", "error"):
+        if kind not in ("finished", "error", "cancelled",
+                        "deadline_exceeded"):
             return  # evictions are a recorder-bound artifact, not a signal
         now = time.time()
         model = payload.get("model")
         derived = payload.get("derived") or {}
+        cancelled = kind in ("cancelled", "deadline_exceeded")
         with self._lock:
             maxlen = self.config.max_samples
+            # cancellations are EXCLUDED from the error-rate burn entirely
+            # (numerator and denominator): a disconnect storm is client
+            # behavior, not an SLO violation — it must neither trip the
+            # error objective nor dilute a real error burn. They feed their
+            # own rate signal instead (llm_cancellation_rate + report doc).
+            cw = self._windows.setdefault("cancel", _SampleWindow(maxlen))
+            cw.add(now, 1.0 if cancelled else 0.0, model)
+            if cancelled:
+                return
             err = self._windows.setdefault("error", _SampleWindow(maxlen))
             err.add(now, 1.0 if kind == "error" else 0.0, model)
             if kind == "finished":
@@ -448,6 +459,19 @@ class Doctor:
                 table.append(row)
                 if row["verdict"] == "critical":
                     reasons.append(f"slo:{obj.name}")
+            # cancellation-rate signal (observability, never a degradation
+            # reason: cancels are client decisions — 0.5 splits the 0/1
+            # samples into cancelled vs served)
+            cancel_doc = None
+            cw = self._windows.get("cancel")
+            if cw is not None:
+                c_total, c_bad = cw.stats(now, cfg.fast_window_s, 0.5, None)
+                if c_total:
+                    cancel_doc = {
+                        "rate_fast": round(c_bad / c_total, 3),
+                        "cancelled_fast": c_bad,
+                        "terminals_fast": c_total,
+                    }
         trips = self._check_watchdogs(now)
         # dedupe: several schedulers tripping the same watchdog is one
         # reason on /readyz (per-scheduler detail lives in the log lines)
@@ -490,9 +514,15 @@ class Doctor:
                 "objectives": table,
                 "watchdog_trips": dict(self._watchdog_trips),
                 "capacity": capacity_doc,
+                "cancellation": cancel_doc,
                 "evals": self._evals,
             }
             self._last_report = report
+        if cancel_doc is not None:
+            _gauge_set("llm_cancellation_rate",
+                       "Fraction of recent terminals that were "
+                       "cancelled/deadline-lapsed (fast window)",
+                       cancel_doc["rate_fast"])
         for row in table:
             _gauge_set("slo_burn_rate",
                        "SLO error-budget burn rate per objective and window",

@@ -60,6 +60,12 @@ _PHASE_AFTER = {
     "finished": "finished",
     "error": "error",
     "evicted": "evicted",
+    #: end-to-end cancellation terminals: a client vanished (or an operator
+    #: cancelled) vs a request deadline lapsing — kept distinct from
+    #: ``error`` so dashboards and the doctor's error-rate burn never read
+    #: a disconnect storm as a server fault
+    "cancelled": "cancelled",
+    "deadline_exceeded": "deadline_exceeded",
     #: a watchdog marked the stream stalled (doctor); the next progress
     #: event (decode_chunk/resumed/…) clears the phase back
     "stalled": "stalled",
@@ -80,9 +86,13 @@ _PROGRESS = frozenset({"admitted", "prefill", "prefill_chunk", "first_token",
 
 #: drain_end / replica_rebuilt close their episode records like request
 #: terminals do (only ``finished`` feeds the latency histograms, and the
-#: doctor's listener ignores kinds it does not ingest)
+#: doctor's listener ignores kinds it does not ingest). ``cancelled`` /
+#: ``deadline_exceeded`` are request terminals too — they close the record
+#: but stay out of the latency histograms (a half-served stream would skew
+#: the percentiles exactly when cancel storms make dashboards matter).
 _TERMINAL = frozenset({"finished", "error", "evicted",
-                       "drain_end", "replica_rebuilt"})
+                       "drain_end", "replica_rebuilt",
+                       "cancelled", "deadline_exceeded"})
 
 
 class RequestRecord:

@@ -179,12 +179,15 @@ class MetricsRegistry:
 default_registry = MetricsRegistry()
 
 
-def bump_counter(name: str, help: str = "", **labels: str) -> None:
+def bump_counter(name: str, help: str = "", *, n: float = 1.0,
+                 **labels: str) -> None:
     """Fire-and-forget counter increment on the default registry: never
     raises (telemetry must not fail a serving/recovery path). Declare the
     metric's help text ONCE at pre-registration (monitoring module) — the
-    registry keeps the first help it sees, so hot-path callers pass none."""
+    registry keeps the first help it sees, so hot-path callers pass none.
+    ``n`` (keyword-only so it can never be mistaken for a label) bumps by
+    more than one — e.g. reclaimed-token counts."""
     try:
-        default_registry.counter(name, help).inc(**labels)
+        default_registry.counter(name, help).inc(n, **labels)
     except Exception:  # noqa: BLE001
         pass

@@ -253,6 +253,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         self.batches: dict[str, dict] = {}
         self.ttft_timeout_s = 120.0
         self.total_timeout_s = 600.0
+        self.default_deadline_ms = 0.0
         self._video_poll_interval_s = 2.0
         self._video_poll_timeout_s = 120.0
         self._external = None
@@ -283,6 +284,13 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         self.usage = UsageTracker(cfg.get("budgets"))
         self.ttft_timeout_s = float(cfg.get("ttft_timeout_s", 120.0))
         self.total_timeout_s = float(cfg.get("total_timeout_s", 600.0))
+        #: default per-request TTL (ms) threaded into the scheduler as a
+        #: deadline when the client sends no X-Request-Deadline-Ms header;
+        #: 0 disables. Unlike ttft/total timeouts (gateway-side waits), the
+        #: deadline propagates END-TO-END: a lapsed request is lapsed in the
+        #: scheduler itself — removed from the queue pre-admit or
+        #: deactivated mid-decode — not just abandoned at the HTTP layer.
+        self.default_deadline_ms = float(cfg.get("default_deadline_ms", 0.0))
         self._video_poll_interval_s = float(cfg.get("video_poll_interval_s", 2.0))
         self._video_poll_timeout_s = float(cfg.get("video_poll_timeout_s", 120.0))
         #: worker-plane exposure policy (review finding: an inference plane
@@ -491,24 +499,31 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         deadline = asyncio.get_event_loop().time() + self.total_timeout_s
         t_start = asyncio.get_event_loop().time()
         first = True
-        while True:
-            timeout = self.ttft_timeout_s if first else max(
-                0.05, deadline - asyncio.get_event_loop().time())
-            try:
-                chunk = await asyncio.wait_for(agen.__anext__(), timeout)
-            except StopAsyncIteration:
-                return
-            except asyncio.TimeoutError:
-                await agen.aclose()
-                raise (ERR.llm.ttft_timeout if first
-                       else ERR.llm.total_timeout).error(
-                    f"model {model.canonical_id} "
-                    f"{'TTFT' if first else 'total'} timeout")
-            if first:
-                self._observe_ttft(
-                    model, body, asyncio.get_event_loop().time() - t_start)
-            first = False
-            yield chunk
+        try:
+            while True:
+                timeout = self.ttft_timeout_s if first else max(
+                    0.05, deadline - asyncio.get_event_loop().time())
+                try:
+                    chunk = await asyncio.wait_for(agen.__anext__(), timeout)
+                except StopAsyncIteration:
+                    return
+                except asyncio.TimeoutError:
+                    raise (ERR.llm.ttft_timeout if first
+                           else ERR.llm.total_timeout).error(
+                        f"model {model.canonical_id} "
+                        f"{'TTFT' if first else 'total'} timeout")
+                if first:
+                    self._observe_ttft(
+                        model, body, asyncio.get_event_loop().time() - t_start)
+                first = False
+                yield chunk
+        finally:
+            # deterministic teardown on EVERY exit — timeout, client
+            # disconnect closing this generator (GeneratorExit), handler
+            # cancellation: the worker generator's own finally cancels the
+            # engine-side work, so a dead consumer stops burning decode
+            # rounds instead of waiting for GC to reap the chain
+            await agen.aclose()
 
     @staticmethod
     def _observe_ttft(model: ModelInfo, body: dict, wall_s: float) -> None:
@@ -588,6 +603,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             body["_resolved_tools"] = await normalize_tools(
                 ctx, body["tools"], self._hub.try_get(TypesRegistryApi))
         self._inject_observability(request, body)
+        self._inject_deadline(request, body)
         models = await self._resolve_with_fallback(ctx, body)
 
         if body.get("async"):
@@ -619,6 +635,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
                 body = verdict["body"]
                 validate_against(schemas.COMPLETION_REQUEST, body)
         self._inject_observability(request, body)
+        self._inject_deadline(request, body)
         models = await self._resolve_with_fallback(ctx, body)
         if body.get("stream"):
             return await self._stream_response(request, ctx, body, models,
@@ -642,6 +659,28 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             body["_traceparent"] = span.traceparent()
         elif request.headers.get("traceparent"):
             body["_traceparent"] = request.headers["traceparent"]
+
+    def _inject_deadline(self, request: web.Request, body: dict) -> None:
+        """Per-request deadline: the ``X-Request-Deadline-Ms`` header (the
+        client's total budget for this request, in milliseconds) takes
+        precedence over the config default TTL (``default_deadline_ms``;
+        0 disables). The relative budget rides to the worker as
+        ``_deadline_ms`` and becomes an absolute monotonic deadline at
+        scheduler submit — from there the per-round expiry sweep owns it in
+        every phase (queued, prefilling, decoding, suspended)."""
+        hdr = request.headers.get("X-Request-Deadline-Ms")
+        if hdr is not None:
+            try:
+                ms = float(hdr)
+            except ValueError:
+                ms = float("nan")
+            if not ms > 0 or ms != ms or ms == float("inf"):
+                raise ProblemError.bad_request(
+                    "X-Request-Deadline-Ms must be a positive, finite "
+                    "number of milliseconds")
+            body["_deadline_ms"] = ms
+        elif self.default_deadline_ms > 0:
+            body["_deadline_ms"] = self.default_deadline_ms
 
     async def _sync_response(self, ctx: SecurityContext, body: dict,
                              models: list[tuple[bool, ModelInfo]],
@@ -693,6 +732,10 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
                 return resp
             except ProblemError as e:
                 last_err = e
+                if e.problem.code in ("request_timeout", "deadline_exceeded"):
+                    # the CLOCK failed, not the model: a fallback attempt
+                    # inherits the same lapsed budget and can only lapse too
+                    break
                 continue
         assert last_err is not None
         raise last_err
@@ -715,6 +758,8 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
                 continue
             except ProblemError as e:
                 last_err = e
+                if e.problem.code in ("request_timeout", "deadline_exceeded"):
+                    break  # a lapsed deadline lapses on every fallback too
                 continue  # fallback BEFORE the stream starts; after TTFT we're committed
             headers = {
                 "Content-Type": "text/event-stream",
@@ -759,13 +804,29 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
                 await send(payload)
 
             try:
-                await emit(first_chunk)
-                async for chunk in agen:
-                    await emit(chunk)
-            except ProblemError as e:
-                # mid-stream failure: emit a terminal error event (can't re-status)
-                await resp.write(format_sse_json(
-                    {"error": e.problem.to_dict()}, event="error"))
+                try:
+                    await emit(first_chunk)
+                    async for chunk in agen:
+                        await emit(chunk)
+                except ProblemError as e:
+                    # mid-stream failure: emit a terminal error event (can't re-status)
+                    await resp.write(format_sse_json(
+                        {"error": e.problem.to_dict()}, event="error"))
+                except (ConnectionResetError, asyncio.CancelledError):
+                    # the SSE consumer is gone (socket reset, or aiohttp
+                    # cancelled the handler on disconnect): the finally's
+                    # aclose propagates into the worker generator, whose
+                    # teardown cancels the engine-side work — the 499-style
+                    # disconnect-abort path. Re-raise: there is nobody left
+                    # to write [DONE] to.
+                    from ...modkit.metrics import bump_counter
+
+                    bump_counter("llm_client_disconnects_total")
+                    raise
+            finally:
+                # deterministic even on the non-exception paths — aclose is
+                # idempotent and the generator is normally already exhausted
+                await agen.aclose()
             await resp.write(SSE_DONE)
             await resp.write_eof()
             return resp
@@ -1024,6 +1085,11 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
                 validate_against(schemas.REQUEST, body)
                 self._check_load_shed()
                 self.usage.check_budget(ctx)
+                # WS frames carry no per-request header; the config default
+                # TTL still bounds each chat.create end-to-end (a vanished
+                # WS peer's frame cannot decode to max_tokens forever)
+                if self.default_deadline_ms > 0:
+                    body.setdefault("_deadline_ms", self.default_deadline_ms)
                 models = await self._resolve_with_fallback(ctx, body)
                 _, model = models[0]
                 reply_parts: list[str] = []

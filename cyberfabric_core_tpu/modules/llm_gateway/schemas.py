@@ -159,7 +159,9 @@ RESPONSE = _schema("core", "response", {
         "fallback_used": {"type": "boolean"},
         "model_used": {"type": "string"},
         "finish_reason": {"type": "string",
-                          "enum": ["stop", "length", "tool_calls", "content_filter"]},
+                          "enum": ["stop", "length", "tool_calls",
+                                   "content_filter", "deadline_exceeded",
+                                   "cancelled"]},
     },
 })
 
@@ -187,7 +189,9 @@ STREAM_CHUNK = _schema("core", "stream_chunk", {
             },
         },
         "finish_reason": {"type": ["string", "null"],
-                          "enum": ["stop", "length", "tool_calls", "content_filter", None]},
+                          "enum": ["stop", "length", "tool_calls",
+                                   "content_filter", "deadline_exceeded",
+                                   "cancelled", None]},
         "usage": USAGE,   # final chunk only
     },
 })

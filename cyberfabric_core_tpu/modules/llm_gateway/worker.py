@@ -434,11 +434,19 @@ class LocalTpuWorker(LlmWorkerApi):
             messages = [preamble] + list(messages)
         prompt = render_chat(messages, entry.model_family)
         # the rendered template carries bos/specials literally — encoding must
-        # not let a tokenizer post-processor add a second bos
-        async for chunk in self._generate_from_ids(
-                entry, model,
-                entry.tokenizer.encode(prompt, add_specials=False), params):
-            yield chunk
+        # not let a tokenizer post-processor add a second bos.
+        # The explicit aclose matters: closing THIS generator (client
+        # disconnect) raises GeneratorExit at the yield, which does NOT
+        # auto-close the inner generator — without the finally its
+        # cancel-on-teardown would wait for GC while the slot keeps decoding
+        agen = self._generate_from_ids(
+            entry, model,
+            entry.tokenizer.encode(prompt, add_specials=False), params)
+        try:
+            async for chunk in agen:
+                yield chunk
+        finally:
+            await agen.aclose()
 
     async def completion_stream(
         self, model: ModelInfo, prompt: str, params: dict
@@ -446,9 +454,14 @@ class LocalTpuWorker(LlmWorkerApi):
         """Raw text completion (POST /v1/completions, the BASELINE metric
         surface): the prompt is tokenized verbatim — no chat template."""
         entry = await self._entry_for(model)
-        async for chunk in self._generate_from_ids(
-                entry, model, entry.tokenizer.encode(prompt), params):
-            yield chunk
+        agen = self._generate_from_ids(
+            entry, model, entry.tokenizer.encode(prompt), params)
+        try:
+            async for chunk in agen:
+                yield chunk
+        finally:
+            # deterministic teardown: see chat_stream
+            await agen.aclose()
 
     async def _generate_from_ids(
         self, entry: _EngineEntry, model: ModelInfo, prompt_ids: list[int],
@@ -494,6 +507,17 @@ class LocalTpuWorker(LlmWorkerApi):
             queue=queue,
             stop_strings=tuple(params.get("stop", ()) or ()),
         )
+        # per-request deadline (X-Request-Deadline-Ms header / gateway
+        # default TTL, relative ms at gateway entry) → absolute monotonic
+        # instant at submit; the scheduler's expiry sweep owns it from here
+        deadline: Optional[float] = None
+        raw_deadline = params.get("_deadline_ms")
+        if raw_deadline:
+            try:
+                deadline = time.monotonic() + float(raw_deadline) / 1000.0
+            except (TypeError, ValueError):
+                deadline = None
+        cancel_target = None
         if entry.pool is not None or entry.scheduler is not None:
             loop = asyncio.get_running_loop()
             if entry.pool is None and not entry.scheduler.servable() \
@@ -511,6 +535,7 @@ class LocalTpuWorker(LlmWorkerApi):
                     raise ERR.llm.replica_unavailable.error(
                         str(e), retry_after_s=e.retry_after_s)
             target = entry.pool if entry.pool is not None else entry.scheduler
+            cancel_target = target
             try:
                 target.submit(
                     prompt_ids, sampling,
@@ -518,6 +543,7 @@ class LocalTpuWorker(LlmWorkerApi):
                         queue.put_nowait, ev),
                     request_id=request_id,
                     trace=trace,
+                    deadline=deadline,
                 )
             except SchedulerSaturated as e:
                 # admission backpressure: the pending queue is at
@@ -555,61 +581,141 @@ class LocalTpuWorker(LlmWorkerApi):
         sent_text = ""
         stop_hit = False
         n_tokens = 0
+        #: flips once the engine-side stream reached ANY terminal — the
+        #: finally below cancels engine work only for true abandonment
+        #: (generator dropped mid-stream: client disconnect, gateway
+        #: timeout aclose, half-consumed stream)
+        stream_done = False
         max_stop_len = max((len(s) for s in req.stop_strings), default=0)
-        while True:
-            item = await queue.get()
-            if item is _STREAM_END:
-                break
-            if isinstance(item, Exception):
-                raise ProblemError.internal(f"generation failed: {item}")
-            ev: StepEvent = item
-            if ev.finished == "error":
-                raise ProblemError.internal("generation failed in scheduler")
-            if ev.token_id >= 0:
-                n_tokens += 1
-                if ev.finished != "stop":
-                    tail_ids.append(ev.token_id)
-            tail_text = entry.tokenizer.decode(tail_ids)
-            if tail_text and not tail_text.endswith("�") and len(tail_ids) >= 8:
-                stable_text += tail_text
-                tail_ids = []
-                tail_text = ""
-            full_text = stable_text + tail_text
-            delta = full_text[len(sent_text):]
-            # stop-string scan over the recent window only
-            if req.stop_strings and not stop_hit:
-                window_start = max(0, len(sent_text) - max_stop_len)
-                window = full_text[window_start:]
-                hit_rel = min((window.find(s) for s in req.stop_strings
-                               if window.find(s) >= 0), default=-1)
-                if hit_rel >= 0:
-                    delta = full_text[len(sent_text):window_start + hit_rel]
-                    stop_hit = True
-            if delta:
-                sent_text += delta
-                yield ChatStreamChunk(request_id=request_id, text=delta,
-                                      token_id=ev.token_id)
-            if ev.finished or stop_hit:
-                self._requests_served += 1
-                self._tokens_out += n_tokens
-                if entry.supervisor is not None and (
-                        stop_hit or ev.finished in ("stop", "length")):
-                    # the single-engine probation pass: a clean stream off
-                    # the (possibly rebuilt) scheduler clears its strikes
-                    entry.supervisor.note_ok()
-                usage = {"input_tokens": len(prompt_ids), "output_tokens": n_tokens}
-                reason = "stop" if (stop_hit or ev.finished == "stop") else (ev.finished or "stop")
-                yield ChatStreamChunk(request_id=request_id, finish_reason=reason,
-                                      usage=usage)
-                if stop_hit and not ev.finished:
-                    # drain remaining events of this request without emitting
-                    while True:
-                        tail = await queue.get()
-                        if tail is _STREAM_END or (
-                            isinstance(tail, StepEvent) and tail.finished
-                        ):
-                            break
-                return
+        try:
+            while True:
+                item = await queue.get()
+                if item is _STREAM_END:
+                    stream_done = True
+                    break
+                if isinstance(item, Exception):
+                    stream_done = True
+                    raise ProblemError.internal(f"generation failed: {item}")
+                ev: StepEvent = item
+                if ev.finished == "error":
+                    stream_done = True
+                    raise ProblemError.internal("generation failed in scheduler")
+                if ev.finished == "cancelled":
+                    # cancelled server-side while this consumer is still
+                    # attached (pool-level cancel racing a break, an operator
+                    # cancel): surface the 499-style problem — this consumer's
+                    # own teardown never reads the event (its queue is orphaned)
+                    stream_done = True
+                    raise ERR.llm.client_closed_request.error(
+                        "request was cancelled")
+                if ev.finished == "deadline":
+                    stream_done = True
+                    if entry.supervisor is not None and n_tokens > 0:
+                        # probation credit only when the engine actually
+                        # produced output — a zero-token queued lapse is
+                        # evidence of a slow/stuck engine, not health, and
+                        # must not clear a rebuilt scheduler's strikes
+                        entry.supervisor.note_ok()
+                    if n_tokens == 0:
+                        # no output ever reached the client. 408 vs 504 by
+                        # PHASE (the expiry sweep stamps it on the terminal
+                        # event): lapsed while still QUEUED → the request
+                        # never started (408 Request Timeout, never
+                        # admitted); lapsed after admission (prefilling /
+                        # decoding / suspended) → the server ran out of
+                        # time serving it (504 Gateway Timeout)
+                        phase = None
+                        try:
+                            rec = default_recorder.lookup(request_id) or {}
+                            phase = (rec.get("timeline") or [{}])[-1].get(
+                                "phase")
+                        except Exception:  # noqa: BLE001 — mapping hint only
+                            pass
+                        if phase == "queued":
+                            raise ERR.llm.request_timeout.error(
+                                "request deadline lapsed before admission "
+                                "(X-Request-Deadline-Ms / gateway default "
+                                "TTL); it never occupied a slot")
+                        raise ERR.llm.deadline_exceeded.error(
+                            "request deadline lapsed before any output "
+                            "(X-Request-Deadline-Ms / gateway default TTL)")
+                    # mid-stream lapse: the SSE stream is already flowing (no
+                    # re-status possible) — close it with the
+                    # deadline_exceeded finish reason and honest usage
+                    self._requests_served += 1
+                    self._tokens_out += n_tokens
+                    usage = {"input_tokens": len(prompt_ids),
+                             "output_tokens": n_tokens}
+                    yield ChatStreamChunk(request_id=request_id,
+                                          finish_reason="deadline_exceeded",
+                                          usage=usage)
+                    return
+                if ev.token_id >= 0:
+                    n_tokens += 1
+                    if ev.finished != "stop":
+                        tail_ids.append(ev.token_id)
+                tail_text = entry.tokenizer.decode(tail_ids)
+                if tail_text and not tail_text.endswith("�") and len(tail_ids) >= 8:
+                    stable_text += tail_text
+                    tail_ids = []
+                    tail_text = ""
+                full_text = stable_text + tail_text
+                delta = full_text[len(sent_text):]
+                # stop-string scan over the recent window only
+                if req.stop_strings and not stop_hit:
+                    window_start = max(0, len(sent_text) - max_stop_len)
+                    window = full_text[window_start:]
+                    hit_rel = min((window.find(s) for s in req.stop_strings
+                                   if window.find(s) >= 0), default=-1)
+                    if hit_rel >= 0:
+                        delta = full_text[len(sent_text):window_start + hit_rel]
+                        stop_hit = True
+                if delta:
+                    sent_text += delta
+                    yield ChatStreamChunk(request_id=request_id, text=delta,
+                                          token_id=ev.token_id)
+                if ev.finished or stop_hit:
+                    stream_done = True
+                    self._requests_served += 1
+                    self._tokens_out += n_tokens
+                    if entry.supervisor is not None and (
+                            stop_hit or ev.finished in ("stop", "length")):
+                        # the single-engine probation pass: a clean stream off
+                        # the (possibly rebuilt) scheduler clears its strikes
+                        entry.supervisor.note_ok()
+                    usage = {"input_tokens": len(prompt_ids), "output_tokens": n_tokens}
+                    reason = "stop" if (stop_hit or ev.finished == "stop") else (ev.finished or "stop")
+                    yield ChatStreamChunk(request_id=request_id, finish_reason=reason,
+                                          usage=usage)
+                    if stop_hit and not ev.finished:
+                        # drain remaining events of this request without emitting
+                        while True:
+                            tail = await queue.get()
+                            if tail is _STREAM_END or (
+                                isinstance(tail, StepEvent) and tail.finished
+                            ):
+                                break
+                    return
+        finally:
+            if not stream_done and cancel_target is not None:
+                # HTTP-layer abandonment: the generator was dropped before
+                # the engine reached a terminal (client disconnect closing
+                # the SSE stream, the gateway's ttft/total-timeout aclose, a
+                # half-consumed stream) — cancel the engine-side work NOW so
+                # the slot, KV pages, and prefix pins free within one round
+                # instead of decoding to max_tokens for a dead consumer.
+                # The orphaned queue (and its late events) just drops.
+                # the reason covers all abandonment flavors (socket
+                # disconnects AND gateway ttft/total-timeout acloses — both
+                # are "the consumer gave up"); llm_client_disconnects_total
+                # counts true socket-level disconnects and is bumped once,
+                # at the gateway's SSE writer, never here
+                try:
+                    cancel_target.cancel(request_id,
+                                         reason="client_disconnect")
+                except Exception:  # noqa: BLE001 — teardown must not raise
+                    logger.exception("cancel-on-teardown failed for %s",
+                                     request_id)
 
     # ------------------------------------------------------------------ embeddings
     async def embed(self, model: ModelInfo, inputs: list[str],

@@ -147,6 +147,27 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
             "llm_cache_aware_placements_total",
             "Requests routed by the prefix-cache affinity hint").inc(0.0)
 
+        # end-to-end cancellation: terminals by reason, the decode budget
+        # reclaimed from dead clients, and the doctor's cancellation-rate
+        # gauge — pre-registered so dashboards can alert from first scrape
+        self.registry.counter(
+            "llm_cancellations_total",
+            "Requests cancelled end-to-end, by reason "
+            "(client_disconnect/deadline/…)").inc(0.0)
+        self.registry.counter(
+            "llm_cancel_reclaimed_tokens_total",
+            "max_tokens budget NOT generated thanks to cancellation "
+            "(reclaimed decode capacity)").inc(0.0)
+        self.registry.counter(
+            "llm_client_disconnects_total",
+            "SSE consumers that vanished mid-response (socket-level "
+            "disconnects at the gateway writer; gateway-timeout aborts "
+            "count only under llm_cancellations_total)").inc(0.0)
+        self.registry.gauge(
+            "llm_cancellation_rate",
+            "Fraction of recent terminals that were cancelled/deadline-"
+            "lapsed (fast window)").set(0.0)
+
         # replica lifecycle (self-healing pools): rebuild outcomes and the
         # healthy/benched census — pre-registered so dashboards can alert
         # from the first scrape; values are pushed by the lifecycle manager

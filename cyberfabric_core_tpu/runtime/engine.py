@@ -271,7 +271,11 @@ class StepEvent:
 
     request_index: int
     token_id: int
-    finished: Optional[str] = None  # stop|length when this is the final token
+    #: terminal reason when this is the final event: stop | length (clean
+    #: finishes), error (engine fault — the replica pool fails it over),
+    #: cancelled (client/gateway let go), deadline (the request's
+    #: deadline lapsed — scheduler-side expiry sweep)
+    finished: Optional[str] = None
 
 
 class InferenceEngine:

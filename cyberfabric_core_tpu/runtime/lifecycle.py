@@ -294,7 +294,12 @@ class ReplicaLifecycleManager:
     def on_terminal(self, idx: int, ok: bool) -> None:
         """A request served by replica ``idx`` reached its client terminal.
         Probation canaries count toward promotion; a canary error
-        re-quarantines immediately (no need to wait for the tick)."""
+        re-quarantines immediately (no need to wait for the tick).
+        ``cancelled``/``deadline`` terminals arrive with ``ok=True`` (the
+        pool maps only ``error`` to False): a cancel is a client decision,
+        not a replica fault — a disconnect storm must not strike a healthy
+        canary, and a drain counts cancels as completions (the cancelled
+        slot frees, so the drain's idle probe sees the replica empty)."""
         with self._lock:
             rec = self._recs[idx]
             if rec.state != "probation":
@@ -497,7 +502,10 @@ class ReplicaLifecycleManager:
               deadline_s: Optional[float] = None) -> dict[str, Any]:
         """Remove replica ``idx`` from routing and let in-flight requests
         finish; past ``deadline_s`` the supervisor closes the engine and the
-        stragglers fail over. Allowed from healthy/probation."""
+        stragglers fail over. Allowed from healthy/probation. Cancelled and
+        deadline-lapsed requests count as completions here: each one frees
+        its slot, so the drain's idle probe (and the clean-drain outcome)
+        treats them exactly like finished streams."""
         self._check_idx(idx)
         deadline = (self.config.drain_deadline_s
                     if deadline_s is None else max(0.0, float(deadline_s)))

@@ -382,6 +382,12 @@ class PrefixKVPool:
         return chain
 
     def release_slot(self, chain: list[int]) -> None:
+        """Drop a slot's chain references — the ONE release path shared by
+        clean finishes, preemption, failover teardown, and the cancellation
+        sweep: tree-shared prefix pages stay cached for other requests,
+        private decode pages return to the allocator, and orphans (evicted
+        mid-flight but slot-ref'd) free here. A cancel therefore needs no
+        special pool handling to be leak-free."""
         self.unref_pages(chain)
 
     # ------------------------------------------------------------ preemption

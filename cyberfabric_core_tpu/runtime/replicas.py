@@ -55,6 +55,14 @@ class _Tracked:
     retries_left: int
     done: bool = False
     trace: Optional[str] = None  # W3C traceparent, carried across failover
+    #: set by cancel(): a cancelled request must NEVER be resubmitted by the
+    #: failover wrapper — the client is gone; an error terminal arriving
+    #: after the mark is surfaced as ``cancelled`` instead of retried
+    cancelled: bool = False
+    #: absolute monotonic deadline, carried across failover so a
+    #: resubmission inherits the original budget (and is skipped entirely
+    #: when the budget is already gone)
+    deadline: Optional[float] = None
 
 
 class DataParallelServingPool:
@@ -217,13 +225,14 @@ class DataParallelServingPool:
         emit: Callable[[StepEvent], None],
         request_id: Optional[str] = None,
         trace: Optional[str] = None,
+        deadline: Optional[float] = None,
     ) -> str:
         # armed raise rejects the request before any replica sees it (the
         # faultlab pool scenario asserts no tracking record leaks)
         failpoint("replicas.submit")
         idx = self._pick(prompt_ids)
         tracked = _Tracked(list(prompt_ids), sampling, emit, [], idx,
-                           self.max_retries, trace=trace)
+                           self.max_retries, trace=trace, deadline=deadline)
         rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
         # register BEFORE submitting: the scheduler thread may finish the
         # request (and fire the tracking-record cleanup) before this thread
@@ -234,13 +243,41 @@ class DataParallelServingPool:
         try:
             self.replicas[idx].submit(prompt_ids, sampling,
                                       self._wrap(rid, tracked), rid,
-                                      trace=trace)
+                                      **self._submit_extras(tracked))
         except Exception:
             self._note_departed(idx)
             with self._lock:
                 self._requests.pop(rid, None)
             raise
         return rid
+
+    @staticmethod
+    def _submit_extras(tracked: _Tracked) -> dict[str, Any]:
+        """trace/deadline kwargs for an engine submit; the deadline key is
+        omitted when unset so pre-deadline engine doubles keep working."""
+        extras: dict[str, Any] = {"trace": tracked.trace}
+        if tracked.deadline is not None:
+            extras["deadline"] = tracked.deadline
+        return extras
+
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
+        """End-to-end cancellation through the pool: mark the tracking
+        record (so the failover wrapper can never resubmit it) and forward
+        to the replica currently serving it. Never raises — a cancel racing
+        a replica break is resolved by the wrapper, which surfaces a
+        ``cancelled`` terminal instead of retrying. Returns False for
+        unknown (already finished) ids."""
+        with self._lock:
+            tracked = self._requests.get(request_id)
+            if tracked is None:
+                return False
+            tracked.cancelled = True
+            idx = tracked.replica
+        try:
+            self.replicas[idx].cancel(request_id, reason)
+        except Exception:  # noqa: BLE001 — a breaking replica's teardown
+            pass           # emits error; _wrap suppresses the failover
+        return True
 
     # ------------------------------------------------- lifecycle notifications
     # (never-raises: these run on submit and scheduler-emit paths — a
@@ -271,6 +308,18 @@ class DataParallelServingPool:
         drop the tracking record once the request finishes."""
 
         def emit(ev: StepEvent) -> None:
+            if ev.finished == "error" and tracked.cancelled and not tracked.done:
+                # a cancelled request's engine raced a replica break (its
+                # error terminal arrived before the cancel applied): NEVER
+                # resubmit — the client is gone. Surface the cancelled
+                # terminal and release the canary slot without crediting a
+                # clean completion (the replica did break).
+                tracked.done = True
+                with self._lock:
+                    self._requests.pop(rid, None)
+                self._note_departed(tracked.replica)
+                tracked.emit(StepEvent(0, -1, "cancelled"))
+                return
             if ev.finished == "error" and tracked.retries_left > 0 and not tracked.done:
                 tracked.retries_left -= 1
                 if self._failover(rid, tracked):
@@ -282,7 +331,10 @@ class DataParallelServingPool:
                 with self._lock:
                     self._requests.pop(rid, None)
                 # probation canaries count their clean terminals here (and a
-                # canary error re-quarantines the replica immediately)
+                # canary error re-quarantines the replica immediately).
+                # ``cancelled``/``deadline`` terminals count as completions:
+                # the engine served them without fault — a storm of client
+                # disconnects must not strike a healthy replica.
                 self._note_terminal(tracked.replica,
                                     ev.finished != "error")
             tracked.emit(ev)
@@ -304,6 +356,18 @@ class DataParallelServingPool:
         during the beat a lifecycle rebuild needs to offer a target)."""
         t0 = time.monotonic()
         old = tracked.replica
+        if tracked.deadline is not None and time.monotonic() >= tracked.deadline:
+            # the budget is already gone: resubmitting would only burn a
+            # surviving replica's slot to produce a guaranteed lapse — close
+            # out with the deadline terminal instead
+            tracked.done = True
+            with self._lock:
+                self._requests.pop(rid, None)
+            record_event(rid, "deadline_exceeded", reason="deadline",
+                         phase="failover", tokens=len(tracked.emitted))
+            self._note_departed(old)
+            tracked.emit(StepEvent(0, -1, "deadline"))
+            return True
         remaining = tracked.sampling.max_tokens - len(tracked.emitted)
         if remaining <= 0:
             # the replica died AFTER this request's full token budget was
@@ -339,6 +403,17 @@ class DataParallelServingPool:
             if attempt:
                 time.sleep(delay * (0.5 + self._failover_rng.random()))  # fabric-lint: waive AS01 reason=jittered failover backoff on the dying scheduler thread; no event loop here
                 delay = min(delay * 2.0, self.failover_backoff_max_s)
+            if tracked.cancelled:
+                # the cancel landed during the backoff window: stop here —
+                # a cancelled request must never be resubmitted
+                tracked.done = True
+                with self._lock:
+                    self._requests.pop(rid, None)
+                record_event(rid, "cancelled", reason="cancelled",
+                             phase="failover", tokens=len(tracked.emitted))
+                self._note_departed(old)
+                tracked.emit(StepEvent(0, -1, "cancelled"))
+                return True
             try:
                 failpoint("replicas.failover")
                 idx = self._pick(cont_prompt, exclude=(old,))
@@ -357,12 +432,22 @@ class DataParallelServingPool:
             try:
                 self.replicas[idx].submit(cont_prompt, cont_sampling,
                                           self._wrap(rid, tracked), rid,
-                                          trace=tracked.trace)
+                                          **self._submit_extras(tracked))
             except Exception:  # noqa: BLE001 — retry, then the error event
                 logger.exception("failover resubmission failed")
                 self._note_departed(idx)
                 continue
             tracked.replica = idx
+            if tracked.cancelled:
+                # a cancel landed DURING the resubmission window: pool.cancel
+                # forwarded it to the old (broken) replica and marked the
+                # record, but the request now lives on ``idx`` — forward the
+                # cancel to the new owner so a dead client's continuation
+                # cannot decode its remaining budget there
+                try:
+                    self.replicas[idx].cancel(rid, "cancelled")
+                except Exception:  # noqa: BLE001 — best-effort forward
+                    pass
             self._note_departed(old)
             self.failovers += 1
             record_recovery("replicas.failover", time.monotonic() - t0)

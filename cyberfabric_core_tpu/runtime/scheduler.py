@@ -53,6 +53,16 @@ instead of alternating.
   stop-ids/limits live on device and only CHANGED rows are patched at
   admission/finish/preempt/resume; the page table patches changed rows
   instead of re-uploading. This holds for the dense (non-paged) rounds too.
+- End-to-end cancellation & deadlines: ``cancel(request_id, reason)`` is
+  thread-safe and applied at the next round boundary in EVERY phase
+  (pending-queue removal pre-admit, mid-chunked-prefill abort, mid-decode
+  row deactivation, suspended drop), and a per-round expiry sweep lapses
+  requests whose ``deadline`` passed (``deadline_exceeded``; a queued
+  request whose remaining budget cannot cover its estimated prefill is
+  never admitted). A mid-decode cancel freezes the row (device rows
+  deactivated, page-table row zeroed so later dispatches park its KV writes
+  on scratch) WITHOUT bumping the epoch — the lookahead ring drains through
+  the cancel instead of discarding, so surviving streams lose nothing.
 
 The one sanctioned host<-device sync of the decode loop is the oldest-chunk
 drain (fabric-lint AS04 enforces this — non-blocking transfer starts are
@@ -83,6 +93,7 @@ from ..models import llama
 from ..models.configs import ModelConfig, get_config
 from ..modkit.failpoints import failpoint, record_recovery
 from ..modkit.flight_recorder import record_event
+from ..modkit.metrics import bump_counter
 from ..modkit.telemetry import (get_global_tracer, reset_log_context,
                                 set_log_context, traceparent_ids)
 from ..ops.rope import rope_frequencies
@@ -128,6 +139,11 @@ class _SlotState:
     prefill_chunks: int = 0
     prefill_t0: float = 0.0
     prefill_wall: float = 0.0
+    #: absolute monotonic deadline (None = unbounded): the per-round expiry
+    #: sweep lapses the request with ``deadline_exceeded`` once passed —
+    #: a dead SSE consumer or a blown client budget stops burning decode
+    #: rounds instead of running to max_tokens
+    deadline: Optional[float] = None
 
 
 @dataclass
@@ -141,6 +157,10 @@ class _Pending:
     #: coalescing/partitioning can never reorder the shared-rng split sequence
     key: Any = None
     trace: Optional[str] = None  # W3C traceparent from the gateway span
+    #: absolute monotonic deadline (None = unbounded); a pending entry whose
+    #: deadline passes — or whose remaining budget cannot even cover the
+    #: estimated prefill — lapses in the queue and NEVER occupies a slot
+    deadline: Optional[float] = None
 
 
 @dataclass
@@ -308,6 +328,27 @@ class ContinuousBatchingEngine:
         self._pending: _queue.Queue[_Pending] = _queue.Queue()
         #: serializes submit()'s bound check-and-put (many gateway threads)
         self._submit_lock = threading.Lock()
+        #: end-to-end cancellation: request ids a client/gateway asked to
+        #: cancel (id → reason), registered from ANY thread under
+        #: ``_cancel_lock`` and APPLIED by the scheduler thread at the next
+        #: round boundary (_service_cancellations) — cancel() itself never
+        #: touches device state, so it is safe on gateway event-loop threads
+        self._cancel_lock = threading.Lock()
+        self._cancel_requests: dict[str, str] = {}
+        #: fast-path flag for the per-round expiry sweep: stays False until
+        #: the first deadline-carrying submit, so deployments that never set
+        #: deadlines pay one bool check per round
+        self._has_deadlines = False
+        from collections import deque as _rate_deque
+
+        #: recent prefill throughput observations (tokens/s) — the
+        #: admission-time estimate behind "never admit a request whose
+        #: remaining deadline budget cannot even cover its prefill" uses the
+        #: BEST recent rate (contention and cold compiles only ever slow a
+        #: prefill down, so the max is the least-contaminated measurement —
+        #: the bench guards' best-run rule). One cold-compile sample can
+        #: therefore never poison the gate into rejecting all traffic.
+        self._prefill_rates: "_rate_deque[float]" = _rate_deque(maxlen=32)
         self._suspended: "_deque[_Suspended]" = _deque()
         #: mixed-batch chunked prefill (Sarathi-style piggybacking through the
         #: ragged kernel) — paged mode only; dense mode has no page chains
@@ -346,6 +387,11 @@ class ContinuousBatchingEngine:
         self.tokens_emitted = 0
         self.requests_completed = 0
         self.rejected_saturated = 0
+        #: cancellation accounting: terminal counts by reason (e.g.
+        #: client_disconnect / deadline) and the decode budget reclaimed —
+        #: max_tokens the fabric did NOT have to generate for dead clients
+        self.cancellations: dict[str, int] = {}
+        self.reclaimed_tokens = 0
         self.resume_latency_samples: "deque[float]" = deque(maxlen=512)
         self.decode_rounds = 0
         self.lookahead_rounds = 0
@@ -586,11 +632,16 @@ class ContinuousBatchingEngine:
         emit: Callable[[StepEvent], None],
         request_id: Optional[str] = None,
         trace: Optional[str] = None,
+        deadline: Optional[float] = None,
     ) -> str:
         """Enqueue a request; ``emit`` receives StepEvents from the scheduler
         thread (request_index is unused here — events are per-request already).
         ``trace`` is the caller's W3C traceparent: lifecycle spans
-        (llm.prefill / llm.decode_chunk / llm.preempt) join that trace."""
+        (llm.prefill / llm.decode_chunk / llm.preempt) join that trace.
+        ``deadline`` is an absolute ``time.monotonic()`` instant: once passed
+        the request lapses with a ``deadline`` terminal wherever it is —
+        still queued (never admitted), mid-chunked-prefill, mid-decode, or
+        suspended — via the per-round expiry sweep."""
         rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
         self._bucket_for(len(prompt_ids))  # validate early, in caller context
         if not self.paged and sampling.seed is not None:
@@ -641,10 +692,15 @@ class ContinuousBatchingEngine:
             # scheduler thread it can be admitted (and even finished)
             # immediately — a late 'enqueued' would arrive out of order and
             # reopen a ghost record
+            extra = {}
+            if deadline is not None:
+                self._has_deadlines = True
+                extra["deadline_ms"] = round(
+                    (deadline - time.monotonic()) * 1000.0, 1)
             record_event(rid, "enqueued", prompt_tokens=len(prompt_ids),
-                         trace_id=traceparent_ids(trace)[0])
+                         trace_id=traceparent_ids(trace)[0], **extra)
             self._pending.put(_Pending(rid, list(prompt_ids), sampling, emit,
-                                       trace=trace))
+                                       trace=trace, deadline=deadline))
         self._wake.set()
         self.start()
         return rid
@@ -658,6 +714,241 @@ class ContinuousBatchingEngine:
         stats() dict build): False once the loop crashed or close() retired
         the engine, at which point a supervisor should rebuild it."""
         return self._broken is None and not self._closed
+
+    # --------------------------------------------------------- cancellation
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
+        """Request cancellation of ``request_id`` — safe from ANY thread and
+        non-blocking (a dict write + a wake; no device work, no sleeps): the
+        gateway calls this on its event loop when an SSE consumer vanishes.
+        The scheduler thread applies it at the next round boundary
+        (:meth:`_service_cancellations`): a still-queued request leaves the
+        pending queue, a prefilling/decoding slot is deactivated and its
+        pages released, a suspended request is dropped — each with exactly
+        one ``cancelled`` terminal. Idempotent; cancelling a request that
+        already finished is a no-op. Returns an ADVISORY bool: whether the
+        id was visible somewhere in this engine at call time."""
+        found = self._cancel_known(request_id)
+        with self._cancel_lock:
+            self._cancel_requests[request_id] = reason
+        self._wake.set()
+        return found
+
+    def _cancel_known(self, request_id: str) -> bool:
+        """Advisory presence probe (GIL-atomic reads + one queue-mutex peek;
+        the authoritative lookup happens on the scheduler thread)."""
+        for state in self.slots:
+            if state is not None and state.request_id == request_id:
+                return True
+        for rec in list(self._suspended):
+            if rec.state.request_id == request_id:
+                return True
+        with self._pending.mutex:
+            return any(req.request_id == request_id
+                       for req in self._pending.queue)
+
+    def _service_cancellations(self) -> None:
+        """Apply registered cancels and lapse blown deadlines — runs on the
+        scheduler thread at every round boundary, so a cancelled mid-decode
+        stream frees its slot, KV pages, and prefix pins within ONE round.
+
+        Ring interaction (the deep-lookahead composition): a mid-decode
+        cancel does NOT bump the epoch, so in-flight speculative chunks keep
+        draining for the surviving rows — no full discard. That is safe
+        because (a) chunks already in flight write the cancelled row's KV
+        only into its own PRIVATE chain pages (decode positions sit past the
+        tree-committed prompt pages), and any later owner of a released page
+        rewrites every position before reading it, in dispatch order behind
+        the stale writes; (b) chunks dispatched AFTER the cancel see the
+        zeroed page-table row (flushed at dispatch) and park the row's
+        writes on scratch page 0 — the same freeze the device-resident
+        finished mask gives device-predicted stops; (c) the host mirrors
+        (``active``/``slots``) are cleared here, so the emit loop masks the
+        row's tokens out of every later drain."""
+        with self._cancel_lock:
+            if self._cancel_requests:
+                cancels = self._cancel_requests
+                self._cancel_requests = {}
+            else:
+                cancels = {}
+        if not cancels and not self._has_deadlines:
+            return
+        now = time.monotonic()
+        self._cancel_filter_pending(cancels, now)
+        self._cancel_suspended(cancels, now)
+        for slot in range(self.n_slots):
+            state = self.slots[slot]
+            if state is None:
+                continue
+            reason = cancels.pop(state.request_id, None)
+            kind = "cancelled"
+            if reason is None and state.deadline is not None \
+                    and now >= state.deadline:
+                reason, kind = "deadline", "deadline_exceeded"
+            if reason is None:
+                continue
+            self._cancel_slot(slot, state, reason, kind)
+        # ids that matched nothing raced a terminal (finished/preempt-shed in
+        # the same round): the request already got its one terminal — the
+        # cancel is consumed without effect, never a second emission
+        if self._ring and not self.active.any() and not self._prefill_slots:
+            # no OCCUPIED slot remains (prefill-phase slots are occupied but
+            # inactive — the PR-6 invariant — and their mixed round would
+            # discard/drain the ring properly itself): nothing will ever
+            # drain these speculative chunks
+            self._discard_ring()
+
+    def _cancel_filter_pending(self, cancels: dict[str, str],
+                               now: float) -> None:
+        """Lapse/cancel still-queued requests without ever taking a slot.
+        The advisory scan keeps the common no-victim round O(pending) cheap;
+        the drain-and-requeue runs under ``_submit_lock`` (the same
+        discipline as _fail_all_inflight) and the terminals emit outside
+        it."""
+        with self._pending.mutex:
+            snapshot = list(self._pending.queue)
+        if not any(req.request_id in cancels
+                   or (req.deadline is not None and now >= req.deadline)
+                   for req in snapshot):
+            return
+        victims: list[tuple[_Pending, str, str]] = []
+        with self._submit_lock:
+            kept: list[_Pending] = []
+            while True:
+                try:
+                    req = self._pending.get_nowait()
+                except _queue.Empty:
+                    break
+                reason = cancels.pop(req.request_id, None)
+                if reason is not None:
+                    victims.append((req, reason, "cancelled"))
+                elif req.deadline is not None and now >= req.deadline:
+                    victims.append((req, "deadline", "deadline_exceeded"))
+                else:
+                    kept.append(req)
+            for req in kept:  # FIFO order preserved
+                self._pending.put(req)
+        for req, reason, kind in victims:
+            self._cancel_finalize(req.request_id, req.emit, reason, kind,
+                                  phase="queued", emitted=0,
+                                  reclaimed=req.sampling.max_tokens,
+                                  trace=req.trace,
+                                  trace_sampled=traceparent_ids(req.trace)[1])
+
+    def _cancel_suspended(self, cancels: dict[str, str], now: float) -> None:
+        """Drop cancelled/lapsed preempted requests — their KV lives on host
+        (no pool pages held while suspended), so the saved copy just
+        drops."""
+        if not self._suspended:
+            return
+        kept: list[_Suspended] = []
+        victims: list[tuple[_Suspended, str, str]] = []
+        while self._suspended:
+            rec = self._suspended.popleft()
+            reason = cancels.pop(rec.state.request_id, None)
+            kind = "cancelled"
+            if reason is None and rec.state.deadline is not None \
+                    and now >= rec.state.deadline:
+                reason, kind = "deadline", "deadline_exceeded"
+            if reason is None:
+                kept.append(rec)
+            else:
+                victims.append((rec, reason, kind))
+        self._suspended.extend(kept)
+        for rec, reason, kind in victims:
+            self._cancel_finalize(
+                rec.state.request_id, rec.state.emit, reason, kind,
+                phase="suspended", emitted=rec.state.emitted,
+                reclaimed=rec.state.sampling.max_tokens - rec.state.emitted,
+                trace=rec.state.trace,
+                trace_sampled=rec.state.trace_sampled)
+
+    def _cancel_slot(self, slot: int, state: _SlotState, reason: str,
+                     kind: str) -> None:
+        """Deactivate one occupied slot (prefill OR decode phase) and
+        release everything it holds: the slot itself, its page chain (the
+        chain's refs are the only pins a mid-flight request holds — the
+        radix probe pin was released at admission), and its device rows
+        (frozen via the finished mask + zeroed page-table row, so chunks
+        dispatched after this park the row's KV writes on scratch).
+        Deliberately NO epoch bump — see _service_cancellations: the
+        lookahead ring drains through a cancel instead of discarding."""
+        phase = state.phase
+        if phase == "prefill":
+            self._prefill_slots.remove(slot)
+        self.active[slot] = False
+        self.slots[slot] = None
+        self._release_free_slot(slot)
+        self._deactivate_slot_device(slot)
+        if self.paged and state.chain is not None:
+            self.pool.release_slot(state.chain)
+            self.page_table[slot, :] = 0
+            self._mark_pt_row(slot)
+        self._cancel_finalize(
+            state.request_id, state.emit, reason, kind, phase=phase,
+            emitted=state.emitted, slot=slot,
+            reclaimed=state.sampling.max_tokens - state.emitted,
+            trace=state.trace, trace_sampled=state.trace_sampled)
+
+    def _cancel_finalize(self, request_id: str,
+                         emit: Callable[[StepEvent], None], reason: str,
+                         kind: str, *, phase: str, emitted: int,
+                         reclaimed: int, slot: Optional[int] = None,
+                         trace: Optional[str] = None,
+                         trace_sampled: bool = False) -> None:
+        """One terminal per cancellation: accounting, the flight-recorder
+        terminal (``cancelled`` / ``deadline_exceeded``), metrics, an
+        ``llm.cancel`` span for sampled traces, and the client StepEvent —
+        all through never-raises helpers (the emit callback may belong to a
+        connection that no longer exists)."""
+        self.cancellations[reason] = self.cancellations.get(reason, 0) + 1
+        self.reclaimed_tokens += max(0, int(reclaimed))
+        attrs = {"reason": reason, "phase": phase, "tokens": emitted}
+        if slot is not None:
+            attrs["slot"] = slot
+        record_event(request_id, kind, **attrs)
+        bump_counter("llm_cancellations_total", reason=reason)
+        if reclaimed > 0:
+            bump_counter("llm_cancel_reclaimed_tokens_total",
+                         n=int(reclaimed))
+        if trace_sampled:
+            # the request's OTLP trace ends with WHY it ended — the span
+            # distinguishes a disconnect-abort from a deadline lapse
+            get_global_tracer().emit_span(
+                "llm.cancel", traceparent=trace,
+                start_unix_ns=int(time.time() * 1e9), duration_ms=0.0,
+                request_id=request_id, reason=reason, kind=kind,
+                phase=phase, tokens=emitted)
+        finished = "deadline" if kind == "deadline_exceeded" else "cancelled"
+        try:
+            emit(StepEvent(0, -1, finished))
+        except Exception:  # noqa: BLE001 — the client is gone by definition
+            pass
+
+    def _note_prefill_rate(self, tokens: int, dur_s: float) -> None:
+        """Observed prefill throughput under CURRENT load — feeds the
+        admission-time "can this request even prefill before its deadline"
+        estimate. Durations include budget pacing across rounds, which is
+        exactly the wait a new admission would experience."""
+        if tokens <= 0 or dur_s <= 0:
+            return
+        self._prefill_rates.append(tokens / dur_s)
+
+    def _estimate_prefill_s(self, tokens: int) -> float:
+        """Optimistic-by-construction, permissive-when-cold: the BEST recent
+        rate (slow samples are contamination — compiles, contention — never
+        capability), and 0 with no observations yet (admit and let the
+        per-round sweep judge it). Under-estimating only costs one wasted
+        prefill; over-estimating would reject servable traffic, and a
+        poisoned estimate could otherwise lock out every deadline-carrying
+        request forever (rejected requests never prefill, so the rate would
+        never correct)."""
+        try:
+            rate = max(self._prefill_rates, default=0.0)
+        except RuntimeError:  # advisory read against the scheduler thread
+            rate = 0.0
+        if rate <= 0:
+            return 0.0
+        return tokens / rate
 
     # -------------------------------------------------------- health surface
     def pending_depth(self) -> int:
@@ -770,6 +1061,10 @@ class ContinuousBatchingEngine:
                 "count": len(waits),
             },
             "rejected_saturated": self.rejected_saturated,
+            # end-to-end cancellation: terminals by reason + the decode
+            # budget (max_tokens never generated) reclaimed for live users
+            "cancellations": dict(self.cancellations),
+            "reclaimed_tokens": self.reclaimed_tokens,
             # preempt→resume recovery latency (the stream-pause a client
             # actually experiences); also exported device-wide as the
             # fault_recovery_seconds{point=scheduler.resume} histogram
@@ -790,6 +1085,10 @@ class ContinuousBatchingEngine:
     def _loop_body(self) -> None:
         while not self._stop.is_set():
             try:
+                # cancels/deadlines apply at the round boundary: BEFORE
+                # admission (a lapsed pending entry must never take the slot
+                # this pass is about to hand out)
+                self._service_cancellations()
                 admitted = self._admit()
                 # prefilling slots are work too: mixed-batch rounds must run
                 # even before any slot reaches decode phase
@@ -813,6 +1112,10 @@ class ContinuousBatchingEngine:
         engine off its ``.params``). Single-threaded by construction: runs on
         the scheduler thread (crash) or after the thread joined (close)."""
         self._ring.clear()
+        with self._cancel_lock:
+            # every in-flight/queued request gets its error terminal below;
+            # a pending cancel for one of them must not re-fire later
+            self._cancel_requests.clear()
         for slot in range(self.n_slots):
             state = self.slots[slot]
             if state is not None:
@@ -1069,6 +1372,28 @@ class ContinuousBatchingEngine:
                 req = self._pending.get_nowait()
             except _queue.Empty:
                 break
+            if req.deadline is not None:
+                now = time.monotonic()
+                # the estimate gate applies only while the engine is BUSY
+                # (its point is shedding doomed work under pile-up): an
+                # idle engine always admits — a wrong estimate then costs
+                # one prefill, and the fresh observation keeps the rate
+                # honest (a rejected request never prefills, so an
+                # always-rejecting gate could never self-correct)
+                busy = self.active.any() or bool(self._prefill_slots)
+                if now >= req.deadline or (busy and (req.deadline - now) <
+                        self._estimate_prefill_s(len(req.prompt_ids))):
+                    # lapsed — or the remaining budget cannot even cover the
+                    # estimated prefill: admitting would burn a slot and
+                    # prefill compute to produce a guaranteed lapse. The
+                    # request never occupies a slot.
+                    self._cancel_finalize(
+                        req.request_id, req.emit, "deadline",
+                        "deadline_exceeded", phase="queued", emitted=0,
+                        reclaimed=req.sampling.max_tokens,
+                        trace=req.trace,
+                        trace_sampled=traceparent_ids(req.trace)[1])
+                    continue
             taken.append(req)
             spent += len(req.prompt_ids)
             wait_ms = (time.monotonic() - req.enqueued_at) * 1000.0
@@ -1229,6 +1554,7 @@ class ContinuousBatchingEngine:
                 prefill_key=req.key,
                 prefill_t0=time.monotonic(),
                 prefill_wall=time.time(),
+                deadline=req.deadline,
             )
             self.slots[slot] = state
             self.lengths[slot] = 0
@@ -1287,6 +1613,8 @@ class ContinuousBatchingEngine:
                              detail="coalesced prefill failed")
             return 0
         placed = 0
+        self._note_prefill_rate(sum(len(r.prompt_ids) for r in reqs),
+                                time.monotonic() - t_pf)
         for i, req in enumerate(reqs):
             slot = self._take_free_slot()
             if slot is None:  # unreachable: takes bounded by free slots
@@ -1445,6 +1773,7 @@ class ContinuousBatchingEngine:
         if self.paged:
             assert chain is not None
         dur_ms = (time.monotonic() - t_pf) * 1000.0
+        self._note_prefill_rate(T - cached_len, dur_ms / 1000.0)
         # recorded BEFORE activation: the first token emitted there may finish
         # the request, and a terminal event must be the timeline's last
         record_event(req.request_id, "prefill", slot=slot, coalesced=False,
@@ -1486,6 +1815,7 @@ class ContinuousBatchingEngine:
             chain=chain,
             trace=req.trace,
             trace_sampled=traceparent_ids(req.trace)[1],
+            deadline=req.deadline,
         )
         T = len(req.prompt_ids)
         self.slots[slot] = state
@@ -1875,6 +2205,9 @@ class ContinuousBatchingEngine:
         if bump_epoch:
             self._epoch += 1
         dur_ms = (time.monotonic() - state.prefill_t0) * 1000.0
+        # the chunked path's duration spans the budget-paced rounds — the
+        # realistic "time to get through prefill under current load"
+        self._note_prefill_rate(T - state.cached_len, dur_ms / 1000.0)
         # same terminal "prefill" event as the phase-separated path (ttft
         # anchors here); the per-chunk progress lives in prefill_chunk events
         record_event(state.request_id, "prefill", slot=slot, mixed=True,

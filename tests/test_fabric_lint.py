@@ -648,9 +648,55 @@ def test_WD01_supervisor_rebuild_helpers_exempt():
     assert ok == []
 
 
+def test_WD01_cancel_callback_blocking_sleep_fails():
+    # cancel() runs on gateway event-loop threads (an SSE disconnect) and
+    # the expiry sweep runs between decode rounds — neither may block
+    bad = lint("import time\n"
+               "class ContinuousBatchingEngine:\n"
+               "    def cancel(self, request_id, reason='cancelled'):\n"
+               "        time.sleep(0.1)\n",
+               tier="runtime", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and bad[0].line == 4
+
+
+def test_WD01_cancel_sweep_direct_recorder_emit_fails():
+    bad = lint("class ContinuousBatchingEngine:\n"
+               "    def _cancel_finalize(self, recorder, rid):\n"
+               "        recorder.record(rid, 'cancelled')\n",
+               tier="runtime", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and "record_event" in bad[0].message
+
+
+def test_WD01_pool_cancel_device_sync_fails():
+    # a device sync inside the pool's cancel would stall the event loop
+    # behind the accelerator exactly when a disconnect storm hits
+    bad = lint("import jax\n"
+               "class DataParallelServingPool:\n"
+               "    def cancel(self, request_id, reason='cancelled'):\n"
+               "        jax.block_until_ready(self._state)\n",
+               tier="runtime", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"]
+
+
+def test_WD01_cancel_callbacks_with_helpers_pass():
+    ok = lint("from cyberfabric_core_tpu.modkit.metrics import bump_counter\n"
+              "from cyberfabric_core_tpu.modkit.flight_recorder import "
+              "record_event\n"
+              "class ContinuousBatchingEngine:\n"
+              "    def cancel(self, request_id, reason='cancelled'):\n"
+              "        self._cancel_requests[request_id] = reason\n"
+              "        self._wake.set()\n"
+              "    def _service_cancellations(self):\n"
+              "        record_event('rid', 'cancelled', reason='x')\n"
+              "        bump_counter('llm_cancellations_total', reason='x')\n",
+              tier="runtime", select=("WD01",))
+    assert ok == []
+
+
 def test_WD01_repo_gate_clean():
-    """The gate: the shipped doctor's evaluators AND the lifecycle
-    supervisor's tick/routing callbacks hold their own contract."""
+    """The gate: the shipped doctor's evaluators, the lifecycle
+    supervisor's tick/routing callbacks, AND the scheduler/pool
+    cancellation callbacks hold their own contract."""
     engine = Engine(all_rules()).select(["WD01"])
     findings = [f for f in engine.run(PKG) if not f.suppressed]
     assert findings == [], [f.to_dict() for f in findings]

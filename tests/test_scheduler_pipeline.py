@@ -416,3 +416,180 @@ def test_dense_mode_still_serves():
     assert len(col.tokens[0]) == 8
     assert st["pipeline"]["rounds"] > 0
     assert st["pipeline"]["lookahead_rounds"] == 0
+
+
+# ------------------------------------------------- cancellation × pipeline
+
+
+def _drain_clean(sched):
+    """Shared leak assertions: slots, pending, suspended, pool refs."""
+    assert len(sched._free_slots) == sched.n_slots
+    assert all(s is None for s in sched.slots)
+    assert not sched.active.any()
+    assert sched._pending.qsize() == 0
+    assert not sched._suspended
+    if sched.pool is not None:
+        st = sched.pool.stats()
+        assert st.get("pages_referenced", 0) == 0, st
+        assert st.get("orphan_pages", 0) == 0, st
+
+
+def test_cancel_mid_decode_survivor_bit_identical_no_ring_discard():
+    """The tentpole golden: cancelling stream B mid-decode (from B's own
+    emit callback — scheduler-thread deterministic) must leave stream A
+    BIT-IDENTICAL to the uncancelled run, free B's slot/pages leak-free,
+    and drain the lookahead ring WITHOUT a discard (the cancel freezes the
+    row instead of bumping the epoch)."""
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(3, 900, 10).tolist(),
+               rng.integers(3, 900, 12).tolist()]
+    samplings = [SamplingParams(max_tokens=40), SamplingParams(max_tokens=40)]
+    cfg = _cfg(decode_lookahead=2)
+
+    ref_col, ref_stats = _run_streams(cfg, prompts, samplings)
+
+    sched = ContinuousBatchingEngine(cfg, seed=0)
+    col = _Collector(2)
+    triggered = []
+    try:
+        sched.submit(prompts[0], samplings[0], col.emit_for(0),
+                     request_id="surv")
+        inner_b = col.emit_for(1)
+
+        def emit_b(ev):
+            inner_b(ev)
+            if len(col.tokens[1]) >= 6 and not triggered:
+                triggered.append(1)
+                assert sched.cancel("vict", "test") is True
+        sched.submit(prompts[1], samplings[1], emit_b, request_id="vict")
+        assert col.done.wait(240), (col.finishes, sched.stats())
+        stats = sched.stats()
+    finally:
+        sched.shutdown()
+
+    assert col.tokens[0] == ref_col.tokens[0], "survivor diverged"
+    assert col.finishes[0] == ref_col.finishes[0]
+    assert col.finishes[1] == "cancelled"
+    assert len(col.tokens[1]) < 40, "victim ran to completion anyway"
+    assert stats["cancellations"] == {"test": 1}
+    assert stats["reclaimed_tokens"] == 40 - len(col.tokens[1])
+    # the ring survived the cancel: no discard beyond what the uncancelled
+    # run itself did (admissions account for both runs identically)
+    assert stats["pipeline"]["lookahead"]["discarded"] \
+        <= ref_stats["pipeline"]["lookahead"]["discarded"]
+    _drain_clean(sched)
+
+
+def test_cancel_racing_device_finish_single_terminal():
+    """Cancel landing in the same rounds as a device-side finish must not
+    double-release pages or emit two terminals — in either order."""
+    rng = np.random.default_rng(12)
+    prompt = rng.integers(3, 900, 10).tolist()
+    cfg = _cfg(decode_lookahead=2)
+
+    # order 1 — finish wins: cancel registered on the FINAL token's emit;
+    # the sweep then finds nothing to cancel
+    sched = ContinuousBatchingEngine(cfg, seed=0)
+    col = _Collector(1)
+    try:
+        inner = col.emit_for(0)
+
+        def emit(ev):
+            inner(ev)
+            if len(col.tokens[0]) == 8:  # max_tokens reached in this event
+                sched.cancel("r1", "late")
+        sched.submit(prompt, SamplingParams(max_tokens=8), emit,
+                     request_id="r1")
+        assert col.done.wait(240)
+        stats = sched.stats()
+    finally:
+        sched.shutdown()
+    assert col.finishes[0] == "length"
+    assert len(col.tokens[0]) == 8
+    assert stats["cancellations"] == {}, \
+        "a post-terminal cancel must be a no-op"
+    _drain_clean(sched)
+
+    # order 2 — cancel wins: registered mid-stream; chunks carrying the
+    # device-predicted finish may still be in the ring, but the deactivated
+    # row is masked out of every later drain
+    sched = ContinuousBatchingEngine(cfg, seed=0)
+    col = _Collector(1)
+    try:
+        inner = col.emit_for(0)
+        fired = []
+
+        def emit(ev):
+            inner(ev)
+            if len(col.tokens[0]) >= 5 and not fired:
+                fired.append(1)
+                sched.cancel("r2", "early")
+        sched.submit(prompt, SamplingParams(max_tokens=8), emit,
+                     request_id="r2")
+        assert col.done.wait(240)
+        stats = sched.stats()
+    finally:
+        sched.shutdown()
+    assert col.finishes[0] == "cancelled", "exactly one terminal, the cancel"
+    assert stats["cancellations"] == {"early": 1}
+    _drain_clean(sched)
+
+
+def test_cancel_while_suspended_never_resurrects():
+    """Cancel during preempt/resume: a suspended (preempted-to-host)
+    request that gets cancelled must terminate once, never resume, and the
+    other stream must stay bit-identical to its unfaulted run."""
+    from cyberfabric_core_tpu.modkit import failpoints as fp
+
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(3, 900, 10).tolist(),
+               rng.integers(3, 900, 10).tolist()]
+    samplings = [SamplingParams(max_tokens=30), SamplingParams(max_tokens=30)]
+    cfg = _cfg(max_batch=2)
+
+    ref_col, _ = _run_streams(cfg, prompts, samplings)
+
+    fp.reset()
+    sched = ContinuousBatchingEngine(cfg, seed=0)
+    col = _Collector(2)
+    try:
+        # one forced MemoryError on a page-chain growth → preempt-to-host
+        fp.arm("scheduler.page_alloc", "1*raise(MemoryError)")
+        sched.submit(prompts[0], samplings[0], col.emit_for(0),
+                     request_id="keeper")
+        sched.submit(prompts[1], samplings[1], col.emit_for(1),
+                     request_id="parked")
+        deadline = time.monotonic() + 60.0
+        while sched.preemptions == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert sched.preemptions >= 1, "injected pressure never preempted"
+        # cancel whichever request is currently suspended
+        victim = None
+        for _ in range(2000):
+            susp = list(sched._suspended)
+            if susp:
+                victim = susp[0].state.request_id
+                break
+            if len(col.finishes) == 2:
+                break  # resumed and finished before we could look
+            time.sleep(0.002)
+        if victim is not None:
+            sched.cancel(victim, "mid_suspend")
+        assert col.done.wait(240), (col.finishes, sched.stats())
+        stats = sched.stats()
+    finally:
+        fp.reset()
+        sched.shutdown()
+    if victim is not None:
+        vic_idx = 0 if victim == "keeper" else 1
+        # the cancel may race the resume: either it caught the request
+        # suspended (cancelled terminal) or the request resumed first and
+        # finished cleanly — but never both, and never zero
+        assert col.finishes[vic_idx] in ("cancelled", "stop", "length")
+        other = 1 - vic_idx
+        assert col.tokens[other] == ref_col.tokens[other], \
+            "the surviving stream diverged"
+        if col.finishes[vic_idx] == "cancelled":
+            assert stats["cancellations"] == {"mid_suspend": 1}
+    assert len(col.finishes) == 2
+    _drain_clean(sched)
