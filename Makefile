@@ -5,9 +5,9 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench lifecycle-guard cancel-guard
+.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench lifecycle-guard cancel-guard fairness-guard
 
-safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench lifecycle-guard cancel-guard  ## the full local gate
+safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench lifecycle-guard cancel-guard fairness-guard  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
 
@@ -65,6 +65,10 @@ lifecycle-guard:  ## replica lifecycle tests + the disarmed-supervisor overhead 
 cancel-guard:  ## end-to-end cancellation/deadline tests + the armed-but-unused deadline-sweep overhead A/B (BENCH_CANCEL.json, <1% bar)
 	$(PY) -m pytest tests/test_cancellation.py -q
 	$(PY) bench.py --cancel-guard > /dev/null
+
+fairness-guard:  ## tenant isolation tests + the armed-with-one-tenant overhead A/B (BENCH_FAIRNESS.json, <1% bar)
+	$(PY) -m pytest tests/test_tenancy.py -q
+	$(PY) bench.py --fairness-guard > /dev/null
 
 test:  ## full suite
 	$(PY) -m pytest tests/ -q

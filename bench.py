@@ -841,6 +841,23 @@ def cancel_guard() -> int:
         "best run per arm (contention only slows runs down)")
 
 
+def fairness_guard() -> int:
+    """Armed-with-one-tenant overhead guard for tenant-fair scheduling:
+    every request lands in the default tenant with the weighted-fair queue
+    LIVE (per-tenant deques, VTC pop, the per-token charge — the production
+    steady state for single-tenant traffic) vs the tenant-blind global FIFO
+    (``BENCH_TENANCY=off``, the pre-tenancy path). Fairness must be free
+    when there is nobody to be fair between."""
+    return _ab_guard(
+        "fairness", "BENCH_TENANCY", "tenancy", "on", "off",
+        "BENCH_FAIRNESS_REPS", "BENCH_FAIRNESS.json",
+        "tenant-fairness armed-with-one-tenant overhead: --aggregate "
+        "tok/s with the weighted-fair queue live and every request in "
+        "the default tenant (VTC pop + per-token charge exercised) vs "
+        "the tenant-blind global FIFO; interleaved ABBA runs, best run "
+        "per arm (contention only slows runs down)")
+
+
 def ragged_bench() -> int:
     """Mixed-batch A/B (BENCH_RAGGED.json): the --aggregate staggered storm
     with ragged mixed-batch rounds ON (prefill chunks piggyback into decode
@@ -1113,13 +1130,20 @@ def aggregate(model_name: str, quant: str) -> int:
         # per-round stalls that a 32-token round boundary would swamp); the
         # cold-storm ragged A/B keeps the production default
         decode_chunk = int(os.environ.get("BENCH_DECODE_CHUNK", "32"))
+        # fairness-guard A/B arms (BENCH_FAIRNESS.json): "on"/unset keeps
+        # tenancy ARMED with every request landing in the one default
+        # tenant (the production steady state for single-tenant traffic:
+        # fair-queue put/pop + the per-token charge all live, one tenant);
+        # "off" pins the tenant-blind global FIFO (the pre-tenancy path)
+        tenant_fair = os.environ.get("BENCH_TENANCY", "on") != "off"
         cfg = EngineConfig(model=model_name, max_seq_len=512, max_batch=slots,
                            decode_chunk=decode_chunk, quantization=quant,
                            prefix_cache_pages=slots * 8 + 33,
                            prefix_page_size=64,
                            decode_lookahead=lookahead,
                            mixed_batch=mixed,
-                           prefill_budget_tokens=budget)
+                           prefill_budget_tokens=budget,
+                           tenant_fair=tenant_fair)
         #: lifecycle-guard A/B arms (BENCH_LIFECYCLE.json): BOTH arms route
         #: the storm through a 1-replica DataParallelServingPool so the pool
         #: wrapper cost cancels out of the delta — "on" arms the lifecycle
@@ -1630,6 +1654,8 @@ if __name__ == "__main__":
         sys.exit(lifecycle_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--faultlab-guard":
         sys.exit(faultlab_guard())
+    if len(sys.argv) > 1 and sys.argv[1] == "--fairness-guard":
+        sys.exit(fairness_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--cancel-guard":
         sys.exit(cancel_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--trace-guard":

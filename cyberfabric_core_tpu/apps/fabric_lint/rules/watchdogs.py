@@ -37,6 +37,14 @@ work or a sleep), and the per-round cancel/expiry sweep
 (``_service_cancellations`` / ``_cancel_*``) runs on the scheduler thread
 between rounds — a blocking call there stalls every live stream, and a
 raising emit would turn a dead client's cleanup into an engine crash.
+
+The tenant fairness/quota surface holds the same contract: the round-
+boundary cap sweep (``_service_tenant_caps``) and the per-token charge path
+(``_charge_tenant``) run between/inside decode rounds (bookkeeping only —
+the actual soft-quota preempt happens in the capacity pass, where device
+work already lives), and the fair queue's ``put``/``pop_fair``/``charge``
+(classes named ``*FairQueue*``) sit on gateway submit threads and the
+admission pass — one sleep there stalls every tenant at once.
 """
 
 from __future__ import annotations
@@ -63,17 +71,26 @@ _METRIC_FACTORIES = frozenset({"counter", "histogram", "gauge"})
 _CALLBACK_PREFIXES = ("evaluate", "_evaluate", "on_record", "ingest",
                       "_check_", "tick", "_tick", "on_terminal",
                       "on_departed", "admit_allowed", "note_dispatch",
-                      "cancel", "_cancel", "_service_cancel", "_expire")
+                      "cancel", "_cancel", "_service_cancel", "_expire",
+                      # tenant fairness/quota surface: the round-boundary
+                      # cap sweep + the charge path (scheduler thread, per
+                      # token) and the fair queue's put/pop/charge (gateway
+                      # threads + the admission pass)
+                      "_service_tenant", "_charge", "put", "pop_fair",
+                      "remove_if", "charge")
 
 
 def _is_doctor_class(node: ast.ClassDef) -> bool:
     # Engine/ServingPool joined for the cancellation callbacks: their other
     # methods legitimately block on device work, but nothing named
     # cancel*/tick*/evaluate* etc. does — the prefix × marker product
-    # stays exact
+    # stays exact. FairQueue joined for the tenant-fairness surface: its
+    # put/pop_fair/charge run on gateway submit threads and inside the
+    # scheduler's admission/emit hot paths — a sleep or raising emit there
+    # stalls serving itself, exactly the supervisor-tick failure mode.
     return any(marker in node.name for marker in
                ("Doctor", "Watchdog", "Supervisor", "Lifecycle",
-                "Engine", "ServingPool"))
+                "Engine", "ServingPool", "FairQueue"))
 
 
 def _is_callback(fn: ast.AST) -> bool:

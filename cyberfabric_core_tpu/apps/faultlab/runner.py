@@ -381,6 +381,391 @@ def _run_deadline_scenario(spec: dict) -> ScenarioResult:
                           "reclaimed_tokens": stats.get("reclaimed_tokens")})
 
 
+# ------------------------------------------------------- tenancy kinds
+
+def _run_noisy_neighbor_scenario(spec: dict) -> ScenarioResult:
+    """noisy-neighbor: one tenant floods ``heavy_requests`` (default 32)
+    greedy streams while a light tenant submits ``light_requests`` (default
+    4) right behind them, through ONE tenant-fair engine. The weighted-fair
+    queue must bound the light tenant's exposure to the flood:
+
+    - every light request is admitted while a large chunk of the heavy
+      backlog is still waiting (under tenant-blind FIFO, ALL heavy requests
+      admit first — the decisive structural check);
+    - the light tenant's worst queue wait stays under an absolute sanity
+      bound (and within a generous factor of its solo run — recorded as
+      detail; CPU timing is too noisy for a tight relative invariant);
+    - at the instant the light tenant's LAST stream finishes (captured on
+      the scheduler thread — a deterministic observation point), the two
+      tenants' weight-normalized charged tokens are within a fixed factor:
+      token shares converge to the configured weights instead of the heavy
+      tenant serializing the engine;
+    - every stream is bit-identical to its tenant's solo (unloaded) run —
+      fairness reorders admission, never tokens — and the drained engine
+      holds zero slot/page leaks."""
+    from ...modkit.flight_recorder import default_recorder
+    from ...runtime.engine import SamplingParams
+    from ...runtime.scheduler import ContinuousBatchingEngine
+
+    seed = int(spec.get("seed", 0))
+    cfg = _engine_config(spec)
+    heavy_n = int(spec.get("heavy_requests", 32))
+    light_n = int(spec.get("light_requests", 4))
+    max_tokens = int((spec.get("load") or {}).get("max_tokens", 8))
+    rng = random.Random(seed)
+    lo, hi = (spec.get("load") or {}).get("prompt_len", [4, 10])
+
+    def mk_prompts(n):
+        return [[rng.randrange(3, 250) for _ in range(rng.randrange(lo, hi + 1))]
+                for _ in range(n)]
+
+    heavy_prompts = mk_prompts(heavy_n)
+    light_prompts = mk_prompts(light_n)
+    heavy_load = [(p, max_tokens) for p in heavy_prompts]
+    light_load = [(p, max_tokens) for p in light_prompts]
+    fp.configure(seed)
+    # solo (unloaded) baselines per tenant — greedy streams are admission-
+    # order invariant, so each tenant's solo run is the bit-identity oracle
+    light_solo = _baseline_streams({**spec, "load": {}}, cfg, light_load)
+    heavy_solo = _baseline_streams({**spec, "load": {}}, cfg, heavy_load)
+    # solo queue waits for the light tenant (detail / sanity factor)
+    default_recorder.reset()
+    solo_engine = ContinuousBatchingEngine(cfg, seed=0)
+    solo_done = threading.Event()
+    solo_left = [light_n]
+
+    def mk_solo_emit():
+        def emit(ev):
+            if ev.finished:
+                solo_left[0] -= 1
+                if solo_left[0] == 0:
+                    solo_done.set()
+        return emit
+
+    solo_rids = []
+    for j, (prompt, mt) in enumerate(light_load):
+        rid = f"nn-solo-{seed}-{j}"
+        solo_rids.append(rid)
+        solo_engine.submit(prompt, SamplingParams(max_tokens=mt),
+                           mk_solo_emit(), request_id=rid, tenant="light")
+    solo_done.wait(_DRAIN_TIMEOUT_S)
+    solo_engine.shutdown()
+
+    def queue_waits(rids):
+        waits = []
+        for rid in rids:
+            rec = default_recorder.lookup(rid) or {}
+            for ev in rec.get("timeline", ()):
+                if ev.get("event") == "admitted":
+                    waits.append(float(ev.get("queue_wait_ms", 0.0)))
+        return waits
+
+    solo_waits = queue_waits(solo_rids)
+
+    # ---- the contended run: heavy floods first, light right behind
+    default_recorder.reset()
+    engine = ContinuousBatchingEngine(cfg, seed=0)
+    n_total = heavy_n + light_n
+    streams = {i: StreamRecord() for i in range(n_total)}
+    done = threading.Event()
+    lock = threading.Lock()
+    remaining = [n_total]
+    light_left = [light_n]
+    share_at_light_finish: dict[str, Any] = {}
+
+    def mk_emit(i, light: bool):
+        def emit(ev):
+            with lock:
+                was_finished = streams[i].finished
+                record_event(streams[i], ev.token_id, ev.finished)
+                if ev.finished and not was_finished:
+                    remaining[0] -= 1
+                    if light:
+                        light_left[0] -= 1
+                        if light_left[0] == 0:
+                            # deterministic observation point, on the
+                            # scheduler thread: the fairness ledger the
+                            # moment the light tenant's work completes
+                            share_at_light_finish.update(
+                                engine.tenant_snapshot())
+                    if remaining[0] == 0:
+                        done.set()
+        return emit
+
+    heavy_rids = [f"nn-heavy-{seed}-{i}" for i in range(heavy_n)]
+    light_rids = [f"nn-light-{seed}-{j}" for j in range(light_n)]
+    for i, (prompt, mt) in enumerate(heavy_load):
+        engine.submit(prompt, SamplingParams(max_tokens=mt), mk_emit(i, False),
+                      request_id=heavy_rids[i], tenant="heavy")
+    for j, (prompt, mt) in enumerate(light_load):
+        engine.submit(prompt, SamplingParams(max_tokens=mt),
+                      mk_emit(heavy_n + j, True),
+                      request_id=light_rids[j], tenant="light")
+    done.wait(_DRAIN_TIMEOUT_S)
+    stats = engine.stats()
+    engine.shutdown()
+
+    # admission order: ts of each request's 'admitted' event
+    admitted_at: dict[str, float] = {}
+    for rid in heavy_rids + light_rids:
+        rec = default_recorder.lookup(rid) or {}
+        for ev in rec.get("timeline", ()):
+            if ev.get("event") == "admitted":
+                admitted_at[rid] = ev["ts"]
+    problems: dict[str, list[str]] = {}
+    order_probs = []
+    # under fair scheduling every light request admits while most of the
+    # heavy backlog still waits; tenant-blind FIFO admits all heavy first
+    max_heavy_before = int(spec.get("max_heavy_admitted_before",
+                                    heavy_n - 8))
+    for rid in light_rids:
+        ts = admitted_at.get(rid)
+        if ts is None:
+            order_probs.append(f"{rid} never admitted")
+            continue
+        before = sum(1 for h in heavy_rids
+                     if admitted_at.get(h) is not None
+                     and admitted_at[h] < ts)
+        if before > max_heavy_before:
+            order_probs.append(
+                f"{rid}: {before} heavy requests admitted first "
+                f"(> {max_heavy_before} — FIFO-like starvation)")
+    problems["light_admitted_while_heavy_backlogged"] = order_probs
+    cont_waits = queue_waits(light_rids)
+    wait_bound_s = float(spec.get("light_wait_bound_s", 10.0))
+    worst = max(cont_waits) / 1000.0 if cont_waits else float("inf")
+    problems["light_queue_wait_bounded"] = (
+        [] if cont_waits and worst <= wait_bound_s else
+        [f"light worst queue wait {worst:.2f}s > {wait_bound_s}s "
+         f"(solo waits ms: {solo_waits})"])
+    # token shares at the light tenant's completion instant
+    share_probs = []
+    ledger = share_at_light_finish
+    if not ledger.get("light") or not ledger.get("heavy"):
+        share_probs.append(f"fairness ledger missing tenants: {ledger}")
+    else:
+        def norm(t):
+            row = ledger[t]
+            return row["charged_tokens"] / max(row["weight"], 1e-9)
+
+        ratio = norm("heavy") / max(norm("light"), 1e-9)
+        lo_f, hi_f = spec.get("share_ratio_bounds", [0.1, 6.0])
+        if not lo_f <= ratio <= hi_f:
+            share_probs.append(
+                f"weight-normalized heavy/light charged ratio {ratio:.2f} "
+                f"outside [{lo_f}, {hi_f}] at light completion — shares "
+                "did not converge to the configured weights")
+    problems["token_shares_converge"] = share_probs
+    # bit-identity against the solo baselines + leak checks
+    evidence = {
+        "streams": streams,
+        "engine": engine,
+        "expect_error": [],
+        "baseline": {**{i: heavy_solo[i] for i in range(heavy_n)},
+                     **{heavy_n + j: light_solo[j]
+                        for j in range(light_n)}},
+    }
+    problems.update(run_checkers(
+        list(spec.get("invariants",
+                      ["exactly_one_terminal", "streams_match_baseline",
+                       "engine_accounting"])), evidence))
+    return _finish(
+        spec["name"], "noisy_neighbor", seed, problems,
+        _streams_payload(streams, tokens=True),
+        waits={"light_solo_ms": solo_waits, "light_contended_ms": cont_waits},
+        tenants={t: {k: row[k] for k in ("charged_tokens", "weight")}
+                 for t, row in ledger.items()} if ledger else {},
+        stats={"tenants": {t: r.get("charged_tokens")
+                           for t, r in stats.get("tenants", {}).items()}})
+
+
+def _run_selective_shed_scenario(spec: dict) -> ScenarioResult:
+    """selective-shed: on a REAL two-tenant stack (accept_all authn —
+    x-tenant-id selects the tenant), a readback delay armed over the
+    guarded REST control plane burns the itl objective while the ``heavy``
+    tenant floods concurrent completions and the ``light`` tenant probes
+    politely. The doctor must attribute the burn/queue pressure to the
+    over-fair-share tenant and the gateway must shed ONLY it:
+
+    - a heavy probe gets 429 ``tenant_shed`` + Retry-After while a light
+      probe keeps returning 200 with baseline-identical text;
+    - global shedding never engages (``/readyz`` stays 200 — ``shed_after``
+      is set out of reach, selective shedding is the first line);
+    - after disarm + drain the shed set clears and heavy serves again."""
+    seed = int(spec.get("seed", 0))
+    delay_spec = spec.get("delay_spec", "delay(0.4)")
+
+    async def go():
+        import aiohttp
+
+        doctor_cfg = {
+            "eval_interval_s": 0.1, "fast_window_s": 2.0,
+            "slow_window_s": 4.0, "min_samples": 3,
+            # global shedding out of reach: selective shedding must carry
+            "shed_after": 10 ** 6, "recover_after": 2,
+            "objectives": {"itl_p99": {"threshold_ms": float(
+                spec.get("itl_threshold_ms", 30.0))}},
+            "tenant_over_share": 1.5, "tenant_min_activity": 8,
+            "tenant_shed_retry_after_s": 1.0,
+            "stream_stall_s": 120.0, "round_stall_floor_s": 120.0,
+            "queue_deadline_s": 120.0,
+        }
+        rt, base = await _boot_stack(
+            ["authn_resolver", "authz_resolver", "monitoring",
+             "model_registry", "llm_gateway"],
+            {"tenant_resolver": {"config": {"tenants": {
+                # both tenants inherit the shared model from root (model
+                # resolution walks up the tenant hierarchy)
+                "root": {}, "light": {"parent": "root"},
+                "heavy": {"parent": "root"}}}},
+             "authn_resolver": {"config": {"mode": "accept_all",
+                                           "default_tenant": "light"}},
+             "model_registry": {"config": {"seed_tenant": "root",
+                                           "models": [{
+                 "provider_slug": "local", "provider_model_id": "tiny-llama",
+                 "approval_state": "approved", "managed": True,
+                 "architecture": "llama",
+                 "engine_options": {"model_config": "tiny-llama",
+                                    "max_seq_len": 128, "max_batch": 4,
+                                    "decode_chunk": 8}}]}},
+             "llm_gateway": {},
+             "monitoring": {"config": {"allow_fault_injection": True,
+                                       "doctor": doctor_cfg}}},
+            auth_disabled=False)
+        out: dict[str, Any] = {}
+        try:
+            async with aiohttp.ClientSession() as s:
+                async def completion(tenant: str, prompt: str,
+                                     max_tokens: int = 16):
+                    async with s.post(
+                            f"{base}/v1/completions",
+                            json={"model": "local::tiny-llama",
+                                  "prompt": prompt,
+                                  "max_tokens": max_tokens},
+                            headers={"x-tenant-id": tenant}) as r:
+                        body = await r.json()
+                        return r.status, dict(r.headers), body
+
+                def text_of(body: dict) -> str:
+                    return "".join(p.get("text", "")
+                                   for p in body.get("content", []))
+
+                # warmup compile + light baseline text
+                await completion("light", "selective shed warmup", 8)
+                st, _, body = await completion("light", f"probe {seed}", 8)
+                out["light_baseline"] = {"status": st,
+                                         "text": text_of(body)}
+
+                await arm_over_rest(s, base, "scheduler.readback",
+                                    delay_spec, seed=seed)
+                flood = [asyncio.ensure_future(
+                    completion("heavy", f"flood {seed} {i}", 24))
+                    for i in range(int(spec.get("heavy_requests", 16)))]
+                # wait for the doctor to attribute + shed the heavy tenant
+                shed_probe = None
+                deadline = time.monotonic() + 45.0
+                while time.monotonic() < deadline:
+                    st, headers, body = await completion(
+                        "heavy", f"shed probe {seed}", 8)
+                    if st == 429:
+                        shed_probe = {
+                            "status": st, "code": body.get("code"),
+                            "retry_after": headers.get("Retry-After")}
+                        break
+                    await asyncio.sleep(0.2)
+                out["heavy_shed_probe"] = shed_probe
+                # while the heavy tenant is shed, the light tenant serves
+                st, _, body = await completion("light", f"probe {seed}", 8)
+                out["light_during_shed"] = {
+                    "status": st,
+                    "text_matches": text_of(body)
+                    == out["light_baseline"]["text"]}
+                # global shedding never engaged: /readyz stays 200
+                async with s.get(f"{base}/readyz") as r:
+                    out["readyz_during_shed"] = r.status
+                # the shed set is rebuilt every eval pass and cleared the
+                # moment an evaluation reads clean — a single-shot read
+                # can race a momentary window droop, so POLL for the
+                # attribution markers while the burn is still armed
+                deadline = time.monotonic() + 15.0
+                while time.monotonic() < deadline:
+                    async with s.get(f"{base}/v1/monitoring/slo",
+                                     headers={"x-tenant-id": "light"}) as r:
+                        slo = await r.json()
+                    out["shed_tenants"] = slo.get("shed_tenants", [])
+                    out["state_during"] = slo.get("state")
+                    async with s.get(f"{base}/v1/monitoring/tenants",
+                                     headers={"x-tenant-id": "light"}) as r:
+                        out["tenants_rows"] = {
+                            row["tenant"]: row.get("shed")
+                            for row in (await r.json()).get("tenants", [])}
+                    if out["shed_tenants"] == ["heavy"] and \
+                            out["tenants_rows"].get("heavy") is True:
+                        break
+                    await asyncio.sleep(0.2)
+                await _disarm_over_rest(s, base, "scheduler.readback")
+                flood_done = await asyncio.gather(*flood)
+                out["flood_status"] = sorted(
+                    {st for st, _, _ in flood_done})
+                # burn subsides → the shed set clears and heavy serves
+                recovered = None
+                deadline = time.monotonic() + 45.0
+                while time.monotonic() < deadline:
+                    st, _, _ = await completion(
+                        "heavy", f"recovered probe {seed}", 8)
+                    if st == 200:
+                        recovered = st
+                        break
+                    await asyncio.sleep(0.3)
+                out["heavy_recovered"] = recovered
+        finally:
+            from ...modkit.doctor import DoctorConfig, default_doctor
+
+            await _stop_stack(rt)
+            default_doctor.stop()
+            default_doctor.configure(DoctorConfig())
+        return out
+
+    out = asyncio.run(go())
+    shed_probe = out.get("heavy_shed_probe") or {}
+    invariants = {
+        "heavy_tenant_shed_with_retry_after": (
+            [] if (shed_probe.get("status") == 429
+                   and shed_probe.get("code") == "tenant_shed"
+                   and shed_probe.get("retry_after")) else
+            [f"heavy shed probe {shed_probe}"]),
+        "light_tenant_keeps_serving": (
+            [] if (out.get("light_during_shed", {}).get("status") == 200
+                   and out.get("light_during_shed", {}).get("text_matches"))
+            else [f"light during shed: {out.get('light_during_shed')}"]),
+        "global_shedding_stays_last_resort": (
+            [] if (out.get("readyz_during_shed") == 200
+                   and out.get("state_during") != "shedding") else
+            [f"readyz={out.get('readyz_during_shed')} "
+             f"state={out.get('state_during')} — global shedding engaged"]),
+        "doctor_names_the_abuser": (
+            [] if out.get("shed_tenants") == ["heavy"] else
+            [f"shed_tenants {out.get('shed_tenants')}"]),
+        "tenants_surface_marks_shed": (
+            [] if out.get("tenants_rows", {}).get("heavy") is True else
+            [f"/v1/monitoring/tenants rows: {out.get('tenants_rows')}"]),
+        "heavy_recovers_after_drain": (
+            [] if out.get("heavy_recovered") == 200 else
+            [f"heavy never recovered ({out.get('heavy_recovered')})"]),
+        "flood_terminates": (
+            [] if out.get("flood_status") and
+            set(out["flood_status"]) <= {200, 429} else
+            [f"flood statuses {out.get('flood_status')}"]),
+    }
+    return _finish(spec["name"], "selective_shed", seed, invariants,
+                   {"shed_probe": {k: shed_probe.get(k)
+                                   for k in ("status", "code")},
+                    "light": out.get("light_during_shed"),
+                    "readyz": out.get("readyz_during_shed")},
+                   shed_tenants=out.get("shed_tenants"),
+                   flood_status=out.get("flood_status"))
+
+
 # ----------------------------------------------------------------- pool kind
 
 def _drive_pool(cfg, load, faults: list[dict], n_replicas: int = 2,
@@ -814,9 +1199,15 @@ def _run_db_commit_scenario(spec: dict) -> ScenarioResult:
 
 # -------------------------------------------------------- server-stack kinds
 
-async def _boot_stack(modules: list[str], module_configs: dict):
+async def _boot_stack(modules: list[str], module_configs: dict,
+                      auth_disabled: bool = True):
     """Boot a minimal in-process server stack (the test_oagw.py pattern):
-    gateway + the requested modules over an in-memory DB, auth disabled."""
+    gateway + the requested modules over an in-memory DB. Auth is disabled
+    by default; ``auth_disabled=False`` routes requests through the
+    accept_all authn resolver instead, so the ``x-tenant-id`` header
+    selects the tenant (the multi-tenant scenarios need per-request
+    tenants — configure ``tenant_resolver``/``authn_resolver`` in
+    ``module_configs``)."""
     from ...gateway.module import ApiGatewayModule
     from ...modkit import (AppConfig, ClientHub, ModuleRegistry, RunOptions)
     from ...modkit.db import DbManager
@@ -827,7 +1218,9 @@ async def _boot_stack(modules: list[str], module_configs: dict):
     from ...modules.model_registry import ModelRegistryModule
     from ...modules.monitoring import MonitoringModule
     from ...modules.oagw import OagwModule
-    from ...modules.resolvers import TenantResolverModule
+    from ...modules.resolvers import (AuthnResolverModule,
+                                      AuthzResolverModule,
+                                      TenantResolverModule)
     from ...modules.serverless_runtime import ServerlessRuntimeModule
 
     available = {
@@ -848,6 +1241,13 @@ async def _boot_stack(modules: list[str], module_configs: dict):
         "llm_gateway": Registration(
             "llm_gateway", LlmGatewayModule, ("model_registry",),
             ("rest", "stateful", "grpc", "db")),
+        # multi-tenant scenarios: accept_all authn takes the tenant from
+        # x-tenant-id (restricted to tenant_resolver's configured tree)
+        "authn_resolver": Registration(
+            "authn_resolver", AuthnResolverModule, ("tenant_resolver",),
+            ("system",)),
+        "authz_resolver": Registration(
+            "authz_resolver", AuthzResolverModule, (), ("system",)),
     }
     regs = [
         Registration("api_gateway", ApiGatewayModule, (),
@@ -858,7 +1258,7 @@ async def _boot_stack(modules: list[str], module_configs: dict):
     _REGISTRATIONS.clear()
     cfg = AppConfig.load_or_default(environ={}, cli_overrides={"modules": {
         "api_gateway": {"config": {"bind_addr": "127.0.0.1:0",
-                                   "auth_disabled": True}},
+                                   "auth_disabled": auth_disabled}},
         "tenant_resolver": {},
         **module_configs,
     }})
@@ -1565,6 +1965,8 @@ _KINDS = {
     "engine": _run_engine_scenario,
     "cancel_storm": _run_cancel_storm_scenario,
     "deadline": _run_deadline_scenario,
+    "noisy_neighbor": _run_noisy_neighbor_scenario,
+    "selective_shed": _run_selective_shed_scenario,
     "pool": _run_pool_scenario,
     "replica_crash_loop": _run_replica_crash_loop_scenario,
     "replica_drain": _run_replica_drain_scenario,

@@ -350,6 +350,39 @@ BUILTIN_SCENARIOS: list[dict[str, Any]] = [
         "kind": "grpc_evict",
         "seed": 405,
     },
+    # ---- tenant isolation (weighted-fair queue + selective shedding) ---
+    {
+        # one tenant floods 32 requests while a light tenant sends 4: the
+        # weighted-fair queue admits every light request while most of the
+        # heavy backlog still waits (FIFO would starve it behind all 32),
+        # the light tenant's queue wait stays bounded, weight-normalized
+        # token shares converge by the light tenant's completion, every
+        # stream is bit-identical to its tenant's solo run, zero leaks
+        "name": "noisy-neighbor",
+        "kind": "noisy_neighbor",
+        "seed": 601,
+        "engine": _TINY,
+        "heavy_requests": 32,
+        "light_requests": 4,
+        "load": {"prompt_len": [4, 10], "max_tokens": 8},
+        "invariants": ["exactly_one_terminal", "streams_match_baseline",
+                       "engine_accounting"],
+    },
+    {
+        # a readback delay (armed over REST) burns the itl objective while
+        # the heavy tenant floods a REAL two-tenant stack: the doctor
+        # attributes the burn per tenant and the gateway sheds ONLY the
+        # over-fair-share tenant (429 tenant_shed + Retry-After) while the
+        # light tenant keeps serving baseline-identical text; /readyz
+        # stays 200 (global shedding is the last resort) and the abuser
+        # recovers once the burn drains
+        "name": "selective-shed",
+        "kind": "selective_shed",
+        "seed": 602,
+        "delay_spec": "delay(0.4)",
+        "itl_threshold_ms": 30.0,
+        "heavy_requests": 16,
+    },
     # ---- fabric-doctor (SLO engine + watchdogs + degradation machine) --
     {
         # delay on every decode readback (armed over the guarded REST

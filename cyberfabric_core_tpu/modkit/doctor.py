@@ -133,6 +133,24 @@ class DoctorConfig:
     stream_stall_s: float = 30.0
     queue_deadline_s: float = 60.0
     watchdog_cooldown_s: float = 10.0
+    # tenant-selective shedding: while an evaluation is bad, tenants whose
+    # recent token rate (or pending-queue share) exceeds ``over_share`` ×
+    # their weighted fair share are shed FIRST — the gateway 429s only
+    # them; global shedding (the state machine reaching ``shedding``)
+    # stays the last resort. Needs ≥ 2 active tenants and at least
+    # ``tenant_min_activity`` tokens/requests of recent activity to
+    # attribute — below that, blame is noise.
+    tenant_shed_enabled: bool = True
+    tenant_over_share: float = 2.0
+    tenant_shed_retry_after_s: float = 2.0
+    tenant_min_activity: int = 32
+    #: how long a shed mark outlives the pass that last found the tenant
+    #: over-share WHILE the burn continues. Being shed suppresses the very
+    #: activity that made a tenant "over", so requiring over-share every
+    #: pass would flap shed→clear→flood→shed; but a mark must not outlive
+    #: its evidence either — a tenant that backs off is exonerated after
+    #: this hold even if the burn persists for unrelated reasons.
+    tenant_shed_hold_s: float = 5.0
     # liveness
     loop_stall_s: float = 10.0
     max_samples: int = 4096         # per-figure sample-deque bound
@@ -323,6 +341,18 @@ class Doctor:
             self._cooldowns: dict[tuple[str, str], float] = {}
             self._last_report: Optional[dict[str, Any]] = None
             self._evals = 0
+            #: tenant-selective shedding state: over-fair-share tenants the
+            #: gateway should 429 first (cleared on a clean evaluation)
+            self._shed_tenants: dict[str, float] = {}
+            self._tenant_prev_charged: dict[str, int] = {}
+            self._tenant_doc: Optional[dict[str, Any]] = None
+            #: tenants whose llm_tenant_shed gauge was last set to 1 — so a
+            #: recovery can push the 0
+            self._shed_gauge_tenants: set = set()
+            #: per-model tenants whose queue-depth gauge was last nonzero —
+            #: a drained tenant vanishes from depths(), so its gauge needs
+            #: an explicit 0 or it sticks at the last backlog forever
+            self._queue_gauge_tenants: dict[str, set] = {}
 
     def attach_recorder(self) -> None:
         """Subscribe to the flight recorder's terminal events (idempotent)."""
@@ -502,6 +532,11 @@ class Doctor:
                 _gauge_set("llm_replicas_benched",
                            "Replicas benched after repeated strikes",
                            float(capacity.get("benched", 0)))
+        # tenant-selective shedding: attribute the burn/queue pressure to
+        # over-fair-share tenants BEFORE the state machine escalates — the
+        # gateway sheds only them while the machine is still degraded, and
+        # global shedding engages only if the burn persists regardless
+        tenant_doc = self._evaluate_tenants(bool(reasons), now)
         with self._lock:
             state = self._machine.step(
                 bool(reasons), reasons, shed_after, cfg.recover_after)
@@ -515,6 +550,7 @@ class Doctor:
                 "watchdog_trips": dict(self._watchdog_trips),
                 "capacity": capacity_doc,
                 "cancellation": cancel_doc,
+                "tenants": tenant_doc,
                 "evals": self._evals,
             }
             self._last_report = report
@@ -568,6 +604,137 @@ class Doctor:
             "burn_slow": round(burn_slow, 3), "samples_fast": n_fast,
             "samples_slow": n_slow, "verdict": verdict,
         }
+
+    # ------------------------------------------------- tenant attribution
+    def _tenant_totals(self) -> dict[str, dict[str, Any]]:
+        """Aggregate per-tenant live figures across the scheduler pool
+        (charged tokens, weight, pending depth, slots). Never raises; the
+        provider and snapshots are public contracts."""
+        provider = self._scheduler_provider
+        if provider is None:
+            return {}
+        try:
+            pairs = list(provider())
+        except Exception:  # noqa: BLE001
+            return {}
+        totals: dict[str, dict[str, Any]] = {}
+        for _name, sched in pairs:
+            snap_fn = getattr(sched, "tenant_snapshot", None)
+            if snap_fn is None:
+                continue
+            try:
+                rows = snap_fn()
+            except Exception:  # noqa: BLE001 — a dying engine
+                continue
+            if not isinstance(rows, dict):
+                continue
+            for tenant, row in rows.items():
+                agg = totals.setdefault(tenant, {
+                    "charged": 0, "weight": 0.0, "pending": 0, "slots": 0})
+                agg["charged"] += int(row.get("charged_tokens", 0))
+                agg["weight"] = max(agg["weight"],
+                                    float(row.get("weight", 1.0)))
+                agg["pending"] += int(row.get("pending", 0))
+                agg["slots"] += int(row.get("active_slots", 0))
+        return totals
+
+    def _evaluate_tenants(self, burning: bool,
+                          now: float) -> Optional[dict[str, Any]]:
+        """Attribute SLO burn / queue pressure per tenant and maintain the
+        selective-shed set. A tenant is OVER-FAIR-SHARE when its recent
+        token rate (charged-token delta since the last pass) or its share
+        of the pending queue exceeds ``tenant_over_share`` × its weighted
+        entitlement while at least one other tenant is active. Marks are
+        refreshed each bad pass the tenant is still over-share and expire
+        after ``tenant_shed_hold_s`` otherwise (being shed suppresses the
+        very activity that made the tenant "over", so a strict per-pass
+        rebuild would flap shed→clear→flood→shed); the whole set clears on
+        a clean evaluation.
+        Non-blocking, never-raises (WD01 — this runs inside evaluate())."""
+        cfg = self.config
+        if not cfg.tenant_shed_enabled:
+            return None
+        totals = self._tenant_totals()
+        if not totals:
+            return None
+        with self._lock:
+            prev = self._tenant_prev_charged
+            deltas = {t: max(0, agg["charged"] - prev.get(t, agg["charged"]))
+                      for t, agg in totals.items()}
+            self._tenant_prev_charged = {
+                t: agg["charged"] for t, agg in totals.items()}
+        sum_delta = sum(deltas.values())
+        sum_weight = sum(agg["weight"] for agg in totals.values()) or 1.0
+        total_pending = sum(agg["pending"] for agg in totals.values())
+        shares: dict[str, dict[str, Any]] = {}
+        over: list[str] = []
+        multi = len(totals) >= 2
+        for tenant, agg in totals.items():
+            fair = agg["weight"] / sum_weight
+            token_share = (deltas[tenant] / sum_delta) if sum_delta else 0.0
+            queue_share = (agg["pending"] / total_pending) \
+                if total_pending else 0.0
+            token_over = (multi and sum_delta >= cfg.tenant_min_activity
+                          and token_share > cfg.tenant_over_share * fair)
+            queue_over = (multi and total_pending >= cfg.tenant_min_activity
+                          and queue_share > cfg.tenant_over_share * fair)
+            if token_over or queue_over:
+                over.append(tenant)
+            shares[tenant] = {
+                "fair_share": round(fair, 3),
+                "token_share": round(token_share, 3),
+                "queue_share": round(queue_share, 3),
+                "charged_tokens": agg["charged"],
+                "pending": agg["pending"],
+                "slots": agg["slots"],
+                "over_share": token_over or queue_over,
+            }
+        with self._lock:
+            if burning:
+                # refresh marks for tenants still over-share; marks not
+                # refreshed expire after the hold window even while the
+                # burn persists — a shed tenant's 429s suppress exactly the
+                # activity that made it "over", so it could otherwise never
+                # be exonerated until the burn fully cleared
+                kept = {t: ts for t, ts in self._shed_tenants.items()
+                        if now - ts < cfg.tenant_shed_hold_s}
+                kept.update({t: now for t in over})
+                self._shed_tenants = kept
+            else:
+                self._shed_tenants = {}
+            shed = sorted(self._shed_tenants)
+        # gauge export: 1 for shed tenants, an explicit 0 for tenants shed
+        # last pass but clear now (a stuck 1 would read as a forever-shed)
+        for tenant in shed:
+            _gauge_set("llm_tenant_shed",
+                       "1 while this tenant is selectively shed", 1.0,
+                       tenant=tenant)
+        for tenant in self._shed_gauge_tenants - set(shed):
+            _gauge_set("llm_tenant_shed",
+                       "1 while this tenant is selectively shed", 0.0,
+                       tenant=tenant)
+        self._shed_gauge_tenants = set(shed)
+        for tenant, row in shares.items():
+            _gauge_set("llm_tenant_token_share",
+                       "Tenant share of recently consumed tokens (0..1)",
+                       row["token_share"], tenant=tenant)
+        return {"shares": shares, "shed": shed,
+                "over_share_factor": cfg.tenant_over_share}
+
+    def tenant_shed_retry_after(self, tenant: str) -> Optional[float]:
+        """Retry-After seconds while ``tenant`` is selectively shed, else
+        None — the llm-gateway admission layer's per-tenant gate (the
+        tenant-scoped twin of :meth:`shed_retry_after`). Never raises."""
+        try:
+            if not self.config.enabled or \
+                    not self.config.tenant_shed_enabled:
+                return None
+            with self._lock:
+                if tenant in self._shed_tenants:
+                    return self.config.tenant_shed_retry_after_s
+        except Exception:  # noqa: BLE001
+            pass
+        return None
 
     # ------------------------------------------------------------ watchdogs
     #
@@ -753,6 +920,28 @@ class Doctor:
             _gauge_set("llm_queue_oldest_age_seconds",
                        "Age of the oldest pending request",
                        float(age or 0.0), model=name)
+            # per-tenant pending depth: saturation is attributable — which
+            # tenant's backlog is aging the queue. Reads the PUBLIC
+            # tenant_snapshot() (the same surface _tenant_totals uses);
+            # tenants seen last pass but drained now get an explicit 0 so
+            # the gauge cannot stick at a stale backlog.
+            snap_fn = getattr(sched, "tenant_snapshot", None)
+            try:
+                rows = snap_fn() if snap_fn is not None else {}
+            except Exception:  # noqa: BLE001
+                rows = {}
+            per_tenant = {t: int(row.get("pending", 0))
+                          for t, row in rows.items()} \
+                if isinstance(rows, dict) else {}
+            seen = self._queue_gauge_tenants.get(name, set())
+            for tenant in seen - set(per_tenant):
+                per_tenant[tenant] = 0
+            for tenant, n in per_tenant.items():
+                _gauge_set("llm_tenant_queue_depth",
+                           "Pending scheduler queue depth per tenant",
+                           float(n), model=name, tenant=tenant)
+            self._queue_gauge_tenants[name] = {
+                t for t, n in per_tenant.items() if n > 0}
 
     # ------------------------------------------------------------- surfaces
     @property
@@ -824,6 +1013,7 @@ class Doctor:
                 "consecutive_clean": machine.consecutive_clean,
                 "state_history": list(machine.history),
                 "watchdog_trips": dict(self._watchdog_trips),
+                "shed_tenants": sorted(self._shed_tenants),
                 "evals": self._evals,
                 "config": {
                     "eval_interval_s": self.config.eval_interval_s,

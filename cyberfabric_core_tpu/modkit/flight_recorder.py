@@ -100,7 +100,8 @@ class RequestRecord:
 
     __slots__ = ("request_id", "trace_id", "created_at", "phase", "slot",
                  "tokens", "prompt_tokens", "events", "_dropped",
-                 "finished_at", "model", "stalled", "last_event_at")
+                 "finished_at", "model", "tenant", "stalled",
+                 "last_event_at")
 
     def __init__(self, request_id: str) -> None:
         self.request_id = request_id
@@ -114,6 +115,10 @@ class RequestRecord:
         self._dropped = 0  # mid-timeline events dropped by the per-record cap
         self.finished_at: Optional[float] = None
         self.model: Optional[str] = None  # set by annotate() at the worker
+        #: owning tenant: stamped by the scheduler's ``enqueued`` event (or
+        #: annotate()) — per-tenant dashboards and the doctor's selective-
+        #: shedding attribution read this column
+        self.tenant: Optional[str] = None
         self.stalled = False  # a stall watchdog flagged this stream
         self.last_event_at = self.created_at
 
@@ -159,6 +164,7 @@ class RequestRecord:
             "request_id": self.request_id,
             "trace_id": self.trace_id,
             "model": self.model,
+            "tenant": self.tenant,
             "phase": self.phase,
             "slot": self.slot,
             "age_s": round(now - self.created_at, 3),
@@ -259,6 +265,8 @@ class FlightRecorder:
                 rec.trace_id = attrs["trace_id"]
             if "prompt_tokens" in attrs:
                 rec.prompt_tokens = int(attrs["prompt_tokens"])
+            if attrs.get("tenant"):
+                rec.tenant = attrs["tenant"]
             if kind in ("prefill", "first_token"):
                 rec.tokens += 1
             elif kind == "decode_chunk":
@@ -274,7 +282,8 @@ class FlightRecorder:
                 if self._listeners:
                     payload = {
                         "request_id": rec.request_id, "kind": kind,
-                        "model": rec.model, "tokens": rec.tokens,
+                        "model": rec.model, "tenant": rec.tenant,
+                        "tokens": rec.tokens,
                         "prompt_tokens": rec.prompt_tokens,
                         "derived": derived,
                     }
@@ -349,19 +358,22 @@ class FlightRecorder:
         except ValueError:
             pass
 
-    def annotate(self, request_id: str, model: Optional[str] = None) -> None:
+    def annotate(self, request_id: str, model: Optional[str] = None,
+                 tenant: Optional[str] = None) -> None:
         """Set denormalized columns on an EXISTING record (live or recently
         finished) without appending an event. The worker stamps the model
-        here after submit — the scheduler, which emits the lifecycle
-        events, does not know which model entry owns it. A miss is a no-op:
-        annotation must never create a record the scheduler will not
-        close."""
+        (and, for external-provider paths, the tenant) here after submit —
+        the scheduler, which emits the lifecycle events, does not know
+        which model entry owns it. A miss is a no-op: annotation must never
+        create a record the scheduler will not close."""
         with self._lock:
             rec = self._live.get(request_id) or self._finished.get(request_id)
             if rec is None:
                 return
             if model is not None:
                 rec.model = model
+            if tenant is not None:
+                rec.tenant = tenant
 
     # --------------------------------------------------------------- reads
     def is_live(self, request_id: str) -> bool:
@@ -422,10 +434,11 @@ def record_event(request_id: str, kind: str, **attrs: Any) -> None:
         pass
 
 
-def annotate_request(request_id: str, model: Optional[str] = None) -> None:
+def annotate_request(request_id: str, model: Optional[str] = None,
+                     tenant: Optional[str] = None) -> None:
     """Never-raises :meth:`FlightRecorder.annotate` on the default recorder
-    (the worker's model stamp sits on the serving path)."""
+    (the worker's model/tenant stamp sits on the serving path)."""
     try:
-        default_recorder.annotate(request_id, model=model)
+        default_recorder.annotate(request_id, model=model, tenant=tenant)
     except Exception:  # noqa: BLE001
         pass

@@ -43,20 +43,54 @@ from .worker import LocalTpuWorker
 
 
 class UsageTracker:
-    """Per-tenant token accounting + budget check hook (DESIGN.md:820-855)."""
+    """Per-tenant token accounting + budget check hook (DESIGN.md:820-855).
 
-    def __init__(self, budgets: Optional[dict[str, int]] = None) -> None:
+    The budget check reads TWO ledgers and takes the max: the gateway-side
+    usage reports (stream-end accounting, the only ledger external
+    providers have) and the scheduler-side live counters
+    (``LlmWorkerApi.tenant_usage`` — prefill + decode tokens actually
+    consumed, charged mid-stream). One source of truth: a tenant cannot
+    dodge its budget by holding streams open (the report lands at stream
+    end) or by hammering cached prefixes (the scheduler charges only real
+    compute)."""
+
+    def __init__(self, budgets: Optional[dict[str, int]] = None,
+                 retry_after_s: float = 60.0) -> None:
         self._usage: dict[str, dict[str, int]] = {}
         self._budgets = budgets or {}
+        self._retry_after_s = retry_after_s
+        #: scheduler-side live accounting source (the worker's
+        #: ``tenant_usage``), attached by the module once the worker exists
+        self._live_source = None
+
+    def attach_live_source(self, fn) -> None:
+        """``fn() -> {tenant: {"charged_tokens": n, ...}}`` — the
+        scheduler-side accounting the budget check folds in."""
+        self._live_source = fn
+
+    def _live_tokens(self, tenant_id: str) -> int:
+        if self._live_source is None:
+            return 0
+        try:
+            return int((self._live_source().get(tenant_id) or {})
+                       .get("charged_tokens", 0))
+        except Exception:  # noqa: BLE001 — accounting must not fail serving
+            return 0
 
     def check_budget(self, ctx: SecurityContext) -> None:
         budget = self._budgets.get(ctx.tenant_id)
         if budget is None:
             return
-        used = self._usage.get(ctx.tenant_id, {}).get("total_tokens", 0)
+        reported = self._usage.get(ctx.tenant_id, {}).get("total_tokens", 0)
+        used = max(reported, self._live_tokens(ctx.tenant_id))
         if used >= budget:
+            from ...modkit.metrics import bump_counter
+
+            bump_counter("llm_tenant_budget_rejections_total",
+                         tenant=ctx.tenant_id)
             raise ERR.llm.budget_exceeded.error(
-                f"tenant token budget {budget} exhausted ({used} used)")
+                f"tenant token budget {budget} exhausted ({used} used)",
+                retry_after_s=self._retry_after_s, tenant=ctx.tenant_id)
 
     def report(self, ctx: SecurityContext, usage: dict[str, int]) -> None:
         entry = self._usage.setdefault(
@@ -281,7 +315,15 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             else:
                 self.worker = LocalTpuWorker(cfg.get("worker", {}))
             ctx.client_hub.register(LlmWorkerApi, self.worker)
-        self.usage = UsageTracker(cfg.get("budgets"))
+        self.usage = UsageTracker(
+            cfg.get("budgets"),
+            retry_after_s=float(cfg.get("budget_retry_after_s", 60.0)))
+        # budget checks fold in the scheduler-side live token counters —
+        # the gateway hook and the engine accounting read one truth
+        worker_ref = self.worker
+        self.usage.attach_live_source(
+            lambda: worker_ref.tenant_usage()
+            if hasattr(worker_ref, "tenant_usage") else {})
         self.ttft_timeout_s = float(cfg.get("ttft_timeout_s", 120.0))
         self.total_timeout_s = float(cfg.get("total_timeout_s", 600.0))
         #: default per-request TTL (ms) threaded into the scheduler as a
@@ -563,24 +605,39 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             self._doctor = self._hub.try_get(DoctorApi)
         return getattr(self, "_doctor", None)
 
-    def _check_load_shed(self) -> None:
-        """fabric-doctor admission gate: while the degradation state machine
-        is ``shedding``, reject BEFORE enqueue with 429 + Retry-After (the
-        scheduler_saturated problem-response path renders the header from
-        ``retry_after_s``). Pre-enqueue is the point: streams already in
-        flight keep decoding untouched; only NEW work is turned away while
-        the burn subsides."""
+    def _check_load_shed(self, ctx: Optional[SecurityContext] = None) -> None:
+        """fabric-doctor admission gate, tenant-selective first. While the
+        doctor attributes SLO burn / queue pressure to an over-fair-share
+        tenant, only THAT tenant's new requests are rejected (429 +
+        Retry-After, ``llm.tenant_shed``) — compliant tenants keep
+        streaming. Global shedding (the degradation state machine reaching
+        ``shedding``) remains the last resort and rejects everyone
+        (``llm.load_shed``). Pre-enqueue is the point: streams already in
+        flight keep decoding untouched."""
         doctor = self._get_doctor()
-        retry_after = doctor.shed_retry_after() if doctor is not None else None
+        if doctor is None:
+            return
+        retry_after = doctor.shed_retry_after()
         if retry_after is not None:
             raise ERR.llm.load_shed.error(
                 "serving is load-shedding (SLO burn/stall watchdogs); "
                 "retry later", retry_after_s=retry_after, state="shedding")
+        if ctx is None:
+            return
+        tenant_gate = getattr(doctor, "tenant_shed_retry_after", None)
+        tenant_retry = (tenant_gate(ctx.tenant_id)
+                        if tenant_gate is not None else None)
+        if tenant_retry is not None:
+            raise ERR.llm.tenant_shed.error(
+                f"tenant {ctx.tenant_id!r} is consuming over its fair "
+                "share while serving burns SLO budget; this tenant's new "
+                "requests are shed first (compliant tenants keep serving)",
+                retry_after_s=tenant_retry, tenant=ctx.tenant_id)
 
     async def handle_chat(self, request: web.Request):
         body = await read_json(request, schemas.REQUEST)
         ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
-        self._check_load_shed()
+        self._check_load_shed(ctx)
         self.usage.check_budget(ctx)
         # pre_call hook: allow / block / override (DESIGN.md:743-766)
         hook = self._hub.try_get(LlmHookApi)
@@ -602,7 +659,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
 
             body["_resolved_tools"] = await normalize_tools(
                 ctx, body["tools"], self._hub.try_get(TypesRegistryApi))
-        self._inject_observability(request, body)
+        self._inject_observability(request, body, ctx)
         self._inject_deadline(request, body)
         models = await self._resolve_with_fallback(ctx, body)
 
@@ -620,7 +677,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         chat path's budget/fallback/timeout/SSE machinery."""
         body = await read_json(request, schemas.COMPLETION_REQUEST)
         ctx: SecurityContext = request[SECURITY_CONTEXT_KEY]
-        self._check_load_shed()
+        self._check_load_shed(ctx)
         self.usage.check_budget(ctx)
         # same pre_call policy hook as chat (DESIGN.md:743-766) — a raw
         # prompt must not bypass content moderation
@@ -634,7 +691,7 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             if action == "override":
                 body = verdict["body"]
                 validate_against(schemas.COMPLETION_REQUEST, body)
-        self._inject_observability(request, body)
+        self._inject_observability(request, body, ctx)
         self._inject_deadline(request, body)
         models = await self._resolve_with_fallback(ctx, body)
         if body.get("stream"):
@@ -643,12 +700,16 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         return await self._sync_response(ctx, body, models, mode="completion")
 
     @staticmethod
-    def _inject_observability(request: web.Request, body: dict) -> None:
-        """Thread the gateway's X-Request-Id and the live HTTP span's
-        traceparent into the worker params (underscore keys ride beside
-        ``_resolved_tools``): the engine keys its flight-recorder timeline by
-        the id the client already holds, and scheduler spans join the HTTP
-        trace — one OTLP trace from socket to tokens."""
+    def _inject_observability(request: web.Request, body: dict,
+                              ctx: Optional[SecurityContext] = None) -> None:
+        """Thread the gateway's X-Request-Id, the live HTTP span's
+        traceparent, and the authenticated tenant into the worker params
+        (underscore keys ride beside ``_resolved_tools``): the engine keys
+        its flight-recorder timeline by the id the client already holds,
+        scheduler spans join the HTTP trace — one OTLP trace from socket to
+        tokens — and ``_tenant_id`` makes tenancy a first-class scheduling
+        dimension (weighted-fair queues, per-tenant caps, selective
+        shedding)."""
         from ...modkit.telemetry import Tracer
 
         rid = request.get("request_id")
@@ -659,6 +720,10 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             body["_traceparent"] = span.traceparent()
         elif request.headers.get("traceparent"):
             body["_traceparent"] = request.headers["traceparent"]
+        if ctx is not None:
+            # the AUTHENTICATED identity, never a client-controlled header:
+            # the worker trusts this value to key fair-queue accounting
+            body["_tenant_id"] = ctx.tenant_id
 
     def _inject_deadline(self, request: web.Request, body: dict) -> None:
         """Per-request deadline: the ``X-Request-Deadline-Ms`` header (the
@@ -1083,13 +1148,14 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             event_id = frame.get("id") or f"rt-{uuid.uuid4().hex[:12]}"
             try:
                 validate_against(schemas.REQUEST, body)
-                self._check_load_shed()
+                self._check_load_shed(ctx)
                 self.usage.check_budget(ctx)
                 # WS frames carry no per-request header; the config default
                 # TTL still bounds each chat.create end-to-end (a vanished
                 # WS peer's frame cannot decode to max_tokens forever)
                 if self.default_deadline_ms > 0:
                     body.setdefault("_deadline_ms", self.default_deadline_ms)
+                body.setdefault("_tenant_id", ctx.tenant_id)
                 models = await self._resolve_with_fallback(ctx, body)
                 _, model = models[0]
                 reply_parts: list[str] = []
@@ -1208,7 +1274,19 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
 
     async def handle_usage(self, request: web.Request):
         ctx = request[SECURITY_CONTEXT_KEY]
-        return {"tenant_id": ctx.tenant_id, "usage": self.usage.snapshot(ctx)}
+        out = {"tenant_id": ctx.tenant_id, "usage": self.usage.snapshot(ctx)}
+        # the scheduler-side live ledger (the budget hook's second source
+        # of truth): tokens actually consumed, including still-open streams
+        try:
+            engine_row = self.worker.tenant_usage().get(ctx.tenant_id) \
+                if hasattr(self.worker, "tenant_usage") else None
+        except Exception:  # noqa: BLE001 — accounting must not fail the view
+            engine_row = None
+        if engine_row is not None:
+            out["engine"] = {k: engine_row[k] for k in
+                            ("charged_tokens", "active_slots", "pages",
+                             "pending") if k in engine_row}
+        return out
 
     @staticmethod
     def _cost(model: ModelInfo, usage: dict[str, int]) -> Optional[float]:

@@ -28,7 +28,8 @@ from ...modkit.errors import ProblemError
 from ...modkit.failpoints import failpoint_async
 from ...modkit.logging_host import observe_task
 from ...runtime.engine import (EngineConfig, InferenceEngine, SamplingParams,
-                               SchedulerSaturated, StepEvent)
+                               SchedulerSaturated, StepEvent,
+                               TenantQuotaExceeded, TenantSaturated)
 from ...runtime.lifecycle import (EngineSupervisor, LifecycleConfig,
                                   LifecycleStateError, ReplicaUnavailable)
 from ...runtime.replicas import DataParallelServingPool
@@ -332,6 +333,22 @@ class LocalTpuWorker(LlmWorkerApi):
             # admission backpressure bound (faultlab satellite): overflow
             # surfaces as 429 + Retry-After instead of unbounded queueing
             max_pending=int(opts.pop("max_pending", 2048)),
+            # tenant isolation (docs/ARCHITECTURE.md "Tenant isolation &
+            # fairness"): weighted-fair pending queues keyed by the
+            # SecurityContext tenant threaded through the gateway, plus
+            # per-tenant slot/page/pending caps. Registry options can
+            # arrive as strings — parse bool words, not truthiness.
+            tenant_fair=str(opts.pop("tenant_fair", True)
+                            ).strip().lower() not in ("0", "false", "no",
+                                                      "off"),
+            tenant_default_weight=float(
+                opts.pop("tenant_default_weight", 1.0)),
+            tenant_weights={str(k): float(v) for k, v in
+                            (opts.pop("tenant_weights", None) or {}).items()},
+            tenant_max_slots=int(opts.pop("tenant_max_slots", 0)),
+            tenant_soft_pages=int(opts.pop("tenant_soft_pages", 0)),
+            tenant_max_pages=int(opts.pop("tenant_max_pages", 0)),
+            tenant_max_pending=int(opts.pop("tenant_max_pending", 0)),
             speculative=opts.pop("speculative", "off"),
             spec_k=int(opts.pop("spec_k", 8)),
             draft_model=opts.pop("draft_model", ""),
@@ -517,6 +534,11 @@ class LocalTpuWorker(LlmWorkerApi):
                 deadline = time.monotonic() + float(raw_deadline) / 1000.0
             except (TypeError, ValueError):
                 deadline = None
+        #: SecurityContext.tenant_id, threaded from the gateway as
+        #: ``_tenant_id`` (crosses the grpc worker wire free, like
+        #: ``_deadline_ms``): keys the scheduler's weighted-fair queue,
+        #: per-tenant caps, and per-tenant accounting
+        tenant = str(params.get("_tenant_id") or "default")
         cancel_target = None
         if entry.pool is not None or entry.scheduler is not None:
             loop = asyncio.get_running_loop()
@@ -544,7 +566,18 @@ class LocalTpuWorker(LlmWorkerApi):
                     request_id=request_id,
                     trace=trace,
                     deadline=deadline,
+                    tenant=tenant,
                 )
+            except TenantSaturated as e:
+                # the CALLER'S tenant queue is full (its own retry storm) —
+                # a tenant-scoped 429 + Retry-After, distinct from global
+                # saturation so dashboards and clients can tell them apart
+                raise ERR.llm.tenant_saturated.error(
+                    str(e), retry_after_s=e.retry_after_s, tenant=e.tenant)
+            except TenantQuotaExceeded as e:
+                # the request can never fit the tenant's hard KV-page quota
+                raise ERR.llm.tenant_quota_exceeded.error(
+                    str(e), retry_after_s=e.retry_after_s, tenant=e.tenant)
             except SchedulerSaturated as e:
                 # admission backpressure: the pending queue is at
                 # max_pending. 429 + Retry-After (the gateway's problem
@@ -568,7 +601,8 @@ class LocalTpuWorker(LlmWorkerApi):
             # live table's model column read this
             from ...modkit.flight_recorder import annotate_request
 
-            annotate_request(request_id, model=model.canonical_id)
+            annotate_request(request_id, model=model.canonical_id,
+                             tenant=tenant)
         else:
             assert entry.batcher is not None
             await entry.batcher.submit(req)
@@ -942,6 +976,43 @@ class LocalTpuWorker(LlmWorkerApi):
                 else:
                     counts["quarantined"] += 1
         return counts
+
+    # ------------------------------------------------------- tenant census
+    def tenant_usage(self) -> dict[str, dict[str, Any]]:
+        """Aggregated per-tenant live accounting across every continuous
+        scheduler (pool replicas included): charged prefill+decode tokens,
+        occupied slots, held KV pages, pending depth, soft yields, and the
+        per-model breakdown. This is the scheduler-side source of truth the
+        gateway's token-budget hook and ``GET /v1/monitoring/tenants`` both
+        read — the two surfaces can never drift."""
+        out: dict[str, dict[str, Any]] = {}
+        for name, sched in self.schedulers():
+            snap = getattr(sched, "tenant_snapshot", None)
+            if snap is None:
+                continue
+            try:
+                rows = snap()
+            except Exception:  # noqa: BLE001 — a dying engine
+                continue
+            for tenant, row in rows.items():
+                agg = out.setdefault(tenant, {
+                    "tenant": tenant, "charged_tokens": 0,
+                    "active_slots": 0, "pages": 0, "pending": 0,
+                    "soft_yields": 0, "virtual_counter": 0.0,
+                    "rejections": {}, "per_model": {}})
+                agg["charged_tokens"] += row.get("charged_tokens", 0)
+                agg["active_slots"] += row.get("active_slots", 0)
+                agg["pages"] += row.get("pages", 0)
+                agg["pending"] += row.get("pending", 0)
+                agg["soft_yields"] += row.get("soft_yields", 0)
+                agg["virtual_counter"] = round(
+                    agg["virtual_counter"] + row.get("virtual_counter", 0.0),
+                    3)
+                for reason, n in (row.get("rejections") or {}).items():
+                    agg["rejections"][reason] = \
+                        agg["rejections"].get(reason, 0) + n
+                agg["per_model"][name] = row
+        return out
 
     async def health(self) -> dict[str, Any]:
         import jax

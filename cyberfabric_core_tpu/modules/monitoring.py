@@ -172,6 +172,33 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
         # healthy/benched census — pre-registered so dashboards can alert
         # from the first scrape; values are pushed by the lifecycle manager
         # (counter) and the doctor's evaluation pass (gauges)
+        # tenant isolation: rejection/budget counters and the fairness
+        # gauges (token share, per-tenant queue depth, selective-shed flag)
+        # — pre-registered so dashboards can alert from the first scrape;
+        # values are pushed by the scheduler (counters) and the doctor's
+        # evaluation pass (gauges)
+        self.registry.counter(
+            "llm_tenant_rejections_total",
+            "Per-tenant scheduler rejections by reason "
+            "(pending/quota)").inc(0.0)
+        self.registry.counter(
+            "llm_tenant_budget_rejections_total",
+            "Requests rejected at the gateway because the tenant's token "
+            "budget is exhausted").inc(0.0)
+        self.registry.counter(
+            "llm_tenant_soft_yields_total",
+            "Slots preempted to host by the tenant soft page-quota sweep "
+            "under contention").inc(0.0)
+        self.registry.gauge(
+            "llm_tenant_queue_depth",
+            "Pending scheduler queue depth per tenant")
+        self.registry.gauge(
+            "llm_tenant_token_share",
+            "Tenant share of recently consumed tokens (0..1)")
+        self.registry.gauge(
+            "llm_tenant_shed",
+            "1 while this tenant is selectively shed (over fair share "
+            "during SLO burn)")
         self.registry.counter(
             "llm_replica_rebuilds_total",
             "Replica rebuilds by outcome (ok/failed)").inc(0.0)
@@ -652,6 +679,55 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
                      "benched escape hatch); rebuild runs on the "
                      "lifecycle supervisor thread") \
             .handler(restart_replica).register()
+
+        # ---- tenant isolation: the per-tenant live view behind the
+        # weighted-fair scheduler — slots, KV pages, queue depth, virtual
+        # counter, charged tokens, and the doctor's selective-shed state.
+        # The operator's first stop when one tenant's latency spikes: is it
+        # over its fair share, capped, or being shed?
+        def _tenant_rows() -> dict[str, dict]:
+            worker = ctx.client_hub.try_get(LlmWorkerApi)
+            usage = worker.tenant_usage() if worker is not None else {}
+            shed = set()
+            doc = getattr(self, "doctor", None)
+            if doc is not None:
+                try:
+                    shed = set(doc.report().get("shed_tenants", ()))
+                except Exception:  # noqa: BLE001 — view must not 500
+                    shed = set()
+            for tenant, row in usage.items():
+                row["shed"] = tenant in shed
+            return usage
+
+        async def list_tenants(request: web.Request):
+            rows = _tenant_rows()
+            return {
+                "tenants": [rows[t] for t in sorted(rows)],
+                "count": len(rows),
+            }
+
+        async def get_tenant(request: web.Request):
+            tenant_id = request.match_info["tenant_id"]
+            rows = _tenant_rows()
+            row = rows.get(tenant_id)
+            if row is None:
+                raise ERR.monitoring.unknown_tenant.error(
+                    f"no live scheduler state for tenant {tenant_id!r} "
+                    "(it has no pending, active, or previously charged "
+                    "work on this node)")
+            return row
+
+        router.operation("GET", "/v1/monitoring/tenants",
+                         module="monitoring").auth_required() \
+            .summary("Per-tenant live scheduler state: slots, KV pages, "
+                     "queue depth, virtual fairness counter, charged "
+                     "tokens, and selective-shed state") \
+            .handler(list_tenants).register()
+        router.operation("GET", "/v1/monitoring/tenants/{tenant_id}",
+                         module="monitoring").auth_required() \
+            .summary("One tenant's live scheduler state (404 when the "
+                     "tenant holds no state on this node)") \
+            .handler(get_tenant).register()
 
         router.operation("GET", "/v1/monitoring/failpoints",
                          module="monitoring").auth_required() \

@@ -134,6 +134,14 @@ class LlmWorkerApi(abc.ABC):
         never scale shedding thresholds."""
         return {}
 
+    def tenant_usage(self) -> dict[str, dict[str, Any]]:
+        """Per-tenant live accounting aggregated across local schedulers
+        (charged tokens, slots, pages, pending) — the scheduler-side source
+        of truth behind ``GET /v1/monitoring/tenants`` and the gateway's
+        token-budget hook. Default: empty (external-provider workers hold
+        no scheduler-side state)."""
+        return {}
+
 
 class LlmHookApi(abc.ABC):
     """Pre/post interceptors for the llm-gateway (DESIGN.md:743-766): pre_call

@@ -47,6 +47,32 @@ class SchedulerSaturated(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+class TenantSaturated(SchedulerSaturated):
+    """``submit()`` rejected: the CALLER'S tenant is at its own pending-depth
+    bound (``tenant_max_pending``) while the global queue may still have
+    room. Serving layers map this to its own 429 + ``Retry-After`` problem
+    (``llm.tenant_saturated``) so a single tenant's retry storm reads as that
+    tenant's saturation, never as global backpressure punishing everyone."""
+
+    def __init__(self, detail: str, retry_after_s: float = 1.0,
+                 tenant: str = "default") -> None:
+        super().__init__(detail, retry_after_s)
+        self.tenant = tenant
+
+
+class TenantQuotaExceeded(RuntimeError):
+    """``submit()`` rejected: the request cannot be served within its
+    tenant's hard KV-page quota (``tenant_max_pages``) — either the request
+    alone needs more pages than the whole quota, or the tenant already holds
+    the quota. Serving layers map this to ``llm.tenant_quota_exceeded``."""
+
+    def __init__(self, detail: str, tenant: str = "default",
+                 retry_after_s: float = 1.0) -> None:
+        super().__init__(detail)
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
 @dataclass
 class SamplingParams:
     """Per-request decode parameters (llm-gateway request schema surface)."""
@@ -141,6 +167,42 @@ class EngineConfig:
     #: limit (unbounded host memory + unbounded queue latency under a
     #: storm). 0 = unbounded (pre-faultlab behavior).
     max_pending: int = 2048
+    #: tenant isolation (continuous scheduler): when True the pending queue
+    #: is a set of PER-TENANT FIFO queues drained by token-weighted fair
+    #: scheduling — each tenant carries a virtual token counter (VTC)
+    #: charged with the prefill + decode tokens it actually consumed, and
+    #: admission always serves the backlogged tenant with the smallest
+    #: weighted counter (FIFO preserved *within* a tenant). False restores
+    #: the tenant-blind global FIFO (the A/B baseline for
+    #: ``bench.py --fairness-guard``). Fairness reorders ADMISSION only —
+    #: tokens within a stream are byte-identical either way.
+    tenant_fair: bool = True
+    #: weight of any tenant not named in ``tenant_weights`` (the default
+    #: class). A tenant with weight 2 is entitled to twice the token share
+    #: of a weight-1 tenant while both are backlogged.
+    tenant_default_weight: float = 1.0
+    #: per-tenant weight overrides, ``{tenant_id: weight}``
+    tenant_weights: Optional[dict] = None
+    #: per-tenant cap on concurrently OCCUPIED slots (decode + chunked
+    #: prefill); a tenant at its cap is skipped by admission until one of
+    #: its slots frees — its requests stay queued, nobody else waits behind
+    #: them. 0 = uncapped.
+    tenant_max_slots: int = 0
+    #: per-tenant SOFT cap on held KV pages: exceeding it only matters under
+    #: contention (another tenant backlogged / requests suspended), where
+    #: the round-boundary cap sweep YIELDS the over-cap tenant's youngest
+    #: slot via the existing preempt-to-host path. 0 = uncapped.
+    tenant_soft_pages: int = 0
+    #: per-tenant HARD cap on held KV pages: a submit whose worst-case page
+    #: need can never fit the quota is rejected outright
+    #: (:class:`TenantQuotaExceeded` → 429), and admission skips a tenant
+    #: already holding its quota. 0 = uncapped.
+    tenant_max_pages: int = 0
+    #: per-tenant bound on PENDING (not-yet-admitted) requests: overflow
+    #: raises :class:`TenantSaturated` (its own 429 + Retry-After) so one
+    #: tenant's retry storm saturates that tenant, not the global queue.
+    #: 0 = unbounded (the global ``max_pending`` still applies).
+    tenant_max_pending: int = 0
 
     def resolve_lookahead_depth(self) -> int:
         """Lookahead ring depth as an int ≥ 0. Legacy bool configs parse as

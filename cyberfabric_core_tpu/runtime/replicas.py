@@ -63,6 +63,9 @@ class _Tracked:
     #: resubmission inherits the original budget (and is skipped entirely
     #: when the budget is already gone)
     deadline: Optional[float] = None
+    #: owning tenant, carried across failover so the surviving replica's
+    #: fair queue charges the same tenant (None = engine default)
+    tenant: Optional[str] = None
 
 
 class DataParallelServingPool:
@@ -226,13 +229,15 @@ class DataParallelServingPool:
         request_id: Optional[str] = None,
         trace: Optional[str] = None,
         deadline: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> str:
         # armed raise rejects the request before any replica sees it (the
         # faultlab pool scenario asserts no tracking record leaks)
         failpoint("replicas.submit")
         idx = self._pick(prompt_ids)
         tracked = _Tracked(list(prompt_ids), sampling, emit, [], idx,
-                           self.max_retries, trace=trace, deadline=deadline)
+                           self.max_retries, trace=trace, deadline=deadline,
+                           tenant=tenant)
         rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
         # register BEFORE submitting: the scheduler thread may finish the
         # request (and fire the tracking-record cleanup) before this thread
@@ -253,11 +258,14 @@ class DataParallelServingPool:
 
     @staticmethod
     def _submit_extras(tracked: _Tracked) -> dict[str, Any]:
-        """trace/deadline kwargs for an engine submit; the deadline key is
-        omitted when unset so pre-deadline engine doubles keep working."""
+        """trace/deadline/tenant kwargs for an engine submit; the deadline
+        and tenant keys are omitted when unset so pre-deadline/pre-tenancy
+        engine doubles keep working."""
         extras: dict[str, Any] = {"trace": tracked.trace}
         if tracked.deadline is not None:
             extras["deadline"] = tracked.deadline
+        if tracked.tenant is not None:
+            extras["tenant"] = tracked.tenant
         return extras
 
     def cancel(self, request_id: str, reason: str = "cancelled") -> bool:

@@ -53,6 +53,19 @@ instead of alternating.
   stop-ids/limits live on device and only CHANGED rows are patched at
   admission/finish/preempt/resume; the page table patches changed rows
   instead of re-uploading. This holds for the dense (non-paged) rounds too.
+- Tenant isolation: the pending queue is PER-TENANT FIFO deques drained by
+  token-weighted fair scheduling (``TenantFairQueue`` — a VTC-style virtual
+  counter per tenant, charged with the prefill + decode tokens actually
+  consumed; the backlogged tenant with the smallest weighted counter wins
+  admission). Per-tenant caps are enforced at round boundaries: a tenant at
+  ``tenant_max_slots`` or holding its ``tenant_max_pages`` hard quota is
+  skipped by admission (its requests stay queued, nobody waits behind
+  them); ``tenant_soft_pages`` overshoot under contention marks the
+  tenant's youngest slot for a preempt-to-host yield (the sweep is pure
+  bookkeeping — the device work runs in the capacity pass, where
+  preemption already lives); ``tenant_max_pending`` overflow raises its own
+  429. Fairness reorders ADMISSION only — per-request token streams are
+  byte-identical to the tenant-blind scheduler.
 - End-to-end cancellation & deadlines: ``cancel(request_id, reason)`` is
   thread-safe and applied at the next round boundary in EVERY phase
   (pending-queue removal pre-admit, mid-chunked-prefill abort, mid-decode
@@ -78,7 +91,6 @@ chunk.
 from __future__ import annotations
 
 import logging
-import queue as _queue
 import threading
 import time
 import uuid
@@ -99,7 +111,8 @@ from ..modkit.telemetry import (get_global_tracer, reset_log_context,
 from ..ops.rope import rope_frequencies
 from ..ops.sampling import sample_token, sample_token_per_slot, split_keys_per_slot
 from .engine import (EngineConfig, SamplingParams, SchedulerSaturated,
-                     StepEvent, build_decode_chunk_fn)
+                     StepEvent, TenantQuotaExceeded, TenantSaturated,
+                     build_decode_chunk_fn)
 
 logger = logging.getLogger("scheduler")
 
@@ -144,6 +157,10 @@ class _SlotState:
     #: a dead SSE consumer or a blown client budget stops burning decode
     #: rounds instead of running to max_tokens
     deadline: Optional[float] = None
+    #: owning tenant (SecurityContext.tenant_id threaded through the
+    #: gateway/worker): decode tokens are charged to its virtual counter,
+    #: per-tenant caps count this slot, and the cap sweep can yield it
+    tenant: str = "default"
 
 
 @dataclass
@@ -161,6 +178,9 @@ class _Pending:
     #: deadline passes — or whose remaining budget cannot even cover the
     #: estimated prefill — lapses in the queue and NEVER occupies a slot
     deadline: Optional[float] = None
+    #: owning tenant: FIFO within this tenant's queue, weighted-fair across
+    #: tenants (TenantFairQueue)
+    tenant: str = "default"
 
 
 @dataclass
@@ -174,6 +194,13 @@ class _Suspended:
     length: int  # decode: valid kv length; prefill phase: prefill_pos
     last_token: int  # meaningless for a prefill-phase suspend (no sample yet)
     slot_key: Any  # per-slot RNG key (None for prefill phase: key untouched)
+    #: True when the preemption was a tenant soft-quota YIELD (not pool
+    #: pressure): resume defers this record while another tenant still has
+    #: pending work — restoring it immediately would hand the freed slot
+    #: straight back to the over-quota tenant (suspended requests outrank
+    #: admissions) and preempt/restore-thrash without ever serving the
+    #: starved tenant
+    soft_yielded: bool = False
     suspended_at: float = field(default_factory=time.monotonic)
     #: wall-clock twin of suspended_at: the llm.preempt span emitted at
     #: resume is backdated to this (OTLP timestamps are unix-epoch ns)
@@ -204,6 +231,176 @@ class _InflightChunk:
     #                       (chained dispatches reuse it; NEVER committed —
     #                       host finish deactivations must not be undone)
     epoch: int
+
+
+class TenantFairQueue:
+    """Per-tenant FIFO pending queues drained by token-weighted fair
+    scheduling (a VTC-style virtual counter per tenant).
+
+    Every tenant owns one FIFO deque; :meth:`pop_fair` serves the backlogged
+    tenant with the smallest *virtual counter* — a cumulative count of the
+    prefill + decode tokens the tenant actually consumed, divided by its
+    configured weight (:meth:`charge`). A tenant that has consumed little
+    relative to its entitlement therefore wins admission, which is exactly
+    what bounds a light tenant's queue wait under a heavy tenant's flood;
+    order *within* a tenant stays strictly FIFO, so single-tenant
+    deployments see the exact pre-tenancy admission order.
+
+    New-backlog lift: when a tenant goes from idle to backlogged, its
+    counter is lifted to the minimum counter among currently backlogged
+    tenants — an idle tenant cannot bank credit and then monopolize the
+    engine with a burst (the standard VTC refresh rule).
+
+    ``fair=False`` degrades to one global FIFO (the tenant-blind baseline
+    the ``bench.py --fairness-guard`` A/B pins against).
+
+    Threading: ``put``/``remove_if``/``drain_all`` may run on any thread
+    (one lock acquire); ``pop_fair`` and ``charge`` run only on the
+    scheduler thread. All methods are non-blocking bookkeeping — dict/deque
+    work, no sleeps, no device syncs (fabric-lint WD01)."""
+
+    def __init__(self, fair: bool = True) -> None:
+        from collections import deque
+
+        self.fair = fair
+        self._lock = threading.Lock()
+        self._queues: dict[str, "deque[_Pending]"] = {}
+        self._count = 0
+        #: virtual counters (charged tokens / weight), never reset — the
+        #: RELATIVE ordering is what matters, and floats hold ~2^53 tokens
+        self._vtc: dict[str, float] = {}
+        #: raw cumulative charged tokens per tenant (stats / doctor
+        #: attribution — the "actual tokens consumed" figure)
+        self._charged: dict[str, int] = {}
+
+    def _key(self, tenant: str) -> str:
+        return tenant if self.fair else "default"
+
+    def put(self, req: "_Pending") -> None:
+        with self._lock:
+            key = self._key(req.tenant)
+            q = self._queues.get(key)
+            if q is None:
+                from collections import deque
+
+                q = self._queues[key] = deque()
+            if not q:
+                # idle → backlogged: lift the counter to the backlogged
+                # minimum so banked idleness cannot become a monopoly
+                backlogged = [self._vtc.get(t, 0.0)
+                              for t, other in self._queues.items()
+                              if other and t != key]
+                floor = min(backlogged) if backlogged else None
+                if floor is not None:
+                    self._vtc[key] = max(self._vtc.get(key, 0.0), floor)
+            q.append(req)
+            self._count += 1
+
+    def put_front(self, req: "_Pending") -> None:
+        """Return a just-popped request to the HEAD of its tenant's queue
+        (the defensive no-free-slot requeue paths) — FIFO order within the
+        tenant is preserved, unlike a tail re-put."""
+        with self._lock:
+            key = self._key(req.tenant)
+            from collections import deque
+
+            self._queues.setdefault(key, deque()).appendleft(req)
+            self._count += 1
+
+    def pop_fair(self, blocked: Optional[set] = None) -> Optional["_Pending"]:
+        """The next request by weighted-fair order: smallest virtual counter
+        among backlogged tenants not in ``blocked`` (tenants at a slot/page
+        cap); ties break on head arrival time, then tenant id, so the order
+        is deterministic. Scheduler thread only."""
+        with self._lock:
+            best_key = None
+            best = (0.0, 0.0, "")
+            for key, q in self._queues.items():
+                if not q or (blocked and key in blocked):
+                    continue
+                cand = (self._vtc.get(key, 0.0), q[0].enqueued_at, key)
+                if best_key is None or cand < best:
+                    best_key, best = key, cand
+            if best_key is None:
+                return None
+            self._count -= 1
+            return self._queues[best_key].popleft()
+
+    def charge(self, tenant: str, tokens: int, weight: float) -> None:
+        """Charge ``tokens`` consumed tokens to ``tenant`` at ``weight``
+        (scheduler thread; one uncontended lock acquire + dict math —
+        WD01-shaped, and the fairness-guard A/B holds it under the 1%
+        bar)."""
+        if tokens <= 0:
+            return
+        key = self._key(tenant)
+        with self._lock:
+            self._vtc[key] = (self._vtc.get(key, 0.0)
+                              + tokens / max(weight, 1e-9))
+            self._charged[key] = self._charged.get(key, 0) + tokens
+
+    # ------------------------------------------------------------ reads
+    def qsize(self) -> int:
+        return self._count
+
+    def empty(self) -> bool:
+        return self._count == 0
+
+    def tenant_depth(self, tenant: str) -> int:
+        with self._lock:
+            q = self._queues.get(self._key(tenant))
+            return len(q) if q else 0
+
+    def depths(self) -> dict[str, int]:
+        with self._lock:
+            return {t: len(q) for t, q in self._queues.items() if q}
+
+    def snapshot(self) -> list["_Pending"]:
+        """Advisory copy of every pending request (cancel/expiry scans)."""
+        with self._lock:
+            return [req for q in self._queues.values() for req in q]
+
+    def oldest_age(self) -> Optional[float]:
+        """Age of the oldest pending request across all tenants (the
+        doctor's queue-age watchdog input)."""
+        with self._lock:
+            heads = [q[0].enqueued_at for q in self._queues.values() if q]
+        if not heads:
+            return None
+        return time.monotonic() - min(heads)
+
+    def remove_if(self, pred) -> list["_Pending"]:
+        """Remove-and-return every pending request matching ``pred``; FIFO
+        order of survivors is untouched (no drain-and-requeue)."""
+        removed: list["_Pending"] = []
+        with self._lock:
+            for key, q in self._queues.items():
+                if not q or not any(pred(r) for r in q):
+                    continue
+                kept = [r for r in q if not pred(r)]
+                removed.extend(r for r in q if pred(r))
+                q.clear()
+                q.extend(kept)
+            self._count -= len(removed)
+        return removed
+
+    def drain_all(self) -> list["_Pending"]:
+        """Pop everything (teardown); callers emit terminals outside any
+        engine lock."""
+        with self._lock:
+            out = [req for q in self._queues.values() for req in q]
+            for q in self._queues.values():
+                q.clear()
+            self._count = 0
+        return out
+
+    def vtc_snapshot(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._vtc)
+
+    def charged_snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._charged)
 
 
 class ContinuousBatchingEngine:
@@ -325,7 +522,39 @@ class ContinuousBatchingEngine:
 
         from collections import deque as _deque
 
-        self._pending: _queue.Queue[_Pending] = _queue.Queue()
+        #: tenant-aware pending queue: per-tenant FIFO deques drained by
+        #: token-weighted fair scheduling (VTC). tenant_fair=False degrades
+        #: to one global FIFO — the tenant-blind A/B baseline.
+        self._pending = TenantFairQueue(fair=config.tenant_fair)
+        self._tenant_weights: dict[str, float] = dict(
+            config.tenant_weights or {})
+        #: True when ANY per-tenant cap is configured AND the queue is
+        #: tenant-fair — the round-boundary cap sweep short-circuits on
+        #: this one bool otherwise. The tenant-blind queue collapses every
+        #: tenant onto one key, so caps could not be attributed: enforcing
+        #: them would either skip nobody (blocked-set keys never match) or
+        #: read a tenant's own backlog as contention — disarm loudly
+        #: instead of enforcing wrongly.
+        caps_configured = bool(
+            config.tenant_max_slots or config.tenant_soft_pages
+            or config.tenant_max_pages or config.tenant_max_pending)
+        self._tenant_caps_armed = caps_configured and config.tenant_fair
+        if caps_configured and not config.tenant_fair:
+            logger.warning(
+                "per-tenant caps configured with tenant_fair=False; caps "
+                "are DISARMED (the tenant-blind queue cannot attribute "
+                "work to tenants)")
+        #: slots the cap sweep marked for a soft-quota yield; consumed by
+        #: the next capacity pass (where preemption device work already
+        #: lives) — the sweep itself stays pure bookkeeping
+        self._soft_yield: set[int] = set()
+        #: per-tenant rejection counters by reason (pending/quota) + yields
+        self.tenant_rejections: dict[str, dict[str, int]] = {}
+        self.tenant_soft_yields: dict[str, int] = {}
+        #: admission throughput observations (ts, requests_admitted) — the
+        #: saturation 429's Retry-After derives from the observed drain
+        #: rate instead of a constant
+        self._admit_events: "_deque[tuple[float, int]]" = _deque(maxlen=256)
         #: serializes submit()'s bound check-and-put (many gateway threads)
         self._submit_lock = threading.Lock()
         #: end-to-end cancellation: request ids a client/gateway asked to
@@ -633,6 +862,7 @@ class ContinuousBatchingEngine:
         request_id: Optional[str] = None,
         trace: Optional[str] = None,
         deadline: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> str:
         """Enqueue a request; ``emit`` receives StepEvents from the scheduler
         thread (request_index is unused here — events are per-request already).
@@ -641,9 +871,29 @@ class ContinuousBatchingEngine:
         ``deadline`` is an absolute ``time.monotonic()`` instant: once passed
         the request lapses with a ``deadline`` terminal wherever it is —
         still queued (never admitted), mid-chunked-prefill, mid-decode, or
-        suspended — via the per-round expiry sweep."""
+        suspended — via the per-round expiry sweep.
+        ``tenant`` is the caller's SecurityContext.tenant_id (None → the
+        default class): it keys the weighted-fair pending queue, the
+        per-tenant caps, and the per-tenant accounting."""
         rid = request_id or f"req-{uuid.uuid4().hex[:16]}"
+        tenant = tenant or "default"
         self._bucket_for(len(prompt_ids))  # validate early, in caller context
+        if self.paged and self._tenant_caps_armed \
+                and self.config.tenant_max_pages > 0:
+            # hard page quota, checked against the request's WORST-CASE need
+            # (full prompt + max_tokens): a request that can never fit the
+            # tenant's quota must be rejected now, not admitted into a
+            # preempt/resume livelock against its own cap
+            need = self.pool.pages_for(
+                min(len(prompt_ids) + sampling.max_tokens,
+                    self.config.max_seq_len))
+            if need > self.config.tenant_max_pages:
+                self._bump_tenant_rejection(tenant, "quota")
+                raise TenantQuotaExceeded(
+                    f"request needs {need} KV pages > tenant hard quota "
+                    f"{self.config.tenant_max_pages} (prompt "
+                    f"{len(prompt_ids)} + max_tokens {sampling.max_tokens})",
+                    tenant=tenant)
         if not self.paged and sampling.seed is not None:
             # dense mode shares ONE key stream across the whole batch — a
             # per-request seed cannot be honored there (the paged default
@@ -680,14 +930,33 @@ class ContinuousBatchingEngine:
             # not overshoot the bound between qsize() and put() (the
             # scheduler-side requeue paths bypass the bound by design —
             # those requests were already admitted once)
+            if self._tenant_caps_armed and self.config.tenant_max_pending \
+                    and self._pending.tenant_depth(tenant) >= \
+                    self.config.tenant_max_pending:
+                # the TENANT's own queue is full: its retry storm saturates
+                # itself — the global queue (and every other tenant) keeps
+                # admitting. Retry-After scales with the tenant's backlog.
+                self.rejected_saturated += 1
+                self._bump_tenant_rejection(tenant, "pending")
+                raise TenantSaturated(
+                    f"tenant {tenant!r} pending queue full "
+                    f"({self.config.tenant_max_pending} requests); "
+                    "retry later",
+                    retry_after_s=self._saturation_retry_after(
+                        self._pending.tenant_depth(tenant)),
+                    tenant=tenant)
             if self.config.max_pending and \
                     self._pending.qsize() >= self.config.max_pending:
                 # backpressure at admission: reject NOW (callers map this to
-                # 429 + Retry-After) instead of growing the queue unbounded
+                # 429 + Retry-After) instead of growing the queue unbounded.
+                # Retry-After derives from the observed drain rate — a
+                # nearly-draining queue says "1s", a wedged one says "30s".
                 self.rejected_saturated += 1
                 raise SchedulerSaturated(
                     f"pending queue full ({self.config.max_pending} "
-                    "requests); retry later")
+                    "requests); retry later",
+                    retry_after_s=self._saturation_retry_after(
+                        self._pending.qsize()))
             # recorded BEFORE the put: once the request is visible to the
             # scheduler thread it can be admitted (and even finished)
             # immediately — a late 'enqueued' would arrive out of order and
@@ -698,9 +967,11 @@ class ContinuousBatchingEngine:
                 extra["deadline_ms"] = round(
                     (deadline - time.monotonic()) * 1000.0, 1)
             record_event(rid, "enqueued", prompt_tokens=len(prompt_ids),
-                         trace_id=traceparent_ids(trace)[0], **extra)
+                         trace_id=traceparent_ids(trace)[0], tenant=tenant,
+                         **extra)
             self._pending.put(_Pending(rid, list(prompt_ids), sampling, emit,
-                                       trace=trace, deadline=deadline))
+                                       trace=trace, deadline=deadline,
+                                       tenant=tenant))
         self._wake.set()
         self.start()
         return rid
@@ -742,9 +1013,8 @@ class ContinuousBatchingEngine:
         for rec in list(self._suspended):
             if rec.state.request_id == request_id:
                 return True
-        with self._pending.mutex:
-            return any(req.request_id == request_id
-                       for req in self._pending.queue)
+        return any(req.request_id == request_id
+                   for req in self._pending.snapshot())
 
     def _service_cancellations(self) -> None:
         """Apply registered cancels and lapse blown deadlines — runs on the
@@ -804,35 +1074,29 @@ class ContinuousBatchingEngine:
         the drain-and-requeue runs under ``_submit_lock`` (the same
         discipline as _fail_all_inflight) and the terminals emit outside
         it."""
-        with self._pending.mutex:
-            snapshot = list(self._pending.queue)
+        snapshot = self._pending.snapshot()
         if not any(req.request_id in cancels
                    or (req.deadline is not None and now >= req.deadline)
                    for req in snapshot):
             return
-        victims: list[tuple[_Pending, str, str]] = []
         with self._submit_lock:
-            kept: list[_Pending] = []
-            while True:
-                try:
-                    req = self._pending.get_nowait()
-                except _queue.Empty:
-                    break
-                reason = cancels.pop(req.request_id, None)
-                if reason is not None:
-                    victims.append((req, reason, "cancelled"))
-                elif req.deadline is not None and now >= req.deadline:
-                    victims.append((req, "deadline", "deadline_exceeded"))
-                else:
-                    kept.append(req)
-            for req in kept:  # FIFO order preserved
-                self._pending.put(req)
+            removed = self._pending.remove_if(
+                lambda req: req.request_id in cancels
+                or (req.deadline is not None and now >= req.deadline))
+        victims: list[tuple[_Pending, str, str]] = []
+        for req in removed:
+            reason = cancels.pop(req.request_id, None)
+            if reason is not None:
+                victims.append((req, reason, "cancelled"))
+            else:
+                victims.append((req, "deadline", "deadline_exceeded"))
         for req, reason, kind in victims:
             self._cancel_finalize(req.request_id, req.emit, reason, kind,
                                   phase="queued", emitted=0,
                                   reclaimed=req.sampling.max_tokens,
                                   trace=req.trace,
-                                  trace_sampled=traceparent_ids(req.trace)[1])
+                                  trace_sampled=traceparent_ids(req.trace)[1],
+                                  tenant=req.tenant)
 
     def _cancel_suspended(self, cancels: dict[str, str], now: float) -> None:
         """Drop cancelled/lapsed preempted requests — their KV lives on host
@@ -860,7 +1124,8 @@ class ContinuousBatchingEngine:
                 phase="suspended", emitted=rec.state.emitted,
                 reclaimed=rec.state.sampling.max_tokens - rec.state.emitted,
                 trace=rec.state.trace,
-                trace_sampled=rec.state.trace_sampled)
+                trace_sampled=rec.state.trace_sampled,
+                tenant=rec.state.tenant)
 
     def _cancel_slot(self, slot: int, state: _SlotState, reason: str,
                      kind: str) -> None:
@@ -887,14 +1152,16 @@ class ContinuousBatchingEngine:
             state.request_id, state.emit, reason, kind, phase=phase,
             emitted=state.emitted, slot=slot,
             reclaimed=state.sampling.max_tokens - state.emitted,
-            trace=state.trace, trace_sampled=state.trace_sampled)
+            trace=state.trace, trace_sampled=state.trace_sampled,
+            tenant=state.tenant)
 
     def _cancel_finalize(self, request_id: str,
                          emit: Callable[[StepEvent], None], reason: str,
                          kind: str, *, phase: str, emitted: int,
                          reclaimed: int, slot: Optional[int] = None,
                          trace: Optional[str] = None,
-                         trace_sampled: bool = False) -> None:
+                         trace_sampled: bool = False,
+                         tenant: str = "default") -> None:
         """One terminal per cancellation: accounting, the flight-recorder
         terminal (``cancelled`` / ``deadline_exceeded``), metrics, an
         ``llm.cancel`` span for sampled traces, and the client StepEvent —
@@ -902,7 +1169,8 @@ class ContinuousBatchingEngine:
         connection that no longer exists)."""
         self.cancellations[reason] = self.cancellations.get(reason, 0) + 1
         self.reclaimed_tokens += max(0, int(reclaimed))
-        attrs = {"reason": reason, "phase": phase, "tokens": emitted}
+        attrs = {"reason": reason, "phase": phase, "tokens": emitted,
+                 "tenant": tenant}
         if slot is not None:
             attrs["slot"] = slot
         record_event(request_id, kind, **attrs)
@@ -917,7 +1185,7 @@ class ContinuousBatchingEngine:
                 "llm.cancel", traceparent=trace,
                 start_unix_ns=int(time.time() * 1e9), duration_ms=0.0,
                 request_id=request_id, reason=reason, kind=kind,
-                phase=phase, tokens=emitted)
+                phase=phase, tokens=emitted, tenant=tenant)
         finished = "deadline" if kind == "deadline_exceeded" else "cancelled"
         try:
             emit(StepEvent(0, -1, finished))
@@ -950,20 +1218,194 @@ class ContinuousBatchingEngine:
             return 0.0
         return tokens / rate
 
+    # ---------------------------------------------------- tenant isolation
+    def _weight(self, tenant: str) -> float:
+        return float(self._tenant_weights.get(
+            tenant, self.config.tenant_default_weight))
+
+    def _charge_tenant(self, tenant: str, tokens: int) -> None:
+        """Charge actually-consumed tokens (prefill or decode) to the
+        tenant's virtual counter — the fair queue's only scheduling input.
+        Scheduler thread only; plain dict math (WD01-shaped)."""
+        self._pending.charge(tenant, tokens, self._weight(tenant))
+
+    def _bump_tenant_rejection(self, tenant: str, reason: str) -> None:
+        """Never-raises rejection accounting (submit runs on gateway
+        threads; a metrics error must not turn a 429 into a 500)."""
+        try:
+            per = self.tenant_rejections.setdefault(tenant, {})
+            per[reason] = per.get(reason, 0) + 1
+            bump_counter("llm_tenant_rejections_total", tenant=tenant,
+                         reason=reason)
+        except Exception:  # noqa: BLE001
+            pass
+
+    #: drain-rate observations older than this are stale — an overnight
+    #: idle gap must not read as "the queue drains one request per hour"
+    _DRAIN_RATE_WINDOW_S = 60.0
+
+    def _drain_rate_per_s(self) -> float:
+        """Observed admission throughput (requests/s) over the recent
+        window — how fast the pending queue actually drains. Only events
+        inside the window count, and the FIRST surviving event anchors the
+        span without contributing its count (its admissions happened over
+        an interval that ENDED at its timestamp — counting them would
+        overestimate the rate when samples are few)."""
+        try:
+            events = list(self._admit_events)
+        except RuntimeError:  # advisory read against the scheduler thread
+            return 0.0
+        cutoff = time.monotonic() - self._DRAIN_RATE_WINDOW_S
+        events = [e for e in events if e[0] >= cutoff]
+        if len(events) < 2:
+            return 0.0
+        span = events[-1][0] - events[0][0]
+        if span <= 0:
+            return 0.0
+        return sum(n for _, n in events[1:]) / span
+
+    def _saturation_retry_after(self, depth: int) -> float:
+        """Retry-After for a saturated queue, derived from the observed
+        drain rate: roughly "when will a slot in line open up", clamped to
+        [1, 30] seconds (an idle/unknown rate reads as 1s — optimistic,
+        like the pre-derivation constant)."""
+        rate = self._drain_rate_per_s()
+        if rate <= 0:
+            return 1.0
+        return float(min(30.0, max(1.0, depth / rate)))
+
+    def _tenant_slot_counts(self) -> dict[str, int]:
+        """Occupied slots (decode + chunked prefill) per tenant."""
+        counts: dict[str, int] = {}
+        for state in self.slots:
+            if state is not None:
+                counts[state.tenant] = counts.get(state.tenant, 0) + 1
+        return counts
+
+    def _tenant_page_counts(self) -> dict[str, int]:
+        """KV pages held per tenant (slot chains only — suspended requests
+        hold host memory, not pool pages)."""
+        counts: dict[str, int] = {}
+        for state in self.slots:
+            if state is not None and state.chain is not None:
+                counts[state.tenant] = (counts.get(state.tenant, 0)
+                                        + len(state.chain))
+        return counts
+
+    def _blocked_tenants(self) -> set:
+        """Tenants admission must skip this pass: at their slot cap, or
+        already holding their hard page quota. Their requests stay queued;
+        weighted-fair pop serves everyone else around them."""
+        blocked: set = set()
+        if not self._tenant_caps_armed:
+            return blocked
+        max_slots = self.config.tenant_max_slots
+        max_pages = self.config.tenant_max_pages
+        slots = self._tenant_slot_counts() if max_slots else {}
+        pages = self._tenant_page_counts() if (self.paged and max_pages) \
+            else {}
+        for tenant, n in slots.items():
+            if n >= max_slots:
+                blocked.add(tenant)
+        for tenant, n in pages.items():
+            if max_pages and n >= max_pages:
+                blocked.add(tenant)
+        return blocked
+
+    def _service_tenant_caps(self) -> None:
+        """Round-boundary soft-quota sweep (the PR-9 cancellation pattern:
+        non-blocking bookkeeping only, no device work, never raises —
+        fabric-lint WD01). A tenant holding more than ``tenant_soft_pages``
+        KV pages *under contention* — another tenant backlogged in the
+        pending queue, or requests suspended waiting for pool space — has
+        its YOUNGEST slot marked for a yield; the next capacity pass (where
+        preemption's device work already lives) preempts it to host through
+        the existing `_preempt_slot` path. One victim per sweep, so a
+        momentary overshoot never thrashes a tenant's whole fleet."""
+        if not self._tenant_caps_armed or not self.paged:
+            return
+        soft = self.config.tenant_soft_pages
+        if soft <= 0 or self._soft_yield:
+            return  # previous mark not yet consumed
+        pages = self._tenant_page_counts()
+        over = {t: n for t, n in pages.items() if n > soft}
+        if not over:
+            return
+        # contention test: someone ELSE is waiting for capacity
+        depths = self._pending.depths()
+        contention = bool(self._suspended) or any(
+            d > 0 for t, d in depths.items() if t not in over)
+        if not contention:
+            return
+        victim_tenant = max(over, key=over.get)  # worst offender first
+        # youngest slot = the least sunk prefill/decode cost to re-pay
+        best_slot, best_len = None, None
+        for slot in range(self.n_slots):
+            state = self.slots[slot]
+            # decode-phase slots only: the consuming capacity pass walks
+            # ACTIVE slots (mid-chunked-prefill yields ride the existing
+            # pool-pressure path instead)
+            if state is None or state.tenant != victim_tenant \
+                    or not self.active[slot]:
+                continue
+            length = int(self.lengths[slot])
+            if best_len is None or length < best_len:
+                best_slot, best_len = slot, length
+        if best_slot is None:
+            return
+        self._soft_yield.add(best_slot)
+        self.tenant_soft_yields[victim_tenant] = \
+            self.tenant_soft_yields.get(victim_tenant, 0) + 1
+        bump_counter("llm_tenant_soft_yields_total", tenant=victim_tenant)
+        record_event(self.slots[best_slot].request_id, "soft_yield_marked",
+                     slot=best_slot, tenant=victim_tenant,
+                     pages=over[victim_tenant], soft_cap=soft)
+
+    def tenant_snapshot(self) -> dict[str, dict[str, Any]]:
+        """Per-tenant live figures — the /v1/monitoring/tenants row source
+        and the doctor's attribution feed. Cheap advisory reads (one slot
+        scan + queue-lock snapshots); safe from any thread."""
+        slots = self._tenant_slot_counts()
+        pages = self._tenant_page_counts()
+        depths = self._pending.depths()
+        vtc = self._pending.vtc_snapshot()
+        charged = self._pending.charged_snapshot()
+        try:
+            # gateway threads insert new tenant/reason keys on rejection
+            # while this (possibly a lifecycle/doctor thread) iterates —
+            # the _depth_hist advisory-snapshot contract: degrade, never
+            # raise (a raising stats() quarantines a healthy replica)
+            rejections = {t: dict(per)
+                          for t, per in self.tenant_rejections.items()}
+            yields = dict(self.tenant_soft_yields)
+        except RuntimeError:
+            rejections, yields = {}, {}
+        tenants = (set(slots) | set(pages) | set(depths) | set(charged)
+                   | set(rejections))
+        out: dict[str, dict[str, Any]] = {}
+        for tenant in tenants:
+            out[tenant] = {
+                "weight": self._weight(tenant),
+                "active_slots": slots.get(tenant, 0),
+                "pages": pages.get(tenant, 0),
+                "pending": depths.get(tenant, 0),
+                "virtual_counter": round(vtc.get(tenant, 0.0), 3),
+                "charged_tokens": charged.get(tenant, 0),
+                "soft_yields": yields.get(tenant, 0),
+                "rejections": rejections.get(tenant, {}),
+            }
+        return out
+
     # -------------------------------------------------------- health surface
     def pending_depth(self) -> int:
         """Live pending-queue depth (llm_queue_depth{model=} gauge)."""
         return self._pending.qsize()
 
     def pending_oldest_age_s(self) -> Optional[float]:
-        """Age of the oldest pending request, or None when the queue is
-        empty — the doctor's queue-age watchdog input. Peeks the queue head
-        under its own mutex (advisory read, one lock acquire)."""
-        with self._pending.mutex:
-            head = self._pending.queue[0] if self._pending.queue else None
-        if head is None:
-            return None
-        return time.monotonic() - head.enqueued_at
+        """Age of the oldest pending request (across every tenant queue),
+        or None when empty — the doctor's queue-age watchdog input.
+        Advisory read, one lock acquire."""
+        return self._pending.oldest_age()
 
     def heartbeat(self) -> dict[str, Any]:
         """Round-liveness snapshot for the doctor's watchdogs: how long ago
@@ -1060,6 +1502,19 @@ class ContinuousBatchingEngine:
                 "max": round(max(waits), 3) if waits else 0.0,
                 "count": len(waits),
             },
+            # queue saturation is now ATTRIBUTABLE: per-tenant pending
+            # depth plus the observed drain rate the 429 Retry-After
+            # derives from
+            "queue": {
+                "pending": self._pending.qsize(),
+                "per_tenant": self._pending.depths(),
+                "drain_rate_per_s": round(self._drain_rate_per_s(), 3),
+                "retry_after_s": round(self._saturation_retry_after(
+                    self._pending.qsize()), 1),
+            },
+            # tenant isolation: weights, live occupancy, virtual counters,
+            # charged tokens, caps activity — the fairness ledger
+            "tenants": self.tenant_snapshot(),
             "rejected_saturated": self.rejected_saturated,
             # end-to-end cancellation: terminals by reason + the decode
             # budget (max_tokens never generated) reclaimed for live users
@@ -1089,6 +1544,9 @@ class ContinuousBatchingEngine:
                 # admission (a lapsed pending entry must never take the slot
                 # this pass is about to hand out)
                 self._service_cancellations()
+                # tenant soft-quota sweep: pure bookkeeping (marks a yield
+                # victim; the capacity pass performs the actual preempt)
+                self._service_tenant_caps()
                 admitted = self._admit()
                 # prefilling slots are work too: mixed-batch rounds must run
                 # even before any slot reaches decode phase
@@ -1149,13 +1607,10 @@ class ContinuousBatchingEngine:
         # (and sleeps its jittered backoff), so emitting under ours would
         # deadlock two same-round teardowns against each other (ABBA) and
         # block fast rejects behind the whole drain.
+        self._soft_yield.clear()
         stranded: list[_Pending] = []
         with self._submit_lock:
-            while True:
-                try:
-                    stranded.append(self._pending.get_nowait())
-                except _queue.Empty:
-                    break
+            stranded.extend(self._pending.drain_all())
         for req in stranded:
             record_event(req.request_id, "error",
                          detail=f"{why} while queued")
@@ -1170,6 +1625,9 @@ class ContinuousBatchingEngine:
         return self._free_slots.popleft()
 
     def _release_free_slot(self, slot: int) -> None:
+        # a pending soft-yield mark dies with the occupancy: the slot's next
+        # owner (possibly another tenant) must not inherit the preempt
+        self._soft_yield.discard(slot)
         self._free_slots.append(slot)
 
     def _reclaim_failed_admission(self, slot: int) -> bool:
@@ -1245,10 +1703,24 @@ class ContinuousBatchingEngine:
         Suspended requests outrank new admissions — their prefill is already
         paid and a client is mid-stream."""
         resumed = 0
+        deferred: list[_Suspended] = []
         while self._suspended:
             if not self._free_slots:
                 break
             rec = self._suspended[0]
+            if rec.soft_yielded and self._defer_soft_yield(rec.state.tenant):
+                # a soft-quota YIELD stays parked while other tenants have
+                # pending work AND its tenant is still over the live cap —
+                # resuming it then would hand the slot its preemption just
+                # freed straight back to the over-quota tenant (suspended
+                # outranks admission) and thrash preempt/restore without
+                # the starved tenant ever admitting. The live re-judge
+                # mirrors the mark's own: once the tenant's other usage
+                # drops to the cap the stream resumes even under
+                # contention (a yielded stream's stall is bounded by its
+                # tenant's overshoot, never by another tenant's backlog).
+                deferred.append(self._suspended.popleft())
+                continue
             # armed raise here error-terminates the engine mid-recovery (the
             # faultlab resume-crash scenario asserts every client still gets
             # exactly one terminal event)
@@ -1346,7 +1818,30 @@ class ContinuousBatchingEngine:
                             state.request_id, slot, rec.length, pause_s)
             finally:
                 reset_log_context(token)
+        for rec in reversed(deferred):  # restore FIFO head order
+            self._suspended.appendleft(rec)
         return resumed
+
+    def _other_tenant_pending(self, tenant: str) -> bool:
+        """True while any OTHER tenant has pending (not-yet-admitted) work —
+        the contention condition that keeps a soft-quota yield parked.
+        Compares in the queue's own key space so the tenant-blind mode
+        (one shared key) never reads its own backlog as contention."""
+        key = self._pending._key(tenant)
+        return any(t != key and depth > 0
+                   for t, depth in self._pending.depths().items())
+
+    def _defer_soft_yield(self, tenant: str) -> bool:
+        """Should a soft-quota yield stay parked this pass? Only while the
+        contention persists AND the tenant's CURRENT page usage still
+        exceeds the soft cap — the same live re-judge the yield mark gets
+        at consumption, so a tenant whose other streams finished resumes
+        immediately instead of being starved by an unrelated backlog."""
+        if not self._other_tenant_pending(tenant):
+            return False
+        soft = self.config.tenant_soft_pages
+        return soft > 0 and \
+            self._tenant_page_counts().get(tenant, 0) > soft
 
     def _admit(self) -> int:
         """Admit pending requests under the per-round prefill token budget.
@@ -1363,15 +1858,23 @@ class ContinuousBatchingEngine:
         budget = self.config.prefill_budget_tokens
         taken: list[_Pending] = []
         spent = 0
+        popped = 0
+        # tenants at their slot/page caps are skipped by the fair pop —
+        # their requests stay queued, everyone else admits around them.
+        # Slot counts update as this pass takes requests, so one pass can
+        # never overshoot a tenant's cap with a burst.
+        blocked = self._blocked_tenants()
+        max_slots = self.config.tenant_max_slots
+        tenant_taken = self._tenant_slot_counts() if max_slots else {}
         while len(taken) < len(self._free_slots):
             # mixed mode admits straight into prefill-phase slots (no device
             # work here) — the budget paces CHUNKS per round, not admissions
             if not self.mixed and budget > 0 and spent >= budget and taken:
                 break
-            try:
-                req = self._pending.get_nowait()
-            except _queue.Empty:
+            req = self._pending.pop_fair(blocked)
+            if req is None:
                 break
+            popped += 1
             if req.deadline is not None:
                 now = time.monotonic()
                 # the estimate gate applies only while the engine is BUSY
@@ -1392,14 +1895,23 @@ class ContinuousBatchingEngine:
                         "deadline_exceeded", phase="queued", emitted=0,
                         reclaimed=req.sampling.max_tokens,
                         trace=req.trace,
-                        trace_sampled=traceparent_ids(req.trace)[1])
+                        trace_sampled=traceparent_ids(req.trace)[1],
+                        tenant=req.tenant)
                     continue
             taken.append(req)
             spent += len(req.prompt_ids)
+            if max_slots:
+                tenant_taken[req.tenant] = tenant_taken.get(req.tenant, 0) + 1
+                if tenant_taken[req.tenant] >= max_slots:
+                    blocked.add(req.tenant)
             wait_ms = (time.monotonic() - req.enqueued_at) * 1000.0
             self.queue_wait_samples.append(wait_ms)
-            record_event(req.request_id, "admitted",
+            record_event(req.request_id, "admitted", tenant=req.tenant,
                          queue_wait_ms=round(wait_ms, 3))
+        if popped:
+            # drain-rate observation (requests that LEFT the queue this
+            # pass, lapses included): the saturation Retry-After reads this
+            self._admit_events.append((time.monotonic(), popped))
         if taken:
             admitted += self._place(taken)
         self._last_admit_ms = round((time.monotonic() - t0) * 1000.0, 3)
@@ -1455,12 +1967,13 @@ class ContinuousBatchingEngine:
         for i, (req, match) in enumerate(singles):
             slot = self._take_free_slot()
             if slot is None:  # unreachable: takes are bounded by free slots
-                for dropped, d_match in singles[i:]:  # requeue EVERY one
+                # reversed: put_front restores each tenant's FIFO order
+                for dropped, d_match in reversed(singles[i:]):
                     logger.error("no free slot for %s; requeueing",
                                  dropped.request_id)
                     if d_match and d_match[0]:
                         self.pool.release(dropped.prompt_ids)
-                    self._pending.put(dropped)
+                    self._pending.put_front(dropped)
                 break
             try:
                 self._prefill_into_slot(slot, req, prematched=match)
@@ -1494,10 +2007,10 @@ class ContinuousBatchingEngine:
         for i, req in enumerate(reqs):
             slot = self._take_free_slot()
             if slot is None:  # unreachable: takes are bounded by free slots
-                for dropped in reqs[i:]:
+                for dropped in reversed(reqs[i:]):
                     logger.error("no free slot for %s; requeueing",
                                  dropped.request_id)
-                    self._pending.put(dropped)
+                    self._pending.put_front(dropped)
                 break
             try:
                 self._admit_prefill_slot(slot, req)
@@ -1555,6 +2068,7 @@ class ContinuousBatchingEngine:
                 prefill_t0=time.monotonic(),
                 prefill_wall=time.time(),
                 deadline=req.deadline,
+                tenant=req.tenant,
             )
             self.slots[slot] = state
             self.lengths[slot] = 0
@@ -1615,13 +2129,15 @@ class ContinuousBatchingEngine:
         placed = 0
         self._note_prefill_rate(sum(len(r.prompt_ids) for r in reqs),
                                 time.monotonic() - t_pf)
+        for req in reqs:  # actual prefill tokens consumed, per tenant
+            self._charge_tenant(req.tenant, len(req.prompt_ids))
         for i, req in enumerate(reqs):
             slot = self._take_free_slot()
             if slot is None:  # unreachable: takes bounded by free slots
-                for dropped in reqs[i:]:  # requeue EVERY unplaced request
+                for dropped in reversed(reqs[i:]):  # requeue EVERY one
                     logger.error("no free slot for %s; requeueing",
                                  dropped.request_id)
-                    self._pending.put(dropped)
+                    self._pending.put_front(dropped)
                 break
             chain: Optional[list[int]] = None
             try:
@@ -1637,7 +2153,8 @@ class ContinuousBatchingEngine:
                         "llm.prefill", traceparent=req.trace,
                         start_unix_ns=int(wall_pf * 1e9), duration_ms=dur_ms,
                         request_id=req.request_id, slot=slot, coalesced=True,
-                        batch=B, prompt_tokens=len(req.prompt_ids))
+                        batch=B, prompt_tokens=len(req.prompt_ids),
+                        tenant=req.tenant)
                 self._activate_slot(slot, req, chain, int(first_host[i]),
                                     keys_out[i])
                 placed += 1
@@ -1774,6 +2291,9 @@ class ContinuousBatchingEngine:
             assert chain is not None
         dur_ms = (time.monotonic() - t_pf) * 1000.0
         self._note_prefill_rate(T - cached_len, dur_ms / 1000.0)
+        # only the UNCACHED suffix is charged: a prefix-cache hit consumed
+        # no prefill compute, so fairness must not bill it
+        self._charge_tenant(req.tenant, T - cached_len)
         # recorded BEFORE activation: the first token emitted there may finish
         # the request, and a terminal event must be the timeline's last
         record_event(req.request_id, "prefill", slot=slot, coalesced=False,
@@ -1784,7 +2304,7 @@ class ContinuousBatchingEngine:
                 "llm.prefill", traceparent=req.trace,
                 start_unix_ns=int(wall_pf * 1e9), duration_ms=dur_ms,
                 request_id=req.request_id, slot=slot, prompt_tokens=T,
-                cached_len=cached_len)
+                cached_len=cached_len, tenant=req.tenant)
         self._activate_slot(slot, req, chain, tok, req_key)
 
     def _activate_slot(self, slot: int, req: _Pending,
@@ -1816,6 +2336,7 @@ class ContinuousBatchingEngine:
             trace=req.trace,
             trace_sampled=traceparent_ids(req.trace)[1],
             deadline=req.deadline,
+            tenant=req.tenant,
         )
         T = len(req.prompt_ids)
         self.slots[slot] = state
@@ -1833,6 +2354,9 @@ class ContinuousBatchingEngine:
         state = self.slots[slot]
         assert state is not None
         state.emitted += 1
+        # decode charge: one actually-emitted token against the tenant's
+        # virtual counter (plain dict math — AS04/WD01 clean)
+        self._charge_tenant(state.tenant, 1)
         if tok in state.stops:
             fin: Optional[str] = "stop"
         elif state.emitted >= state.sampling.max_tokens:
@@ -1881,6 +2405,18 @@ class ContinuousBatchingEngine:
             state = self.slots[slot]
             if state is None or not self.active[slot]:
                 continue
+            if slot in self._soft_yield:
+                # tenant soft-quota yield marked by the round-boundary cap
+                # sweep: the actual preempt (device readback + host save)
+                # runs HERE, where preemption already lives — re-judged
+                # against the live cap so a stale mark cannot evict a
+                # tenant that already shrank below its quota
+                self._soft_yield.discard(slot)
+                soft = self.config.tenant_soft_pages
+                if soft > 0 and self._tenant_page_counts().get(
+                        state.tenant, 0) > soft:
+                    self._preempt_slot(slot, state, soft_yielded=True)
+                    continue
             try:
                 # an armed MemoryError here forces the preempt-to-host path
                 # without real pool pressure (deterministic faultlab preempt
@@ -1945,12 +2481,15 @@ class ContinuousBatchingEngine:
         self.page_table[slot, before: len(chain)] = chain[before:]
         self._mark_pt_row(slot)
 
-    def _preempt_slot(self, slot: int, state: _SlotState) -> None:
+    def _preempt_slot(self, slot: int, state: _SlotState,
+                      soft_yielded: bool = False) -> None:
         """Preempt-to-host, don't shed: save the chain's KV, free the pages,
         and park the request — _admit resumes it when space frees (no
         recompute; the stream pauses, never errors). Works mid-chunked-
         prefill too: the saved pages cover prefill_pos tokens and chunking
-        continues from there on resume."""
+        continues from there on resume. ``soft_yielded`` marks a tenant
+        soft-quota yield: resume defers it while other tenants have pending
+        work (see _resume_suspended)."""
         chain = state.chain
         is_prefill = state.phase == "prefill"
         length = state.prefill_pos if is_prefill else int(self.lengths[slot])
@@ -1971,7 +2510,8 @@ class ContinuousBatchingEngine:
             last_token=0 if is_prefill
             else int(np.asarray(self._last_tokens)[slot]),
             slot_key=None if is_prefill
-            else np.asarray(self._slot_keys[slot])))
+            else np.asarray(self._slot_keys[slot]),
+            soft_yielded=soft_yielded))
         self.preemptions += 1
         if is_prefill:
             self._prefill_slots.remove(slot)
@@ -2219,7 +2759,7 @@ class ContinuousBatchingEngine:
                 start_unix_ns=int(state.prefill_wall * 1e9),
                 duration_ms=dur_ms, request_id=state.request_id, slot=slot,
                 prompt_tokens=T, cached_len=state.cached_len, mixed=True,
-                chunks=state.prefill_chunks)
+                chunks=state.prefill_chunks, tenant=state.tenant)
         no_room = T + self._k_steps > self.config.max_seq_len
         self._emit_token(slot, tok, force_length=no_room)
 
@@ -2363,6 +2903,9 @@ class ContinuousBatchingEngine:
             state.prefill_chunks += 1
             self.prefill_chunks += 1
             self.chunked_prefill_tokens += chunk
+            # chunked prefill charges as it lands — a tenant mid-prompt is
+            # already paying its fair-queue bill, not only at completion
+            self._charge_tenant(state.tenant, chunk)
             # one event per piggybacked chunk (mirrors decode_chunk): the
             # request timeline shows interleaved prefill progress
             record_event(state.request_id, "prefill_chunk", slot=slot,

@@ -314,6 +314,44 @@ def test_usage_accounting(server):
     assert body["usage"]["requests"] > 0
 
 
+def test_monitoring_tenants_two_api_keys(server):
+    """Tenancy is a first-class scheduling dimension end to end: two
+    identities (x-tenant-id selects the tenant under accept_all authn —
+    acme-eu inherits acme's models via the tenant tree) drive the same
+    engine, and GET /v1/monitoring/tenants shows BOTH tenants' scheduler-
+    side accounting: charged tokens, live slots/pages/queue depth, the
+    virtual fairness counter, and shed state."""
+    for tenant, headers in (("acme", {}),
+                            ("acme-eu", {"x-tenant-id": "acme-eu"})):
+        status, body = req(server, "POST", "/v1/completions", json={
+            "model": "local::tiny-llama",
+            "prompt": f"tenant probe for {tenant}", "max_tokens": 4,
+        }, headers=headers)
+        assert status == 200, body
+    status, body = req(server, "GET", "/v1/monitoring/tenants")
+    assert status == 200, body
+    rows = {row["tenant"]: row for row in body["tenants"]}
+    assert {"acme", "acme-eu"} <= set(rows), rows.keys()
+    for tenant in ("acme", "acme-eu"):
+        row = rows[tenant]
+        assert row["charged_tokens"] > 0
+        assert row["shed"] is False
+        assert "local::tiny-llama" in row["per_model"]
+        per = row["per_model"]["local::tiny-llama"]
+        assert per["weight"] == 1.0
+        assert "virtual_counter" in per and "pending" in per
+    # the single-tenant view + the 404 problem for an unknown tenant
+    status, body = req(server, "GET", "/v1/monitoring/tenants/acme-eu")
+    assert status == 200 and body["tenant"] == "acme-eu"
+    status, body = req(server, "GET", "/v1/monitoring/tenants/nobody")
+    assert status == 404 and body["code"] == "unknown_tenant"
+    # the flight recorder's live/finished rows carry the tenant column
+    status, body = req(server, "GET", "/v1/monitoring/requests")
+    assert status == 200
+    tenants_seen = {r.get("tenant") for r in body["recent"]}
+    assert "acme-eu" in tenants_seen or "acme" in tenants_seen
+
+
 # ---------------------------------------------------------------- model registry
 def test_model_registry_resolution_and_listing(server):
     status, body = req(server, "GET", "/v1/model-registry/models/default-chat")

@@ -693,10 +693,65 @@ def test_WD01_cancel_callbacks_with_helpers_pass():
     assert ok == []
 
 
+def test_WD01_fair_queue_pop_blocking_sleep_fails():
+    # the fair queue's pop runs inside the scheduler's admission pass —
+    # one sleep there stalls every tenant at once
+    bad = lint("import time\n"
+               "class TenantFairQueue:\n"
+               "    def pop_fair(self, blocked=None):\n"
+               "        time.sleep(0.05)\n",
+               tier="runtime", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and bad[0].line == 4
+
+
+def test_WD01_tenant_cap_sweep_direct_metric_fails():
+    # the round-boundary cap sweep is bookkeeping-only: a raising metric
+    # mutate there would turn a quota mark into an engine crash
+    bad = lint("class ContinuousBatchingEngine:\n"
+               "    def _service_tenant_caps(self, registry):\n"
+               "        registry.counter('llm_tenant_soft_yields_total')"
+               ".inc(tenant='t')\n",
+               tier="runtime", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and "bump_counter" in bad[0].message
+
+
+def test_WD01_tenant_charge_device_sync_fails():
+    # the per-token charge path sits inside _emit_token — a device sync
+    # there would re-serialize host and device every token
+    bad = lint("import numpy as np\n"
+               "class ContinuousBatchingEngine:\n"
+               "    def _charge_tenant(self, tenant, tokens):\n"
+               "        np.asarray(self._lengths_dev)\n",
+               tier="runtime", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"]
+
+
+def test_WD01_fairness_callbacks_with_helpers_pass():
+    ok = lint("from cyberfabric_core_tpu.modkit.metrics import bump_counter\n"
+              "from cyberfabric_core_tpu.modkit.flight_recorder import "
+              "record_event\n"
+              "class TenantFairQueue:\n"
+              "    def put(self, req):\n"
+              "        with self._lock:\n"
+              "            self._queues[req.tenant].append(req)\n"
+              "    def charge(self, tenant, tokens, weight):\n"
+              "        with self._lock:\n"
+              "            self._vtc[tenant] = tokens / weight\n"
+              "class ContinuousBatchingEngine:\n"
+              "    def _service_tenant_caps(self):\n"
+              "        self._soft_yield.add(0)\n"
+              "        bump_counter('llm_tenant_soft_yields_total',"
+              " tenant='t')\n"
+              "        record_event('rid', 'soft_yield_marked', slot=0)\n",
+              tier="runtime", select=("WD01",))
+    assert ok == []
+
+
 def test_WD01_repo_gate_clean():
     """The gate: the shipped doctor's evaluators, the lifecycle
-    supervisor's tick/routing callbacks, AND the scheduler/pool
-    cancellation callbacks hold their own contract."""
+    supervisor's tick/routing callbacks, the scheduler/pool cancellation
+    callbacks, AND the tenant fairness/quota surface (fair-queue
+    put/pop/charge + the cap sweep) hold their own contract."""
     engine = Engine(all_rules()).select(["WD01"])
     findings = [f for f in engine.run(PKG) if not f.suppressed]
     assert findings == [], [f.to_dict() for f in findings]
