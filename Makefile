@@ -5,9 +5,9 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench lifecycle-guard cancel-guard fairness-guard
+.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench lifecycle-guard cancel-guard fairness-guard
 
-safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench lifecycle-guard cancel-guard fairness-guard  ## the full local gate
+safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench lifecycle-guard cancel-guard fairness-guard  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
 
@@ -57,6 +57,10 @@ ragged-bench:  ## ragged mixed-batch kernel/scheduler tests + the mixed-vs-phase
 overlap-bench:  ## deep-lookahead pipeline tests + the depth 0/1/N sweep (BENCH_OVERLAP.json: overlap_ratio > 0.85 at depth >= 2)
 	$(PY) -m pytest tests/test_scheduler_pipeline.py -q
 	$(PY) bench.py --overlap-bench > /dev/null
+
+spec-bench:  ## batched speculative decoding tests + the greedy repetitive-storm k=0-vs-k A/B (BENCH_SPEC.json: tok/s must improve, acceptance histogram reported)
+	$(PY) -m pytest tests/test_scheduler_spec.py -q
+	$(PY) bench.py --spec-bench > /dev/null
 
 lifecycle-guard:  ## replica lifecycle tests + the disarmed-supervisor overhead A/B (BENCH_LIFECYCLE.json, <1% bar)
 	$(PY) -m pytest tests/test_lifecycle.py tests/test_replicas.py -q

@@ -1066,6 +1066,116 @@ def overlap_bench() -> int:
     return 0 if report["pass"] else 1
 
 
+def spec_bench() -> int:
+    """Batched speculative decoding A/B (BENCH_SPEC.json): the --aggregate
+    GREEDY REPETITIVE-TEXT storm (``BENCH_PROMPT_MODE=repeat`` — each prompt
+    tiles an 8-token motif, so prompt-lookup drafting has recurring n-grams
+    from the first decode round) at ``scheduler_spec_k = 0`` (the plain
+    continuous scheduler) vs ``k`` (``BENCH_SPEC_DECODE_K``, default 4).
+    Reports tok/s, itl p50/p99, ttft p50 and the ACCEPTANCE-LENGTH HISTOGRAM
+    per arm; interleaved ABBA ordering decorrelates host drift, and per arm
+    the run with the BEST tok/s is reported (contention only ever slows a
+    run down — the overhead guards' best-run rule).
+
+    What moves and what cannot, on CPU evidence: the structural win — up to
+    k+1 tokens committed per weight pass instead of one — is the same
+    mechanism on CPU and TPU, and the acceptance histogram (how many drafts
+    the on-device greedy verify accepted per span) measures workload
+    structure, not hardware. The MAGNITUDE is hardware-bound: on a
+    bandwidth-bound TPU decode, a k+1-position verify forward costs nearly
+    the same HBM traffic as a 1-position step (weights dominate), which is
+    where the published 2-3x on greedy/low-temperature traffic lives
+    (RTP-LLM, PAPERS.md); on this CPU host the interpret-mode ragged kernel
+    makes each verify span compute-priced, so the measured speedup is a
+    conservative floor for the TPU number. Greedy output is byte-identical
+    across arms by construction (pinned by tests/test_scheduler_spec.py);
+    this harness measures ONLY speed."""
+    reps = int(os.environ.get("BENCH_SPEC_REPS", "2"))
+    k = max(1, int(os.environ.get("BENCH_SPEC_DECODE_K", "4")))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_COST="0",
+               BENCH_PROMPT_MODE="repeat")
+    env.setdefault("BENCH_STAGGER_S", "0.05")
+    # shorter fused chunks: the spec round's ONE-weight-pass verify competes
+    # against k_steps sequential passes — decode chunk 8 keeps the plain arm
+    # honest (production-sized rounds) without drowning the run in the
+    # 32-step round boundary (the overlap-bench knob, same rationale)
+    env.setdefault("BENCH_DECODE_CHUNK", "8")
+
+    def one(spec_k: int) -> Optional[dict]:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--aggregate",
+             "tiny-llama", "none"],
+            capture_output=True, text=True, timeout=900,
+            env=dict(env, BENCH_SPEC_K=str(spec_k)))
+        sys.stderr.write(proc.stderr[-2000:])
+        try:
+            row = json.loads(proc.stdout.strip().splitlines()[-1])
+            return row if "tokens_per_sec" in row else None
+        except Exception as e:  # noqa: BLE001
+            log(f"spec-bench child (spec_k={spec_k}) failed: {e}")
+            return None
+
+    arms: dict[str, list[dict]] = {"plain": [], "spec": []}
+    order = (["spec", "plain", "plain", "spec"] * ((reps + 1) // 2))[: 2 * reps]
+    for label in order:
+        row = one(k if label == "spec" else 0)
+        if row is not None:
+            arms[label].append(row)
+
+    keep = ("tokens_per_sec", "itl_p50_ms", "itl_p99_ms", "ttft_p50_ms",
+            "spec_k", "speculative")
+
+    def best(rows: list[dict]) -> Optional[dict]:
+        if not rows:
+            return None
+        r = max(rows, key=lambda r: r["tokens_per_sec"])
+        return {m: r.get(m) for m in keep}
+
+    plain_best, spec_best = best(arms["plain"]), best(arms["spec"])
+    report: dict = {
+        "kind": "batched_speculative_decode_ab_cpu_evidence",
+        "note": "aggregate greedy repetitive-text storm (8 streams, prompts "
+                "tile an 8-token motif) through the continuous scheduler at "
+                "scheduler_spec_k=0 vs k; interleaved ABBA runs, per-arm "
+                "best-tok/s run reported (contention only slows runs down)",
+        "spec_decode_k": k,
+        "runs": {label: [{m: r.get(m) for m in keep} for r in rows]
+                 for label, rows in arms.items()},
+        "plain": plain_best, "spec": spec_best,
+    }
+    if plain_best and spec_best:
+        delta = (spec_best["tokens_per_sec"]
+                 / max(plain_best["tokens_per_sec"], 1e-9) - 1.0) * 100.0
+        spec_stats = spec_best.get("speculative") or {}
+        report.update({
+            "tokens_per_sec_delta_pct": round(delta, 1),
+            "itl_p50_reduction_pct": round(
+                (1.0 - spec_best["itl_p50_ms"]
+                 / max(plain_best["itl_p50_ms"], 1e-9)) * 100.0, 1),
+            "accept_hist": spec_stats.get("accept_hist", {}),
+            "accept_rate": spec_stats.get("accept_rate", 0.0),
+            "spec_rounds": spec_stats.get("rounds", 0),
+            "tpu_note": (
+                "the CPU delta is a conservative floor: interpret-mode "
+                "ragged kernels price the verify span by compute, while a "
+                "bandwidth-bound TPU decode prices it by (weight) HBM "
+                "traffic — nearly free for k+1 positions — which is where "
+                "the 2-3x greedy/low-temp number lives (RTP-LLM, PAPERS.md)"),
+            # the claim this harness CAN prove on CPU: speculation commits
+            # more tokens per dispatch AND never hurts throughput
+            "pass": bool(delta > 0.0
+                         and spec_stats.get("rounds", 0) > 0
+                         and spec_stats.get("accepted", 0) > 0),
+        })
+    else:
+        report["pass"] = False
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_SPEC.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return 0 if report["pass"] else 1
+
+
 def aggregate(model_name: str, quant: str) -> int:
     """8 concurrent streams through the continuous scheduler (paged KV pool +
     ragged paged decode attention), with STAGGERED arrivals — the pattern the
@@ -1136,6 +1246,11 @@ def aggregate(model_name: str, quant: str) -> int:
         # fair-queue put/pop + the per-token charge all live, one tenant);
         # "off" pins the tenant-blind global FIFO (the pre-tenancy path)
         tenant_fair = os.environ.get("BENCH_TENANCY", "on") != "off"
+        # BENCH_SPEC_K: batched speculative decoding in the continuous
+        # scheduler — k ngram drafts per greedy slot per round verified as a
+        # ragged span with on-device accept/rollback; 0/unset = off (the
+        # bit-identity baseline). --spec-bench sweeps it (BENCH_SPEC.json).
+        spec_k = int(os.environ.get("BENCH_SPEC_K", "0") or "0")
         cfg = EngineConfig(model=model_name, max_seq_len=512, max_batch=slots,
                            decode_chunk=decode_chunk, quantization=quant,
                            prefix_cache_pages=slots * 8 + 33,
@@ -1143,7 +1258,8 @@ def aggregate(model_name: str, quant: str) -> int:
                            decode_lookahead=lookahead,
                            mixed_batch=mixed,
                            prefill_budget_tokens=budget,
-                           tenant_fair=tenant_fair)
+                           tenant_fair=tenant_fair,
+                           scheduler_spec_k=spec_k)
         #: lifecycle-guard A/B arms (BENCH_LIFECYCLE.json): BOTH arms route
         #: the storm through a 1-replica DataParallelServingPool so the pool
         #: wrapper cost cancels out of the delta — "on" arms the lifecycle
@@ -1237,8 +1353,19 @@ def aggregate(model_name: str, quant: str) -> int:
                             done.set()
             return emit
 
+        # BENCH_PROMPT_MODE=repeat builds each prompt by tiling a short
+        # per-request motif — the greedy repetitive-text storm the
+        # speculative A/B measures (prompt-lookup drafting needs recurring
+        # n-grams; pure-random prompts only speculate once greedy decode
+        # settles into its own cycle). Default: the usual random prompts.
+        repeat_prompts = os.environ.get("BENCH_PROMPT_MODE", "") == "repeat"
         for i in range(n_req):
-            prompt = rng.integers(3, 1000, 96 + 8 * i).tolist()
+            plen = 96 + 8 * i
+            if repeat_prompts:
+                motif = rng.integers(3, 1000, 8).tolist()
+                prompt = (motif * (plen // len(motif) + 1))[:plen]
+            else:
+                prompt = rng.integers(3, 1000, plen).tolist()
             reqs[i]["t_submit"] = time.monotonic()
             trace = (f"00-{os.urandom(16).hex()}-{os.urandom(8).hex()}-00"
                      if trace_mode == "unsampled" else None)
@@ -1279,6 +1406,8 @@ def aggregate(model_name: str, quant: str) -> int:
                           "ttft_p50_ms": pct(ttfts_ms, 0.5),
                           "decode_lookahead": lookahead,
                           "mixed_batch": mixed,
+                          "spec_k": spec_k,
+                          "speculative": stats.get("speculative", {}),
                           "mixed_rounds": pipe.get("mixed_rounds", 0),
                           "prefill_chunks": pipe.get("prefill_chunks", 0),
                           "overlap_ratio": pipe.get("overlap_ratio", 0.0),
@@ -1664,6 +1793,8 @@ if __name__ == "__main__":
         sys.exit(ragged_bench())
     if len(sys.argv) > 1 and sys.argv[1] == "--overlap-bench":
         sys.exit(overlap_bench())
+    if len(sys.argv) > 1 and sys.argv[1] == "--spec-bench":
+        sys.exit(spec_bench())
     if len(sys.argv) > 1 and sys.argv[1] == "--embed":
         sys.exit(embed_bench())
     if len(sys.argv) > 3 and sys.argv[1] == "--cost":

@@ -98,13 +98,18 @@ def _engine_config(spec: dict):
 
 
 def _make_load(spec: dict) -> list[tuple[list[int], int]]:
-    """(prompt_ids, max_tokens) per request, from the scenario's own rng."""
+    """(prompt_ids, max_tokens) per request, from the scenario's own rng.
+    ``vocab: [lo, hi]`` narrows the token alphabet — a tiny alphabet makes
+    prompts (and greedy continuations) repetitive, which is what arms the
+    speculative scenarios' ngram proposers from the first rounds."""
     load = dict(spec.get("load") or {})
     rng = random.Random(int(spec.get("seed", 0)))
     n = int(load.get("requests", 4))
     lo, hi = load.get("prompt_len", [4, 10])
+    v_lo, v_hi = load.get("vocab", [3, 250])
     max_tokens = int(load.get("max_tokens", 10))
-    return [([rng.randrange(3, 250) for _ in range(rng.randrange(lo, hi + 1))],
+    return [([rng.randrange(v_lo, v_hi)
+              for _ in range(rng.randrange(lo, hi + 1))],
              max_tokens) for _ in range(n)]
 
 
@@ -194,9 +199,12 @@ def _run_engine_scenario(spec: dict) -> ScenarioResult:
     evidence["engine"] = engine
     invariants = run_checkers(checkers, evidence)
     for name, expr in (spec.get("expect_stats") or {}).items():
-        # e.g. {"preemptions": [1, null]} — inclusive [min, max] bounds
+        # e.g. {"preemptions": [1, null]} — inclusive [min, max] bounds;
+        # dotted names descend into nested stats ("speculative.rounds")
         lo, hi = expr
-        val = stats.get(name, 0)
+        val: Any = stats
+        for part in name.split("."):
+            val = val.get(part, 0) if isinstance(val, dict) else 0
         ok = (lo is None or val >= lo) and (hi is None or val <= hi)
         invariants[f"stats:{name}"] = (
             [] if ok else [f"{name}={val} outside [{lo}, {hi}]"])

@@ -162,6 +162,34 @@ BUILTIN_SCENARIOS: list[dict[str, Any]] = [
         "expect_stats": {"preemptions": [1, None]},
     },
     {
+        "name": "spec-preempt",
+        "kind": "engine",
+        "seed": 110,
+        # batched speculative decoding (k=3 draft spans through the ragged
+        # dispatch) under a 3-deep lookahead ring, on a tiny repetitive
+        # alphabet so every stream's ngram proposer fires from the first
+        # decode rounds. The armed MemoryError lands mid-run on a page-chain
+        # growth — preempting a speculating stream to host — and every
+        # plain-round readback drain is delayed while ring chunks are in
+        # flight. Resume must continue bit-identical to the k=0 UNFAULTED
+        # synchronous baseline (speculation + ring + preemption change
+        # speed, never text), with exactly one terminal per stream and zero
+        # slot/page-ref/orphan leaks; the fingerprint is seed-stable.
+        "engine": {**_TINY, "scheduler_spec_k": 3, "decode_lookahead": 3},
+        "baseline_engine": {"scheduler_spec_k": 0, "decode_lookahead": 0},
+        "load": {**_LOAD, "max_tokens": 16, "vocab": [3, 8]},
+        "faults": [
+            {"point": "scheduler.page_alloc",
+             "spec": {"kind": "raise", "exc": "MemoryError",
+                      "mode": "once", "after": 6}},
+            {"point": "scheduler.readback", "spec": "delay(0.02)"},
+        ],
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "engine_accounting"],
+        "expect_stats": {"preemptions": [1, None],
+                         "speculative.rounds": [1, None]},
+    },
+    {
         "name": "resume-crash",
         "kind": "engine",
         "seed": 105,

@@ -353,6 +353,16 @@ class LocalTpuWorker(LlmWorkerApi):
             spec_k=int(opts.pop("spec_k", 8)),
             draft_model=opts.pop("draft_model", ""),
             draft_checkpoint=opts.pop("draft_checkpoint", ""),
+            # batched speculative decoding in the continuous scheduler
+            # (docs/ARCHITECTURE.md "Speculative decoding"): k ngram-drafted
+            # tokens per greedy slot per round, verified as a ragged span
+            # with on-device accept/rollback. 0 (default) = off — streams
+            # bit-identical to the pre-speculation scheduler. Lossless for
+            # the greedy traffic it applies to, so it is a pure speed knob.
+            scheduler_spec_k=int(opts.pop("scheduler_spec_k", 0)),
+            spec_min_accept=float(opts.pop("spec_min_accept", 0.0)),
+            spec_max_ngram=int(opts.pop("spec_max_ngram", 3)),
+            spec_min_ngram=int(opts.pop("spec_min_ngram", 1)),
         )
         params = None
         tokenizer: Tokenizer
@@ -380,9 +390,10 @@ class LocalTpuWorker(LlmWorkerApi):
         if eng_cfg.speculative != "off" and mode == "continuous":
             logger.warning(
                 "engine_options.speculative=%r is inert under the continuous "
-                "scheduler (speculation is a lockstep bs=1 greedy path); use "
-                "scheduler: lockstep for this model or drop the option",
-                eng_cfg.speculative)
+                "scheduler (that field drives the lockstep bs=1 path); set "
+                "engine_options.scheduler_spec_k for batched speculative "
+                "decoding in the continuous scheduler, or scheduler: "
+                "lockstep for this model", eng_cfg.speculative)
         if mode == "continuous":
             # replica lifecycle knobs (docs/ARCHITECTURE.md "Replica
             # lifecycle"): dp_replicas > 1 serves this model through a

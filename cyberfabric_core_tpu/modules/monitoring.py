@@ -293,6 +293,40 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
             "Speculative decode chunks discarded as stale / dispatched (0..1)"
         ).set_function(lookahead_discard_ratio)
 
+        # batched speculative decoding (k-token ragged verify in the
+        # continuous scheduler): draft tokens proposed vs device-accepted
+        # (pushed by the scheduler per spec round) plus the mean accepted
+        # draft length per verify span. The gauge reads the scheduler's
+        # accept-length histogram counters directly (the _depth_hist
+        # advisory-snapshot pattern of the lookahead gauges above — one
+        # dict copy per scrape, no stats() build); stats()["speculative"]
+        # renders the SAME counters for REST/BENCH_SPEC.json, so the
+        # surfaces agree by construction
+        self.registry.counter(
+            "llm_spec_tokens_proposed_total",
+            "Draft tokens proposed to the scheduler's ragged verify spans"
+        ).inc(0.0)
+        self.registry.counter(
+            "llm_spec_tokens_accepted_total",
+            "Draft tokens the on-device greedy verify accepted").inc(0.0)
+
+        def spec_accept_len() -> float:
+            weighted = total = 0
+            for sched in _schedulers():
+                try:  # scheduler thread inserts new accept-len keys mid-copy
+                    hist = dict(getattr(sched, "_spec_accept_hist", {}))
+                except RuntimeError:
+                    continue  # advisory metric: skip this scrape
+                for a, n in hist.items():
+                    weighted += int(a) * n
+                    total += n
+            return weighted / total if total else 0.0
+
+        self.registry.gauge(
+            "llm_spec_accept_len",
+            "Mean accepted draft length per speculative verify span"
+        ).set_function(spec_accept_len)
+
         # prefix-cache effectiveness (ROADMAP item 1's metrics half): the
         # fraction of prefill tokens the radix cache let admission skip, and
         # the cumulative tokens saved — both read straight off the pools'

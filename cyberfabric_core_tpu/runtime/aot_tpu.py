@@ -89,12 +89,16 @@ def serving_programs(
     page_size: int = 64,
     max_seq_len: int = 2048,
     device_stop_width: int = 8,
+    spec_k: int = 0,
 ) -> dict[str, tuple[Any, tuple]]:
     """name → (fn, abstract_args): the scheduler's program set, abstracted.
 
     Bodies intentionally mirror runtime/scheduler.py:_build_programs — same
     flash prefill + sample fusion, same scan-fused paged decode chunk — so a
     lowering failure here is a lowering failure of the real serving path.
+    ``spec_k > 0`` adds the batched-speculation ragged verify step
+    (parameterized like ``--device-stop-width``: it must match the serving
+    EngineConfig's ``scheduler_spec_k`` or the AOT cache misses).
     """
     cfg = get_config(model)
     if prefill_bucket > max_seq_len:
@@ -179,11 +183,113 @@ def serving_programs(
         sds((max_batch,), jnp.float32),
         sds((max_batch,), jnp.int32),
     )
-    return {
+    programs = {
         f"prefill-flash-b1x{prefill_bucket}": (prefill, prefill_args),
         f"paged-decode-k{decode_chunk}x{max_batch}": (paged_decode_chunk,
                                                       decode_args),
     }
+
+    if spec_k > 0:
+        # batched speculative decoding: the scheduler's ragged verify step
+        # (runtime/scheduler.py spec_mixed_step) — speculating rows run a
+        # q_len=1+d draft span through the ragged paged kernel; accept,
+        # per-position stop/limit truncation and the length advance happen
+        # in-program. The body mirrors the serving jit exactly so a Mosaic
+        # lowering failure of the spec path is visible pre-hardware.
+        from .speculative import greedy_accept_counts
+
+        spec_w = spec_k + 1
+        q_max = -(-spec_w // 8) * 8
+
+        def spec_verify_step(params, k_pool, v_pool, page_table, q_ids,
+                             q_lens, prefill_hist, last_tokens, lengths,
+                             active, finished, sample_mask, final_mask,
+                             final_lens, spec_lens, stop_ids, limit_lens,
+                             keys, temp, top_p, top_k):
+            run = active & jnp.logical_not(finished)
+            q_ids = q_ids.at[:, 0].set(
+                jnp.where(active, last_tokens, q_ids[:, 0]))
+            hist = jnp.where(active, lengths, prefill_hist)
+            hidden, pools = llama.forward_paged_mixed(
+                params, cfg, q_ids, (k_pool, v_pool), page_table, hist,
+                q_lens, rope, write_mask=run | jnp.logical_not(active))
+            last_h = llama.gather_last_hidden(hidden, q_lens)
+            logits = llama.lm_head_logits(params, cfg, last_h)
+            keys2, subs = split_keys_per_slot(keys)
+            nxt = sample_token_per_slot(logits, subs, temp, top_p, top_k)
+            N = q_ids.shape[0]
+            H = hidden.shape[-1]
+            span_h = jax.lax.dynamic_slice_in_dim(hidden, 0, spec_w, axis=1)
+            span_logits = llama.lm_head_logits(
+                params, cfg, span_h.reshape(N * spec_w, H))
+            outs = jnp.argmax(span_logits, axis=-1).astype(
+                jnp.int32).reshape(N, spec_w)
+            spec = (spec_lens > 0) & run
+            a = greedy_accept_counts(outs, q_ids[:, 1:spec_w], spec_lens)
+            committed = outs.at[:, 0].set(jnp.where(spec, outs[:, 0], nxt))
+            n_commit = jnp.where(spec, a + 1, 1)
+            idx = jnp.arange(spec_w, dtype=jnp.int32)[None, :]
+            in_commit = idx < n_commit[:, None]
+            is_stop = jnp.any(
+                committed[:, :, None] == stop_ids[:, None, :], axis=2)
+            eff_len = jnp.where(
+                run, lengths, jnp.where(final_mask, final_lens - 1, lengths))
+            len_after = eff_len[:, None] + idx + 1
+            hit = (len_after >= limit_lens[:, None]) | (
+                len_after + decode_chunk > max_seq_len)
+            fin_at = (is_stop | hit) & in_commit
+            alive = jnp.cumprod(
+                1 - jnp.pad(fin_at.astype(jnp.int32),
+                            ((0, 0), (1, 0)))[:, :spec_w], axis=1) > 0
+            emit = in_commit & alive
+            n_emit = jnp.sum(emit.astype(jnp.int32), axis=1)
+            sample = sample_mask & jnp.logical_not(finished)
+            toks = jnp.where(emit & sample[:, None], committed, -1)
+            new_last = jnp.where(
+                sample,
+                jnp.take_along_axis(
+                    committed, jnp.maximum(n_emit - 1, 0)[:, None],
+                    axis=1)[:, 0],
+                last_tokens)
+            keys_out = jnp.where(sample[:, None], keys2, keys)
+            new_lens = jnp.where(
+                run, lengths + n_emit,
+                jnp.where(final_mask, final_lens,
+                          jnp.where(active, lengths, 0)))
+            fin_out = finished | (sample & jnp.any(fin_at & emit, axis=1))
+            active_out = active | final_mask
+            # accept counts ride the emit matrix's last column — one drain
+            # carries tokens AND acceptance (the serving AS04 discipline)
+            a_out = jnp.where(spec, a, -1)
+            toks_out = jnp.concatenate([toks, a_out[:, None]], axis=1)
+            return (toks_out, pools[0], pools[1], new_last, keys_out,
+                    new_lens, fin_out, active_out)
+
+        spec_args = (
+            params_abs, pool_sds, pool_sds,
+            sds((max_batch, pmax), jnp.int32),
+            sds((max_batch, q_max), jnp.int32),
+            sds((max_batch,), jnp.int32),
+            sds((max_batch,), jnp.int32),
+            sds((max_batch,), jnp.int32),
+            sds((max_batch,), jnp.int32),
+            sds((max_batch,), jnp.bool_),
+            sds((max_batch,), jnp.bool_),
+            sds((max_batch,), jnp.bool_),
+            sds((max_batch,), jnp.bool_),
+            sds((max_batch,), jnp.int32),
+            sds((max_batch,), jnp.int32),
+            sds((max_batch, stop_width), jnp.int32),
+            sds((max_batch,), jnp.int32),
+            keys_abs,
+            sds((max_batch,), jnp.float32),
+            sds((max_batch,), jnp.float32),
+            sds((max_batch,), jnp.int32),
+        )
+        programs[f"spec-verify-w{spec_w}x{max_batch}"] = (spec_verify_step,
+                                                          spec_args)
+
+    return programs
 
 
 def tp_sharded_program(model: str, mesh, *, dtype=jnp.bfloat16,
@@ -234,6 +340,7 @@ def aot_compile(
     max_batch: int = 8,
     max_seq_len: int = 2048,
     device_stop_width: int = 8,
+    spec_k: int = 0,
     tp: int = 0,
     include_serving: bool = True,
     out_dir: Optional[str | Path] = None,
@@ -256,7 +363,7 @@ def aot_compile(
         "model": model, "quantization": quantization, "topology": topology,
         "dtype": dtype, "prefill_bucket": prefill_bucket,
         "decode_chunk": decode_chunk, "max_batch": max_batch,
-        "max_seq_len": max_seq_len, "programs": [],
+        "max_seq_len": max_seq_len, "spec_k": spec_k, "programs": [],
     }
     out = Path(out_dir) if out_dir else None
     if out:
@@ -268,7 +375,7 @@ def aot_compile(
             model, dtype=dt, quantization=quantization,
             prefill_bucket=prefill_bucket, decode_chunk=decode_chunk,
             max_batch=max_batch, max_seq_len=max_seq_len,
-            device_stop_width=device_stop_width)
+            device_stop_width=device_stop_width, spec_k=spec_k)
         jobs = [(name, fn, jax.tree.map(
             lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=repl)
             if getattr(l, "sharding", None) is None else l, args))
@@ -363,6 +470,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument("--max-batch", type=int, default=8)
     ap.add_argument("--max-seq-len", type=int, default=2048)
     ap.add_argument("--device-stop-width", type=int, default=8)
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="scheduler_spec_k of the serving config: adds the "
+                         "batched-speculation ragged verify step to the "
+                         "compiled set (0 = off, matching the default)")
     ap.add_argument("--tp", type=int, default=0)
     ap.add_argument("--out", default=None)
     ap.add_argument("--serialize", action="store_true")
@@ -375,7 +486,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         dtype=args.dtype, prefill_bucket=args.prefill_bucket,
         decode_chunk=args.decode_chunk, max_batch=args.max_batch,
         max_seq_len=args.max_seq_len,
-        device_stop_width=args.device_stop_width, tp=args.tp,
+        device_stop_width=args.device_stop_width, spec_k=args.spec_k,
+        tp=args.tp,
         out_dir=args.out,
         serialize=args.serialize)
     print(json.dumps(report))

@@ -123,6 +123,26 @@ class EngineConfig:
     #: vocab/tokenizer) + optional checkpoint dir for its weights
     draft_model: str = ""
     draft_checkpoint: str = ""
+    #: batched speculative decoding in the CONTINUOUS scheduler (paged mode):
+    #: up to this many ngram-proposed draft tokens per speculating slot per
+    #: round, verified as ONE q_len=k+1 ragged span inside the mixed-batch
+    #: dispatch with accept/reject, accepted-length and rollback computed on
+    #: device (a rejected suffix's KV is rewritten before any later read —
+    #: runtime/scheduler.py "speculative rounds"). Greedy-only per slot
+    #: (temperature 0) and lossless: greedy streams are byte-identical to
+    #: ``scheduler_spec_k=0`` — speculation changes speed, never text.
+    #: 0 = off (the default: streams bit-identical to the pre-speculation
+    #: scheduler). Drafts come from each stream's own emitted-token history
+    #: (prompt-lookup / NgramProposer). The legacy ``speculative``/``spec_k``
+    #: fields keep driving only the lockstep InferenceEngine path.
+    scheduler_spec_k: int = 0
+    #: adaptive per-stream speculation gate (continuous scheduler): after a
+    #: probation window of 4*scheduler_spec_k proposed drafts, a stream whose
+    #: rolling acceptance rate sits below this floor stops proposing for the
+    #: rest of its life — its verify width was pure waste on that text.
+    #: 0.0 = never disable. Deterministic per stream and acceptance-checked,
+    #: so the gate can only ever change speed, never token values.
+    spec_min_accept: float = 0.0
     #: continuous scheduler (paged mode only): lookahead DEPTH — up to this
     #: many decode chunks are kept in flight beyond the one being drained
     #: (an epoch ring). Each chunk chains off device-resident state, so the

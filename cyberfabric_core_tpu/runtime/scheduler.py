@@ -113,6 +113,7 @@ from ..ops.sampling import sample_token, sample_token_per_slot, split_keys_per_s
 from .engine import (EngineConfig, SamplingParams, SchedulerSaturated,
                      StepEvent, TenantQuotaExceeded, TenantSaturated,
                      build_decode_chunk_fn)
+from .speculative import NgramProposer, greedy_accept_counts
 
 logger = logging.getLogger("scheduler")
 
@@ -161,6 +162,18 @@ class _SlotState:
     #: gateway/worker): decode tokens are charged to its virtual counter,
     #: per-tenant caps count this slot, and the cap sweep can yield it
     tenant: str = "default"
+    #: batched speculative decoding (paged mode, scheduler_spec_k > 0): the
+    #: per-stream prompt-lookup proposer, fed every emitted token from
+    #: _emit_token. Armed at decode activation only for ELIGIBLE requests —
+    #: temperature 0 (verification is argmax equality: lossless) whose token
+    #: limit fires before the window bound ever could, so window-bound
+    #: streams keep the exact k=0 chunk-boundary "length" semantics by never
+    #: speculating. None = this stream never proposes (also the
+    #: spec_min_accept adaptive gate's sticky off state).
+    proposer: Any = None
+    #: rolling acceptance evidence for the spec_min_accept gate
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
 
 @dataclass
@@ -607,6 +620,28 @@ class ContinuousBatchingEngine:
         self._ring: "_deque[_InflightChunk]" = _deque()
         self._lookahead_depth = (config.resolve_lookahead_depth()
                                  if self.paged else 0)
+        #: batched speculative decoding: k draft tokens per speculating slot
+        #: per round, verified as a q_len=k+1 ragged span in the mixed-batch
+        #: dispatch (paged mode only — the span rides the ragged kernel).
+        #: 0 disables everything: no spec program is built and every round
+        #: takes the exact pre-speculation code path (the bit-identity
+        #: default the k=0 goldens pin).
+        self.spec_k = (max(0, int(config.scheduler_spec_k))
+                       if self.paged else 0)
+        if config.scheduler_spec_k > 0 and not self.paged:
+            logger.info("scheduler_spec_k=%d needs the paged scheduler "
+                        "(prefix_cache_pages > 0); speculation disabled",
+                        config.scheduler_spec_k)
+        self._spec_w = self.spec_k + 1
+        #: acceptance observability (stats()["speculative"]): rounds that
+        #: carried at least one draft span, the subset that also carried
+        #: prefill chunks, draft tokens proposed vs accepted on device, the
+        #: tokens emitted through spec rounds, the accept-length histogram
+        #: (rounds × per-slot spans binned by accepted count), and streams
+        #: the spec_min_accept gate switched off
+        self.spec_stats = {"rounds": 0, "mixed_rounds": 0, "proposed": 0,
+                           "accepted": 0, "emitted": 0, "slots_disabled": 0}
+        self._spec_accept_hist: dict[int, int] = {}
         self._build_programs()
 
         # metrics (BASELINE observability: batch occupancy, tokens/sec, and
@@ -795,6 +830,126 @@ class ContinuousBatchingEngine:
                         new_lens, fin_out, active_out)
 
             self._mixed_step_fn = jax.jit(mixed_step, donate_argnums=(1, 2))
+
+            if self.spec_k:
+                spec_w = self._spec_w
+
+                def spec_mixed_step(params, k_pool, v_pool, page_table,
+                                    q_ids, q_lens, prefill_hist, last_tokens,
+                                    lengths, active, finished, sample_mask,
+                                    final_mask, final_lens, spec_lens,
+                                    stop_ids, limit_lens, keys,
+                                    temp, top_p, top_k):
+                    """mixed_step + k-token speculation: speculating rows run
+                    their draft span (q_len = 1 + spec_lens ≤ spec_w, q_ids =
+                    [last_token, d_1..d_d]) through the SAME ragged dispatch
+                    as decode rows (q_len=1) and prefill-chunk rows. Greedy
+                    accept/reject, accepted-length, per-position stop/limit
+                    truncation and the length advance all happen HERE, on
+                    device — only the [N, spec_w] emit matrix (-1 sentinels
+                    past each row's commit) and the accept counts cross to
+                    the host.
+
+                    Rollback is rewrite-before-read: a rejected suffix's KV
+                    sits at positions new_length..L+d of the row's own chain
+                    pages — masked out of attention by the per-row length
+                    bounds, and every later dispatch's span starts at the
+                    committed length and scatters BEFORE it attends, so the
+                    stale entries are overwritten before any read (the same
+                    discipline the discarded-ring argument rests on). Non-
+                    speculating rows compute bit-identically to mixed_step;
+                    greedy speculating rows commit exactly the tokens plain
+                    decode would have produced (acceptance is argmax
+                    equality), so speculation changes speed, never text."""
+                    run = active & jnp.logical_not(finished)
+                    q_ids = q_ids.at[:, 0].set(
+                        jnp.where(active, last_tokens, q_ids[:, 0]))
+                    hist = jnp.where(active, lengths, prefill_hist)
+                    hidden, pools = llama.forward_paged_mixed(
+                        params, cfg, q_ids, (k_pool, v_pool), page_table,
+                        hist, q_lens, rope,
+                        write_mask=run | jnp.logical_not(active))
+                    last_h = llama.gather_last_hidden(hidden, q_lens)
+                    logits = llama.lm_head_logits(params, cfg, last_h)
+                    keys2, subs = split_keys_per_slot(keys)
+                    nxt = sample_token_per_slot(logits, subs, temp, top_p,
+                                                top_k)
+                    # verify: per-position argmax over the span's first
+                    # spec_w positions (q_lens ≤ spec_w for speculating rows;
+                    # prefill rows ignore these logits entirely)
+                    N = q_ids.shape[0]
+                    H = hidden.shape[-1]
+                    span_h = jax.lax.dynamic_slice_in_dim(hidden, 0, spec_w,
+                                                          axis=1)
+                    span_logits = llama.lm_head_logits(
+                        params, cfg, span_h.reshape(N * spec_w, H))
+                    outs = jnp.argmax(span_logits, axis=-1).astype(
+                        jnp.int32).reshape(N, spec_w)
+                    spec = (spec_lens > 0) & run
+                    a = greedy_accept_counts(outs, q_ids[:, 1:spec_w],
+                                             spec_lens)
+                    # committed[i] = the model's token after the accepted
+                    # prefix of length i. Position 0 keeps the sampled path
+                    # for non-spec rows (bit-identity with mixed_step);
+                    # spec rows are greedy, so outs[:, 0] IS that argmax.
+                    committed = outs.at[:, 0].set(
+                        jnp.where(spec, outs[:, 0], nxt))
+                    n_commit = jnp.where(spec, a + 1, 1)
+                    idx = jnp.arange(spec_w, dtype=jnp.int32)[None, :]
+                    in_commit = idx < n_commit[:, None]
+                    is_stop = jnp.any(
+                        committed[:, :, None] == stop_ids[:, None, :],
+                        axis=2)
+                    # per-position termination, mirroring mixed_step's
+                    # single-token rule exactly at idx 0 (final-chunk prefill
+                    # rows carry lengths=0 on device — their post-token
+                    # length is final_lens, hence eff_len)
+                    eff_len = jnp.where(
+                        run, lengths,
+                        jnp.where(final_mask, final_lens - 1, lengths))
+                    len_after = eff_len[:, None] + idx + 1
+                    hit = (len_after >= limit_lens[:, None]) | (
+                        len_after + k_steps > max_seq)
+                    fin_at = (is_stop | hit) & in_commit
+                    # token i commits only while no stop/limit fired before
+                    # it: the accepted suffix past a terminal is dropped ON
+                    # DEVICE, the same truncation the scan chunk's freeze
+                    # gives mid-chunk finishes
+                    alive = jnp.cumprod(
+                        1 - jnp.pad(fin_at.astype(jnp.int32),
+                                    ((0, 0), (1, 0)))[:, :spec_w],
+                        axis=1) > 0
+                    emit = in_commit & alive
+                    n_emit = jnp.sum(emit.astype(jnp.int32), axis=1)
+                    sample = sample_mask & jnp.logical_not(finished)
+                    toks = jnp.where(emit & sample[:, None], committed, -1)
+                    new_last = jnp.where(
+                        sample,
+                        jnp.take_along_axis(
+                            committed,
+                            jnp.maximum(n_emit - 1, 0)[:, None],
+                            axis=1)[:, 0],
+                        last_tokens)
+                    keys_out = jnp.where(sample[:, None], keys2, keys)
+                    new_lens = jnp.where(
+                        run, lengths + n_emit,
+                        jnp.where(final_mask, final_lens,
+                                  jnp.where(active, lengths, 0)))
+                    fin_out = finished | (sample & jnp.any(fin_at & emit,
+                                                           axis=1))
+                    active_out = active | final_mask
+                    # accept counts ride the emit matrix's last column (-1
+                    # for non-spec rows): ONE drain carries tokens AND the
+                    # acceptance evidence — the round keeps its single
+                    # sanctioned sync point (AS04)
+                    a_out = jnp.where(spec, a, -1)
+                    toks_out = jnp.concatenate([toks, a_out[:, None]],
+                                               axis=1)
+                    return (toks_out, pools[0], pools[1], new_last,
+                            keys_out, new_lens, fin_out, active_out)
+
+                self._spec_step_fn = jax.jit(spec_mixed_step,
+                                             donate_argnums=(1, 2))
         else:
             def insert(k_cache, v_cache, k_new, v_new, slot):
                 return llama.insert_slot_kv((k_cache, v_cache), (k_new, v_new), slot)
@@ -1483,9 +1638,27 @@ class ContinuousBatchingEngine:
             "prefill_chunks": self.prefill_chunks,
             "chunked_prefill_tokens": self.chunked_prefill_tokens,
         }
+        try:  # the scheduler thread inserts new accept-length keys mid-copy
+            accept_hist = dict(self._spec_accept_hist)
+        except RuntimeError:
+            accept_hist = {}
+        spec = dict(self.spec_stats)
+        speculative = {
+            "k": self.spec_k,
+            **spec,
+            "accept_rate": round(
+                spec["accepted"] / max(1, spec["proposed"]), 3),
+            "accept_hist": {str(a): n
+                            for a, n in sorted(accept_hist.items())},
+        }
         return {
             "broken": self._broken,
             "closed": self._closed,
+            # batched speculative decoding: rounds that carried draft spans,
+            # draft tokens proposed vs device-accepted, tokens emitted via
+            # spec rounds, and the acceptance-length histogram the perf
+            # claim rests on (BENCH_SPEC.json reads this surface)
+            "speculative": speculative,
             "prefix_cache": self.pool.stats() if self.pool is not None else None,
             "slots": self.n_slots,
             "active": self.active_slots,
@@ -2307,6 +2480,29 @@ class ContinuousBatchingEngine:
                 cached_len=cached_len, tenant=req.tenant)
         self._activate_slot(slot, req, chain, tok, req_key)
 
+    def _arm_spec(self, state: _SlotState, prompt_ids: list[int]) -> None:
+        """Arm per-stream speculation at decode activation (phase-separated
+        AND chunked-prefill flips both land here). Eligibility: greedy only —
+        verification is argmax equality, so acceptance is lossless — and the
+        request's token limit must fire before the window bound ever could
+        (limit + decode_chunk ≤ max_seq): a window-bound stream's "length"
+        finish lands on a k=0 chunk boundary, which speculation's variable
+        advance would move, so those streams simply never speculate. The
+        proposer is seeded with the prompt; _emit_token feeds it every
+        emitted token from the first one on."""
+        if not self.spec_k:
+            return
+        s = state.sampling
+        if s.temperature != 0.0:
+            return
+        if len(prompt_ids) + s.max_tokens - 1 + self._k_steps \
+                > self.config.max_seq_len:
+            return
+        proposer = NgramProposer(self.config.spec_max_ngram,
+                                 self.config.spec_min_ngram, self.spec_k)
+        proposer.extend(list(prompt_ids))
+        state.proposer = proposer
+
     def _activate_slot(self, slot: int, req: _Pending,
                        chain: Optional[list[int]], tok: int,
                        slot_key: Any) -> None:
@@ -2338,6 +2534,7 @@ class ContinuousBatchingEngine:
             deadline=req.deadline,
             tenant=req.tenant,
         )
+        self._arm_spec(state, req.prompt_ids)
         T = len(req.prompt_ids)
         self.slots[slot] = state
         self.lengths[slot] = T
@@ -2353,6 +2550,11 @@ class ContinuousBatchingEngine:
     def _emit_token(self, slot: int, tok: int, force_length: bool = False) -> None:
         state = self.slots[slot]
         assert state is not None
+        if state.proposer is not None:
+            # proposer feeding: every emitted token extends this stream's
+            # ngram index, so the next round's proposals come from the live
+            # emitted history (prompt-lookup decoding)
+            state.proposer.extend([tok])
         state.emitted += 1
         # decode charge: one actually-emitted token against the tenant's
         # virtual counter (plain dict math — AS04/WD01 clean)
@@ -2577,6 +2779,11 @@ class ContinuousBatchingEngine:
             return False
         if self._free_slots and (self._suspended or not self._pending.empty()):
             return False  # an admission next round would invalidate it
+        if self.spec_k and self._spec_round_safe() and self._spec_candidates():
+            # live draft proposals: stop deepening the ring so it drains and
+            # the next dispatch speculates instead — a k-token verify span
+            # beats a chained plain chunk on the same traffic
+            return False
         k = self._k_steps
         horizon = (len(self._ring) + 1) * k
         max_seq = self.config.max_seq_len
@@ -2629,7 +2836,8 @@ class ContinuousBatchingEngine:
                       ts: Optional[float] = None,
                       mixed: bool = False,
                       chunk_tokens: int = 0,
-                      depth: int = 0) -> None:
+                      depth: int = 0,
+                      spec_tokens: int = 0) -> None:
         """One timing-schema owner for both decode modes — the stats()
         percentile keys cannot drift between paged and dense. ``ts`` is the
         round's wall-clock start; /v1/monitoring/rounds exports these entries
@@ -2650,6 +2858,7 @@ class ContinuousBatchingEngine:
             "mixed": mixed,
             "chunk_tokens": chunk_tokens,
             "depth": depth,
+            "spec_tokens": spec_tokens,
             "active": self.active_slots,
         })
 
@@ -2735,6 +2944,7 @@ class ContinuousBatchingEngine:
             logger.exception("prefix-tree commit failed for %s",
                              state.request_id)
         state.phase = "decode"
+        self._arm_spec(state, state.prompt_ids)
         self._prefill_slots.remove(slot)
         self.lengths[slot] = T
         self.active[slot] = True
@@ -2763,6 +2973,108 @@ class ContinuousBatchingEngine:
         no_room = T + self._k_steps > self.config.max_seq_len
         self._emit_token(slot, tok, force_length=no_room)
 
+    # ------------------------------------------------------------ speculation
+    def _spec_candidates(self) -> bool:
+        """Cheap pre-check: some active decode row is armed for speculation
+        and its proposer has a draft RIGHT NOW (a few dict probes per slot).
+        Gates both the spec-round entry and ring deepening — the ring stops
+        growing while speculation is ready, so it drains in a round or two
+        and the next dispatch carries draft spans instead."""
+        if not self.spec_k:
+            return False
+        for slot in range(self.n_slots):
+            state = self.slots[slot]
+            if (state is not None and self.active[slot]
+                    and state.proposer is not None
+                    and state.proposer.propose()):
+                return True
+        return False
+
+    def _spec_round_safe(self) -> bool:
+        """A pure-decode round may become a speculative round only while
+        EVERY active row is LIMIT-bound — its max-tokens limit fires before
+        the window bound ever could (limit + decode_chunk ≤ max_seq). A
+        limit-bound stream finishes at exactly max_tokens regardless of how
+        rounds chunk its advance, so variable spec-round advances cannot
+        move its terminal; a window-bound stream's "length" finish lands on
+        a chunk-lattice point, which a 1-token spec-round advance would
+        shift — k>0 must never move that finish off its k=0 boundary (the
+        byte-identity contract), so those batches just keep taking plain
+        chunks to the brim."""
+        max_seq = self.config.max_seq_len
+        for slot in range(self.n_slots):
+            if not self.active[slot]:
+                continue
+            state = self.slots[slot]
+            if state is None:
+                continue
+            limit = (int(self.lengths[slot]) - state.emitted
+                     + state.sampling.max_tokens)
+            if limit + self._k_steps > max_seq:
+                return False
+        return True
+
+    def _spec_gate_closed(self, state: _SlotState) -> bool:
+        """spec_min_accept: after a probation window of 4k proposed drafts,
+        a stream whose rolling acceptance rate sits below the floor stops
+        proposing for good (sticky — the proposer and its index memory are
+        dropped). Deterministic per stream and acceptance-checked, so the
+        gate can only change speed, never tokens."""
+        floor = self.config.spec_min_accept
+        if floor <= 0.0:
+            return False
+        if state.spec_proposed < 4 * self.spec_k:
+            return False
+        if state.spec_accepted < floor * state.spec_proposed:
+            state.proposer = None
+            self.spec_stats["slots_disabled"] += 1
+            record_event(state.request_id, "spec_disabled",
+                         proposed=state.spec_proposed,
+                         accepted=state.spec_accepted)
+            return True
+        return False
+
+    def _plan_spec(self, budget_left) -> list[tuple[int, "_SlotState",
+                                                    list[int]]]:
+        """Plan this round's draft spans: one proposer probe per armed
+        active row, trimmed to the shared ragged token budget (speculation
+        and prefill chunks draw from the same prefill_budget_tokens pool),
+        the row's remaining token allowance (a draft past max_tokens can
+        never commit), the window guard, and page-chain coverage — a failed
+        chain extension just skips that row's speculation this round, never
+        a preempt (the capacity sweep already guaranteed the mandatory
+        chunk)."""
+        plan: list[tuple[int, _SlotState, list[int]]] = []
+        if not self.spec_k:
+            return plan
+        max_seq = self.config.max_seq_len
+        for slot in range(self.n_slots):
+            if budget_left <= 0:
+                break
+            state = self.slots[slot]
+            if state is None or not self.active[slot] \
+                    or state.proposer is None or self._spec_gate_closed(state):
+                continue
+            L = int(self.lengths[slot])
+            if L + self._spec_w + self._k_steps > max_seq:
+                continue
+            remaining = state.sampling.max_tokens - state.emitted
+            cap = int(min(self.spec_k, remaining - 1, budget_left))
+            if cap <= 0:
+                continue
+            drafts = state.proposer.propose()
+            if not drafts:
+                continue
+            drafts = drafts[:cap]
+            try:
+                self._extend_chain_to(slot, state,
+                                      min(L + 1 + len(drafts), max_seq))
+            except MemoryError:
+                continue
+            plan.append((slot, state, drafts))
+            budget_left -= len(drafts)
+        return plan
+
     def _mixed_ring_span(self, rec: _InflightChunk,
                          finals: list[tuple[int, "_SlotState"]]) -> int:
         """Let the lookahead ring SPAN the mixed→pure-decode transition: when
@@ -2772,7 +3084,9 @@ class ContinuousBatchingEngine:
         chain straight off it, with no synchronous fallback round. Chains are
         pre-extended opportunistically; any MemoryError just caps the span
         (the next synchronous round preempts properly). Returns the number of
-        chunks chained."""
+        chunks chained. Speculative dispatches never span (see the call
+        site), so the record's device lengths always match the host mirror
+        +1 here and the horizons below stay exact."""
         depth = self._lookahead_depth
         if (depth <= 0 or len(finals) != len(self._prefill_slots)
                 or self._suspended or not self._pending.empty()
@@ -2806,7 +3120,7 @@ class ContinuousBatchingEngine:
             chained += 1
         return chained
 
-    def _decode_round_mixed(self) -> None:
+    def _decode_round_mixed(self, spec_only: bool = False) -> bool:
         """One ragged mixed-batch round: decode rows advance ONE token while
         this round's prompt chunks (≤ prefill_budget_tokens, FIFO across
         prefilling slots) run in the SAME dispatch through the ragged paged
@@ -2816,7 +3130,20 @@ class ContinuousBatchingEngine:
         work bumped the epoch) and is discarded — EXCEPT the other way
         around: when this round's plan drains the prefill queue, lookahead
         chunks chain off THIS dispatch's outputs (_mixed_ring_span), so the
-        mixed→pure-decode transition keeps the pipeline full."""
+        mixed→pure-decode transition keeps the pipeline full.
+
+        Speculative rounds (scheduler_spec_k > 0): eligible greedy rows with
+        a live ngram proposal become q_len=1+d draft spans in the SAME
+        dispatch (the _spec_step_fn variant), sharing the round's ragged
+        token budget with prefill chunks — chunks first (a cold prompt beats
+        an optimistic draft), leftovers to drafts. Accept/reject, per-row
+        advance (1..k+1 tokens) and rollback all run on device; the emit
+        loop below just walks each row's -1-terminated token list through
+        the ordinary _emit_token path, so stop/limit/charging/cancel
+        semantics are untouched. ``spec_only=True`` is the pure-decode entry
+        (no prefill slots): returns False without dispatching when no draft
+        survives planning, and the caller falls back to the plain chunk
+        round."""
         t0 = time.monotonic()
         wall0 = time.time()
         if self._ring:
@@ -2826,24 +3153,41 @@ class ContinuousBatchingEngine:
         # MemoryError on either path preempts-to-host.
         self._ensure_chunk_capacity(self._k_steps)
         plan: list[tuple[int, _SlotState, int]] = []
-        for slot, state, chunk in self._plan_prefill_chunks():
-            try:
-                self._grow_chain_prefill(slot, state, chunk)
-                plan.append((slot, state, chunk))
-            except MemoryError:
-                self._preempt_slot(slot, state)
-        if not plan:
-            # every planned slot got preempted (or flipped): the next loop
-            # pass runs a plain decode round / resumes from host
-            return
+        if not spec_only:
+            for slot, state, chunk in self._plan_prefill_chunks():
+                try:
+                    self._grow_chain_prefill(slot, state, chunk)
+                    plan.append((slot, state, chunk))
+                except MemoryError:
+                    self._preempt_slot(slot, state)
+        # speculation shares the ragged token budget: prefill chunks draw
+        # first (a cold prompt's TTFT beats an optimistic draft, and chunk
+        # pacing stays bit-identical to k=0), drafts take what is left —
+        # floored at one span's worth, so a budget-filling admission burst
+        # can't starve in-flight streams of their speculation entirely
+        # (budget 0 = unbounded, as for chunks)
+        budget = self.config.prefill_budget_tokens
+        spec_left = (max(budget - sum(c for _, _, c in plan), self.spec_k)
+                     if budget > 0 else float("inf"))
+        spec_plan = self._plan_spec(spec_left) if self.spec_k else []
+        if not plan and not spec_plan:
+            # every planned slot got preempted (or flipped), and nothing
+            # speculates: the next loop pass runs a plain decode round /
+            # resumes from host (spec_only: the caller falls through to the
+            # plain round immediately)
+            return False
         n = self.n_slots
-        max_chunk = max(c for _, _, c in plan)
         # static dispatch width: the prefill bucket covering the largest
-        # chunk, rounded to the kernel's q_block (bounded compile variants)
-        q_max = -(-self._bucket_for(max_chunk) // 8) * 8
+        # chunk — and the spec span width when rows speculate — rounded to
+        # the kernel's q_block (bounded compile variants)
+        q_need = self._bucket_for(max(c for _, _, c in plan)) if plan else 1
+        if spec_plan:
+            q_need = max(q_need, self._spec_w)
+        q_max = -(-q_need // 8) * 8
         q_ids = np.zeros((n, q_max), np.int32)
         q_lens = np.zeros(n, np.int32)
         hist = np.zeros(n, np.int32)
+        spec_lens = np.zeros(n, np.int32)
         q_lens[self.active] = 1  # decode rows
         sample = self.active.copy()
         final_mask = np.zeros(n, bool)
@@ -2864,26 +3208,51 @@ class ContinuousBatchingEngine:
                 i = jnp.asarray(slot, jnp.int32)
                 self._slot_keys = self._slot_keys.at[i].set(
                     jnp.asarray(state.prefill_key))
+        for slot, state, drafts in spec_plan:
+            # draft span: position 0 (the last committed token) is filled on
+            # device from last_tokens; the drafts follow
+            d = len(drafts)
+            q_ids[slot, 1:1 + d] = drafts
+            q_lens[slot] = 1 + d
+            spec_lens[slot] = d
         self._flush_pt_patches()
-        (toks_dev, k_pool, v_pool, last_o, keys_o, lens_o, fin_o,
-         active_o) = self._mixed_step_fn(
-            self.params, self.pool.k_pool, self.pool.v_pool,
-            self._page_table_dev, jnp.asarray(q_ids), jnp.asarray(q_lens),
-            jnp.asarray(hist), self._last_tokens, self._lengths_dev,
-            self._active_dev, self._finished_dev, jnp.asarray(sample),
-            jnp.asarray(final_mask), jnp.asarray(final_lens),
-            self._stops_dev, self._limit_dev, self._slot_keys,
-            self._temp_dev, self._top_p_dev, self._top_k_dev)
+        if spec_plan:
+            (toks_dev, k_pool, v_pool, last_o, keys_o, lens_o, fin_o,
+             active_o) = self._spec_step_fn(
+                self.params, self.pool.k_pool, self.pool.v_pool,
+                self._page_table_dev, jnp.asarray(q_ids), jnp.asarray(q_lens),
+                jnp.asarray(hist), self._last_tokens, self._lengths_dev,
+                self._active_dev, self._finished_dev, jnp.asarray(sample),
+                jnp.asarray(final_mask), jnp.asarray(final_lens),
+                jnp.asarray(spec_lens), self._stops_dev, self._limit_dev,
+                self._slot_keys, self._temp_dev, self._top_p_dev,
+                self._top_k_dev)
+        else:
+            (toks_dev, k_pool, v_pool, last_o, keys_o, lens_o, fin_o,
+             active_o) = self._mixed_step_fn(
+                self.params, self.pool.k_pool, self.pool.v_pool,
+                self._page_table_dev, jnp.asarray(q_ids), jnp.asarray(q_lens),
+                jnp.asarray(hist), self._last_tokens, self._lengths_dev,
+                self._active_dev, self._finished_dev, jnp.asarray(sample),
+                jnp.asarray(final_mask), jnp.asarray(final_lens),
+                self._stops_dev, self._limit_dev, self._slot_keys,
+                self._temp_dev, self._top_p_dev, self._top_k_dev)
         self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
         try:
             toks_dev.copy_to_host_async()  # non-blocking D2H start
         except AttributeError:
             pass
         # ring spanning: chain lookahead chunks off this dispatch BEFORE the
-        # drain, so the device keeps working while the host emits + flips
+        # drain, so the device keeps working while the host emits + flips.
+        # Speculative dispatches deliberately do NOT span: their proposals
+        # almost always recur next round (repetitive text is why they fired),
+        # and a chained plain chunk would spend k weight passes on k tokens
+        # where the next verify span spends ONE on up to k+1 — the ring
+        # instead rebuilds the moment proposals dry up (_can_extend_ring).
         mixed_rec = _InflightChunk(toks_dev, last_o, keys_o, lens_o, fin_o,
                                    active_o, self._epoch)
-        spanned = self._mixed_ring_span(mixed_rec, finals)
+        spanned = 0 if spec_plan else self._mixed_ring_span(mixed_rec,
+                                                            finals)
         t1 = time.monotonic()
         toks = np.asarray(toks_dev, np.int32)  # sync-point: mixed-round drain (AS04)
         t2 = time.monotonic()
@@ -2892,12 +3261,58 @@ class ContinuousBatchingEngine:
         self._slot_keys = keys_o
         self._lengths_dev = lens_o
         self._finished_dev = fin_o
+        # spec dispatches return [n, spec_w + 1]: -1-sentinel emit columns
+        # plus the accept-count column (one drain carries both); plain mixed
+        # returns [n] — normalize to 2-D so one emit loop serves both
+        if toks.ndim == 2:
+            toks2d, accepts = toks[:, :-1], toks[:, -1]
+        else:
+            toks2d, accepts = toks[:, None], None
         decode_rows = [s for s in range(n) if self.active[s]]
         old_lengths = self.lengths.copy()
-        self.lengths = np.where(self.active, self.lengths + 1,
-                                self.lengths).astype(np.int32)
+        if spec_plan:
+            # variable per-slot advance: the host mirror adopts each row's
+            # actual emit count (1..k+1), matching the device's new_lens
+            adv = (toks2d >= 0).sum(axis=1).astype(np.int32)
+            self.lengths = np.where(self.active, self.lengths + adv,
+                                    self.lengths).astype(np.int32)
+        else:
+            self.lengths = np.where(self.active, self.lengths + 1,
+                                    self.lengths).astype(np.int32)
+        spec_slots = {slot: (state, drafts)
+                      for slot, state, drafts in spec_plan}
+        row_tokens = {slot: int((toks2d[slot] >= 0).sum())
+                      for slot in decode_rows} if spec_plan else None
+        row_attrs = {slot: {"spec_proposed": len(drafts),
+                            "spec_accepted": int(accepts[slot])}
+                     for slot, (state, drafts) in spec_slots.items()} \
+            if spec_plan else None
         self._emit_decode_spans(wall0, (t2 - t0) * 1000.0, lookahead=False,
-                                rows=decode_rows, tokens=1, depth=spanned)
+                                rows=decode_rows, tokens=1, depth=spanned,
+                                row_tokens=row_tokens, row_attrs=row_attrs)
+        # acceptance accounting BEFORE the emit loop (a mid-row finish
+        # clears the slot state): totals, the accept-length histogram, the
+        # per-stream evidence the spec_min_accept gate reads, and the
+        # monitoring counters
+        if spec_plan:
+            self.spec_stats["rounds"] += 1
+            if plan:
+                self.spec_stats["mixed_rounds"] += 1
+            round_proposed = round_accepted = 0
+            for slot, (state, drafts) in spec_slots.items():
+                a = int(accepts[slot])
+                d = len(drafts)
+                round_proposed += d
+                round_accepted += a
+                self.spec_stats["proposed"] += d
+                self.spec_stats["accepted"] += a
+                self.spec_stats["emitted"] += int((toks2d[slot] >= 0).sum())
+                self._spec_accept_hist[a] = \
+                    self._spec_accept_hist.get(a, 0) + 1
+                state.spec_proposed += d
+                state.spec_accepted += a
+            bump_counter("llm_spec_tokens_proposed_total", n=round_proposed)
+            bump_counter("llm_spec_tokens_accepted_total", n=round_accepted)
         for slot, state, chunk in plan:
             state.prefill_pos += chunk
             state.prefill_chunks += 1
@@ -2920,28 +3335,37 @@ class ContinuousBatchingEngine:
         for slot, state in finals:
             # spanned flips must not bump the epoch: the chained ring chunks
             # already carry the flip state (device-computed) and stay valid
-            self._finish_prefill(slot, state, int(toks[slot]),
+            self._finish_prefill(slot, state, int(toks2d[slot, 0]),
                                  bump_epoch=spanned == 0)
         for slot in decode_rows:
             state = self.slots[slot]
             if state is None or not self.active[slot]:
                 continue
+            n_row = int((toks2d[slot] >= 0).sum())
+            extra = row_attrs.get(slot, {}) if row_attrs else {}
             record_event(state.request_id, "decode_chunk", slot=slot,
-                         tokens=1, depth=spanned)
-            # keep the invariant: after this token the slot must still fit a
-            # full decode chunk, else finish with 'length' now
-            no_room = (int(old_lengths[slot]) + 1 + self._k_steps
-                       > self.config.max_seq_len)
-            self._emit_token(slot, int(toks[slot]), force_length=no_room)
+                         tokens=n_row, depth=spanned, **extra)
+            for j in range(n_row):
+                if not self.active[slot]:
+                    break  # a host-authoritative finish truncates the row
+                # keep the invariant: after each token the slot must still
+                # fit a full decode chunk, else finish with 'length' now
+                no_room = (int(old_lengths[slot]) + j + 1 + self._k_steps
+                           > self.config.max_seq_len)
+                self._emit_token(slot, int(toks2d[slot, j]),
+                                 force_length=no_room)
         # a host-fallback stop during the emit stales the spanned suffix
         if self._ring and self._ring[0].epoch != self._epoch:
             self._discard_ring()
         t3 = time.monotonic()
         self._record_round((t1 - t0) * 1000.0, (t2 - t1) * 1000.0,
                            (t3 - t2) * 1000.0, lookahead=False, ts=wall0,
-                           mixed=True,
+                           mixed=bool(plan),
                            chunk_tokens=sum(c for _, _, c in plan),
-                           depth=spanned)
+                           depth=spanned,
+                           spec_tokens=sum(len(dr)
+                                           for _, _, dr in spec_plan))
+        return True
 
     def _decode_round(self) -> None:
         self.occupancy_samples.append(self.active_slots)
@@ -2951,6 +3375,17 @@ class ContinuousBatchingEngine:
         if self.mixed and self._prefill_slots:
             self._decode_round_mixed()
             return
+        if self.spec_k and not self._ring and self._spec_round_safe() \
+                and self._spec_candidates():
+            # speculative round: draft spans through the ragged dispatch
+            # (commits 1..k+1 tokens per speculating row for ONE weight
+            # pass). Runs only off a drained ring — in-flight plain chunks
+            # are valid and drain first; _can_extend_ring stops deepening
+            # the ring while proposals are live, so this engages within a
+            # round or two. Falls through to the plain chunk round when no
+            # draft survives planning (budget/pages/limits).
+            if self._decode_round_mixed(spec_only=True):
+                return
         t0 = time.monotonic()
         wall0 = time.time()
         depth = self._lookahead_depth
@@ -3000,25 +3435,32 @@ class ContinuousBatchingEngine:
     def _emit_decode_spans(self, wall0: float, dur_ms: float,
                            lookahead: bool, rows: Optional[list[int]] = None,
                            tokens: Optional[int] = None,
-                           depth: int = 0) -> None:
+                           depth: int = 0,
+                           row_tokens: Optional[dict] = None,
+                           row_attrs: Optional[dict] = None) -> None:
         """llm.decode_chunk spans for SAMPLED in-flight requests — called
         before the emit loop (a mid-chunk finish clears the slot state). The
         guard is one bool attribute per slot: an unsampled or traceless
         request pays nothing here (the disarmed-failpoint pattern; the
         bench.py --trace-guard A/B holds this under 1% tok/s). Mixed rounds
         pass ``rows`` (their decode rows only) and ``tokens=1``. ``depth`` is
-        the ring depth still in flight at this round's drain."""
+        the ring depth still in flight at this round's drain. Speculative
+        rounds pass ``row_tokens`` (per-slot variable advance) and
+        ``row_attrs`` (spec_proposed/spec_accepted stamps — the depth-style
+        acceptance evidence on each span)."""
         k = tokens if tokens is not None else self._k_steps
         start_ns = int(wall0 * 1e9)
         for slot in (rows if rows is not None else range(self.n_slots)):
             state = self.slots[slot]
             if state is None or not state.trace_sampled or not self.active[slot]:
                 continue
+            extra = row_attrs.get(slot, {}) if row_attrs else {}
             get_global_tracer().emit_span(
                 "llm.decode_chunk", traceparent=state.trace,
                 start_unix_ns=start_ns, duration_ms=dur_ms,
-                request_id=state.request_id, slot=slot, tokens=k,
-                lookahead=lookahead, depth=depth)
+                request_id=state.request_id, slot=slot,
+                tokens=row_tokens.get(slot, k) if row_tokens else k,
+                lookahead=lookahead, depth=depth, **extra)
 
     def _decode_round_dense(self) -> None:
         """Dense (non-paged) synchronous round. All per-slot state —

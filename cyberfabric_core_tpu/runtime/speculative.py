@@ -57,6 +57,10 @@ class NgramProposer:
         #: The sequence tail is always its own latest occurrence, so propose()
         #: reads the PREVIOUS slot.
         self._index: dict[tuple[int, ...], tuple[int, Optional[int]]] = {}
+        #: propose() memo keyed by the sequence length it was computed at —
+        #: the scheduler probes the proposer several times per round (ring
+        #: gate, round gate, plan), all against the same unchanged tail
+        self._memo: tuple[int, Optional[list[int]]] = (-1, None)
 
     def extend(self, tokens: list[int]) -> None:
         for tok in tokens:
@@ -69,8 +73,13 @@ class NgramProposer:
                     self._index[gram] = (end, prev[0] if prev else None)
 
     def propose(self) -> Optional[list[int]]:
-        """Up to k draft tokens, or None when no tail n-gram has recurred."""
+        """Up to k draft tokens, or None when no tail n-gram has recurred.
+        Memoized per sequence length (repeat probes between extends are
+        free)."""
         end = len(self.tokens)
+        if self._memo[0] == end:
+            return self._memo[1]
+        result: Optional[list[int]] = None
         for n in range(self.max_n, self.min_n - 1, -1):
             if end < n:
                 continue
@@ -82,8 +91,45 @@ class NgramProposer:
             if pos is not None:
                 drafts = self.tokens[pos:pos + self.k]
                 if drafts:
-                    return drafts
-        return None
+                    result = drafts
+                    break
+        self._memo = (end, result)
+        return result
+
+
+def span_verify_logits(params, model_config: ModelConfig, cache, tokens,
+                       lengths, rope_tables):
+    """THE shared verify forward: run a [B, T] draft span (tokens[:, 0] is
+    the last committed token, whose KV is not yet in cache; tokens[:, 1:]
+    the drafts) at positions lengths..lengths+T-1 against the cache and
+    return (per-position logits [B*T, V], updated cache). Both legacy
+    verify builders (greedy + acceptance-sampling) and the continuous
+    scheduler's ragged spec program share this prologue's semantics —
+    logits[:, i] is the model's next-token distribution after consuming
+    tokens[:, :i+1] — so acceptance math can never drift between paths."""
+    B, T = tokens.shape
+    positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    hidden, cache = llama.forward(
+        params, model_config, tokens, positions, cache, lengths, rope_tables)
+    H = hidden.shape[-1]
+    logits = llama.lm_head_logits(
+        params, model_config, hidden.reshape(B * T, H))
+    return logits, cache
+
+
+def greedy_accept_counts(outs: jnp.ndarray, drafts: jnp.ndarray,
+                         draft_lens: jnp.ndarray) -> jnp.ndarray:
+    """Device-side greedy acceptance: ``outs`` [N, S] is the per-position
+    argmax of a verify span (S = k+1), ``drafts`` [N, S-1] the proposed
+    tokens, ``draft_lens`` [N] how many are real (the rest padding). Returns
+    [N] — the number of leading drafts equal to the model's own argmax
+    continuation (``accept_length``'s vectorized twin; one source of truth
+    for the scheduler's on-device accept and any batched host caller)."""
+    S = outs.shape[1]
+    pos = jnp.arange(S - 1, dtype=jnp.int32)[None, :]
+    match = (drafts == outs[:, :-1]) & (pos < draft_lens[:, None])
+    return jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                   axis=1).astype(jnp.int32)
 
 
 def build_verify_fn(model_config: ModelConfig, k: int,
@@ -100,13 +146,9 @@ def build_verify_fn(model_config: ModelConfig, k: int,
 
     def verify(params, k_cache, v_cache, tokens, lengths):
         B, T = tokens.shape
-        positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
-        hidden, cache = llama.forward(
-            params, model_config, tokens, positions, (k_cache, v_cache),
-            lengths, rope_tables)
-        H = hidden.shape[-1]
-        logits = llama.lm_head_logits(
-            params, model_config, hidden.reshape(B * T, H))
+        logits, cache = span_verify_logits(
+            params, model_config, (k_cache, v_cache), tokens, lengths,
+            rope_tables)
         out = jnp.argmax(logits, axis=-1).astype(jnp.int32).reshape(B, T)
         return out, cache[0], cache[1]
 
@@ -275,13 +317,9 @@ def build_verify_accept_fn(model_config: ModelConfig, k: int,
         from ..ops.sampling import warped_probs
 
         B, T = tokens.shape  # B == 1, T == k + 1
-        positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
-        hidden, cache = llama.forward(
-            params, model_config, tokens, positions, (k_cache, v_cache),
-            lengths, rope_tables)
-        H = hidden.shape[-1]
-        logits = llama.lm_head_logits(
-            params, model_config, hidden.reshape(B * T, H))  # [k+1, V]
+        logits, cache = span_verify_logits(
+            params, model_config, (k_cache, v_cache), tokens, lengths,
+            rope_tables)  # [k+1, V]
         t_probs = warped_probs(logits, jnp.broadcast_to(temp, (T,)),
                                jnp.broadcast_to(top_p, (T,)),
                                jnp.broadcast_to(top_k, (T,)))  # [k+1, V]
