@@ -5,18 +5,29 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench lifecycle-guard cancel-guard fairness-guard
+.PHONY: safety lint lock-graph lock-graph-check modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench lifecycle-guard cancel-guard fairness-guard
 
-safety: lint modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench lifecycle-guard cancel-guard fairness-guard  ## the full local gate
+safety: lint lock-graph-check modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench lifecycle-guard cancel-guard fairness-guard  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
 
-lint:  ## fabric-lint (AS/JP/LK + migrated DE01-DE13 + EC01 families, SARIF artifact) + pytest driver + license audit (deny.toml parity)
+lint:  ## fabric-lint (AS/JP/LK/RC interprocedural + migrated DE/EC families, SARIF artifact) + pytest driver + concurrency stress + license audit (deny.toml parity)
 	@mkdir -p $(dir $(LINT_SARIF))
 	$(PY) -m cyberfabric_core_tpu.apps.fabric_lint cyberfabric_core_tpu \
 		--format sarif --output $(LINT_SARIF)
 	$(PY) -m pytest tests/test_arch_lint.py tests/test_fabric_lint.py \
+		tests/test_concurrency_stress.py \
 		tests/test_license_audit.py -q -m "not slow"
+
+lock-graph:  ## regenerate the checked lock-hierarchy artifact (docs/lock_graph.json) from the code
+	$(PY) -m cyberfabric_core_tpu.apps.fabric_lint cyberfabric_core_tpu \
+		--lock-graph json --output docs/lock_graph.json
+
+lock-graph-check:  ## drift check: the committed hierarchy doc matches the regenerated graph (and stays acyclic)
+	@$(PY) -m cyberfabric_core_tpu.apps.fabric_lint cyberfabric_core_tpu \
+		--lock-graph json --output build/lock_graph.regen.json
+	@diff -u docs/lock_graph.json build/lock_graph.regen.json \
+		|| { echo "docs/lock_graph.json is stale — run 'make lock-graph' and commit"; exit 1; }
 
 modelcheck:  ## kani parity: exhaustive pool-protocol model check + scheduler admission invariant walks
 	$(PY) -m pytest tests/test_model_check_pool.py tests/test_model_check_scheduler.py -q

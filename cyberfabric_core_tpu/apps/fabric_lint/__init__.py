@@ -13,12 +13,20 @@ three semantic families the old tier could not express:
   traced arguments, and captured-state mutation inside jit-traced functions.
 - **LK — lock-discipline**: writes to lock-guarded attributes of the
   scheduler/pool classes outside their declared lock scopes.
+- **RC — fabric-race (interprocedural)**: a second, whole-program pass
+  (``project_model.py``) builds a per-class lock inventory, a call graph
+  with lock-context propagation, and a derived guarded-by map; the RC01–04
+  rules find lock-order inversions (with witness paths), mixed-guard
+  writes/RMWs, blocking-while-locked, and unguarded iteration over shared
+  resizable collections. ``--lock-graph json|dot`` dumps the inferred
+  acquisition-order hierarchy (the committed ``docs/lock_graph.json``).
 - **DE/EC — design/error-catalog**: the migrated DE01–DE13 + EC01 families.
 
 Usage:
     python -m cyberfabric_core_tpu.apps.fabric_lint PATH...
         [--select AS,JP01] [--format text|json|sarif] [--output FILE]
         [--baseline FILE] [--no-default-baseline] [--list-rules]
+        [--lock-graph json|dot]
 
 Findings are suppressed inline with::
 

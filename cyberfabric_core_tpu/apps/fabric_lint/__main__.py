@@ -10,7 +10,8 @@ import sys
 from pathlib import Path
 
 from .emitters import emit_json, emit_sarif, emit_text
-from .engine import Engine, all_rules, dump_baseline, load_baseline
+from .engine import (Engine, ProjectContext, all_rules, dump_baseline,
+                     load_baseline, load_contexts)
 
 #: baseline committed next to the other gate configs; resolved against the
 #: repo root (parent of the scanned package) so the CLI works from anywhere
@@ -29,8 +30,9 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="fabric_lint",
         description="AST/dataflow analyzer: async-safety (AS), jit-purity "
-                    "(JP), lock-discipline (LK), design (DE) and "
-                    "error-catalog (EC) rule families.")
+                    "(JP), lock-discipline (LK), interprocedural races "
+                    "(RC, fabric-race), design (DE) and error-catalog (EC) "
+                    "rule families.")
     parser.add_argument("paths", nargs="*", type=Path,
                         help="files or package roots to lint")
     parser.add_argument("--select", default="",
@@ -49,6 +51,12 @@ def main(argv: list[str] | None = None) -> int:
                         help="snapshot current unwaived findings as the new "
                              "baseline and exit 0")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--lock-graph", choices=("json", "dot"), default=None,
+                        help="instead of linting, dump the inferred "
+                             "acquisition-order lock graph (nodes, order "
+                             "edges with witnesses, guarded-by map, cycles) "
+                             "— the checked concurrency-hierarchy artifact "
+                             "(docs/lock_graph.json)")
     args = parser.parse_args(argv)
 
     rules = all_rules()
@@ -58,6 +66,42 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if not args.paths:
         parser.error("no paths given")
+
+    if args.lock_graph:
+        import json as _json
+
+        from .project_model import (build_project_model, lock_graph_dict,
+                                    lock_graph_dot)
+
+        contexts = []
+        parse_errors = []
+        for path in args.paths:
+            if not path.exists():
+                print(f"fabric-lint: no such path: {path}", file=sys.stderr)
+                return 2
+            contexts.extend(load_contexts(path, on_error=parse_errors.append))
+        if parse_errors:
+            # a file whose locks silently vanish would ship a WRONG
+            # hierarchy — refuse rather than regenerate from a partial scan
+            for f in parse_errors:
+                print(f"fabric-lint: {f.path}:{f.line}: {f.message}",
+                      file=sys.stderr)
+            return 2
+        model = build_project_model(
+            ProjectContext(args.paths[0].resolve(), contexts))
+        graph = lock_graph_dict(model)
+        if args.lock_graph == "dot":
+            report = lock_graph_dot(model)
+        else:
+            report = _json.dumps(graph, indent=2, sort_keys=True) + "\n"
+        if args.output:
+            args.output.parent.mkdir(parents=True, exist_ok=True)
+            args.output.write_text(report)
+            print(f"fabric-lint: lock graph written to {args.output}")
+        else:
+            sys.stdout.write(report)
+        # a cycle in the committed hierarchy is a failure even in dump mode
+        return 1 if graph["cycles"] else 0
 
     baseline = {}
     baseline_path = args.baseline

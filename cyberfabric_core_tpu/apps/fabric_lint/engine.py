@@ -28,7 +28,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 
 __all__ = [
     "Engine", "FileContext", "Finding", "ProjectContext", "Rule",
-    "Scope", "all_rules", "load_baseline", "register",
+    "Scope", "all_rules", "load_baseline", "load_contexts", "register",
 ]
 
 SEVERITIES = ("error", "warning")
@@ -423,6 +423,38 @@ def _is_sync_lock_expr(expr: ast.AST) -> bool:
     return "lock" in terminal or "mutex" in terminal
 
 
+def load_contexts(root: Path, paths: Optional[Iterable[Path]] = None,
+                  on_error: Optional[Callable[[Finding], None]] = None
+                  ) -> list[FileContext]:
+    """Parse every file under ``root`` (or the explicit ``paths``) into
+    FileContexts — shared by :meth:`Engine.run` and the ``--lock-graph``
+    mode, so both see identical relpaths/tiers."""
+    root = root.resolve()
+    if paths is None:
+        paths = sorted(root.rglob("*.py")) if root.is_dir() else [root]
+        # re-root a single file or package SUBdirectory at its package
+        # root so relpath/tier match a whole-package scan — otherwise
+        # tier-gated rules silently never fire (or mis-fire)
+        base = root if root.is_dir() else root.parent
+        if (base / "__init__.py").is_file():
+            while (base.parent / "__init__.py").is_file():
+                base = base.parent
+            root = base
+        elif root.is_file():
+            root = base
+    contexts: list[FileContext] = []
+    for path in paths:
+        if "__pycache__" in path.parts:
+            continue
+        try:
+            contexts.append(FileContext(path, root))
+        except SyntaxError as e:
+            if on_error is not None:
+                on_error(Finding("XX00", "error", str(path), e.lineno or 1,
+                                 0, f"syntax error: {e.msg}"))
+    return contexts
+
+
 class Engine:
     """Run a rule set over paths; apply waivers and the baseline."""
 
@@ -454,32 +486,9 @@ class Engine:
 
     def run(self, root: Path, paths: Optional[Iterable[Path]] = None
             ) -> list[Finding]:
-        root = root.resolve()
-        if paths is None:
-            paths = sorted(root.rglob("*.py")) if root.is_dir() else [root]
-            # re-root a single file or package SUBdirectory at its package
-            # root so relpath/tier match a whole-package scan — otherwise
-            # tier-gated rules silently never fire (or mis-fire)
-            base = root if root.is_dir() else root.parent
-            if (base / "__init__.py").is_file():
-                while (base.parent / "__init__.py").is_file():
-                    base = base.parent
-                root = base
-            elif root.is_file():
-                root = base
         findings: list[Finding] = []
-        contexts: list[FileContext] = []
-        for path in paths:
-            if "__pycache__" in path.parts:
-                continue
-            try:
-                ctx = FileContext(path, root)
-            except SyntaxError as e:
-                findings.append(Finding(
-                    "XX00", "error", str(path), e.lineno or 1, 0,
-                    f"syntax error: {e.msg}"))
-                continue
-            contexts.append(ctx)
+        contexts = load_contexts(root, paths, on_error=findings.append)
+        for ctx in contexts:
             findings.extend(self._lint_file(ctx))
         return self._finish(contexts, findings)
 

@@ -1,0 +1,1014 @@
+"""Whole-program project model — pass 1 of the interprocedural analyzer.
+
+The per-function rule families (AS/JP/LK/WD) see one ``def`` at a time and
+structurally cannot catch the concurrency bug class every robustness PR has
+shipped review fixes for: ABBA deadlocks whose two acquisitions live in
+different classes, RMWs on state whose guard is only visible from *other*
+methods, and blocking calls reached two frames below the ``with self._lock:``
+that makes them dangerous. This module builds the global picture those rules
+(RC01–RC04, ``rules/races.py``) run over:
+
+- a **lock inventory**: every ``self._x = threading.Lock()`` (and RLock /
+  Condition) per class, plus module-level locks, each a :class:`LockInfo`
+  keyed by ``(owner, attr)``;
+- an **attribute type map** per class (``self._pending = TenantFairQueue()``
+  ⇒ calls through ``self._pending`` resolve into that class);
+- a **call graph** over resolved calls: ``self.method()``, ``cls._helper()``,
+  ``self.attr.method()`` through the type map, module-level functions, and
+  direct ``ClassName(...)`` construction;
+- per-method **event streams** recorded with the set of locks held at each
+  point: lock acquisitions, calls, attribute writes/RMWs, and iterations
+  over ``self`` collections (with the ``try/except RuntimeError`` snapshot
+  contract and ``locked_snapshot()`` recognized);
+- a **lock-context propagation** fixpoint: a private method only ever called
+  with ``self._lock`` held *inherits* that context, so a write inside it
+  counts as guarded (the LK01 false-positive class) and an acquisition
+  inside it creates an order edge from the inherited lock;
+- a **guarded-by map**: for each attribute, the lock that *statistically
+  dominates* its write sites — derived, never hand-listed, so the inference
+  tracks the code;
+- the **acquisition-order digraph**: an edge ``A → B`` whenever ``B`` is
+  acquired (directly or transitively through the call graph) while ``A`` is
+  held, each edge carrying a witness call path. Cycles in this graph are
+  RC01 findings; the acyclic graph is the checked lock hierarchy that
+  ``--lock-graph`` dumps (docs/lock_graph.json).
+
+Instance blindness is deliberate: two instances of one class share a lock
+node, so "engine A holds its ``_submit_lock`` while submitting into engine
+B" shows up as a self-edge — exactly the PR-8 ABBA shape, which per-instance
+modeling would miss.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .engine import FileContext, ProjectContext, dotted_name
+
+__all__ = [
+    "AcquireEvent", "CallEvent", "ClassModel", "IterEvent", "LockInfo",
+    "MethodModel", "OrderEdge", "ProjectModel", "WriteEvent",
+    "build_project_model", "lock_graph_dict", "lock_graph_dot",
+]
+
+_LOCK_FACTORIES = {
+    "threading.Lock": "Lock", "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "Lock": "Lock", "RLock": "RLock", "Condition": "Condition",
+}
+
+_MUTATORS = {"append", "extend", "add", "update", "insert", "remove",
+             "discard", "setdefault", "clear", "pop", "popleft", "popitem",
+             "appendleft", "rotate"}
+
+#: calls that materialize/iterate their first argument
+_ITER_CALLS = {"dict", "list", "tuple", "set", "sorted", "frozenset",
+               "min", "max", "sum", "any", "all", "len"}
+#: ``len``/``any``/``all``/``min``/``max``/``sum`` read the collection but a
+#: torn len() is usually benign — only these force a full traversal that can
+#: raise "changed size during iteration"
+_TRAVERSAL_CALLS = {"dict", "list", "tuple", "set", "sorted", "frozenset",
+                    "min", "max", "sum"}
+
+#: view methods whose result is lazily iterated (racy without a lock)
+_VIEW_METHODS = {"items", "values", "keys"}
+
+#: the sanctioned snapshot helper (modkit/concurrency.py) — iteration routed
+#: through it is degrade-never-raise by contract
+_SNAPSHOT_HELPERS = {"locked_snapshot"}
+
+LockKey = tuple[str, str]     # (owner qualname, attribute name)
+
+
+@dataclass(frozen=True)
+class LockInfo:
+    """One declared lock: ``(owner, attr)`` plus its factory kind."""
+
+    owner: str                # "ClassName" or "<module>" qualifier
+    attr: str                 # "_submit_lock" / module global name
+    kind: str                 # Lock | RLock | Condition
+    path: str                 # repo-relative file
+    tier: str
+    line: int
+
+    @property
+    def key(self) -> LockKey:
+        return (self.owner, self.attr)
+
+    @property
+    def label(self) -> str:
+        return f"{self.owner}.{self.attr}"
+
+
+@dataclass
+class AcquireEvent:
+    lock: LockKey
+    held: tuple[LockKey, ...]     # locks already held at the acquisition
+    line: int
+
+
+@dataclass
+class CallEvent:
+    #: ("self", meth) | ("attr", attr, meth) | ("cls", ClassName) |
+    #: ("free", name) — resolution handled by the model
+    callee: tuple
+    dotted: str                   # raw dotted spelling for pattern rules
+    held: tuple[LockKey, ...]
+    line: int
+    in_nested: bool = False
+
+
+@dataclass
+class WriteEvent:
+    attr: str
+    held: tuple[LockKey, ...]
+    line: int
+    rmw: bool                     # augmented / read-feeds-write / mutator
+    in_nested: bool = False
+    #: how the write happens: "assign" (rebind), "aug", "mutator:<name>",
+    #: "subscript:<const key>" or "subscript:*" (computed key) — the input
+    #: to resize-site classification
+    via: str = "assign"
+
+
+@dataclass
+class IterEvent:
+    attr: str
+    held: tuple[LockKey, ...]
+    line: int
+    kind: str                     # "for" | "view" | "copy" | "comprehension"
+    rte_guarded: bool             # inside try/except RuntimeError
+    via_snapshot: bool            # routed through locked_snapshot()
+
+
+@dataclass
+class MethodModel:
+    name: str
+    node: ast.AST
+    cls: "ClassModel"
+    acquires: list[AcquireEvent] = field(default_factory=list)
+    calls: list[CallEvent] = field(default_factory=list)
+    writes: list[WriteEvent] = field(default_factory=list)
+    iters: list[IterEvent] = field(default_factory=list)
+    #: locks guaranteed held at entry (propagated from intraclass call sites
+    #: of private methods) — the lock-context fixpoint fills this in
+    entry_locks: frozenset = frozenset()
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls.name}.{self.name}"
+
+
+class ClassModel:
+    """Everything pass 1 knows about one class (or a module's free
+    functions, modeled as the pseudo-class ``<module>``)."""
+
+    def __init__(self, name: str, ctx: FileContext,
+                 node: Optional[ast.ClassDef]):
+        self.name = name
+        self.ctx = ctx
+        self.node = node
+        self.locks: dict[str, LockInfo] = {}      # attr -> LockInfo
+        self.methods: dict[str, MethodModel] = {}
+        #: self.<attr> -> class simple name (from ``self.x = Cls()`` /
+        #: ``self.x: Cls``) — ambiguous attrs are dropped
+        self.attr_types: dict[str, str] = {}
+        #: attr -> guarding LockKey (the statistically dominant write guard)
+        self.guarded_by: dict[str, LockKey] = {}
+        #: attrs written at least once under some lock (shared-mutable set)
+        self.lock_touched: set[str] = set()
+        #: attr -> container kind ("dict" | "set" | "deque" | "list") from
+        #: its initializer — only dict/set/deque raise on concurrent resize
+        self.container_kind: dict[str, str] = {}
+        #: dict attrs initialized with a constant-key literal: stores to
+        #: those keys UPDATE, they don't resize
+        self.literal_keys: dict[str, frozenset] = {}
+        #: methods handed to ``threading.Thread(target=self.X)`` — the
+        #: class's owning-thread entry points
+        self.thread_entries: set[str] = set()
+        #: attr -> set of method names that RESIZE it (mutator calls /
+        #: new-key dict stores) outside ``__init__``
+        self.resize_sites: dict[str, set[str]] = {}
+
+    def owner_methods(self) -> set[str]:
+        """Methods reachable (intraclass) from the thread entry points —
+        code that runs on the class's own thread."""
+        reached: set[str] = set()
+        stack = list(self.thread_entries)
+        while stack:
+            name = stack.pop()
+            if name in reached or name not in self.methods:
+                continue
+            reached.add(name)
+            for ev in self.methods[name].calls:
+                if ev.callee[0] == "self":
+                    stack.append(ev.callee[1])
+        return reached
+
+    @property
+    def relpath(self) -> str:
+        return self.ctx.relpath
+
+    @property
+    def tier(self) -> str:
+        return self.ctx.tier
+
+
+@dataclass
+class OrderEdge:
+    """``src`` held while ``dst`` acquired; ``witness`` is the call chain
+    from the holding frame to the acquiring frame."""
+
+    src: LockKey
+    dst: LockKey
+    witness: tuple[str, ...]      # ("Engine._fail_all_inflight", "Queue.put")
+    path: str
+    line: int
+
+
+# ------------------------------------------------------------ method scanner
+
+
+class _MethodScanner:
+    """Record the event stream of one method body, tracking which of the
+    class's (and module's) locks are held at each statement."""
+
+    def __init__(self, model: MethodModel, lock_attrs: dict[str, LockInfo],
+                 module_locks: dict[str, LockInfo]):
+        self.m = model
+        self.lock_attrs = lock_attrs          # self.<attr> locks
+        self.module_locks = module_locks      # bare-name module locks
+
+    def scan(self, body: list[ast.stmt]) -> None:
+        self._scan(body, held=(), rte=False, nested=False)
+
+    # -- helpers ----------------------------------------------------------
+
+    def _lock_of(self, expr: ast.AST) -> Optional[LockKey]:
+        """``self._lock`` / module ``_lock`` (possibly called/entered)."""
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        attr = _self_attr_of(expr)
+        if attr is not None and attr in self.lock_attrs:
+            return self.lock_attrs[attr].key
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return self.module_locks[expr.id].key
+        return None
+
+    def _scan(self, body: list[ast.stmt], held: tuple, rte: bool,
+              nested: bool) -> None:
+        for stmt in body:
+            self._scan_stmt(stmt, held, rte, nested)
+
+    def _scan_stmt(self, stmt: ast.stmt, held: tuple, rte: bool,
+                   nested: bool) -> None:
+        # writes + expression-level events of THIS statement
+        for attr, node, rmw, via in _writes_of(stmt):
+            self.m.writes.append(WriteEvent(
+                attr, held, getattr(node, "lineno", stmt.lineno), rmw,
+                in_nested=nested, via=via))
+        for expr in _shallow_exprs(stmt):
+            self._scan_expr(expr, held, rte, nested)
+
+        if isinstance(stmt, ast.With):
+            newly = [self._lock_of(i.context_expr) for i in stmt.items]
+            newly = [k for k in newly if k is not None and k not in held]
+            for k in newly:
+                self.m.acquires.append(AcquireEvent(k, held, stmt.lineno))
+                held = held + (k,)
+            self._scan(stmt.body, held, rte, nested)
+        elif isinstance(stmt, ast.Try):
+            catches_rte = any(_handler_catches_runtime_error(h)
+                              for h in stmt.handlers)
+            self._scan(stmt.body, held, rte or catches_rte, nested)
+            for h in stmt.handlers:
+                self._scan(h.body, held, rte, nested)
+            self._scan(stmt.orelse, held, rte, nested)
+            self._scan(stmt.finalbody, held, rte, nested)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def's body runs LATER, outside the current lock
+            # context (often as a thread/callback entry)
+            self._scan(stmt.body, (), rte=False, nested=True)
+        elif isinstance(stmt, (ast.If, ast.For, ast.AsyncFor, ast.While,
+                               ast.AsyncWith, ast.Match)):
+            for blocks in ("body", "orelse"):
+                sub = getattr(stmt, blocks, None)
+                if isinstance(sub, list):
+                    self._scan(sub, held, rte, nested)
+            for case in getattr(stmt, "cases", []):
+                self._scan(case.body, held, rte, nested)
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._record_iter_expr(stmt.iter, held, rte, kind="for")
+
+    def _scan_expr(self, expr: ast.AST, held: tuple, rte: bool,
+                   nested: bool) -> None:
+        if isinstance(expr, ast.Call):
+            self._record_call(expr, held, rte, nested)
+        elif isinstance(expr, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in expr.generators:
+                self._record_iter_expr(gen.iter, held, rte,
+                                       kind="comprehension")
+
+    def _record_call(self, call: ast.Call, held: tuple, rte: bool,
+                     nested: bool) -> None:
+        dotted = dotted_name(call.func)
+        callee: Optional[tuple] = None
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+                callee = ("self", func.attr)
+            else:
+                attr = _self_attr_of(recv)
+                if attr is not None:
+                    callee = ("attr", attr, func.attr)
+        elif isinstance(func, ast.Name):
+            callee = ("free", func.id)
+        if callee is None:
+            callee = ("unresolved",)
+        self.m.calls.append(CallEvent(
+            callee, dotted, held, call.lineno, in_nested=nested))
+        # iteration-shaped calls: dict(self._d), sorted(self._q), and
+        # self._d.items()/.values()/.keys()
+        terminal = dotted.rsplit(".", 1)[-1] if dotted else ""
+        if isinstance(func, ast.Name) and func.id in _TRAVERSAL_CALLS \
+                and call.args:
+            self._record_iter_expr(call.args[0], held, rte, kind="copy")
+        elif isinstance(func, ast.Attribute) and terminal in _VIEW_METHODS:
+            attr = _self_attr_of(func.value)
+            if attr is not None:
+                self.m.iters.append(IterEvent(
+                    attr, held, call.lineno, "view", rte,
+                    via_snapshot=False))
+
+    def _record_iter_expr(self, expr: ast.AST, held: tuple, rte: bool,
+                          kind: str) -> None:
+        """``expr`` is about to be traversed — note self-attr sources."""
+        via_snapshot = False
+        if isinstance(expr, ast.Call):
+            name = dotted_name(expr.func).rsplit(".", 1)[-1]
+            if name in _SNAPSHOT_HELPERS:
+                via_snapshot = True
+                expr = expr.args[0] if expr.args else expr
+            elif name in _VIEW_METHODS and isinstance(expr.func,
+                                                      ast.Attribute):
+                expr = expr.func.value      # self._d.items() -> self._d
+            elif name in _TRAVERSAL_CALLS and expr.args:
+                # sorted(self._d) inside list(...) etc.
+                expr = expr.args[0]
+        attr = _self_attr_of(expr)
+        if attr is not None:
+            self.m.iters.append(IterEvent(
+                attr, held, getattr(expr, "lineno", 0), kind, rte,
+                via_snapshot=via_snapshot))
+
+
+def _self_attr_of(expr: ast.AST) -> Optional[str]:
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id in ("self", "cls"):
+        return expr.attr
+    return None
+
+
+_CONTAINER_CALLS = {
+    "dict": "dict", "OrderedDict": "dict", "defaultdict": "dict",
+    "Counter": "dict", "set": "set", "frozenset": "set", "deque": "deque",
+    "list": "list",
+}
+
+#: mutators that change a container's SHAPE — concurrent iteration raises
+#: "changed size during iteration" / "deque mutated during iteration"
+_RESIZE_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "add", "update", "insert", "remove",
+    "discard", "setdefault", "clear", "pop", "popleft", "popitem", "rotate",
+})
+
+
+def _container_kind(value: ast.AST) -> str:
+    """dict/set/deque/list kind of an initializer expression, "" if not a
+    container construction."""
+    if isinstance(value, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(value, (ast.Set, ast.SetComp)):
+        return "set"
+    if isinstance(value, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(value, ast.Call):
+        # aliased imports keep the conventional name (_deque, _OrderedDict)
+        terminal = dotted_name(value.func).rsplit(".", 1)[-1].lstrip("_")
+        return _CONTAINER_CALLS.get(terminal, "")
+    return ""
+
+
+def _is_resize(cm: "ClassModel", w: WriteEvent) -> bool:
+    """Does this write change the SHAPE of a raise-on-resize container?
+    A rebinding assign replaces the object (old iterators unaffected); a
+    store to a constant key present in the attr's literal initializer
+    updates in place; everything else on a dict/set/deque resizes."""
+    kind = cm.container_kind.get(w.attr)
+    if kind not in ("dict", "set", "deque"):
+        return False
+    if w.via.startswith("mutator:"):
+        return w.via.split(":", 1)[1] in _RESIZE_MUTATORS
+    if w.via == "subscript:*":
+        return kind == "dict"
+    if w.via.startswith("subscript:"):
+        key = w.via.split(":", 1)[1]
+        return kind == "dict" and key not in cm.literal_keys.get(
+            w.attr, frozenset())
+    return False
+
+
+def _annotation_terminal(ann: Optional[ast.AST]) -> str:
+    """Terminal class name of an annotation: ``Engine``, ``"Engine"``,
+    ``Optional["Engine"]`` — empty string when it isn't class-shaped."""
+    if ann is None:
+        return ""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.rsplit(".", 1)[-1]
+    if isinstance(ann, ast.Subscript):        # Optional[X] / "X" inside
+        return _annotation_terminal(ann.slice)
+    name = dotted_name(ann).rsplit(".", 1)[-1]
+    return name if name and name[0].isupper() else ""
+
+
+def _handler_catches_runtime_error(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    names: list[str] = []
+    if t is None:
+        return False
+    if isinstance(t, ast.Tuple):
+        names = [dotted_name(e) for e in t.elts]
+    else:
+        names = [dotted_name(t)]
+    return any(n.rsplit(".", 1)[-1] in ("RuntimeError", "Exception")
+               for n in names)
+
+
+def _shallow_exprs(stmt: ast.stmt):
+    """Expressions evaluated by this statement itself (nested statement
+    blocks are scanned with their own lock context)."""
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+            continue
+        yield from ast.walk(child)
+
+
+def _reads_attr(expr: ast.AST, attr: str) -> bool:
+    """Does ``expr`` read ``self.<attr>``?"""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr == attr and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in ("self", "cls"):
+            return True
+    return False
+
+
+def _writes_of(stmt: ast.stmt):
+    """Yield (attr, node, rmw, via) for writes to ``self.<attr>`` performed
+    by this statement: assignment targets, augmented assigns, and mutating
+    method calls. ``rmw`` marks read-modify-write shapes (the lost-update
+    surface); ``via`` feeds resize-site classification."""
+    targets: list[ast.AST] = []
+    aug = False
+    value: Optional[ast.AST] = None
+    if isinstance(stmt, ast.Assign):
+        targets, value = list(stmt.targets), stmt.value
+    elif isinstance(stmt, ast.AugAssign):
+        targets, aug = [stmt.target], True
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        targets, value = [stmt.target], stmt.value
+    for t in targets:
+        attr = _self_attr_of(t)
+        if attr is None:
+            continue
+        rmw = aug or (value is not None and _reads_attr(value, attr))
+        via = "aug" if aug else "assign"
+        # a subscript store reads the container before writing the slot
+        if isinstance(t, ast.Subscript):
+            rmw = True
+            key = t.slice
+            if isinstance(key, ast.Constant):
+                via = f"subscript:{key.value!r}"
+            else:
+                via = "subscript:*"
+        yield attr, stmt, rmw, via
+    for expr in _shallow_exprs(stmt):
+        if isinstance(expr, ast.Call) and \
+                isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr in _MUTATORS:
+            attr = _self_attr_of(expr.func.value)
+            if attr is not None:
+                yield attr, expr, True, f"mutator:{expr.func.attr}"
+
+
+# ------------------------------------------------------------- model builder
+
+
+class ProjectModel:
+    """The whole-program model pass 2 (rules/races.py) runs over."""
+
+    def __init__(self) -> None:
+        self.classes: dict[tuple[str, str], ClassModel] = {}  # (path, name)
+        #: simple class name -> ClassModel, only when unique project-wide
+        self.by_name: dict[str, ClassModel] = {}
+        self.locks: dict[LockKey, LockInfo] = {}
+        self.edges: list[OrderEdge] = []
+        #: method qualkey -> {LockKey: witness chain} (transitive acquires)
+        self._acquired_via: dict[tuple, dict[LockKey, tuple[str, ...]]] = {}
+        #: method qualkey -> (reason, chain) for transitively-blocking calls
+        self.blocking_via: dict[tuple, tuple[str, tuple[str, ...]]] = {}
+
+    # -- resolution -------------------------------------------------------
+
+    def resolve_call(self, cls: ClassModel,
+                     ev: CallEvent) -> Optional[MethodModel]:
+        kind = ev.callee[0]
+        if kind == "self":
+            return cls.methods.get(ev.callee[1])
+        if kind == "attr":
+            _, attr, meth = ev.callee
+            tname = cls.attr_types.get(attr)
+            target = self.by_name.get(tname) if tname else None
+            if target is not None:
+                return target.methods.get(meth)
+            return None
+        if kind == "free":
+            name = ev.callee[1]
+            # ClassName(...) construction -> __init__
+            target = self.by_name.get(name)
+            if target is not None:
+                return target.methods.get("__init__")
+            mod = self.classes.get((cls.relpath, "<module>"))
+            if mod is not None and name in mod.methods:
+                return mod.methods[name]
+        return None
+
+    def method_key(self, m: MethodModel) -> tuple:
+        return (m.cls.relpath, m.cls.name, m.name)
+
+    def acquires_of(self, m: MethodModel) -> dict[LockKey, tuple[str, ...]]:
+        return self._acquired_via.get(self.method_key(m), {})
+
+
+def build_project_model(project: ProjectContext) -> ProjectModel:
+    """Pass 1 over every file in the run (memoized on the context)."""
+    cached = getattr(project, "_race_model", None)
+    if cached is not None:
+        return cached
+    model = ProjectModel()
+    for ctx in project.files:
+        _collect_file(model, ctx)
+    _resolve_unique_names(model)
+    _propagate_lock_contexts(model)
+    _infer_guards(model)
+    _compute_transitive_acquires(model)
+    _compute_transitive_blocking(model)
+    _build_order_edges(model)
+    project._race_model = model
+    return model
+
+
+def _collect_file(model: ProjectModel, ctx: FileContext) -> None:
+    # module-level locks + free functions form a pseudo-class
+    module_cls = ClassModel("<module>", ctx, None)
+    module_locks: dict[str, LockInfo] = {}
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            kind = _LOCK_FACTORIES.get(dotted_name(stmt.value.func))
+            if kind:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        info = LockInfo(f"<{ctx.relpath}>", t.id, kind,
+                                        ctx.relpath, ctx.tier, stmt.lineno)
+                        module_locks[t.id] = info
+                        model.locks[info.key] = info
+                        module_cls.locks[t.id] = info
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mm = MethodModel(stmt.name, stmt, module_cls)
+            module_cls.methods[stmt.name] = mm
+            _MethodScanner(mm, {}, module_locks).scan(stmt.body)
+    if module_cls.methods or module_cls.locks:
+        model.classes[(ctx.relpath, "<module>")] = module_cls
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cm = ClassModel(node.name, ctx, node)
+        _collect_class(model, cm, node, module_locks)
+        model.classes[(ctx.relpath, node.name)] = cm
+
+
+def _collect_class(model: ProjectModel, cm: ClassModel, node: ast.ClassDef,
+                   module_locks: dict[str, LockInfo]) -> None:
+    methods = [n for n in node.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    ambiguous: set[str] = set()
+    for fn in methods:
+        # ``self.x = param`` where the parameter is annotated with a class
+        # (plain or string form) types the attribute too
+        param_types: dict[str, str] = {}
+        for p in list(fn.args.posonlyargs) + list(fn.args.args) + \
+                list(fn.args.kwonlyargs):
+            terminal = _annotation_terminal(p.annotation)
+            if terminal:
+                param_types[p.arg] = terminal
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Name) and \
+                    stmt.value.id in param_types:
+                for t in stmt.targets:
+                    attr = _self_attr_of(t)
+                    if attr is not None and isinstance(t, ast.Attribute):
+                        terminal = param_types[stmt.value.id]
+                        prev = cm.attr_types.get(attr)
+                        if prev is not None and prev != terminal:
+                            ambiguous.add(attr)
+                        cm.attr_types[attr] = terminal
+            # lock inventory: self._x = threading.Lock()
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                kind = _LOCK_FACTORIES.get(dotted_name(stmt.value.func))
+                call_name = dotted_name(stmt.value.func)
+                for t in stmt.targets:
+                    attr = _self_attr_of(t)
+                    if attr is None or not isinstance(t, ast.Attribute):
+                        continue
+                    if kind:
+                        info = LockInfo(cm.name, attr, kind, cm.relpath,
+                                        cm.tier, stmt.lineno)
+                        cm.locks[attr] = info
+                        model.locks[info.key] = info
+                    else:
+                        # attr type: self.x = ClassName(...)
+                        terminal = call_name.rsplit(".", 1)[-1]
+                        if terminal and terminal[0].isupper():
+                            prev = cm.attr_types.get(attr)
+                            if prev is not None and prev != terminal:
+                                ambiguous.add(attr)
+                            cm.attr_types[attr] = terminal
+            elif isinstance(stmt, ast.AnnAssign):
+                attr = _self_attr_of(stmt.target)
+                ann = dotted_name(stmt.annotation) if stmt.annotation else ""
+                terminal = ann.rsplit(".", 1)[-1]
+                if attr and terminal and terminal[0].isupper():
+                    prev = cm.attr_types.get(attr)
+                    if prev is not None and prev != terminal:
+                        ambiguous.add(attr)
+                    else:
+                        cm.attr_types[attr] = terminal
+    for attr in ambiguous:
+        cm.attr_types.pop(attr, None)
+    for fn in methods:
+        for stmt in ast.walk(fn):
+            # container kinds + constant-key dict literals (RC04's raise-on-
+            # resize model) and thread entry points
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                value = stmt.value
+                for t in targets:
+                    attr = _self_attr_of(t)
+                    if attr is None or isinstance(t, ast.Subscript) or \
+                            value is None:
+                        continue
+                    kind = _container_kind(value)
+                    if kind:
+                        cm.container_kind.setdefault(attr, kind)
+                        if isinstance(value, ast.Dict):
+                            keys = [k.value for k in value.keys
+                                    if isinstance(k, ast.Constant)]
+                            if len(keys) == len(value.keys):
+                                cm.literal_keys.setdefault(
+                                    attr, frozenset(map(repr, keys)))
+            elif isinstance(stmt, ast.Call) and \
+                    dotted_name(stmt.func).rsplit(".", 1)[-1] == "Thread":
+                for kw in stmt.keywords:
+                    if kw.arg == "target":
+                        entry = _self_attr_of(kw.value)
+                        if entry is not None:
+                            cm.thread_entries.add(entry)
+    for fn in methods:
+        mm = MethodModel(fn.name, fn, cm)
+        cm.methods[fn.name] = mm
+        _MethodScanner(mm, cm.locks, module_locks).scan(fn.body)
+    # resize sites: method -> attrs whose dict/set/deque shape it changes
+    for name, mm in cm.methods.items():
+        if name == "__init__":
+            continue
+        for w in mm.writes:
+            if _is_resize(cm, w):
+                cm.resize_sites.setdefault(w.attr, set()).add(name)
+
+
+def _resolve_unique_names(model: ProjectModel) -> None:
+    counts: dict[str, int] = {}
+    for (_, name), cm in model.classes.items():
+        if name != "<module>":
+            counts[name] = counts.get(name, 0) + 1
+    for (_, name), cm in model.classes.items():
+        if name != "<module>" and counts[name] == 1:
+            model.by_name[name] = cm
+
+
+def _propagate_lock_contexts(model: ProjectModel) -> None:
+    """Fixpoint: a PRIVATE method called only with lock L held (from inside
+    its own class) inherits L at entry. Public methods and methods with no
+    intraclass call sites get no context (they are thread entry points)."""
+    for _ in range(4):          # nesting depth 4 is beyond anything real
+        changed = False
+        for cm in model.classes.values():
+            # call sites per callee method name
+            sites: dict[str, list[frozenset]] = {}
+            for m in cm.methods.values():
+                effective = m.entry_locks
+                for ev in m.calls:
+                    if ev.callee[0] == "self" and not ev.in_nested:
+                        sites.setdefault(ev.callee[1], []).append(
+                            frozenset(ev.held) | effective)
+            for name, m in cm.methods.items():
+                if not name.startswith("_") or name.startswith("__"):
+                    continue
+                held_sets = sites.get(name)
+                if not held_sets:
+                    continue
+                entry = frozenset.intersection(*held_sets)
+                if entry != m.entry_locks:
+                    m.entry_locks = entry
+                    changed = True
+        if not changed:
+            break
+
+
+def _effective_held(m: MethodModel, held: tuple) -> frozenset:
+    return frozenset(held) | m.entry_locks
+
+
+def _infer_guards(model: ProjectModel) -> None:
+    """Guarded-by inference: the lock that statistically dominates an
+    attribute's write sites. ``__init__`` writes happen-before thread start
+    and never count. An attribute qualifies when (a) every write site holds
+    one lock, (b) at least two sites hold it and they form a ≥2/3 majority,
+    or (c) at least one site holds it and every site WITHOUT it is a
+    read-modify-write — a lost-update shape has no benign interleaving
+    (the lock-free ``charge()`` class), whereas a single unlocked plain
+    store against a single locked one stays uninferred (the sanctioned
+    advisory last-writer-wins idiom, e.g. ``last_round_at``)."""
+    for cm in model.classes.values():
+        if not cm.locks and cm.name != "<module>":
+            continue
+        per_attr: dict[str, list[tuple[frozenset, bool]]] = {}
+        for name, m in cm.methods.items():
+            if name == "__init__":
+                continue
+            for w in m.writes:
+                if w.attr in cm.locks:
+                    continue
+                per_attr.setdefault(w.attr, []).append(
+                    (_effective_held(m, w.held), w.rmw))
+        for attr, sites in per_attr.items():
+            total = len(sites)
+            by_lock: dict[LockKey, int] = {}
+            for hs, _rmw in sites:
+                for lk in hs:
+                    if lk in model.locks and \
+                            model.locks[lk].owner == cm.name:
+                        by_lock[lk] = by_lock.get(lk, 0) + 1
+                if hs:
+                    cm.lock_touched.add(attr)
+            if not by_lock:
+                continue
+            lock, n = max(by_lock.items(), key=lambda kv: (kv[1], kv[0]))
+            unguarded_all_rmw = all(
+                rmw for hs, rmw in sites if lock not in hs)
+            if n == total or (n >= 2 and n * 3 >= total * 2) \
+                    or (n >= 1 and unguarded_all_rmw):
+                cm.guarded_by[attr] = lock
+
+
+def _compute_transitive_acquires(model: ProjectModel) -> None:
+    """For every method: the set of locks it may acquire, directly or
+    through resolved calls, with one witness call chain per lock."""
+    memo = model._acquired_via
+    in_progress: set[tuple] = set()
+
+    def visit(m: MethodModel) -> dict[LockKey, tuple[str, ...]]:
+        key = model.method_key(m)
+        if key in memo:
+            return memo[key]
+        if key in in_progress:      # recursion: already-found locks suffice
+            return {}
+        in_progress.add(key)
+        out: dict[LockKey, tuple[str, ...]] = {}
+        for acq in m.acquires:
+            out.setdefault(acq.lock, (m.qualname,))
+        for ev in m.calls:
+            callee = model.resolve_call(m.cls, ev)
+            if callee is None:
+                continue
+            for lk, chain in visit(callee).items():
+                out.setdefault(lk, (m.qualname,) + chain)
+        in_progress.discard(key)
+        memo[key] = out
+        return out
+
+    for cm in model.classes.values():
+        for m in cm.methods.values():
+            visit(m)
+
+
+#: dotted-call patterns that block the calling thread (RC03's primitive set;
+#: the transitive closure rides the call graph)
+_BLOCKING_TERMINALS = frozenset({
+    "sleep", "join", "result", "block_until_ready", "device_get",
+    "copy_to_host", "urlopen", "recv", "accept", "connect", "getaddrinfo",
+})
+_BLOCKING_PREFIXES = ("socket.", "requests.", "urllib.", "subprocess.",
+                      "http.client.", "sqlite3.")
+#: calls that hand control to foreign code which may take ITS OWN locks or
+#: sleep — the PR-8 decree (emits outside the lock) generalized
+_FOREIGN_TERMINALS = frozenset({"emit", "submit"})
+
+
+def _direct_blocking_reason(ev: CallEvent) -> Optional[str]:
+    dotted = ev.dotted
+    if not dotted:
+        return None
+    terminal = dotted.rsplit(".", 1)[-1]
+    if dotted.startswith(_BLOCKING_PREFIXES):
+        return f"`{dotted}(...)` does network/process/disk work"
+    if terminal in _BLOCKING_TERMINALS:
+        # jnp/np asarray-style false friends are excluded by the exact list
+        return f"`{dotted}(...)` blocks the calling thread"
+    if terminal in _FOREIGN_TERMINALS:
+        return (f"`{dotted}(...)` hands control to foreign code (an emit "
+                "callback / another component's submit) that may take its "
+                "own locks or sleep")
+    return None
+
+
+def _compute_transitive_blocking(model: ProjectModel) -> None:
+    """method -> (reason, chain) when some call path from it blocks."""
+    memo = model.blocking_via
+    in_progress: set[tuple] = set()
+
+    def visit(m: MethodModel):
+        key = model.method_key(m)
+        if key in memo:
+            return memo[key]
+        if key in in_progress:
+            return None
+        in_progress.add(key)
+        found = None
+        for ev in m.calls:
+            if ev.in_nested:
+                continue
+            reason = _direct_blocking_reason(ev)
+            if reason is not None:
+                found = (reason, (m.qualname,))
+                break
+            callee = model.resolve_call(m.cls, ev)
+            if callee is None:
+                continue
+            sub = visit(callee)
+            if sub is not None:
+                found = (sub[0], (m.qualname,) + sub[1])
+                break
+        in_progress.discard(key)
+        if found is not None:
+            memo[key] = found
+        return found
+
+    for cm in model.classes.values():
+        for m in cm.methods.values():
+            visit(m)
+
+
+def _build_order_edges(model: ProjectModel) -> None:
+    """Acquisition-order digraph: direct nested ``with`` acquisitions plus
+    acquisitions reached transitively through calls made while holding."""
+    edges: dict[tuple[LockKey, LockKey], OrderEdge] = {}
+
+    def add(src: LockKey, dst: LockKey, witness: tuple, path: str,
+            line: int) -> None:
+        if src == dst and model.locks[src].kind == "RLock":
+            return      # reentrant re-acquisition is the RLock contract
+        k = (src, dst)
+        if k not in edges or len(witness) < len(edges[k].witness):
+            edges[k] = OrderEdge(src, dst, witness, path, line)
+
+    for cm in model.classes.values():
+        for m in cm.methods.values():
+            for acq in m.acquires:
+                for src in _effective_held(m, acq.held):
+                    add(src, acq.lock, (m.qualname,), cm.relpath, acq.line)
+            for ev in m.calls:
+                held = _effective_held(m, ev.held)
+                if not held or ev.in_nested:
+                    continue
+                callee = model.resolve_call(cm, ev)
+                if callee is None:
+                    continue
+                for lk, chain in model.acquires_of(callee).items():
+                    for src in held:
+                        add(src, lk, (m.qualname,) + chain, cm.relpath,
+                            ev.line)
+    model.edges = sorted(edges.values(),
+                         key=lambda e: (e.src, e.dst, e.path, e.line))
+
+
+def find_cycles(model: ProjectModel) -> list[list[OrderEdge]]:
+    """Cycles in the acquisition-order digraph: self-edges (a non-reentrant
+    lock re-acquired under itself — the ABBA shape when two instances run
+    the same path concurrently) and multi-lock loops, each reported as the
+    ordered edge list forming the cycle."""
+    adj: dict[LockKey, list[OrderEdge]] = {}
+    for e in model.edges:
+        adj.setdefault(e.src, []).append(e)
+    cycles: list[list[OrderEdge]] = []
+    seen_cycles: set[frozenset] = set()
+
+    for e in model.edges:
+        if e.src == e.dst:
+            sig = frozenset([(e.src, e.dst)])
+            if sig not in seen_cycles:
+                seen_cycles.add(sig)
+                cycles.append([e])
+
+    # bounded DFS for simple cycles (the lock graph is tiny: tens of nodes)
+    def dfs(start: LockKey, node: LockKey, path: list[OrderEdge],
+            visited: set) -> None:
+        for edge in adj.get(node, ()):  # noqa: B007
+            if edge.dst == start and path:
+                sig = frozenset((x.src, x.dst) for x in path + [edge])
+                if sig not in seen_cycles:
+                    seen_cycles.add(sig)
+                    cycles.append(list(path) + [edge])
+            elif edge.dst not in visited and edge.src != edge.dst \
+                    and len(path) < 6:
+                visited.add(edge.dst)
+                dfs(start, edge.dst, path + [edge], visited)
+                visited.discard(edge.dst)
+
+    for node in sorted(adj):
+        dfs(node, node, [], {node})
+    return cycles
+
+
+# ------------------------------------------------------------ graph emitters
+
+
+def lock_graph_dict(model: ProjectModel) -> dict:
+    """The inferred lock world as a stable JSON-able dict — the committed
+    ``docs/lock_graph.json`` artifact (line numbers excluded so the drift
+    check churns on structure, not on unrelated edits)."""
+    nodes = [
+        {"lock": info.label, "kind": info.kind, "path": info.path,
+         "tier": info.tier}
+        for _, info in sorted(model.locks.items())
+    ]
+    edges = [
+        {"src": model.locks[e.src].label, "dst": model.locks[e.dst].label,
+         "via": " -> ".join(e.witness)}
+        for e in model.edges
+        if e.src in model.locks and e.dst in model.locks
+    ]
+    guards = []
+    for (path, name), cm in sorted(model.classes.items()):
+        for attr, lk in sorted(cm.guarded_by.items()):
+            if lk in model.locks:
+                guards.append({"class": name, "attr": attr,
+                               "guarded_by": model.locks[lk].label,
+                               "path": path})
+    cycles = [
+        {"locks": [model.locks[e.src].label for e in cyc],
+         "witnesses": [" -> ".join(e.witness) for e in cyc]}
+        for cyc in find_cycles(model)
+    ]
+    return {"version": 1, "nodes": nodes, "edges": edges,
+            "guarded_by": guards, "cycles": cycles}
+
+
+def lock_graph_dot(model: ProjectModel) -> str:
+    """Graphviz DOT of the acquisition-order digraph (cycle edges red)."""
+    cycle_pairs = {(e.src, e.dst) for cyc in find_cycles(model) for e in cyc}
+    lines = ["digraph lock_order {", '  rankdir="LR";',
+             '  node [shape=box, fontname="monospace"];']
+    for key, info in sorted(model.locks.items()):
+        if any(key in (e.src, e.dst) for e in model.edges):
+            lines.append(
+                f'  "{info.label}" [tooltip="{info.path} ({info.kind})"];')
+    for e in model.edges:
+        attrs = f'label="{e.witness[0]}"'
+        if (e.src, e.dst) in cycle_pairs:
+            attrs += ', color="red", penwidth=2'
+        lines.append(f'  "{model.locks[e.src].label}" -> '
+                     f'"{model.locks[e.dst].label}" [{attrs}];')
+    lines.append("}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,312 @@
+"""RC — interprocedural race/deadlock discipline (fabric-race).
+
+Four rule families over the whole-program model (``project_model.py``),
+each distilled from a concurrency bug this repo actually shipped and then
+fixed in review — the class of bug the per-function families (LK01, AS01-04,
+WD01) structurally cannot see because it needs a call graph and lock-context
+propagation (RacerD's discipline, PAPERS.md):
+
+- **RC01 — lock-order inversion.** Cycles in the acquisition-order digraph,
+  including acquisitions reached *transitively* through calls made while a
+  lock is held, and self-edges on non-reentrant locks (two instances of one
+  class running the same hold-then-call path concurrently deadlock ABBA —
+  the PR-8 ``_fail_all_inflight`` drain vs sibling ``submit`` shape). Both
+  witness paths are reported.
+- **RC02 — mixed-guard state.** An attribute whose write sites are
+  statistically dominated by one ``with self._lock:`` context, written or
+  RMW'd on another thread-visible path without it (the PR-10 lock-free
+  ``TenantFairQueue.charge()`` shape, the PR-4 unlocked metric RMWs).
+  Advisory *plain reads* are deliberately out of scope — the repo's
+  GIL-atomic snapshot idiom is sanctioned; it is the lost-update RMW that
+  has no benign interleaving.
+- **RC03 — blocking while locked.** A sleep / network / process / device
+  sync / ``.join()`` — or a hand-off to foreign code (``emit``/``submit``
+  shaped calls) — reached directly or transitively while a ``runtime/`` or
+  ``modkit/`` lock is held: the generalization of the PR-8
+  emits-outside-the-lock decree and WD01's intent.
+- **RC04 — unguarded iteration.** Iterating (``for``, ``.items()``,
+  ``dict(...)`` copies, comprehensions) over a ``self`` collection that
+  other threads mutate under a lock, without holding that lock and without
+  the established snapshot contract (``try/except RuntimeError`` or the
+  shared ``modkit.concurrency.locked_snapshot()`` helper) — the
+  dict-changed-size crash class (``_depth_hist``, ``tenant_snapshot()``).
+
+Precision heuristics shared by RC02/RC04: ``__init__`` (and private helpers
+reachable only from it) happens-before thread start and never counts;
+classes that declare no lock are assumed thread-confined and skipped
+entirely — declaring a lock is what marks a class as thread-shared.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from ..engine import FileContext, Finding, ProjectContext, Rule, register
+from ..project_model import (ClassModel, LockKey, MethodModel, ProjectModel,
+                             _direct_blocking_reason, _effective_held,
+                             build_project_model, find_cycles)
+
+#: the serving fabric's shared tiers — the locks whose misuse stalls or
+#: corrupts the data plane (fixtures pass tier="runtime")
+_SHARED_TIERS = frozenset({"runtime", "modkit"})
+
+
+def _init_confined(cm: ClassModel) -> set[str]:
+    """Private methods whose intraclass callers are ONLY ``__init__`` (or
+    other such methods, transitively) — they run happens-before thread
+    start, like ``__init__`` itself. A method with no callers at all is NOT
+    confined: it may be a thread/callback entry."""
+    callers: dict[str, set[str]] = {}
+    for name, m in cm.methods.items():
+        for ev in m.calls:
+            if ev.callee[0] == "self":
+                callers.setdefault(ev.callee[1], set()).add(name)
+    confined: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name in cm.methods:
+            if not name.startswith("_") or name.startswith("__") \
+                    or name in confined:
+                continue
+            from_sites = callers.get(name)
+            if from_sites and all(
+                    c == "__init__" or c == name or c in confined
+                    for c in from_sites):
+                confined.add(name)
+                changed = True
+    return confined
+
+
+def _lock_label(model: ProjectModel, key: LockKey) -> str:
+    info = model.locks.get(key)
+    return info.label if info is not None else f"{key[0]}.{key[1]}"
+
+
+class _RaceRule(Rule):
+    """Shared plumbing: build/memoize the model, map classes back to their
+    FileContext for finding locations."""
+
+    def _model(self, project: ProjectContext) -> ProjectModel:
+        return build_project_model(project)
+
+    def _shared_classes(self, model: ProjectModel) -> Iterable[ClassModel]:
+        for cm in model.classes.values():
+            if cm.tier in _SHARED_TIERS and cm.locks:
+                yield cm
+
+
+@register
+class RC01(_RaceRule):
+    id = "RC01"
+    family = "RC"
+    severity = "error"
+    description = ("lock-order inversion: a cycle in the acquisition-order "
+                   "digraph (transitive acquisitions included) — two "
+                   "threads walking the two paths deadlock ABBA")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        model = self._model(project)
+        for cycle in find_cycles(model):
+            # a cycle matters when any lock on it lives in a shared tier
+            tiers = {model.locks[e.src].tier for e in cycle}
+            if not tiers & _SHARED_TIERS:
+                continue
+            labels = [_lock_label(model, e.src) for e in cycle]
+            witnesses = "; ".join(
+                f"{_lock_label(model, e.src)} held along "
+                f"[{' -> '.join(e.witness)}] acquires "
+                f"{_lock_label(model, e.dst)} ({e.path}:{e.line})"
+                for e in cycle)
+            anchor = cycle[0]
+            ctx = self._ctx_for(project, anchor.path)
+            if len(cycle) == 1:
+                msg = (f"lock {labels[0]} can be re-acquired while held, via "
+                       f"[{' -> '.join(anchor.witness)}] — one thread "
+                       "self-deadlocks, and two instances of this class "
+                       "running the path concurrently deadlock ABBA; move "
+                       "the re-acquiring call outside the lock (the "
+                       "emits-outside-the-lock decree)")
+            else:
+                msg = (f"lock-order inversion {' -> '.join(labels)} -> "
+                       f"{labels[0]}: {witnesses} — two threads walking "
+                       "these paths in opposite order deadlock; pick one "
+                       "global order (see docs/lock_graph.json) and "
+                       "restructure the later acquisition")
+            yield self._finding_at(ctx, anchor.path, anchor.line, msg)
+
+    def _ctx_for(self, project: ProjectContext,
+                 relpath: str) -> Optional[FileContext]:
+        for ctx in project.files:
+            if ctx.relpath == relpath:
+                return ctx
+        return None
+
+    def _finding_at(self, ctx: Optional[FileContext], path: str, line: int,
+                    msg: str) -> Finding:
+        if ctx is not None:
+            return self.finding_in(ctx, line, msg)
+        return Finding(self.id, self.severity, path, line, 0, msg)
+
+
+@register
+class RC02(_RaceRule):
+    id = "RC02"
+    family = "RC"
+    severity = "error"
+    description = ("mixed-guard state: attribute written under its inferred "
+                   "lock but written/RMW'd elsewhere without it — a lost "
+                   "update under contention")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        model = self._model(project)
+        ctx_by_path = {c.relpath: c for c in project.files}
+        for cm in self._shared_classes(model):
+            if not cm.guarded_by:
+                continue
+            ctx = ctx_by_path.get(cm.relpath)
+            if ctx is None:
+                continue
+            confined = _init_confined(cm)
+            for name, m in cm.methods.items():
+                if name == "__init__" or name in confined:
+                    continue
+                for w in m.writes:
+                    guard = cm.guarded_by.get(w.attr)
+                    if guard is None or guard in _effective_held(m, w.held):
+                        continue
+                    label = _lock_label(model, guard)
+                    kind = "read-modify-write" if w.rmw else "write"
+                    yield self.finding_in(
+                        ctx, w.line,
+                        f"{cm.name}.{name} performs an unlocked {kind} on "
+                        f"self.{w.attr}, but {label} guards its other write "
+                        "sites (lock contexts inherited through intraclass "
+                        "call sites counted) — a concurrent holder loses "
+                        f"this update; take {label} (the "
+                        "TenantFairQueue.charge bug class)")
+
+
+@register
+class RC03(_RaceRule):
+    id = "RC03"
+    family = "RC"
+    severity = "error"
+    description = ("blocking call (sleep/net/db/device-sync) or foreign "
+                   "hand-off (emit/submit) reached while a runtime/modkit "
+                   "lock is held")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        model = self._model(project)
+        ctx_by_path = {c.relpath: c for c in project.files}
+        for cm in model.classes.values():
+            ctx = ctx_by_path.get(cm.relpath)
+            if ctx is None:
+                continue
+            for m in cm.methods.values():
+                yield from self._check_method(model, cm, m, ctx)
+
+    def _check_method(self, model: ProjectModel, cm: ClassModel,
+                      m: MethodModel, ctx: FileContext) -> Iterable[Finding]:
+        for ev in m.calls:
+            if ev.in_nested:
+                continue
+            held = [k for k in _effective_held(m, ev.held)
+                    if k in model.locks
+                    and model.locks[k].tier in _SHARED_TIERS
+                    and model.locks[k].kind != "Condition"]
+            if not held:
+                continue
+            labels = ", ".join(sorted(_lock_label(model, k) for k in held))
+            reason = _direct_blocking_reason(ev)
+            if reason is not None:
+                yield self.finding_in(
+                    ctx, ev.line,
+                    f"{m.qualname} holds {labels} while calling "
+                    f"{reason} — every thread queued on the lock stalls "
+                    "behind it; move the call outside the lock scope")
+                continue
+            callee = model.resolve_call(cm, ev)
+            if callee is None:
+                continue
+            blocked = model.blocking_via.get(model.method_key(callee))
+            if blocked is not None:
+                reason_t, chain = blocked
+                yield self.finding_in(
+                    ctx, ev.line,
+                    f"{m.qualname} holds {labels} while calling "
+                    f"{callee.qualname}, which reaches {reason_t} via "
+                    f"[{' -> '.join(chain)}] — the lock is held across "
+                    "the whole blocking path; hoist the blocking work "
+                    "out of the locked region")
+
+
+@register
+class RC04(_RaceRule):
+    id = "RC04"
+    family = "RC"
+    severity = "error"
+    description = ("unguarded iteration over a lock-managed collection "
+                   "without the snapshot contract (lock held, try/except "
+                   "RuntimeError, or locked_snapshot())")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        model = self._model(project)
+        ctx_by_path = {c.relpath: c for c in project.files}
+        for cm in model.classes.values():
+            # thread-shared marker: the class declares a lock or owns a
+            # thread; everything else is assumed thread-confined
+            if cm.tier not in _SHARED_TIERS or \
+                    not (cm.locks or cm.thread_entries):
+                continue
+            ctx = ctx_by_path.get(cm.relpath)
+            if ctx is None or not cm.resize_sites:
+                continue
+            confined = _init_confined(cm)
+            owner = cm.owner_methods()
+            own_locks = {info.key for info in cm.locks.values()}
+            for name, m in cm.methods.items():
+                if name == "__init__" or name in confined:
+                    continue
+                seen_sites: set[tuple[str, int]] = set()
+                for it in m.iters:
+                    resizers = cm.resize_sites.get(it.attr)
+                    if not resizers or it.via_snapshot or it.rte_guarded:
+                        continue
+                    if (it.attr, it.line) in seen_sites:
+                        continue    # `for x in list(self._q)` records the
+                        #             copy and the for-loop once each
+                    seen_sites.add((it.attr, it.line))
+                    held = _effective_held(m, it.held)
+                    guard = cm.guarded_by.get(it.attr)
+                    if guard is not None and guard in held:
+                        continue
+                    if guard is None and held & own_locks:
+                        continue    # some own lock held — the established
+                        #             discipline for un-inferred attrs
+                    if cm.thread_entries:
+                        # thread-role split: flag only iteration that can
+                        # race a resize on ANOTHER thread (same-thread
+                        # iterate+resize is sequential)
+                        it_on_owner = name in owner
+                        if not any((w in owner) != it_on_owner
+                                   for w in resizers):
+                            continue
+                    elif resizers == {name}:
+                        continue    # passive class, single self-resizing
+                        #             method: racy only against itself
+                    label = (_lock_label(model, guard) if guard is not None
+                             else " / ".join(sorted(
+                                 i.label for i in cm.locks.values()))
+                             or "the owning lock")
+                    yield self.finding_in(
+                        ctx, it.line,
+                        f"{cm.name}.{name} iterates self.{it.attr} "
+                        f"({it.kind} of a {cm.container_kind.get(it.attr)}) "
+                        f"without {label}, while "
+                        f"{', '.join(sorted(resizers))} resize(s) it on "
+                        "another thread-visible path — concurrent resize "
+                        "raises `changed size during iteration` "
+                        "mid-request; hold the lock, or snapshot via "
+                        "modkit.concurrency.locked_snapshot() / the "
+                        "try/except RuntimeError advisory contract")

@@ -1,0 +1,58 @@
+"""Shared concurrency primitives — the one implementation of the advisory
+snapshot contract.
+
+The fabric's monitoring/doctor/lifecycle threads constantly read collections
+that the scheduler and gateway threads mutate. The established contract
+(grown ad hoc across ``_depth_hist``, ``tenant_snapshot()``, the worker's
+replica table, and a dozen metric closures) is: **degrade, never raise** — a
+torn advisory read returns an empty/partial view instead of crashing the
+reader, because a raising ``stats()`` quarantines a healthy replica and a
+raising gauge closure kills a scrape. Before this module each site
+hand-rolled its own ``try: dict(x) except RuntimeError: {}``; fabric-lint
+RC04 now points here instead, so the contract has exactly one
+implementation to audit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+__all__ = ["locked_snapshot"]
+
+
+def _copy(container: Any):
+    if isinstance(container, dict):
+        return dict(container)
+    if isinstance(container, (set, frozenset)):
+        return set(container)
+    return list(container)
+
+
+def locked_snapshot(container: Iterable, *, lock: Optional[Any] = None,
+                    retries: int = 4):
+    """Shallow-copy a collection that another thread may be resizing.
+
+    With ``lock``, acquire it and copy — the canonical guarded snapshot.
+    Without, the **advisory** mode the monitoring surfaces use against the
+    scheduler thread: attempt the copy a few times (CPython raises
+    ``RuntimeError`` when a dict/set/deque is resized mid-iteration; an
+    immediate retry almost always lands between mutations) and degrade to
+    an EMPTY copy only if every attempt loses the race — never raise.
+
+    Returns a ``dict`` for dicts, a ``set`` for sets, else a ``list``
+    (deques and other iterables), so ``.items()`` / membership / indexing
+    keep working on the snapshot.
+    """
+    if lock is not None:
+        with lock:
+            return _copy(container)
+    for _ in range(max(1, retries) - 1):
+        try:
+            return _copy(container)
+        except RuntimeError:
+            continue
+    try:
+        return _copy(container)
+    except RuntimeError:
+        return type(container)() if isinstance(container, (dict, set)) \
+            else []

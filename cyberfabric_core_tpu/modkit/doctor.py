@@ -933,15 +933,21 @@ class Doctor:
             per_tenant = {t: int(row.get("pending", 0))
                           for t, row in rows.items()} \
                 if isinstance(rows, dict) else {}
-            seen = self._queue_gauge_tenants.get(name, set())
+            # the seen-set RMW runs under the doctor lock: configure() can
+            # rebind/reset the dict from another thread mid-eval, and an
+            # unlocked read-modify-write here would resurrect the stale
+            # seen-set it read (fabric-lint RC02)
+            with self._lock:
+                seen = self._queue_gauge_tenants.get(name, set())
             for tenant in seen - set(per_tenant):
                 per_tenant[tenant] = 0
             for tenant, n in per_tenant.items():
                 _gauge_set("llm_tenant_queue_depth",
                            "Pending scheduler queue depth per tenant",
                            float(n), model=name, tenant=tenant)
-            self._queue_gauge_tenants[name] = {
-                t for t, n in per_tenant.items() if n > 0}
+            with self._lock:
+                self._queue_gauge_tenants[name] = {
+                    t for t, n in per_tenant.items() if n > 0}
 
     # ------------------------------------------------------------- surfaces
     @property

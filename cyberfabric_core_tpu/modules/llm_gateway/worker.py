@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, AsyncIterator, Optional
 
+from ...modkit.concurrency import locked_snapshot
 from ...modkit.errcat import ERR
 from ...modkit.errors import ProblemError
 from ...modkit.failpoints import failpoint_async
@@ -849,7 +850,7 @@ class LocalTpuWorker(LlmWorkerApi):
         # event loop may be admitting/evicting entries. Pool entries expose
         # every replica engine (watchdogs and queue gauges see each one).
         out: list[tuple[str, Any]] = []
-        for name, e in list(self._entries.items()):
+        for name, e in locked_snapshot(self._entries).items():
             if e.scheduler is not None:
                 out.append((name, e.scheduler))
             elif e.pool is not None:
@@ -864,8 +865,10 @@ class LocalTpuWorker(LlmWorkerApi):
         controllable (drain/undrain/restart); single-engine entries are
         listed with their supervisor state but have no pool to drain into."""
         rows: list[tuple[dict[str, Any], Any, int]] = []
-        for name in sorted(self._entries):
-            entry = self._entries[name]
+        # doctor/lifecycle threads call this while the event loop builds or
+        # evicts entries — one advisory snapshot, then a stable iteration
+        # (the RC04 contract; a KeyError mid-walk would 500 the endpoint)
+        for name, entry in sorted(locked_snapshot(self._entries).items()):
             if entry.pool is not None:
                 lc = entry.pool.lifecycle
                 for i, eng in enumerate(entry.pool.replicas):
@@ -963,7 +966,7 @@ class LocalTpuWorker(LlmWorkerApi):
         counts = {"replicas": 0, "serving": 0, "healthy": 0, "probation": 0,
                   "draining": 0, "drained": 0, "quarantined": 0,
                   "rebuilding": 0, "benched": 0}
-        for name, entry in list(self._entries.items()):
+        for name, entry in locked_snapshot(self._entries).items():
             if entry.pool is not None and entry.pool.lifecycle is not None:
                 c = entry.pool.lifecycle.counts()
                 counts["replicas"] += c["replicas"]

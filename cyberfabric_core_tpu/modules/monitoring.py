@@ -11,6 +11,7 @@ from __future__ import annotations
 from aiohttp import web
 
 from ..modkit import Module, module
+from ..modkit.concurrency import locked_snapshot
 from ..modkit.contracts import RestApiCapability, RunnableCapability
 from ..modkit.context import ModuleCtx
 from ..modkit.lifecycle import ReadySignal
@@ -266,10 +267,9 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
         def lookahead_depth() -> float:
             weighted = total = 0
             for sched in _schedulers():
-                try:  # scheduler thread inserts new depth keys mid-copy
-                    hist = dict(getattr(sched, "_depth_hist", {}))
-                except RuntimeError:
-                    continue  # advisory metric: skip this scrape
+                # scheduler thread inserts new depth keys mid-copy:
+                # advisory snapshot, degrades to empty for this scrape
+                hist = locked_snapshot(getattr(sched, "_depth_hist", {}))
                 for d, n in hist.items():
                     weighted += int(d) * n
                     total += n
@@ -313,10 +313,9 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
         def spec_accept_len() -> float:
             weighted = total = 0
             for sched in _schedulers():
-                try:  # scheduler thread inserts new accept-len keys mid-copy
-                    hist = dict(getattr(sched, "_spec_accept_hist", {}))
-                except RuntimeError:
-                    continue  # advisory metric: skip this scrape
+                # scheduler thread inserts new accept-len keys mid-copy
+                hist = locked_snapshot(
+                    getattr(sched, "_spec_accept_hist", {}))
                 for a, n in hist.items():
                     weighted += int(a) * n
                     total += n
@@ -371,10 +370,8 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
         def queue_wait_p50_ms() -> float:
             waits: list[float] = []
             for sched in _schedulers():
-                try:
-                    waits.extend(sched.queue_wait_samples)
-                except RuntimeError:
-                    pass  # deque mutated mid-iteration: advisory metric
+                # deque resized mid-iteration: advisory snapshot
+                waits.extend(locked_snapshot(sched.queue_wait_samples))
             if not waits:
                 return 0.0
             return float(sorted(waits)[len(waits) // 2])
@@ -592,10 +589,8 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
             limit = _int_param(request, "limit", 512)
             per_model: dict[str, list[dict]] = {}
             for name, sched in _schedulers_named():
-                try:  # snapshot a deque the scheduler thread appends to
-                    rounds = list(sched.round_timings)
-                except RuntimeError:
-                    rounds = []
+                # snapshot a deque the scheduler thread appends to
+                rounds = locked_snapshot(sched.round_timings)
                 rounds = rounds[-limit:] if limit else []
                 per_model[name] = rounds
             if fmt == "json":

@@ -103,6 +103,7 @@ import numpy as np
 
 from ..models import llama
 from ..models.configs import ModelConfig, get_config
+from ..modkit.concurrency import locked_snapshot
 from ..modkit.failpoints import failpoint, record_recovery
 from ..modkit.flight_recorder import record_event
 from ..modkit.metrics import bump_counter
@@ -1160,12 +1161,16 @@ class ContinuousBatchingEngine:
         return found
 
     def _cancel_known(self, request_id: str) -> bool:
-        """Advisory presence probe (GIL-atomic reads + one queue-mutex peek;
-        the authoritative lookup happens on the scheduler thread)."""
+        """Advisory presence probe (slot scan + suspended-deque snapshot +
+        one queue-mutex peek; the authoritative lookup happens on the
+        scheduler thread). Runs on gateway threads — the suspended deque
+        must be copied under the advisory contract, not bare ``list()``:
+        the scheduler thread preempts/resumes concurrently, and a resized
+        deque raises mid-copy (fabric-lint RC04)."""
         for state in self.slots:
             if state is not None and state.request_id == request_id:
                 return True
-        for rec in list(self._suspended):
+        for rec in locked_snapshot(self._suspended):
             if rec.state.request_id == request_id:
                 return True
         return any(req.request_id == request_id
@@ -1365,10 +1370,7 @@ class ContinuousBatchingEngine:
         poisoned estimate could otherwise lock out every deadline-carrying
         request forever (rejected requests never prefill, so the rate would
         never correct)."""
-        try:
-            rate = max(self._prefill_rates, default=0.0)
-        except RuntimeError:  # advisory read against the scheduler thread
-            rate = 0.0
+        rate = max(locked_snapshot(self._prefill_rates), default=0.0)
         if rate <= 0:
             return 0.0
         return tokens / rate
@@ -1406,10 +1408,7 @@ class ContinuousBatchingEngine:
         span without contributing its count (its admissions happened over
         an interval that ENDED at its timestamp — counting them would
         overestimate the rate when samples are few)."""
-        try:
-            events = list(self._admit_events)
-        except RuntimeError:  # advisory read against the scheduler thread
-            return 0.0
+        events = locked_snapshot(self._admit_events)
         cutoff = time.monotonic() - self._DRAIN_RATE_WINDOW_S
         events = [e for e in events if e[0] >= cutoff]
         if len(events) < 2:
@@ -1525,16 +1524,15 @@ class ContinuousBatchingEngine:
         depths = self._pending.depths()
         vtc = self._pending.vtc_snapshot()
         charged = self._pending.charged_snapshot()
-        try:
-            # gateway threads insert new tenant/reason keys on rejection
-            # while this (possibly a lifecycle/doctor thread) iterates —
-            # the _depth_hist advisory-snapshot contract: degrade, never
-            # raise (a raising stats() quarantines a healthy replica)
-            rejections = {t: dict(per)
-                          for t, per in self.tenant_rejections.items()}
-            yields = dict(self.tenant_soft_yields)
-        except RuntimeError:
-            rejections, yields = {}, {}
+        # gateway threads insert new tenant/reason keys on rejection while
+        # this (possibly a lifecycle/doctor thread) iterates — the advisory
+        # snapshot contract: degrade, never raise (a raising stats()
+        # quarantines a healthy replica). Inner per-tenant dicts grow new
+        # reason keys concurrently too, so they get their own snapshots.
+        rejections = {t: locked_snapshot(per)
+                      for t, per in
+                      locked_snapshot(self.tenant_rejections).items()}
+        yields = locked_snapshot(self.tenant_soft_yields)
         tenants = (set(slots) | set(pages) | set(depths) | set(charged)
                    | set(rejections))
         out: dict[str, dict[str, Any]] = {}
@@ -1566,12 +1564,10 @@ class ContinuousBatchingEngine:
         """Round-liveness snapshot for the doctor's watchdogs: how long ago
         the last decode round completed, the recent p95 round time, and
         whether there is work the loop OUGHT to be making progress on."""
-        try:  # advisory snapshot of a deque the scheduler thread appends to
-            durations = sorted(
-                t["dispatch_ms"] + t["sync_wait_ms"] + t["host_emit_ms"]
-                for t in list(self.round_timings))
-        except RuntimeError:
-            durations = []
+        # advisory snapshot of a deque the scheduler thread appends to
+        durations = sorted(
+            t["dispatch_ms"] + t["sync_wait_ms"] + t["host_emit_ms"]
+            for t in locked_snapshot(self.round_timings))
         p95 = durations[int(0.95 * (len(durations) - 1))] if durations else 0.0
         return {
             "last_round_age_s": round(time.monotonic() - self.last_round_at, 3),
@@ -1593,21 +1589,16 @@ class ContinuousBatchingEngine:
         return float(s[len(s) // 2])
 
     def stats(self) -> dict[str, Any]:
-        occ = sum(self.occupancy_samples) / max(1, len(self.occupancy_samples))
-        # snapshot deques the scheduler thread appends to (advisory metrics —
-        # a torn read under contention degrades to zeros, never crashes)
-        try:
-            timings = list(self.round_timings)
-            waits = list(self.queue_wait_samples)
-            resumes = list(self.resume_latency_samples)
-            rb_waits = list(self.readback_wait_samples)
-        except RuntimeError:
-            timings, waits, resumes, rb_waits = [], [], [], []
-        la = dict(self._lookahead_stats)
-        try:  # the scheduler thread inserts new depth keys mid-iteration
-            depth_hist = dict(self._depth_hist)
-        except RuntimeError:
-            depth_hist = {}
+        # snapshot collections the scheduler thread resizes (advisory
+        # metrics — locked_snapshot degrades to empty, never raises)
+        occ_samples = locked_snapshot(self.occupancy_samples)
+        occ = sum(occ_samples) / max(1, len(occ_samples))
+        timings = locked_snapshot(self.round_timings)
+        waits = locked_snapshot(self.queue_wait_samples)
+        resumes = locked_snapshot(self.resume_latency_samples)
+        rb_waits = locked_snapshot(self.readback_wait_samples)
+        la = dict(self._lookahead_stats)  # fixed key set: updates, no resize
+        depth_hist = locked_snapshot(self._depth_hist)
         pipeline = {
             "rounds": self.decode_rounds,
             "lookahead_rounds": self.lookahead_rounds,
@@ -1638,10 +1629,7 @@ class ContinuousBatchingEngine:
             "prefill_chunks": self.prefill_chunks,
             "chunked_prefill_tokens": self.chunked_prefill_tokens,
         }
-        try:  # the scheduler thread inserts new accept-length keys mid-copy
-            accept_hist = dict(self._spec_accept_hist)
-        except RuntimeError:
-            accept_hist = {}
+        accept_hist = locked_snapshot(self._spec_accept_hist)
         spec = dict(self.spec_stats)
         speculative = {
             "k": self.spec_k,
@@ -1691,7 +1679,8 @@ class ContinuousBatchingEngine:
             "rejected_saturated": self.rejected_saturated,
             # end-to-end cancellation: terminals by reason + the decode
             # budget (max_tokens never generated) reclaimed for live users
-            "cancellations": dict(self.cancellations),
+            # (reason keys are inserted by the scheduler thread mid-copy)
+            "cancellations": locked_snapshot(self.cancellations),
             "reclaimed_tokens": self.reclaimed_tokens,
             # preempt→resume recovery latency (the stream-pause a client
             # actually experiences); also exported device-wide as the

@@ -1,0 +1,407 @@
+"""Dynamic counterpart to fabric-lint's RC rule families.
+
+RC02 proves statically that the guarded-state discipline holds; this suite
+checks the same invariants at runtime under real thread interleavings: N
+threads hammer ``TenantFairQueue`` put/pop/charge, the flight recorder's
+record/reopen/snapshot surfaces, and the metrics RMW paths under a seeded
+schedule, asserting **no exceptions** and **conserved counters** — the
+lost-update and changed-size-during-iteration bug classes the static rules
+flag (the pre-fix PR-10 ``charge()`` loses updates here deterministically
+enough to fail within a run).
+
+``sys.setswitchinterval`` is dropped to ~10µs for the duration so the
+interpreter forces orders of magnitude more preemption points than the
+default 5ms — races that would hide for weeks surface in seconds.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import threading
+import time
+from collections import deque
+from types import SimpleNamespace
+
+import pytest
+
+from cyberfabric_core_tpu.modkit.concurrency import locked_snapshot
+from cyberfabric_core_tpu.modkit.flight_recorder import FlightRecorder
+from cyberfabric_core_tpu.modkit.metrics import (Counter, Gauge, Histogram,
+                                                 MetricsRegistry)
+from cyberfabric_core_tpu.runtime.engine import SamplingParams
+from cyberfabric_core_tpu.runtime.scheduler import (ContinuousBatchingEngine,
+                                                    TenantFairQueue, _Pending)
+
+SEED = 0xFAB
+N_THREADS = 4
+
+
+@pytest.fixture(autouse=True)
+def _aggressive_preemption():
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+    try:
+        yield
+    finally:
+        sys.setswitchinterval(old)
+
+
+def _run_threads(targets) -> list[BaseException]:
+    """Start all, join all, return every exception raised in a worker."""
+    errors: list[BaseException] = []
+
+    def wrap(fn):
+        def inner():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — the assert surface
+                errors.append(e)
+        return inner
+
+    threads = [threading.Thread(target=wrap(t)) for t in targets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "stress worker hung"
+    return errors
+
+
+def _pending(rid: str, tenant: str) -> _Pending:
+    return _Pending(rid, [1, 2, 3], SamplingParams(max_tokens=4),
+                    emit=lambda ev: None, tenant=tenant)
+
+
+# ------------------------------------------------------- TenantFairQueue
+
+
+def test_tenant_fair_queue_put_pop_charge_stress():
+    """Producers put across tenants, a popper drains fairly, chargers RMW
+    the virtual counters, and readers snapshot — concurrently. Every put is
+    popped exactly once and every charged token is conserved (the PR-10
+    lock-free charge() loses updates under this schedule)."""
+    q = TenantFairQueue(fair=True)
+    per_thread = 400
+    charges_per_thread = 2000
+    tenants = ["acme", "umbrella", "initech"]
+    popped: list = []
+    done = threading.Event()
+
+    def producer(i: int):
+        rng = random.Random(SEED + i)
+        for n in range(per_thread):
+            q.put(_pending(f"r{i}-{n}", rng.choice(tenants)))
+
+    def charger(i: int):
+        rng = random.Random(SEED ^ i)
+        for _ in range(charges_per_thread):
+            q.charge(rng.choice(tenants), 1, 1.0)
+
+    def popper():
+        deadline = time.monotonic() + 30
+        while len(popped) < N_THREADS * per_thread:
+            req = q.pop_fair()
+            if req is not None:
+                popped.append(req)
+            elif time.monotonic() > deadline:
+                raise AssertionError(
+                    f"popper starved: {len(popped)} of "
+                    f"{N_THREADS * per_thread}")
+
+    def reader():
+        while not done.is_set():
+            q.depths()
+            q.vtc_snapshot()
+            q.charged_snapshot()
+            q.oldest_age()
+            q.snapshot()
+
+    workers = [lambda i=i: producer(i) for i in range(N_THREADS)]
+    workers += [lambda i=i: charger(i) for i in range(N_THREADS)]
+    workers += [popper]
+
+    reader_t = threading.Thread(target=reader)
+    reader_t.start()
+    errors = _run_threads(workers)
+    done.set()
+    reader_t.join(timeout=10)
+    assert errors == []
+
+    # conservation: every put popped exactly once, nothing left behind
+    assert len(popped) == N_THREADS * per_thread
+    assert len({r.request_id for r in popped}) == len(popped)
+    assert q.qsize() == 0 and q.empty()
+    # conservation: charged tokens sum exactly (unit charges at weight 1.0
+    # are exact in float) — a lost RMW shows up as a shortfall here
+    charged = q.charged_snapshot()
+    assert sum(charged.values()) == N_THREADS * charges_per_thread
+    vtc = q.vtc_snapshot()
+    for tenant, tokens in charged.items():
+        assert vtc[tenant] >= float(tokens)  # put-lift only ever raises it
+
+
+def test_tenant_fair_queue_remove_if_under_churn():
+    """remove_if (the cancel sweep's primitive) racing puts never loses a
+    request: removed + popped + left == put."""
+    q = TenantFairQueue(fair=True)
+    per_thread = 500
+    removed: list = []
+
+    def producer(i: int):
+        for n in range(per_thread):
+            q.put(_pending(f"r{i}-{n}", f"t{i}"))
+
+    def sweeper():
+        for _ in range(200):
+            removed.extend(
+                q.remove_if(lambda r: r.request_id.endswith("7")))
+
+    errors = _run_threads(
+        [lambda i=i: producer(i) for i in range(N_THREADS)] + [sweeper])
+    assert errors == []
+    removed.extend(q.remove_if(lambda r: r.request_id.endswith("7")))
+    left = q.drain_all()
+    assert len(removed) + len(left) == N_THREADS * per_thread
+    assert not any(r.request_id.endswith("7") for r in left)
+    assert len({r.request_id for r in removed + left}) == \
+        N_THREADS * per_thread
+
+
+# ------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_record_reopen_snapshot_stress():
+    """Writers drive full request timelines (some with the failover REOPEN
+    path), readers walk every snapshot surface — no exceptions, no stuck
+    live rows, ring bounds hold."""
+    rec = FlightRecorder(max_live=4096, max_finished=128, max_events=64)
+    per_thread = 250
+    done = threading.Event()
+
+    def writer(i: int):
+        rng = random.Random(SEED + i)
+        for n in range(per_thread):
+            rid = f"req-{i}-{n}"
+            rec.record(rid, "enqueued", prompt_tokens=8)
+            rec.record(rid, "admitted", slot=n % 8)
+            for c in range(rng.randrange(1, 4)):
+                rec.record(rid, "decode_chunk", tokens=2, chunk=c)
+            if rng.random() < 0.25:
+                # failover reopen: error → failover → enqueued → finished
+                # must stay ONE story under one id
+                rec.record(rid, "error", detail="injected")
+                rec.record(rid, "failover", attempt=1)
+                rec.record(rid, "enqueued", prompt_tokens=8)
+                rec.record(rid, "admitted", slot=n % 8)
+            rec.record(rid, "finished", reason="stop", tokens=4)
+
+    def reader():
+        while not done.is_set():
+            rec.inflight()
+            rec.inflight(stalled_only=True)
+            rec.recent(32)
+            rec.stats()
+            rec.lookup(f"req-0-{random.randrange(per_thread)}")
+
+    reader_t = threading.Thread(target=reader)
+    reader_t.start()
+    errors = _run_threads([lambda i=i: writer(i) for i in range(N_THREADS)])
+    done.set()
+    reader_t.join(timeout=10)
+    assert errors == []
+
+    stats = rec.stats()
+    assert stats["live"] == 0, "every timeline got its terminal"
+    assert stats["finished"] <= 128, "finished ring bound held"
+    for row in rec.recent(128):
+        assert row["phase"] in ("finished", "error", "evicted")
+
+
+# ----------------------------------------------------------- metrics RMW
+
+
+def test_metrics_rmw_conservation():
+    """The PR-4 bug class at runtime: unlocked Counter/Gauge/Histogram RMWs
+    lose increments under contention — with the per-metric locks, counts
+    conserve exactly while scrapes render concurrently."""
+    registry = MetricsRegistry()
+    counter = registry.counter("stress_total")
+    hist = registry.histogram("stress_seconds")
+    gauge = registry.gauge("stress_depth")
+    per_thread = 5000
+    done = threading.Event()
+
+    def bumper(i: int):
+        for n in range(per_thread):
+            counter.inc(1.0, tenant=f"t{i % 2}")
+            hist.observe(n % 10 / 10.0)
+            gauge.set(float(n), shard=str(i))
+
+    def scraper():
+        while not done.is_set():
+            registry.render()
+
+    scraper_t = threading.Thread(target=scraper)
+    scraper_t.start()
+    errors = _run_threads([lambda i=i: bumper(i) for i in range(N_THREADS)])
+    done.set()
+    scraper_t.join(timeout=10)
+    assert errors == []
+
+    total = sum(counter._values.values())
+    assert total == N_THREADS * per_thread
+    assert sum(hist._totals.values()) == N_THREADS * per_thread
+    # labeled gauges: every shard ends at its final set
+    for i in range(N_THREADS):
+        key = (("shard", str(i)),)
+        assert gauge._values[key] == float(per_thread - 1)
+
+
+# ------------------------------------------------ fixed-race regressions
+
+
+def test_cancel_known_probe_races_suspended_churn():
+    """Regression for the RC04 fix in ContinuousBatchingEngine._cancel_known:
+    the gateway-thread presence probe snapshots the suspended deque via
+    locked_snapshot while the scheduler thread preempts/resumes (resizing
+    it) — no RuntimeError, and a stably-present id is always found."""
+    eng = ContinuousBatchingEngine.__new__(ContinuousBatchingEngine)
+    eng.slots = [None] * 8
+    eng._pending = TenantFairQueue(fair=True)
+    eng._suspended = deque()
+    anchor = SimpleNamespace(state=SimpleNamespace(request_id="anchor"))
+    eng._suspended.append(anchor)
+    done = threading.Event()
+
+    def churner():
+        rng = random.Random(SEED)
+        for n in range(20000):
+            eng._suspended.append(SimpleNamespace(
+                state=SimpleNamespace(request_id=f"s{n}")))
+            if rng.random() < 0.9 and len(eng._suspended) > 1:
+                # pop from the right so the anchor at the left survives
+                eng._suspended.pop()
+        done.set()
+
+    found = []
+
+    def prober():
+        while not done.is_set():
+            assert eng._cancel_known("anchor") is True
+            found.append(1)
+            eng._cancel_known("never-submitted")
+
+    errors = _run_threads([churner, prober])
+    assert errors == []
+    assert found, "prober never ran"
+
+
+def test_doctor_queue_gauge_export_races_configure():
+    """Regression for the RC02 fix in Doctor._export_queue_gauges: the
+    seen-set RMW now runs under the doctor lock, so a concurrent
+    configure() reset cannot interleave a stale read-modify-write (and the
+    export loop never raises against the swap)."""
+    from cyberfabric_core_tpu.modkit.doctor import Doctor, DoctorConfig
+
+    rec = FlightRecorder()
+    doctor = Doctor(DoctorConfig(), recorder=rec)
+    tick = [0]
+
+    def fake_sched():
+        tick[0] += 1
+        tenants = {f"t{tick[0] % 5}": {"pending": tick[0] % 3}}
+        return SimpleNamespace(
+            pending_depth=lambda: 1.0,
+            pending_oldest_age_s=lambda: 0.5,
+            tenant_snapshot=lambda: tenants)
+
+    doctor.set_scheduler_provider(lambda: [("m", fake_sched())])
+
+    def configurer():
+        for _ in range(300):
+            doctor.configure(DoctorConfig())
+
+    def exporter():
+        for _ in range(300):
+            doctor._export_queue_gauges()
+
+    errors = _run_threads([configurer, exporter, exporter])
+    assert errors == []
+    # the seen-set is wholly owned by the lock now: one quiesced export
+    # leaves exactly the nonzero tenants recorded
+    doctor.configure(DoctorConfig())
+    doctor.set_scheduler_provider(lambda: [("m", SimpleNamespace(
+        pending_depth=lambda: 1.0,
+        pending_oldest_age_s=lambda: 0.5,
+        tenant_snapshot=lambda: {"busy": {"pending": 2},
+                                 "idle": {"pending": 0}}))])
+    doctor._export_queue_gauges()
+    assert doctor._queue_gauge_tenants == {"m": {"busy"}}
+
+
+def test_scheduler_stats_collections_snapshot_under_churn():
+    """Regression for the RC04 fixes in stats()/tenant_snapshot(): the
+    occupancy/cancellations/rejection collections are snapshotted through
+    locked_snapshot, so a monitoring thread copying them while the
+    scheduler/gateway threads resize never raises and never tears."""
+    from collections import deque as _deque
+
+    occupancy = _deque(maxlen=1000)
+    cancellations: dict = {}
+    rejections: dict = {}
+    done = threading.Event()
+
+    def mutator():
+        rng = random.Random(SEED)
+        for n in range(30000):
+            occupancy.append(n % 8)
+            cancellations[f"reason{rng.randrange(50)}"] = n
+            per = rejections.setdefault(f"tenant{rng.randrange(50)}", {})
+            per[f"r{rng.randrange(8)}"] = n
+        done.set()
+
+    def snapshotter():
+        while not done.is_set():
+            occ = locked_snapshot(occupancy)
+            sum(occ)
+            locked_snapshot(cancellations)
+            {t: locked_snapshot(per)
+             for t, per in locked_snapshot(rejections).items()}
+
+    errors = _run_threads([mutator, snapshotter, snapshotter])
+    assert errors == []
+
+
+# ------------------------------------------------------- locked_snapshot
+
+
+def test_locked_snapshot_copies_by_kind():
+    assert locked_snapshot({"a": 1}) == {"a": 1}
+    assert isinstance(locked_snapshot({"a": 1}), dict)
+    assert locked_snapshot({1, 2}) == {1, 2}
+    assert locked_snapshot(deque([1, 2])) == [1, 2]
+    assert locked_snapshot([1, 2]) == [1, 2]
+
+
+def test_locked_snapshot_lock_mode_acquires():
+    lock = threading.Lock()
+    snap = locked_snapshot({"a": 1}, lock=lock)
+    assert snap == {"a": 1} and not lock.locked()
+
+
+def test_locked_snapshot_retries_then_degrades():
+    class Flaky:
+        def __init__(self, failures: int):
+            self.failures = failures
+
+        def __iter__(self):
+            if self.failures > 0:
+                self.failures -= 1
+                raise RuntimeError("deque mutated during iteration")
+            return iter([1, 2])
+
+    # two losses then a win: the retry loop lands the copy
+    assert locked_snapshot(Flaky(2)) == [1, 2]
+    # every attempt loses: degrade to empty, never raise
+    assert locked_snapshot(Flaky(99)) == []

@@ -757,6 +757,645 @@ def test_WD01_repo_gate_clean():
     assert findings == [], [f.to_dict() for f in findings]
 
 
+# ----------------------------------------------- RC family (fabric-race)
+
+#: the PR-8 pre-fix shape, distilled: _fail_all_inflight drains the pending
+#: queue UNDER _submit_lock and hands each request to the pool's failover,
+#: which (under its own lock) resubmits into a sibling engine's submit —
+#: submit takes _submit_lock again. Two same-round teardowns deadlock ABBA.
+PR8_ABBA_PREFIX = """
+import threading
+
+class ServingPool:
+    def __init__(self, engine: "Engine"):
+        self._lock = threading.Lock()
+        self.engine = engine
+
+    def failover(self, req):
+        with self._lock:
+            self.engine.submit(req)
+
+class Engine:
+    def __init__(self):
+        self._submit_lock = threading.Lock()
+        self._pending = []
+        self.pool = ServingPool(self)
+
+    def submit(self, req):
+        with self._submit_lock:
+            self._pending.append(req)
+
+    def _fail_all_inflight(self):
+        with self._submit_lock:
+            for req in list(self._pending):
+                self.pool.failover(req)
+"""
+
+#: the PR-10 pre-fix shape: charge() RMWs the virtual counters without the
+#: queue lock that guards every other write to them
+PR10_CHARGE_PREFIX = """
+import threading
+
+class TenantFairQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vtc = {}
+
+    def put(self, tenant):
+        with self._lock:
+            self._vtc[tenant] = max(self._vtc.get(tenant, 0.0), 1.0)
+
+    def charge(self, tenant, tokens, weight):
+        self._vtc[tenant] = self._vtc.get(tenant, 0.0) + tokens / weight
+"""
+
+
+def test_RC01_pr8_abba_prefix_shape_must_flag():
+    """Acceptance regression: the PR-8 ABBA deadlock's pre-fix shape is a
+    lock-order cycle RC01 must report, with both witness paths."""
+    bad = lint(PR8_ABBA_PREFIX, tier="runtime", select=("RC01",))
+    assert "RC01" in rule_ids(bad)
+    msg = " ".join(f.message for f in bad)
+    assert "_submit_lock" in msg
+    assert "_fail_all_inflight" in msg and "failover" in msg  # witness paths
+
+
+def test_RC01_emits_outside_lock_passes():
+    """The shipped fix: drain under the lock, hand off after releasing it —
+    no call is made while _submit_lock is held, so no cycle exists."""
+    ok = lint("""
+import threading
+
+class ServingPool:
+    def __init__(self, engine: "Engine"):
+        self._lock = threading.Lock()
+        self.engine = engine
+
+    def failover(self, req):
+        with self._lock:
+            self.engine.submit(req)
+
+class Engine:
+    def __init__(self):
+        self._submit_lock = threading.Lock()
+        self._pending = []
+        self.pool = ServingPool(self)
+
+    def submit(self, req):
+        with self._submit_lock:
+            self._pending.append(req)
+
+    def _fail_all_inflight(self):
+        stranded = []
+        with self._submit_lock:
+            stranded.extend(self._pending)
+            self._pending = []
+        for req in stranded:
+            self.pool.failover(req)
+""", tier="runtime", select=("RC01",))
+    assert ok == []
+
+
+def test_RC01_self_reacquire_through_helper_fails():
+    # a non-reentrant lock re-acquired two frames down self-deadlocks
+    bad = lint("""
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def _bump(self):
+        with self._lock:
+            self.n += 1
+
+    def tick(self):
+        with self._lock:
+            self._bump()
+""", tier="runtime", select=("RC01",))
+    assert rule_ids(bad) == ["RC01"]
+
+
+def test_RC01_rlock_reentry_passes():
+    ok = lint("""
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.n = 0
+
+    def _bump(self):
+        with self._lock:
+            self.n += 1
+
+    def tick(self):
+        with self._lock:
+            self._bump()
+""", tier="runtime", select=("RC01",))
+    assert ok == []
+
+
+def test_RC01_consistent_order_passes():
+    # A-then-B from two call paths is a hierarchy, not an inversion
+    ok = lint("""
+import threading
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def put(self, x):
+        with self._lock:
+            self.items.append(x)
+
+class Engine:
+    def __init__(self):
+        self._submit_lock = threading.Lock()
+        self._pending = Queue()
+
+    def submit(self, req):
+        with self._submit_lock:
+            self._pending.put(req)
+
+    def drain(self):
+        with self._submit_lock:
+            self._pending.put(None)
+""", tier="runtime", select=("RC01",))
+    assert ok == []
+
+
+def test_RC02_pr10_unlocked_charge_prefix_shape_must_flag():
+    """Acceptance regression: the PR-10 lock-free charge() RMW is exactly
+    the mixed-guard shape RC02 must report."""
+    bad = lint(PR10_CHARGE_PREFIX, tier="runtime", select=("RC02",))
+    assert rule_ids(bad) == ["RC02"]
+    assert "charge" in bad[0].message and "_vtc" in bad[0].message
+
+
+def test_RC02_locked_charge_passes():
+    ok = lint("""
+import threading
+
+class TenantFairQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vtc = {}
+
+    def put(self, tenant):
+        with self._lock:
+            self._vtc[tenant] = max(self._vtc.get(tenant, 0.0), 1.0)
+
+    def charge(self, tenant, tokens, weight):
+        with self._lock:
+            self._vtc[tenant] = self._vtc.get(tenant, 0.0) + tokens / weight
+""", tier="runtime", select=("RC02",))
+    assert ok == []
+
+
+def test_RC02_helper_called_under_lock_inherits_context():
+    """The LK01 false-positive class: a private helper only ever called
+    with the lock held inherits that context interprocedurally."""
+    ok = lint("""
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = {}
+
+    def _bump(self, key):
+        self._stats[key] = self._stats.get(key, 0) + 1
+
+    def note(self, key):
+        with self._lock:
+            self._bump(key)
+
+    def note_two(self, key):
+        with self._lock:
+            self._bump(key)
+            self._stats[key] = self._stats.get(key, 0) + 1
+""", tier="runtime", select=("RC02",))
+    assert ok == []
+
+
+def test_RC02_init_writes_free():
+    # __init__ happens-before thread start; so do helpers only it calls
+    ok = lint("""
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = {}
+        self._seed()
+
+    def _seed(self):
+        self._stats["boot"] = 1
+
+    def note(self, key):
+        with self._lock:
+            self._stats[key] = self._stats.get(key, 0) + 1
+""", tier="runtime", select=("RC02",))
+    assert ok == []
+
+
+def test_RC02_advisory_plain_store_not_inferred():
+    # one locked plain store vs one unlocked plain store: the sanctioned
+    # last-writer-wins advisory idiom (last_round_at) — no guard inferred
+    ok = lint("""
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last_round_at = 0.0
+
+    def submit(self, now):
+        with self._lock:
+            self.last_round_at = now
+
+    def round_done(self, now):
+        self.last_round_at = now
+""", tier="runtime", select=("RC02",))
+    assert ok == []
+
+
+def test_RC03_sleep_under_lock_fails():
+    bad = lint("""
+import threading
+import time
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def tick(self):
+        with self._lock:
+            time.sleep(0.1)
+""", tier="runtime", select=("RC03",))
+    assert rule_ids(bad) == ["RC03"]
+    assert "time.sleep" in bad[0].message
+
+
+def test_RC03_transitive_block_through_helper_fails():
+    # the blocking call two frames below the lock is the RacerD case the
+    # single-function families cannot see
+    bad = lint("""
+import threading
+import time
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _backoff(self):
+        self._wait()
+
+    def _wait(self):
+        time.sleep(0.5)
+
+    def tick(self):
+        with self._lock:
+            self._backoff()
+""", tier="runtime", select=("RC03",))
+    assert rule_ids(bad) == ["RC03"]
+    assert "_backoff" in bad[0].message and "_wait" in bad[0].message
+
+
+def test_RC03_emit_under_lock_fails():
+    # the PR-8 decree generalized: emit callbacks are foreign code
+    bad = lint("""
+import threading
+
+class Engine:
+    def __init__(self):
+        self._submit_lock = threading.Lock()
+        self._pending = []
+
+    def _fail_all(self):
+        with self._submit_lock:
+            for req in list(self._pending):
+                req.emit(None)
+""", tier="runtime", select=("RC03",))
+    assert rule_ids(bad) == ["RC03"]
+
+
+def test_RC03_blocking_outside_lock_passes():
+    ok = lint("""
+import threading
+import time
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def tick(self):
+        with self._lock:
+            self.n += 1
+        time.sleep(0.1)
+""", tier="runtime", select=("RC03",))
+    assert ok == []
+
+
+def test_RC03_only_shared_tier_locks_gate():
+    # a modules-tier helper class may block under its own lock — RC03 is a
+    # runtime/modkit data-plane rule
+    ok = lint("""
+import threading
+import time
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def refresh(self):
+        with self._lock:
+            time.sleep(0.1)
+""", tier="modules", select=("RC03",))
+    assert ok == []
+
+
+def test_RC04_unguarded_iteration_fails():
+    bad = lint("""
+import threading
+from collections import deque
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._suspended = deque()
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        self._suspended.append(1)
+
+    def probe(self, rid):
+        return rid in list(self._suspended)
+""", tier="runtime", select=("RC04",))
+    assert rule_ids(bad) == ["RC04"]
+    assert "_suspended" in bad[0].message
+
+
+def test_RC04_runtime_error_guard_passes():
+    ok = lint("""
+import threading
+from collections import deque
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._suspended = deque()
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        self._suspended.append(1)
+
+    def probe(self, rid):
+        try:
+            return rid in list(self._suspended)
+        except RuntimeError:
+            return False
+""", tier="runtime", select=("RC04",))
+    assert ok == []
+
+
+def test_RC04_locked_snapshot_helper_passes():
+    ok = lint("""
+import threading
+from collections import deque
+
+from cyberfabric_core_tpu.modkit.concurrency import locked_snapshot
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._suspended = deque()
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        self._suspended.append(1)
+
+    def probe(self, rid):
+        return rid in list(locked_snapshot(self._suspended))
+""", tier="runtime", select=("RC04",))
+    assert ok == []
+
+
+def test_RC04_iteration_under_guard_passes():
+    ok = lint("""
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def add(self, name):
+        with self._lock:
+            self._metrics[name] = 1
+
+    def render(self):
+        with self._lock:
+            return sorted(self._metrics)
+""", tier="modkit", select=("RC04",))
+    assert ok == []
+
+
+def test_RC04_fixed_key_dict_update_not_a_resize():
+    # constant-key stores into a literal-initialized dict update in place;
+    # they cannot raise `changed size during iteration` in a reader
+    ok = lint("""
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = {"hits": 0, "misses": 0}
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        self._stats["hits"] = self._stats["hits"] + 1
+
+    def stats(self):
+        return dict(self._stats)
+""", tier="runtime", select=("RC04",))
+    assert ok == []
+
+
+def test_RC04_same_thread_iteration_passes():
+    # iterate and resize on the SAME owning thread: sequential, not a race
+    ok = lint("""
+import threading
+from collections import deque
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = deque()
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        self._q.append(1)
+        self._drain()
+
+    def _drain(self):
+        for item in list(self._q):
+            pass
+""", tier="runtime", select=("RC04",))
+    assert ok == []
+
+
+def test_RC_waiver_round_trips():
+    """Each RC family suppresses through the standard inline waiver."""
+    waived_charge = PR10_CHARGE_PREFIX.replace(
+        "        self._vtc[tenant] = self._vtc.get(tenant, 0.0) + "
+        "tokens / weight",
+        "        # fabric-lint: waive RC02 reason=fixture\n"
+        "        self._vtc[tenant] = self._vtc.get(tenant, 0.0) + "
+        "tokens / weight")
+    assert waived_charge != PR10_CHARGE_PREFIX, "fixture drifted"
+    findings = Engine(all_rules()).select(["RC02"]).run_source(
+        waived_charge, relpath="runtime/snippet.py", tier="runtime")
+    assert findings and all(f.waived for f in findings)
+
+    bad = lint(PR8_ABBA_PREFIX, tier="runtime", select=("RC01",))
+    lines = PR8_ABBA_PREFIX.splitlines()
+    for f in Engine(all_rules()).select(["RC01"]).run_source(
+            PR8_ABBA_PREFIX, relpath="runtime/snippet.py", tier="runtime"):
+        lines[f.line - 1] += \
+            "  # fabric-lint: waive RC01 reason=fixture"
+    waived = Engine(all_rules()).select(["RC01"]).run_source(
+        "\n".join(lines), relpath="runtime/snippet.py", tier="runtime")
+    assert len(waived) == len(bad) and all(f.waived for f in waived)
+
+    rc03 = Engine(all_rules()).select(["RC03"]).run_source(
+        "import threading\n"
+        "import time\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def tick(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.1)"
+        "  # fabric-lint: waive RC03 reason=fixture\n",
+        relpath="runtime/snippet.py", tier="runtime")
+    assert rc03 and all(f.waived for f in rc03)
+
+    rc04 = Engine(all_rules()).select(["RC04"]).run_source(
+        "import threading\n"
+        "from collections import deque\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = deque()\n"
+        "        self._thread = threading.Thread(target=self._loop)\n"
+        "    def _loop(self):\n"
+        "        self._q.append(1)\n"
+        "    def probe(self):\n"
+        "        return list(self._q)"
+        "  # fabric-lint: waive RC04 reason=fixture\n",
+        relpath="runtime/snippet.py", tier="runtime")
+    assert rc04 and all(f.waived for f in rc04)
+
+
+def test_RC_baseline_round_trips():
+    baseline = {("runtime/snippet.py", "RC02"): 1}
+    findings = Engine(all_rules(), baseline).select(["RC02"]).run_source(
+        PR10_CHARGE_PREFIX, relpath="runtime/snippet.py", tier="runtime")
+    assert findings and findings[0].baselined
+    # the budget is finite: a second identical engine run is NOT absorbed
+    engine = Engine(all_rules(), baseline).select(["RC02"])
+    first = engine.run_source(PR10_CHARGE_PREFIX,
+                              relpath="runtime/snippet.py", tier="runtime")
+    second = engine.run_source(PR10_CHARGE_PREFIX,
+                               relpath="runtime/snippet.py", tier="runtime")
+    assert first[0].baselined and not second[0].baselined
+
+
+def test_RC_repo_gate_clean():
+    """The tentpole acceptance: RC01–RC04 run clean on the live package
+    (real findings fixed in this PR, sanctioned patterns carry reasoned
+    waivers)."""
+    engine = Engine(all_rules()).select(["RC"])
+    findings = [f for f in engine.run(PKG) if not f.suppressed]
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line} {f.rule} {f.message}" for f in findings)
+
+
+def test_RC_repo_waivers_are_reasoned():
+    """Every RC waiver in the package carries a written reason (WV01 makes
+    a reasonless one a finding, so this is belt-and-braces documentation)."""
+    engine = Engine(all_rules()).select(["RC"])
+    waived = [f for f in engine.run(PKG) if f.waived]
+    assert waived, "expected the sanctioned RC03 waivers to exist"
+    assert all(f.waive_reason for f in waived)
+
+
+# ----------------------------------------------------------- lock graph
+
+
+def test_lock_graph_dict_shape():
+    from cyberfabric_core_tpu.apps.fabric_lint.engine import (
+        FileContext, ProjectContext)
+    from cyberfabric_core_tpu.apps.fabric_lint.project_model import (
+        build_project_model, lock_graph_dict, lock_graph_dot)
+
+    ctx = FileContext(Path("runtime/snippet.py"), Path("."),
+                      source=PR8_ABBA_PREFIX)
+    ctx.relpath, ctx.tier = "runtime/snippet.py", "runtime"
+    model = build_project_model(ProjectContext(Path("."), [ctx]))
+    graph = lock_graph_dict(model)
+    labels = {n["lock"] for n in graph["nodes"]}
+    assert {"Engine._submit_lock", "ServingPool._lock"} <= labels
+    pairs = {(e["src"], e["dst"]) for e in graph["edges"]}
+    assert ("Engine._submit_lock", "ServingPool._lock") in pairs
+    assert ("ServingPool._lock", "Engine._submit_lock") in pairs
+    assert graph["cycles"], "the ABBA fixture must show up as a cycle"
+    dot = lock_graph_dot(model)
+    assert dot.startswith("digraph lock_order") and "color=\"red\"" in dot
+
+
+def test_lock_graph_refuses_partial_scan(tmp_path):
+    """A file that fails to parse must fail --lock-graph (exit 2) instead of
+    silently regenerating a hierarchy missing that file's locks."""
+    import io
+    from contextlib import redirect_stderr, redirect_stdout
+
+    from cyberfabric_core_tpu.apps.fabric_lint.__main__ import main
+
+    (tmp_path / "bad.py").write_text("def broken(:\n")
+    err = io.StringIO()
+    with redirect_stdout(io.StringIO()), redirect_stderr(err):
+        rc = main([str(tmp_path), "--lock-graph", "json"])
+    assert rc == 2 and "syntax error" in err.getvalue()
+
+
+def test_lock_graph_cli_json_and_drift():
+    """--lock-graph regenerates the committed artifact byte-for-byte (the
+    CI drift check) and exits 0 because the committed hierarchy is
+    acyclic."""
+    import io
+    from contextlib import redirect_stdout
+
+    from cyberfabric_core_tpu.apps.fabric_lint.__main__ import main
+
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = main([str(PKG), "--lock-graph", "json"])
+    assert rc == 0
+    regenerated = json.loads(out.getvalue())
+    committed = json.loads((REPO / "docs" / "lock_graph.json").read_text())
+    assert regenerated == committed, (
+        "docs/lock_graph.json is stale — run `make lock-graph` and commit "
+        "the regenerated hierarchy")
+    assert regenerated["cycles"] == []
+
+
 # ------------------------------------------------------- waivers + baseline
 
 
