@@ -5,9 +5,9 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint lock-graph lock-graph-check modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench lifecycle-guard cancel-guard fairness-guard
+.PHONY: safety lint lock-graph lock-graph-check modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench lifecycle-guard cancel-guard fairness-guard
 
-safety: lint lock-graph-check modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench lifecycle-guard cancel-guard fairness-guard  ## the full local gate
+safety: lint lock-graph-check modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench lifecycle-guard cancel-guard fairness-guard  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
 
@@ -72,6 +72,10 @@ overlap-bench:  ## deep-lookahead pipeline tests + the depth 0/1/N sweep (BENCH_
 spec-bench:  ## batched speculative decoding tests + the greedy repetitive-storm k=0-vs-k A/B (BENCH_SPEC.json: tok/s must improve, acceptance histogram reported)
 	$(PY) -m pytest tests/test_scheduler_spec.py -q
 	$(PY) bench.py --spec-bench > /dev/null
+
+tp-bench:  ## tensor-parallel engine tests (tp=8 streams bit-identical to tp=1) + the tp=1-vs-N A/B on forced host devices (BENCH_TP.json: per-dispatch collective overhead)
+	$(PY) -m pytest tests/test_tp_engine.py tests/test_parallel.py -q
+	$(PY) bench.py --tp-bench > /dev/null
 
 lifecycle-guard:  ## replica lifecycle tests + the disarmed-supervisor overhead A/B (BENCH_LIFECYCLE.json, <1% bar)
 	$(PY) -m pytest tests/test_lifecycle.py tests/test_replicas.py -q

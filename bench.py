@@ -1176,6 +1176,122 @@ def spec_bench() -> int:
     return 0 if report["pass"] else 1
 
 
+def tp_bench() -> int:
+    """Tensor-parallel A/B (BENCH_TP.json): the --aggregate staggered storm
+    through the continuous scheduler at tp=1 (the single-device engine) vs
+    tp=N (``BENCH_TP_N``, default 2) on FORCED HOST devices
+    (--xla_force_host_platform_device_count). Reports tok/s, ttft_p50,
+    itl_p99 and the per-dispatch COLLECTIVE OVERHEAD (the tp arm's
+    dispatch_ms_p50 minus the tp=1 arm's — what GSPMD's inserted
+    all-reduces and the per-device program launches cost each decode
+    round); interleaved ABBA ordering, per-arm best-tok/s run reported.
+
+    What the CPU A/B measures: each forced host "device" runs on its own
+    host threads, so GSPMD partitioning spreads the per-dispatch compute
+    across cores — on a multi-core host the tp arm can genuinely WIN
+    (observed: dispatch_ms_p50 collapses and tok/s rises), in which case
+    the overhead column goes negative (parallel speedup dominating the
+    emulated-collective cost); on a single-core host it degrades to pure
+    overhead. Either way the capability tp buys in production is HBM
+    SCALE-OUT — the feasibility verdict pair (bf16@tp=8 rejected,
+    int8@tp=8 fits at 74%, FEASIBILITY_70B.json) — with the collectives
+    riding dedicated ICI. The structural pass: the tp arm serves the
+    identical storm to completion, zero errors, mesh block reporting the
+    topology; stream bit-identity across tp is pinned by
+    tests/test_tp_engine.py."""
+    reps = int(os.environ.get("BENCH_TP_REPS", "2"))
+    tp_n = max(2, int(os.environ.get("BENCH_TP_N", "2")))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_COST="0")
+    env.setdefault("BENCH_STAGGER_S", "0.05")
+    env.setdefault("BENCH_DECODE_CHUNK", "8")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{max(8, tp_n)}").strip()
+
+    def one(tp: int) -> Optional[dict]:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--aggregate",
+             "tiny-llama", "none"],
+            capture_output=True, text=True, timeout=1200,
+            env=dict(env, BENCH_TP=str(tp)))
+        sys.stderr.write(proc.stderr[-2000:])
+        try:
+            row = json.loads(proc.stdout.strip().splitlines()[-1])
+            return row if "tokens_per_sec" in row else None
+        except Exception as e:  # noqa: BLE001
+            log(f"tp-bench child (tp={tp}) failed: {e}")
+            return None
+
+    arms: dict[int, list[dict]] = {1: [], tp_n: []}
+    order = ([1, tp_n, tp_n, 1] * ((reps + 1) // 2))[: 2 * reps]
+    for tp in order:
+        row = one(tp)
+        if row is not None:
+            arms[tp].append(row)
+
+    keep = ("tokens_per_sec", "itl_p50_ms", "itl_p99_ms", "ttft_p50_ms",
+            "complete", "errors", "tp", "mesh", "round_ms_p50")
+
+    def best(rows: list[dict]) -> Optional[dict]:
+        if not rows:
+            return None
+        r = max(rows, key=lambda r: r["tokens_per_sec"])
+        return {m: r.get(m) for m in keep}
+
+    b1, bn = best(arms[1]), best(arms[tp_n])
+    report: dict = {
+        "kind": "tensor_parallel_ab_cpu_evidence",
+        "note": "aggregate staggered storm (8 streams) at tp=1 vs tp=N on "
+                "forced host devices; interleaved ABBA runs, per-arm "
+                "best-tok/s run reported",
+        "tp_n": tp_n,
+        "runs": {str(tp): [{m: r.get(m) for m in keep} for r in rows]
+                 for tp, rows in arms.items()},
+        "tp1": b1, "tpN": bn,
+    }
+    if b1 and bn:
+        d1 = (b1.get("round_ms_p50") or {}).get("dispatch_ms_p50", 0.0)
+        dn = (bn.get("round_ms_p50") or {}).get("dispatch_ms_p50", 0.0)
+        mesh = bn.get("mesh") or {}
+        report.update({
+            "tokens_per_sec_delta_pct": round(
+                (bn["tokens_per_sec"]
+                 / max(b1["tokens_per_sec"], 1e-9) - 1.0) * 100.0, 1),
+            "ttft_p50_delta_pct": round(
+                (bn["ttft_p50_ms"]
+                 / max(b1["ttft_p50_ms"], 1e-9) - 1.0) * 100.0, 1),
+            "itl_p99_delta_pct": round(
+                (bn["itl_p99_ms"]
+                 / max(b1["itl_p99_ms"], 1e-9) - 1.0) * 100.0, 1),
+            # the honest mesh cost on this host: added host-emulated
+            # collective + multi-device launch time per decode dispatch
+            "collective_overhead_ms_per_dispatch": round(dn - d1, 3),
+            "collective_overhead_pct": round(
+                (dn / max(d1, 1e-9) - 1.0) * 100.0, 1),
+            "hbm_note": (
+                "production tp buys HBM scale-out (bf16@tp=8 rejected, "
+                "int8@tp=8 fits at 74% — FEASIBILITY_70B.json); on this "
+                "CPU host each forced device owns host threads, so a "
+                "negative overhead column means GSPMD's compute split "
+                "across cores beat the emulated-collective cost — a real "
+                "parallel speedup, not a measurement artifact"),
+            # the claims this harness CAN prove: the mesh engine serves
+            # the identical storm to completion with zero errors and
+            # reports its topology; bit-identity is pinned in tier-1
+            "pass": bool(bn.get("complete") and bn.get("errors") == 0
+                         and (mesh.get("tp") == tp_n)),
+        })
+    else:
+        report["pass"] = False
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_TP.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return 0 if report["pass"] else 1
+
+
 def aggregate(model_name: str, quant: str) -> int:
     """8 concurrent streams through the continuous scheduler (paged KV pool +
     ragged paged decode attention), with STAGGERED arrivals — the pattern the
@@ -1251,6 +1367,11 @@ def aggregate(model_name: str, quant: str) -> int:
         # ragged span with on-device accept/rollback; 0/unset = off (the
         # bit-identity baseline). --spec-bench sweeps it (BENCH_SPEC.json).
         spec_k = int(os.environ.get("BENCH_SPEC_K", "0") or "0")
+        # BENCH_TP: tensor-parallel degree — the engine lifts onto a
+        # NamedSharding mesh over the first N visible devices (forced-host
+        # CPU devices in the A/B). 1/unset = the single-device engine.
+        # --tp-bench sweeps it (BENCH_TP.json).
+        tp = int(os.environ.get("BENCH_TP", "1") or "1")
         cfg = EngineConfig(model=model_name, max_seq_len=512, max_batch=slots,
                            decode_chunk=decode_chunk, quantization=quant,
                            prefix_cache_pages=slots * 8 + 33,
@@ -1259,7 +1380,8 @@ def aggregate(model_name: str, quant: str) -> int:
                            mixed_batch=mixed,
                            prefill_budget_tokens=budget,
                            tenant_fair=tenant_fair,
-                           scheduler_spec_k=spec_k)
+                           scheduler_spec_k=spec_k,
+                           tp=tp)
         #: lifecycle-guard A/B arms (BENCH_LIFECYCLE.json): BOTH arms route
         #: the storm through a 1-replica DataParallelServingPool so the pool
         #: wrapper cost cancels out of the delta — "on" arms the lifecycle
@@ -1407,6 +1529,8 @@ def aggregate(model_name: str, quant: str) -> int:
                           "decode_lookahead": lookahead,
                           "mixed_batch": mixed,
                           "spec_k": spec_k,
+                          "tp": tp,
+                          "mesh": stats.get("mesh"),
                           "speculative": stats.get("speculative", {}),
                           "mixed_rounds": pipe.get("mixed_rounds", 0),
                           "prefill_chunks": pipe.get("prefill_chunks", 0),
@@ -1795,6 +1919,8 @@ if __name__ == "__main__":
         sys.exit(overlap_bench())
     if len(sys.argv) > 1 and sys.argv[1] == "--spec-bench":
         sys.exit(spec_bench())
+    if len(sys.argv) > 1 and sys.argv[1] == "--tp-bench":
+        sys.exit(tp_bench())
     if len(sys.argv) > 1 and sys.argv[1] == "--embed":
         sys.exit(embed_bench())
     if len(sys.argv) > 3 and sys.argv[1] == "--cost":

@@ -1,0 +1,106 @@
+"""SH — sharding discipline (tensor-parallel serving).
+
+SH01: in mesh-mode ``runtime/`` code — a class that builds a serving mesh
+(assigns ``self.mesh``) or a function taking/holding a ``mesh`` — a bare
+``jax.device_put(x)`` with no destination silently commits the array to the
+default device, and the next jitted use under GSPMD quietly replicates it
+across the whole mesh. For a sharded-intent array (a param tree, a KV pool)
+that is an N-fold HBM bill and an all-gather on every dispatch; for a
+control row it means relying on implicit placement instead of the engine's
+explicit replicated commitment. Mesh-mode uploads must name their
+destination: ``jax.device_put(x, sharding_or_device)`` or the engine's
+``_dev()`` helper (which routes through ``parallel.sharding.replicated``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import FileContext, Finding, Rule, dotted_name, register
+
+RUNTIME_TIERS = frozenset({"runtime"})
+
+_DEVICE_PUT = frozenset({"jax.device_put", "device_put"})
+
+
+def _mentions_mesh(node: ast.AST) -> bool:
+    """Does this scope reference a mesh at all? ``self.mesh``/``self._mesh``
+    attributes, a ``mesh`` name, or a parameter named ``mesh``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("mesh", "_mesh"):
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "mesh":
+            return True
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = sub.args
+            names = [p.arg for p in list(args.posonlyargs) + list(args.args)
+                     + list(args.kwonlyargs)]
+            if "mesh" in names:
+                return True
+    return False
+
+
+def _assigns_self_mesh(cls: ast.ClassDef) -> bool:
+    """True when any method stores ``self.mesh = ...`` — the engine idiom
+    marking the whole class as mesh-mode code."""
+    for sub in ast.walk(cls):
+        if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+            targets = sub.targets if isinstance(sub, ast.Assign) \
+                else [sub.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and t.attr in ("mesh", "_mesh") \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    return True
+    return False
+
+
+def _bare_device_puts(scope: ast.AST) -> Iterable[ast.Call]:
+    for sub in ast.walk(scope):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = dotted_name(sub.func)
+        if name not in _DEVICE_PUT:
+            continue
+        # a destination may arrive positionally (device_put(x, sharding))
+        # or by keyword (device=... / ...sharding-named kwargs)
+        has_dst = len(sub.args) >= 2 or any(
+            kw.arg and ("shard" in kw.arg or kw.arg in ("device", "dst"))
+            for kw in sub.keywords)
+        if not has_dst:
+            yield sub
+
+
+@register
+class SH01(Rule):
+    id = "SH01"
+    family = "SH"
+    severity = "error"
+    tiers = RUNTIME_TIERS
+    description = ("mesh-mode runtime uploads must name an explicit "
+                   "sharding/device: bare jax.device_put(x) silently "
+                   "replicates a sharded-intent array across the mesh")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        scopes: list[ast.AST] = []
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                if _assigns_self_mesh(node):
+                    scopes.append(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _mentions_mesh(node):
+                    scopes.append(node)
+        for scope in scopes:
+            owner = getattr(scope, "name", "<module>")
+            for call in _bare_device_puts(scope):
+                yield self.finding_in(
+                    ctx, call,
+                    f"bare `jax.device_put(...)` in mesh-mode scope "
+                    f"`{owner}` — without an explicit sharding the array "
+                    "commits to the default device and GSPMD silently "
+                    "FULL-REPLICATES it across the serving mesh; pass a "
+                    "NamedSharding (parallel.sharding.replicated / "
+                    "llama_page_pool_sharding / the param spec tree) or "
+                    "route through the engine's _dev() helper")

@@ -341,6 +341,28 @@ def forward(
 PagedPools = tuple[jnp.ndarray, jnp.ndarray]  # (k, v): [L, N, page, Hkv, D]
 
 
+def _shard_mapped_attn(mesh, kernel_fn, q_spec, tail_specs):
+    """Wrap a paged-attention kernel call in shard_map over the mesh's tp
+    axis (kv heads sharded; ``tail_specs`` cover the replicated control
+    operands — page table, lengths/hist/q_lens). Mosaic kernels cannot be
+    automatically partitioned by GSPMD — each device runs the kernel over
+    ITS head slice, which is exactly the head-axis sharding the Ragged
+    Paged Attention paper names. Head-major GQA grouping survives the
+    split because consecutive q heads map to consecutive kv heads
+    (requires num_kv_heads % tp == 0 — the engine gates on it).
+    check_rep=False: pallas_call defeats the replication checker. The ONE
+    wrapping implementation both paged forwards share, so the specs cannot
+    drift."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    kv_spec = P(None, None, "tp", None)
+    return shard_map(
+        kernel_fn, mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec) + tuple(tail_specs),
+        out_specs=q_spec, check_rep=False)
+
+
 def forward_paged_decode(
     params: Params,
     cfg: ModelConfig,
@@ -351,6 +373,7 @@ def forward_paged_decode(
     rope_tables: tuple[jnp.ndarray, jnp.ndarray],
     interpret: bool | None = None,
     write_mask: jnp.ndarray | None = None,  # [B] bool; False rows → scratch
+    mesh=None,
 ) -> tuple[jnp.ndarray, PagedPools]:
     """One decode step over the paged KV pool. Returns (hidden [B,1,H], pools).
 
@@ -362,6 +385,10 @@ def forward_paged_decode(
     ``write_mask`` (device-side termination): rows marked False — frozen by
     the decode program's finished mask — redirect their k/v scatter to
     scratch page 0 instead of re-writing position ``lengths`` of their chain.
+    ``mesh`` (tensor-parallel serving, kv-head-sharded pools): the attention
+    kernel runs under shard_map over the tp axis — required wherever the
+    kernel compiles as a real Mosaic call (GSPMD cannot auto-partition it);
+    on interpret backends it is an equivalent, bit-identical partitioning.
     """
     from ..ops.paged_attention import paged_decode_attention
 
@@ -398,9 +425,22 @@ def forward_paged_decode(
         v_pool = v_pool.at[layer, pid, off].set(
             vproj[:, 0].astype(v_pool.dtype))
 
-        attn = paged_decode_attention(
-            q[:, 0], k_pool[layer], v_pool[layer], page_table, lengths + 1,
-            interpret=interpret, sliding_window=cfg.sliding_window)
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            attn = _shard_mapped_attn(
+                mesh,
+                lambda qq, kk, vv, pt, ln: paged_decode_attention(
+                    qq, kk, vv, pt, ln, interpret=interpret,
+                    sliding_window=cfg.sliding_window),
+                P(None, "tp", None), (P(None, None), P(None)),
+            )(q[:, 0], k_pool[layer], v_pool[layer], page_table,
+              lengths + 1)
+        else:
+            attn = paged_decode_attention(
+                q[:, 0], k_pool[layer], v_pool[layer], page_table,
+                lengths + 1,
+                interpret=interpret, sliding_window=cfg.sliding_window)
         h = _attn_out(lp, h, attn.reshape(B, 1, Hq * D))
         h = _mlp_residual(lp, h, cfg)
         return (h, k_pool, v_pool), None
@@ -424,10 +464,12 @@ def forward_paged_mixed(
     rope_tables: tuple[jnp.ndarray, jnp.ndarray],
     interpret: bool | None = None,
     write_mask: jnp.ndarray | None = None,  # [B] bool; False rows → scratch
+    mesh=None,
 ) -> tuple[jnp.ndarray, PagedPools]:
     """One ragged mixed-batch step over the paged KV pool: decode rows
     (q_len=1) and chunked-prefill rows (q_len=chunk) in one dispatch.
-    Returns (hidden [B, Qmax, H], pools).
+    Returns (hidden [B, Qmax, H], pools). ``mesh``: see
+    :func:`forward_paged_decode` — shard_map over the tp head axis.
 
     Row b's span tokens land at absolute positions hist[b] .. hist[b]+q_len-1
     of its page chain (a chunk may cross page boundaries — per-token page
@@ -471,9 +513,21 @@ def forward_paged_mixed(
         k_pool = k_pool.at[layer, pid, off].set(kproj.astype(k_pool.dtype))
         v_pool = v_pool.at[layer, pid, off].set(vproj.astype(v_pool.dtype))
 
-        attn = ragged_paged_attention(
-            q, k_pool[layer], v_pool[layer], page_table, hist, q_lens,
-            interpret=interpret, sliding_window=cfg.sliding_window)
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            attn = _shard_mapped_attn(
+                mesh,
+                lambda qq, kk, vv, pt, hh, ql: ragged_paged_attention(
+                    qq, kk, vv, pt, hh, ql, interpret=interpret,
+                    sliding_window=cfg.sliding_window),
+                P(None, None, "tp", None),
+                (P(None, None), P(None), P(None)),
+            )(q, k_pool[layer], v_pool[layer], page_table, hist, q_lens)
+        else:
+            attn = ragged_paged_attention(
+                q, k_pool[layer], v_pool[layer], page_table, hist, q_lens,
+                interpret=interpret, sliding_window=cfg.sliding_window)
         h = _attn_out(lp, h, attn.reshape(B, Qmax, Hq * D))
         h = _mlp_residual(lp, h, cfg)
         return (h, k_pool, v_pool), None

@@ -28,6 +28,7 @@ from ...modkit.errcat import ERR
 from ...modkit.errors import ProblemError
 from ...modkit.failpoints import failpoint_async
 from ...modkit.logging_host import observe_task
+from ...parallel.feasibility import InfeasiblePlanError
 from ...runtime.engine import (EngineConfig, InferenceEngine, SamplingParams,
                                SchedulerSaturated, StepEvent,
                                TenantQuotaExceeded, TenantSaturated)
@@ -214,7 +215,16 @@ class LocalTpuWorker(LlmWorkerApi):
                 return entry
             loop = asyncio.get_running_loop()
             self._maybe_evict_for(model)
-            entry = await loop.run_in_executor(self._executor, self._build_entry, model)
+            try:
+                entry = await loop.run_in_executor(
+                    self._executor, self._build_entry, model)
+            except InfeasiblePlanError as e:
+                # the feasibility gate fired at engine construction: the
+                # model's (tp, quant, batch, seq) plan cannot fit the
+                # per-device HBM budget. A clean, typed 507 problem — the
+                # alternative is a device OOM mid-build that poisons the
+                # whole worker process.
+                raise ERR.llm.infeasible_plan.error(str(e))
             entry.last_used = time.monotonic()
             entry.est_bytes = self._estimate_bytes(model)
             self._entries[key] = entry
@@ -364,6 +374,15 @@ class LocalTpuWorker(LlmWorkerApi):
             spec_min_accept=float(opts.pop("spec_min_accept", 0.0)),
             spec_max_ngram=int(opts.pop("spec_max_ngram", 3)),
             spec_min_ngram=int(opts.pop("spec_min_ngram", 1)),
+            # tensor parallelism (docs/ARCHITECTURE.md "Tensor-parallel
+            # serving"): shard this model's engine over the first tp
+            # devices as a NamedSharding mesh — Megatron param shardings,
+            # the paged KV pool split on the kv-head axis, replicated
+            # control rows. The feasibility gate rejects an over-HBM
+            # (tp, quant, batch, seq) plan at build time as a typed 507
+            # problem; hbm_bytes_per_device=0 plans without enforcing.
+            tp=int(opts.pop("tp", 1)),
+            hbm_bytes_per_device=int(opts.pop("hbm_bytes_per_device", 0)),
         )
         params = None
         tokenizer: Tokenizer
@@ -405,6 +424,16 @@ class LocalTpuWorker(LlmWorkerApi):
             # LifecycleConfig-shaped dict; default supervised.
             dp_replicas = int(opts.pop("dp_replicas", 1))
             lc_cfg = LifecycleConfig.from_config(opts.pop("lifecycle", True))
+            if dp_replicas > 1 and eng_cfg.tp > 1:
+                # one engine, one parallelism axis: a dp pool pins each
+                # replica to ONE device, which a tp mesh cannot share.
+                # Fail at build (clear, typed) instead of letting the
+                # engine's own pinned-device check surface as a 500.
+                raise ValueError(
+                    f"engine_options for {model.canonical_id}: dp_replicas="
+                    f"{dp_replicas} cannot combine with tp={eng_cfg.tp} "
+                    "(a dp pool pins one device per replica; tensor-"
+                    "parallel pools are a future rung)")
             if dp_replicas > 1:
                 pool = DataParallelServingPool(
                     eng_cfg, n_replicas=dp_replicas, params=params,
@@ -891,6 +920,7 @@ class LocalTpuWorker(LlmWorkerApi):
                                         else "healthy")),
                         "lifecycle": sr,
                         "engine": engine,
+                        "mesh": self._mesh_of(eng),
                     }, entry, i))
             elif entry.scheduler is not None:
                 sched = entry.scheduler
@@ -911,8 +941,23 @@ class LocalTpuWorker(LlmWorkerApi):
                               else "healthy"),
                     "supervisor": sup.status() if sup is not None else None,
                     "engine": engine,
+                    "mesh": self._mesh_of(sched),
                 }, entry, 0))
         return rows
+
+    @staticmethod
+    def _mesh_of(engine: Any) -> Optional[dict[str, Any]]:
+        """The replica's serving-mesh block (topology, tp, sharded-page
+        bytes, feasibility plan) for /v1/monitoring/replicas — cheap
+        attribute reads via mesh_info(); None for engines (or test doubles)
+        without the surface."""
+        fn = getattr(engine, "mesh_info", None)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 — monitoring must not 500 on a dying engine
+            return None
 
     def replicas_view(self) -> list[dict[str, Any]]:
         """GET /v1/monitoring/replicas rows."""

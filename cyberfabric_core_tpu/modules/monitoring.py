@@ -230,6 +230,66 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
             "tpu_hbm_bytes_in_use", "HBM in use on device 0 (0 if unreported)"
         ).set_function(hbm_in_use)
 
+        # tensor-parallel serving (docs/ARCHITECTURE.md "Tensor-parallel
+        # serving"): the mesh width actually serving, and per-device HBM
+        # utilization. Utilization prefers LIVE device stats (bytes_in_use /
+        # bytes_limit per device); backends that report nothing (CPU, some
+        # PJRT plugins) fall back to the engines' feasibility-plan figure —
+        # the same per-device byte budget the build-time gate enforced.
+        def mesh_devices() -> float:
+            width = 1 if any(True for _ in _schedulers()) else 0
+            for sched in _schedulers():
+                info = _mesh_info(sched)
+                width = max(width, int(info.get("devices", 1)))
+            return float(width)
+
+        def _mesh_info(sched) -> dict:
+            fn = getattr(sched, "mesh_info", None)
+            if fn is None:
+                return {}
+            try:
+                return fn() or {}
+            except Exception:  # noqa: BLE001 — scrape must not die on a dying engine
+                return {}
+
+        self.registry.gauge(
+            "llm_mesh_devices",
+            "Devices in the widest serving mesh (tp degree; 1 = unsharded)"
+        ).set_function(mesh_devices)
+
+        def hbm_utilization_per_device() -> float:
+            import jax
+
+            worst = 0.0
+            try:
+                for dev in jax.devices():
+                    stats = dev.memory_stats() or {}
+                    limit = float(stats.get("bytes_limit", 0) or 0)
+                    if limit > 0:
+                        worst = max(worst,
+                                    float(stats.get("bytes_in_use", 0))
+                                    / limit)
+            except Exception:  # noqa: BLE001
+                pass
+            if worst > 0.0:
+                return worst
+            for sched in _schedulers():
+                plan = _mesh_info(sched).get("plan") or {}
+                # only ENFORCED plans report: an unenforced plan's fraction
+                # is computed against the default v5e budget — fictional
+                # hardware on CPU/forced-host backends, and a 400% reading
+                # there would fire HBM alerts over nothing
+                if plan.get("enforced"):
+                    worst = max(worst,
+                                float(plan.get("hbm_utilization", 0.0)))
+            return worst
+
+        self.registry.gauge(
+            "llm_hbm_utilization_per_device",
+            "Worst per-device HBM utilization (live device stats, or the "
+            "feasibility plan's budgeted fraction when unreported)"
+        ).set_function(hbm_utilization_per_device)
+
         def active_slots() -> float:
             worker = hub.try_get(LlmWorkerApi)
             pairs = worker.schedulers() if worker is not None else []

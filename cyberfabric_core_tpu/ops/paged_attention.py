@@ -42,9 +42,41 @@ _NEG_INF = -1e30
 _LANES = 128
 
 
+def _banded_scores_2d(bands, k_of):
+    """GQA scores via unrolled 2D dots — no rank-3 transpose, no batched
+    dot_general (Mosaic's dot supports only 2D operands). ``bands`` is a
+    list of (q_band [rows, D], kv_head) in head-major row order; ``k_of``
+    maps a kv head to its [page, D] key slice — a REF-level lane slice of
+    the minor-merged [1, page, Hkv*D] block (the wrapper reshapes the pool
+    outside the kernel): value-level bf16 lane slices at non-zero tile
+    offsets are an unlowerable relayout, ref-level sliced LOADS are not.
+    The per-band results concatenate in f32 (bf16 sublane concats are an
+    unsupported multi-row shift); each output element is the same
+    contraction the batched dot computes, so the results are bitwise
+    identical (pinned by
+    tests/test_ragged_attention.py::test_two_d_dot_rewrite_bitwise)."""
+    outs = [jax.lax.dot_general(
+        qb, k_of(kv), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) for qb, kv in bands]
+    return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+
+def _banded_weighted_v_2d(p, row_bands, v_of):
+    """The p@v half of the 2D rewrite: per-band [rows, page] x [page, D]
+    2D dots against ref-level lane slices of the minor-merged value block,
+    concatenated (f32) back to head-major rows. ``row_bands`` lists
+    (row_start, rows, kv_head); ``p`` is f32, so its sublane band slices
+    lower (32-bit shifts are implemented, 16-bit are not)."""
+    outs = [jax.lax.dot_general(
+        p[s:s + n], v_of(kv).astype(p.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) for s, n, kv in row_bands]
+    return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+
 def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                   acc_ref, m_ref, l_ref, *, page_size: int,
-                  sliding_window: int | None = None):
+                  sliding_window: int | None = None,
+                  two_d_dots: bool = False):
     """One (slot, page) program.
 
     Refs:
@@ -53,6 +85,11 @@ def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
       q_ref:   [1, Hq, D] VMEM; k_ref/v_ref: [1, page, Hkv, D] VMEM
       o_ref:   [1, Hq, D] VMEM
       acc_ref: [Hq, D] f32; m_ref/l_ref: [Hq, LANES] f32
+
+    ``two_d_dots`` replaces the batched GQA dot_generals (and their rank-3
+    operand transposes) with unrolled per-kv-head 2D dots — the form Mosaic
+    can lower (its dot supports only 2D tensors); bitwise-identical to the
+    batched form, which interpret mode keeps for tier-1 wall-clock.
     """
     b = pl.program_id(0)
     j = pl.program_id(1)
@@ -75,18 +112,39 @@ def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(relevant)
     def _compute():
         q = q_ref[0]          # [Hq, D]
-        k = k_ref[0]          # [page, Hkv, D]
-        v = v_ref[0]
         Hq, D = q.shape
-        Hkv = k.shape[1]
-        G = Hq // Hkv
 
-        qg = q.reshape(Hkv, G, D)
-        kt = jnp.transpose(k, (1, 2, 0))        # [Hkv, D, page]
-        scores = jax.lax.dot_general(
-            qg, kt, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)  # [Hkv, G, page]
-        scores = scores.reshape(Hq, page_size) * (1.0 / (D ** 0.5))
+        if two_d_dots:
+            # merged kv blocks ([1, page, Hkv*D]): each head is a REF-level
+            # lane slice. q's rows are head-major but a bf16 SUBLANE band
+            # slice is itself an unlowerable multi-row shift — so each kv
+            # head dots the FULL q block against its key slice and the band
+            # rows are carved out of the f32 result (32-bit sublane slices
+            # lower fine). The retained elements are the same contractions
+            # the batched dot computes: bitwise identical, a little
+            # redundant MXU work on a tiny [Hq, D] operand.
+            Hkv = k_ref.shape[2] // D
+            G = Hq // Hkv
+            k_of = lambda kv: k_ref[0, :, kv * D:(kv + 1) * D]  # noqa: E731
+            scores = jnp.concatenate([
+                jax.lax.dot_general(
+                    q, k_of(kv), (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)[kv * G:(kv + 1) * G]
+                for kv in range(Hkv)], axis=0) if Hkv > 1 \
+                else jax.lax.dot_general(
+                    q, k_of(0), (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [Hq, page]
+        else:
+            k = k_ref[0]      # [page, Hkv, D]
+            Hkv = k.shape[1]
+            G = Hq // Hkv
+            qg = q.reshape(Hkv, G, D)
+            kt = jnp.transpose(k, (1, 2, 0))        # [Hkv, D, page]
+            scores = jax.lax.dot_general(
+                qg, kt, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)  # [Hkv, G, page]
+            scores = scores.reshape(Hq, page_size)
+        scores = scores * (1.0 / (D ** 0.5))
 
         k_pos = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (Hq, page_size), 1)
@@ -106,12 +164,18 @@ def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         l_blk = jnp.sum(p, axis=1, keepdims=True)
         l_ref[...] = l_ref[...] * correction + jax.lax.broadcast_in_dim(
             l_blk, m_prev.shape, (0, 1))
-        pg = p.reshape(Hkv, G, page_size)
-        vt = jnp.transpose(v, (1, 0, 2))                    # [Hkv, page, D]
-        pv = jax.lax.dot_general(
-            pg, vt.astype(pg.dtype), (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)             # [Hkv, G, D]
-        acc_ref[...] = acc_ref[...] * correction[:, :1] + pv.reshape(Hq, D)
+        if two_d_dots:
+            pv = _banded_weighted_v_2d(
+                p, [(kv * G, G, kv) for kv in range(Hkv)],
+                lambda kv: v_ref[0, :, kv * D:(kv + 1) * D])
+        else:
+            v = v_ref[0]
+            pg = p.reshape(Hkv, G, page_size)
+            vt = jnp.transpose(v, (1, 0, 2))                # [Hkv, page, D]
+            pv = jax.lax.dot_general(
+                pg, vt.astype(pg.dtype), (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32).reshape(Hq, D)
+        acc_ref[...] = acc_ref[...] * correction[:, :1] + pv
 
     @pl.when(j == nj - 1)
     def _finalize():
@@ -119,7 +183,8 @@ def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
+@functools.partial(jax.jit, static_argnames=("interpret", "sliding_window",
+                                             "two_d_dots"))
 def paged_decode_attention(
     q: jnp.ndarray,           # [B, Hq, D] — one query token per slot
     k_pool: jnp.ndarray,      # [N, page, Hkv, D] — one layer's page pool
@@ -128,8 +193,16 @@ def paged_decode_attention(
     lengths: jnp.ndarray,     # [B] int32 valid kv length (incl. current token)
     interpret: bool = False,
     sliding_window: int | None = None,
+    two_d_dots: bool | None = None,
 ) -> jnp.ndarray:
-    """Returns [B, Hq, D] attention over each slot's paged history."""
+    """Returns [B, Hq, D] attention over each slot's paged history.
+
+    ``two_d_dots`` (default: on exactly when compiling for real — Mosaic's
+    dot supports only 2D tensors) selects the unrolled per-kv-head 2D-dot
+    body; interpret mode keeps the batched form for tier-1 wall-clock. The
+    two are bitwise-identical (golden-pinned)."""
+    if two_d_dots is None:
+        two_d_dots = not interpret
     B, Hq, D = q.shape
     _, page_size, Hkv, _ = k_pool.shape
     Pmax = page_table.shape[1]
@@ -143,15 +216,28 @@ def paged_decode_attention(
         if sliding_window is not None:
             lo = jnp.maximum((length - sliding_window) // page_size, 0)
             jj = jnp.maximum(jj, lo)
+        if two_d_dots:
+            return (pt_ref[b, jj], 0, 0)
         return (pt_ref[b, jj], 0, 0, 0)
+
+    if two_d_dots:
+        # the pool arrives at the kernel MINOR-MERGED ([N, page, Hkv*D] —
+        # a free caller-side reshape): in-kernel merges of a loaded block
+        # are an unsupported vector shape_cast under Mosaic, lane slices
+        # of a 2D block are not
+        k_pool = k_pool.reshape(k_pool.shape[0], page_size, Hkv * D)
+        v_pool = v_pool.reshape(v_pool.shape[0], page_size, Hkv * D)
+        kv_spec = pl.BlockSpec((1, page_size, Hkv * D), _page_index)
+    else:
+        kv_spec = pl.BlockSpec((1, page_size, Hkv, D), _page_index)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, Pmax),
         in_specs=[
             pl.BlockSpec((1, Hq, D), lambda b, j, pt, ln: (b, 0, 0)),
-            pl.BlockSpec((1, page_size, Hkv, D), _page_index),
-            pl.BlockSpec((1, page_size, Hkv, D), _page_index),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=pl.BlockSpec((1, Hq, D), lambda b, j, pt, ln: (b, 0, 0)),
         scratch_shapes=[
@@ -162,7 +248,8 @@ def paged_decode_attention(
     )
     return pl.pallas_call(
         functools.partial(_paged_kernel, page_size=page_size,
-                          sliding_window=sliding_window),
+                          sliding_window=sliding_window,
+                          two_d_dots=two_d_dots),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         compiler_params=_CompilerParams(
@@ -175,7 +262,9 @@ def paged_decode_attention(
 
 def _ragged_kernel(pt_ref, hist_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_ref, m_ref, l_ref, *, page_size: int, q_block: int,
-                   sliding_window: int | None = None):
+                   sliding_window: int | None = None,
+                   two_d_dots: bool = False,
+                   head_dim: int | None = None):
     """One (slot, q-block, page) program of the ragged mixed-batch kernel.
 
     Refs:
@@ -190,6 +279,13 @@ def _ragged_kernel(pt_ref, hist_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
     and attends causally over its row's paged KV chain (history AND the
     span's earlier tokens — prefill-chunk self attention). Rows are flat
     r = h*Qb + qi so the GQA dot keeps the decode kernel's head grouping.
+
+    ``two_d_dots`` (the Mosaic-lowerable form): q/k/v/o blocks arrive
+    MINOR-MERGED ([1, Qb, Hq*D] / [1, page, Hkv*D]; ``head_dim`` un-merges
+    them) and the head-major [Qb,Hq,D]↔[Hq,Qb,D] shuffles plus the batched
+    GQA dots — the constructs Mosaic cannot lower — become unrolled lane
+    slices, sublane/lane concats and per-kv-head 2D dots. Bitwise-identical
+    to the batched interpret form (golden-pinned).
     """
     b = pl.program_id(0)
     qb = pl.program_id(1)
@@ -217,22 +313,39 @@ def _ragged_kernel(pt_ref, hist_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(relevant)
     def _compute():
-        q = q_ref[0]          # [Qb, Hq, D]
-        k = k_ref[0]          # [page, Hkv, D]
-        v = v_ref[0]
-        Qb, Hq, D = q.shape
-        Hkv = k.shape[1]
-        G = Hq // Hkv
+        if two_d_dots:
+            D = head_dim
+            Qb, Hq = q_ref.shape[1], q_ref.shape[2] // D
+        else:
+            Qb, Hq, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+        R = Hq * Qb
 
         # head-major rows: r = h*Qb + qi (h = kv*G + g), so the GQA grouping
         # matches the decode kernel's reshape(Hkv, G, D) exactly
-        qt = jnp.transpose(q, (1, 0, 2)).reshape(Hkv, G * Qb, D)
-        kt = jnp.transpose(k, (1, 2, 0))        # [Hkv, D, page]
-        scores = jax.lax.dot_general(
-            qt, kt, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)  # [Hkv, G*Qb, page]
-        R = Hq * Qb
-        scores = scores.reshape(R, page_size) * (1.0 / (D ** 0.5))
+        if two_d_dots:
+            G = Hq // (k_ref.shape[2] // D)
+            # the [Qb,Hq,D]→head-major shuffle as unrolled per-head
+            # REF-level lane slices of the minor-merged [1, Qb, Hq*D]
+            # block feeding per-head 2D dots — neither the rank-3
+            # transpose nor a bf16 relayout (both Mosaic-unlowerable) ever
+            # appears; only the f32 score tiles concatenate
+            scores = _banded_scores_2d(
+                [(q_ref[0, :, h * D:(h + 1) * D], h // G)
+                 for h in range(Hq)],
+                lambda kv: k_ref[0, :, kv * D:(kv + 1) * D],
+            )                                    # [R, page], rows h*Qb+qi
+        else:
+            q = q_ref[0]      # [Qb, Hq, D]
+            k = k_ref[0]      # [page, Hkv, D]
+            Hkv = k.shape[1]
+            G = Hq // Hkv
+            qt = jnp.transpose(q, (1, 0, 2)).reshape(Hkv, G * Qb, D)
+            kt = jnp.transpose(k, (1, 2, 0))    # [Hkv, D, page]
+            scores = jax.lax.dot_general(
+                qt, kt, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)  # [Hkv, G*Qb, page]
+            scores = scores.reshape(R, page_size)
+        scores = scores * (1.0 / (D ** 0.5))
 
         qi = jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 0) % Qb
         q_idx = q0 + qi                          # index within the span
@@ -251,36 +364,60 @@ def _ragged_kernel(pt_ref, hist_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
         m_new = jnp.maximum(m_prev, jax.lax.broadcast_in_dim(
             m_blk, m_prev.shape, (0, 1)))
         m_ref[...] = m_new
-        # a row with no visible key yet has m_prev == m_new == -inf; the raw
-        # exp would be exp(nan) and poison acc/l for the rest of the walk —
-        # such rows carry no mass, so their correction is 0 (this keeps
-        # padding query rows inside a partially-valid block at exactly 0.0
-        # in the output, the documented contract, instead of NaN)
-        correction = jnp.where(jnp.isfinite(m_new),
+        # a row with no visible key yet still sits at the _NEG_INF floor;
+        # the raw exp could poison acc/l for the rest of the walk — such
+        # rows carry no mass, so their correction is 0 (this keeps padding
+        # query rows inside a partially-valid block at exactly 0.0 in the
+        # output, the documented contract, instead of NaN). The floor
+        # compare replaces jnp.isfinite: same verdict on every reachable
+        # value (masked scores are exactly _NEG_INF, never -inf), and
+        # is_finite has no Pallas TPU lowering — the compare is what lets
+        # the spec-verify program compile under Mosaic.
+        correction = jnp.where(m_new > _NEG_INF * 0.5,
                                jnp.exp(m_prev - m_new), 0.0)  # [R, LANES]
         p = jnp.exp(scores - m_new[:, :1])                  # [R, page]
         p = jnp.where(mask, p, 0.0)
         l_blk = jnp.sum(p, axis=1, keepdims=True)
         l_ref[...] = l_ref[...] * correction + jax.lax.broadcast_in_dim(
             l_blk, m_prev.shape, (0, 1))
-        pg = p.reshape(Hkv, G * Qb, page_size)
-        vt = jnp.transpose(v, (1, 0, 2))                    # [Hkv, page, D]
-        pv = jax.lax.dot_general(
-            pg, vt.astype(pg.dtype), (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)             # [Hkv, G*Qb, D]
-        acc_ref[...] = acc_ref[...] * correction[:, :1] + pv.reshape(R, D)
+        if two_d_dots:
+            pv = _banded_weighted_v_2d(
+                p, [(h * Qb, Qb, h // G) for h in range(Hq)],
+                lambda kv: v_ref[0, :, kv * D:(kv + 1) * D])
+        else:
+            v = v_ref[0]
+            pg = p.reshape(Hkv, G * Qb, page_size)
+            vt = jnp.transpose(v, (1, 0, 2))                # [Hkv, page, D]
+            pv = jax.lax.dot_general(
+                pg, vt.astype(pg.dtype), (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32).reshape(R, D)
+        acc_ref[...] = acc_ref[...] * correction[:, :1] + pv
 
     @pl.when(j == nj - 1)
     def _finalize():
         Qb = q_ref.shape[1]
-        Hq, D = q_ref.shape[2], q_ref.shape[3]
         denom = jnp.maximum(l_ref[...][:, :1], 1e-30)
-        out = (acc_ref[...] / denom).reshape(Hq, Qb, D)
-        o_ref[0] = jnp.transpose(out, (1, 0, 2)).astype(o_ref.dtype)
+        out = (acc_ref[...] / denom)                        # [Hq*Qb, D]
+        if two_d_dots:
+            # head-major rows → the minor-merged [Qb, Hq*D] output block
+            # via the inverse shuffle: each head's [Qb, D] band
+            # concatenates along LANES — a single full-block store, no
+            # rank-3 transpose, no strided per-head writes (the wrapper
+            # un-merges outside the kernel)
+            D = head_dim
+            Hq = q_ref.shape[2] // D
+            flat = jnp.concatenate(
+                [out[h * Qb:(h + 1) * Qb] for h in range(Hq)], axis=1) \
+                if Hq > 1 else out                          # [Qb, Hq*D]
+            o_ref[0] = flat.astype(o_ref.dtype)
+        else:
+            Hq, D = q_ref.shape[2], q_ref.shape[3]
+            out = out.reshape(Hq, Qb, D)
+            o_ref[0] = jnp.transpose(out, (1, 0, 2)).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("q_block", "interpret",
-                                             "sliding_window"))
+                                             "sliding_window", "two_d_dots"))
 def ragged_paged_attention(
     q: jnp.ndarray,           # [B, Qmax, Hq, D] — per-row query span, padded
     k_pool: jnp.ndarray,      # [N, page, Hkv, D] — one layer's page pool
@@ -291,6 +428,7 @@ def ragged_paged_attention(
     q_block: int = 8,
     interpret: bool = False,
     sliding_window: int | None = None,
+    two_d_dots: bool | None = None,
 ) -> jnp.ndarray:
     """Ragged mixed-batch paged attention: one dispatch where each batch row
     attends a variable-length query span over its paged KV chain with causal
@@ -301,7 +439,14 @@ def ragged_paged_attention(
 
     The span's own KV must already be present in the pool (the caller
     scatters the chunk's k/v before attending — within-span causality then
-    reads the earlier chunk tokens through the page chain)."""
+    reads the earlier chunk tokens through the page chain).
+
+    ``two_d_dots`` (default: on exactly when compiling for real) replaces
+    the head-major [Qb,Hq,D]↔[Hq,Qb,D] shuffles and the batched GQA dots —
+    the two constructs Mosaic cannot lower — with unrolled 2D slices/dots;
+    bitwise-identical to the batched interpret form (golden-pinned)."""
+    if two_d_dots is None:
+        two_d_dots = not interpret
     B, Qmax, Hq, D = q.shape
     _, page_size, Hkv, _ = k_pool.shape
     Pmax = page_table.shape[1]
@@ -320,36 +465,53 @@ def ragged_paged_attention(
             lo = jnp.maximum(
                 (hist_b + qb * q_block - sliding_window) // page_size, 0)
             jj = jnp.maximum(jj, jnp.minimum(lo, last))
+        if two_d_dots:
+            return (pt_ref[b, jj], 0, 0)
         return (pt_ref[b, jj], 0, 0, 0)
+
+    if two_d_dots:
+        # q/k/v/o travel MINOR-MERGED (free caller-side reshapes): in-kernel
+        # merges of loaded blocks are unsupported vector shape_casts under
+        # Mosaic, lane slices of 2D blocks are not
+        q_in = q.reshape(B, Qmax, Hq * D)
+        k_in = k_pool.reshape(k_pool.shape[0], page_size, Hkv * D)
+        v_in = v_pool.reshape(v_pool.shape[0], page_size, Hkv * D)
+        q_spec = pl.BlockSpec((1, q_block, Hq * D),
+                              lambda b, qb, j, pt, hh, ql: (b, qb, 0))
+        kv_spec = pl.BlockSpec((1, page_size, Hkv * D), _page_index)
+        out_shape = jax.ShapeDtypeStruct((B, Qmax, Hq * D), q.dtype)
+    else:
+        q_in, k_in, v_in = q, k_pool, v_pool
+        q_spec = pl.BlockSpec((1, q_block, Hq, D),
+                              lambda b, qb, j, pt, hh, ql: (b, qb, 0, 0))
+        kv_spec = pl.BlockSpec((1, page_size, Hkv, D), _page_index)
+        out_shape = jax.ShapeDtypeStruct((B, Qmax, Hq, D), q.dtype)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B, Qmax // q_block, Pmax),
-        in_specs=[
-            pl.BlockSpec((1, q_block, Hq, D),
-                         lambda b, qb, j, pt, hh, ql: (b, qb, 0, 0)),
-            pl.BlockSpec((1, page_size, Hkv, D), _page_index),
-            pl.BlockSpec((1, page_size, Hkv, D), _page_index),
-        ],
-        out_specs=pl.BlockSpec((1, q_block, Hq, D),
-                               lambda b, qb, j, pt, hh, ql: (b, qb, 0, 0)),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
         scratch_shapes=[
             pltpu.VMEM((Hq * q_block, D), jnp.float32),
             pltpu.VMEM((Hq * q_block, _LANES), jnp.float32),
             pltpu.VMEM((Hq * q_block, _LANES), jnp.float32),
         ],
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         functools.partial(_ragged_kernel, page_size=page_size,
-                          q_block=q_block, sliding_window=sliding_window),
+                          q_block=q_block, sliding_window=sliding_window,
+                          two_d_dots=two_d_dots,
+                          head_dim=D if two_d_dots else None),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Qmax, Hq, D), q.dtype),
+        out_shape=out_shape,
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(page_table.astype(jnp.int32), hist.astype(jnp.int32),
-      q_lens.astype(jnp.int32), q, k_pool, v_pool)
+      q_lens.astype(jnp.int32), q_in, k_in, v_in)
+    return out.reshape(B, Qmax, Hq, D) if two_d_dots else out
 
 
 def paged_gather_dense(k_pool, v_pool, page_table):

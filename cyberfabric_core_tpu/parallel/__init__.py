@@ -8,13 +8,19 @@ sequence parallelism for long context.
 """
 
 from .mesh import MeshConfig, build_mesh, local_device_count
-from .sharding import llama_param_shardings, llama_cache_sharding, input_shardings
+from .sharding import (dense_cache_sharding, input_shardings,
+                       llama_cache_sharding, llama_page_pool_sharding,
+                       llama_param_shardings, replicated, shard_llama_params)
 
 __all__ = [
     "MeshConfig",
     "build_mesh",
+    "dense_cache_sharding",
     "input_shardings",
     "llama_cache_sharding",
+    "llama_page_pool_sharding",
     "llama_param_shardings",
     "local_device_count",
+    "replicated",
+    "shard_llama_params",
 ]

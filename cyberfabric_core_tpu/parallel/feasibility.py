@@ -39,6 +39,71 @@ from .sharding import llama_param_shardings
 V5E_HBM_BYTES = 16 * 1024**3
 
 
+def abstract_mesh(axes: "tuple[tuple[str, int], ...]") -> AbstractMesh:
+    """Device-free mesh across the jax API drift: <=0.4.x takes ONE
+    shape_tuple of (name, size) pairs; newer releases take (sizes, names).
+    The planner must construct on both — this is what un-fails the whole
+    feasibility family on the current image."""
+    try:
+        return AbstractMesh(tuple(axes))
+    except TypeError:
+        return AbstractMesh(tuple(s for _, s in axes),
+                            tuple(n for n, _ in axes))
+
+
+class InfeasiblePlanError(ValueError):
+    """A serving configuration whose per-device byte budget exceeds HBM —
+    raised by the engine-construction gate (:func:`gate_engine_plan`) so an
+    over-budget config (FEASIBILITY_70B's bf16@tp=8 shape) is rejected with
+    a typed, explainable error at BUILD time, never as a device OOM at
+    request time. Carries the full machine-derived ``plan`` report."""
+
+    def __init__(self, message: str, plan: dict[str, Any]):
+        super().__init__(message)
+        self.plan = plan
+
+
+def gate_engine_plan(
+    model: "str | ModelConfig",
+    tp: int,
+    *,
+    quantization: str = "none",
+    dtype=jnp.bfloat16,
+    max_batch: int = 8,
+    max_seq_len: int = 8192,
+    page_size: int = 64,
+    num_pages: Optional[int] = None,
+    hbm_bytes: Optional[int] = None,
+) -> dict[str, Any]:
+    """Engine-construction gate: derive the per-device byte plan for the
+    EXACT serving geometry (the engine passes its real page-pool size via
+    ``num_pages``) and raise :class:`InfeasiblePlanError` when a known HBM
+    budget cannot hold it. ``hbm_bytes=None`` plans without enforcing (CPU
+    hosts and forced-host meshes have no HBM to blow) — the report still
+    lands in ``stats()["mesh"]`` so the budget is visible either way."""
+    cfg = model if isinstance(model, ModelConfig) else get_config(model)
+    plan = tp_plan(cfg, max(1, tp), quantization=quantization, dtype=dtype,
+                   max_batch=max_batch, max_seq_len=max_seq_len,
+                   page_size=page_size, num_pages=num_pages,
+                   hbm_bytes=hbm_bytes or V5E_HBM_BYTES,
+                   # the engine's pool REPLICATES when tp cannot divide the
+                   # kv heads — budget what serving actually allocates
+                   kv_replicated=tp > 1 and cfg.num_kv_heads % tp != 0)
+    plan["enforced"] = hbm_bytes is not None
+    if hbm_bytes is not None and not plan["fits"]:
+        raise InfeasiblePlanError(
+            f"{plan['model']} @ tp={plan['tp']} quant={quantization} needs "
+            f"{plan['total_bytes_per_device']} bytes/device "
+            f"(params {plan['param_bytes_per_device']} + KV "
+            f"{plan['kv_bytes_per_device']} + activations "
+            f"{plan['activation_bytes_estimate']}) > HBM budget {hbm_bytes} "
+            f"({plan['hbm_utilization']:.2f}x the budget); "
+            "raise tp, quantize, or shrink max_batch/max_seq_len",
+            plan={k: v for k, v in plan.items()
+                  if k not in ("leaves", "read_plan")})
+    return plan
+
+
 def _walk(tree: dict, prefix: str = ""):
     for k, v in tree.items():
         path = f"{prefix}.{k}" if prefix else k
@@ -61,6 +126,8 @@ def tp_plan(
     page_size: int = 64,
     prefill_bucket: int = 2048,
     hbm_bytes: int = V5E_HBM_BYTES,
+    num_pages: Optional[int] = None,
+    kv_replicated: bool = False,
 ) -> dict[str, Any]:
     """Per-device byte budget + per-shard read plan for ``model`` at tp=N.
 
@@ -83,7 +150,7 @@ def tp_plan(
                          f"divisible by ep={ep}")
     # the ep axis always exists (size 1 for dense models / pure-TP plans) so
     # MoE expert shardings resolve on any plan
-    mesh = AbstractMesh((ep, tp), ("ep", "tp"))
+    mesh = abstract_mesh((("ep", ep), ("tp", tp)))
     # the SAME sharded abstract tree the AOT compiler lowers — planner and
     # compiler cannot drift (tests/test_feasibility.py pins them together)
     sharded = sharded_abstract_params(cfg, mesh, dtype, quantization)
@@ -110,9 +177,18 @@ def tp_plan(
             param_bytes_total += total
 
     # KV pool [L, n_pages, page, Hkv, D], kv heads sharded on tp (or page
-    # replicated when tp > kv heads — q_per_kv grouping still shards queries)
-    pages = max_batch * (-(-max_seq_len // page_size)) + 1
-    kv_heads_dev = max(1, cfg.num_kv_heads // tp)
+    # replicated when tp > kv heads — q_per_kv grouping still shards queries).
+    # ``num_pages`` pins the ENGINE's actual pool size (prefix-cache headroom
+    # included) so the gate budgets the bytes serving will really allocate.
+    pages = num_pages if num_pages is not None \
+        else max_batch * (-(-max_seq_len // page_size)) + 1
+    # ``kv_replicated`` budgets the ENGINE's fallback (tp does not divide
+    # the kv heads → llama_page_pool_sharding replicates, every device pays
+    # full heads); the default models the canonical Megatron layouts —
+    # heads/tp when tp divides, duplicated-KV groups (1 head/device) when
+    # the mesh outgrows the head count
+    kv_heads_dev = cfg.num_kv_heads if kv_replicated \
+        else max(1, cfg.num_kv_heads // tp)
     kv_dtype = jnp.dtype(dtype)
     kv_bytes_device = (2 * cfg.num_layers * pages * page_size * kv_heads_dev
                        * cfg.head_dim * kv_dtype.itemsize)

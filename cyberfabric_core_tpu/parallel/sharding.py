@@ -83,6 +83,64 @@ def llama_cache_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, "dp", None, "tp", None))
 
 
+def _kv_head_axis(cfg: ModelConfig, mesh: Mesh):
+    """Mesh axis for the KV-head dim, or None when it cannot divide (tp >
+    num_kv_heads replicates the cache; query heads still shard via the
+    column-parallel projections — q_per_kv grouping keeps them busy)."""
+    tp = mesh.shape.get("tp", 1) if hasattr(mesh, "shape") else 1
+    return "tp" if tp > 1 and cfg.num_kv_heads % tp == 0 else None
+
+
+def llama_page_pool_sharding(cfg: ModelConfig, mesh: Mesh) -> NamedSharding:
+    """Paged KV pool [L, num_pages, page, Hkv, D] (runtime/paged.py): the
+    kv-head axis shards on ``tp`` — every device holds its heads' slice of
+    EVERY page, so page allocation, the radix prefix tree, page-table rows
+    and save/restore-to-host all stay head-count-agnostic host bookkeeping.
+    Falls back to replication when tp does not divide the kv heads."""
+    return NamedSharding(mesh, P(None, None, None, _kv_head_axis(cfg, mesh),
+                                 None))
+
+
+def dense_cache_sharding(cfg: ModelConfig, mesh: Mesh) -> NamedSharding:
+    """Dense slot cache [L, B, S, Hkv, D] for the non-paged scheduler under
+    a pure-tp serving mesh (no dp axis in play: batch stays whole)."""
+    return NamedSharding(mesh, P(None, None, None, _kv_head_axis(cfg, mesh),
+                                 None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """The explicit destination for host-control rows under a serving mesh
+    (tokens / lengths / stops / page table / sampling params): every device
+    holds the full copy, so control flow never gathers. Passing this —
+    rather than a bare ``jax.device_put(x)`` — is the discipline fabric-lint
+    SH01 enforces in mesh-mode runtime code."""
+    return NamedSharding(mesh, P())
+
+
+def shard_llama_params(params: Any, cfg: ModelConfig, mesh: Mesh,
+                       layer_axis: Any = None) -> Any:
+    """device_put a CONCRETE llama param tree (plain or quantized) onto its
+    Megatron-style NamedShardings. Quantized sub-leaves ('q'/'s'/'qe'/'se',
+    runtime/quant.py layouts) derive their spec from the parent weight's via
+    spec_for_quant_leaf — the same walk sharded_abstract_params uses, so the
+    uploaded tree matches what the AOT compiler and the feasibility planner
+    budgeted."""
+    import jax
+
+    spec_tree = llama_param_shardings(cfg, mesh, layer_axis=layer_axis)
+
+    def walk(node, spec_node):
+        if isinstance(node, dict) and any(k in node for k in ("q", "qe")):
+            return {k: jax.device_put(v, NamedSharding(
+                mesh, spec_for_quant_leaf(spec_node.spec, k)))
+                for k, v in node.items()}
+        if isinstance(node, dict):
+            return {k: walk(v, spec_node[k]) for k, v in node.items()}
+        return jax.device_put(node, spec_node)
+
+    return walk(params, spec_tree)
+
+
 def input_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
     """Activations entering jit: token ids/positions [B, T] on dp, lengths [B]."""
     return {

@@ -90,6 +90,7 @@ def serving_programs(
     max_seq_len: int = 2048,
     device_stop_width: int = 8,
     spec_k: int = 0,
+    mesh: Any = None,
 ) -> dict[str, tuple[Any, tuple]]:
     """name → (fn, abstract_args): the scheduler's program set, abstracted.
 
@@ -99,17 +100,49 @@ def serving_programs(
     ``spec_k > 0`` adds the batched-speculation ragged verify step
     (parameterized like ``--device-stop-width``: it must match the serving
     EngineConfig's ``scheduler_spec_k`` or the AOT cache misses).
+
+    ``mesh`` switches the set to the TENSOR-PARALLEL serving variants: the
+    abstract param tree carries the Megatron NamedShardings
+    (parallel/sharding.sharded_abstract_params — the exact tree the engine
+    uploads), the paged pool shards on the kv-head axis, and every host-
+    control row pins to the replicated sharding, so GSPMD lowers the same
+    collectives serving runs. Program names gain a ``-tp{N}`` suffix — the
+    AOT cache key is (topology, tp, spec_k, device_stop_width, shapes).
     """
     cfg = get_config(model)
     if prefill_bucket > max_seq_len:
         raise ValueError("prefill_bucket must fit max_seq_len")
     rope = llama.rope_frequencies(cfg.head_dim, cfg.max_position, cfg.rope_theta)
-    params_abs = _abstract_params(cfg, dtype, quantization)
-    sds = jax.ShapeDtypeStruct
+    suffix = ""
+    pool_sharding = repl_sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.sharding import (llama_page_pool_sharding,
+                                         sharded_abstract_params)
+
+        tp_degree = dict(mesh.shape).get("tp", 1)
+        suffix = f"-tp{tp_degree}"
+        params_abs = sharded_abstract_params(cfg, mesh, dtype, quantization)
+        pool_sharding = llama_page_pool_sharding(cfg, mesh)
+        repl_sharding = NamedSharding(mesh, P())
+    else:
+        params_abs = _abstract_params(cfg, dtype, quantization)
+    _plain_sds = jax.ShapeDtypeStruct
+
+    def sds(shape, dt):
+        # control rows: EXPLICITLY replicated under a tp mesh (the engine's
+        # SH01 discipline, mirrored into the lowering args)
+        if repl_sharding is not None:
+            return _plain_sds(shape, dt, sharding=repl_sharding)
+        return _plain_sds(shape, dt)
 
     def prefill(params, ids, lengths, rng, temp, top_p, top_k, rope_t):
+        # tp meshes take the jnp attention path (the flash kernel cannot
+        # auto-partition under GSPMD — tp_sharded_program's documented
+        # discipline); single-device sets lower the real flash kernel
         last_h, kv = llama.prefill_collect(params, cfg, ids, lengths, rope_t,
-                                           use_flash=True)
+                                           use_flash=mesh is None)
         logits = llama.lm_head_logits(params, cfg, last_h)
         rng, sub = jax.random.split(rng)
         return sample_token(logits, sub, temp, top_p, top_k), kv, rng
@@ -128,8 +161,10 @@ def serving_programs(
 
     n_pages = max_batch * (-(-max_seq_len // page_size)) + 1
     pmax = -(-max_seq_len // page_size)
-    pool_sds = sds((cfg.num_layers, n_pages, page_size, cfg.num_kv_heads,
-                    cfg.head_dim), dtype)
+    pool_shape = (cfg.num_layers, n_pages, page_size, cfg.num_kv_heads,
+                  cfg.head_dim)
+    pool_sds = _plain_sds(pool_shape, dtype, sharding=pool_sharding) \
+        if pool_sharding is not None else _plain_sds(pool_shape, dtype)
 
     # device-side termination mirror (runtime/scheduler.py): per-slot stop-id
     # rows (-1 padded to device_stop_width — must match the serving
@@ -146,7 +181,7 @@ def serving_programs(
             run = active & jnp.logical_not(fin)
             hidden, pools = llama.forward_paged_decode(
                 params, cfg, toks[:, None], pools, page_table, lens, rope,
-                write_mask=run)
+                write_mask=run, mesh=mesh)
             logits = llama.lm_head_logits(params, cfg, hidden[:, 0, :])
             keys2, subs = split_keys_per_slot(keys)
             nxt = sample_token_per_slot(logits, subs, temp, top_p, top_k)
@@ -184,9 +219,9 @@ def serving_programs(
         sds((max_batch,), jnp.int32),
     )
     programs = {
-        f"prefill-flash-b1x{prefill_bucket}": (prefill, prefill_args),
-        f"paged-decode-k{decode_chunk}x{max_batch}": (paged_decode_chunk,
-                                                      decode_args),
+        f"prefill-flash-b1x{prefill_bucket}{suffix}": (prefill, prefill_args),
+        f"paged-decode-k{decode_chunk}x{max_batch}{suffix}":
+            (paged_decode_chunk, decode_args),
     }
 
     if spec_k > 0:
@@ -212,7 +247,8 @@ def serving_programs(
             hist = jnp.where(active, lengths, prefill_hist)
             hidden, pools = llama.forward_paged_mixed(
                 params, cfg, q_ids, (k_pool, v_pool), page_table, hist,
-                q_lens, rope, write_mask=run | jnp.logical_not(active))
+                q_lens, rope, write_mask=run | jnp.logical_not(active),
+                mesh=mesh)
             last_h = llama.gather_last_hidden(hidden, q_lens)
             logits = llama.lm_head_logits(params, cfg, last_h)
             keys2, subs = split_keys_per_slot(keys)
@@ -286,9 +322,19 @@ def serving_programs(
             sds((max_batch,), jnp.float32),
             sds((max_batch,), jnp.int32),
         )
-        programs[f"spec-verify-w{spec_w}x{max_batch}"] = (spec_verify_step,
-                                                          spec_args)
+        programs[f"spec-verify-w{spec_w}x{max_batch}{suffix}"] = \
+            (spec_verify_step, spec_args)
 
+    if repl_sharding is not None:
+        # leaves eval_shape produced without a placement (rng keys, rope
+        # tables) pin to the replicated sharding — every arg of a tp
+        # program names its destination explicitly
+        programs = {
+            name: (fn, jax.tree.map(
+                lambda l: _plain_sds(l.shape, l.dtype,
+                                     sharding=repl_sharding)
+                if getattr(l, "sharding", None) is None else l, args))
+            for name, (fn, args) in programs.items()}
     return programs
 
 
@@ -363,7 +409,8 @@ def aot_compile(
         "model": model, "quantization": quantization, "topology": topology,
         "dtype": dtype, "prefill_bucket": prefill_bucket,
         "decode_chunk": decode_chunk, "max_batch": max_batch,
-        "max_seq_len": max_seq_len, "spec_k": spec_k, "programs": [],
+        "max_seq_len": max_seq_len, "spec_k": spec_k, "tp": tp,
+        "device_stop_width": device_stop_width, "programs": [],
     }
     out = Path(out_dir) if out_dir else None
     if out:
@@ -390,6 +437,21 @@ def aot_compile(
                                       quantization=quantization,
                                       prefill_bucket=prefill_bucket)
         jobs.append((f"prefill-tp{tp}", fn, args))
+        if include_serving:
+            # the tp SERVING set: the same paged-decode / spec-verify
+            # bodies, lowered with Megatron-sharded params, the kv-head-
+            # sharded pool and replicated control rows — the (topology, tp,
+            # spec_k, stop_width)-keyed variants the mesh engine runs, so a
+            # GSPMD/Mosaic lowering failure of the sharded path is visible
+            # pre-hardware exactly like the single-device one
+            tp_progs = serving_programs(
+                model, dtype=dt, quantization=quantization,
+                prefill_bucket=prefill_bucket, decode_chunk=decode_chunk,
+                max_batch=max_batch, max_seq_len=max_seq_len,
+                device_stop_width=device_stop_width, spec_k=spec_k,
+                mesh=tp_mesh)
+            jobs.extend((name, fn, args)
+                        for name, (fn, args) in tp_progs.items())
 
     for name, fn, args in jobs:
         t0 = time.monotonic()

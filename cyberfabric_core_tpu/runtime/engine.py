@@ -223,6 +223,27 @@ class EngineConfig:
     #: tenant's retry storm saturates that tenant, not the global queue.
     #: 0 = unbounded (the global ``max_pending`` still applies).
     tenant_max_pending: int = 0
+    #: tensor parallelism (continuous scheduler): shard the engine over the
+    #: first ``tp`` visible devices as a NamedSharding mesh — Megatron-style
+    #: weight shardings (parallel/sharding.py), the paged KV pool split on
+    #: the kv-head axis, host-control rows (tokens/lengths/stops/page-table/
+    #: sampling) explicitly replicated, and XLA GSPMD inserting the
+    #: collectives inside the existing dispatch families. 1 (default) keeps
+    #: the single-device engine byte-identical to pre-tp builds; tp=N on the
+    #: forced-host CPU mesh produces bit-identical streams to tp=1 (pinned
+    #: by tests/test_tp_engine.py). The 70B-class path is tp=8 (+int8) per
+    #: FEASIBILITY_70B.json.
+    tp: int = 1
+    #: per-device HBM byte budget for the feasibility gate: engine
+    #: construction derives the per-device plan (params + KV pool +
+    #: activations via parallel/feasibility.py — the same shard math the AOT
+    #: compiler lowers) and raises InfeasiblePlanError when the budget
+    #: cannot hold it, so an over-HBM config (bf16@tp=8 on v5e) dies with a
+    #: typed, explainable error at BUILD time instead of a device OOM at
+    #: request time. 0 = plan without enforcing (CPU hosts / forced-host
+    #: meshes have no HBM to protect; the plan still lands in
+    #: stats()["mesh"]).
+    hbm_bytes_per_device: int = 0
 
     def resolve_lookahead_depth(self) -> int:
         """Lookahead ring depth as an int ≥ 0. Legacy bool configs parse as

@@ -50,15 +50,26 @@ class PrefixKVPool:
 
     def __init__(self, model_config: ModelConfig, *, num_pages: int = 64,
                  page_size: int = 64, dtype=jnp.bfloat16,
-                 force_python_native: bool = False) -> None:
+                 force_python_native: bool = False,
+                 sharding: Optional[Any] = None) -> None:
         self.cfg = model_config
         self.page_size = page_size
         self.num_pages = num_pages
         self.dtype = dtype
+        #: tensor-parallel serving: a NamedSharding for the pool arrays
+        #: ([L, P, page, Hkv, D], kv heads on tp — parallel/sharding.py
+        #: llama_page_pool_sharding). Every mover program (gather/scatter/
+        #: tail) runs under GSPMD against the sharded pool; the host-side
+        #: bookkeeping (allocator, radix tree, refcounts, page ids) is
+        #: byte-count-agnostic and identical to the single-device pool.
+        self.sharding = sharding
         L, H, D = model_config.num_layers, model_config.num_kv_heads, model_config.head_dim
         shape = (L, num_pages, page_size, H, D)
         self.k_pool = jnp.zeros(shape, dtype)
         self.v_pool = jnp.zeros(shape, dtype)
+        if sharding is not None:
+            self.k_pool = jax.device_put(self.k_pool, sharding)
+            self.v_pool = jax.device_put(self.v_pool, sharding)
         # page 0 is scratch (padding target); allocator hands out 1..num_pages-1
         self.allocator = BlockAllocator(num_pages - 1, force_python=force_python_native)
         self._page_offset = 1

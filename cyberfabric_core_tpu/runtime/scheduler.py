@@ -438,6 +438,71 @@ class ContinuousBatchingEngine:
         # engine compiles — and every host->device transfer it makes — lands
         # there, not on jax.devices()[0]
         self.device = device
+        # tensor parallelism: tp > 1 lifts the WHOLE engine onto a
+        # NamedSharding mesh over the first tp visible devices — params
+        # Megatron-sharded, the paged KV pool split on the kv-head axis,
+        # host-control rows explicitly replicated (the SH01 discipline), and
+        # every dispatch family compiled under GSPMD. tp=1 keeps the
+        # single-device engine byte-identical to pre-tp builds (mesh is
+        # None and no code path below changes).
+        self.tp = max(1, int(config.tp))
+        self.mesh = None
+        self._replicated = None
+        self._pool_sharding = None
+        self.feasibility: Optional[dict] = None
+        if self.tp > 1 and device is not None:
+            raise ValueError(
+                "tp > 1 cannot combine with a pinned device (dp replica "
+                "pools own one device per engine; shard OR replicate, "
+                "not both)")
+        page = config.prefix_page_size
+        paged_planned = config.prefix_cache_pages > 0
+        planned_pages = None
+        if paged_planned:
+            pmax = -(-config.max_seq_len // page)
+            planned_pages = max(config.prefix_cache_pages,
+                                config.max_batch * pmax + 1)
+        if self.tp > 1 or config.hbm_bytes_per_device > 0:
+            # feasibility gate BEFORE any allocation: an over-HBM plan dies
+            # here as a typed error (parallel/feasibility.py derives the
+            # per-device bytes from the same shardings served below), never
+            # as a device OOM mid-build or at request time
+            from ..parallel.feasibility import gate_engine_plan
+
+            self.feasibility = gate_engine_plan(
+                self.model_config, self.tp,
+                quantization=config.quantization, dtype=self.dtype,
+                max_batch=config.max_batch, max_seq_len=config.max_seq_len,
+                page_size=page, num_pages=planned_pages,
+                hbm_bytes=config.hbm_bytes_per_device or None)
+            self.feasibility.pop("leaves", None)
+            self.feasibility.pop("read_plan", None)
+        if self.tp > 1:
+            from ..parallel.mesh import MeshConfig, build_mesh
+            from ..parallel.sharding import (llama_page_pool_sharding,
+                                             replicated)
+
+            devices = jax.devices()
+            if len(devices) < self.tp:
+                raise ValueError(
+                    f"tp={self.tp} needs {self.tp} devices, have "
+                    f"{len(devices)} (forced-host meshes: set XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count={self.tp})")
+            self.mesh = build_mesh(MeshConfig(dp=1, tp=self.tp),
+                                   devices[: self.tp])
+            self._replicated = replicated(self.mesh)
+            self._pool_sharding = llama_page_pool_sharding(
+                self.model_config, self.mesh)
+            #: mesh handed to the paged attention kernels (shard_map over
+            #: the tp head axis — required wherever the kernel compiles as
+            #: real Mosaic, since GSPMD cannot auto-partition it; bitwise-
+            #: equivalent on interpret backends). Only meaningful when the
+            #: kv heads actually shard; a replicated pool (tp > Hkv) keeps
+            #: the plain GSPMD path.
+            self._attn_mesh = self.mesh if "tp" in (
+                self._pool_sharding.spec or ()) else None
+        else:
+            self._attn_mesh = None
         self._device_ctx = (lambda: jax.default_device(self.device)) \
             if device is not None else _null_ctx
         import contextlib
@@ -468,12 +533,21 @@ class ContinuousBatchingEngine:
                 params = quantize_llama_params(params, bits=quant_bits)
             if device is not None:
                 params = jax.device_put(params, device)
+        if self.mesh is not None:
+            # Megatron-style tp shardings (wq/wk/wv/gate/up column-parallel,
+            # wo/down row-parallel, lm_head vocab-sharded) — the SAME spec
+            # tree the feasibility gate budgeted and the AOT compiler lowers
+            from ..parallel.sharding import shard_llama_params
+
+            params = shard_llama_params(params, self.model_config, self.mesh)
         self.params = params
         self.rope_tables = rope_frequencies(
             self.model_config.head_dim,
             max(self.model_config.max_position, config.max_seq_len),
             self.model_config.rope_theta,
         )
+        if self.mesh is not None:
+            self.rope_tables = self._dev(self.rope_tables)
         self.n_slots = config.max_batch
         self._rng = jax.random.PRNGKey(seed)
 
@@ -482,7 +556,7 @@ class ContinuousBatchingEngine:
         self.lengths = np.zeros(self.n_slots, np.int32)
         self.active = np.zeros(self.n_slots, bool)
 
-        self._last_tokens = jnp.zeros((self.n_slots,), jnp.int32)
+        self._last_tokens = self._dev(jnp.zeros((self.n_slots,), jnp.int32))
 
         # device-resident per-slot sampling/termination state (paged AND
         # dense rounds): patched row-wise at admission/finish/preempt/resume,
@@ -490,16 +564,19 @@ class ContinuousBatchingEngine:
         # device_stop_width) + limit lengths let the decode program freeze
         # finished rows on-device; _dev_term marks slots whose FULL stop set
         # fits the device rows (others fall back to host stop detection).
+        # Mesh mode commits every row EXPLICITLY replicated (_dev): control
+        # state is host bookkeeping every device must agree on, and row
+        # patches (.at[].set) propagate the replication forward.
         self._stop_width = max(1, config.device_stop_width)
-        self._temp_dev = jnp.zeros((self.n_slots,), jnp.float32)
-        self._top_p_dev = jnp.ones((self.n_slots,), jnp.float32)
-        self._top_k_dev = jnp.zeros((self.n_slots,), jnp.int32)
-        self._lengths_dev = jnp.zeros((self.n_slots,), jnp.int32)
-        self._active_dev = jnp.zeros((self.n_slots,), bool)
-        self._finished_dev = jnp.zeros((self.n_slots,), bool)
-        self._stops_dev = jnp.full((self.n_slots, self._stop_width), -1,
-                                   jnp.int32)
-        self._limit_dev = jnp.zeros((self.n_slots,), jnp.int32)
+        self._temp_dev = self._dev(jnp.zeros((self.n_slots,), jnp.float32))
+        self._top_p_dev = self._dev(jnp.ones((self.n_slots,), jnp.float32))
+        self._top_k_dev = self._dev(jnp.zeros((self.n_slots,), jnp.int32))
+        self._lengths_dev = self._dev(jnp.zeros((self.n_slots,), jnp.int32))
+        self._active_dev = self._dev(jnp.zeros((self.n_slots,), bool))
+        self._finished_dev = self._dev(jnp.zeros((self.n_slots,), bool))
+        self._stops_dev = self._dev(jnp.full(
+            (self.n_slots, self._stop_width), -1, jnp.int32))
+        self._limit_dev = self._dev(jnp.zeros((self.n_slots,), jnp.int32))
         self._dev_term = np.ones(self.n_slots, bool)
 
         # paged decode (default): slot KV lives in ONE paged pool shared with
@@ -523,16 +600,23 @@ class ContinuousBatchingEngine:
                             config.prefix_cache_pages, num_pages)
             self.pool = PrefixKVPool(
                 self.model_config, num_pages=num_pages,
-                page_size=page, dtype=self.dtype)
+                page_size=page, dtype=self.dtype,
+                sharding=self._pool_sharding)
             self.page_table = np.zeros((self.n_slots, self.pmax), np.int32)
-            self._page_table_dev = jnp.asarray(self.page_table)
+            self._page_table_dev = self._dev(jnp.asarray(self.page_table))
             self._pt_dirty_rows: set[int] = set()
             self.cache = None  # no dense pool — HBM belongs to the paged pool
-            self._slot_keys = jax.random.split(
-                jax.random.PRNGKey(seed ^ 0x5EED), self.n_slots)
+            self._slot_keys = self._dev(jax.random.split(
+                jax.random.PRNGKey(seed ^ 0x5EED), self.n_slots))
         else:
             self.cache = llama.init_cache(
                 self.model_config, self.n_slots, config.max_seq_len, self.dtype)
+            if self.mesh is not None:
+                from ..parallel.sharding import dense_cache_sharding
+
+                self.cache = jax.device_put(
+                    self.cache, dense_cache_sharding(self.model_config,
+                                                     self.mesh))
 
         from collections import deque as _deque
 
@@ -685,7 +769,13 @@ class ContinuousBatchingEngine:
         cfg = self.model_config
         k_steps = max(1, self.config.decode_chunk)
 
-        use_flash = self.config.resolve_use_flash()
+        # tp meshes take the jnp prefill attention path: the flash Pallas
+        # kernel cannot auto-partition under GSPMD (the same constraint the
+        # AOT tp variants honor — aot_tpu.py compiles the tp prefill with
+        # use_flash=False), so a live-TPU tp engine must not jit it either.
+        # The paged decode/ragged kernels stay real: they run under
+        # shard_map over the tp head axis (_attn_mesh).
+        use_flash = self.config.resolve_use_flash() and self.mesh is None
 
         def prefill(params, ids, lengths, rng, temp, top_p, top_k, rope):
             last_h, kv = llama.prefill_collect(params, cfg, ids, lengths, rope,
@@ -760,7 +850,7 @@ class ContinuousBatchingEngine:
                     run = active & jnp.logical_not(fin)
                     hidden, pools = llama.forward_paged_decode(
                         params, cfg, toks[:, None], pools, page_table, lens,
-                        rope, write_mask=run)
+                        rope, write_mask=run, mesh=self._attn_mesh)
                     logits = llama.lm_head_logits(params, cfg, hidden[:, 0, :])
                     keys2, subs = split_keys_per_slot(keys)
                     nxt = sample_token_per_slot(logits, subs, temp, top_p,
@@ -810,7 +900,8 @@ class ContinuousBatchingEngine:
                 hidden, pools = llama.forward_paged_mixed(
                     params, cfg, q_ids, (k_pool, v_pool), page_table,
                     hist, q_lens, rope,
-                    write_mask=run | jnp.logical_not(active))
+                    write_mask=run | jnp.logical_not(active),
+                    mesh=self._attn_mesh)
                 last_h = llama.gather_last_hidden(hidden, q_lens)
                 logits = llama.lm_head_logits(params, cfg, last_h)
                 keys2, subs = split_keys_per_slot(keys)
@@ -869,7 +960,8 @@ class ContinuousBatchingEngine:
                     hidden, pools = llama.forward_paged_mixed(
                         params, cfg, q_ids, (k_pool, v_pool), page_table,
                         hist, q_lens, rope,
-                        write_mask=run | jnp.logical_not(active))
+                        write_mask=run | jnp.logical_not(active),
+                        mesh=self._attn_mesh)
                     last_h = llama.gather_last_hidden(hidden, q_lens)
                     logits = llama.lm_head_logits(params, cfg, last_h)
                     keys2, subs = split_keys_per_slot(keys)
@@ -1550,6 +1642,38 @@ class ContinuousBatchingEngine:
         return out
 
     # -------------------------------------------------------- health surface
+    def mesh_info(self) -> dict[str, Any]:
+        """The serving-mesh block (stats()["mesh"], /v1/monitoring/replicas,
+        llm_mesh_* gauges): topology, tp degree, how the paged pool shards,
+        and the feasibility plan's per-device byte budget. Cheap attribute
+        reads — safe for gauges and lifecycle probes (no stats() build)."""
+        try:
+            platform = jax.devices()[0].platform
+        except Exception:  # noqa: BLE001 — a wedged backend must not break stats
+            platform = "unknown"
+        kv_sharded = bool(
+            self._pool_sharding is not None
+            and "tp" in (self._pool_sharding.spec or ()))
+        info: dict[str, Any] = {
+            "tp": self.tp,
+            "devices": self.tp if self.mesh is not None else 1,
+            "topology": f"{platform}:{self.tp}",
+            "kv_heads_sharded": kv_sharded,
+        }
+        if self.pool is not None:
+            pool_bytes = 2 * int(np.prod(self.pool.k_pool.shape)) \
+                * self.pool.k_pool.dtype.itemsize
+            info["sharded_page_bytes_per_device"] = (
+                pool_bytes // self.tp if kv_sharded else pool_bytes)
+        if self.feasibility is not None:
+            info["plan"] = {
+                k: self.feasibility.get(k)
+                for k in ("param_bytes_per_device", "kv_bytes_per_device",
+                          "total_bytes_per_device", "hbm_bytes",
+                          "hbm_utilization", "fits", "enforced",
+                          "quantization")}
+        return info
+
     def pending_depth(self) -> int:
         """Live pending-queue depth (llm_queue_depth{model=} gauge)."""
         return self._pending.qsize()
@@ -1642,6 +1766,9 @@ class ContinuousBatchingEngine:
         return {
             "broken": self._broken,
             "closed": self._closed,
+            # tensor-parallel serving: mesh topology, tp degree, pool
+            # sharding and the feasibility plan's per-device byte budget
+            "mesh": self.mesh_info(),
             # batched speculative decoding: rounds that carried draft spans,
             # draft tokens proposed vs device-accepted, tokens emitted via
             # spec rounds, and the acceptance-length histogram the perf
@@ -1806,6 +1933,18 @@ class ContinuousBatchingEngine:
         return True
 
     # ------------------------------------------------------------ device patches
+    def _dev(self, x: Any) -> Any:
+        """Host→device upload with an EXPLICIT destination: replicated over
+        the serving mesh (tp > 1) or the plain default device. Every
+        host-control upload in this engine routes through here — tokens,
+        lengths, stop rows, page-table patches, per-round ragged plans — so
+        a sharded-intent array can never be silently full-replicated by an
+        implicit transfer, and control rows are guaranteed identical on
+        every mesh device (the fabric-lint SH01 discipline)."""
+        if self.mesh is not None:
+            return jax.device_put(x, self._replicated)
+        return jnp.asarray(x)
+
     def _patch_slot_device(self, slot: int, temp: float, top_p: float,
                            top_k: int, length: int, active: bool,
                            stops: frozenset = frozenset(),
@@ -1855,9 +1994,9 @@ class ContinuousBatchingEngine:
         while np2 < len(rows):
             np2 *= 2
         rows = rows + [rows[0]] * (np2 - len(rows))
-        idx = jnp.asarray(rows, jnp.int32)
+        idx = self._dev(np.asarray(rows, np.int32))
         self._page_table_dev = self._page_table_dev.at[idx].set(
-            jnp.asarray(self.page_table[rows]))
+            self._dev(self.page_table[rows]))
 
     # ------------------------------------------------------------ admission
     def _resume_suspended(self) -> int:
@@ -2276,9 +2415,9 @@ class ContinuousBatchingEngine:
         wall_pf = time.time()
         try:
             first, kv, keys_out = self._batch_prefill_fn(
-                self.params, jnp.asarray(ids), jnp.asarray(lengths),
-                jnp.asarray(keys), jnp.asarray(temp), jnp.asarray(top_p),
-                jnp.asarray(top_k), self.rope_tables)
+                self.params, self._dev(ids), self._dev(lengths),
+                self._dev(keys), self._dev(temp), self._dev(top_p),
+                self._dev(top_k), self.rope_tables)
             first_host = np.asarray(first, np.int32)
         except Exception:  # noqa: BLE001 — the whole dispatch failed
             logger.exception("coalesced prefill failed (%d reqs, bucket %d)",
@@ -2355,9 +2494,9 @@ class ContinuousBatchingEngine:
         T = len(req.prompt_ids)
         bucket = self._bucket_for(T)
         s = req.sampling
-        temp = jnp.asarray([s.temperature], jnp.float32)
-        top_p = jnp.asarray([s.top_p], jnp.float32)
-        top_k = jnp.asarray([s.top_k], jnp.int32)
+        temp = self._dev(np.asarray([s.temperature], np.float32))
+        top_p = self._dev(np.asarray([s.top_p], np.float32))
+        top_k = self._dev(np.asarray([s.top_k], np.int32))
 
         # paged mode: the request gets its own key stream from admission on —
         # an explicit seed reproduces the whole generation (first token
@@ -2404,9 +2543,9 @@ class ContinuousBatchingEngine:
                 cache = llama.init_cache(self.model_config, 1, bucket, self.dtype)
                 cache = self.pool.gather_for_prefill(cached_pages, bucket, cache)
                 first, kv, rng_out = self._suffix_prefill_fn(
-                    self.params, jnp.asarray(ids),
-                    jnp.asarray([len(suffix)], jnp.int32),
-                    jnp.asarray(cached_len, jnp.int32), cache,
+                    self.params, self._dev(ids),
+                    self._dev(np.asarray([len(suffix)], np.int32)),
+                    self._dev(np.asarray(cached_len, np.int32)), cache,
                     req_key if self.paged else self._rng, temp, top_p, top_k)
                 if self.paged:
                     req_key = rng_out
@@ -2420,7 +2559,8 @@ class ContinuousBatchingEngine:
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :T] = req.prompt_ids
             first, kv, rng_out = self._prefill_fn(
-                self.params, jnp.asarray(ids), jnp.asarray([T], jnp.int32),
+                self.params, self._dev(ids),
+                self._dev(np.asarray([T], np.int32)),
                 req_key if self.paged else self._rng, temp, top_p, top_k,
                 self.rope_tables)
             if self.paged:
@@ -3209,21 +3349,21 @@ class ContinuousBatchingEngine:
             (toks_dev, k_pool, v_pool, last_o, keys_o, lens_o, fin_o,
              active_o) = self._spec_step_fn(
                 self.params, self.pool.k_pool, self.pool.v_pool,
-                self._page_table_dev, jnp.asarray(q_ids), jnp.asarray(q_lens),
-                jnp.asarray(hist), self._last_tokens, self._lengths_dev,
-                self._active_dev, self._finished_dev, jnp.asarray(sample),
-                jnp.asarray(final_mask), jnp.asarray(final_lens),
-                jnp.asarray(spec_lens), self._stops_dev, self._limit_dev,
+                self._page_table_dev, self._dev(q_ids), self._dev(q_lens),
+                self._dev(hist), self._last_tokens, self._lengths_dev,
+                self._active_dev, self._finished_dev, self._dev(sample),
+                self._dev(final_mask), self._dev(final_lens),
+                self._dev(spec_lens), self._stops_dev, self._limit_dev,
                 self._slot_keys, self._temp_dev, self._top_p_dev,
                 self._top_k_dev)
         else:
             (toks_dev, k_pool, v_pool, last_o, keys_o, lens_o, fin_o,
              active_o) = self._mixed_step_fn(
                 self.params, self.pool.k_pool, self.pool.v_pool,
-                self._page_table_dev, jnp.asarray(q_ids), jnp.asarray(q_lens),
-                jnp.asarray(hist), self._last_tokens, self._lengths_dev,
-                self._active_dev, self._finished_dev, jnp.asarray(sample),
-                jnp.asarray(final_mask), jnp.asarray(final_lens),
+                self._page_table_dev, self._dev(q_ids), self._dev(q_lens),
+                self._dev(hist), self._last_tokens, self._lengths_dev,
+                self._active_dev, self._finished_dev, self._dev(sample),
+                self._dev(final_mask), self._dev(final_lens),
                 self._stops_dev, self._limit_dev, self._slot_keys,
                 self._temp_dev, self._top_p_dev, self._top_k_dev)
         self.pool.k_pool, self.pool.v_pool = k_pool, v_pool

@@ -757,6 +757,83 @@ def test_WD01_repo_gate_clean():
     assert findings == [], [f.to_dict() for f in findings]
 
 
+# ---------------------------------------------------------------- SH family
+
+
+def test_SH01_bare_device_put_in_mesh_class_fails():
+    bad = lint(
+        "import jax\n"
+        "class Engine:\n"
+        "    def __init__(self, tp):\n"
+        "        self.mesh = object()\n"
+        "    def upload(self, x):\n"
+        "        return jax.device_put(x)\n",
+        tier="runtime", select=("SH01",))
+    assert rule_ids(bad) == ["SH01"] and bad[0].line == 6
+    assert "FULL-REPLICATES" in bad[0].message
+
+
+def test_SH01_bare_device_put_in_mesh_function_fails():
+    bad = lint(
+        "import jax\n"
+        "def shard_tree(params, mesh):\n"
+        "    return jax.device_put(params)\n",
+        tier="runtime", select=("SH01",))
+    assert rule_ids(bad) == ["SH01"]
+
+
+def test_SH01_explicit_sharding_passes():
+    ok = lint(
+        "import jax\n"
+        "class Engine:\n"
+        "    def __init__(self, mesh, repl):\n"
+        "        self.mesh = mesh\n"
+        "        self._repl = repl\n"
+        "    def upload(self, x):\n"
+        "        return jax.device_put(x, self._repl)\n"
+        "    def upload_kw(self, x):\n"
+        "        return jax.device_put(x, device=self._repl)\n",
+        tier="runtime", select=("SH01",))
+    assert ok == []
+
+
+def test_SH01_non_mesh_class_passes():
+    # single-device code may device_put without a destination — the rule
+    # scopes to mesh-mode classes/functions only
+    ok = lint(
+        "import jax\n"
+        "class Plain:\n"
+        "    def upload(self, x):\n"
+        "        return jax.device_put(x)\n",
+        tier="runtime", select=("SH01",))
+    assert ok == []
+
+
+def test_SH01_outside_runtime_tier_passes():
+    ok = lint(
+        "import jax\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self.mesh = object()\n"
+        "    def upload(self, x):\n"
+        "        return jax.device_put(x)\n",
+        tier="modules", select=("SH01",))
+    assert ok == []
+
+
+def test_SH01_waiver_roundtrip():
+    ok = lint(
+        "import jax\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self.mesh = object()\n"
+        "    def upload(self, x):\n"
+        "        # fabric-lint: waive SH01 reason=staging-host copy\n"
+        "        return jax.device_put(x)\n",
+        tier="runtime", select=("SH01",))
+    assert ok == []
+
+
 # ----------------------------------------------- RC family (fabric-race)
 
 #: the PR-8 pre-fix shape, distilled: _fail_all_inflight drains the pending

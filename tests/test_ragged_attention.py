@@ -162,3 +162,55 @@ def test_ragged_rejects_misaligned_q_max():
         ragged_paged_attention(q, k_pool, v_pool, pt,
                                jnp.zeros((B,), jnp.int32),
                                jnp.ones((B,), jnp.int32), interpret=True)
+
+
+@pytest.mark.parametrize("B,Hq,Hkv,D,page,Pmax,hist,q_lens,window", [
+    # mixed GQA batch: decode row + page-crossing chunk + idle row
+    (3, 4, 2, 16, 16, 6, [37, 12, 0], [1, 23, 0], None),
+    # MHA (G=1) with a chunk starting exactly on a page boundary
+    (2, 4, 4, 16, 8, 8, [16, 8], [16, 9], None),
+    # sliding window + multiple q-block programs
+    (3, 4, 2, 16, 16, 6, [40, 10, 25], [1, 14, 2], 24),
+    (1, 2, 2, 16, 8, 8, [11, ], [33, ], None),
+])
+def test_two_d_dot_rewrite_bitwise(B, Hq, Hkv, D, page, Pmax, hist, q_lens,
+                                   window):
+    """The Mosaic-lowerable 2D-dot form of the ragged kernel (unrolled
+    per-head slices/dots replacing the head-major [Qb,Hq,D]<->[Hq,Qb,D]
+    shuffles and the batched GQA dot_generals) is BITWISE identical to the
+    batched interpret form — the golden that lets the AOT path lower a
+    different kernel body without any possibility of drift."""
+    N = B * Pmax + 2
+    key = jax.random.PRNGKey(7)
+    kq, kp = jax.random.split(key)
+    q_max = -(-max(q_lens) // 8) * 8
+    q = jax.random.normal(kq, (B, q_max, Hq, D), jnp.float32)
+    k_pool, v_pool, pt = _build_pool(kp, B, page, Pmax, Hkv, D, N)
+    hist_a = jnp.asarray(hist, jnp.int32)
+    qlen_a = jnp.asarray(q_lens, jnp.int32)
+
+    batched = ragged_paged_attention(q, k_pool, v_pool, pt, hist_a, qlen_a,
+                                     interpret=True, sliding_window=window,
+                                     two_d_dots=False)
+    two_d = ragged_paged_attention(q, k_pool, v_pool, pt, hist_a, qlen_a,
+                                   interpret=True, sliding_window=window,
+                                   two_d_dots=True)
+    np.testing.assert_array_equal(np.asarray(two_d), np.asarray(batched))
+
+
+def test_two_d_dot_rewrite_bitwise_decode_kernel():
+    """Same golden for the decode (T=1) kernel's 2D form — the whole paged
+    family must lower, so the whole family carries the rewrite."""
+    B, Hq, Hkv, D, page, Pmax = 4, 4, 2, 32, 16, 6
+    N = B * Pmax + 2
+    key = jax.random.PRNGKey(11)
+    kq, kp = jax.random.split(key)
+    q = jax.random.normal(kq, (B, Hq, D), jnp.float32)
+    k_pool, v_pool, pt = _build_pool(kp, B, page, Pmax, Hkv, D, N)
+    lengths = jnp.asarray([1, 10, 34, 81], jnp.int32)
+
+    batched = paged_decode_attention(q, k_pool, v_pool, pt, lengths,
+                                     interpret=True, two_d_dots=False)
+    two_d = paged_decode_attention(q, k_pool, v_pool, pt, lengths,
+                                   interpret=True, two_d_dots=True)
+    np.testing.assert_array_equal(np.asarray(two_d), np.asarray(batched))
