@@ -5,16 +5,20 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint lock-graph lock-graph-check modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench lifecycle-guard cancel-guard fairness-guard
+.PHONY: safety lint lock-graph lock-graph-check shard-graph shard-graph-check modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench lifecycle-guard cancel-guard fairness-guard
 
-safety: lint lock-graph-check modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench lifecycle-guard cancel-guard fairness-guard  ## the full local gate
+safety: lint lock-graph-check shard-graph-check modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench lifecycle-guard cancel-guard fairness-guard  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
+#: wall-clock budget for the whole-repo analyzer run (all three passes) —
+#: the CI guard that keeps interprocedural passes from silently blowing up
+#: the lint gate (exit 3 on overrun)
+LINT_BUDGET ?= 120
 
-lint:  ## fabric-lint (AS/JP/LK/RC interprocedural + migrated DE/EC families, SARIF artifact) + pytest driver + concurrency stress + license audit (deny.toml parity)
+lint:  ## fabric-lint (AS/JP/LK/RC/SH/AK interprocedural + migrated DE/EC families, SARIF artifact, wall-clock budget) + pytest driver + concurrency stress + license audit (deny.toml parity)
 	@mkdir -p $(dir $(LINT_SARIF))
 	$(PY) -m cyberfabric_core_tpu.apps.fabric_lint cyberfabric_core_tpu \
-		--format sarif --output $(LINT_SARIF)
+		--format sarif --output $(LINT_SARIF) --max-seconds $(LINT_BUDGET)
 	$(PY) -m pytest tests/test_arch_lint.py tests/test_fabric_lint.py \
 		tests/test_concurrency_stress.py \
 		tests/test_license_audit.py -q -m "not slow"
@@ -28,6 +32,16 @@ lock-graph-check:  ## drift check: the committed hierarchy doc matches the regen
 		--lock-graph json --output build/lock_graph.regen.json
 	@diff -u docs/lock_graph.json build/lock_graph.regen.json \
 		|| { echo "docs/lock_graph.json is stale — run 'make lock-graph' and commit"; exit 1; }
+
+shard-graph:  ## regenerate the checked SPMD-world artifact (docs/shard_graph.json: mesh inventory, dispatch map, provenance, AOT key coverage) from the code
+	$(PY) -m cyberfabric_core_tpu.apps.fabric_lint cyberfabric_core_tpu \
+		--shard-graph json --output docs/shard_graph.json
+
+shard-graph-check:  ## drift check: the committed SPMD doc matches the regenerated graph (and the AOT key stays complete)
+	@$(PY) -m cyberfabric_core_tpu.apps.fabric_lint cyberfabric_core_tpu \
+		--shard-graph json --output build/shard_graph.regen.json
+	@diff -u docs/shard_graph.json build/shard_graph.regen.json \
+		|| { echo "docs/shard_graph.json is stale — run 'make shard-graph' and commit"; exit 1; }
 
 modelcheck:  ## kani parity: exhaustive pool-protocol model check + scheduler admission invariant walks
 	$(PY) -m pytest tests/test_model_check_pool.py tests/test_model_check_scheduler.py -q

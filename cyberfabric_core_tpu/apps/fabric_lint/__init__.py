@@ -20,13 +20,25 @@ three semantic families the old tier could not express:
   writes/RMWs, blocking-while-locked, and unguarded iteration over shared
   resizable collections. ``--lock-graph json|dot`` dumps the inferred
   acquisition-order hierarchy (the committed ``docs/lock_graph.json``).
+- **SH/AK — fabric-shard (interprocedural)**: a third, whole-program pass
+  (``spmd_model.py``) builds the SPMD world — mesh inventory + resolved
+  axis universe, a device-value provenance lattice
+  (host/device/replicated/sharded) over mesh-mode class attributes, the
+  jitted-dispatch map, bare-upload witness chains, and the AOT cache-key
+  coverage model. SH02 catches host arrays flowing into mesh dispatches
+  (and helper-routed bare ``device_put``, the SH01 blind spot), SH03
+  catches PartitionSpec axis typos and shard_map spec-arity drift, SH04
+  catches implicit GSPMD reshards on combined arrays, and AK01 catches
+  program-shape config fields missing from the AOT key (the
+  ``device_stop_width`` bug class). ``--shard-graph json|dot`` dumps the
+  inferred world (the committed ``docs/shard_graph.json``).
 - **DE/EC — design/error-catalog**: the migrated DE01–DE13 + EC01 families.
 
 Usage:
     python -m cyberfabric_core_tpu.apps.fabric_lint PATH...
         [--select AS,JP01] [--format text|json|sarif] [--output FILE]
         [--baseline FILE] [--no-default-baseline] [--list-rules]
-        [--lock-graph json|dot]
+        [--lock-graph json|dot] [--shard-graph json|dot] [--max-seconds T]
 
 Findings are suppressed inline with::
 

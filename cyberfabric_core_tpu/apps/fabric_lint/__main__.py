@@ -1,12 +1,14 @@
 """CLI: ``python -m cyberfabric_core_tpu.apps.fabric_lint PATH...``.
 
-Exit codes: 0 clean (or fully waived/baselined), 1 findings, 2 usage error.
+Exit codes: 0 clean (or fully waived/baselined), 1 findings, 2 usage error,
+3 wall-clock budget exceeded (``--max-seconds``).
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+import time
 from pathlib import Path
 
 from .emitters import emit_json, emit_sarif, emit_text
@@ -31,8 +33,9 @@ def main(argv: list[str] | None = None) -> int:
         prog="fabric_lint",
         description="AST/dataflow analyzer: async-safety (AS), jit-purity "
                     "(JP), lock-discipline (LK), interprocedural races "
-                    "(RC, fabric-race), design (DE) and error-catalog (EC) "
-                    "rule families.")
+                    "(RC, fabric-race), sharding/AOT-key provenance "
+                    "(SH/AK, fabric-shard), design (DE) and error-catalog "
+                    "(EC) rule families.")
     parser.add_argument("paths", nargs="*", type=Path,
                         help="files or package roots to lint")
     parser.add_argument("--select", default="",
@@ -57,7 +60,21 @@ def main(argv: list[str] | None = None) -> int:
                              "edges with witnesses, guarded-by map, cycles) "
                              "— the checked concurrency-hierarchy artifact "
                              "(docs/lock_graph.json)")
+    parser.add_argument("--shard-graph", choices=("json", "dot"),
+                        default=None,
+                        help="instead of linting, dump the inferred SPMD "
+                             "world (mesh inventory + axis universe, "
+                             "jitted-dispatch map, attribute provenance, "
+                             "AOT key coverage) — the checked sharding "
+                             "artifact (docs/shard_graph.json)")
+    parser.add_argument("--max-seconds", type=float, default=None,
+                        metavar="T",
+                        help="wall-clock budget for the whole run (all "
+                             "analyzer passes); exit 3 on overrun — the CI "
+                             "guard that keeps interprocedural passes from "
+                             "silently blowing up `make lint`")
     args = parser.parse_args(argv)
+    t_start = time.monotonic()
 
     rules = all_rules()
     if args.list_rules:
@@ -102,6 +119,43 @@ def main(argv: list[str] | None = None) -> int:
             sys.stdout.write(report)
         # a cycle in the committed hierarchy is a failure even in dump mode
         return 1 if graph["cycles"] else 0
+
+    if args.shard_graph:
+        import json as _json
+
+        from .spmd_model import (build_spmd_model, shard_graph_dict,
+                                 shard_graph_dot)
+
+        contexts = []
+        parse_errors = []
+        for path in args.paths:
+            if not path.exists():
+                print(f"fabric-lint: no such path: {path}", file=sys.stderr)
+                return 2
+            contexts.extend(load_contexts(path, on_error=parse_errors.append))
+        if parse_errors:
+            # a file whose meshes/specs silently vanish would ship a WRONG
+            # axis universe — refuse rather than regenerate from a partial
+            # scan (the lock-graph discipline)
+            for f in parse_errors:
+                print(f"fabric-lint: {f.path}:{f.line}: {f.message}",
+                      file=sys.stderr)
+            return 2
+        model = build_spmd_model(
+            ProjectContext(args.paths[0].resolve(), contexts))
+        graph = shard_graph_dict(model)
+        if args.shard_graph == "dot":
+            report = shard_graph_dot(model)
+        else:
+            report = _json.dumps(graph, indent=2, sort_keys=True) + "\n"
+        if args.output:
+            args.output.parent.mkdir(parents=True, exist_ok=True)
+            args.output.write_text(report)
+            print(f"fabric-lint: shard graph written to {args.output}")
+        else:
+            sys.stdout.write(report)
+        # an uncovered AOT key field is a failure even in dump mode
+        return 1 if graph.get("aot_key", {}).get("uncovered") else 0
 
     baseline = {}
     baseline_path = args.baseline
@@ -148,6 +202,15 @@ def main(argv: list[str] | None = None) -> int:
     else:
         sys.stdout.write(report)
         blocking = [f for f in findings if not f.suppressed]
+
+    if args.max_seconds is not None:
+        elapsed = time.monotonic() - t_start
+        if elapsed > args.max_seconds:
+            print(f"fabric-lint: wall-clock budget exceeded: {elapsed:.1f}s "
+                  f"> {args.max_seconds:.1f}s — an interprocedural pass "
+                  "regressed; profile project_model/spmd_model before "
+                  "raising the budget", file=sys.stderr)
+            return 3
     return 1 if blocking else 0
 
 

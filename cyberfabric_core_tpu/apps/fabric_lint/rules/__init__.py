@@ -7,5 +7,6 @@ from . import jit_purity    # noqa: F401
 from . import lock_discipline  # noqa: F401
 from . import races         # noqa: F401
 from . import sharding      # noqa: F401
+from . import spmd          # noqa: F401
 from . import telemetry     # noqa: F401
 from . import watchdogs     # noqa: F401

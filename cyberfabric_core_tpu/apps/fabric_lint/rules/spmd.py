@@ -1,0 +1,514 @@
+"""SH02–SH04 + AK01 — whole-program SPMD provenance discipline
+(fabric-shard).
+
+Four rule families over the pass-3 model (``spmd_model.py``), each
+distilled from a sharding/device-boundary bug this repo shipped or
+narrowly dodged once the scheduler went mesh-mode (PR 13):
+
+- **SH02 — host flow into a mesh dispatch.** SH01 generalized from syntax
+  to dataflow: (a) a mesh-mode scope calls a helper that — directly or
+  transitively through the call graph — performs a destination-less
+  ``jax.device_put``, the case SH01's per-scope walk cannot see; (b) a
+  value whose provenance lattice point is ``host`` (an ``np.*`` array, a
+  host-typed ``self`` attribute) is passed straight into a jitted dispatch
+  (``self._X_fn = jax.jit(...)``) of a mesh-mode class without routing
+  through ``_dev()`` / ``parallel.sharding.replicated`` / a NamedSharding
+  construction. Under GSPMD the host array commits wherever jit's
+  device-put default lands and is silently full-replicated.
+- **SH03 — spec/mesh drift.** A ``PartitionSpec`` axis name that no mesh
+  in the program declares (the union of literal ``Mesh``/``build_mesh``
+  axis tuples — the provenance-resolved axis universe), or a ``shard_map``
+  whose literal ``in_specs`` arity cannot match the wrapped callable's
+  signature (or whose literal ``out_specs`` tuple disagrees with a literal
+  tuple return). Axis typos compile fine on CPU tests (mesh axes exist
+  but sizes are 1) and explode on the real topology.
+- **SH04 — implicit reshard on the hot path.** Two arrays whose inferred
+  ``NamedSharding`` specs disagree on a named axis are combined (binop /
+  ``jnp.concatenate``-family) inside a jit-traced or mesh-mode scope with
+  no ``with_sharding_constraint`` on the combining expression — GSPMD
+  inserts a silent all-gather/reshard per dispatch instead of failing.
+- **AK01 — AOT cache-key completeness.** A config field that provably
+  shapes the compiled serving programs (read in ``_build_programs``
+  directly, through derived attributes/locals/config methods, or flowing
+  into a device-array shape constructor anywhere in the engine class) has
+  no name-matched parameter in ``aot_tpu.serving_programs``/``aot_compile``
+  — the exact ``device_stop_width`` shape PR 7 fixed by hand: the AOT
+  artifact deserializes, then every dispatch donates mismatched buffers.
+
+Precision heuristics: ``unknown`` provenance never flags (join of host and
+device evidence stays silent); SH03 skips axis checks when the scanned
+program declares no mesh at all and skips arity checks on ``*args`` /
+spliced specs; SH04 requires both specs to carry at least one named axis
+(replicated-with-sharded combinations are the normal broadcast case).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..engine import (FileContext, Finding, ProjectContext, Rule,
+                      dotted_name, register)
+from ..spmd_model import (HOST, P_UNKNOWN, SpmdModel, build_spmd_model,
+                          expr_prov, _named_sharding_spec)
+
+#: mesh-touching tiers (fixtures pass tier="runtime")
+_SPMD_TIERS = frozenset({"runtime", "parallel", "models", "ops"})
+
+_COMBINERS = frozenset({
+    "concatenate", "stack", "hstack", "vstack", "where", "add", "subtract",
+    "multiply", "divide", "maximum", "minimum", "matmul", "dot", "einsum",
+    "tensordot",
+})
+
+_WSC = "with_sharding_constraint"
+
+
+class _SpmdRule(Rule):
+    """Shared plumbing: build/memoize the pass-3 model, map paths back to
+    FileContexts for finding locations."""
+
+    def _model(self, project: ProjectContext) -> SpmdModel:
+        return build_spmd_model(project)
+
+    @staticmethod
+    def _ctx_by_path(project: ProjectContext) -> dict[str, FileContext]:
+        return {c.relpath: c for c in project.files}
+
+
+def _in_mesh_scope(model: SpmdModel, key: tuple) -> bool:
+    """Is method qualkey (path, cls, meth) inside a mesh-mode scope?"""
+    path, cls, meth = key
+    if (path, cls) in model.mesh_classes:
+        return True
+    return cls == "<module>" and (path, meth) in model.mesh_functions
+
+
+# ---------------------------------------------------------------------- SH02
+
+
+@register
+class SH02(_SpmdRule):
+    id = "SH02"
+    family = "SH"
+    severity = "error"
+    description = ("host-provenance array flows into a mesh-mode jitted "
+                   "dispatch, or a mesh-mode scope calls a helper that "
+                   "performs a bare jax.device_put — the dataflow "
+                   "generalization of SH01")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        model = self._model(project)
+        race = model.race
+        ctx_by_path = self._ctx_by_path(project)
+        for cm in race.classes.values():
+            if cm.tier not in _SPMD_TIERS:
+                continue
+            ctx = ctx_by_path.get(cm.relpath)
+            if ctx is None:
+                continue
+            cls_key = (cm.relpath, cm.name)
+            cls_is_mesh = cls_key in model.mesh_classes
+            dispatches = model.dispatch_attrs.get(cls_key, {})
+            attr_prov = model.attr_prov.get(cls_key, {})
+            for name, m in cm.methods.items():
+                mesh_scope = cls_is_mesh or (
+                    cm.name == "<module>"
+                    and (cm.relpath, name) in model.mesh_functions)
+                if not mesh_scope:
+                    continue
+                yield from self._helper_uploads(model, race, cm, m, ctx)
+                if cls_is_mesh and dispatches:
+                    yield from self._host_dispatch_args(
+                        m.node, dispatches, attr_prov, cm.name, ctx)
+
+    # -- (a) helper-routed bare uploads -----------------------------------
+
+    def _helper_uploads(self, model, race, cm, m, ctx):
+        my_key = race.method_key(m)
+        seen: set[tuple] = set()
+        for ev in m.calls:
+            callee = race.resolve_call(cm, ev)
+            if callee is None:
+                continue
+            key = race.method_key(callee)
+            info = model.bare_upload_via.get(key)
+            if info is None or key == my_key:
+                continue
+            chain, dpath, dline, direct_key = info
+            if direct_key == my_key:
+                continue                # the bare site is HERE — SH01's job
+            if _in_mesh_scope(model, direct_key):
+                continue                # SH01 flags the site itself there
+            if (ev.line, key) in seen:
+                continue
+            seen.add((ev.line, key))
+            yield self.finding_in(
+                ctx, ev.line,
+                f"{m.qualname} runs in a mesh-mode scope and calls "
+                f"{callee.qualname}, which reaches a bare "
+                f"`jax.device_put(...)` via [{' -> '.join(chain)}] "
+                f"({dpath}:{dline}) — the upload commits to the default "
+                "device and GSPMD silently FULL-REPLICATES it across the "
+                "serving mesh; pass an explicit sharding at the upload "
+                "site or route the value through the engine's _dev() "
+                "helper (SH01 cannot see through the call)")
+
+    # -- (b) host-provenance dispatch arguments ---------------------------
+
+    def _host_dispatch_args(self, fn_node, dispatches, attr_prov, cls_name,
+                            ctx):
+        env: dict[str, object] = {}
+        findings: list[Finding] = []
+
+        def on_expr(expr: ast.AST) -> None:
+            for node in ast.walk(expr):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if not (isinstance(func, ast.Attribute)
+                        and isinstance(func.value, ast.Name)
+                        and func.value.id == "self"
+                        and func.attr in dispatches):
+                    continue
+                for arg in node.args:
+                    if isinstance(arg, ast.Starred):
+                        continue
+                    p = expr_prov(arg, env, attr_prov)
+                    if p.kind != HOST:
+                        continue
+                    label = ast.unparse(arg) if hasattr(ast, "unparse") \
+                        else "<arg>"
+                    findings.append(self.finding_in(
+                        ctx, node,
+                        f"host-provenance array `{label}` is passed into "
+                        f"the jitted dispatch `self.{func.attr}(...)` of "
+                        f"mesh-mode class {cls_name} without an explicit "
+                        "placement — jit commits it to the default device "
+                        "and GSPMD silently full-replicates it; wrap it "
+                        "in the engine's _dev() (replicated commitment) "
+                        "or device_put it with a NamedSharding first"))
+
+        def walk(body: list) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    value = stmt.value
+                    if value is None:
+                        continue
+                    on_expr(value)
+                    prov = expr_prov(value, env, attr_prov)
+                    targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                        else [stmt.target]
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            env[t.id] = prov
+                        elif isinstance(t, ast.Tuple):
+                            for el in t.elts:
+                                if isinstance(el, ast.Name):
+                                    env[el.id] = P_UNKNOWN
+                elif isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    walk(stmt.body)
+                else:
+                    for child in ast.iter_child_nodes(stmt):
+                        if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                            continue
+                        on_expr(child)
+                    for blk in ("body", "orelse", "finalbody"):
+                        sub = getattr(stmt, blk, None)
+                        if isinstance(sub, list) and sub and \
+                                isinstance(sub[0], ast.stmt):
+                            walk(sub)
+                    for h in getattr(stmt, "handlers", []):
+                        walk(h.body)
+                    for case in getattr(stmt, "cases", []):
+                        walk(case.body)
+
+        walk(fn_node.body)
+        return findings
+
+
+# ---------------------------------------------------------------------- SH03
+
+
+def _pspec_axis_names(call: ast.Call):
+    """Yield (axis string constant node, name) from a P(...) call."""
+    for arg in call.args:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            yield arg, arg.value
+        elif isinstance(arg, (ast.Tuple, ast.List)):
+            for el in arg.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    yield el, el.value
+
+
+def _is_pspec(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    return name in ("P", "PartitionSpec") or \
+        name.rsplit(".", 1)[-1] == "PartitionSpec"
+
+
+def _literal_spec_arity(expr: ast.AST) -> Optional[int]:
+    """Entry count of a literal in_specs/out_specs tuple; None if opaque
+    (a Name, a BinOp splice, a Starred element...)."""
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        if any(isinstance(el, ast.Starred) for el in expr.elts):
+            return None
+        return len(expr.elts)
+    return None
+
+
+@register
+class SH03(_SpmdRule):
+    id = "SH03"
+    family = "SH"
+    severity = "error"
+    description = ("PartitionSpec axis name absent from every mesh in the "
+                   "program, or shard_map in_specs/out_specs arity "
+                   "mismatching the wrapped callable")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        model = self._model(project)
+        universe = model.axis_universe
+        for ctx in project.files:
+            funcs = self._local_funcs(ctx)
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if universe and _is_pspec(node):
+                    for const, axis in _pspec_axis_names(node):
+                        if axis not in universe:
+                            yield self.finding_in(
+                                ctx, const,
+                                f"PartitionSpec names axis '{axis}' but no "
+                                "mesh in the program declares it (known "
+                                f"axes: {', '.join(sorted(universe))}) — "
+                                "the spec compiles against a size-1 axis "
+                                "in tests and fails or silently "
+                                "no-ops on the real topology")
+                if dotted_name(node.func).rsplit(".", 1)[-1] == "shard_map":
+                    yield from self._check_shard_map(ctx, node, funcs)
+
+    @staticmethod
+    def _local_funcs(ctx: FileContext) -> dict[str, list[ast.AST]]:
+        funcs: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault(node.name, []).append(node)
+        return funcs
+
+    def _check_shard_map(self, ctx: FileContext, call: ast.Call,
+                         funcs: dict) -> Iterable[Finding]:
+        target: Optional[ast.AST] = None
+        if call.args:
+            arg0 = call.args[0]
+            if isinstance(arg0, ast.Lambda):
+                target = arg0
+            elif isinstance(arg0, ast.Name):
+                cands = funcs.get(arg0.id, [])
+                if len(cands) == 1:
+                    target = cands[0]
+        in_specs = out_specs = None
+        for kw in call.keywords:
+            if kw.arg == "in_specs":
+                in_specs = kw.value
+            elif kw.arg == "out_specs":
+                out_specs = kw.value
+        if in_specs is not None and isinstance(in_specs, ast.Name):
+            # `in_specs = (...)` bound just above — resolve one hop
+            in_specs = self._local_binding(ctx, in_specs.id)
+        if target is None or in_specs is None:
+            return
+        n = _literal_spec_arity(in_specs)
+        if n is None:
+            return
+        args = target.args
+        if args.vararg is not None or args.kwarg is not None:
+            return
+        total = len(args.posonlyargs) + len(args.args)
+        required = total - len(args.defaults)
+        fname = getattr(target, "name", "<lambda>")
+        if not (required <= n <= total):
+            yield self.finding_in(
+                ctx, call,
+                f"shard_map in_specs has {n} spec(s) but the wrapped "
+                f"callable `{fname}` takes "
+                f"{total if required == total else f'{required}-{total}'} "
+                "positional argument(s) — shard_map applies specs "
+                "positionally, so every argument needs exactly one spec")
+            return
+        m = _literal_spec_arity(out_specs) if out_specs is not None else None
+        if m is not None and isinstance(target,
+                                        (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+            returns = [r.value for r in ast.walk(target)
+                       if isinstance(r, ast.Return) and r.value is not None]
+            arities = {len(r.elts) for r in returns
+                       if isinstance(r, ast.Tuple)}
+            if returns and len(arities) == 1 and \
+                    all(isinstance(r, ast.Tuple) for r in returns):
+                r = arities.pop()
+                if r != m:
+                    yield self.finding_in(
+                        ctx, call,
+                        f"shard_map out_specs has {m} spec(s) but "
+                        f"`{fname}` returns a {r}-tuple — the output "
+                        "pytree and its specs must agree")
+
+    @staticmethod
+    def _local_binding(ctx: FileContext, name: str) -> Optional[ast.AST]:
+        found: Optional[ast.AST] = None
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        if found is not None:
+                            return None            # rebound — opaque
+                        found = node.value
+        return found
+
+
+# ---------------------------------------------------------------------- SH04
+
+
+def _spec_conflict(a: tuple, b: tuple) -> bool:
+    """Both specs carry a named axis and disagree position-wise (padded
+    with None). P() vs P('tp') is the normal broadcast case — silent."""
+    def named(s):
+        return any(x for x in s)
+    if not (named(a) and named(b)):
+        return False
+    n = max(len(a), len(b))
+    pa = tuple(a) + (None,) * (n - len(a))
+    pb = tuple(b) + (None,) * (n - len(b))
+    return pa != pb
+
+
+def _spec_label(s: tuple) -> str:
+    return "P(" + ", ".join(repr(x) if x is not None else "None"
+                            for x in s) + ")"
+
+
+@register
+class SH04(_SpmdRule):
+    id = "SH04"
+    family = "SH"
+    severity = "error"
+    description = ("arrays with disagreeing inferred NamedSharding specs "
+                   "combined without with_sharding_constraint — an "
+                   "implicit GSPMD reshard on the hot path")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(ctx, node)
+
+    def _check_fn(self, ctx: FileContext,
+                  fn: ast.AST) -> Iterable[Finding]:
+        env: dict[str, tuple] = {}
+        sanctioned: set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    dotted_name(node.func).rsplit(".", 1)[-1] == _WSC \
+                    and node.args:
+                for sub in ast.walk(node.args[0]):
+                    sanctioned.add(id(sub))
+        for stmt in ast.walk(fn):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            spec = self._binding_spec(stmt.value)
+            if spec is None:
+                continue
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    env[t.id] = spec
+        if not env:
+            return
+        for node in ast.walk(fn):
+            if id(node) in sanctioned:
+                continue
+            operands: list[tuple[str, tuple]] = []
+            if isinstance(node, ast.BinOp):
+                for side in (node.left, node.right):
+                    if isinstance(side, ast.Name) and side.id in env:
+                        operands.append((side.id, env[side.id]))
+            elif isinstance(node, ast.Call) and \
+                    dotted_name(node.func).rsplit(".", 1)[-1] in _COMBINERS:
+                flat: list[ast.AST] = []
+                for a in node.args:
+                    if isinstance(a, (ast.Tuple, ast.List)):
+                        flat.extend(a.elts)
+                    else:
+                        flat.append(a)
+                for a in flat:
+                    if isinstance(a, ast.Name) and a.id in env:
+                        operands.append((a.id, env[a.id]))
+            for i in range(len(operands)):
+                for j in range(i + 1, len(operands)):
+                    (na, sa), (nb, sb) = operands[i], operands[j]
+                    if _spec_conflict(sa, sb):
+                        yield self.finding_in(
+                            ctx, node,
+                            f"`{na}` {_spec_label(sa)} and `{nb}` "
+                            f"{_spec_label(sb)} disagree on a named axis "
+                            "and are combined here — GSPMD inserts a "
+                            "silent all-gather/reshard on every dispatch; "
+                            "re-place one operand or wrap the result in "
+                            "jax.lax.with_sharding_constraint to make "
+                            "the layout decision explicit")
+                        break
+
+    @staticmethod
+    def _binding_spec(value: ast.AST) -> Optional[tuple]:
+        """Spec bound by `x = device_put(v, NamedSharding(mesh, P(...)))`
+        or `x = with_sharding_constraint(v, NamedSharding(...))`."""
+        if not isinstance(value, ast.Call):
+            return None
+        name = dotted_name(value.func)
+        terminal = name.rsplit(".", 1)[-1]
+        if name in ("jax.device_put", "device_put"):
+            dst = value.args[1] if len(value.args) >= 2 else None
+            for kw in value.keywords:
+                if kw.arg and "shard" in kw.arg:
+                    dst = kw.value
+            if dst is not None:
+                return _named_sharding_spec(dst)
+        elif terminal == _WSC and len(value.args) >= 2:
+            return _named_sharding_spec(value.args[1])
+        return None
+
+
+# ---------------------------------------------------------------------- AK01
+
+
+@register
+class AK01(_SpmdRule):
+    id = "AK01"
+    family = "AK"
+    severity = "error"
+    description = ("config field shapes the compiled serving programs but "
+                   "has no name-matched parameter in the AOT cache key "
+                   "(serving_programs/aot_compile) — the device_stop_width "
+                   "bug class")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        model = self._model(project)
+        aot = model.aot
+        if aot is None or not aot.key_sites or not aot.engine_cls:
+            return
+        ctx = self._ctx_by_path(project).get(aot.engine_path)
+        if ctx is None:
+            return
+        key_fns = ", ".join(sorted({fn for _p, fn in aot.key_sites}))
+        for f in aot.uncovered:
+            witness, line = aot.shape_fields[f]
+            yield self.finding_in(
+                ctx, line,
+                f"EngineConfig.{f} shapes the compiled serving programs "
+                f"({witness}) but no parameter of the AOT key functions "
+                f"({key_fns}) name-matches it — an artifact compiled "
+                f"under one {f} value silently serves a config with "
+                f"another, and the first dispatch donates mismatched "
+                f"buffers; thread {f} into the AOT key tuple (the "
+                "device_stop_width bug class)")

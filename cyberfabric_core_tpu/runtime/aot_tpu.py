@@ -90,6 +90,8 @@ def serving_programs(
     max_seq_len: int = 2048,
     device_stop_width: int = 8,
     spec_k: int = 0,
+    use_flash: bool = True,
+    prefix_cache_pages: int = 0,
     mesh: Any = None,
 ) -> dict[str, tuple[Any, tuple]]:
     """name → (fn, abstract_args): the scheduler's program set, abstracted.
@@ -137,12 +139,17 @@ def serving_programs(
             return _plain_sds(shape, dt, sharding=repl_sharding)
         return _plain_sds(shape, dt)
 
+    # program-shape knob, part of the AOT cache key: the serving engine
+    # resolves config.resolve_use_flash() AND mesh is None (tp meshes take
+    # the jnp attention path — the flash kernel cannot auto-partition under
+    # GSPMD, tp_sharded_program's documented discipline), so the compiled
+    # set must key on the same pair or the artifact mismatches a
+    # use_flash=False serving config (AK01)
+    flash = use_flash and mesh is None
+
     def prefill(params, ids, lengths, rng, temp, top_p, top_k, rope_t):
-        # tp meshes take the jnp attention path (the flash kernel cannot
-        # auto-partition under GSPMD — tp_sharded_program's documented
-        # discipline); single-device sets lower the real flash kernel
         last_h, kv = llama.prefill_collect(params, cfg, ids, lengths, rope_t,
-                                           use_flash=mesh is None)
+                                           use_flash=flash)
         logits = llama.lm_head_logits(params, cfg, last_h)
         rng, sub = jax.random.split(rng)
         return sample_token(logits, sub, temp, top_p, top_k), kv, rng
@@ -159,8 +166,11 @@ def serving_programs(
         jax.eval_shape(lambda: rope),
     )
 
-    n_pages = max_batch * (-(-max_seq_len // page_size)) + 1
+    # pool depth mirrors the engine: max(config.prefix_cache_pages, the
+    # per-slot minimum) — a bigger committed pool is a different program
+    # shape, so it keys the cache too (AK01)
     pmax = -(-max_seq_len // page_size)
+    n_pages = max(prefix_cache_pages, max_batch * pmax + 1)
     pool_shape = (cfg.num_layers, n_pages, page_size, cfg.num_kv_heads,
                   cfg.head_dim)
     pool_sds = _plain_sds(pool_shape, dtype, sharding=pool_sharding) \
@@ -387,6 +397,8 @@ def aot_compile(
     max_seq_len: int = 2048,
     device_stop_width: int = 8,
     spec_k: int = 0,
+    use_flash: bool = True,
+    prefix_cache_pages: int = 0,
     tp: int = 0,
     include_serving: bool = True,
     out_dir: Optional[str | Path] = None,
@@ -410,7 +422,8 @@ def aot_compile(
         "dtype": dtype, "prefill_bucket": prefill_bucket,
         "decode_chunk": decode_chunk, "max_batch": max_batch,
         "max_seq_len": max_seq_len, "spec_k": spec_k, "tp": tp,
-        "device_stop_width": device_stop_width, "programs": [],
+        "device_stop_width": device_stop_width, "use_flash": use_flash,
+        "prefix_cache_pages": prefix_cache_pages, "programs": [],
     }
     out = Path(out_dir) if out_dir else None
     if out:
@@ -422,7 +435,8 @@ def aot_compile(
             model, dtype=dt, quantization=quantization,
             prefill_bucket=prefill_bucket, decode_chunk=decode_chunk,
             max_batch=max_batch, max_seq_len=max_seq_len,
-            device_stop_width=device_stop_width, spec_k=spec_k)
+            device_stop_width=device_stop_width, spec_k=spec_k,
+            use_flash=use_flash, prefix_cache_pages=prefix_cache_pages)
         jobs = [(name, fn, jax.tree.map(
             lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=repl)
             if getattr(l, "sharding", None) is None else l, args))
@@ -449,7 +463,8 @@ def aot_compile(
                 prefill_bucket=prefill_bucket, decode_chunk=decode_chunk,
                 max_batch=max_batch, max_seq_len=max_seq_len,
                 device_stop_width=device_stop_width, spec_k=spec_k,
-                mesh=tp_mesh)
+                use_flash=use_flash,
+                prefix_cache_pages=prefix_cache_pages, mesh=tp_mesh)
             jobs.extend((name, fn, args)
                         for name, (fn, args) in tp_progs.items())
 
@@ -536,6 +551,15 @@ def main(argv: Optional[list[str]] = None) -> int:
                     help="scheduler_spec_k of the serving config: adds the "
                          "batched-speculation ragged verify step to the "
                          "compiled set (0 = off, matching the default)")
+    ap.add_argument("--use-flash", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="resolve_use_flash() of the serving config — part "
+                         "of the AOT key: flash vs jnp attention are "
+                         "different compiled programs")
+    ap.add_argument("--prefix-cache-pages", type=int, default=0,
+                    help="prefix_cache_pages of the serving config: pool "
+                         "depth above the per-slot minimum changes the "
+                         "compiled program shape, so it keys the cache")
     ap.add_argument("--tp", type=int, default=0)
     ap.add_argument("--out", default=None)
     ap.add_argument("--serialize", action="store_true")
@@ -549,7 +573,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         decode_chunk=args.decode_chunk, max_batch=args.max_batch,
         max_seq_len=args.max_seq_len,
         device_stop_width=args.device_stop_width, spec_k=args.spec_k,
-        tp=args.tp,
+        use_flash=args.use_flash,
+        prefix_cache_pages=args.prefix_cache_pages, tp=args.tp,
         out_dir=args.out,
         serialize=args.serialize)
     print(json.dumps(report))

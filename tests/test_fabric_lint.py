@@ -834,6 +834,457 @@ def test_SH01_waiver_roundtrip():
     assert ok == []
 
 
+# ------------------------------------- SH02–SH04 + AK01 (fabric-shard)
+
+#: the SH01 blind spot, distilled: the bare device_put lives in a module
+#: helper OUTSIDE any mesh scope, and only the interprocedural pass can
+#: see that a mesh-mode engine routes its uploads through it
+SH02_HELPER_UPLOAD = """
+import jax
+
+def _stage(batch):
+    return jax.device_put(batch)
+
+class Engine:
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def upload(self, batch):
+        return _stage(batch)
+"""
+
+#: the pre-PR-7 AOT-key shape, distilled: device_stop_width flows through
+#: a derived attribute into a device-array shape constructor, but the AOT
+#: cache key (serving_programs' parameter tuple) never names it — the
+#: artifact deserializes and the first dispatch donates mismatched buffers
+AK01_PRE_PR7 = """
+import jax.numpy as jnp
+
+class EngineConfig:
+    model: str = "llama"
+    max_batch: int = 8
+    device_stop_width: int = 4
+
+class Engine:
+    def __init__(self, config):
+        self.config = config
+        self._stop_width = max(1, config.device_stop_width)
+        self.stop_row = jnp.full((config.max_batch, self._stop_width), -1)
+
+    def _build_programs(self):
+        return self.config.max_batch
+
+def serving_programs(model, max_batch):
+    return (model, max_batch)
+"""
+
+
+def test_SH02_helper_routed_bare_upload_must_flag():
+    """Acceptance regression: a bare jax.device_put reached only through a
+    helper call from a mesh-mode scope must flag under SH02 (SH01 cannot
+    see through the call)."""
+    bad = lint(SH02_HELPER_UPLOAD, tier="runtime", select=("SH02",))
+    assert rule_ids(bad) == ["SH02"]
+    assert "_stage" in bad[0].message and "device_put" in bad[0].message
+
+
+def test_SH02_transitive_chain_reported():
+    # two frames down: the witness chain names every hop
+    bad = lint(
+        "import jax\n"
+        "def _upload(x):\n"
+        "    return jax.device_put(x)\n"
+        "def _stage(x):\n"
+        "    return _upload(x)\n"
+        "class Engine:\n"
+        "    def __init__(self, mesh):\n"
+        "        self.mesh = mesh\n"
+        "    def upload(self, x):\n"
+        "        return _stage(x)\n",
+        tier="runtime", select=("SH02",))
+    assert rule_ids(bad) == ["SH02"]
+    assert "_stage" in bad[0].message and "_upload" in bad[0].message
+
+
+def test_SH02_explicit_destination_helper_passes():
+    ok = lint(
+        "import jax\n"
+        "def _stage(batch, sharding):\n"
+        "    return jax.device_put(batch, sharding)\n"
+        "class Engine:\n"
+        "    def __init__(self, mesh, repl):\n"
+        "        self.mesh = mesh\n"
+        "        self._repl = repl\n"
+        "    def upload(self, batch):\n"
+        "        return _stage(batch, self._repl)\n",
+        tier="runtime", select=("SH02",))
+    assert ok == []
+
+
+def test_SH02_non_mesh_caller_passes():
+    # single-device code may route through a bare-upload helper
+    ok = lint(
+        "import jax\n"
+        "def _stage(batch):\n"
+        "    return jax.device_put(batch)\n"
+        "class Plain:\n"
+        "    def upload(self, batch):\n"
+        "        return _stage(batch)\n",
+        tier="runtime", select=("SH02",))
+    assert ok == []
+
+
+def test_SH02_outside_spmd_tiers_passes():
+    ok = lint(SH02_HELPER_UPLOAD, tier="modules", select=("SH02",))
+    assert ok == []
+
+
+_SH02_DISPATCH_PREFIX = (
+    "import jax\n"
+    "import numpy as np\n"
+    "class Engine:\n"
+    "    def __init__(self, mesh):\n"
+    "        self.mesh = mesh\n"
+    "        self._decode_fn = jax.jit(lambda x: x)\n"
+)
+
+
+def test_SH02_host_array_into_jitted_dispatch_fails():
+    bad = lint(
+        _SH02_DISPATCH_PREFIX +
+        "    def step(self):\n"
+        "        tokens = np.zeros((8,), dtype=np.int32)\n"
+        "        return self._decode_fn(tokens)\n",
+        tier="runtime", select=("SH02",))
+    assert rule_ids(bad) == ["SH02"]
+    assert "tokens" in bad[0].message and "_decode_fn" in bad[0].message
+
+
+def test_SH02_host_attr_provenance_inherited_across_methods():
+    # cross-function inheritance: the host provenance assigned in __init__
+    # reaches the dispatch call in step() through the attribute lattice
+    bad = lint(
+        "import jax\n"
+        "import numpy as np\n"
+        "class Engine:\n"
+        "    def __init__(self, mesh):\n"
+        "        self.mesh = mesh\n"
+        "        self.page_table = np.zeros((8, 16))\n"
+        "        self._decode_fn = jax.jit(lambda x: x)\n"
+        "    def step(self):\n"
+        "        return self._decode_fn(self.page_table)\n",
+        tier="runtime", select=("SH02",))
+    assert rule_ids(bad) == ["SH02"]
+    assert "page_table" in bad[0].message
+
+
+def test_SH02_dev_helper_routing_passes():
+    # the blessed upload path: self._dev() commits replicated-on-mesh
+    ok = lint(
+        _SH02_DISPATCH_PREFIX +
+        "    def step(self):\n"
+        "        tokens = self._dev(np.zeros((8,), dtype=np.int32))\n"
+        "        return self._decode_fn(tokens)\n",
+        tier="runtime", select=("SH02",))
+    assert ok == []
+
+
+def test_SH02_device_array_dispatch_passes():
+    ok = lint(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "class Engine:\n"
+        "    def __init__(self, mesh):\n"
+        "        self.mesh = mesh\n"
+        "        self._decode_fn = jax.jit(lambda x: x)\n"
+        "    def step(self):\n"
+        "        tokens = jnp.zeros((8,), dtype=jnp.int32)\n"
+        "        return self._decode_fn(tokens)\n",
+        tier="runtime", select=("SH02",))
+    assert ok == []
+
+
+def test_SH02_unknown_provenance_never_flags():
+    # join of host and device evidence is `unknown` — silence over noise
+    ok = lint(
+        _SH02_DISPATCH_PREFIX +
+        "    def step(self, flag):\n"
+        "        import jax.numpy as jnp\n"
+        "        tokens = np.zeros(8) if flag else jnp.zeros(8)\n"
+        "        return self._decode_fn(tokens)\n",
+        tier="runtime", select=("SH02",))
+    assert ok == []
+
+
+_SH03_MESH_PREFIX = (
+    "import jax\n"
+    "from jax.sharding import Mesh, PartitionSpec as P\n"
+    "def build(devices):\n"
+    "    return Mesh(devices, ('dp', 'tp'))\n"
+)
+
+
+def test_SH03_unknown_axis_name_fails():
+    bad = lint(
+        _SH03_MESH_PREFIX +
+        "def spec():\n"
+        "    return P('tpx', None)\n",
+        tier="runtime", select=("SH03",))
+    assert rule_ids(bad) == ["SH03"]
+    assert "'tpx'" in bad[0].message and "dp, tp" in bad[0].message
+
+
+def test_SH03_declared_axis_passes():
+    ok = lint(
+        _SH03_MESH_PREFIX +
+        "def spec():\n"
+        "    return P('tp', None)\n",
+        tier="runtime", select=("SH03",))
+    assert ok == []
+
+
+def test_SH03_no_mesh_in_program_is_silent():
+    # without any mesh the axis universe is empty — no basis to judge
+    ok = lint(
+        "from jax.sharding import PartitionSpec as P\n"
+        "def spec():\n"
+        "    return P('whatever')\n",
+        tier="runtime", select=("SH03",))
+    assert ok == []
+
+
+def test_SH03_shard_map_in_specs_arity_mismatch_fails():
+    bad = lint(
+        _SH03_MESH_PREFIX +
+        "def body(a, b):\n"
+        "    return a\n"
+        "def run(mesh, xs):\n"
+        "    f = jax.shard_map(body, mesh=mesh,\n"
+        "                      in_specs=(P(), P(), P()), out_specs=P())\n"
+        "    return f(*xs)\n",
+        tier="runtime", select=("SH03",))
+    assert rule_ids(bad) == ["SH03"]
+    assert "3 spec(s)" in bad[0].message and "body" in bad[0].message
+
+
+def test_SH03_shard_map_out_specs_arity_mismatch_fails():
+    bad = lint(
+        _SH03_MESH_PREFIX +
+        "def body(a, b):\n"
+        "    return a, b\n"
+        "def run(mesh, xs):\n"
+        "    f = jax.shard_map(body, mesh=mesh,\n"
+        "                      in_specs=(P(), P()),\n"
+        "                      out_specs=(P(), P(), P()))\n"
+        "    return f(*xs)\n",
+        tier="runtime", select=("SH03",))
+    assert rule_ids(bad) == ["SH03"]
+    assert "out_specs" in bad[0].message and "2-tuple" in bad[0].message
+
+
+def test_SH03_shard_map_matched_specs_pass():
+    # incl. the pipeline.py idiom: in_specs bound to a local name one
+    # assignment above the shard_map call
+    ok = lint(
+        _SH03_MESH_PREFIX +
+        "def body(a, b):\n"
+        "    return a, b\n"
+        "def run(mesh, xs):\n"
+        "    in_specs = (P('tp'), P())\n"
+        "    f = jax.shard_map(body, mesh=mesh,\n"
+        "                      in_specs=in_specs, out_specs=(P(), P()))\n"
+        "    return f(*xs)\n",
+        tier="runtime", select=("SH03",))
+    assert ok == []
+
+
+def test_SH03_vararg_wrapped_fn_skipped():
+    ok = lint(
+        _SH03_MESH_PREFIX +
+        "def body(*arrs):\n"
+        "    return arrs[0]\n"
+        "def run(mesh, xs):\n"
+        "    f = jax.shard_map(body, mesh=mesh,\n"
+        "                      in_specs=(P(), P(), P()), out_specs=P())\n"
+        "    return f(*xs)\n",
+        tier="runtime", select=("SH03",))
+    assert ok == []
+
+
+_SH04_PREFIX = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+)
+
+
+def test_SH04_conflicting_specs_combined_fails():
+    bad = lint(
+        _SH04_PREFIX +
+        "def combine(mesh, x, y):\n"
+        "    a = jax.device_put(x, NamedSharding(mesh, P('tp', None)))\n"
+        "    b = jax.device_put(y, NamedSharding(mesh, P(None, 'tp')))\n"
+        "    return jnp.concatenate([a, b])\n",
+        tier="runtime", select=("SH04",))
+    assert rule_ids(bad) == ["SH04"]
+    assert "all-gather" in bad[0].message
+
+
+def test_SH04_binop_combine_fails():
+    bad = lint(
+        _SH04_PREFIX +
+        "def combine(mesh, x, y):\n"
+        "    a = jax.device_put(x, NamedSharding(mesh, P('tp')))\n"
+        "    b = jax.device_put(y, NamedSharding(mesh, P('dp')))\n"
+        "    return a + b\n",
+        tier="runtime", select=("SH04",))
+    assert rule_ids(bad) == ["SH04"]
+
+
+def test_SH04_agreeing_specs_pass():
+    ok = lint(
+        _SH04_PREFIX +
+        "def combine(mesh, x, y):\n"
+        "    a = jax.device_put(x, NamedSharding(mesh, P('tp', None)))\n"
+        "    b = jax.device_put(y, NamedSharding(mesh, P('tp', None)))\n"
+        "    return jnp.concatenate([a, b])\n",
+        tier="runtime", select=("SH04",))
+    assert ok == []
+
+
+def test_SH04_replicated_with_sharded_is_broadcast_not_conflict():
+    # P() vs P('tp') is the normal broadcast case — silent by design
+    ok = lint(
+        _SH04_PREFIX +
+        "def combine(mesh, x, y):\n"
+        "    a = jax.device_put(x, NamedSharding(mesh, P('tp')))\n"
+        "    b = jax.device_put(y, NamedSharding(mesh, P()))\n"
+        "    return a * b\n",
+        tier="runtime", select=("SH04",))
+    assert ok == []
+
+
+def test_SH04_sharding_constraint_sanctions_the_combine():
+    ok = lint(
+        _SH04_PREFIX +
+        "def combine(mesh, x, y):\n"
+        "    a = jax.device_put(x, NamedSharding(mesh, P('tp', None)))\n"
+        "    b = jax.device_put(y, NamedSharding(mesh, P(None, 'tp')))\n"
+        "    return jax.lax.with_sharding_constraint(\n"
+        "        jnp.concatenate([a, b]), NamedSharding(mesh, P('tp', None)))\n",
+        tier="runtime", select=("SH04",))
+    assert ok == []
+
+
+def test_AK01_pre_pr7_stop_width_shape_must_flag():
+    """Acceptance regression: the pre-PR-7 hardcoded-device_stop_width
+    AOT-key shape — a config field that shapes a device array through a
+    derived attribute but is absent from the serving_programs key — must
+    flag under AK01."""
+    bad = lint(AK01_PRE_PR7, tier="runtime", select=("AK01",))
+    assert rule_ids(bad) == ["AK01"]
+    assert "device_stop_width" in bad[0].message
+    assert "serving_programs" in bad[0].message
+
+
+def test_AK01_keyed_field_passes():
+    fixed = AK01_PRE_PR7.replace(
+        "def serving_programs(model, max_batch):",
+        "def serving_programs(model, max_batch, device_stop_width):")
+    assert fixed != AK01_PRE_PR7, "fixture drifted"
+    ok = lint(fixed, tier="runtime", select=("AK01",))
+    assert ok == []
+
+
+def test_AK01_affix_match_covers_derived_key_names():
+    # scheduler_spec_k covers key spec_k; prefix_page_size covers page_size
+    fixed = AK01_PRE_PR7.replace(
+        "    device_stop_width: int = 4",
+        "    scheduler_spec_k: int = 2").replace(
+        "max(1, config.device_stop_width)",
+        "max(1, config.scheduler_spec_k)")
+    ok = lint(
+        fixed.replace("def serving_programs(model, max_batch):",
+                      "def serving_programs(model, max_batch, spec_k):"),
+        tier="runtime", select=("AK01",))
+    assert ok == []
+
+
+def test_AK01_non_shape_field_not_required_in_key():
+    # a field the engine never reads into a shape or _build_programs does
+    # not need a key slot (log levels, host-side toggles...)
+    ok = lint(
+        "import jax.numpy as jnp\n"
+        "class EngineConfig:\n"
+        "    max_batch: int = 8\n"
+        "    log_level: str = 'info'\n"
+        "class Engine:\n"
+        "    def __init__(self, config):\n"
+        "        self.config = config\n"
+        "    def _build_programs(self):\n"
+        "        return jnp.zeros((self.config.max_batch,))\n"
+        "def serving_programs(model, max_batch):\n"
+        "    return (model, max_batch)\n",
+        tier="runtime", select=("AK01",))
+    assert ok == []
+
+
+def test_SHAK_waiver_round_trips():
+    """SH02 and AK01 suppress through the standard inline waiver."""
+    bad = lint(SH02_HELPER_UPLOAD, tier="runtime", select=("SH02",))
+    lines = SH02_HELPER_UPLOAD.splitlines()
+    for f in Engine(all_rules()).select(["SH02"]).run_source(
+            SH02_HELPER_UPLOAD, relpath="runtime/snippet.py", tier="runtime"):
+        lines[f.line - 1] += "  # fabric-lint: waive SH02 reason=fixture"
+    waived = Engine(all_rules()).select(["SH02"]).run_source(
+        "\n".join(lines), relpath="runtime/snippet.py", tier="runtime")
+    assert len(waived) == len(bad) and all(f.waived for f in waived)
+
+    lines = AK01_PRE_PR7.splitlines()
+    for f in Engine(all_rules()).select(["AK01"]).run_source(
+            AK01_PRE_PR7, relpath="runtime/snippet.py", tier="runtime"):
+        lines[f.line - 1] += "  # fabric-lint: waive AK01 reason=fixture"
+    waived = Engine(all_rules()).select(["AK01"]).run_source(
+        "\n".join(lines), relpath="runtime/snippet.py", tier="runtime")
+    assert waived and all(f.waived for f in waived)
+
+
+def test_SHAK_baseline_round_trips():
+    baseline = {("runtime/snippet.py", "SH02"): 1}
+    engine = Engine(all_rules(), baseline).select(["SH02"])
+    first = engine.run_source(SH02_HELPER_UPLOAD,
+                              relpath="runtime/snippet.py", tier="runtime")
+    second = engine.run_source(SH02_HELPER_UPLOAD,
+                               relpath="runtime/snippet.py", tier="runtime")
+    assert first and first[0].baselined
+    assert second and not second[0].baselined  # the budget is finite
+
+    baseline = {("runtime/snippet.py", "AK01"): 1}
+    findings = Engine(all_rules(), baseline).select(["AK01"]).run_source(
+        AK01_PRE_PR7, relpath="runtime/snippet.py", tier="runtime")
+    assert findings and findings[0].baselined
+
+
+def test_SHAK_sarif_round_trip():
+    findings = Engine(all_rules()).select(["AK01"]).run_source(
+        AK01_PRE_PR7, relpath="runtime/snippet.py", tier="runtime")
+    doc = json.loads(emit_sarif(findings, all_rules()))
+    run = doc["runs"][0]
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} >= {
+        "SH02", "SH03", "SH04", "AK01"}
+    assert run["results"][0]["ruleId"] == "AK01"
+
+
+def test_SHAK_repo_gate_clean():
+    """The tentpole acceptance: SH02–SH04 + AK01 run clean on the live
+    package (the two real AK01 gaps — use_flash, prefix_cache_pages — were
+    threaded into the AOT key in this PR; no waivers, no baseline)."""
+    engine = Engine(all_rules()).select(["SH02", "SH03", "SH04", "AK01"])
+    findings = [f for f in engine.run(PKG) if not f.suppressed]
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line} {f.rule} {f.message}" for f in findings)
+
+
 # ----------------------------------------------- RC family (fabric-race)
 
 #: the PR-8 pre-fix shape, distilled: _fail_all_inflight drains the pending
@@ -1471,6 +1922,107 @@ def test_lock_graph_cli_json_and_drift():
         "docs/lock_graph.json is stale — run `make lock-graph` and commit "
         "the regenerated hierarchy")
     assert regenerated["cycles"] == []
+
+
+# ----------------------------------------------------------- shard graph
+
+
+def test_shard_graph_dict_shape():
+    from cyberfabric_core_tpu.apps.fabric_lint.engine import (
+        FileContext, ProjectContext)
+    from cyberfabric_core_tpu.apps.fabric_lint.spmd_model import (
+        build_spmd_model, shard_graph_dict, shard_graph_dot)
+
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def build_mesh(devices):\n"
+        "    return Mesh(devices, ('dp', 'tp'))\n"
+        "class Engine:\n"
+        "    def __init__(self, devices):\n"
+        "        self.mesh = build_mesh(devices)\n"
+        "        self.page_table = np.zeros((8, 16))\n"
+        "        self._decode_fn = jax.jit(lambda x: x)\n"
+    )
+    ctx = FileContext(Path("runtime/snippet.py"), Path("."), source=src)
+    ctx.relpath, ctx.tier = "runtime/snippet.py", "runtime"
+    model = build_spmd_model(ProjectContext(Path("."), [ctx]))
+    graph = shard_graph_dict(model)
+    assert graph["axes"] == ["dp", "tp"]
+    # the build_mesh call site INHERITS the axes from the builder's body
+    builder_sites = [m for m in graph["meshes"] if m["ctor"] == "build_mesh"]
+    assert builder_sites and builder_sites[0]["axes"] == ["dp", "tp"]
+    assert {"path": "runtime/snippet.py", "class": "Engine"} in \
+        graph["mesh_classes"]
+    assert any(d["attr"] == "_decode_fn" for d in graph["dispatches"])
+    assert {"path": "runtime/snippet.py", "class": "Engine",
+            "attr": "page_table", "prov": "host"} in graph["provenance"]
+    dot = shard_graph_dot(model)
+    assert dot.startswith("digraph shard_world") and '"axis:tp"' in dot
+
+
+def test_shard_graph_refuses_partial_scan(tmp_path):
+    """A file that fails to parse must fail --shard-graph (exit 2) instead
+    of silently regenerating an axis universe missing that file's meshes."""
+    import io
+    from contextlib import redirect_stderr, redirect_stdout
+
+    from cyberfabric_core_tpu.apps.fabric_lint.__main__ import main
+
+    (tmp_path / "bad.py").write_text("def broken(:\n")
+    err = io.StringIO()
+    with redirect_stdout(io.StringIO()), redirect_stderr(err):
+        rc = main([str(tmp_path), "--shard-graph", "json"])
+    assert rc == 2 and "syntax error" in err.getvalue()
+
+
+def test_shard_graph_cli_json_and_drift():
+    """--shard-graph regenerates the committed artifact byte-for-byte (the
+    CI drift check) and exits 0 because the AOT key is complete."""
+    import io
+    from contextlib import redirect_stdout
+
+    from cyberfabric_core_tpu.apps.fabric_lint.__main__ import main
+
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = main([str(PKG), "--shard-graph", "json"])
+    assert rc == 0
+    regenerated = json.loads(out.getvalue())
+    committed = json.loads((REPO / "docs" / "shard_graph.json").read_text())
+    assert regenerated == committed, (
+        "docs/shard_graph.json is stale — run `make shard-graph` and commit "
+        "the regenerated SPMD world")
+    assert regenerated["aot_key"]["uncovered"] == []
+    assert "tp" in regenerated["axes"]
+    assert any(d["attr"] == "_decode_fn" for d in regenerated["dispatches"])
+
+
+def test_max_seconds_budget_exceeded(tmp_path):
+    """--max-seconds 0 forces the wall-clock guard to trip (exit 3)."""
+    import io
+    from contextlib import redirect_stderr, redirect_stdout
+
+    from cyberfabric_core_tpu.apps.fabric_lint.__main__ import main
+
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    err = io.StringIO()
+    with redirect_stdout(io.StringIO()), redirect_stderr(err):
+        rc = main([str(tmp_path), "--max-seconds", "0"])
+    assert rc == 3 and "wall-clock budget exceeded" in err.getvalue()
+
+
+def test_max_seconds_budget_met_keeps_exit_code(tmp_path):
+    import io
+    from contextlib import redirect_stdout
+
+    from cyberfabric_core_tpu.apps.fabric_lint.__main__ import main
+
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    with redirect_stdout(io.StringIO()):
+        rc = main([str(tmp_path), "--max-seconds", "600"])
+    assert rc == 0
 
 
 # ------------------------------------------------------- waivers + baseline
