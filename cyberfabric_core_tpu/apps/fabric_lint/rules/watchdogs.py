@@ -77,7 +77,12 @@ _CALLBACK_PREFIXES = ("evaluate", "_evaluate", "on_record", "ingest",
                       # token) and the fair queue's put/pop/charge (gateway
                       # threads + the admission pass)
                       "_service_tenant", "_charge", "put", "pop_fair",
-                      "remove_if", "charge")
+                      "remove_if", "charge",
+                      # PD handoff surface: on_handoff runs on the SOURCE
+                      # engine's scheduler thread and submit_handoff inside
+                      # it — a blocking call there stalls the prefill
+                      # replica's round loop mid-export
+                      "on_handoff", "submit_handoff")
 
 
 def _is_doctor_class(node: ast.ClassDef) -> bool:

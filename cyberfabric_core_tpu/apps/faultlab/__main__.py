@@ -26,9 +26,10 @@ def main(argv: list[str] | None = None) -> int:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
-            # the pool scenarios need >= 2 virtual devices
+            # the pool scenarios need >= 2 virtual devices; the PD-split
+            # scenario (2 prefill + 1 decode replicas) needs >= 3
             os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=2").strip()
+                flags + " --xla_force_host_platform_device_count=4").strip()
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -9,6 +9,9 @@ format). ``run_scenario`` dispatches on ``kind``:
   baseline computed once per (config, load) and cached.
 - ``pool``     — drives a DataParallelServingPool (2 replicas) through
   mid-stream replica death and failover-path faults.
+- ``pd_pool``  — drives a prefill/decode-disaggregated PDServingPool
+  through a mid-handoff prefill-replica crash; streams must match the
+  UNIFIED single-engine baseline.
 - ``http_retry`` — the layered HttpClient against a local mock server with
   per-attempt transport faults (retry triggers + budget).
 - ``db_commit``  — SqliteEngine with injected commit failures (atomicity).
@@ -869,6 +872,61 @@ def _run_pool_scenario(spec: dict) -> ScenarioResult:
                           ("failovers", "failovers_failed", "healthy")})
 
 
+def _run_pd_pool_scenario(spec: dict) -> ScenarioResult:
+    """pd_pool kind: a prefill/decode-disaggregated PDServingPool
+    (``prefill_replicas`` + ``decode_replicas``) driven through the same
+    load/fault machinery as the unified pool kind. The baseline is the
+    UNIFIED single-engine run: splitting prefill from decode — and crashing
+    a prefill replica mid-handoff — must not change a single token.
+    ``expect_stats`` names may be dotted (``pd.handoffs``)."""
+    import jax
+
+    from ...runtime.pd import PDServingPool
+
+    seed = int(spec.get("seed", 0))
+    n_prefill = int(spec.get("prefill_replicas", 2))
+    n_decode = int(spec.get("decode_replicas", 1))
+    n_replicas = n_prefill + n_decode
+    if len(jax.devices()) < n_replicas:
+        return ScenarioResult(
+            spec["name"], "pd_pool", seed, verdict=True,
+            invariants={"skipped": []}, fingerprint="skipped",
+            details={"skipped": f"needs {n_replicas} devices"})
+    cfg = _engine_config(spec)
+    load = _make_load(spec)
+    checkers = list(spec.get("invariants", ["exactly_one_terminal"]))
+    evidence: dict[str, Any] = {"expect_error": spec.get("expect_error", [])}
+    if "streams_match_baseline" in checkers:
+        evidence["baseline"] = _baseline_streams(spec, cfg, load)
+    fp.configure(seed)
+    pool = PDServingPool(cfg, n_prefill=n_prefill, n_decode=n_decode)
+    streams, pool, submit_errors = _drive_pool(
+        cfg, load, list(spec.get("faults", [])), n_replicas, pool=pool)
+    stats = pool.stats()
+    pool.shutdown()
+    evidence["streams"] = streams
+    evidence["pool"] = pool
+    invariants = run_checkers(checkers, evidence)
+    for name, expr in (spec.get("expect_stats") or {}).items():
+        lo, hi = expr
+        val: Any = stats
+        for part in name.split("."):
+            val = val.get(part, 0) if isinstance(val, dict) else 0
+        ok = (lo is None or val >= lo) and (hi is None or val <= hi)
+        invariants[f"stats:{name}"] = (
+            [] if ok else [f"{name}={val} outside [{lo}, {hi}]"])
+    if submit_errors:
+        invariants["submit_errors"] = [
+            f"unexpected submit rejections: {submit_errors}"]
+    deterministic_tokens = bool(spec.get("deterministic_tokens", True))
+    return _finish(spec["name"], "pd_pool", seed, invariants,
+                   _streams_payload(streams, tokens=deterministic_tokens),
+                   stats={"failovers": stats["failovers"],
+                          "healthy": stats["healthy"],
+                          "handoffs": stats["pd"]["handoffs"],
+                          "handoffs_failed": stats["pd"]["handoffs_failed"]})
+
+
 # ------------------------------------------------- replica lifecycle kinds
 
 def _pool_probe(pool, prompt: list[int], max_tokens: int,
@@ -1622,8 +1680,18 @@ def _run_worker_scenario(spec: dict) -> ScenarioResult:
                 finish = chunk.finish_reason
         entry = next(iter(worker._entries.values()))
         sched = entry.scheduler
-        clean = (len(sched._free_slots) == sched.n_slots
-                 and not sched._pending.qsize())
+        # the terminal chunk reaches this coroutine from the emit callback
+        # BEFORE the scheduler thread finishes the round's slot teardown,
+        # so a single instantaneous read races thread scheduling — poll
+        # briefly; a real leak stays leaked and still fails the invariant
+        clean = False
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            clean = (len(sched._free_slots) == sched.n_slots
+                     and not sched._pending.qsize())
+            if clean:
+                break
+            await asyncio.sleep(0.05)
         sched.shutdown()
         return crashed, finish, clean
 
@@ -1976,6 +2044,7 @@ _KINDS = {
     "noisy_neighbor": _run_noisy_neighbor_scenario,
     "selective_shed": _run_selective_shed_scenario,
     "pool": _run_pool_scenario,
+    "pd_pool": _run_pd_pool_scenario,
     "replica_crash_loop": _run_replica_crash_loop_scenario,
     "replica_drain": _run_replica_drain_scenario,
     "http_retry": _run_http_retry_scenario,

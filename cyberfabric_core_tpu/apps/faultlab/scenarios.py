@@ -289,6 +289,30 @@ BUILTIN_SCENARIOS: list[dict[str, Any]] = [
         "expect_stats": {"failovers_failed": [1, None]},
         "deterministic_tokens": False,
     },
+    # ---- prefill/decode disaggregation (runtime/pd.py) ----------------
+    {
+        # a prefill-role replica breaks mid-handoff (the armed
+        # scheduler.handoff raise fires at the KV export, right before the
+        # page copy): every stream it carried error-terminates into the
+        # pool's failover, RE-prefills prompt+emitted on the surviving
+        # prefill replica, and hands off to the decode replica for real —
+        # each stream bit-identical to the unified single-engine baseline,
+        # exactly one terminal, zero slot/page/tracking leaks on every
+        # live replica (the corpse is exempt; its pool died whole)
+        "name": "pd-handoff-crash",
+        "kind": "pd_pool",
+        "seed": 210,
+        "prefill_replicas": 2,
+        "decode_replicas": 1,
+        "engine": _TINY,
+        "load": {**_LOAD, "max_tokens": 12},
+        "faults": [{"point": "scheduler.handoff", "spec": "1*raise"}],
+        "invariants": ["exactly_one_terminal", "expected_errors",
+                       "streams_match_baseline", "pool_clean",
+                       "pool_engine_accounting"],
+        "expect_stats": {"failovers": [1, None], "healthy": [2, 2],
+                         "pd.handoffs": [1, None]},
+    },
     # ---- replica lifecycle (runtime/lifecycle.py) ---------------------
     {
         # the self-healing acceptance cycle, crash-loop leg: a mid-stream

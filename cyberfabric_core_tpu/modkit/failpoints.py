@@ -72,6 +72,11 @@ FAILPOINT_CATALOG: dict[str, tuple[str, str]] = {
     "scheduler.resume": (
         "runtime", "suspended-request resume; a raise error-terminates the "
         "engine mid-recovery"),
+    "scheduler.handoff": (
+        "runtime", "PD-disaggregation KV export on a prefill-role engine "
+        "(right before the page copy); a raise breaks the prefill replica "
+        "mid-handoff so the pool's failover must re-prefill the stream on "
+        "a survivor"),
     "replicas.submit": (
         "runtime", "serving-pool request routing; a raise rejects the "
         "request before any replica sees it"),

@@ -424,6 +424,46 @@ class LocalTpuWorker(LlmWorkerApi):
             # LifecycleConfig-shaped dict; default supervised.
             dp_replicas = int(opts.pop("dp_replicas", 1))
             lc_cfg = LifecycleConfig.from_config(opts.pop("lifecycle", True))
+            # prefill/decode disaggregation (docs/ARCHITECTURE.md
+            # "Prefill/decode disaggregation"): role-split replica groups
+            # with page-granularity KV handoff — prefill-role engines run
+            # only chunked prefill and hand each stream's KV to the
+            # decode-role group, so prefill storms never land in decode
+            # rounds. Both knobs must be set together (each role needs at
+            # least one replica to serve).
+            pd_prefill = int(opts.pop("pd_prefill_replicas", 0))
+            pd_decode = int(opts.pop("pd_decode_replicas", 0))
+            if (pd_prefill > 0) != (pd_decode > 0):
+                raise ValueError(
+                    f"engine_options for {model.canonical_id}: "
+                    f"pd_prefill_replicas={pd_prefill} and "
+                    f"pd_decode_replicas={pd_decode} must be set together "
+                    "(each PD role needs at least one replica)")
+            if pd_prefill > 0:
+                if dp_replicas > 1:
+                    raise ValueError(
+                        f"engine_options for {model.canonical_id}: the PD "
+                        f"split cannot combine with dp_replicas="
+                        f"{dp_replicas} (the PD pool IS the replica pool; "
+                        "size it with the pd_*_replicas knobs)")
+                if eng_cfg.tp > 1:
+                    raise ValueError(
+                        f"engine_options for {model.canonical_id}: the PD "
+                        f"split cannot combine with tp={eng_cfg.tp} (PD "
+                        "replicas pin one device each; tp'd PD groups are "
+                        "a future rung)")
+                from ...runtime.pd import PDServingPool
+
+                pool = PDServingPool(
+                    eng_cfg, n_prefill=pd_prefill, n_decode=pd_decode,
+                    params=params, lifecycle=lc_cfg)
+                logger.info(
+                    "PD pool ready for %s (%s, %d prefill + %d decode, "
+                    "slots=%d each, max_seq=%d)", model.canonical_id,
+                    arch_config, pd_prefill, pd_decode, eng_cfg.max_batch,
+                    eng_cfg.max_seq_len)
+                return _EngineEntry(config=eng_cfg, tokenizer=tokenizer,
+                                    pool=pool, model_family=chat_family)
             if dp_replicas > 1 and eng_cfg.tp > 1:
                 # one engine, one parallelism axis: a dp pool pins each
                 # replica to ONE device, which a tp mesh cannot share.

@@ -147,6 +147,9 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
         self.registry.counter(
             "llm_cache_aware_placements_total",
             "Requests routed by the prefix-cache affinity hint").inc(0.0)
+        self.registry.counter(
+            "llm_pd_handoffs_total",
+            "Streams handed prefill→decode across PD role groups").inc(0.0)
 
         # end-to-end cancellation: terminals by reason, the decode budget
         # reclaimed from dead clients, and the doctor's cancellation-rate
@@ -352,6 +355,36 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
             "llm_lookahead_discard_ratio",
             "Speculative decode chunks discarded as stale / dispatched (0..1)"
         ).set_function(lookahead_discard_ratio)
+
+        # per-round-kind dispatch time (PD disaggregation's measurement):
+        # pure-decode vs mixed vs prefill-only round dispatch percentiles,
+        # read straight off the scheduler round_timings ring (advisory
+        # snapshot; same entries stats()["pipeline"]["dispatch_ms_by_kind"]
+        # renders, so REST and Prometheus agree by construction). A decode-
+        # role engine must show ~zero mixed/prefill mass here — that IS the
+        # disaggregation claim, attributable per kind.
+        def round_dispatch_ms(kind: str, q: float):
+            def read() -> float:
+                samples: list[float] = []
+                for sched in _schedulers():
+                    for t in locked_snapshot(
+                            getattr(sched, "round_timings", ())):
+                        if t.get("kind", "decode") == kind:
+                            samples.append(t["dispatch_ms"])
+                if not samples:
+                    return 0.0
+                s = sorted(samples)
+                return float(s[min(len(s) - 1, int(q * len(s)))])
+            return read
+
+        g = self.registry.gauge(
+            "llm_round_dispatch_ms",
+            "Scheduler round dispatch time by round kind "
+            "(decode/mixed/prefill) and quantile")
+        for _kind in ("decode", "mixed", "prefill"):
+            for _q, _qname in ((0.50, "p50"), (0.99, "p99")):
+                g.set_function(round_dispatch_ms(_kind, _q),
+                               kind=_kind, quantile=_qname)
 
         # batched speculative decoding (k-token ragged verify in the
         # continuous scheduler): draft tokens proposed vs device-accepted

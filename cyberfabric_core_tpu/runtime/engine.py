@@ -244,6 +244,16 @@ class EngineConfig:
     #: meshes have no HBM to protect; the plan still lands in
     #: stats()["mesh"]).
     hbm_bytes_per_device: int = 0
+    #: prefill/decode disaggregation role. "" (default) = unified engine
+    #: serving both phases. "prefill" = this engine runs ONLY chunked
+    #: prefill (mixed-batch machinery with no decode rows) and exports each
+    #: request's committed KV pages + resume state to a handoff sink after
+    #: the first token; requires the paged pool + mixed batching. "decode" =
+    #: this engine admits handed-off streams in a handoff phase that skips
+    #: prefill entirely (deep ring + speculation intact); requires the paged
+    #: pool. Set by PDServingPool (runtime/pd.py) via
+    #: engine_options.pd_prefill_replicas / pd_decode_replicas.
+    pd_role: str = ""
 
     def resolve_lookahead_depth(self) -> int:
         """Lookahead ring depth as an int ≥ 0. Legacy bool configs parse as

@@ -427,6 +427,34 @@ class PrefixKVPool:
             jnp.asarray(host_kv[1], self.v_pool.dtype))
         return ids
 
+    # ------------------------------------------------------------ PD handoff
+    def export_pages(self, chain: list[int],
+                     prompt_ids: Optional[list[int]] = None
+                     ) -> tuple[np.ndarray, np.ndarray]:
+        """PD disaggregation export: copy a committed chain's pages to host
+        and release this pool's hold on them, transferring ownership of the
+        KV bytes to the caller. Tree-shared prefix pages stay cached on THIS
+        pool's radix (the prefill replica keeps serving warm prefixes);
+        private pages return to the allocator. ``prompt_ids`` releases any
+        radix pins the caller still holds from match_prefix. Host numpy is
+        the transfer format on purpose — it is sharding-agnostic, so pages
+        move between same-tp meshes (import re-shards under the destination
+        pool's NamedSharding)."""
+        host_kv = self.save_chain_to_host(chain)
+        if prompt_ids is not None:
+            self.release(prompt_ids)
+        self.release_slot(chain)
+        return host_kv
+
+    def import_pages(self, host_kv: tuple[np.ndarray, np.ndarray]) -> list[int]:
+        """PD disaggregation import: allocate pages in THIS pool and land an
+        exported chain's KV bytes in them (cast to this pool's dtype, placed
+        under this pool's sharding). Pages are private to the importing slot;
+        the radix structure is not reconstructed — the decode-role pool never
+        serves prefix matches, so nothing is lost. Raises MemoryError when
+        this pool cannot hold the chain even after eviction."""
+        return self.restore_chain_from_host(host_kv)
+
     def stats(self) -> dict[str, Any]:
         with self._tree_lock:
             tree_stats = self.tree.stats()

@@ -159,7 +159,8 @@ class DataParallelServingPool:
                 and not s.get("closed")]
 
     def _pick(self, prompt_ids: Optional[list[int]] = None,
-              exclude: tuple[int, ...] = ()) -> int:
+              exclude: tuple[int, ...] = (),
+              group: Optional[list[int]] = None) -> int:
         """Least-loaded admittable replica (active slots + pending queue) —
         unless another replica's prefix cache already holds this prompt's
         head (RTP-LLM's cache-aware routing recipe): route there while its
@@ -174,11 +175,22 @@ class DataParallelServingPool:
         replicas are capped at their canary budget — but a probation replica
         WITH budget gets a half-load head start, so an idle canary target
         wins idle ties and actually receives the traffic its promotion
-        requires (real load still outvotes the bonus)."""
+        requires (real load still outvotes the bonus).
+
+        ``group`` restricts the candidate set to a replica-index subset —
+        role-aware routing for PD-disaggregated pools (runtime/pd.py),
+        where a fresh request must land on a PREFILL-role replica and a
+        KV handoff on a DECODE-role one. The cache-affinity probe then
+        consults exactly that group's prefix caches, so a warm prefix
+        routes to the prefill replica actually holding it (the unified
+        pool's probe only ever saw its own unified replicas). None = all
+        replicas, the unified-pool behavior, byte-identical to pre-PD."""
         best, best_eff = None, None
         loads: dict[int, int] = {}
         lc = self.lifecycle
-        for i, r in enumerate(self.replicas):
+        candidates = range(len(self.replicas)) if group is None else group
+        for i in candidates:
+            r = self.replicas[i]
             if i in exclude:
                 continue
             s = r.stats()

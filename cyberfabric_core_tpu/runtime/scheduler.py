@@ -219,6 +219,12 @@ class _Suspended:
     #: wall-clock twin of suspended_at: the llm.preempt span emitted at
     #: resume is backdated to this (OTLP timestamps are unix-epoch ns)
     suspended_wall: float = field(default_factory=time.time)
+    #: PD disaggregation: True when this record is a cross-engine KV handoff
+    #: (prefill-role engine → decode-role engine) rather than a local
+    #: preemption. The decode branch of _resume_suspended admits it through
+    #: the same restore path but records a ``handoff_import`` event instead
+    #: of ``resumed`` and keeps it out of the preemption/recovery stats.
+    handoff: bool = False
 
 
 @dataclass
@@ -446,6 +452,29 @@ class ContinuousBatchingEngine:
         # single-device engine byte-identical to pre-tp builds (mesh is
         # None and no code path below changes).
         self.tp = max(1, int(config.tp))
+        # prefill/decode disaggregation role (runtime/pd.py): validated
+        # before any allocation so a mis-roled config dies typed at BUILD
+        # time. Prefill engines run only chunked prefill (mixed-batch
+        # machinery, no decode rows survive past the first token) and push
+        # each stream's KV + resume state to _handoff_sink; decode engines
+        # admit those records in a handoff phase that skips prefill.
+        self.pd_role = str(config.pd_role or "")
+        if self.pd_role not in ("", "prefill", "decode"):
+            raise ValueError(
+                f"pd_role must be '', 'prefill' or 'decode', got "
+                f"{config.pd_role!r}")
+        if self.pd_role and config.prefix_cache_pages <= 0:
+            raise ValueError(
+                f"pd_role={self.pd_role!r} requires the paged pool "
+                "(prefix_cache_pages > 0) — KV handoff moves pool pages")
+        if self.pd_role == "prefill" and not config.mixed_batch:
+            raise ValueError(
+                "pd_role='prefill' requires mixed_batch=True (prefill-role "
+                "engines run chunked prefill through the ragged dispatch)")
+        #: set by PDServingPool on prefill-role engines: called on the
+        #: scheduler thread with the _Suspended handoff record right after
+        #: the first token samples. Never set on unified/decode engines.
+        self._handoff_sink: Optional[Callable[["_Suspended"], None]] = None
         self.mesh = None
         self._replicated = None
         self._pool_sharding = None
@@ -1224,6 +1253,50 @@ class ContinuousBatchingEngine:
         self.start()
         return rid
 
+    def submit_handoff(self, rec: _Suspended) -> None:
+        """PD disaggregation: enqueue a handed-off stream (prefill already
+        done elsewhere, KV on host, first token emitted) for decode-side
+        admission. The record enters the suspended deque — the handoff
+        phase IS the resume path: _resume_suspended restores the pages,
+        patches the slot rows from the record's length/last-token/key, and
+        decode continues with zero prefill work on this engine. Suspended
+        outranks admission, so a handoff is never stuck behind this
+        engine's own queue. Runs on the SOURCE engine's scheduler thread
+        (via the pool's handoff sink): non-blocking bookkeeping only — a
+        deque append is GIL-atomic against this engine's popleft, and the
+        _submit_lock pairs the dead-engine check with _fail_all_inflight's
+        drain exactly like submit()."""
+        if self.pd_role == "prefill":
+            raise RuntimeError(
+                "handoff target must be a decode-role or unified engine")
+        if not self.paged:
+            raise RuntimeError("handoff needs the paged pool "
+                               "(prefix_cache_pages > 0)")
+        state = rec.state
+        # (re-)arm speculation under THIS engine's spec config — the
+        # prefill role runs with spec disabled, so the proposer arrives
+        # None; seed it with the full history (prompt + the one emitted
+        # token) so proposals match a unified engine's exactly
+        state.proposer = None
+        self._arm_spec(state, state.prompt_ids)
+        if state.proposer is not None:
+            state.proposer.extend([rec.last_token])
+        if not self.active_slots and not self._suspended \
+                and not self._prefill_slots and self._pending.qsize() == 0:
+            # idle→busy heartbeat refresh, same contract as submit()
+            self.last_round_at = time.monotonic()
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError(
+                    "scheduler is closed; build a fresh engine")
+            if self._broken:
+                raise RuntimeError(f"scheduler is broken: {self._broken}")
+            if state.deadline is not None:
+                self._has_deadlines = True
+            self._suspended.append(rec)
+        self._wake.set()
+        self.start()
+
     @property
     def active_slots(self) -> int:
         return int(self.active.sum())
@@ -1358,18 +1431,22 @@ class ContinuousBatchingEngine:
             return
         kept: list[_Suspended] = []
         victims: list[tuple[_Suspended, str, str]] = []
-        while self._suspended:
-            rec = self._suspended.popleft()
-            reason = cancels.pop(rec.state.request_id, None)
-            kind = "cancelled"
-            if reason is None and rec.state.deadline is not None \
-                    and now >= rec.state.deadline:
-                reason, kind = "deadline", "deadline_exceeded"
-            if reason is None:
-                kept.append(rec)
-            else:
-                victims.append((rec, reason, kind))
-        self._suspended.extend(kept)
+        # _suspended mutations take _submit_lock uniformly now that
+        # submit_handoff appends from OTHER engines' scheduler threads
+        # (emits stay outside the lock — see _fail_all_inflight)
+        with self._submit_lock:
+            while self._suspended:
+                rec = self._suspended.popleft()
+                reason = cancels.pop(rec.state.request_id, None)
+                kind = "cancelled"
+                if reason is None and rec.state.deadline is not None \
+                        and now >= rec.state.deadline:
+                    reason, kind = "deadline", "deadline_exceeded"
+                if reason is None:
+                    kept.append(rec)
+                else:
+                    victims.append((rec, reason, kind))
+            self._suspended.extend(kept)
         for rec, reason, kind in victims:
             self._cancel_finalize(
                 rec.state.request_id, rec.state.emit, reason, kind,
@@ -1712,6 +1789,25 @@ class ContinuousBatchingEngine:
         s = sorted(samples)
         return float(s[len(s) // 2])
 
+    @staticmethod
+    def _pq(samples: list, q: float) -> float:
+        """Nearest-rank percentile (q in [0,1]) over a small sample list."""
+        if not samples:
+            return 0.0
+        s = sorted(samples)
+        return float(s[min(len(s) - 1, int(q * len(s)))])
+
+    @staticmethod
+    def _dispatch_by_kind(timings: list) -> dict[str, list[float]]:
+        """Group round dispatch times by round kind (pure decode / mixed /
+        prefill-only). Entries recorded before the kind field existed count
+        as decode — the dominant kind in any steady-state window."""
+        out: dict[str, list[float]] = {}
+        for t in timings:
+            out.setdefault(t.get("kind", "decode"), []).append(
+                t["dispatch_ms"])
+        return out
+
     def stats(self) -> dict[str, Any]:
         # snapshot collections the scheduler thread resizes (advisory
         # metrics — locked_snapshot degrades to empty, never raises)
@@ -1723,6 +1819,7 @@ class ContinuousBatchingEngine:
         rb_waits = locked_snapshot(self.readback_wait_samples)
         la = dict(self._lookahead_stats)  # fixed key set: updates, no resize
         depth_hist = locked_snapshot(self._depth_hist)
+        per_kind = self._dispatch_by_kind(timings)
         pipeline = {
             "rounds": self.decode_rounds,
             "lookahead_rounds": self.lookahead_rounds,
@@ -1752,6 +1849,20 @@ class ContinuousBatchingEngine:
             "mixed_rounds": self.mixed_rounds,
             "prefill_chunks": self.prefill_chunks,
             "chunked_prefill_tokens": self.chunked_prefill_tokens,
+            # per-round-kind dispatch-time breakdown: pure-decode rounds vs
+            # mixed (decode + prefill chunks) vs prefill-only — the
+            # attribution the PD-disaggregation claim rests on (a unified
+            # pool's decode tail hides inside "mixed"/"prefill" here;
+            # a decode-role engine must show only "decode"). Exported as
+            # llm_round_dispatch_ms{kind,quantile}.
+            "dispatch_ms_by_kind": {
+                kind: {
+                    "p50": round(self._pq(per_kind.get(kind, ()), 0.50), 3),
+                    "p99": round(self._pq(per_kind.get(kind, ()), 0.99), 3),
+                    "count": len(per_kind.get(kind, ())),
+                }
+                for kind in ("decode", "mixed", "prefill")
+            },
         }
         accept_hist = locked_snapshot(self._spec_accept_hist)
         spec = dict(self.spec_stats)
@@ -1879,8 +1990,17 @@ class ContinuousBatchingEngine:
                 self.slots[slot] = None
         self.active[:] = False
         self._prefill_slots.clear()
-        while self._suspended:  # preempted requests fail too
-            rec = self._suspended.popleft()
+        # preempted AND handed-off requests fail too. The POP runs under the
+        # submit lock, paired with submit_handoff()'s locked append: a
+        # racing handoff either lands before this drain (error terminal
+        # below) or sees _closed/_broken under the same lock and raises —
+        # a handed-off stream can never be stranded on a dead deque. Emits
+        # run after the lock for the same ABBA reason as the queued drain.
+        stranded_recs: list[_Suspended] = []
+        with self._submit_lock:
+            while self._suspended:
+                stranded_recs.append(self._suspended.popleft())
+        for rec in stranded_recs:
             record_event(rec.state.request_id, "error",
                          detail=f"{why} while suspended")
             try:
@@ -2020,14 +2140,19 @@ class ContinuousBatchingEngine:
                 # drops to the cap the stream resumes even under
                 # contention (a yielded stream's stall is bounded by its
                 # tenant's overshoot, never by another tenant's backlog).
-                deferred.append(self._suspended.popleft())
+                with self._submit_lock:
+                    deferred.append(self._suspended.popleft())
                 continue
             # armed raise here error-terminates the engine mid-recovery (the
             # faultlab resume-crash scenario asserts every client still gets
             # exactly one terminal event)
             failpoint("scheduler.resume")
             try:
-                chain = self.pool.restore_chain_from_host(rec.host_kv)
+                # PD handoff records land through the import half of the
+                # export/import pair (same restore machinery: fresh private
+                # pages, cast + re-sharded under THIS pool's sharding)
+                chain = (self.pool.import_pages(rec.host_kv) if rec.handoff
+                         else self.pool.restore_chain_from_host(rec.host_kv))
                 try:
                     self.pool.extend_chain(chain, rec.length + self._k_steps)
                 except MemoryError:
@@ -2046,7 +2171,8 @@ class ContinuousBatchingEngine:
                 pages_needed = self.pool.pages_for(rec.length + self._k_steps)
                 if (pages_needed > self.pool.capacity_pages
                         or not self.active.any()):
-                    self._suspended.popleft()
+                    with self._submit_lock:
+                        self._suspended.popleft()
                     reason = (
                         f"needs {pages_needed} pages > pool capacity "
                         f"{self.pool.capacity_pages}"
@@ -2062,7 +2188,8 @@ class ContinuousBatchingEngine:
                     self.requests_completed += 1
                     continue
                 break  # still no room; stay suspended
-            self._suspended.popleft()
+            with self._submit_lock:
+                self._suspended.popleft()
             slot = self._take_free_slot()
             assert slot is not None  # guarded by the _free_slots check above
             state = rec.state
@@ -2099,12 +2226,22 @@ class ContinuousBatchingEngine:
             self._epoch += 1
             resumed += 1
             pause_s = time.monotonic() - rec.suspended_at
-            self.resume_latency_samples.append(pause_s)
-            record_recovery("scheduler.resume", pause_s)
-            record_event(state.request_id, "resumed", slot=slot,
-                         phase=state.phase,
-                         pause_ms=round(pause_s * 1000.0, 3))
-            if state.trace_sampled:
+            if rec.handoff:
+                # cross-engine PD handoff, not a recovery: it gets its own
+                # flight-recorder verb (one request id, export on the
+                # prefill engine + import here) and stays out of the
+                # preemption/recovery latency stats — those measure pool
+                # pressure, and a handoff pause is routing, not pressure.
+                record_event(state.request_id, "handoff_import", slot=slot,
+                             length=rec.length, pages=len(chain),
+                             pause_ms=round(pause_s * 1000.0, 3))
+            else:
+                self.resume_latency_samples.append(pause_s)
+                record_recovery("scheduler.resume", pause_s)
+                record_event(state.request_id, "resumed", slot=slot,
+                             phase=state.phase,
+                             pause_ms=round(pause_s * 1000.0, 3))
+            if state.trace_sampled and not rec.handoff:
                 # the pause a client stream actually experienced, as a span
                 # in the request's trace (backdated to the preemption)
                 get_global_tracer().emit_span(
@@ -2115,12 +2252,14 @@ class ContinuousBatchingEngine:
             token = set_log_context(state.request_id,
                                     traceparent_ids(state.trace)[0])
             try:
-                logger.info("resumed %s into slot %d (len=%d, paused %.3fs)",
+                logger.info("%s %s into slot %d (len=%d, paused %.3fs)",
+                            "imported" if rec.handoff else "resumed",
                             state.request_id, slot, rec.length, pause_s)
             finally:
                 reset_log_context(token)
-        for rec in reversed(deferred):  # restore FIFO head order
-            self._suspended.appendleft(rec)
+        with self._submit_lock:
+            for rec in reversed(deferred):  # restore FIFO head order
+                self._suspended.appendleft(rec)
         return resumed
 
     def _other_tenant_pending(self, tenant: str) -> bool:
@@ -2835,14 +2974,15 @@ class ContinuousBatchingEngine:
         record_event(state.request_id, "preempted", slot=slot,
                      phase=state.phase, length=length)
         host_kv = self.pool.save_chain_to_host(chain)
-        self._suspended.append(_Suspended(
-            state=state, host_kv=host_kv,
-            length=length,
-            last_token=0 if is_prefill
-            else int(np.asarray(self._last_tokens)[slot]),
-            slot_key=None if is_prefill
-            else np.asarray(self._slot_keys[slot]),
-            soft_yielded=soft_yielded))
+        with self._submit_lock:
+            self._suspended.append(_Suspended(
+                state=state, host_kv=host_kv,
+                length=length,
+                last_token=0 if is_prefill
+                else int(np.asarray(self._last_tokens)[slot]),
+                slot_key=None if is_prefill
+                else np.asarray(self._slot_keys[slot]),
+                soft_yielded=soft_yielded))
         self.preemptions += 1
         if is_prefill:
             self._prefill_slots.remove(slot)
@@ -2966,7 +3106,8 @@ class ContinuousBatchingEngine:
                       mixed: bool = False,
                       chunk_tokens: int = 0,
                       depth: int = 0,
-                      spec_tokens: int = 0) -> None:
+                      spec_tokens: int = 0,
+                      kind: str = "decode") -> None:
         """One timing-schema owner for both decode modes — the stats()
         percentile keys cannot drift between paged and dense. ``ts`` is the
         round's wall-clock start; /v1/monitoring/rounds exports these entries
@@ -2985,6 +3126,11 @@ class ContinuousBatchingEngine:
             "host_emit_ms": round(host_emit_ms, 3),
             "lookahead": lookahead,
             "mixed": mixed,
+            # round kind for the dispatch-time attribution: "decode" (pure
+            # decode rows), "mixed" (decode + prefill chunks in one ragged
+            # dispatch), "prefill" (only prefill chunks — the prefill-role
+            # engine's steady state, and the unified pool's storm rounds)
+            "kind": kind,
             "chunk_tokens": chunk_tokens,
             "depth": depth,
             "spec_tokens": spec_tokens,
@@ -3101,6 +3247,57 @@ class ContinuousBatchingEngine:
                 chunks=state.prefill_chunks, tenant=state.tenant)
         no_room = T + self._k_steps > self.config.max_seq_len
         self._emit_token(slot, tok, force_length=no_room)
+        # PD disaggregation: a prefill-role engine's job ends at the first
+        # token. If the emit above finished the stream (stop/length on
+        # token one), slots[slot] is already None and there is nothing to
+        # hand off — the guard keys on slot survival, not on phase.
+        if self.pd_role == "prefill" and self._handoff_sink is not None \
+                and self.slots[slot] is state:
+            self._export_handoff(slot, state, tok)
+
+    def _export_handoff(self, slot: int, state: _SlotState, tok: int) -> None:
+        """Export a just-prefilled stream off this engine (prefill role):
+        copy its committed chain to host, free the slot, and push the
+        resume record at the pool's handoff sink, which enqueues it on a
+        decode-role engine. Runs on the scheduler thread right after the
+        first token emitted. Failure atomicity: a raise here (the armed
+        ``scheduler.handoff`` failpoint, or a real export fault) propagates
+        to the loop → the engine breaks → _fail_all_inflight error-
+        terminates the stream → the replica pool's failover re-prefills
+        prompt+emitted on a survivor, so the client stream stays
+        bit-identical (greedy) and nothing leaks (the broken engine's pool
+        dies whole)."""
+        # armed raise = faultlab pd-handoff-crash: prefill replica dies
+        # mid-handoff, the stream must fail over and re-prefill elsewhere
+        failpoint("scheduler.handoff")
+        T = int(self.lengths[slot])
+        chain = state.chain
+        n_pages = len(chain)
+        # export releases this engine's hold on the chain: tree-shared
+        # prefix pages stay cached in the prefill radix (the warm-prefix
+        # short-circuit for later requests), private pages free. The radix
+        # pins from this request's match_prefix were already consumed by
+        # admission, so no prompt_ids release is needed here.
+        host_kv = self.pool.export_pages(chain)
+        state.chain = None
+        # the post-first-sample key stream (committed at the mixed-round
+        # drain) — the decode engine continues sampling from exactly here,
+        # which is what makes seeded streams bit-identical across the split
+        slot_key = np.asarray(self._slot_keys[slot])
+        rec = _Suspended(state=state, host_kv=host_kv, length=T,
+                         last_token=tok, slot_key=slot_key, handoff=True)
+        # free the slot with the preempt teardown idiom — the chain is
+        # already released above, so no pool.release_slot here
+        self.active[slot] = False
+        self.slots[slot] = None
+        self._release_free_slot(slot)
+        self._deactivate_slot_device(slot)
+        self._epoch += 1
+        self.page_table[slot, :] = 0
+        self._mark_pt_row(slot)
+        record_event(state.request_id, "handoff_export", slot=slot,
+                     length=T, pages=n_pages, tokens_emitted=state.emitted)
+        self._handoff_sink(rec)
 
     # ------------------------------------------------------------ speculation
     def _spec_candidates(self) -> bool:
@@ -3493,7 +3690,9 @@ class ContinuousBatchingEngine:
                            chunk_tokens=sum(c for _, _, c in plan),
                            depth=spanned,
                            spec_tokens=sum(len(dr)
-                                           for _, _, dr in spec_plan))
+                                           for _, _, dr in spec_plan),
+                           kind=("mixed" if decode_rows else "prefill")
+                           if plan else "decode")
         return True
 
     def _decode_round(self) -> None:
