@@ -5,9 +5,9 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint lock-graph lock-graph-check shard-graph shard-graph-check modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench pd-bench lifecycle-guard cancel-guard fairness-guard
+.PHONY: safety lint lock-graph lock-graph-check shard-graph shard-graph-check modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench pd-bench fed-bench lifecycle-guard cancel-guard fairness-guard
 
-safety: lint lock-graph-check shard-graph-check modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench pd-bench lifecycle-guard cancel-guard fairness-guard  ## the full local gate
+safety: lint lock-graph-check shard-graph-check modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench pd-bench fed-bench lifecycle-guard cancel-guard fairness-guard  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
 #: wall-clock budget for the whole-repo analyzer run (all three passes) —
@@ -94,6 +94,10 @@ tp-bench:  ## tensor-parallel engine tests (tp=8 streams bit-identical to tp=1) 
 pd-bench:  ## prefill/decode disaggregation tests (PD-split streams bit-identical to unified) + the unified-vs-split cold-storm A/B on forced host devices (BENCH_PD.json: per-arm decode itl_p99 + ttft, role purity)
 	$(PY) -m pytest tests/test_pd_disaggregation.py -q
 	$(PY) bench.py --pd-bench > /dev/null
+
+fed-bench:  ## federation tests (registry/routing/failover + multi-process e2e) + the in-process-vs-2-loopback-workers cold-storm A/B (BENCH_FED.json: tokens/sec + honest gRPC overhead notes)
+	$(PY) -m pytest tests/test_federation.py tests/test_federation_e2e.py -q
+	$(PY) bench.py --fed-bench > /dev/null
 
 lifecycle-guard:  ## replica lifecycle tests + the disarmed-supervisor overhead A/B (BENCH_LIFECYCLE.json, <1% bar)
 	$(PY) -m pytest tests/test_lifecycle.py tests/test_replicas.py -q

@@ -1417,6 +1417,223 @@ def pd_bench() -> int:
     return 0 if report["pass"] else 1
 
 
+def fed_bench() -> int:
+    """Cross-host federation A/B (BENCH_FED.json): the same cache-cold
+    8-stream storm (distinct prompts, tiny-llama, greedy) driven through
+    one in-process LocalTpuWorker vs a FederatedServingPool routing over
+    TWO real worker subprocesses on loopback gRPC. Interleaved ABBA
+    ordering; per-arm best (highest) tokens/sec run reported, with the
+    federated arm's per-host placement split alongside.
+
+    What the CPU A/B measures: every federated token crosses a JSON-gRPC
+    loopback hop (serialize, TCP round-trip, deserialize) and the two
+    worker processes share the driver's CPU cores, so the tokens/sec
+    delta here is the WORST-case picture of the wire tax — on real
+    multi-host fabric the workers bring their own chips and the overhead
+    shrinks to NIC latency amortized across decode steps. What this
+    harness CAN prove: the storm completes through the wire path with
+    zero errors, the router spreads cache-cold load across BOTH hosts,
+    and every stream gets exactly one terminal. Prefix-affinity routing
+    and crash failover are pinned by tests/test_federation*.py and the
+    worker-host-crash faultlab scenario, not re-measured here."""
+    import asyncio
+
+    reps = int(os.environ.get("BENCH_FED_REPS", "2"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from cyberfabric_core_tpu.modkit.flight_recorder import default_recorder
+    from cyberfabric_core_tpu.modkit.transport_grpc import JsonGrpcServer
+    from cyberfabric_core_tpu.modules.grpc_hub import \
+        register_worker_registry_service
+    from cyberfabric_core_tpu.modules.llm_gateway.grpc_service import (
+        GrpcLlmWorkerClient, model_ref_dict)
+    from cyberfabric_core_tpu.modules.llm_gateway.worker import LocalTpuWorker
+    from cyberfabric_core_tpu.modules.sdk import ChatStreamChunk, ModelInfo
+    from cyberfabric_core_tpu.runtime.federation import (
+        FederatedServingPool, FederationConfig, WorkerRegistry)
+
+    model = ModelInfo(
+        canonical_id="local::fed-bench-tiny", provider_slug="local",
+        provider_model_id="fed-bench-tiny", managed=True,
+        architecture="llama",
+        engine_options={"model_config": "tiny-llama", "max_seq_len": 256,
+                        "max_batch": 8, "decode_chunk": 8})
+    n_streams, max_tokens = 8, 32
+    # distinct prompts = cache-cold: no radix hit, no prefix hint — the
+    # router falls back to least-loaded, which is the spread being measured
+    prompts = [f"federated storm stream {i:02d} distinct cold payload " * 3
+               for i in range(n_streams)]
+
+    def pct(vals: list, q: float) -> Optional[float]:
+        if not vals:
+            return None
+        s = sorted(vals)
+        return round(s[min(len(s) - 1, int(q * len(s)))], 2)
+
+    async def storm(stream_fn) -> dict:
+        stats = {"tokens": 0, "ttfts": [], "itls": [],
+                 "errors": 0, "finished": 0}
+
+        async def one(i: int, prompt: str) -> None:
+            t_submit = last = time.perf_counter()
+            first = None
+            chunks = usage_tokens = 0
+            try:
+                async for chunk in stream_fn(
+                        model, prompt, {"max_tokens": max_tokens,
+                                        "_request_id": f"fed-bench-{i}"}):
+                    now = time.perf_counter()
+                    if chunk.text:
+                        if first is None:
+                            first = now - t_submit
+                        else:
+                            stats["itls"].append((now - last) * 1e3)
+                        last = now
+                        chunks += 1
+                    if chunk.finish_reason:
+                        stats["finished"] += 1
+                        usage_tokens = (chunk.usage or {}).get(
+                            "output_tokens", 0)
+            except Exception as e:  # noqa: BLE001
+                log(f"fed-bench stream {i} failed: {e}")
+                stats["errors"] += 1
+            stats["tokens"] += usage_tokens or chunks
+            if first is not None:
+                stats["ttfts"].append(first * 1e3)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one(i, p) for i, p in enumerate(prompts)))
+        wall = time.perf_counter() - t0
+        return {"tokens_per_sec": round(stats["tokens"] / max(wall, 1e-9), 1),
+                "wall_s": round(wall, 2),
+                "ttft_p50_ms": pct(stats["ttfts"], 0.50),
+                "itl_p50_ms": pct(stats["itls"], 0.50),
+                "itl_p99_ms": pct(stats["itls"], 0.99),
+                "complete": stats["finished"] == n_streams,
+                "errors": stats["errors"]}
+
+    async def run_inproc() -> dict:
+        worker = LocalTpuWorker({})
+        try:
+            # warm: compile is paid before the measured storm in BOTH arms
+            async for _ in worker.completion_stream(
+                    model, prompts[0], {"max_tokens": 2}):
+                pass
+            return await storm(worker.completion_stream)
+        finally:
+            for entry in worker._entries.values():
+                entry.scheduler.shutdown()
+
+    async def run_fed() -> dict:
+        default_recorder.reset()
+        registry = WorkerRegistry(lease_ttl_s=10.0)
+        server = JsonGrpcServer()
+        register_worker_registry_service(server, registry)
+        port = await server.start("127.0.0.1:0")
+        procs: list[subprocess.Popen] = []
+        pool = FederatedServingPool(
+            registry, lambda w: GrpcLlmWorkerClient(endpoint=w.endpoint),
+            ChatStreamChunk, FederationConfig(seed=0))
+        loop = asyncio.get_running_loop()
+        try:
+            for i in range(2):
+                cfg = json.dumps({
+                    "hub_endpoint": f"127.0.0.1:{port}",
+                    "host": f"bench-worker-{i}", "worker": {},
+                    "models": [model_ref_dict(model)],
+                    "heartbeat_interval_s": 0.5})
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m",
+                     "cyberfabric_core_tpu.modules.llm_gateway.worker"],
+                    env={**os.environ, "JAX_PLATFORMS": "cpu",
+                         "FED_WORKER_CONFIG": cfg},
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True))
+            # boot + per-worker model preload happens before the clock
+            for p in procs:
+                line = await asyncio.wait_for(
+                    loop.run_in_executor(None, p.stdout.readline), 240.0)
+                if not line:
+                    raise RuntimeError("fed-bench worker died before READY "
+                                       f"(rc={p.poll()})")
+            async for _ in pool.completion_stream(
+                    model, prompts[0], {"max_tokens": 2,
+                                        "_request_id": "fed-bench-warm"}):
+                pass
+            row = await storm(pool.completion_stream)
+            row["placements"] = dict(pool.placements)
+            hosts = {(default_recorder.lookup(f"fed-bench-{i}") or {})
+                     .get("worker_host") for i in range(n_streams)}
+            row["hosts_served"] = sorted(h for h in hosts if h)
+            return row
+        finally:
+            await pool.close()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=30)
+                if p.stdout is not None:
+                    p.stdout.close()
+            await server.stop()
+
+    arms: dict[str, list[dict]] = {"inproc": [], "federated": []}
+    order = (["inproc", "federated", "federated", "inproc"]
+             * ((reps + 1) // 2))[: 2 * reps]
+    for arm in order:
+        try:
+            row = asyncio.run(run_fed() if arm == "federated"
+                              else run_inproc())
+        except Exception as e:  # noqa: BLE001
+            log(f"fed-bench {arm} run failed: {e}")
+            continue
+        arms[arm].append(row)
+
+    def best(rows: list[dict]) -> Optional[dict]:
+        return max(rows, key=lambda r: r.get("tokens_per_sec") or 0.0) \
+            if rows else None
+
+    bi, bf = best(arms["inproc"]), best(arms["federated"])
+    report: dict = {
+        "kind": "federated_grpc_ab_cpu_evidence",
+        "note": "cache-cold 8-stream storm through one in-process worker "
+                "vs FederatedServingPool over 2 loopback worker "
+                "subprocesses; interleaved ABBA runs, per-arm best "
+                "(highest) tokens/sec run reported",
+        "runs": arms, "inproc": bi, "federated": bf,
+    }
+    if bi and bf:
+        both_hosts = len(bf.get("hosts_served") or []) == 2
+        report.update({
+            "grpc_overhead_pct": round(
+                (1.0 - bf["tokens_per_sec"]
+                 / max(bi["tokens_per_sec"], 1e-9)) * 100.0, 1),
+            "ttft_p50_delta_pct": round(
+                (bf["ttft_p50_ms"] / max(bi["ttft_p50_ms"], 1e-9) - 1.0)
+                * 100.0, 1) if bf.get("ttft_p50_ms") and bi.get("ttft_p50_ms")
+            else None,
+            "both_hosts_served": both_hosts,
+            "cpu_note": (
+                "loopback JSON-gRPC with both worker processes sharing the "
+                "driver's CPU cores: every token pays serialize + TCP + "
+                "deserialize AND the hosts contend for the same cores, so "
+                "the overhead column is the worst case — on real fabric "
+                "the workers bring their own chips and the wire tax "
+                "amortizes across decode steps; only the structural "
+                "claims (storm completes over the wire, both hosts serve, "
+                "one terminal per stream) transfer directly"),
+            "pass": bool(bi.get("complete") and bf.get("complete")
+                         and bi.get("errors") == 0 and bf.get("errors") == 0
+                         and both_hosts),
+        })
+    else:
+        report["pass"] = False
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_FED.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return 0 if report["pass"] else 1
+
+
 def aggregate(model_name: str, quant: str) -> int:
     """8 concurrent streams through the continuous scheduler (paged KV pool +
     ragged paged decode attention), with STAGGERED arrivals — the pattern the
@@ -2073,6 +2290,8 @@ if __name__ == "__main__":
         sys.exit(tp_bench())
     if len(sys.argv) > 1 and sys.argv[1] == "--pd-bench":
         sys.exit(pd_bench())
+    if len(sys.argv) > 1 and sys.argv[1] == "--fed-bench":
+        sys.exit(fed_bench())
     if len(sys.argv) > 1 and sys.argv[1] == "--embed":
         sys.exit(embed_bench())
     if len(sys.argv) > 3 and sys.argv[1] == "--cost":

@@ -38,6 +38,14 @@ work or a sleep), and the per-round cancel/expiry sweep
 between rounds — a blocking call there stalls every live stream, and a
 raising emit would turn a dead client's cleanup into an engine crash.
 
+The federation worker plane (``heartbeat`` / ``route`` /
+``on_lease_expired`` methods of classes named ``*Registry*`` /
+``*Federated*``) is a second, separate marker × prefix group: heartbeat
+renews every worker's lease on the hub's service path, route places every
+request on the gateway's submit path, and on_lease_expired fans departures
+out from the hub's evict tick — a sleep or raising emit in any of them
+takes down lease renewal, placement, or eviction for the whole fleet.
+
 The tenant fairness/quota surface holds the same contract: the round-
 boundary cap sweep (``_service_tenant_caps``) and the per-token charge path
 (``_charge_tenant``) run between/inside decode rounds (bookkeeping only —
@@ -84,8 +92,28 @@ _CALLBACK_PREFIXES = ("evaluate", "_evaluate", "on_record", "ingest",
                       # replica's round loop mid-export
                       "on_handoff", "submit_handoff")
 
+#: federation worker-plane surface, a SECOND marker × prefix product kept
+#: separate so it stays exact: heartbeat() sits on the hub's gRPC service
+#: path (a worker lease renewal per interval per host), route() on the
+#: gateway's per-request submit path, and on_lease_expired() inside the
+#: hub's evict tick — a blocking call or raising emit in any of them stalls
+#: lease renewal / placement / eviction fleet-wide. Joining these prefixes
+#: to the doctor group would false-flag e.g. MetricsRegistry.put or
+#: *Doctor*.heartbeat; joining the markers would drag every Registry
+#: method under the doctor prefixes.
+_FED_MARKERS = ("Registry", "Federated")
+_FED_PREFIXES = ("heartbeat", "route", "on_lease_expired")
 
-def _is_doctor_class(node: ast.ClassDef) -> bool:
+_DOCTOR_MARKERS = ("Doctor", "Watchdog", "Supervisor", "Lifecycle",
+                   "Engine", "ServingPool", "FairQueue")
+
+#: each group is (class-name markers, callback-name prefixes); a class is
+#: checked under the union of prefixes of every group whose marker matches
+_GROUPS = ((_DOCTOR_MARKERS, _CALLBACK_PREFIXES),
+           (_FED_MARKERS, _FED_PREFIXES))
+
+
+def _class_prefixes(node: ast.ClassDef) -> tuple[str, ...]:
     # Engine/ServingPool joined for the cancellation callbacks: their other
     # methods legitimately block on device work, but nothing named
     # cancel*/tick*/evaluate* etc. does — the prefix × marker product
@@ -93,14 +121,22 @@ def _is_doctor_class(node: ast.ClassDef) -> bool:
     # put/pop_fair/charge run on gateway submit threads and inside the
     # scheduler's admission/emit hot paths — a sleep or raising emit there
     # stalls serving itself, exactly the supervisor-tick failure mode.
-    return any(marker in node.name for marker in
-               ("Doctor", "Watchdog", "Supervisor", "Lifecycle",
-                "Engine", "ServingPool", "FairQueue"))
+    # FederatedServingPool matches BOTH groups (ServingPool + Federated):
+    # its cancel* and route/heartbeat surfaces are each covered.
+    prefixes: tuple[str, ...] = ()
+    for markers, group_prefixes in _GROUPS:
+        if group_prefixes is _FED_PREFIXES and "Client" in node.name:
+            # a *RegistryClient* is the worker-side WIRE caller — awaiting
+            # the hub is its whole job, not a lease-path stall
+            continue
+        if any(marker in node.name for marker in markers):
+            prefixes += group_prefixes
+    return prefixes
 
 
-def _is_callback(fn: ast.AST) -> bool:
+def _is_callback(fn: ast.AST, prefixes: tuple[str, ...]) -> bool:
     return isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
-        fn.name.startswith(_CALLBACK_PREFIXES)
+        fn.name.startswith(prefixes)
 
 
 @register
@@ -114,10 +150,13 @@ class WD01(Rule):
 
     def check_file(self, ctx: FileContext) -> Iterable[Finding]:
         for cls in ast.walk(ctx.tree):
-            if not isinstance(cls, ast.ClassDef) or not _is_doctor_class(cls):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            prefixes = _class_prefixes(cls)
+            if not prefixes:
                 continue
             for fn in cls.body:
-                if not _is_callback(fn):
+                if not _is_callback(fn, prefixes):
                     continue
                 yield from self._check_callback(ctx, fn)
 

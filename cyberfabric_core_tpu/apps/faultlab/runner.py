@@ -22,6 +22,9 @@ format). ``run_scenario`` dispatches on ``kind``:
 - ``serverless`` — gateway + serverless stack: retry/backoff, dead-letter,
   scheduler-loop tick resilience.
 - ``worker``   — LocalTpuWorker job crash at the stream boundary.
+- ``worker_host_crash`` — two REAL worker subprocesses behind a
+  FederatedServingPool; SIGKILL mid-stream → failover, prefix-affinity
+  routing, and lease-window eviction.
 - ``grpc_evict`` — grpc-hub eviction tick resilience.
 
 Determinism: every scenario seeds modkit.failpoints (probability decisions),
@@ -2035,6 +2038,269 @@ def _run_grpc_evict_scenario(spec: dict) -> ScenarioResult:
                    {"raised": raised})
 
 
+# ----------------------------------------- federation: worker_host_crash kind
+
+def _run_worker_host_crash_scenario(spec: dict) -> ScenarioResult:
+    """Cross-host federation under a real host death: two REAL worker
+    subprocesses (serve-mode ``python -m ...llm_gateway.worker``) announce
+    to an in-process WorkerRegistry over loopback gRPC, a
+    FederatedServingPool routes to them, and one host is SIGKILLed
+    mid-stream. Proves, end to end across process boundaries:
+
+    - an armed ``federation.route`` failpoint rejects the request as a
+      typed 503 (replica_unavailable) before any host is dialed;
+    - repeated-prefix requests land on the host already holding the prefix
+      (gossiped digest chains → routing reason ``prefix``);
+    - the SIGKILLed stream fails over to the survivor and the delivered
+      text is BIT-IDENTICAL to an in-process single-worker baseline, with
+      exactly one terminal;
+    - the corpse leaves the registry within one lease window (the crash
+      report evicts immediately; the lease sweep is the backstop), so lost
+      host = lost capacity is visible to the doctor.
+
+    The fingerprint hashes only the delivered texts + terminal reasons —
+    hosts, pids, and timing stay out of it (seed-stable across repeats).
+    """
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    from ...modkit.errors import ProblemError
+    from ...modkit.flight_recorder import default_recorder
+    from ...modkit.transport_grpc import JsonGrpcServer
+    from ...modules.grpc_hub import register_worker_registry_service
+    from ...modules.llm_gateway.grpc_service import (GrpcLlmWorkerClient,
+                                                     model_ref_dict)
+    from ...modules.llm_gateway.worker import LocalTpuWorker
+    from ...modules.sdk import ChatStreamChunk, ModelInfo
+    from ...runtime.federation import (FederatedServingPool, FederationConfig,
+                                       WorkerRegistry, digest_chain)
+
+    seed = int(spec.get("seed", 0))
+    lease_ttl_s = float(spec.get("lease_ttl_s", 2.0))
+    max_tokens = int((spec.get("load") or {}).get("max_tokens", 16))
+    model = ModelInfo(
+        canonical_id="local::faultlab-tiny", provider_slug="local",
+        provider_model_id="faultlab-tiny", managed=True, architecture="llama",
+        engine_options={"model_config": "tiny-llama", "max_seq_len": 192,
+                        "max_batch": 2, "decode_chunk": 4})
+    model_key = model.canonical_id
+    # each prompt must span >= 2 digest blocks (48 chars) so the gossiped
+    # chain carries a usable prefix hint
+    prompt_a = f"federated prefix probe seed {seed} " * 4
+    prompt_b = f"federated crash victim seed {seed} " * 4
+    faults = list(spec.get("faults", []))
+
+    async def baseline(prompt: str) -> tuple[str, Optional[str]]:
+        worker = LocalTpuWorker({})
+        text, finish = [], None
+        try:
+            async for chunk in worker.completion_stream(
+                    model, prompt, {"max_tokens": max_tokens}):
+                text.append(chunk.text or "")
+                if chunk.finish_reason:
+                    finish = chunk.finish_reason
+        finally:
+            for entry in worker._entries.values():
+                entry.scheduler.shutdown()
+        return "".join(text), finish
+
+    async def read_ready(proc, timeout_s: float = 240.0) -> dict:
+        loop = asyncio.get_running_loop()
+        line = await asyncio.wait_for(
+            loop.run_in_executor(None, proc.stdout.readline), timeout_s)
+        if not line:
+            raise RuntimeError("worker died before READY "
+                               f"(rc={proc.poll()})")
+        return json.loads(line)
+
+    async def go() -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        fp.configure(seed)
+        default_recorder.reset()
+        # the gateway-side half: registry + its gRPC service on loopback
+        registry = WorkerRegistry(lease_ttl_s=lease_ttl_s)
+        server = JsonGrpcServer()
+        register_worker_registry_service(server, registry)
+        port = await server.start("127.0.0.1:0")
+        procs: list[subprocess.Popen] = []
+        ready: list[dict] = []
+        pool = FederatedServingPool(
+            registry,
+            lambda w: GrpcLlmWorkerClient(endpoint=w.endpoint),
+            ChatStreamChunk,
+            FederationConfig(seed=seed, failover_backoff_s=0.01))
+
+        async def drive(prompt: str, rid: str,
+                        kill_after: Optional[int] = None) -> dict[str, Any]:
+            """Stream one federated completion; optionally SIGKILL the
+            serving host once ``kill_after`` text chunks arrived."""
+            text, finishes, killed_host = [], [], None
+            async for chunk in pool.completion_stream(
+                    model, prompt, {"max_tokens": max_tokens,
+                                    "_request_id": rid}):
+                if chunk.text:
+                    text.append(chunk.text)
+                if chunk.finish_reason:
+                    finishes.append(chunk.finish_reason)
+                if kill_after is not None and killed_host is None \
+                        and len(text) >= kill_after:
+                    rec = default_recorder.lookup(rid) or {}
+                    killed_host = rec.get("worker_host")
+                    victim = next((r for r in ready
+                                   if r["host"] == killed_host), None)
+                    if victim is not None:
+                        os.kill(victim["pid"], signal.SIGKILL)
+            return {"text": "".join(text), "finishes": finishes,
+                    "killed_host": killed_host}
+
+        try:
+            loop = asyncio.get_running_loop()
+            for i in range(2):
+                cfg_json = json.dumps({
+                    "hub_endpoint": f"127.0.0.1:{port}",
+                    "host": f"worker-{i}", "worker": {},
+                    "models": [model_ref_dict(model)],
+                    "heartbeat_interval_s": 0.25})
+
+                def spawn(cfg: str = cfg_json) -> subprocess.Popen:
+                    return subprocess.Popen(
+                        [sys.executable, "-m",
+                         "cyberfabric_core_tpu.modules.llm_gateway.worker"],
+                        env={**os.environ, "JAX_PLATFORMS": "cpu",
+                             "FED_WORKER_CONFIG": cfg},
+                        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                        text=True)
+
+                procs.append(await loop.run_in_executor(None, spawn))
+            ready.extend([await read_ready(p) for p in procs])
+            out["hosts_announced"] = registry.healthy()
+
+            # phase 0 — armed federation.route rejects BEFORE dialing any
+            # host: the typed 503 surfaces, no worker sees the request
+            for f in faults:
+                fp.arm(f["point"], f["spec"])
+            try:
+                try:
+                    async for _ in pool.completion_stream(
+                            model, prompt_a,
+                            {"max_tokens": 2,
+                             "_request_id": f"fed-route-{seed}"}):
+                        pass
+                    out["route_fault"] = "no error surfaced"
+                except ProblemError as e:
+                    out["route_fault"] = e.problem.code
+            finally:
+                for f in faults:
+                    fp.disarm(f["point"])
+
+            # phase 1 — prefix affinity: serve prompt_a once, let the
+            # serving host gossip its radix prefix (>= 2 heartbeats), then
+            # the router must send the repeat to the SAME host for reason
+            # ``prefix``
+            first = await drive(prompt_a, f"fed-a-{seed}")
+            out["first_stream"] = first
+            first_host = (default_recorder.lookup(f"fed-a-{seed}")
+                          or {}).get("worker_host")
+            chain = digest_chain(prompt_a)
+            deadline = time.monotonic() + 10.0
+            hint = None
+            while time.monotonic() < deadline:
+                w, reason = pool.route(model_key, chain)
+                if reason == "prefix":
+                    hint = {"host": w.host, "reason": reason}
+                    break
+                await asyncio.sleep(0.25)
+            out["prefix_hint"] = hint
+            out["prefix_host_matches"] = bool(
+                hint and first_host and hint["host"] == first_host)
+
+            # phase 2 — SIGKILL the host mid-stream; the pool must fail
+            # over to the survivor and deliver the baseline text exactly
+            crash = await drive(prompt_b, f"fed-b-{seed}", kill_after=1)
+            out["crash_stream"] = crash
+
+            # phase 3 — the corpse leaves the registry within one lease
+            # window (report_failure evicts at the failover; the lease
+            # sweep below is the backstop the hub's evict tick runs)
+            deadline = time.monotonic() + lease_ttl_s + 2.0
+            while time.monotonic() < deadline and registry.healthy() > 1:
+                registry.evict_expired()
+                await asyncio.sleep(0.1)
+            out["hosts_after_crash"] = registry.healthy()
+            out["evicted"] = [
+                {"host": e["host"], "reason": e["reason"]}
+                for e in registry.rows()["evicted"]]
+
+            # phase 4 — the survivor still serves, baseline-identical
+            out["survivor_stream"] = await drive(prompt_a,
+                                                 f"fed-c-{seed}")
+        finally:
+            await pool.close()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=30)
+                if p.stdout is not None:
+                    p.stdout.close()
+            await server.stop()
+        return out
+
+    base_a_text, base_a_finish = asyncio.run(baseline(prompt_a))
+    base_b_text, base_b_finish = asyncio.run(baseline(prompt_b))
+    out = asyncio.run(go())
+
+    first = out.get("first_stream") or {}
+    crash = out.get("crash_stream") or {}
+    survivor = out.get("survivor_stream") or {}
+    invariants = {
+        "both_hosts_announced": (
+            [] if out.get("hosts_announced") == 2 else
+            [f"{out.get('hosts_announced')} hosts in the registry"]),
+        "route_fault_typed_503": (
+            [] if out.get("route_fault") == "replica_unavailable" else
+            [f"armed route fault surfaced as {out.get('route_fault')!r}"]),
+        "prefix_routing": (
+            [] if out.get("prefix_host_matches") else
+            [f"repeat did not land on the prefix host: "
+             f"{out.get('prefix_hint')}"]),
+        "first_stream_matches_baseline": (
+            [] if (first.get("text") == base_a_text
+                   and first.get("finishes") == [base_a_finish]) else
+            [f"first stream diverged: {first.get('finishes')}"]),
+        "failover_stream_bit_identical": (
+            [] if crash.get("text") == base_b_text else
+            [f"crashed stream text diverged "
+             f"({len(crash.get('text') or '')} vs {len(base_b_text)} chars)"]),
+        "exactly_one_terminal": (
+            [] if crash.get("finishes") == [base_b_finish] else
+            [f"terminals {crash.get('finishes')} != [{base_b_finish}]"]),
+        "host_was_killed_mid_stream": (
+            [] if crash.get("killed_host") else
+            ["never identified/killed the serving host"]),
+        "corpse_evicted_within_lease": (
+            [] if (out.get("hosts_after_crash") == 1
+                   and any(e["reason"] in ("crash", "lease_expired")
+                           for e in out.get("evicted", []))) else
+            [f"hosts={out.get('hosts_after_crash')} "
+             f"evicted={out.get('evicted')}"]),
+        "survivor_serves_baseline": (
+            [] if (survivor.get("text") == base_a_text
+                   and survivor.get("finishes") == [base_a_finish]) else
+            [f"survivor stream diverged: {survivor.get('finishes')}"]),
+    }
+    return _finish(
+        spec["name"], "worker_host_crash", seed, invariants,
+        {"texts": sorted([first.get("text", ""), crash.get("text", ""),
+                          survivor.get("text", "")]),
+         "finishes": sorted([str(first.get("finishes")),
+                             str(crash.get("finishes")),
+                             str(survivor.get("finishes"))]),
+         "route_fault": out.get("route_fault")},
+        evicted=out.get("evicted"), killed_host=crash.get("killed_host"))
+
+
 # ------------------------------------------------------------------ dispatch
 
 _KINDS = {
@@ -2053,6 +2319,7 @@ _KINDS = {
     "server_gateway": _run_server_gateway_scenario,
     "serverless": _run_serverless_scenario,
     "worker": _run_worker_scenario,
+    "worker_host_crash": _run_worker_host_crash_scenario,
     "grpc_evict": _run_grpc_evict_scenario,
     "slo_burn": _run_slo_burn_scenario,
     "stall": _run_stall_scenario,

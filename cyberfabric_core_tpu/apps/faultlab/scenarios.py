@@ -7,7 +7,8 @@ A scenario is a dict:
     name: forced-preempt            # unique scenario name
     kind: engine                    # engine|pool|http_retry|db_commit|
                                     #   server_breaker|server_gateway|
-                                    #   serverless|worker|grpc_evict
+                                    #   serverless|worker|grpc_evict|
+                                    #   worker_host_crash
     seed: 1234                      # drives load gen + probability modes
     engine: {max_batch: 2, ...}     # EngineConfig overrides (engine/pool)
     load: {requests: 4, prompt_len: [4, 10], max_tokens: 10}
@@ -401,6 +402,23 @@ BUILTIN_SCENARIOS: list[dict[str, Any]] = [
         "name": "grpc-evict-tick",
         "kind": "grpc_evict",
         "seed": 405,
+    },
+    # ---- cross-host federation (runtime/federation.py) -----------------
+    {
+        # two REAL worker subprocesses over loopback gRPC: an armed
+        # federation.route raise rejects one request as a typed 503 before
+        # any host is dialed; a repeated-prefix request lands on the host
+        # already holding the prefix (gossiped digest chains); SIGKILLing
+        # the serving host mid-stream fails over to the survivor with the
+        # delivered text bit-identical to an in-process baseline and
+        # exactly one terminal; the corpse leaves the registry within one
+        # lease window (lost host = lost capacity)
+        "name": "worker-host-crash",
+        "kind": "worker_host_crash",
+        "seed": 406,
+        "lease_ttl_s": 2.0,
+        "load": {"max_tokens": 16},
+        "faults": [{"point": "federation.route", "spec": "1*raise"}],
     },
     # ---- tenant isolation (weighted-fair queue + selective shedding) ---
     {

@@ -89,6 +89,11 @@ FAILPOINT_CATALOG: dict[str, tuple[str, str]] = {
         "single-engine supervisor); an armed raise models a device still "
         "too sick to rebuild on — strikes accumulate through exponential "
         "backoff until the replica is benched"),
+    "federation.route": (
+        "runtime", "federated host placement (prefix > load > random) in "
+        "the cross-host serving pool; a raise rejects the request before "
+        "any worker host is dialed — armed once, it also exercises the "
+        "route-retry inside mid-stream failover"),
     # -- gateway ----------------------------------------------------------
     "gateway.request": (
         "gateway", "per-request middleware entry (inside the error-mapping "

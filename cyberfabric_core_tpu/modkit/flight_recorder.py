@@ -101,7 +101,7 @@ class RequestRecord:
     __slots__ = ("request_id", "trace_id", "created_at", "phase", "slot",
                  "tokens", "prompt_tokens", "events", "_dropped",
                  "finished_at", "model", "tenant", "stalled",
-                 "last_event_at")
+                 "last_event_at", "worker_host")
 
     def __init__(self, request_id: str) -> None:
         self.request_id = request_id
@@ -121,6 +121,10 @@ class RequestRecord:
         self.tenant: Optional[str] = None
         self.stalled = False  # a stall watchdog flagged this stream
         self.last_event_at = self.created_at
+        #: federated serving: which worker HOST served (or is serving) this
+        #: request — stamped by the FederatedServingPool's placement, and
+        #: re-stamped on failover so the column always names the live host
+        self.worker_host: Optional[str] = None
 
     # ------------------------------------------------------------- derived
     def _first(self, kind: str) -> Optional[float]:
@@ -165,6 +169,7 @@ class RequestRecord:
             "trace_id": self.trace_id,
             "model": self.model,
             "tenant": self.tenant,
+            "worker_host": self.worker_host,
             "phase": self.phase,
             "slot": self.slot,
             "age_s": round(now - self.created_at, 3),
@@ -267,6 +272,8 @@ class FlightRecorder:
                 rec.prompt_tokens = int(attrs["prompt_tokens"])
             if attrs.get("tenant"):
                 rec.tenant = attrs["tenant"]
+            if attrs.get("worker_host"):
+                rec.worker_host = attrs["worker_host"]
             if kind in ("prefill", "first_token"):
                 rec.tokens += 1
             elif kind == "decode_chunk":
@@ -359,7 +366,8 @@ class FlightRecorder:
             pass
 
     def annotate(self, request_id: str, model: Optional[str] = None,
-                 tenant: Optional[str] = None) -> None:
+                 tenant: Optional[str] = None,
+                 worker_host: Optional[str] = None) -> None:
         """Set denormalized columns on an EXISTING record (live or recently
         finished) without appending an event. The worker stamps the model
         (and, for external-provider paths, the tenant) here after submit —
@@ -374,6 +382,8 @@ class FlightRecorder:
                 rec.model = model
             if tenant is not None:
                 rec.tenant = tenant
+            if worker_host is not None:
+                rec.worker_host = worker_host
 
     # --------------------------------------------------------------- reads
     def is_live(self, request_id: str) -> bool:
@@ -435,10 +445,12 @@ def record_event(request_id: str, kind: str, **attrs: Any) -> None:
 
 
 def annotate_request(request_id: str, model: Optional[str] = None,
-                     tenant: Optional[str] = None) -> None:
+                     tenant: Optional[str] = None,
+                     worker_host: Optional[str] = None) -> None:
     """Never-raises :meth:`FlightRecorder.annotate` on the default recorder
     (the worker's model/tenant stamp sits on the serving path)."""
     try:
-        default_recorder.annotate(request_id, model=model, tenant=tenant)
+        default_recorder.annotate(request_id, model=model, tenant=tenant,
+                                  worker_host=worker_host)
     except Exception:  # noqa: BLE001
         pass

@@ -32,6 +32,12 @@ Handler = Callable[[dict], Awaitable[dict]]
 #: server-streaming handler: request dict → async iterator of chunk dicts
 StreamHandler = Callable[[dict], Any]
 
+#: tracing metadata keys the server surfaces to handlers: the federated
+#: gateway sends `x-request-id` + W3C `traceparent` as real gRPC metadata so
+#: one OTLP trace spans gateway-host → worker-host → tokens (and any proxy in
+#: between sees standard headers, not payload internals)
+_TRACE_METADATA_KEYS = ("x-request-id", "traceparent")
+
 #: abort-details marker carrying a serialized RFC-9457 problem — a remote
 #: worker's typed 4xx must re-raise as the SAME ProblemError on the caller,
 #: or the "cannot tell remote from in-process" contract breaks on every
@@ -67,6 +73,20 @@ def raise_remote_problem(e: "grpc_aio.AioRpcError") -> None:
 
         raise ProblemError(Problem.from_dict(
             json.loads(details[len(_PROBLEM_MARK):]))) from e
+
+
+def _inject_trace_metadata(req: dict, context) -> None:
+    """Surface the tracing headers to the handler as ``req["_grpc_metadata"]``
+    — decoded request dicts are handler-private, so the extra key is safe for
+    both the JSON and the proto-codec planes. Never raises: tracing metadata
+    must not fail an RPC."""
+    try:
+        meta = dict(context.invocation_metadata() or ())
+        picked = {k: meta[k] for k in _TRACE_METADATA_KEYS if meta.get(k)}
+        if picked:
+            req["_grpc_metadata"] = picked
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _ser(obj: dict) -> bytes:
@@ -214,6 +234,7 @@ class JsonGrpcServer:
                         await self._check_auth(_sn, context)
                         req = (_codec.decode_request(request) if _codec
                                else _de(request))
+                        _inject_trace_metadata(req, context)
                         out = await _fn(req)
                         return (_codec.encode_response(out) if _codec
                                 else _ser(out))
@@ -246,6 +267,7 @@ class JsonGrpcServer:
                         await self._check_auth(_sn, context)
                         req = (_codec.decode_request(request) if _codec
                                else _de(request))
+                        _inject_trace_metadata(req, context)
                         async for chunk in _gen(req):
                             yield (_codec.encode_response(chunk) if _codec
                                    else _ser(chunk))
@@ -325,8 +347,15 @@ class JsonGrpcClient:
             self._channel = grpc_aio.insecure_channel(self.target)
         return self._channel
 
+    def _merged_metadata(self, extra) -> Optional[tuple]:
+        """Fixed auth metadata + per-call pairs (tracing headers)."""
+        if not extra:
+            return self._metadata
+        return tuple(self._metadata or ()) + tuple(extra)
+
     async def call(self, service: str, method: str, payload: dict,
-                   codec: Optional[ProtoCodec] = None) -> dict:
+                   codec: Optional[ProtoCodec] = None,
+                   metadata: Optional[tuple] = None) -> dict:
         channel = await self._ensure_channel()
         rpc = channel.unary_unary(
             f"/{service}/{method}",
@@ -334,12 +363,13 @@ class JsonGrpcClient:
             response_deserializer=lambda b: b,
         )
         wire = codec.encode_request(payload) if codec else _ser(payload)
+        md = self._merged_metadata(metadata)
         delay = self.config.retry_backoff_s
         last: Optional[grpc_aio.AioRpcError] = None
         for attempt in range(self.config.max_retries + 1):
             try:
                 resp = await rpc(wire, timeout=self.config.call_timeout_s,
-                                 metadata=self._metadata)
+                                 metadata=md)
                 return codec.decode_response(resp) if codec else _de(resp)
             except grpc_aio.AioRpcError as e:
                 raise_remote_problem(e)  # typed server Problems re-raise as-is
@@ -351,7 +381,8 @@ class JsonGrpcClient:
         raise last  # pragma: no cover
 
     async def call_stream(self, service: str, method: str, payload: dict,
-                          codec: Optional[ProtoCodec] = None):
+                          codec: Optional[ProtoCodec] = None,
+                          metadata: Optional[tuple] = None):
         """Server-streaming call: yields chunk dicts. No automatic retry —
         replaying a partially-consumed token stream would duplicate output;
         callers own stream-level recovery."""
@@ -362,12 +393,13 @@ class JsonGrpcClient:
             response_deserializer=lambda b: b,
         )
         wire = codec.encode_request(payload) if codec else _ser(payload)
+        md = self._merged_metadata(metadata)
 
         async def gen():
             try:
                 async for resp in rpc(wire,
                                       timeout=self.config.stream_timeout_s,
-                                      metadata=self._metadata):
+                                      metadata=md):
                     yield codec.decode_response(resp) if codec else _de(resp)
             except grpc_aio.AioRpcError as e:
                 raise_remote_problem(e)

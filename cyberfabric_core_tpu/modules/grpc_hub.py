@@ -1,17 +1,24 @@
 """grpc-hub — the single gRPC host: one server for all modules' services, hosting
-the DirectoryService.
+the DirectoryService and the federation WorkerRegistry.
 
 Reference: modules/system/grpc-hub/src/module.rs (GrpcHubConfig :36-56, exactly one
 tonic Server per process, directory deregistration on shutdown :277-299) +
 run_grpc_phase collecting GrpcServiceCapability installers
 (host_runtime.rs:449-516).
+
+Federation (docs/ARCHITECTURE.md "Cross-host federation"): remote worker
+processes announce themselves over ``fabricfed.v1.WorkerRegistry`` (a
+JSON-over-gRPC generic service — no codegen; the census payload is an
+open-world gossip dict), heartbeat with capacity/model/prefix census, and are
+evicted by the same tick that sweeps stale directory instances. The gateway's
+FederatedServingPool resolves the registry through the ClientHub.
 """
 
 from __future__ import annotations
 
 import asyncio
 from dataclasses import dataclass
-from typing import Optional
+from typing import Any, Optional
 
 from ..modkit import Module, ReadySignal, module
 from ..modkit.contracts import RunnableCapability, SystemCapability
@@ -21,8 +28,13 @@ from ..modkit.logging_host import observe_task
 from ..modkit.transport_grpc import (
     DIRECTORY_SERVICE,
     DirectoryService,
+    JsonGrpcClient,
     JsonGrpcServer,
 )
+from ..runtime.federation import WorkerRegistry
+
+#: federation worker-plane control service (JSON-over-gRPC, runtime-registered)
+WORKER_REGISTRY_SERVICE = "fabricfed.v1.WorkerRegistry"
 
 
 @dataclass
@@ -30,6 +42,69 @@ class GrpcHubConfig:
     bind_addr: str = "127.0.0.1:0"
     heartbeat_ttl_s: float = 15.0
     eviction_interval_s: float = 5.0
+    #: federation worker lease: a worker that misses heartbeats for this long
+    #: is evicted from the WorkerRegistry (lost host = lost capacity)
+    worker_lease_ttl_s: float = 10.0
+
+
+def register_worker_registry_service(server: JsonGrpcServer,
+                                     registry: WorkerRegistry,
+                                     auth_token: Optional[str] = None) -> None:
+    """Expose ``registry`` as fabricfed.v1.WorkerRegistry: Announce /
+    Heartbeat / Withdraw / ListWorkers. Heartbeat answers ``registered:
+    false`` for an unknown instance (evicted, or the hub restarted) — the
+    worker's loop re-announces instead of silently gossiping into a void."""
+
+    async def announce(req: dict) -> dict:
+        return registry.announce(req)
+
+    async def heartbeat(req: dict) -> dict:
+        ok = registry.heartbeat(str(req.get("instance_id", "")),
+                                req.get("census") or None)
+        return {"registered": ok}
+
+    async def withdraw(req: dict) -> dict:
+        return {"ok": registry.withdraw(str(req.get("instance_id", "")))}
+
+    async def list_workers(_req: dict) -> dict:
+        return registry.rows()
+
+    server.add_service(WORKER_REGISTRY_SERVICE, {
+        "Announce": announce, "Heartbeat": heartbeat,
+        "Withdraw": withdraw, "ListWorkers": list_workers,
+    }, auth_token=auth_token)
+
+
+class WorkerRegistryClient:
+    """Worker-side registry client (the announce/heartbeat half of the
+    lease protocol) — what a `python -m ...llm_gateway.worker` serve-mode
+    process dials back to the hub."""
+
+    def __init__(self, endpoint: str, auth_token: Optional[str] = None) -> None:
+        self._client = JsonGrpcClient(endpoint, auth_token=auth_token)
+
+    async def announce(self, info: dict[str, Any]) -> dict[str, Any]:
+        return await self._client.call(WORKER_REGISTRY_SERVICE, "Announce",
+                                       info)
+
+    async def heartbeat(self, instance_id: str,
+                        census: Optional[dict[str, Any]] = None) -> bool:
+        resp = await self._client.call(
+            WORKER_REGISTRY_SERVICE, "Heartbeat",
+            {"instance_id": instance_id, "census": census or {}})
+        return bool(resp.get("registered"))
+
+    async def withdraw(self, instance_id: str) -> bool:
+        resp = await self._client.call(WORKER_REGISTRY_SERVICE, "Withdraw",
+                                       {"instance_id": instance_id})
+        return bool(resp.get("ok"))
+
+    async def list_workers(self) -> dict[str, Any]:
+        return await self._client.call(WORKER_REGISTRY_SERVICE,
+                                       "ListWorkers", {})
+
+    async def close(self) -> None:
+        await self._client.close()
 
 
 @module(name="grpc_hub", capabilities=["system", "stateful"])
@@ -37,21 +112,29 @@ class GrpcHubModule(Module, SystemCapability, RunnableCapability):
     def __init__(self) -> None:
         self.server = JsonGrpcServer()
         self.directory = DirectoryService()
+        self.registry: Optional[WorkerRegistry] = None
         self.config = GrpcHubConfig()
         self.bound_port: Optional[int] = None
         self._evict_task: Optional[asyncio.Task] = None
 
     async def init(self, ctx: ModuleCtx) -> None:
-        raw = ctx.raw_config()
+        raw = dict(ctx.raw_config() or {})
+        worker_auth = raw.pop("worker_auth_token", None)
         self.config = GrpcHubConfig(**raw) if raw else GrpcHubConfig()
         self.directory.ttl = self.config.heartbeat_ttl_s
+        self.registry = WorkerRegistry(
+            lease_ttl_s=self.config.worker_lease_ttl_s)
         from ..modkit.transport_grpc import directory_codecs
 
         self.server.add_service(DIRECTORY_SERVICE, self.directory.rpc_handlers(),
                                 codecs=directory_codecs())
-        # expose for other modules: in-process directory + service registration
+        register_worker_registry_service(self.server, self.registry,
+                                         auth_token=worker_auth)
+        # expose for other modules: in-process directory + service
+        # registration + the federation worker census
         ctx.client_hub.register(DirectoryService, self.directory)
         ctx.client_hub.register(JsonGrpcServer, self.server)
+        ctx.client_hub.register(WorkerRegistry, self.registry)
 
     async def start(self, ctx: ModuleCtx, ready: ReadySignal) -> None:
         self.bound_port = await self.server.start(self.config.bind_addr)
@@ -83,10 +166,15 @@ class GrpcHubModule(Module, SystemCapability, RunnableCapability):
         ready.notify_ready()
 
     def _evict_tick(self) -> None:
-        """One directory staleness sweep; the loop survives a failing tick
-        (chaos rehearsals arm grpc_hub.evict to prove it)."""
+        """One staleness sweep: stale directory instances AND expired worker
+        leases; the loop survives a failing tick (chaos rehearsals arm
+        grpc_hub.evict to prove it). Worker lease expiry fans out through
+        WorkerRegistry.on_lease_expired — lost host = lost capacity, visible
+        to the doctor and /v1/monitoring/workers within one tick."""
         failpoint("grpc_hub.evict")
         self.directory.evict_stale()
+        if self.registry is not None:
+            self.registry.evict_expired()
 
     async def stop(self, ctx: ModuleCtx) -> None:
         if self._evict_task is not None:

@@ -133,18 +133,31 @@ def register_llm_worker_service(server: Any, worker: LlmWorkerApi,
             raise ValueError("request requires model.canonical_id")
         return model_from_ref(req["model"])
 
+    def _params(req: dict) -> dict:
+        """Decode the params Struct and fold in the wire's tracing metadata
+        (x-request-id / traceparent gRPC headers, injected by the transport
+        as ``_grpc_metadata``): one X-Request-Id and one OTLP trace span
+        gateway-host → worker-host → tokens. Explicit params win — metadata
+        is the fallback for callers that only speak standard headers."""
+        params = _destruct(dict(req.get("params") or {}))
+        meta = req.get("_grpc_metadata") or {}
+        if meta.get("x-request-id") and not params.get("_request_id"):
+            params["_request_id"] = meta["x-request-id"]
+        if meta.get("traceparent") and not params.get("_traceparent"):
+            params["_traceparent"] = meta["traceparent"]
+        return params
+
     async def chat_stream(req: dict) -> AsyncIterator[dict]:
         model = _model(req)
         async for chunk in worker.chat_stream(
                 model, _normalize_messages(req.get("messages", [])),
-                _destruct(dict(req.get("params") or {}))):
+                _params(req)):
             yield chunk_dict(chunk)
 
     async def completion(req: dict) -> AsyncIterator[dict]:
         model = _model(req)
         async for chunk in worker.completion_stream(
-                model, req.get("prompt", ""),
-                _destruct(dict(req.get("params") or {}))):
+                model, req.get("prompt", ""), _params(req)):
             yield chunk_dict(chunk)
 
     async def embed(req: dict) -> dict:
@@ -209,6 +222,20 @@ class GrpcLlmWorkerClient(LlmWorkerApi):
         return {k: v for k, v in (params or {}).items()
                 if k not in ("messages", "model", "prompt")}
 
+    @staticmethod
+    def _wire_metadata(params: Optional[dict]) -> Optional[tuple]:
+        """X-Request-Id + W3C traceparent as real gRPC metadata, so the
+        worker-host joins the gateway's trace even through header-only
+        middleboxes (and the worker's flight recorder keys on the same id
+        the client holds)."""
+        meta = []
+        p = params or {}
+        if p.get("_request_id"):
+            meta.append(("x-request-id", str(p["_request_id"])))
+        if p.get("_traceparent"):
+            meta.append(("traceparent", str(p["_traceparent"])))
+        return tuple(meta) or None
+
     async def chat_stream(self, model: ModelInfo, messages: list[dict],
                           params: dict) -> AsyncIterator[ChatStreamChunk]:
         client = await self._ensure()
@@ -216,7 +243,8 @@ class GrpcLlmWorkerClient(LlmWorkerApi):
             LLM_WORKER_SERVICE, "ChatStream",
             {"model": model_ref_dict(model), "messages": messages,
              "params": self._wire_params(params)},
-            codec=self._codecs["ChatStream"])
+            codec=self._codecs["ChatStream"],
+            metadata=self._wire_metadata(params))
         async for d in stream:
             yield chunk_from_dict(d)
 
@@ -227,7 +255,8 @@ class GrpcLlmWorkerClient(LlmWorkerApi):
             LLM_WORKER_SERVICE, "Completion",
             {"model": model_ref_dict(model), "prompt": prompt,
              "params": self._wire_params(params)},
-            codec=self._codecs["Completion"])
+            codec=self._codecs["Completion"],
+            metadata=self._wire_metadata(params))
         async for d in stream:
             yield chunk_from_dict(d)
 

@@ -303,8 +303,16 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         # allow a pre-registered worker (test seam per client_hub.rs:16)
         self.worker = ctx.client_hub.try_get(LlmWorkerApi)
         if self.worker is None:
+            fed = cfg.get("federation") or {}
             remote = cfg.get("remote_worker_endpoint")
-            if remote:
+            if fed.get("enabled"):
+                # route-remote before route-local: the federated pool places
+                # each request on the best registered worker HOST (prefix >
+                # load > random) over the typed llmworker.v1 wire, with
+                # mid-stream host-crash failover — docs/ARCHITECTURE.md
+                # "Cross-host federation"
+                self.worker = self._build_federated_pool(ctx, cfg, fed)
+            elif remote:
                 # OoP worker on another host: typed llmworker.v1 wire
                 # (proto/llmworker/v1/llm_worker.proto)
                 from .grpc_service import GrpcLlmWorkerClient
@@ -343,16 +351,54 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         self._hub = ctx.client_hub  # external adapter resolves lazily (oagw may
         #                             init after this module — no dep ordering)
 
+    def _build_federated_pool(self, ctx: ModuleCtx, cfg: dict,
+                              fed: dict) -> Any:
+        """Wire the transport-free FederatedServingPool (runtime tier) to
+        this process's gRPC stack: the WorkerRegistry resolves LAZILY through
+        the ClientHub (grpc_hub may init after this module — no dep
+        ordering), each placed host gets a cached GrpcLlmWorkerClient, and
+        synthesized terminals use the SDK's ChatStreamChunk."""
+        from ...runtime.federation import (FederatedServingPool,
+                                           FederationConfig)
+        from ..sdk import ChatStreamChunk, WorkerRegistryApi
+        from .grpc_service import GrpcLlmWorkerClient
+
+        # the pool is runtime-tier (transport-free, no modules import), so
+        # it satisfies the worker contract as an abc VIRTUAL subclass —
+        # isinstance passes in ClientHub.register without inverting tiers
+        LlmWorkerApi.register(FederatedServingPool)
+        hub = ctx.client_hub
+        auth = fed.get("worker_auth_token") or \
+            (cfg.get("worker_service") or {}).get("token")
+
+        def client_factory(w: Any) -> GrpcLlmWorkerClient:
+            return GrpcLlmWorkerClient(endpoint=w.endpoint, auth_token=auth)
+
+        config = FederationConfig(
+            prefix_slack=int(fed.get("prefix_slack", 2)),
+            max_failovers=int(fed.get("max_failovers", 2)),
+            failover_backoff_s=float(fed.get("failover_backoff_s", 0.05)),
+            block_chars=int(fed.get("block_chars", 48)),
+            max_blocks=int(fed.get("max_blocks", 64)),
+            seed=int(fed.get("seed", 0)),
+        )
+        return FederatedServingPool(
+            lambda: hub.try_get(WorkerRegistryApi),
+            client_factory, ChatStreamChunk, config)
+
     def register_grpc(self, ctx: ModuleCtx, server: Any) -> None:
         """Expose the worker as llmworker.v1.LlmWorkerService (typed proto)
         so OTHER hosts' gateways can consume this node's TPU engines. A
         remote-worker PROXY is never re-exported — advertising someone
         else's engines would add a hop per call and lets two hosts pointing
-        at each other recurse (review finding)."""
+        at each other recurse (review finding); the federated pool is a
+        router over OTHER hosts' engines, so the same rule applies."""
+        from ...runtime.federation import FederatedServingPool
         from .grpc_service import GrpcLlmWorkerClient, register_llm_worker_service
 
         if self._worker_service_expose and self.worker is not None and \
-                not isinstance(self.worker, GrpcLlmWorkerClient):
+                not isinstance(self.worker,
+                               (GrpcLlmWorkerClient, FederatedServingPool)):
             register_llm_worker_service(server, self.worker,
                                         auth_token=self._worker_service_token)
 

@@ -32,6 +32,7 @@ from ...parallel.feasibility import InfeasiblePlanError
 from ...runtime.engine import (EngineConfig, InferenceEngine, SamplingParams,
                                SchedulerSaturated, StepEvent,
                                TenantQuotaExceeded, TenantSaturated)
+from ...runtime.federation import digest_chain, prompt_text
 from ...runtime.lifecycle import (EngineSupervisor, LifecycleConfig,
                                   LifecycleStateError, ReplicaUnavailable)
 from ...runtime.replicas import DataParallelServingPool
@@ -199,6 +200,16 @@ class LocalTpuWorker(LlmWorkerApi):
         self._started_at = time.monotonic()
         self._requests_served = 0
         self._tokens_out = 0
+        # federation gossip inputs (docs/ARCHITECTURE.md "Cross-host
+        # federation"): per-request prompt digest chains + tokenized ids —
+        # probed against the live prefix pools at census time so only
+        # KV-resident prefixes are advertised — and the recent
+        # request→trace map that lets a gateway assert cross-process traces
+        from collections import OrderedDict as _OD
+
+        self._prefix_log: "_OD[str, tuple[str, list[str], list[int]]]" = _OD()
+        self._recent_traces: "_OD[str, str]" = _OD()
+        self._census_lock = threading.Lock()
 
     # ------------------------------------------------------------------ engines
     async def _entry_for(self, model: ModelInfo) -> _EngineEntry:
@@ -523,6 +534,10 @@ class LocalTpuWorker(LlmWorkerApi):
         self, model: ModelInfo, messages: list[dict], params: dict
     ) -> AsyncIterator[ChatStreamChunk]:
         entry = await self._entry_for(model)
+        # digest BEFORE any preamble/template work: the federated router
+        # hashes the same raw message text on its side of the wire — the two
+        # chains must agree byte-for-byte for prefix placement to hit
+        census_text = prompt_text(messages=messages)
         if params.get("_resolved_tools"):
             from .tools import render_tools_preamble
 
@@ -539,7 +554,8 @@ class LocalTpuWorker(LlmWorkerApi):
         # cancel-on-teardown would wait for GC while the slot keeps decoding
         agen = self._generate_from_ids(
             entry, model,
-            entry.tokenizer.encode(prompt, add_specials=False), params)
+            entry.tokenizer.encode(prompt, add_specials=False), params,
+            census_text=census_text)
         try:
             async for chunk in agen:
                 yield chunk
@@ -553,7 +569,8 @@ class LocalTpuWorker(LlmWorkerApi):
         surface): the prompt is tokenized verbatim — no chat template."""
         entry = await self._entry_for(model)
         agen = self._generate_from_ids(
-            entry, model, entry.tokenizer.encode(prompt), params)
+            entry, model, entry.tokenizer.encode(prompt), params,
+            census_text=prompt_text(prompt=prompt))
         try:
             async for chunk in agen:
                 yield chunk
@@ -563,7 +580,7 @@ class LocalTpuWorker(LlmWorkerApi):
 
     async def _generate_from_ids(
         self, entry: _EngineEntry, model: ModelInfo, prompt_ids: list[int],
-        params: dict
+        params: dict, census_text: Optional[str] = None
     ) -> AsyncIterator[ChatStreamChunk]:
         # chaos rehearsals arm this to crash a job at the worker boundary,
         # before the engine sees it (the reference's "provider adapter died")
@@ -584,6 +601,19 @@ class LocalTpuWorker(LlmWorkerApi):
             raise ERR.llm.context_length_exceeded.error(
                 f"prompt of {len(prompt_ids)} tokens exceeds engine window "
                 f"{entry.config.max_seq_len}")
+        # federated failover continuation (runtime/federation.py carries the
+        # ledger): the surviving host re-prefills prompt + already-delivered
+        # tokens and seeds the detokenizer below, so the client stream stays
+        # bit-identical across the host crash
+        n_prompt = len(prompt_ids)
+        resume_ids = [int(t) for t in (params.get("_resume_token_ids") or ())]
+        if resume_ids:
+            prompt_ids = list(prompt_ids) + resume_ids
+            if len(prompt_ids) >= entry.config.max_seq_len:
+                raise ERR.llm.context_length_exceeded.error(
+                    f"prompt of {n_prompt} tokens + {len(resume_ids)} carried "
+                    f"failover tokens exceeds engine window "
+                    f"{entry.config.max_seq_len}")
 
         # the gateway threads its X-Request-Id through (``_request_id``), so
         # the engine-side flight-recorder timeline is addressable by the id
@@ -598,6 +628,8 @@ class LocalTpuWorker(LlmWorkerApi):
         if default_recorder.is_live(request_id):
             request_id = f"{request_id}-{uuid.uuid4().hex[:8]}"
         trace = params.get("_traceparent")
+        self._note_census(request_id, model.canonical_id, census_text,
+                          prompt_ids[:n_prompt], trace)
         queue: asyncio.Queue = asyncio.Queue()
         req = _Request(
             prompt_ids=prompt_ids,
@@ -694,6 +726,17 @@ class LocalTpuWorker(LlmWorkerApi):
         tail_ids: list[int] = []
         stable_text = ""
         sent_text = ""
+        if resume_ids:
+            # failover seed: the carried tokens' text is already "generated"
+            # here; sent_text is what the GATEWAY actually delivered — any
+            # held-back unstable tail re-emits as the first survivor delta
+            stable_text = entry.tokenizer.decode(resume_ids)
+            sent_text = str(params.get("_resume_sent_text") or "")
+        #: federated mode: one chunk per token EVENT (text may be empty
+        #: while the detokenizer holds an unstable tail) — the gateway-side
+        #: pool keeps an exact token ledger for mid-stream failover and
+        #: swallows empty non-terminal chunks before the client sees them
+        fed_stream = bool(params.get("_fed_token_stream"))
         stop_hit = False
         n_tokens = 0
         #: flips once the engine-side stream reached ANY terminal — the
@@ -787,8 +830,13 @@ class LocalTpuWorker(LlmWorkerApi):
                         stop_hit = True
                 if delta:
                     sent_text += delta
-                    yield ChatStreamChunk(request_id=request_id, text=delta,
-                                          token_id=ev.token_id)
+                if delta or (fed_stream and ev.token_id >= 0):
+                    # fed mode emits the chunk even for a text-less token
+                    # (incl. the terminal event's own token, just before the
+                    # terminal chunk) so the ledger counts every token once
+                    yield ChatStreamChunk(
+                        request_id=request_id, text=delta,
+                        token_id=ev.token_id if ev.token_id >= 0 else None)
                 if ev.finished or stop_hit:
                     stream_done = True
                     self._requests_served += 1
@@ -1113,6 +1161,96 @@ class LocalTpuWorker(LlmWorkerApi):
                 agg["per_model"][name] = row
         return out
 
+    # ------------------------------------------------- federation census
+    def _note_census(self, request_id: str, model_key: str,
+                     census_text: Optional[str], prompt_ids: list[int],
+                     trace: Optional[str]) -> None:
+        """Bounded gossip bookkeeping on the serving path: remember this
+        prompt's digest chain + token ids (probed against the live prefix
+        pools at census time) and the request→trace join. Never raises."""
+        try:
+            chain = digest_chain(census_text) if census_text else []
+            with self._census_lock:
+                if chain:
+                    self._prefix_log[request_id] = (model_key, chain,
+                                                    list(prompt_ids))
+                    while len(self._prefix_log) > 64:
+                        self._prefix_log.popitem(last=False)
+                if trace:
+                    from ...modkit.telemetry import traceparent_ids
+
+                    trace_id, _ = traceparent_ids(trace)
+                    if trace_id:
+                        self._recent_traces[request_id] = trace_id
+                        while len(self._recent_traces) > 64:
+                            self._recent_traces.popitem(last=False)
+        except Exception:  # noqa: BLE001 — gossip must not fail serving
+            pass
+
+    def _prefix_gossip(self) -> dict[str, list[list[str]]]:
+        """model → digest chains for prefixes that are KV-RESIDENT right now:
+        each logged prompt is probed with ``peek_prefix_len`` against the
+        model's live prefix pools and its chain truncated to the covered
+        fraction — an evicted prefix ages out of the gossip within one
+        heartbeat, and a half-resident one advertises only its cached head.
+        Block-vs-token granularity makes this proportional, not exact; a
+        stale hint costs one prefill on the wrong host, never correctness."""
+        with self._census_lock:
+            logged = list(self._prefix_log.values())
+        out: dict[str, list[list[str]]] = {}
+        for model_key, chain, ids in logged:
+            entry = self._entries.get(model_key)
+            if entry is None or not ids:
+                continue
+            pools = []
+            if entry.scheduler is not None:
+                pools.append(getattr(entry.scheduler, "pool", None))
+            if entry.pool is not None:
+                pools.extend(getattr(r, "pool", None)
+                             for r in getattr(entry.pool, "replicas", ()))
+            best = 0
+            for p in pools:
+                if p is None:
+                    continue
+                try:
+                    best = max(best, int(p.peek_prefix_len(list(ids))))
+                except Exception:  # noqa: BLE001 — a dying engine
+                    continue
+            if best <= 0:
+                continue
+            blocks = min(len(chain), max(1, (len(chain) * best) // len(ids)))
+            trimmed = chain[:blocks]
+            chains = out.setdefault(model_key, [])
+            if trimmed not in chains:
+                chains.append(trimmed)
+        return out
+
+    def federation_census(self) -> dict[str, Any]:
+        """The heartbeat gossip payload (schema: docs/ARCHITECTURE.md
+        "Cross-host federation"): live load, capacity + tenant census,
+        loaded models, KV-resident prefix digests, and the recent
+        request→trace map that lets the gateway prove one trace spans
+        both hosts."""
+        load = 0
+        for _name, sched in self.schedulers():
+            try:
+                st = sched.stats()
+                load += int(st.get("active", 0)) + int(st.get("pending", 0)) \
+                    + int(st.get("prefilling", 0))
+            except Exception:  # noqa: BLE001 — a dying engine
+                continue
+        with self._census_lock:
+            traces = dict(self._recent_traces)
+        return {
+            "load": load,
+            "capacity": {**self.replica_capacity(),
+                         "tenants": self.tenant_usage()},
+            "models": sorted(self._entries),
+            "requests_served": self._requests_served,
+            "prefix": self._prefix_gossip(),
+            "recent_traces": traces,
+        }
+
     async def health(self) -> dict[str, Any]:
         import jax
 
@@ -1128,3 +1266,116 @@ class LocalTpuWorker(LlmWorkerApi):
             "tokens_out": self._tokens_out,
             "uptime_s": round(time.monotonic() - self._started_at, 1),
         }
+
+
+# ------------------------------------------------------------- serve mode
+#
+# `python -m cyberfabric_core_tpu.modules.llm_gateway.worker` with a
+# FED_WORKER_CONFIG env JSON turns this file into a standalone federation
+# worker process (the OoP-child pattern from modkit/oop.py, specialized for
+# the LLM worker plane):
+#
+#   {"hub_endpoint": "127.0.0.1:PORT",      # gateway-side grpc_hub
+#    "host": "worker-0",                    # display name in the registry
+#    "auth_token": "...",                   # bearer for OUR LlmWorkerService
+#    "hub_auth_token": "...",               # bearer for the hub's registry
+#    "worker": {...LocalTpuWorker config...},
+#    "models": [...model_ref dicts, preloaded at boot...],
+#    "roles": ["chat"], "heartbeat_interval_s": 1.0}
+#
+# Boot: build engines → bind LlmWorkerService on loopback → announce →
+# heartbeat census loop (re-announcing if evicted) → SIGTERM withdraws.
+
+async def serve(cfg: dict[str, Any]) -> None:
+    import json
+    import os
+    import signal
+
+    from ...modkit.transport_grpc import JsonGrpcServer
+    # fabric-lint: waive DE05 reason=standalone serve-mode process entrypoint; it dials the hub's registry over the wire, there is no in-stack ClientHub to resolve through
+    from ..grpc_hub import WorkerRegistryClient
+    from .grpc_service import model_from_ref, register_llm_worker_service
+
+    worker = LocalTpuWorker(cfg.get("worker") or {})
+    server = JsonGrpcServer()
+    register_llm_worker_service(server, worker,
+                                auth_token=cfg.get("auth_token"))
+    port = await server.start(str(cfg.get("bind_addr", "127.0.0.1:0")))
+    endpoint = f"{cfg.get('advertise_host', '127.0.0.1')}:{port}"
+    host_label = str(cfg.get("host") or f"worker-{os.getpid()}")
+
+    models = [model_from_ref(m) for m in (cfg.get("models") or [])]
+    for m in models:
+        # pay the engine build at boot, not on the first routed request
+        await worker._entry_for(m)
+
+    registry = WorkerRegistryClient(str(cfg["hub_endpoint"]),
+                                    auth_token=cfg.get("hub_auth_token"))
+    info = {
+        "host": host_label,
+        "endpoint": endpoint,
+        "pid": os.getpid(),
+        "models": [m.canonical_id for m in models],
+        "roles": list(cfg.get("roles") or ()),
+    }
+    lease = await registry.announce(info)
+    instance_id = str(lease["instance_id"])
+    await registry.heartbeat(instance_id, worker.federation_census())
+    # parents (tests, faultlab, bench) block on this line before dialing
+    # fabric-lint: waive DE13 reason=the READY line on stdout IS the parent's wait protocol (the OoP-child handshake), not logging
+    print(json.dumps({"ready": True, "endpoint": endpoint,
+                      "instance_id": instance_id, "host": host_label,
+                      "pid": os.getpid()}), flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):  # non-main thread / win
+            pass
+
+    interval = float(cfg.get("heartbeat_interval_s", 1.0))
+    try:
+        while not stop.is_set():
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=interval)
+                break
+            except asyncio.TimeoutError:
+                pass
+            try:
+                if not await registry.heartbeat(instance_id,
+                                                worker.federation_census()):
+                    # evicted (hub restart or a missed lease window):
+                    # re-announce under a fresh lease instead of gossiping
+                    # into the void
+                    instance_id = str(
+                        (await registry.announce(info))["instance_id"])
+            except Exception:  # noqa: BLE001 — hub outage must not kill us
+                logger.exception("federation heartbeat failed")
+    finally:
+        try:
+            await registry.withdraw(instance_id)  # graceful departure
+        except Exception:  # noqa: BLE001 — hub may already be gone
+            pass
+        await registry.close()
+        await server.stop()
+
+
+def main() -> int:
+    import json
+    import os
+    import sys
+
+    raw = os.environ.get("FED_WORKER_CONFIG")
+    if not raw:
+        print("worker serve mode requires the FED_WORKER_CONFIG env var "
+              "(JSON: hub_endpoint, host, worker, models, ...)",
+              file=sys.stderr)
+        return 2
+    asyncio.run(serve(json.loads(raw)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -203,6 +203,40 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
             "llm_tenant_shed",
             "1 while this tenant is selectively shed (over fair share "
             "during SLO burn)")
+        # cross-host federation: worker-plane lease counters, placement
+        # reasons, failovers, and the healthy-host gauge — pre-registered so
+        # dashboards can alert from the first scrape; the gauge reads the
+        # hub-registered WorkerRegistry at scrape time (non-federated stacks
+        # simply scrape 0)
+        from .sdk import WorkerRegistryApi
+
+        self.registry.counter(
+            "llm_remote_worker_announcements_total",
+            "Worker processes announced to the federation registry").inc(0.0)
+        self.registry.counter(
+            "llm_remote_worker_heartbeats_total",
+            "Worker lease renewals (heartbeats with gossip census)").inc(0.0)
+        self.registry.counter(
+            "llm_remote_worker_evictions_total",
+            "Worker hosts evicted by reason "
+            "(lease_expired/crash/withdrawn)").inc(0.0)
+        self.registry.counter(
+            "llm_federated_placements_total",
+            "Federated host placements by routing reason "
+            "(prefix/load/random)").inc(0.0)
+        self.registry.counter(
+            "llm_federated_failovers_total",
+            "Mid-stream requests re-prefilled on a surviving host").inc(0.0)
+
+        def remote_workers_healthy() -> float:
+            reg = hub.try_get(WorkerRegistryApi)
+            return float(reg.healthy()) if reg is not None else 0.0
+
+        self.registry.gauge(
+            "llm_remote_workers_healthy",
+            "Worker hosts holding a live federation lease"
+        ).set_function(remote_workers_healthy)
+
         self.registry.counter(
             "llm_replica_rebuilds_total",
             "Replica rebuilds by outcome (ok/failed)").inc(0.0)
@@ -850,6 +884,44 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
             .summary("One tenant's live scheduler state (404 when the "
                      "tenant holds no state on this node)") \
             .handler(get_tenant).register()
+
+        # ---- cross-host federation: the worker-plane census behind the
+        # FederatedServingPool's routing decisions — per-host lease age,
+        # roles, capacity, gossiped prefix-index size, and the bounded
+        # evicted-host memory (why did capacity shrink?). The registry is
+        # hub-registered by grpc_hub; non-federated stacks 404 per-worker
+        # and list an empty table.
+        from .sdk import WorkerRegistryApi
+
+        async def list_workers(request: web.Request):
+            reg = ctx.client_hub.try_get(WorkerRegistryApi)
+            if reg is None:
+                return {"workers": [], "evicted": [], "lease_ttl_s": 0.0,
+                        "prefix_index_size": 0, "federation": False}
+            body = reg.rows()
+            body["federation"] = True
+            return body
+
+        async def get_worker(request: web.Request):
+            instance_id = request.match_info["instance_id"]
+            reg = ctx.client_hub.try_get(WorkerRegistryApi)
+            w = reg.lookup(instance_id) if reg is not None else None
+            if w is None:
+                raise ERR.monitoring.unknown_worker.error(
+                    f"no live federation lease for worker {instance_id!r} "
+                    "(never announced, withdrawn, or evicted)")
+            return w.row(lease_ttl_s=reg.lease_ttl_s)
+
+        router.operation("GET", "/v1/monitoring/workers",
+                         module="monitoring").auth_required() \
+            .summary("Federated worker census: per-host lease age, roles, "
+                     "capacity, prefix-index size, and recent evictions") \
+            .handler(list_workers).register()
+        router.operation("GET", "/v1/monitoring/workers/{instance_id}",
+                         module="monitoring").auth_required() \
+            .summary("One federated worker's census row (404 when it holds "
+                     "no live lease)") \
+            .handler(get_worker).register()
 
         router.operation("GET", "/v1/monitoring/failpoints",
                          module="monitoring").auth_required() \

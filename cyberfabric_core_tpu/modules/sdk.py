@@ -20,6 +20,12 @@ from ..modkit.security import SecurityContext
 #: MetricsRegistry — the SDK alias is the hub-resolution contract name.
 from ..modkit.doctor import Doctor as DoctorApi  # noqa: E402
 
+#: federation worker-census contract: the WorkerRegistry the grpc_hub module
+#: registers and the llm-gateway router / monitoring surface consult (alive /
+#: lookup / rows / healthy). Implementation lives a layer DOWN
+#: (runtime.federation), the DoctorApi pattern.
+from ..runtime.federation import WorkerRegistry as WorkerRegistryApi  # noqa: E402
+
 
 # ----------------------------------------------------------------- model registry
 @dataclass

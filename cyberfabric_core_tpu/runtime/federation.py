@@ -1,0 +1,690 @@
+"""Cross-host federation: the remote-worker data plane.
+
+Two pieces, both transport-free (the gateway injects a gRPC client factory;
+this tier never imports grpc — DE0308):
+
+* :class:`WorkerRegistry` — the gateway-side census of worker processes.
+  Workers **announce** themselves, then **heartbeat** with a capacity /
+  role / model census plus radix-tree prefix digests; a missed lease
+  window evicts the host (``grpc_hub._evict_tick`` drives the sweep).
+  Lease expiry and crash reports fire ``on_lease_expired`` — the doctor's
+  "lost host = lost capacity" feed.
+
+* :class:`FederatedServingPool` — an ``LlmWorkerApi``-shaped router that
+  places each request on the best host: longest gossiped-prefix match
+  within a load slack (the RTP-LLM recipe, generalized from the
+  in-process ``DataParallelServingPool._pick``), else least-loaded, else
+  a seeded random tie-break — routing precedence **prefix > load >
+  random**. Mid-stream host crashes fail over to a survivor with the
+  emitted tokens carried as a continuation (``_resume_token_ids`` /
+  ``_resume_sent_text``), mirroring ``replicas._failover``: streams stay
+  bit-identical and exactly one terminal reaches the client.
+
+The **gossip payload** a worker piggybacks on each heartbeat::
+
+    {"load": 3, "capacity": {...replica_capacity()...},
+     "models": ["local::tiny-llama"], "roles": ["chat"],
+     "requests_served": 17,
+     "prefix": {"local::tiny-llama": [["ab12..", "9f0e..", ...], ...]},
+     "recent_traces": {"req-1": "4bf9..."}}
+
+``prefix`` maps model → digest *chains*: position ``i`` holds the chained
+hash of the first ``i+1`` text blocks of a prompt whose KV prefix is still
+resident in that worker's radix tree (the worker probes
+``peek_prefix_len`` at census time, so evicted prefixes age out of the
+gossip within one heartbeat). The router hashes the incoming prompt the
+same way and scores hosts by longest common chain prefix — a text-block
+approximation of token-level ``peek_prefix_len``, which is exactly enough
+for a placement *hint* (a wrong hint costs a prefill, never correctness).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+from ..modkit.failpoints import failpoint, record_recovery
+from ..modkit.flight_recorder import annotate_request, record_event
+from ..modkit.metrics import bump_counter
+
+__all__ = ["FederatedServingPool", "FederationConfig", "WorkerInfo",
+           "WorkerRegistry", "digest_chain", "prompt_text"]
+
+
+# ------------------------------------------------------------- prefix digests
+
+def prompt_text(messages: Optional[list] = None,
+                prompt: Optional[str] = None) -> str:
+    """The canonical text both sides of the wire digest: the raw completion
+    prompt, or every text part of the chat messages in order. Router and
+    worker must agree byte-for-byte, so neither renders the chat template."""
+    if prompt is not None:
+        return prompt
+    parts: list[str] = []
+    for m in messages or ():
+        content = m.get("content")
+        if isinstance(content, str):
+            parts.append(content)
+            continue
+        for p in content or ():
+            if isinstance(p, dict) and p.get("type") == "text":
+                parts.append(str(p.get("text", "")))
+    return "\x1f".join(parts)
+
+
+def digest_chain(text: str, block_chars: int = 48,
+                 max_blocks: int = 64) -> list[str]:
+    """Chained block hashes of ``text``: position ``i`` digests blocks
+    ``0..i``, so two chains share a prefix exactly when the texts share
+    those leading blocks (a hash-chain radix path). Short tails (< one
+    block) are dropped — they cannot carry a reusable KV page anyway."""
+    chain: list[str] = []
+    h = hashlib.sha1()
+    for i in range(0, min(len(text), block_chars * max_blocks), block_chars):
+        block = text[i:i + block_chars]
+        if len(block) < block_chars:
+            break
+        h.update(block.encode("utf-8", "replace"))
+        chain.append(h.hexdigest()[:12])
+    return chain
+
+
+def match_depth(chain: list[str], candidates: Iterable[list[str]]) -> int:
+    """Longest common chain prefix between ``chain`` and any candidate —
+    the ``peek_prefix_len`` analogue over gossiped digests."""
+    best = 0
+    for cand in candidates:
+        d = 0
+        for a, b in zip(chain, cand):
+            if a != b:
+                break
+            d += 1
+        if d > best:
+            best = d
+    return best
+
+
+# ------------------------------------------------------------------ registry
+
+@dataclass
+class WorkerInfo:
+    """One announced worker process (a host in the federation)."""
+
+    instance_id: str
+    host: str                      # display name ("worker-0", a hostname)
+    endpoint: str                  # host:port the gateway dials back
+    roles: tuple[str, ...] = ()
+    models: tuple[str, ...] = ()
+    pid: int = 0
+    registered_at: float = field(default_factory=time.time)
+    last_heartbeat: float = field(default_factory=time.time)
+    census: dict[str, Any] = field(default_factory=dict)
+    heartbeats: int = 0
+
+    def row(self, now: Optional[float] = None,
+            lease_ttl_s: float = 0.0) -> dict[str, Any]:
+        now = time.time() if now is None else now
+        prefix = self.census.get("prefix") or {}
+        return {
+            "instance_id": self.instance_id,
+            "host": self.host,
+            "endpoint": self.endpoint,
+            "roles": list(self.roles),
+            "models": list(self.models) or sorted(
+                self.census.get("models") or []),
+            "pid": self.pid,
+            "lease_age_s": round(now - self.last_heartbeat, 3),
+            "expires_in_s": round(
+                max(0.0, lease_ttl_s - (now - self.last_heartbeat)), 3),
+            "heartbeats": self.heartbeats,
+            "load": int(self.census.get("load") or 0),
+            "capacity": self.census.get("capacity") or {},
+            "requests_served": int(self.census.get("requests_served") or 0),
+            "prefix_index": {m: len(chains) for m, chains in prefix.items()},
+            "recent_traces": self.census.get("recent_traces") or {},
+        }
+
+
+class WorkerRegistry:
+    """Gateway-side worker census: announce → heartbeat → lease-expiry evict.
+
+    The single ``_lock`` (see docs/lock_graph.json) guards the worker table
+    and is never held across I/O or listener calls — every mutator snapshots
+    under the lock and notifies outside it, so the registry can sit on the
+    hub's evict tick and the router's submit path at once."""
+
+    def __init__(self, lease_ttl_s: float = 10.0) -> None:
+        self.lease_ttl_s = float(lease_ttl_s)
+        self._lock = threading.Lock()
+        self._workers: dict[str, WorkerInfo] = {}
+        #: bounded memory of departed hosts: monitoring shows *why* capacity
+        #: shrank, and replica_capacity() counts them as lost replicas
+        self._evicted: list[dict[str, Any]] = []
+        self._listeners: list[Callable[[WorkerInfo, str], None]] = []
+        self._seq = 0
+
+    # ------------------------------------------------------------- mutators
+    def announce(self, info: dict[str, Any]) -> dict[str, Any]:
+        """Register (or re-register) a worker. Idempotent on instance_id —
+        a worker that missed heartbeats and got evicted re-announces with
+        the same id and simply reappears."""
+        with self._lock:
+            self._seq += 1
+            instance_id = str(info.get("instance_id") or
+                              f"fedw-{self._seq}-{random.getrandbits(32):08x}")
+            w = WorkerInfo(
+                instance_id=instance_id,
+                host=str(info.get("host") or instance_id),
+                endpoint=str(info["endpoint"]),
+                roles=tuple(info.get("roles") or ()),
+                models=tuple(info.get("models") or ()),
+                pid=int(info.get("pid") or 0),
+            )
+            self._workers[instance_id] = w
+        bump_counter("llm_remote_worker_announcements_total")
+        return {"instance_id": instance_id, "lease_ttl_s": self.lease_ttl_s}
+
+    def heartbeat(self, instance_id: str,
+                  census: Optional[dict[str, Any]] = None) -> bool:
+        """Refresh a lease and merge the gossip payload. Returns False for
+        an unknown id (evicted / never announced) — the worker re-announces.
+        Non-blocking, never-raises emits only (WD01)."""
+        with self._lock:
+            w = self._workers.get(instance_id)
+            if w is None:
+                return False
+            w.last_heartbeat = time.time()
+            w.heartbeats += 1
+            if census:
+                w.census = census
+        bump_counter("llm_remote_worker_heartbeats_total")
+        return True
+
+    def withdraw(self, instance_id: str) -> bool:
+        """Graceful departure (SIGTERM path) — no failure accounting."""
+        return self._remove(instance_id, "withdrawn") is not None
+
+    def report_failure(self, instance_id: str, reason: str = "crash") -> None:
+        """A router saw the host die mid-stream: evict NOW instead of
+        waiting out the lease (lost host = lost capacity, immediately)."""
+        self._remove(instance_id, reason)
+
+    def evict_expired(self, now: Optional[float] = None) -> list[str]:
+        """Lease sweep (called from grpc_hub's evict tick)."""
+        now = time.time() if now is None else now
+        cutoff = now - self.lease_ttl_s
+        with self._lock:
+            stale = [k for k, w in self._workers.items()
+                     if w.last_heartbeat < cutoff]
+        evicted = []
+        for k in stale:
+            if self._remove(k, "lease_expired") is not None:
+                evicted.append(k)
+        return evicted
+
+    def _remove(self, instance_id: str, reason: str) -> Optional[WorkerInfo]:
+        with self._lock:
+            w = self._workers.pop(instance_id, None)
+            if w is None:
+                return None
+            self._evicted.append({
+                "instance_id": w.instance_id, "host": w.host,
+                "endpoint": w.endpoint, "reason": reason,
+                "evicted_at": time.time()})
+            del self._evicted[:-16]
+        self.on_lease_expired(w, reason)
+        return w
+
+    # ------------------------------------------------------------ listeners
+    def add_lease_listener(self,
+                           fn: Callable[[WorkerInfo, str], None]) -> None:
+        """Subscribe to departures: ``fn(worker, reason)`` with reason in
+        {lease_expired, crash, withdrawn}. Idempotent."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def on_lease_expired(self, worker: WorkerInfo, reason: str) -> None:
+        """Departure fan-out — called OUTSIDE the lock; every emit is a
+        never-raises helper and every listener is wrapped (WD01: the hub's
+        evict tick must survive a bad observer)."""
+        bump_counter("llm_remote_worker_evictions_total", reason=reason)
+        record_event(f"fed/{worker.host}", "evicted", reason=reason,
+                     endpoint=worker.endpoint)
+        for fn in list(self._listeners):
+            try:
+                fn(worker, reason)
+            except Exception:  # noqa: BLE001 — observers never break eviction
+                pass
+
+    # ---------------------------------------------------------------- reads
+    def alive(self, model: Optional[str] = None,
+              role: Optional[str] = None) -> list[WorkerInfo]:
+        """Live workers, optionally filtered to those serving ``model`` /
+        ``role`` (a worker that advertises no model census serves any)."""
+        with self._lock:
+            out = list(self._workers.values())
+        if model:
+            out = [w for w in out
+                   if not (w.models or w.census.get("models"))
+                   or model in w.models
+                   or model in (w.census.get("models") or ())]
+        if role:
+            out = [w for w in out if not w.roles or role in w.roles]
+        return sorted(out, key=lambda w: w.instance_id)
+
+    def lookup(self, instance_id: str) -> Optional[WorkerInfo]:
+        with self._lock:
+            return self._workers.get(instance_id)
+
+    def healthy(self) -> int:
+        with self._lock:
+            return len(self._workers)
+
+    def index_size(self) -> int:
+        """Total gossiped prefix chains across live workers — the global
+        prefix index's footprint gauge."""
+        with self._lock:
+            return sum(len(chains)
+                       for w in self._workers.values()
+                       for chains in (w.census.get("prefix") or {}).values())
+
+    def rows(self) -> dict[str, Any]:
+        now = time.time()
+        with self._lock:
+            workers = [w.row(now, self.lease_ttl_s)
+                       for w in sorted(self._workers.values(),
+                                       key=lambda w: w.instance_id)]
+            evicted = list(self._evicted)
+        return {"workers": workers, "evicted": evicted,
+                "lease_ttl_s": self.lease_ttl_s,
+                "prefix_index_size": self.index_size()}
+
+
+# ---------------------------------------------------------------- federation
+
+@dataclass
+class FederationConfig:
+    """Router policy knobs (the gateway's ``federation:`` config block)."""
+
+    #: a prefix-hint host may carry this many more in-flight requests than
+    #: the least-loaded host and still win (the cache_affinity_slack
+    #: analogue at host granularity)
+    prefix_slack: int = 2
+    #: mid-stream crash failovers per request before the error surfaces
+    max_failovers: int = 2
+    failover_backoff_s: float = 0.05
+    #: text-block geometry — MUST match what workers hash into their gossip
+    block_chars: int = 48
+    max_blocks: int = 64
+    #: seeded tie-break RNG (deterministic scenarios)
+    seed: int = 0
+
+
+class FederatedServingPool:
+    """LlmWorkerApi-shaped router over remote worker hosts.
+
+    ``client_factory(worker_info)`` returns an LlmWorkerApi-speaking client
+    for one host (the gateway injects ``GrpcLlmWorkerClient``); clients are
+    cached per instance and dropped when the host departs.
+    ``make_chunk(**fields)`` builds a stream chunk (the gateway injects
+    ``ChatStreamChunk``) for synthesized terminals."""
+
+    def __init__(self, registry: Any, client_factory: Callable[[WorkerInfo], Any],
+                 make_chunk: Callable[..., Any],
+                 config: Optional[FederationConfig] = None) -> None:
+        #: WorkerRegistry or a zero-arg resolver for it (module init order:
+        #: the gateway may init before grpc_hub has registered the registry)
+        self._registry_ref = registry
+        self._factory = client_factory
+        self._make_chunk = make_chunk
+        self.config = config or FederationConfig()
+        self._clients: dict[str, Any] = {}
+        self._inflight: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._rng = random.Random(self.config.seed)
+        self.placements = {"prefix": 0, "load": 0, "random": 0}
+        self.failovers = 0
+        self.failovers_failed = 0
+        self.requests = 0
+
+    # ------------------------------------------------------------- plumbing
+    def registry(self) -> Any:
+        reg = self._registry_ref
+        if callable(reg) and not hasattr(reg, "alive"):
+            reg = reg()
+            if reg is not None:
+                self._registry_ref = reg
+        if reg is None:
+            raise RuntimeError("federation: no WorkerRegistry (is the "
+                               "grpc_hub module enabled?)")
+        return reg
+
+    def _client_for(self, w: WorkerInfo) -> Any:
+        with self._lock:
+            client = self._clients.get(w.instance_id)
+            if client is None:
+                client = self._factory(w)
+                self._clients[w.instance_id] = client
+        return client
+
+    def _drop_client(self, instance_id: str) -> None:
+        with self._lock:
+            client = self._clients.pop(instance_id, None)
+        if client is not None and hasattr(client, "close"):
+            try:
+                from ..modkit.logging_host import observe_task
+
+                loop = asyncio.get_running_loop()
+                observe_task(loop.create_task(client.close()),
+                             "federation.client_close", logger="federation")
+            except Exception:  # noqa: BLE001 — teardown must not fail routing
+                pass
+
+    def _bump_inflight(self, instance_id: str, d: int) -> None:
+        with self._lock:
+            self._inflight[instance_id] = \
+                max(0, self._inflight.get(instance_id, 0) + d)
+
+    # -------------------------------------------------------------- routing
+    def route(self, model_key: str, chain: list[str],
+              exclude: tuple[str, ...] = ()) -> tuple[WorkerInfo, str]:
+        """Pick the serving host: **prefix > load > random** (WD01: sync,
+        non-blocking, never-raises emits only). Raises RuntimeError when no
+        live host can serve the model."""
+        failpoint("federation.route")
+        workers = [w for w in self.registry().alive(model=model_key)
+                   if w.instance_id not in exclude]
+        if not workers:
+            raise RuntimeError(
+                f"federation: no live worker host for {model_key!r}")
+        with self._lock:
+            local = dict(self._inflight)
+        loads = {w.instance_id: int(w.census.get("load") or 0)
+                 + local.get(w.instance_id, 0) for w in workers}
+        by_id = {w.instance_id: w for w in workers}
+        best = min(loads, key=lambda k: (loads[k], k))
+        reason = "load"
+        pick = best
+        if chain:
+            hint, hint_depth = None, 0
+            for w in workers:
+                chains = (w.census.get("prefix") or {}).get(model_key) or ()
+                d = match_depth(chain, chains)
+                if d > hint_depth:
+                    hint, hint_depth = w.instance_id, d
+            if hint is not None and \
+                    loads[hint] - loads[best] <= self.config.prefix_slack:
+                pick, reason = hint, "prefix"
+        if reason != "prefix" and len(workers) > 1 and \
+                len(set(loads.values())) == 1:
+            # every host equally idle and no cache hint: spread, seeded
+            pick = self._rng.choice(sorted(loads))
+            reason = "random"
+        self.placements[reason] += 1
+        bump_counter("llm_federated_placements_total", reason=reason)
+        return by_id[pick], reason
+
+    # ---------------------------------------------------------- LlmWorkerApi
+    async def chat_stream(self, model: Any, messages: list[dict],
+                          params: dict):
+        async for chunk in self._stream("chat", model, messages, None,
+                                        params):
+            yield chunk
+
+    async def completion_stream(self, model: Any, prompt: str, params: dict):
+        async for chunk in self._stream("completion", model, None, prompt,
+                                        params):
+            yield chunk
+
+    async def _stream(self, mode: str, model: Any,
+                      messages: Optional[list[dict]], prompt: Optional[str],
+                      params: dict):
+        """One federated stream: route → proxy → (on host crash) fail over
+        with the emitted tokens as a continuation. Exactly one terminal
+        reaches the consumer."""
+        from ..modkit.errors import ProblemError
+
+        cfg = self.config
+        model_key = getattr(model, "canonical_id", str(model))
+        params = dict(params or {})
+        rid = params.get("_request_id") or f"fed-{self._rng.getrandbits(48):012x}"
+        params["_request_id"] = rid
+        #: workers emit one chunk per token (token_id on each) so the carry
+        #: ledger below is exact; empty-text token chunks are swallowed here
+        params["_fed_token_stream"] = True
+        chain = digest_chain(prompt_text(messages, prompt),
+                             cfg.block_chars, cfg.max_blocks)
+        max_total = int(params.get("max_tokens", 256))
+        deadline_ms = params.get("_deadline_ms")
+        t0 = time.monotonic()
+        carried: list[int] = []      # token ids already delivered downstream
+        sent_text = ""
+        tried: list[str] = []
+        failovers_left = cfg.max_failovers
+        self.requests += 1
+        # surface the HTTP span's trace id on the gateway-side record: the
+        # worker processes join the same trace via the traceparent gRPC
+        # metadata, so ONE id covers both hosts' tokens
+        tp_parts = str(params.get("_traceparent") or "").split("-")
+        record_event(rid, "enqueued", tenant=params.get("_tenant_id"),
+                     federated=True,
+                     trace_id=tp_parts[1] if len(tp_parts) >= 3 else None)
+        while True:
+            try:
+                w, reason = self.route(model_key, chain, exclude=tuple(tried))
+            except RuntimeError as e:
+                # no live host (or an armed federation.route failpoint):
+                # a transient capacity hole, not a server bug — 503 +
+                # Retry-After, same mapping as the in-process pool's
+                # "no healthy replicas"
+                record_event(rid, "error", error=f"no_worker_host: {e}")
+                from ..modkit.errcat import ERR
+
+                raise ERR.llm.replica_unavailable.error(
+                    str(e), retry_after_s=1.0)
+            annotate_request(rid, model=model_key, worker_host=w.host)
+            record_event(rid, "admitted", worker_host=w.host,
+                         placement=reason, endpoint=w.endpoint)
+            client = self._client_for(w)
+            call_params = dict(params)
+            if carried:
+                call_params["_resume_token_ids"] = list(carried)
+                call_params["_resume_sent_text"] = sent_text
+                call_params["max_tokens"] = max_total - len(carried)
+            if deadline_ms:
+                left = float(deadline_ms) - (time.monotonic() - t0) * 1000.0
+                if left <= 0.0:
+                    record_event(rid, "deadline_exceeded",
+                                 worker_host=w.host)
+                    yield self._make_chunk(
+                        request_id=rid, finish_reason="deadline_exceeded",
+                        usage={"input_tokens": 0,
+                               "output_tokens": len(carried)})
+                    return
+                call_params["_deadline_ms"] = left
+            self._bump_inflight(w.instance_id, +1)
+            saw_terminal = False
+            t_attempt = time.monotonic()
+            try:
+                if mode == "completion":
+                    agen = client.completion_stream(model, prompt,
+                                                    call_params)
+                else:
+                    agen = client.chat_stream(model, messages, call_params)
+                try:
+                    async for chunk in agen:
+                        if chunk.token_id is not None:
+                            carried.append(int(chunk.token_id))
+                            record_event(rid, "decode_chunk", tokens=1,
+                                         worker_host=w.host)
+                        if chunk.text:
+                            sent_text += chunk.text
+                        if chunk.finish_reason:
+                            saw_terminal = True
+                            if tried and chunk.usage and carried:
+                                # honest accounting across the failover: the
+                                # carried tokens were GENERATED work the
+                                # survivor re-prefilled as "prompt" — move
+                                # them back to the output column
+                                n_prev = len(carried) - int(
+                                    chunk.usage.get("output_tokens", 0))
+                                if n_prev > 0:
+                                    chunk.usage = {
+                                        "input_tokens": max(
+                                            0, int(chunk.usage.get(
+                                                "input_tokens", 0)) - n_prev),
+                                        "output_tokens": int(chunk.usage.get(
+                                            "output_tokens", 0)) + n_prev,
+                                    }
+                            record_event(rid, "finished" if chunk.finish_reason
+                                         not in ("error",) else "error",
+                                         worker_host=w.host,
+                                         finish_reason=chunk.finish_reason)
+                        if chunk.text or chunk.finish_reason \
+                                or chunk.usage is not None:
+                            yield chunk
+                    if saw_terminal:
+                        return
+                    # stream closed with no terminal: the host died between
+                    # chunks without an exception — treat as a crash
+                    raise ConnectionError(
+                        f"worker {w.host} stream ended without a terminal")
+                finally:
+                    aclose = getattr(agen, "aclose", None)
+                    if aclose is not None:
+                        await aclose()
+            except (asyncio.CancelledError, GeneratorExit):
+                raise
+            except ProblemError:
+                # a typed remote problem (422/429/404…) is the WORKER
+                # answering, not the worker dying — no failover, no evict
+                record_event(rid, "error", worker_host=w.host,
+                             error="remote_problem")
+                raise
+            except Exception as e:  # noqa: BLE001 — transport/host failure
+                reg = self.registry()
+                reg.report_failure(w.instance_id, reason="crash")
+                self._drop_client(w.instance_id)
+                tried.append(w.instance_id)
+                if failovers_left <= 0:
+                    self.failovers_failed += 1
+                    record_event(rid, "error", worker_host=w.host,
+                                 error=f"failover_exhausted: {e}")
+                    raise
+                failovers_left -= 1
+                self.failovers += 1
+                bump_counter("llm_federated_failovers_total")
+                record_event(rid, "failover", from_host=w.host,
+                             carried_tokens=len(carried),
+                             retries_left=failovers_left)
+                if len(carried) >= max_total:
+                    # the budget was already served — synthesize the length
+                    # terminal instead of re-prefilling for zero tokens
+                    record_event(rid, "finished", worker_host=w.host,
+                                 synthesized_terminal=True)
+                    yield self._make_chunk(
+                        request_id=rid, finish_reason="length",
+                        usage={"input_tokens": 0,
+                               "output_tokens": len(carried)})
+                    return
+                record_recovery("federation.failover",
+                                time.monotonic() - t_attempt)
+                await asyncio.sleep(
+                    cfg.failover_backoff_s * (0.5 + self._rng.random()))
+            finally:
+                self._bump_inflight(w.instance_id, -1)
+
+    async def embed(self, model: Any, inputs: list[str],
+                    params: dict) -> tuple[list[list[float]], int]:
+        model_key = getattr(model, "canonical_id", str(model))
+        w, _reason = self.route(model_key, [])
+        return await self._client_for(w).embed(model, inputs, params)
+
+    async def health(self) -> dict[str, Any]:
+        reg = self.registry()
+        rows = reg.rows()
+        return {
+            "status": "ok" if rows["workers"] else "degraded",
+            "federated": True,
+            "workers": [{k: r[k] for k in
+                         ("instance_id", "host", "endpoint", "load",
+                          "lease_age_s")} for r in rows["workers"]],
+            "requests_served": self.requests,
+        }
+
+    # --------------------------------------------- doctor/monitoring surface
+    def schedulers(self) -> list[tuple[str, Any]]:
+        return []  # schedulers live in the worker processes
+
+    def replicas_view(self) -> list[dict[str, Any]]:
+        """Host-level rows for /v1/monitoring/replicas: in a federated
+        stack a "replica" is a worker host."""
+        rows = []
+        for r in self.registry().rows()["workers"]:
+            rows.append({
+                "index": len(rows), "model": ",".join(r["models"]) or "*",
+                "replica": r["host"], "pool": True, "controllable": False,
+                "state": "healthy", "federated": True,
+                "engine": {"active": r["load"],
+                           "requests_served": r["requests_served"]},
+            })
+        return rows
+
+    def replica_capacity(self) -> dict[str, Any]:
+        """Host census for the doctor: every evicted host is LOST capacity
+        (counted under ``quarantined``), so shedding hysteresis scales with
+        surviving hosts exactly like the in-process pool's replica feed."""
+        reg = self.registry()
+        rows = reg.rows()
+        alive = len(rows["workers"])
+        lost = len(rows["evicted"])
+        counts = {"replicas": alive + lost, "serving": alive,
+                  "healthy": alive, "probation": 0, "draining": 0,
+                  "drained": 0, "quarantined": lost, "rebuilding": 0,
+                  "benched": 0, "federated_hosts": alive}
+        return counts
+
+    def tenant_usage(self) -> dict[str, dict[str, Any]]:
+        """Merge the per-tenant census every worker gossips on heartbeat —
+        the gateway's budget hook sees one cross-host truth."""
+        out: dict[str, dict[str, Any]] = {}
+        for r in self.registry().rows()["workers"]:
+            for tenant, row in (r.get("capacity") or {}).get(
+                    "tenants", {}).items():
+                agg = out.setdefault(tenant, {
+                    "tenant": tenant, "charged_tokens": 0,
+                    "active_slots": 0, "pages": 0, "pending": 0})
+                for k in ("charged_tokens", "active_slots", "pages",
+                          "pending"):
+                    agg[k] += int(row.get(k, 0))
+        return out
+
+    def stats(self) -> dict[str, Any]:
+        reg = self.registry()
+        with self._lock:
+            placements = dict(self.placements)
+        return {
+            "federated": True,
+            "hosts": reg.healthy(),
+            "requests": self.requests,
+            "failovers": self.failovers,
+            "failovers_failed": self.failovers_failed,
+            "placements": placements,
+            "prefix_index_size": reg.index_size(),
+        }
+
+    async def close(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            if hasattr(c, "close"):
+                try:
+                    await c.close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass

@@ -648,6 +648,61 @@ def test_WD01_supervisor_rebuild_helpers_exempt():
     assert ok == []
 
 
+def test_WD01_registry_heartbeat_blocking_sleep_fails():
+    # every worker heartbeat serializes through the registry lock — a
+    # sleeping heartbeat handler stalls the whole federation lease plane
+    bad = lint("import time\n"
+               "class WorkerRegistry:\n"
+               "    def heartbeat(self, instance_id, census):\n"
+               "        time.sleep(0.1)\n",
+               tier="runtime", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and bad[0].line == 4
+
+
+def test_WD01_federated_route_await_fails():
+    # routing runs on the admission path of every request; an await means
+    # it can park mid-decision while holding routing state
+    bad = lint("class FederatedRouter:\n"
+               "    async def route(self, model_key, chain):\n"
+               "        await self._refresh()\n",
+               tier="runtime", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and "await" in bad[0].message
+
+
+def test_WD01_lease_expiry_callback_direct_metric_fails():
+    # on_lease_expired fans out from inside the eviction sweep — a raising
+    # metric mutate there would wedge eviction, not just metrics
+    bad = lint("class PoolRegistry:\n"
+               "    def on_lease_expired(self, row, registry):\n"
+               "        registry.counter('llm_remote_worker_evictions_total')"
+               ".inc(reason='lease')\n",
+               tier="runtime", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and "bump_counter" in bad[0].message
+
+
+def test_WD01_registry_heartbeat_never_raises_helpers_pass():
+    ok = lint("from cyberfabric_core_tpu.modkit.metrics import bump_counter\n"
+              "from cyberfabric_core_tpu.modkit.flight_recorder import "
+              "record_event\n"
+              "class WorkerRegistry:\n"
+              "    def heartbeat(self, instance_id, census):\n"
+              "        bump_counter('llm_remote_worker_heartbeats_total')\n"
+              "        record_event(instance_id, 'heartbeat')\n"
+              "        return True\n",
+              tier="runtime", select=("WD01",))
+    assert ok == []
+
+
+def test_WD01_registry_client_wire_heartbeat_exempt():
+    # a *RegistryClient* is the worker-side WIRE caller of the hub — its
+    # heartbeat IS a network call by definition, so the fed group skips it
+    ok = lint("class WorkerRegistryClient:\n"
+              "    async def heartbeat(self, census):\n"
+              "        return await self._call('Heartbeat', census)\n",
+              tier="runtime", select=("WD01",))
+    assert ok == []
+
+
 def test_WD01_cancel_callback_blocking_sleep_fails():
     # cancel() runs on gateway event-loop threads (an SSE disconnect) and
     # the expiry sweep runs between decode rounds — neither may block

@@ -1,0 +1,402 @@
+"""Federation unit tests: registry lease lifecycle, prefix digests, routing
+precedence (prefix > load > random), and mid-stream failover semantics with
+fake wire clients — no subprocesses, no JAX. The multi-process truth lives in
+tests/test_federation_e2e.py and the worker-host-crash faultlab scenario.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from cyberfabric_core_tpu.modkit.errcat import ERR
+from cyberfabric_core_tpu.modkit.errors import ProblemError
+from cyberfabric_core_tpu.modules.sdk import ChatStreamChunk
+from cyberfabric_core_tpu.runtime.federation import (
+    FederatedServingPool,
+    FederationConfig,
+    WorkerRegistry,
+    digest_chain,
+    match_depth,
+    prompt_text,
+)
+
+MODEL = "local::fed-test"
+
+
+# ------------------------------------------------------------ prefix digests
+
+def test_prompt_text_prefers_raw_prompt():
+    assert prompt_text(prompt="raw") == "raw"
+    assert prompt_text(messages=[{"content": "a"}], prompt="raw") == "raw"
+
+
+def test_prompt_text_joins_chat_text_parts():
+    msgs = [
+        {"role": "user", "content": [{"type": "text", "text": "one"},
+                                     {"type": "image", "url": "x"},
+                                     {"type": "text", "text": "two"}]},
+        {"role": "assistant", "content": "three"},
+    ]
+    assert prompt_text(messages=msgs) == "one\x1ftwo\x1fthree"
+
+
+def test_digest_chain_block_geometry():
+    # exact blocks chain; a short tail is dropped (cannot carry a KV page)
+    assert len(digest_chain("x" * 96)) == 2
+    assert len(digest_chain("x" * 100)) == 2
+    assert digest_chain("x" * 47) == []
+    assert len(digest_chain("x" * 48 * 100, max_blocks=4)) == 4
+
+
+def test_digest_chain_shared_prefix_property():
+    a = digest_chain("A" * 96)
+    b = digest_chain("A" * 48 + "B" * 48)
+    # same first block → same first digest; divergent second block → chains
+    # diverge AND stay divergent (the hash is chained, not per-block)
+    assert a[0] == b[0] and a[1] != b[1]
+    assert match_depth(a, [b]) == 1
+    assert match_depth(a, [a]) == 2
+    assert match_depth(a, []) == 0
+
+
+# ----------------------------------------------------------------- registry
+
+def test_registry_announce_heartbeat_lease_cycle():
+    reg = WorkerRegistry(lease_ttl_s=60.0)
+    got = reg.announce({"host": "h0", "endpoint": "127.0.0.1:1", "pid": 42,
+                        "models": [MODEL]})
+    iid = got["instance_id"]
+    assert got["lease_ttl_s"] == 60.0
+    assert reg.healthy() == 1
+    assert reg.lookup(iid).pid == 42
+
+    assert reg.heartbeat(iid, {"load": 3, "models": [MODEL]})
+    assert reg.lookup(iid).census["load"] == 3
+    assert not reg.heartbeat("never-announced")
+
+    # lease sweep: nothing stale now, everything stale a TTL into the future
+    assert reg.evict_expired() == []
+    assert reg.evict_expired(now=time.time() + 61.0) == [iid]
+    assert reg.healthy() == 0
+    assert not reg.heartbeat(iid)  # evicted id must re-announce
+
+    # re-announce with the SAME id reappears (idempotent recovery)
+    reg.announce({"instance_id": iid, "host": "h0", "endpoint": "127.0.0.1:1"})
+    assert reg.lookup(iid) is not None
+
+
+def test_registry_departure_reasons_and_listeners():
+    reg = WorkerRegistry(lease_ttl_s=60.0)
+    seen = []
+    reg.add_lease_listener(lambda w, reason: seen.append((w.host, reason)))
+    reg.add_lease_listener(lambda w, reason: 1 / 0)  # observers never break it
+
+    a = reg.announce({"host": "a", "endpoint": "e-a"})["instance_id"]
+    b = reg.announce({"host": "b", "endpoint": "e-b"})["instance_id"]
+    c = reg.announce({"host": "c", "endpoint": "e-c"})["instance_id"]
+
+    assert reg.withdraw(a)
+    assert not reg.withdraw(a)  # already gone
+    reg.report_failure(b)
+    reg.evict_expired(now=time.time() + 61.0)
+    assert seen == [("a", "withdrawn"), ("b", "crash"), ("c", "lease_expired")]
+    reasons = [e["reason"] for e in reg.rows()["evicted"]]
+    assert reasons == ["withdrawn", "crash", "lease_expired"]
+
+
+def test_registry_evicted_memory_is_bounded():
+    reg = WorkerRegistry()
+    for i in range(20):
+        iid = reg.announce({"host": f"h{i}", "endpoint": f"e{i}"})["instance_id"]
+        reg.withdraw(iid)
+    assert len(reg.rows()["evicted"]) == 16
+
+
+def test_registry_alive_filters_and_prefix_index():
+    reg = WorkerRegistry()
+    a = reg.announce({"host": "a", "endpoint": "e-a", "models": [MODEL],
+                      "roles": ["chat"]})["instance_id"]
+    b = reg.announce({"host": "b", "endpoint": "e-b"})["instance_id"]
+    reg.heartbeat(a, {"prefix": {MODEL: [["d1", "d2"], ["d3"]]}})
+    reg.heartbeat(b, {"models": ["other::model"]})
+
+    assert [w.host for w in reg.alive()] == sorted(["a", "b"],
+                                                   key=lambda h: h)
+    # b's census names another model, so it cannot serve MODEL; a worker
+    # with NO census at all would serve anything
+    assert [w.host for w in reg.alive(model=MODEL)] == ["a"]
+    assert [w.host for w in reg.alive(role="embed")] == ["b"]  # b: no roles
+    assert reg.index_size() == 2
+    rows = reg.rows()
+    assert rows["prefix_index_size"] == 2
+    row_a = next(r for r in rows["workers"] if r["host"] == "a")
+    assert row_a["prefix_index"] == {MODEL: 2}
+    assert row_a["expires_in_s"] > 0
+
+
+# ------------------------------------------------------------------ routing
+
+def _pool(reg, factory=lambda w: None, **cfg):
+    return FederatedServingPool(reg, factory, ChatStreamChunk,
+                                FederationConfig(**cfg))
+
+
+def _two_hosts(reg):
+    a = reg.announce({"host": "a", "endpoint": "e-a"})["instance_id"]
+    b = reg.announce({"host": "b", "endpoint": "e-b"})["instance_id"]
+    return a, b
+
+
+def test_route_prefix_beats_load_within_slack():
+    reg = WorkerRegistry()
+    a, b = _two_hosts(reg)
+    chain = digest_chain("p" * 96)
+    reg.heartbeat(a, {"load": 2, "prefix": {MODEL: [chain]}})
+    reg.heartbeat(b, {"load": 0})
+    w, reason = _pool(reg).route(MODEL, chain)
+    assert (w.host, reason) == ("a", "prefix")
+
+
+def test_route_prefix_loses_beyond_slack():
+    reg = WorkerRegistry()
+    a, b = _two_hosts(reg)
+    chain = digest_chain("p" * 96)
+    reg.heartbeat(a, {"load": 3, "prefix": {MODEL: [chain]}})
+    reg.heartbeat(b, {"load": 0})
+    w, reason = _pool(reg, prefix_slack=2).route(MODEL, chain)
+    assert (w.host, reason) == ("b", "load")
+
+
+def test_route_least_loaded_and_seeded_spread():
+    reg = WorkerRegistry()
+    a, b = _two_hosts(reg)
+    reg.heartbeat(a, {"load": 1})
+    reg.heartbeat(b, {"load": 0})
+    pool = _pool(reg)
+    w, reason = pool.route(MODEL, [])
+    assert (w.host, reason) == ("b", "load")
+
+    # equal loads + no hint → seeded random spread, and the tie-break must
+    # actually use both hosts over a handful of picks
+    reg.heartbeat(a, {"load": 0})
+    picks = set()
+    for _ in range(16):
+        w, reason = pool.route(MODEL, [])
+        assert reason == "random"
+        picks.add(w.host)
+    assert picks == {"a", "b"}
+    assert pool.placements["load"] == 1 and pool.placements["random"] == 16
+
+
+def test_route_exclude_and_no_host():
+    reg = WorkerRegistry()
+    a, b = _two_hosts(reg)
+    pool = _pool(reg)
+    w, _ = pool.route(MODEL, [], exclude=(a,))
+    assert w.instance_id == b
+    with pytest.raises(RuntimeError):
+        pool.route(MODEL, [], exclude=(a, b))
+    with pytest.raises(RuntimeError):
+        _pool(WorkerRegistry()).route(MODEL, [])
+
+
+def test_route_inflight_counts_toward_load():
+    reg = WorkerRegistry()
+    a, b = _two_hosts(reg)
+    pool = _pool(reg)
+    pool._bump_inflight(a, +2)  # two streams routed here, census not yet
+    w, reason = pool.route(MODEL, [])
+    assert (w.instance_id, reason) == (b, "load")
+
+
+# ----------------------------------------------------------------- failover
+
+class FakeWorkerClient:
+    """LlmWorkerApi-shaped fake honoring the fed continuation protocol."""
+
+    def __init__(self, tokens, crash_after=None, problem=None,
+                 input_tokens=10):
+        self.tokens = tokens          # [(token_id, text), ...]
+        self.crash_after = crash_after
+        self.problem = problem
+        self.input_tokens = input_tokens
+        self.calls = 0
+        self.closed = False
+
+    async def completion_stream(self, model, prompt, params):
+        self.calls += 1
+        if self.problem is not None:
+            raise self.problem
+        resume = params.get("_resume_token_ids") or []
+        start = len(resume)
+        emitted = 0
+        for tid, text in self.tokens[start:]:
+            if self.crash_after is not None and emitted >= self.crash_after:
+                raise ConnectionError("host died mid-stream")
+            yield ChatStreamChunk(request_id=params["_request_id"],
+                                  text=text, token_id=tid)
+            emitted += 1
+        yield ChatStreamChunk(
+            request_id=params["_request_id"], finish_reason="stop",
+            usage={"input_tokens": self.input_tokens + start,
+                   "output_tokens": len(self.tokens) - start})
+
+    async def close(self):
+        self.closed = True
+
+
+TOKENS = [(11, "Hello"), (12, " wor"), (13, "ld"), (14, "!")]
+FULL_TEXT = "Hello world!"
+
+
+def _fed_pool(clients, reg, **cfg):
+    cfg.setdefault("failover_backoff_s", 0.001)
+    return FederatedServingPool(
+        reg, lambda w: clients[w.instance_id], ChatStreamChunk,
+        FederationConfig(**cfg))
+
+
+def _collect(pool, prompt="q" * 96, **params):
+    params.setdefault("max_tokens", 16)
+
+    async def go():
+        text, finishes, usage = [], [], None
+        async for ch in pool.completion_stream(MODEL, prompt, params):
+            if ch.text:
+                text.append(ch.text)
+            if ch.finish_reason:
+                finishes.append(ch.finish_reason)
+                usage = ch.usage
+        return "".join(text), finishes, usage
+
+    return asyncio.run(go())
+
+
+def test_failover_stream_bit_identical_one_terminal():
+    reg = WorkerRegistry()
+    a, b = _two_hosts(reg)
+    reg.heartbeat(a, {"load": 0})
+    reg.heartbeat(b, {"load": 1})  # a wins the first route
+    clients = {a: FakeWorkerClient(TOKENS, crash_after=2),
+               b: FakeWorkerClient(TOKENS)}
+    pool = _fed_pool(clients, reg)
+
+    text, finishes, usage = _collect(pool)
+    assert text == FULL_TEXT
+    assert finishes == ["stop"]  # exactly one terminal crossed the failover
+    assert clients[a].calls == 1 and clients[b].calls == 1
+    # the survivor saw 2 carried tokens as resume context
+    assert pool.failovers == 1 and pool.failovers_failed == 0
+    # crash eviction: the dead host left the registry IMMEDIATELY
+    assert reg.healthy() == 1 and reg.lookup(a) is None
+    assert reg.rows()["evicted"][0]["reason"] == "crash"
+    # the crashed host's cached client was dropped (and closed)
+    assert clients[a].closed
+
+
+def test_failover_usage_moves_carried_tokens_to_output():
+    reg = WorkerRegistry()
+    a, b = _two_hosts(reg)
+    reg.heartbeat(a, {"load": 0})
+    reg.heartbeat(b, {"load": 1})
+    clients = {a: FakeWorkerClient(TOKENS, crash_after=2),
+               b: FakeWorkerClient(TOKENS)}
+    _, _, usage = _collect(_fed_pool(clients, reg))
+    # survivor reported input 10+2 / output 2; the 2 carried tokens were
+    # GENERATED work, so the patched ledger restores input 10 / output 4
+    assert usage == {"input_tokens": 10, "output_tokens": 4}
+
+
+def test_remote_problem_is_an_answer_not_a_crash():
+    reg = WorkerRegistry()
+    a, b = _two_hosts(reg)
+    reg.heartbeat(a, {"load": 0})
+    reg.heartbeat(b, {"load": 1})
+    boom = ERR.llm.context_length_exceeded.error("prompt too long")
+    clients = {a: FakeWorkerClient(TOKENS, problem=boom),
+               b: FakeWorkerClient(TOKENS)}
+    pool = _fed_pool(clients, reg)
+    with pytest.raises(ProblemError) as ei:
+        _collect(pool)
+    assert ei.value.problem.code == "context_length_exceeded"
+    # a typed problem is the worker ANSWERING: no failover, no eviction
+    assert pool.failovers == 0 and reg.healthy() == 2
+    assert clients[b].calls == 0
+
+
+def test_budget_served_synthesizes_length_terminal():
+    reg = WorkerRegistry()
+    a, b = _two_hosts(reg)
+    reg.heartbeat(a, {"load": 0})
+    reg.heartbeat(b, {"load": 1})
+    # the host dies AFTER emitting the whole token budget but BEFORE its
+    # terminal — re-prefilling on the survivor would buy zero tokens
+    clients = {a: FakeWorkerClient(TOKENS, crash_after=3),
+               b: FakeWorkerClient(TOKENS)}
+    pool = _fed_pool(clients, reg)
+    text, finishes, usage = _collect(pool, max_tokens=3)
+    assert text == "Hello world"  # 3 of 4 token texts
+    assert finishes == ["length"]
+    assert usage["output_tokens"] == 3
+    assert clients[b].calls == 0  # synthesized, not re-served
+
+
+def test_failover_exhaustion_surfaces_the_crash():
+    reg = WorkerRegistry()
+    a, b = _two_hosts(reg)
+    reg.heartbeat(a, {"load": 0})
+    reg.heartbeat(b, {"load": 1})
+    clients = {a: FakeWorkerClient(TOKENS, crash_after=0),
+               b: FakeWorkerClient(TOKENS, crash_after=0)}
+    pool = _fed_pool(clients, reg, max_failovers=1)
+    with pytest.raises(ConnectionError):
+        _collect(pool)
+    assert pool.failovers == 1 and pool.failovers_failed == 1
+    assert reg.healthy() == 0  # both corpses evicted
+
+
+def test_no_live_host_maps_to_replica_unavailable_503():
+    pool = _fed_pool({}, WorkerRegistry())
+    with pytest.raises(ProblemError) as ei:
+        _collect(pool)
+    assert ei.value.problem.code == "replica_unavailable"
+    assert ei.value.problem.status == 503
+
+
+def test_pool_monitoring_surfaces():
+    reg = WorkerRegistry()
+    a, b = _two_hosts(reg)
+    reg.heartbeat(a, {"load": 1, "requests_served": 7,
+                      "capacity": {"tenants": {"acme": {
+                          "charged_tokens": 5, "active_slots": 1,
+                          "pages": 2, "pending": 0}}}})
+    reg.heartbeat(b, {"load": 0, "capacity": {"tenants": {"acme": {
+        "charged_tokens": 3, "active_slots": 0, "pages": 1, "pending": 1}}}})
+    reg.withdraw(b)
+    pool = _fed_pool({}, reg)
+
+    view = pool.replicas_view()
+    assert len(view) == 1 and view[0]["federated"] and not \
+        view[0]["controllable"]
+    cap = pool.replica_capacity()
+    assert cap["serving"] == 1 and cap["quarantined"] == 1
+    assert cap["federated_hosts"] == 1 and cap["replicas"] == 2
+    usage = pool.tenant_usage()
+    assert usage["acme"]["charged_tokens"] == 5  # b withdrew, a remains
+    stats = pool.stats()
+    assert stats["federated"] and stats["hosts"] == 1
+    health = asyncio.run(pool.health())
+    assert health["status"] == "ok" and len(health["workers"]) == 1
+
+
+def test_pool_registry_resolves_lazily():
+    reg = WorkerRegistry()
+    holder = {}
+    pool = FederatedServingPool(lambda: holder.get("reg"), lambda w: None,
+                                ChatStreamChunk)
+    with pytest.raises(RuntimeError):
+        pool.registry()  # grpc_hub not up yet
+    holder["reg"] = reg
+    assert pool.registry() is reg
+    assert pool.registry() is reg  # cached after first resolution
