@@ -5,9 +5,9 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: safety lint lock-graph lock-graph-check shard-graph shard-graph-check modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench pd-bench fed-bench lifecycle-guard cancel-guard fairness-guard
+.PHONY: safety lint lock-graph lock-graph-check shard-graph shard-graph-check modelcheck fuzz sanitizers contracts test native aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench pd-bench fed-bench fleetobs-guard lifecycle-guard cancel-guard fairness-guard
 
-safety: lint lock-graph-check shard-graph-check modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench pd-bench fed-bench lifecycle-guard cancel-guard fairness-guard  ## the full local gate
+safety: lint lock-graph-check shard-graph-check modelcheck fuzz sanitizers contracts aot-tpu chaos trace-guard doctor doctor-guard ragged-bench overlap-bench spec-bench tp-bench pd-bench fed-bench fleetobs-guard lifecycle-guard cancel-guard fairness-guard  ## the full local gate
 
 LINT_SARIF ?= build/fabric_lint.sarif
 #: wall-clock budget for the whole-repo analyzer run (all three passes) —
@@ -98,6 +98,10 @@ pd-bench:  ## prefill/decode disaggregation tests (PD-split streams bit-identica
 fed-bench:  ## federation tests (registry/routing/failover + multi-process e2e) + the in-process-vs-2-loopback-workers cold-storm A/B (BENCH_FED.json: tokens/sec + honest gRPC overhead notes)
 	$(PY) -m pytest tests/test_federation.py tests/test_federation_e2e.py -q
 	$(PY) bench.py --fed-bench > /dev/null
+
+fleetobs-guard:  ## fleet observability tests + the payload-bearing-vs-bare-heartbeat federated storm A/B (BENCH_FLEETOBS.json, <1% tok/s bar)
+	$(PY) -m pytest tests/test_fleetscope.py -q
+	$(PY) bench.py --fleetobs-guard > /dev/null
 
 lifecycle-guard:  ## replica lifecycle tests + the disarmed-supervisor overhead A/B (BENCH_LIFECYCLE.json, <1% bar)
 	$(PY) -m pytest tests/test_lifecycle.py tests/test_replicas.py -q

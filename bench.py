@@ -1634,6 +1634,177 @@ def fed_bench() -> int:
     return 0 if report["pass"] else 1
 
 
+def fleetobs_guard() -> int:
+    """Fleet-observability payload overhead A/B (BENCH_FLEETOBS.json): the
+    same cache-cold 8-stream storm through a FederatedServingPool over TWO
+    real worker subprocesses on loopback, with the workers' heartbeats
+    CARRYING the fleetscope observability payload — metrics snapshot +
+    doctor report + flight-recorder terminal summaries, folded on the
+    gateway by the FleetView on every route's health rung (the production
+    state) — vs ``observability.enabled: false`` workers sending bare
+    census heartbeats. Interleaved ABBA ordering, per-arm BEST tokens/sec
+    (on a shared host contention only ever slows a run down), <1% bar.
+
+    Both arms pay the identical wire path (JSON-gRPC per token, 0.25s
+    heartbeats, health-rung lookup per route), so the delta isolates
+    exactly what fabric-fleetscope ADDED: the worker-side snapshot/report
+    build per heartbeat and the gateway-side FleetDoctor fold per census
+    refresh."""
+    import asyncio
+
+    reps = int(os.environ.get("BENCH_FLEETOBS_REPS", "2"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from cyberfabric_core_tpu.modkit.transport_grpc import JsonGrpcServer
+    from cyberfabric_core_tpu.modules.grpc_hub import \
+        register_worker_registry_service
+    from cyberfabric_core_tpu.modules.llm_gateway.grpc_service import (
+        GrpcLlmWorkerClient, model_ref_dict)
+    from cyberfabric_core_tpu.modules.sdk import ChatStreamChunk, ModelInfo
+    from cyberfabric_core_tpu.runtime.federation import (
+        FederatedServingPool, FederationConfig, WorkerRegistry)
+
+    model = ModelInfo(
+        canonical_id="local::fleetobs-tiny", provider_slug="local",
+        provider_model_id="fleetobs-tiny", managed=True,
+        architecture="llama",
+        engine_options={"model_config": "tiny-llama", "max_seq_len": 256,
+                        "max_batch": 8, "decode_chunk": 8})
+    n_streams, max_tokens = 8, 32
+    prompts = [f"fleetobs storm stream {i:02d} distinct cold payload " * 3
+               for i in range(n_streams)]
+
+    async def run_arm(obs_enabled: bool) -> dict:
+        registry = WorkerRegistry(lease_ttl_s=10.0)
+        server = JsonGrpcServer()
+        register_worker_registry_service(server, registry)
+        port = await server.start("127.0.0.1:0")
+        procs: list[subprocess.Popen] = []
+        pool = FederatedServingPool(
+            registry, lambda w: GrpcLlmWorkerClient(endpoint=w.endpoint),
+            ChatStreamChunk, FederationConfig(seed=0))
+        loop = asyncio.get_running_loop()
+        try:
+            for i in range(2):
+                cfg = json.dumps({
+                    "hub_endpoint": f"127.0.0.1:{port}",
+                    "host": f"obs-worker-{i}", "worker": {},
+                    "observability": {"enabled": obs_enabled},
+                    "models": [model_ref_dict(model)],
+                    "heartbeat_interval_s": 0.25})
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m",
+                     "cyberfabric_core_tpu.modules.llm_gateway.worker"],
+                    env={**os.environ, "JAX_PLATFORMS": "cpu",
+                         "FED_WORKER_CONFIG": cfg},
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True))
+            for p in procs:
+                line = await asyncio.wait_for(
+                    loop.run_in_executor(None, p.stdout.readline), 240.0)
+                if not line:
+                    raise RuntimeError("fleetobs worker died before READY "
+                                       f"(rc={p.poll()})")
+            # warm: compile paid before the clock in both arms
+            async for _ in pool.completion_stream(
+                    model, prompts[0], {"max_tokens": 2,
+                                        "_request_id": "fleetobs-warm"}):
+                pass
+
+            stats = {"tokens": 0, "errors": 0, "finished": 0}
+
+            async def one(i: int, prompt: str) -> None:
+                chunks = usage_tokens = 0
+                try:
+                    async for chunk in pool.completion_stream(
+                            model, prompt,
+                            {"max_tokens": max_tokens,
+                             "_request_id": f"fleetobs-{i}"}):
+                        if chunk.text:
+                            chunks += 1
+                        if chunk.finish_reason:
+                            stats["finished"] += 1
+                            usage_tokens = (chunk.usage or {}).get(
+                                "output_tokens", 0)
+                except Exception as e:  # noqa: BLE001
+                    log(f"fleetobs stream {i} failed: {e}")
+                    stats["errors"] += 1
+                stats["tokens"] += usage_tokens or chunks
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(one(i, p)
+                                   for i, p in enumerate(prompts)))
+            wall = time.perf_counter() - t0
+            # in the payload arm the fold must actually have health data —
+            # otherwise the guard would "pass" by measuring nothing
+            states = pool.fleet.doctor.host_states() if obs_enabled else {}
+            return {"tokens_per_sec": round(
+                        stats["tokens"] / max(wall, 1e-9), 1),
+                    "wall_s": round(wall, 2),
+                    "complete": stats["finished"] == n_streams,
+                    "errors": stats["errors"],
+                    "hosts_reporting": len(states)}
+        finally:
+            await pool.close()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=30)
+                if p.stdout is not None:
+                    p.stdout.close()
+            await server.stop()
+
+    arms: dict[str, list[dict]] = {"payload": [], "bare": []}
+    order = (["payload", "bare", "bare", "payload"]
+             * ((reps + 1) // 2))[: 2 * reps]
+    for arm in order:
+        try:
+            row = asyncio.run(run_arm(obs_enabled=(arm == "payload")))
+        except Exception as e:  # noqa: BLE001
+            log(f"fleetobs-guard {arm} run failed: {e}")
+            continue
+        arms[arm].append(row)
+
+    def best(rows: list[dict]) -> Optional[dict]:
+        return max(rows, key=lambda r: r.get("tokens_per_sec") or 0.0) \
+            if rows else None
+
+    bp, bb = best(arms["payload"]), best(arms["bare"])
+    report: dict = {
+        "kind": "fleetobs_payload_ab_cpu_evidence",
+        "note": "cache-cold 8-stream federated storm over 2 loopback "
+                "worker subprocesses: heartbeats carrying the fleetscope "
+                "observability payload (worker doctor + metrics snapshot "
+                "+ terminals, FleetView fold live on the routing path) vs "
+                "observability disabled (bare census); interleaved ABBA "
+                "runs, per-arm best tokens/sec, <1% overhead bar",
+        "runs": arms, "payload": bp, "bare": bb,
+    }
+    if bp and bb:
+        overhead_pct = round(
+            (1.0 - bp["tokens_per_sec"]
+             / max(bb["tokens_per_sec"], 1e-9)) * 100.0, 3)
+        report.update({
+            "overhead_pct": overhead_pct,
+            "within_run_spread": {
+                k: (round(max(r["tokens_per_sec"] for r in v)
+                          / max(1e-9, min(r["tokens_per_sec"] for r in v))
+                          - 1.0, 4) if v else None)
+                for k, v in arms.items()},
+            "pass": bool(bp.get("complete") and bb.get("complete")
+                         and bp.get("errors") == 0 and bb.get("errors") == 0
+                         and bp.get("hosts_reporting") == 2
+                         and overhead_pct < 1.0),
+        })
+    else:
+        report["pass"] = False
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_FLEETOBS.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return 0 if report["pass"] else 1
+
+
 def aggregate(model_name: str, quant: str) -> int:
     """8 concurrent streams through the continuous scheduler (paged KV pool +
     ragged paged decode attention), with STAGGERED arrivals — the pattern the
@@ -2292,6 +2463,8 @@ if __name__ == "__main__":
         sys.exit(pd_bench())
     if len(sys.argv) > 1 and sys.argv[1] == "--fed-bench":
         sys.exit(fed_bench())
+    if len(sys.argv) > 1 and sys.argv[1] == "--fleetobs-guard":
+        sys.exit(fleetobs_guard())
     if len(sys.argv) > 1 and sys.argv[1] == "--embed":
         sys.exit(embed_bench())
     if len(sys.argv) > 3 and sys.argv[1] == "--cost":

@@ -104,13 +104,25 @@ _CALLBACK_PREFIXES = ("evaluate", "_evaluate", "on_record", "ingest",
 _FED_MARKERS = ("Registry", "Federated")
 _FED_PREFIXES = ("heartbeat", "route", "on_lease_expired")
 
+#: fleet observability fold (fabric-fleetscope), a THIRD product: a
+#: FleetDoctor/FleetView ``on_report`` runs per heartbeat per host on the
+#: census refresh path and ``merge*`` on every /readyz probe and routing
+#: health check — and both consume REMOTE worker payloads, so on top of the
+#: non-blocking contract they must never let a hostile dict shape escape as
+#: an exception. (``FleetDoctor`` also carries the ``Doctor`` marker, so
+#: its evaluate*/on_record surfaces stay bound to the doctor prefixes —
+#: intended layering, not double-counting.)
+_FLEET_MARKERS = ("FleetDoctor", "FleetView")
+_FLEET_PREFIXES = ("merge", "on_report")
+
 _DOCTOR_MARKERS = ("Doctor", "Watchdog", "Supervisor", "Lifecycle",
                    "Engine", "ServingPool", "FairQueue")
 
 #: each group is (class-name markers, callback-name prefixes); a class is
 #: checked under the union of prefixes of every group whose marker matches
 _GROUPS = ((_DOCTOR_MARKERS, _CALLBACK_PREFIXES),
-           (_FED_MARKERS, _FED_PREFIXES))
+           (_FED_MARKERS, _FED_PREFIXES),
+           (_FLEET_MARKERS, _FLEET_PREFIXES))
 
 
 def _class_prefixes(node: ast.ClassDef) -> tuple[str, ...]:

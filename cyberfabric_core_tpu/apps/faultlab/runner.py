@@ -2301,6 +2301,397 @@ def _run_worker_host_crash_scenario(spec: dict) -> ScenarioResult:
         evicted=out.get("evicted"), killed_host=crash.get("killed_host"))
 
 
+def _run_fleet_doctor_shed_scenario(spec: dict) -> ScenarioResult:
+    """fabric-fleetscope's acceptance cycle on a REAL federated stack: one
+    gateway (grpc_hub + llm_gateway ``federation.enabled`` + monitoring)
+    and TWO worker subprocesses on loopback, each running its own tight
+    fabric-doctor that piggybacks reports on the heartbeat census. A
+    ``scheduler.readback`` delay is armed ON one worker host over the
+    guarded REST plane (``PUT /v1/monitoring/failpoints/{name}`` with a
+    ``host`` body — the arm crosses the wire and fires in the WORKER
+    process), and the fleet fold must tell the whole story:
+
+    - prefix-affine traffic pins the burn to the armed host; its itl
+      objective blows and ``GET /v1/monitoring/fleet`` marks the host
+      ``degraded`` off nothing but heartbeats;
+    - the router's health rung steers NEW requests to the healthy host
+      (timelines prove the placement) while streams served under the delay
+      stay bit-identical to the pre-arm baseline — the fault changes only
+      latency, never tokens;
+    - the gateway's own /readyz keeps its 200 (a sick WORKER host must not
+      get the gateway mass-evicted) but carries the host-level reason;
+    - disarming over REST drains the worker's windows and the fleet table
+      walks the host back to ``healthy``, after which it serves the
+      baseline again.
+
+    The fingerprint hashes the delivered texts + the observed state edges —
+    host names, pids, and timing stay out (which of the two hosts gets
+    armed depends on routing, not on the seed alone).
+    """
+    import os
+    import subprocess
+    import sys
+
+    from ... import modules  # noqa: F401 — registers every module
+    from ...modkit import AppConfig, ClientHub, ModuleRegistry, RunOptions
+    from ...modkit.db import DbManager
+    from ...modkit.runtime import HostRuntime
+    from ...modules.llm_gateway.grpc_service import model_ref_dict
+    from ...modules.sdk import ModelInfo
+
+    seed = int(spec.get("seed", 0))
+    lease_ttl_s = float(spec.get("lease_ttl_s", 4.0))
+    delay_spec = spec.get("delay_spec", "delay(0.4)")
+    itl_threshold_ms = float(spec.get("itl_threshold_ms", 30.0))
+    max_tokens = int((spec.get("load") or {}).get("max_tokens", 8))
+
+    # decode_chunk 2: itl_ms derives from gaps BETWEEN decode_chunk flight
+    # events — at the default chunk of 8 an 8-token request has a single
+    # event and the workers' itl objective never sees a sample
+    engine_options = {"model_config": "tiny-llama", "max_seq_len": 256,
+                      "max_batch": 4, "decode_chunk": 2}
+    model = ModelInfo(
+        canonical_id="local::tiny-llama", provider_slug="local",
+        provider_model_id="tiny-llama", managed=True, architecture="llama",
+        engine_options=engine_options)
+    # >= 2 digest blocks so the armed host's gossiped prefix chain keeps
+    # pulling the burn traffic back to IT (not the healthy host)
+    prompt_burn = f"fleetscope burn probe seed {seed} " * 4
+    prompt_probe = f"fleetscope steering probe seed {seed} " * 4
+
+    #: the WORKER-side doctor: tight windows so the cycle completes in
+    #: seconds. min_samples 1 because a faulted request outlasts the fast
+    #: window (terminals arrive one per window at best); shed_after is high
+    #: on purpose — the scenario proves the GATEWAY steers on ``degraded``,
+    #: not that the worker self-sheds — and recover_after keeps the host
+    #: degraded through the probe phase instead of flapping back
+    worker_doctor = {
+        "eval_interval_s": 0.1, "fast_window_s": 4.0, "slow_window_s": 8.0,
+        "min_samples": 1, "shed_after": 1000, "recover_after": 40,
+        # only the itl objective is under test — at min_samples 1 the
+        # default ttft/queue/error objectives become hair-triggers (one
+        # cold compile would degrade the HEALTHY host too), so pin them
+        # untrippable
+        "objectives": {"itl_p99": {"threshold_ms": itl_threshold_ms},
+                       "ttft_p95": {"threshold_ms": 120000.0},
+                       "queue_wait_p95": {"threshold_ms": 120000.0},
+                       "error_rate": {"budget": 1.0}},
+        "stream_stall_s": 120.0, "round_stall_floor_s": 120.0,
+        "queue_deadline_s": 120.0,
+    }
+    config = {
+        "modules": {
+            "api_gateway": {"config": {"bind_addr": "127.0.0.1:0",
+                                       "timeout_secs": 30.0}},
+            "tenant_resolver": {"config": {"tenants": {
+                "root": {}, "acme": {"parent": "root"}}}},
+            "authn_resolver": {"config": {"mode": "accept_all",
+                                          "default_tenant": "acme"}},
+            "authz_resolver": {},
+            "types_registry": {}, "types": {},
+            "module_orchestrator": {},
+            "nodes_registry": {"config": {"tenant": "acme"}},
+            "model_registry": {"config": {
+                "seed_tenant": "acme",
+                "models": [{
+                    "provider_slug": "local",
+                    "provider_model_id": "tiny-llama",
+                    "approval_state": "approved", "managed": True,
+                    "architecture": "llama", "format": "safetensors",
+                    "capabilities": {"chat": True, "streaming": True},
+                    "limits": {"max_input_tokens": 200,
+                               "max_output_tokens": 64},
+                    "engine_options": engine_options}]}},
+            "grpc_hub": {"config": {"bind_addr": "127.0.0.1:0",
+                                    "worker_lease_ttl_s": lease_ttl_s,
+                                    "eviction_interval_s": 0.5}},
+            "llm_gateway": {"config": {"federation": {
+                "enabled": True, "failover_backoff_s": 0.01,
+                "seed": seed}}},
+            # the GATEWAY doctor stays generous: only the armed WORKER's
+            # doctor may degrade, so the fleet fold (not local burn) is
+            # what the assertions read
+            "monitoring": {"config": {
+                "allow_fault_injection": True,
+                "doctor": {
+                    "objectives": {"ttft_p95": {"threshold_ms": 120000.0,
+                                                "budget": 0.5}},
+                    "stream_stall_s": 300.0, "round_stall_floor_s": 300.0,
+                    "queue_deadline_s": 300.0, "shed_after": 1000}}},
+        }
+    }
+
+    async def go() -> dict[str, Any]:
+        import aiohttp
+
+        out: dict[str, Any] = {}
+        cfg = AppConfig.load_or_default(environ={}, cli_overrides=config)
+        registry = ModuleRegistry.discover_and_build(
+            enabled=cfg.module_names())
+        opts = RunOptions(config=cfg, registry=registry,
+                          client_hub=ClientHub(),
+                          db_manager=DbManager(in_memory=True))
+        rt = HostRuntime(opts)
+        await rt.run_setup_phases()
+        gw = registry.get("api_gateway").instance
+        hub = registry.get("grpc_hub").instance
+        base = f"http://127.0.0.1:{gw.bound_port}"
+        procs: list[subprocess.Popen] = []
+        loop = asyncio.get_running_loop()
+        try:
+            for i in range(2):
+                cfg_json = json.dumps({
+                    "hub_endpoint": hub.endpoint,
+                    "host": f"fleet-{i}", "worker": {},
+                    "observability": {"allow_fault_injection": True,
+                                      "doctor": worker_doctor},
+                    "models": [model_ref_dict(model)],
+                    "heartbeat_interval_s": 0.25})
+
+                def spawn(c: str = cfg_json) -> subprocess.Popen:
+                    return subprocess.Popen(
+                        [sys.executable, "-m",
+                         "cyberfabric_core_tpu.modules.llm_gateway.worker"],
+                        env={**os.environ, "JAX_PLATFORMS": "cpu",
+                             "FED_WORKER_CONFIG": c},
+                        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                        text=True)
+
+                procs.append(await loop.run_in_executor(None, spawn))
+            for p in procs:
+                line = await asyncio.wait_for(
+                    loop.run_in_executor(None, p.stdout.readline), 240.0)
+                if not line:
+                    raise RuntimeError("worker died before READY "
+                                       f"(rc={p.poll()})")
+
+            async with aiohttp.ClientSession() as s:
+                async def completion(prompt: str, rid: str) -> str:
+                    async with s.post(
+                            f"{base}/v1/completions",
+                            headers={"X-Request-Id": rid},
+                            json={"model": model.canonical_id,
+                                  "prompt": prompt,
+                                  "max_tokens": max_tokens}) as r:
+                        body = await r.json()
+                        if r.status != 200:
+                            raise RuntimeError(f"completion {r.status}: "
+                                               f"{body}")
+                        return body["content"][0]["text"]
+
+                async def fleet(host: Optional[str] = None
+                                ) -> tuple[int, dict]:
+                    url = f"{base}/v1/monitoring/fleet"
+                    if host:
+                        url += f"?host={host}"
+                    async with s.get(url) as r:
+                        return r.status, await r.json()
+
+                async def served_by(rid: str) -> Optional[str]:
+                    async with s.get(
+                            f"{base}/v1/monitoring/requests/{rid}") as r:
+                        body = await r.json()
+                        return body.get("worker_host") \
+                            if r.status == 200 else None
+
+                async def host_state(host: str) -> str:
+                    st, doc = await fleet(host)
+                    if st != 200 or not doc.get("hosts"):
+                        return "unknown"
+                    return str(doc["hosts"][0].get("state", "unknown"))
+
+                # phase 0 — both hosts announce and the fleet fold sees
+                # their heartbeat reports; unknown host is a typed 404
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    st, doc = await fleet()
+                    if st == 200 and doc.get("workers") == 2:
+                        break
+                    await asyncio.sleep(0.2)
+                out["fleet_workers"] = doc.get("workers")
+                out["federation_flag"] = doc.get("federation")
+                st, problem = await fleet("no-such-host")
+                out["unknown_host"] = {"status": st,
+                                       "code": problem.get("code")}
+
+                # phase 1 — warm BOTH hosts (cold-compile itl transients
+                # must drain before any state edge counts), then baseline
+                warm_hosts: set = set()
+                for i in range(8):
+                    rid = f"fls-warm-{seed}-{i}"
+                    await completion(f"fleetscope warmup {seed} {i} " * 4,
+                                     rid)
+                    h = await served_by(rid)
+                    if h:
+                        warm_hosts.add(h)
+                    if len(warm_hosts) == 2 and i >= 3:
+                        break
+                out["warmed_hosts"] = sorted(warm_hosts)
+                base_burn = await completion(prompt_burn,
+                                             f"fls-base-{seed}")
+                base_probe = await completion(prompt_probe,
+                                              f"fls-base2-{seed}")
+                target = await served_by(f"fls-base-{seed}")
+                out["target_found"] = bool(target)
+                healthy = [h for h in ("fleet-0", "fleet-1")
+                           if h != target][0]
+                # let warmup transients age out of the 4s fast window so
+                # the armed host is the ONLY one that can degrade
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline:
+                    states = [await host_state(h)
+                              for h in ("fleet-0", "fleet-1")]
+                    if states == ["healthy", "healthy"]:
+                        break
+                    await asyncio.sleep(0.25)
+                out["pre_arm_states"] = states
+
+                # phase 2 — arm the delay ON the target host over REST;
+                # prefix affinity keeps pulling prompt_burn back to it
+                async with s.put(
+                        f"{base}/v1/monitoring/failpoints/"
+                        "scheduler.readback",
+                        json={"spec": delay_spec, "seed": seed,
+                              "host": target}) as r:
+                    out["armed"] = {"status": r.status,
+                                    **(await r.json())}
+
+                burn_texts: list[str] = []
+                sick_state = None
+                deadline = time.monotonic() + 90.0
+                i = 0
+                while time.monotonic() < deadline:
+                    state = await host_state(target)
+                    if state in ("degraded", "shedding"):
+                        sick_state = state
+                        break
+                    burn_texts.append(await completion(
+                        prompt_burn, f"fls-burn-{seed}-{i}"))
+                    i += 1
+                out["sick_state"] = sick_state
+                out["burn_texts_match"] = all(t == base_burn
+                                              for t in burn_texts)
+                out["burn_requests"] = len(burn_texts)
+                st, doc = await fleet()
+                out["fleet_state"] = doc.get("state")
+                out["fleet_reasons"] = doc.get("reasons")
+                async with s.get(f"{base}/readyz") as r:
+                    out["readyz"] = {"status": r.status,
+                                     "reasons": (await r.json()
+                                                 ).get("reasons", [])}
+
+                # phase 3 — the health rung steers NEW requests off the
+                # sick host (timelines prove it), tokens stay identical
+                probe_hosts, probe_texts = [], []
+                for i in range(3):
+                    rid = f"fls-probe-{seed}-{i}"
+                    probe_texts.append(await completion(prompt_probe, rid))
+                    probe_hosts.append(await served_by(rid))
+                out["probe_hosts"] = probe_hosts
+                out["probes_avoid_sick"] = all(h == healthy
+                                               for h in probe_hosts)
+                out["probe_texts_match"] = all(t == base_probe
+                                               for t in probe_texts)
+
+                # the host-labeled rung is on the federated /metrics
+                async with s.get(f"{base}/metrics") as r:
+                    text = await r.text()
+                out["host_labeled_metrics"] = (
+                    f'llm_remote_workers_healthy{{host="{target}"}}' in text
+                    and "llm_federated_placements_total" in text)
+
+                # phase 4 — disarm over REST; the worker's windows drain
+                # and the fleet table walks the host back to healthy
+                async with s.delete(
+                        f"{base}/v1/monitoring/failpoints/"
+                        f"scheduler.readback?host={target}") as r:
+                    out["disarmed"] = {"status": r.status,
+                                       **(await r.json())}
+                recovered = None
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    state = await host_state(target)
+                    if state == "healthy":
+                        recovered = state
+                        break
+                    await asyncio.sleep(0.25)
+                out["recovered_state"] = recovered
+                out["final_text_matches"] = (await completion(
+                    prompt_burn, f"fls-final-{seed}")) == base_burn
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=30)
+                if p.stdout is not None:
+                    p.stdout.close()
+            from ...modkit.doctor import DoctorConfig, default_doctor
+
+            rt.root_token.cancel()
+            await rt.run_stop_phase()
+            default_doctor.stop()
+            default_doctor.set_fleet_provider(None)
+            default_doctor.configure(DoctorConfig())
+        return out
+
+    out = asyncio.run(go())
+    invariants = {
+        "fleet_endpoint_sees_both_hosts": (
+            [] if (out.get("federation_flag") is True
+                   and out.get("fleet_workers") == 2) else
+            [f"workers={out.get('fleet_workers')} "
+             f"federation={out.get('federation_flag')}"]),
+        "unknown_host_is_typed_404": (
+            [] if out.get("unknown_host") == {
+                "status": 404, "code": "unknown_host"} else
+            [f"?host=no-such-host → {out.get('unknown_host')}"]),
+        "armed_over_rest_on_worker": (
+            [] if (out.get("armed", {}).get("status") == 200
+                   and out.get("armed", {}).get("host")) else
+            [f"cross-host arm → {out.get('armed')}"]),
+        "burn_marks_host_degraded": (
+            [] if out.get("sick_state") in ("degraded", "shedding") else
+            [f"armed host never degraded (state={out.get('sick_state')}, "
+             f"{out.get('burn_requests')} burn requests)"]),
+        "fleet_reasons_name_the_host": (
+            [] if any("fleet-" in r for r in out.get("fleet_reasons", []))
+            else [f"fleet reasons {out.get('fleet_reasons')}"]),
+        "gateway_readyz_stays_200_with_reason": (
+            [] if (out.get("readyz", {}).get("status") == 200
+                   and any("fleet-" in r for r in
+                           out.get("readyz", {}).get("reasons", []))) else
+            [f"/readyz → {out.get('readyz')}"]),
+        "routing_steers_to_healthy_host": (
+            [] if out.get("probes_avoid_sick") else
+            [f"probe hosts {out.get('probe_hosts')}"]),
+        "streams_bit_identical_under_fault": (
+            [] if (out.get("burn_texts_match")
+                   and out.get("probe_texts_match")) else
+            ["texts diverged under the armed delay"]),
+        "host_labeled_metrics_exported": (
+            [] if out.get("host_labeled_metrics") else
+            ["llm_remote_workers_healthy{host=...} missing from /metrics"]),
+        "disarm_walks_host_back_healthy": (
+            [] if (out.get("disarmed", {}).get("status") == 200
+                   and out.get("recovered_state") == "healthy") else
+            [f"recovery: disarm={out.get('disarmed')} "
+             f"state={out.get('recovered_state')}"]),
+        "healthy_again_serves_baseline": (
+            [] if out.get("final_text_matches") else
+            ["post-recovery text diverged from baseline"]),
+    }
+    return _finish(
+        spec["name"], "fleet_doctor_shed", seed, invariants,
+        {"sick_state": out.get("sick_state"),
+         "recovered_state": out.get("recovered_state"),
+         "texts_match": [out.get("burn_texts_match"),
+                         out.get("probe_texts_match"),
+                         out.get("final_text_matches")],
+         "unknown_host": out.get("unknown_host")},
+        fleet_state=out.get("fleet_state"),
+        burn_requests=out.get("burn_requests"))
+
+
 # ------------------------------------------------------------------ dispatch
 
 _KINDS = {
@@ -2320,6 +2711,7 @@ _KINDS = {
     "serverless": _run_serverless_scenario,
     "worker": _run_worker_scenario,
     "worker_host_crash": _run_worker_host_crash_scenario,
+    "fleet_doctor_shed": _run_fleet_doctor_shed_scenario,
     "grpc_evict": _run_grpc_evict_scenario,
     "slo_burn": _run_slo_burn_scenario,
     "stall": _run_stall_scenario,

@@ -420,6 +420,26 @@ BUILTIN_SCENARIOS: list[dict[str, Any]] = [
         "load": {"max_tokens": 16},
         "faults": [{"point": "federation.route", "spec": "1*raise"}],
     },
+    {
+        # fabric-fleetscope: two REAL loopback worker hosts behind one
+        # gateway; a readback delay armed over REST onto worker-0 ONLY
+        # (PUT body {"host": ...} forwarded over the observability wire)
+        # burns that host's itl objective in ITS process; the heartbeat
+        # payload walks the gateway's FleetDoctor to degraded/shedding,
+        # GET /v1/monitoring/fleet marks the host, new requests provably
+        # steer to the healthy survivor (placement reason "health"),
+        # streams stay bit-identical to the unfaulted run, and disarming
+        # walks the host back to healthy within the recovery hysteresis
+        "name": "fleet-doctor-shed",
+        "kind": "fleet_doctor_shed",
+        "seed": 407,
+        "lease_ttl_s": 4.0,
+        # delay(0.4) per decode_chunk-2 readback ≈ 200ms/token mean itl —
+        # far over the 60ms objective; ambient CPU mean itl sits well under
+        "delay_spec": "delay(0.4)",
+        "itl_threshold_ms": 60.0,
+        "load": {"max_tokens": 8},
+    },
     # ---- tenant isolation (weighted-fair queue + selective shedding) ---
     {
         # one tenant floods 32 requests while a light tenant sends 4: the
@@ -529,4 +549,7 @@ def covered_points(specs: list[dict[str, Any]] | None = None) -> set[str]:
             out.add("grpc_hub.evict")
         if spec.get("kind") == "slo_burn":
             out.add("scheduler.readback")  # armed over REST, not via faults
+        if spec.get("kind") == "fleet_doctor_shed":
+            # armed over REST with {"host": ...}, fired in the worker process
+            out.add("scheduler.readback")
     return out

@@ -42,6 +42,14 @@ class Counter:
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
+    def samples(self) -> list[tuple[dict[str, str], float]]:
+        """Point-in-time (labels, value) pairs — the wire-snapshot feed.
+        Counters export their CUMULATIVE value: the fleet aggregator merges
+        by (host, labels), so cumulative survives heartbeat loss where a
+        delta stream would drop increments."""
+        with self._lock:
+            return [(dict(k), v) for k, v in sorted(self._values.items())]
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -74,8 +82,7 @@ class Gauge:
         with self._lock:
             self._fns[key] = fn
 
-    def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+    def _evaluated(self) -> dict[tuple, float]:
         with self._lock:
             values = dict(self._values)
             fns = list(self._fns.items())
@@ -84,7 +91,16 @@ class Gauge:
                 values[key] = float(fn())
             except Exception:  # noqa: BLE001 — scrape must not fail
                 pass
-        for key, v in sorted(values.items()):
+        return values
+
+    def samples(self) -> list[tuple[dict[str, str], float]]:
+        """(labels, value) pairs with scrape-time functions evaluated —
+        the snapshot sees the same values a local scrape would."""
+        return [(dict(k), v) for k, v in sorted(self._evaluated().items())]
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for key, v in sorted(self._evaluated().items()):
             out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
         return out
 
@@ -123,6 +139,17 @@ class Histogram:
             if c >= target:
                 return self.buckets[i]
         return self.buckets[-1]
+
+    def samples(self) -> list[tuple[dict[str, str], dict]]:
+        """(labels, {buckets, sum, count}) per label set — cumulative bucket
+        counts keyed by upper bound, JSON-safe for the heartbeat wire."""
+        with self._lock:
+            snapshot = [(key, list(self._counts[key]), self._sums[key],
+                         self._totals[key]) for key in sorted(self._counts)]
+        return [(dict(key),
+                 {"buckets": {str(b): c for b, c in zip(self.buckets, counts)},
+                  "sum": total_sum, "count": total})
+                for key, counts, total_sum, total in snapshot]
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
@@ -173,6 +200,25 @@ class MetricsRegistry:
             for name in sorted(self._metrics):
                 lines.extend(self._metrics[name].render())
         return "\n".join(lines) + "\n"
+
+    def snapshot(self, prefix: str = "") -> dict[str, dict]:
+        """JSON-safe export of every metric whose name starts with ``prefix``:
+        ``{name: {type, help, samples}}``. This is what a federated worker
+        piggybacks on its heartbeat census — counters cumulative, gauges
+        evaluated, histograms as bucket maps — so the gateway can re-render
+        the family host-labeled without ever mutating its own registry."""
+        with self._lock:
+            metrics = [(name, m) for name, m in sorted(self._metrics.items())
+                       if name.startswith(prefix)]
+        out: dict[str, dict] = {}
+        for name, m in metrics:
+            kind = type(m).__name__.lower()
+            try:
+                samples = [[labels, value] for labels, value in m.samples()]
+            except Exception:  # noqa: BLE001 — export must not fail a heartbeat
+                continue
+            out[name] = {"type": kind, "help": m.help, "samples": samples}
+        return out
 
 
 #: process-global default registry (modules grab it via ClientHub or directly)

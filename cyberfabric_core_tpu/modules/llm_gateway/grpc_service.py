@@ -283,3 +283,96 @@ class GrpcLlmWorkerClient(LlmWorkerApi):
         if self._client is not None:
             await self._client.close()
             self._client = None
+
+
+# ------------------------------------------------- observability service
+
+#: fleet observability pull plane (fabric-fleetscope): full per-request
+#: flight-recorder timelines on demand + faultlab's cross-host failpoint
+#: arm path. JSON-over-gRPC like the registry service — observability
+#: payloads are open-world dicts, a fixed IDL would fight every new field.
+WORKER_OBS_SERVICE = "fabricobs.v1.WorkerObservability"
+
+
+def register_worker_observability_service(
+        server: Any, *, allow_fault_injection: bool = False,
+        auth_token: Optional[str] = None) -> None:
+    """Expose the worker process's flight recorder (and, when faultlab is
+    enabled for the stack, its failpoint registry) over the gRPC hub.
+
+    Same trust boundary as the worker service: intra-cluster plane. The
+    failpoint methods are additionally gated on ``allow_fault_injection``
+    mirroring the REST layer's faultlab guard — a production worker refuses
+    them even from an authenticated gateway."""
+    from ...modkit import failpoints as fp
+    from ...modkit.flight_recorder import default_recorder
+
+    async def timeline(req: dict) -> dict:
+        rec = default_recorder.lookup(str(req.get("request_id") or ""))
+        if rec is None:
+            return {"found": False}
+        return {"found": True, "record": rec}
+
+    def _gate() -> Optional[dict]:
+        if not allow_fault_injection:
+            return {"ok": False, "error": "fault_injection_disabled"}
+        return None
+
+    async def arm_failpoint(req: dict) -> dict:
+        refused = _gate()
+        if refused:
+            return refused
+        name = str(req.get("name") or "")
+        if name not in fp.FAILPOINT_CATALOG:
+            return {"ok": False, "error": f"unknown failpoint {name!r}"}
+        if req.get("seed") is not None:
+            fp.configure(seed=int(req["seed"]))
+        try:
+            fp.arm(name, req.get("spec") or "raise")
+        except (TypeError, ValueError) as e:
+            return {"ok": False, "error": f"bad spec: {e}"}
+        return {"ok": True, "name": name}
+
+    async def disarm_failpoint(req: dict) -> dict:
+        refused = _gate()
+        if refused:
+            return refused
+        name = str(req.get("name") or "")
+        if name not in fp.FAILPOINT_CATALOG:
+            return {"ok": False, "error": f"unknown failpoint {name!r}"}
+        fp.disarm(name)
+        return {"ok": True, "name": name}
+
+    server.add_service(
+        WORKER_OBS_SERVICE,
+        {"Timeline": timeline, "ArmFailpoint": arm_failpoint,
+         "DisarmFailpoint": disarm_failpoint},
+        auth_token=auth_token,
+    )
+
+
+class WorkerObservabilityClient:
+    """Gateway-side client for one worker host's observability plane."""
+
+    def __init__(self, endpoint: str,
+                 auth_token: Optional[str] = None) -> None:
+        self._client = JsonGrpcClient(endpoint, auth_token=auth_token)
+
+    async def timeline(self, request_id: str) -> dict:
+        return await self._client.call(WORKER_OBS_SERVICE, "Timeline",
+                                       {"request_id": request_id})
+
+    async def arm_failpoint(self, name: str, spec: Any = "raise",
+                            seed: Optional[int] = None) -> dict:
+        req: dict[str, Any] = {"name": name, "spec": spec}
+        if seed is not None:
+            req["seed"] = seed
+        return await self._client.call(WORKER_OBS_SERVICE, "ArmFailpoint",
+                                       req)
+
+    async def disarm_failpoint(self, name: str) -> dict:
+        return await self._client.call(WORKER_OBS_SERVICE, "DisarmFailpoint",
+                                       {"name": name})
+
+    async def close(self) -> None:
+        await self._client.close()

@@ -358,10 +358,12 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         the ClientHub (grpc_hub may init after this module — no dep
         ordering), each placed host gets a cached GrpcLlmWorkerClient, and
         synthesized terminals use the SDK's ChatStreamChunk."""
+        from ...modkit.doctor import default_doctor
         from ...runtime.federation import (FederatedServingPool,
                                            FederationConfig)
         from ..sdk import ChatStreamChunk, WorkerRegistryApi
-        from .grpc_service import GrpcLlmWorkerClient
+        from .grpc_service import (GrpcLlmWorkerClient,
+                                   WorkerObservabilityClient)
 
         # the pool is runtime-tier (transport-free, no modules import), so
         # it satisfies the worker contract as an abc VIRTUAL subclass —
@@ -374,6 +376,10 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
         def client_factory(w: Any) -> GrpcLlmWorkerClient:
             return GrpcLlmWorkerClient(endpoint=w.endpoint, auth_token=auth)
 
+        def obs_client_factory(w: Any) -> WorkerObservabilityClient:
+            return WorkerObservabilityClient(w.endpoint, auth_token=auth)
+
+        obs = dict(fed.get("observability") or {})
         config = FederationConfig(
             prefix_slack=int(fed.get("prefix_slack", 2)),
             max_failovers=int(fed.get("max_failovers", 2)),
@@ -381,10 +387,18 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
             block_chars=int(fed.get("block_chars", 48)),
             max_blocks=int(fed.get("max_blocks", 64)),
             seed=int(fed.get("seed", 0)),
+            stitch_timeout_s=float(obs.get("stitch_timeout_s", 2.0)),
+            host_metrics=bool(obs.get("host_metrics", True)),
         )
-        return FederatedServingPool(
+        pool = FederatedServingPool(
             lambda: hub.try_get(WorkerRegistryApi),
-            client_factory, ChatStreamChunk, config)
+            client_factory, ChatStreamChunk, config,
+            obs_client_factory=obs_client_factory)
+        # /readyz tells the whole-fleet truth: host-level doctor reasons
+        # from the heartbeat fold ride along with the local state (cleared
+        # in stop() — a dead stack's fleet must not haunt the next one)
+        default_doctor.set_fleet_provider(pool.fleet.readiness_reasons)
+        return pool
 
     def register_grpc(self, ctx: ModuleCtx, server: Any) -> None:
         """Expose the worker as llmworker.v1.LlmWorkerService (typed proto)
@@ -484,6 +498,14 @@ class LlmGatewayModule(Module, RestApiCapability, RunnableCapability,
     async def stop(self, ctx: ModuleCtx) -> None:
         for t in list(self._job_tasks):
             t.cancel()
+        fleet = getattr(self.worker, "fleet", None)
+        if fleet is not None:
+            # detach the fleet feed from the process-global doctor so a
+            # torn-down federated stack's hosts never color the next
+            # stack's /readyz
+            from ...modkit.doctor import default_doctor
+
+            default_doctor.set_fleet_provider(None)
 
     async def _resolve_media(self, ctx: SecurityContext, body: dict) -> dict:
         """Media via FileStorage (DESIGN ADR-0003 + vision/document UCs):

@@ -1241,7 +1241,7 @@ class LocalTpuWorker(LlmWorkerApi):
                 continue
         with self._census_lock:
             traces = dict(self._recent_traces)
-        return {
+        census = {
             "load": load,
             "capacity": {**self.replica_capacity(),
                          "tenants": self.tenant_usage()},
@@ -1250,6 +1250,46 @@ class LocalTpuWorker(LlmWorkerApi):
             "prefix": self._prefix_gossip(),
             "recent_traces": traces,
         }
+        obs = self.observability_census()
+        if obs is not None:
+            census["observability"] = obs
+        return census
+
+    def observability_census(self) -> Optional[dict[str, Any]]:
+        """The fabric-fleetscope heartbeat payload (schema:
+        docs/ARCHITECTURE.md "Fleet observability"): the ``llm_*`` metrics
+        snapshot, a compact doctor report (state + last-eval burn rows +
+        trip/shed counters), and the most recent flight-recorder terminal
+        summaries. Piggybacked on the census so fleet aggregation costs
+        zero extra wire round-trips; ``observability.enabled: false`` in
+        the worker config turns it off (the bench guard's bare arm).
+        Never raises — a broken export degrades to a bare heartbeat."""
+        if not bool((self._config.get("observability") or {})
+                    .get("enabled", True)):
+            return None
+        try:
+            from ...modkit.doctor import default_doctor
+            from ...modkit.flight_recorder import default_recorder
+            from ...modkit.metrics import default_registry
+
+            doc = default_doctor.report()
+            last = doc.get("last_eval") or {}
+            return {
+                "metrics": default_registry.snapshot("llm_"),
+                "doctor": {
+                    "state": doc.get("state"),
+                    "state_since": doc.get("state_since"),
+                    "reasons": list(last.get("reasons") or ()),
+                    "objectives": list(last.get("objectives") or ()),
+                    "watchdog_trips": doc.get("watchdog_trips") or {},
+                    "shed_tenants": doc.get("shed_tenants") or [],
+                    "evals": doc.get("evals", 0),
+                },
+                "terminals": default_recorder.recent(8),
+                "ts": time.time(),
+            }
+        except Exception:  # noqa: BLE001 — the heartbeat must still go out
+            return None
 
     async def health(self) -> dict[str, Any]:
         import jax
@@ -1291,15 +1331,38 @@ async def serve(cfg: dict[str, Any]) -> None:
     import os
     import signal
 
+    from ...modkit.doctor import DoctorConfig, default_doctor
     from ...modkit.transport_grpc import JsonGrpcServer
     # fabric-lint: waive DE05 reason=standalone serve-mode process entrypoint; it dials the hub's registry over the wire, there is no in-stack ClientHub to resolve through
     from ..grpc_hub import WorkerRegistryClient
-    from .grpc_service import model_from_ref, register_llm_worker_service
+    from .grpc_service import (model_from_ref, register_llm_worker_service,
+                               register_worker_observability_service)
 
-    worker = LocalTpuWorker(cfg.get("worker") or {})
+    worker_cfg = dict(cfg.get("worker") or {})
+    obs_cfg = dict(cfg.get("observability") or {})
+    # the worker-level flag is what observability_census() reads; the
+    # top-level block is the operator surface (config/quickstart.yaml)
+    worker_cfg.setdefault("observability", obs_cfg)
+    obs_enabled = bool(obs_cfg.get("enabled", True))
+
+    worker = LocalTpuWorker(worker_cfg)
+    if obs_enabled:
+        # this process's OWN doctor: burn rates over local terminals, fed
+        # back to the gateway on every heartbeat
+        default_doctor.configure(DoctorConfig.from_config(
+            obs_cfg.get("doctor") or {}))
+        default_doctor.set_scheduler_provider(worker.schedulers)
+        default_doctor.set_capacity_provider(worker.replica_capacity)
+        default_doctor.attach_recorder()
+        default_doctor.ensure_started()
     server = JsonGrpcServer()
     register_llm_worker_service(server, worker,
                                 auth_token=cfg.get("auth_token"))
+    if obs_enabled:
+        register_worker_observability_service(
+            server,
+            allow_fault_injection=bool(obs_cfg.get("allow_fault_injection")),
+            auth_token=cfg.get("auth_token"))
     port = await server.start(str(cfg.get("bind_addr", "127.0.0.1:0")))
     endpoint = f"{cfg.get('advertise_host', '127.0.0.1')}:{port}"
     host_label = str(cfg.get("host") or f"worker-{os.getpid()}")
@@ -1360,6 +1423,11 @@ async def serve(cfg: dict[str, Any]) -> None:
             pass
         await registry.close()
         await server.stop()
+        if obs_enabled:
+            default_doctor.stop()
+            default_doctor.detach_recorder()
+            default_doctor.set_scheduler_provider(None)
+            default_doctor.set_capacity_provider(None)
 
 
 def main() -> int:

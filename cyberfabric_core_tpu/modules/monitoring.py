@@ -223,7 +223,7 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
         self.registry.counter(
             "llm_federated_placements_total",
             "Federated host placements by routing reason "
-            "(prefix/load/random)").inc(0.0)
+            "(prefix/health/load/random)").inc(0.0)
         self.registry.counter(
             "llm_federated_failovers_total",
             "Mid-stream requests re-prefilled on a surviving host").inc(0.0)
@@ -528,7 +528,19 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
 
     def register_rest(self, ctx: ModuleCtx, router, openapi) -> None:
         async def metrics(request: web.Request):
-            return web.Response(text=self.registry.render(),
+            # federated stacks merge worker heartbeat snapshots into the
+            # exposition host-labeled (FleetView.render_with keeps one
+            # HELP/TYPE block per family); any fold failure degrades to
+            # the plain gateway-local render, never to a scrape error
+            text = None
+            fleet = getattr(ctx.client_hub.try_get(LlmWorkerApi),
+                            "fleet", None)
+            if fleet is not None:
+                try:
+                    text = fleet.render_with(self.registry)
+                except Exception:  # noqa: BLE001
+                    text = None
+            return web.Response(text=text or self.registry.render(),
                                 content_type="text/plain")
 
         router.operation("GET", "/metrics", module="monitoring").public() \
@@ -619,14 +631,47 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
                 "stats": fp.stats(),
             }
 
+        async def _remote_failpoint(host: str, action: str, name: str,
+                                    spec, seed):
+            """Forward a failpoint arm/disarm to a federated worker host
+            over the observability service, mapping its refusal strings
+            back onto the same problems the local path raises."""
+            remote = getattr(ctx.client_hub.try_get(LlmWorkerApi),
+                             "remote_failpoint", None)
+            if remote is None:
+                raise ERR.monitoring.unknown_host.error(
+                    f"unknown worker host {host!r} (not a federated stack)")
+            try:
+                resp = await remote(host, action, name, spec, seed=seed)
+            except KeyError:
+                raise ERR.monitoring.unknown_host.error(
+                    f"unknown worker host {host!r}")
+            if not resp.get("ok"):
+                err = str(resp.get("error") or "remote refusal")
+                if "unknown failpoint" in err:
+                    raise ERR.monitoring.unknown_failpoint.error(err)
+                if "disabled" in err:
+                    raise ERR.monitoring.faultlab_disabled.error(
+                        f"worker host {host!r}: {err}")
+                raise ERR.monitoring.bad_failpoint_spec.error(err[:200])
+            return resp
+
         async def arm_failpoint(request: web.Request):
             _require_faultlab()
             name = request.match_info["name"]
             body = await read_json(request, {
                 "type": "object",
                 "properties": {"spec": {"type": ["string", "object"]},
-                               "seed": {"type": "integer"}},
+                               "seed": {"type": "integer"},
+                               "host": {"type": "string"}},
                 "additionalProperties": False})
+            if body.get("host"):
+                # faultlab's cross-host arm: the failpoint fires in the
+                # WORKER process, not here
+                await _remote_failpoint(body["host"], "arm", name,
+                                        body.get("spec", "raise"),
+                                        body.get("seed"))
+                return {"armed": name, "host": body["host"]}
             if "seed" in body:
                 fp.configure(int(body["seed"]))
             try:
@@ -641,6 +686,10 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
         async def disarm_failpoint(request: web.Request):
             _require_faultlab()
             name = request.match_info["name"]
+            host = request.query.get("host")
+            if host:
+                await _remote_failpoint(host, "disarm", name, "off", None)
+                return {"disarmed": True, "host": host}
             if name not in fp.FAILPOINT_CATALOG:
                 raise ERR.monitoring.unknown_failpoint.error(
                     f"unknown failpoint {name!r}")
@@ -699,7 +748,32 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
                 raise ERR.monitoring.unknown_request.error(
                     f"no flight record for request {rid!r} (live table + "
                     "finished ring miss — it may have aged out)")
-            return rec
+            # federated stacks: every host named by the gateway-side events
+            # (worker_host on admitted/decode, from_host on failover) holds
+            # the other half of this request's story — pull each segment
+            # over the observability wire and stitch into ONE timeline
+            # under the same X-Request-Id. Best-effort: a dead host's
+            # segment is simply absent, never a 500.
+            fetch = getattr(ctx.client_hub.try_get(LlmWorkerApi),
+                            "fetch_remote_timeline", None)
+            if fetch is None:
+                return rec
+            hosts: list[str] = []
+            for ev in rec.get("timeline") or ():
+                for key in ("worker_host", "from_host"):
+                    h = ev.get(key)
+                    if h and h not in hosts:
+                        hosts.append(h)
+            if not hosts:
+                return rec
+            from ..runtime.federation import stitch_timelines
+
+            segments = {}
+            for h in hosts:
+                seg = await fetch(h, rid)
+                if seg is not None:
+                    segments[h] = seg
+            return stitch_timelines(rec, segments) if segments else rec
 
         def _schedulers_named():
             worker = ctx.client_hub.try_get(LlmWorkerApi)
@@ -912,6 +986,39 @@ class MonitoringModule(Module, RestApiCapability, RunnableCapability):
                     "(never announced, withdrawn, or evicted)")
             return w.row(lease_ttl_s=reg.lease_ttl_s)
 
+        # ---- fleet observability (fabric-fleetscope): the health fold
+        # over every worker's heartbeat payload — per-host doctor state,
+        # burn-rate objective rows, and the worst-of fleet verdict that
+        # also feeds /readyz and the router's health rung.
+        async def get_fleet(request: web.Request):
+            fleet = getattr(ctx.client_hub.try_get(LlmWorkerApi),
+                            "fleet", None)
+            host = request.query.get("host")
+            if fleet is None:
+                if host:
+                    raise ERR.monitoring.unknown_host.error(
+                        f"unknown worker host {host!r} (not a federated "
+                        "stack)")
+                return {"federation": False, "state": "unknown",
+                        "reasons": [], "hosts": [], "objectives": [],
+                        "workers": 0, "stale": 0, "lease_ttl_s": 0.0}
+            doc = fleet.report()
+            if host:
+                rows = [r for r in doc["hosts"]
+                        if host in (r.get("host"), r.get("instance_id"))]
+                if not rows:
+                    raise ERR.monitoring.unknown_host.error(
+                        f"unknown worker host {host!r} (no live lease "
+                        "carries that host name or instance id)")
+                doc = {**doc, "hosts": rows}
+            return doc
+
+        router.operation("GET", "/v1/monitoring/fleet",
+                         module="monitoring").auth_required() \
+            .summary("Fleet health fold: per-host doctor state and burn "
+                     "rates off worker heartbeats (?host= filters; 404 on "
+                     "an unknown host)") \
+            .handler(get_fleet).register()
         router.operation("GET", "/v1/monitoring/workers",
                          module="monitoring").auth_required() \
             .summary("Federated worker census: per-host lease age, roles, "

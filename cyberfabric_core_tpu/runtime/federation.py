@@ -52,8 +52,21 @@ from ..modkit.failpoints import failpoint, record_recovery
 from ..modkit.flight_recorder import annotate_request, record_event
 from ..modkit.metrics import bump_counter
 
-__all__ = ["FederatedServingPool", "FederationConfig", "WorkerInfo",
-           "WorkerRegistry", "digest_chain", "prompt_text"]
+__all__ = ["FederatedServingPool", "FederationConfig", "FleetView",
+           "HostShedError", "WorkerInfo", "WorkerRegistry", "digest_chain",
+           "prompt_text", "stitch_timelines"]
+
+
+class HostShedError(RuntimeError):
+    """Every routable worker host is in doctor state ``shedding`` — the
+    fleet-scoped analogue of the local admission gate's load shed. The
+    router raises it instead of placing work a sick host would shed anyway;
+    the stream layer maps it to ``ERR.llm.load_shed`` (429 + Retry-After),
+    NOT to the 503 capacity hole of a truly empty fleet."""
+
+    def __init__(self, message: str, retry_after_s: float = 2.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
 
 
 # ------------------------------------------------------------- prefix digests
@@ -305,6 +318,268 @@ class WorkerRegistry:
                 "prefix_index_size": self.index_size()}
 
 
+# ------------------------------------------------------------- fleet view
+
+def _render_sample(name: str, kind: str, labels: dict[str, str],
+                   value: Any) -> list[str]:
+    """One snapshot sample → Prometheus exposition lines. Histogram values
+    arrive as the ``{buckets, sum, count}`` wire shape; anything that will
+    not coerce to a float renders as nothing (hostile payload discipline)."""
+    from ..modkit.metrics import _fmt_labels
+
+    if kind == "histogram" and isinstance(value, dict):
+        out: list[str] = []
+        buckets = value.get("buckets") or {}
+        try:
+            bounds = sorted(buckets, key=float)
+        except (TypeError, ValueError):
+            bounds = sorted(str(b) for b in buckets)
+        try:
+            for b in bounds:
+                out.append(f"{name}_bucket"
+                           f"{_fmt_labels({**labels, 'le': str(b)})} "
+                           f"{int(buckets[b])}")
+            count = int(value.get("count") or 0)
+            out.append(f"{name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} "
+                       f"{count}")
+            out.append(f"{name}_sum{_fmt_labels(labels)} "
+                       f"{float(value.get('sum') or 0.0)}")
+            out.append(f"{name}_count{_fmt_labels(labels)} {count}")
+        except (TypeError, ValueError):
+            return []
+        return out
+    try:
+        return [f"{name}{_fmt_labels(labels)} {float(value)}"]
+    except (TypeError, ValueError):
+        return []
+
+
+def stitch_timelines(gateway_record: dict[str, Any],
+                     segments: dict[str, dict[str, Any]]) -> dict[str, Any]:
+    """Merge the gateway-side flight record with per-host worker segments
+    into ONE timeline under one request id. Every event keeps its host of
+    origin (``origin``: "gateway" or the worker host name); global order is
+    by wall-clock ``ts`` — both sides stamp ``time.time()`` precisely so
+    cross-process merge sorts (flight_recorder docstring contract). A
+    cross-host failover thus reads as one story: gateway enqueue, host A's
+    tokens, the failover marker, host B's continuation. Pure + defensive:
+    worker segments are remote input, malformed events are dropped."""
+    out = dict(gateway_record)
+    merged: list[dict[str, Any]] = []
+    for ev in gateway_record.get("timeline") or ():
+        if isinstance(ev, dict):
+            merged.append({**ev, "origin": "gateway"})
+    seg_meta: dict[str, dict[str, Any]] = {}
+    for host in sorted(str(h) for h in segments):
+        seg = segments[host]
+        if not isinstance(seg, dict):
+            continue
+        n = 0
+        for ev in seg.get("timeline") or ():
+            if isinstance(ev, dict):
+                merged.append({**ev, "origin": host})
+                n += 1
+        seg_meta[host] = {"events": n, "state": seg.get("state"),
+                          "trace_id": seg.get("trace_id")}
+
+    def _ts(ev: dict[str, Any]) -> float:
+        try:
+            return float(ev.get("ts") or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    merged.sort(key=_ts)
+    out["timeline"] = merged
+    out["stitched"] = True
+    out["origins"] = ["gateway"] + sorted(seg_meta)
+    out["segments"] = seg_meta
+    return out
+
+
+class FleetView:
+    """Gateway-side fold of the observability payloads workers piggyback on
+    their heartbeat census (fabric-fleetscope).
+
+    Reads ``census["observability"]`` — metrics snapshot + compact doctor
+    report + flight-recorder terminal count — straight off the
+    :class:`WorkerRegistry`, so aggregation costs zero extra wire traffic.
+    Staleness is LEASE semantics, not a second clock: a report older than
+    the registry's ``lease_ttl_s`` is marked stale and stops feeding fleet
+    state (it may still render, flagged), and a host that leaves the
+    registry takes its rows with it (:meth:`FleetDoctor.retain`). Every
+    read path is never-raises: worker payloads are remote input."""
+
+    def __init__(self, registry: Any, host_metrics: bool = True) -> None:
+        from ..modkit.doctor import FleetDoctor
+
+        #: WorkerRegistry or a zero-arg resolver (same deferred-init dance
+        #: as the pool: gateway module may build this before grpc_hub runs)
+        self._registry_ref = registry
+        self.doctor = FleetDoctor()
+        #: ``federation.observability.host_metrics: false`` keeps worker
+        #: series off the gateway scrape (fleet/health folds unaffected)
+        self.host_metrics = bool(host_metrics)
+
+    def registry(self) -> Any:
+        reg = self._registry_ref
+        if callable(reg) and not hasattr(reg, "alive"):
+            reg = reg()
+            if reg is not None:
+                self._registry_ref = reg
+        return reg
+
+    # ------------------------------------------------------------- refresh
+    def hosts(self) -> list[dict[str, Any]]:
+        """Refresh the fold from the live census and return per-host rows
+        (doctor fields + registry lease/load fields)."""
+        reg = self.registry()
+        if reg is None or not hasattr(reg, "alive"):
+            return []
+        now = time.time()
+        ttl = float(getattr(reg, "lease_ttl_s", 0.0) or 0.0)
+        rows: list[dict[str, Any]] = []
+        seen: list[str] = []
+        for w in reg.alive():
+            lease_age = now - w.last_heartbeat
+            stale = bool(ttl) and lease_age > ttl
+            census = w.census if isinstance(w.census, dict) else {}
+            row = self.doctor.on_report(w.host, census.get("observability"),
+                                        stale=stale)
+            try:
+                load = int(census.get("load") or 0)
+            except (TypeError, ValueError):
+                load = 0
+            row.update({"instance_id": w.instance_id, "endpoint": w.endpoint,
+                        "lease_age_s": round(lease_age, 3), "load": load,
+                        "heartbeats": w.heartbeats})
+            seen.append(w.host)
+            rows.append(row)
+        # rows of departed hosts decay WITH the lease, never pinning state
+        self.doctor.retain(seen)
+        return rows
+
+    def host_states(self) -> dict[str, str]:
+        """instance_id → doctor state, fresh known-state rows only — the
+        router's health-rung feed. Never raises."""
+        try:
+            return {row["instance_id"]: row["state"] for row in self.hosts()
+                    if not row.get("stale") and row.get("state") != "unknown"}
+        except Exception:  # noqa: BLE001 — health data must not break routing
+            return {}
+
+    def report(self) -> dict[str, Any]:
+        """The ``GET /v1/monitoring/fleet`` document."""
+        rows = self.hosts()
+        doc = self.doctor.merge(rows)
+        reg = self.registry()
+        return {
+            "federation": True,
+            "state": doc["state"],
+            "reasons": doc["reasons"],
+            "hosts": doc["hosts"],
+            "objectives": doc["objectives"],
+            "workers": len(rows),
+            "stale": sum(1 for r in rows if r.get("stale")),
+            "lease_ttl_s": float(getattr(reg, "lease_ttl_s", 0.0) or 0.0),
+        }
+
+    def readiness_reasons(self) -> list[str]:
+        """Host-level reason strings for the gateway's /readyz (feeds
+        ``Doctor.set_fleet_provider``). Never raises, never blocks."""
+        try:
+            return list(self.doctor.merge(self.hosts())["reasons"])
+        except Exception:  # noqa: BLE001 — the readiness probe must not 500
+            return []
+
+    # ------------------------------------------------------------- metrics
+    def metric_snapshots(self) -> dict[str, dict[str, Any]]:
+        """host → metrics snapshot, FRESH heartbeat payloads only."""
+        if not self.host_metrics:
+            return {}
+        reg = self.registry()
+        if reg is None or not hasattr(reg, "alive"):
+            return {}
+        now = time.time()
+        ttl = float(getattr(reg, "lease_ttl_s", 0.0) or 0.0)
+        out: dict[str, dict[str, Any]] = {}
+        for w in reg.alive():
+            if ttl and now - w.last_heartbeat > ttl:
+                continue
+            census = w.census if isinstance(w.census, dict) else {}
+            obs = census.get("observability")
+            snap = obs.get("metrics") if isinstance(obs, dict) else None
+            if isinstance(snap, dict):
+                out[str(w.host)] = snap
+        return out
+
+    @staticmethod
+    def merge_metric_samples(
+            host_snaps: dict[str, dict[str, Any]]) -> dict[str, dict]:
+        """Merge per-host snapshots into one host-labeled family table
+        (``{name: {type, help, samples}}``). Conservation by construction:
+        every worker sample appears exactly once with its ``host`` label —
+        nothing is summed away, so per-host totals survive aggregation.
+        Hostile shapes are dropped per sample, never raised."""
+        merged: dict[str, dict] = {}
+        for host in sorted(str(h) for h in host_snaps):
+            snap = host_snaps[host]
+            if not isinstance(snap, dict):
+                continue
+            for name in sorted(str(n) for n in snap):
+                fam = snap[name]
+                if not isinstance(fam, dict):
+                    continue
+                entry = merged.setdefault(name, {
+                    "type": str(fam.get("type") or "gauge"),
+                    "help": str(fam.get("help") or ""),
+                    "samples": []})
+                for pair in fam.get("samples") or ():
+                    try:
+                        labels, value = pair
+                        labels = {str(k): str(v)
+                                  for k, v in dict(labels).items()}
+                        labels["host"] = host  # the fleet label wins
+                        entry["samples"].append([labels, value])
+                    except (TypeError, ValueError):
+                        continue
+        return merged
+
+    def render_with(self, registry: Any) -> str:
+        """The federated /metrics exposition: gateway families and worker
+        families merged into ONE ``HELP``/``TYPE`` block per name (a valid
+        exposition never repeats a family header) — gateway samples bare,
+        worker samples host-labeled — plus the per-host
+        ``llm_remote_workers_healthy{host=...}`` 0/1 rung next to the
+        registry's existing unlabeled total."""
+        gw = registry.snapshot() if registry is not None else {}
+        fleet = self.merge_metric_samples(self.metric_snapshots())
+        healthy_samples: list[list] = []
+        try:
+            for row in self.hosts():
+                healthy_samples.append([{"host": row["host"]},
+                                        0.0 if row.get("stale") else 1.0])
+        except Exception:  # noqa: BLE001 — the scrape must not fail
+            pass
+        if healthy_samples:
+            fam = fleet.setdefault("llm_remote_workers_healthy", {
+                "type": "gauge",
+                "help": "Remote federated workers holding a live lease",
+                "samples": []})
+            fam["samples"].extend(healthy_samples)
+        lines: list[str] = []
+        for name in sorted(set(gw) | set(fleet)):
+            ref = gw.get(name) or fleet[name]
+            lines.append(f"# HELP {name} {ref['help']}")
+            lines.append(f"# TYPE {name} {ref['type']}")
+            for fam in (gw.get(name), fleet.get(name)):
+                if not fam:
+                    continue
+                for labels, value in fam["samples"]:
+                    lines.extend(_render_sample(name, ref["type"],
+                                                dict(labels), value))
+        return "\n".join(lines) + "\n"
+
+
 # ---------------------------------------------------------------- federation
 
 @dataclass
@@ -323,6 +598,13 @@ class FederationConfig:
     max_blocks: int = 64
     #: seeded tie-break RNG (deterministic scenarios)
     seed: int = 0
+    #: per-host budget for pulling a remote timeline segment when stitching
+    #: (``federation.observability.stitch_timeout_s``) — a slow host costs
+    #: this much latency, never a hang
+    stitch_timeout_s: float = 2.0
+    #: merge worker ``llm_*`` snapshots host-labeled into /metrics
+    #: (``federation.observability.host_metrics``)
+    host_metrics: bool = True
 
 
 class FederatedServingPool:
@@ -336,7 +618,9 @@ class FederatedServingPool:
 
     def __init__(self, registry: Any, client_factory: Callable[[WorkerInfo], Any],
                  make_chunk: Callable[..., Any],
-                 config: Optional[FederationConfig] = None) -> None:
+                 config: Optional[FederationConfig] = None,
+                 obs_client_factory: Optional[
+                     Callable[[WorkerInfo], Any]] = None) -> None:
         #: WorkerRegistry or a zero-arg resolver for it (module init order:
         #: the gateway may init before grpc_hub has registered the registry)
         self._registry_ref = registry
@@ -344,13 +628,23 @@ class FederatedServingPool:
         self._make_chunk = make_chunk
         self.config = config or FederationConfig()
         self._clients: dict[str, Any] = {}
+        #: observability-plane clients (timeline pull / remote failpoints) —
+        #: cached separately so tearing one down never touches a live stream
+        self._obs_factory = obs_client_factory
+        self._obs_clients: dict[str, Any] = {}
         self._inflight: dict[str, int] = {}
         self._lock = threading.Lock()
         self._rng = random.Random(self.config.seed)
-        self.placements = {"prefix": 0, "load": 0, "random": 0}
+        self.placements = {"prefix": 0, "health": 0, "load": 0, "random": 0}
         self.failovers = 0
         self.failovers_failed = 0
         self.requests = 0
+        #: fleet observability fold over the same registry — the router's
+        #: health rung and the monitoring module's fleet endpoint both read it
+        self.fleet = FleetView(lambda: self._registry_ref
+                               if not callable(self._registry_ref)
+                               else self._registry_ref(),
+                               host_metrics=self.config.host_metrics)
 
     # ------------------------------------------------------------- plumbing
     def registry(self) -> Any:
@@ -391,21 +685,18 @@ class FederatedServingPool:
                 max(0, self._inflight.get(instance_id, 0) + d)
 
     # -------------------------------------------------------------- routing
-    def route(self, model_key: str, chain: list[str],
-              exclude: tuple[str, ...] = ()) -> tuple[WorkerInfo, str]:
-        """Pick the serving host: **prefix > load > random** (WD01: sync,
-        non-blocking, never-raises emits only). Raises RuntimeError when no
-        live host can serve the model."""
-        failpoint("federation.route")
-        workers = [w for w in self.registry().alive(model=model_key)
-                   if w.instance_id not in exclude]
-        if not workers:
-            raise RuntimeError(
-                f"federation: no live worker host for {model_key!r}")
-        with self._lock:
-            local = dict(self._inflight)
-        loads = {w.instance_id: int(w.census.get("load") or 0)
-                 + local.get(w.instance_id, 0) for w in workers}
+    def _host_states(self) -> dict[str, str]:
+        """instance_id → doctor state off the fleet view (WD01: sync,
+        in-memory census reads only; {} when the view is broken — health
+        data degrades to no opinion, never to a routing failure)."""
+        try:
+            return self.fleet.host_states()
+        except Exception:  # noqa: BLE001
+            return {}
+
+    def _select(self, workers: list[WorkerInfo], loads: dict[str, int],
+                chain: list[str], model_key: str) -> tuple[WorkerInfo, str]:
+        """The prefix > load > random rungs over one candidate set."""
         by_id = {w.instance_id: w for w in workers}
         best = min(loads, key=lambda k: (loads[k], k))
         reason = "load"
@@ -425,9 +716,59 @@ class FederatedServingPool:
             # every host equally idle and no cache hint: spread, seeded
             pick = self._rng.choice(sorted(loads))
             reason = "random"
+        return by_id[pick], reason
+
+    def route(self, model_key: str, chain: list[str],
+              exclude: tuple[str, ...] = ()) -> tuple[WorkerInfo, str]:
+        """Pick the serving host: **prefix > health > load > random** (WD01:
+        sync, non-blocking, never-raises emits only). The health rung sits
+        between prefix-affinity and least-loaded: hosts the fleet doctor
+        marks degraded/shedding are filtered out before the load/prefix
+        rungs see them — a prefix hint on a sick host loses, and the
+        placement reason becomes ``health``. When the only survivors are
+        degraded they stay routable (degraded capacity beats none); when
+        EVERY routable host is shedding, raise :class:`HostShedError` so
+        the caller sheds host-scoped (429 + Retry-After) instead of placing
+        doomed work. Raises RuntimeError when no live host serves the
+        model at all."""
+        failpoint("federation.route")
+        workers = [w for w in self.registry().alive(model=model_key)
+                   if w.instance_id not in exclude]
+        if not workers:
+            raise RuntimeError(
+                f"federation: no live worker host for {model_key!r}")
+        states = self._host_states()
+        shed = {w.instance_id for w in workers
+                if states.get(w.instance_id) == "shedding"}
+        sick = {w.instance_id for w in workers
+                if states.get(w.instance_id) in ("degraded", "shedding")}
+        candidates = [w for w in workers if w.instance_id not in sick] \
+            or [w for w in workers if w.instance_id not in shed]
+        if not candidates:
+            raise HostShedError(
+                f"federation: every live worker host for {model_key!r} "
+                f"is shedding ({len(workers)} host(s))")
+        with self._lock:
+            local = dict(self._inflight)
+
+        def _loads(ws: list[WorkerInfo]) -> dict[str, int]:
+            return {w.instance_id: int(w.census.get("load") or 0)
+                    + local.get(w.instance_id, 0) for w in ws}
+
+        picked, reason = self._select(candidates, _loads(candidates),
+                                      chain, model_key)
+        if len(candidates) < len(workers):
+            # the health rung actually bit: attribute the placement to it
+            # when the host the prefix/load rungs would have chosen over
+            # the FULL set is sick and differs from the real pick
+            virtual, _ = self._select(workers, _loads(workers), chain,
+                                      model_key)
+            if virtual.instance_id in sick and \
+                    virtual.instance_id != picked.instance_id:
+                reason = "health"
         self.placements[reason] += 1
         bump_counter("llm_federated_placements_total", reason=reason)
-        return by_id[pick], reason
+        return picked, reason
 
     # ---------------------------------------------------------- LlmWorkerApi
     async def chat_stream(self, model: Any, messages: list[dict],
@@ -477,6 +818,15 @@ class FederatedServingPool:
         while True:
             try:
                 w, reason = self.route(model_key, chain, exclude=tuple(tried))
+            except HostShedError as e:
+                # every host is shedding: host-scoped load shed — 429 +
+                # Retry-After, the fleet analogue of the local admission
+                # gate, NOT the 503 capacity hole of an empty fleet
+                record_event(rid, "error", error=f"fleet_shed: {e}")
+                from ..modkit.errcat import ERR
+
+                raise ERR.llm.load_shed.error(
+                    str(e), retry_after_s=e.retry_after_s)
             except RuntimeError as e:
                 # no live host (or an armed federation.route failpoint):
                 # a transient capacity hole, not a server bug — 503 +
@@ -678,10 +1028,63 @@ class FederatedServingPool:
             "prefix_index_size": reg.index_size(),
         }
 
+    # ------------------------------------------------- observability plane
+    def _worker_by_host(self, host: str) -> WorkerInfo:
+        """Resolve a host name OR instance id to its live WorkerInfo.
+        Raises KeyError (→ the monitoring layer's 404 problem) on a miss."""
+        for w in self.registry().alive():
+            if host in (w.host, w.instance_id):
+                return w
+        raise KeyError(host)
+
+    def _obs_client_for(self, w: WorkerInfo) -> Any:
+        if self._obs_factory is None:
+            raise KeyError(w.host)
+        with self._lock:
+            client = self._obs_clients.get(w.instance_id)
+            if client is None:
+                client = self._obs_factory(w)
+                self._obs_clients[w.instance_id] = client
+        return client
+
+    async def fetch_remote_timeline(self, host: str,
+                                    request_id: str) -> Optional[dict]:
+        """Pull one request's flight record off a worker host over the
+        observability service. Never raises — a dead/slow host degrades the
+        stitched timeline to the gateway-side half, not to a 500."""
+        try:
+            w = self._worker_by_host(host)
+            resp = await asyncio.wait_for(
+                self._obs_client_for(w).timeline(request_id),
+                timeout=max(0.05, self.config.stitch_timeout_s))
+        except Exception:  # noqa: BLE001 — remote segment is best-effort
+            return None
+        if isinstance(resp, dict) and resp.get("found"):
+            rec = resp.get("record")
+            return rec if isinstance(rec, dict) else None
+        return None
+
+    async def remote_failpoint(self, host: str, action: str, name: str,
+                               spec: str = "raise",
+                               seed: Optional[int] = None) -> dict[str, Any]:
+        """Arm/disarm a failpoint ON a worker host (faultlab's cross-host
+        arm path). KeyError on unknown host propagates to the 404 problem;
+        worker-side refusals come back as ``{"ok": False, "error": ...}``."""
+        w = self._worker_by_host(host)
+        client = self._obs_client_for(w)
+        if action == "disarm":
+            resp = await client.disarm_failpoint(name)
+        else:
+            resp = await client.arm_failpoint(name, spec, seed=seed)
+        return resp if isinstance(resp, dict) else {"ok": False,
+                                                    "error": "bad response"}
+
     async def close(self) -> None:
         with self._lock:
-            clients = list(self._clients.values())
+            clients = list(self._clients.values()) \
+                + list(self._obs_clients.values())
             self._clients.clear()
+            self._obs_clients.clear()
         for c in clients:
             if hasattr(c, "close"):
                 try:

@@ -703,6 +703,59 @@ def test_WD01_registry_client_wire_heartbeat_exempt():
     assert ok == []
 
 
+def test_WD01_fleet_doctor_on_report_blocking_sleep_fails():
+    # on_report runs once per heartbeat per host on the census refresh
+    # path — a sleeping fold stalls every fleet read (/readyz, routing)
+    bad = lint("import time\n"
+               "class FleetDoctor:\n"
+               "    def on_report(self, host, payload, stale=False):\n"
+               "        time.sleep(0.1)\n",
+               tier="modkit", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and bad[0].line == 4
+
+
+def test_WD01_fleet_view_merge_await_fails():
+    # merge* feeds the router's health rung and /readyz — the fold over
+    # remote payloads is a sync in-memory pass, never a wire call
+    bad = lint("class FleetView:\n"
+               "    async def merge_reports(self, rows):\n"
+               "        return await self._pull(rows)\n",
+               tier="runtime", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and "await" in bad[0].message
+
+
+def test_WD01_fleet_doctor_merge_direct_metric_fails():
+    bad = lint("class FleetDoctor:\n"
+               "    def merge(self, rows, registry):\n"
+               "        registry.gauge('llm_fleet_state')"
+               ".set(1.0)\n",
+               tier="modkit", select=("WD01",))
+    assert rule_ids(bad) == ["WD01"] and "bump_counter" in bad[0].message
+
+
+def test_WD01_fleet_callbacks_with_helpers_pass():
+    ok = lint("from cyberfabric_core_tpu.modkit.metrics import bump_counter\n"
+              "class FleetDoctor:\n"
+              "    def on_report(self, host, payload, stale=False):\n"
+              "        bump_counter('llm_fleet_reports_total', host=host)\n"
+              "        return dict(payload or {})\n"
+              "    def merge(self, rows=None):\n"
+              "        return {'state': 'healthy', 'reasons': []}\n"
+              "class FleetViewHelper:\n"
+              "    def refresh(self, client):\n"
+              "        client.fetch()\n",  # not a merge/on_report callback
+              tier="modkit", select=("WD01",))
+    assert ok == []
+
+
+def test_WD01_fleet_repo_gate_clean():
+    """The gate: the repo's own FleetDoctor/FleetView merge and on_report
+    callbacks honor the non-blocking never-raises contract."""
+    engine = Engine(all_rules()).select(["WD01"])
+    findings = [f for f in engine.run(PKG) if not f.suppressed]
+    assert findings == [], [f.to_dict() for f in findings]
+
+
 def test_WD01_cancel_callback_blocking_sleep_fails():
     # cancel() runs on gateway event-loop threads (an SSE disconnect) and
     # the expiry sweep runs between decode rounds — neither may block

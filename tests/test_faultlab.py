@@ -113,7 +113,13 @@ def test_every_catalogued_failpoint_has_a_scenario():
     assert layers >= {"runtime", "gateway", "modkit", "modules"}
 
 
-@pytest.mark.parametrize("name", [s["name"] for s in BUILTIN_SCENARIOS])
+# fleet-doctor-shed boots a full REST stack + two worker subprocesses and
+# waits out a real burn/recovery cycle — too heavy for the tier-1 budget;
+# `make chaos` and the CI faultlab leg (--repeat 2) still run it
+@pytest.mark.parametrize("name", [
+    pytest.param(s["name"], marks=[pytest.mark.slow]
+                 if s["kind"] == "fleet_doctor_shed" else [])
+    for s in BUILTIN_SCENARIOS])
 def test_scenario(name):
     result = run_scenario(scenario_by_name(name))
     red = {k: v for k, v in result.invariants.items() if v}

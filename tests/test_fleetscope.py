@@ -1,0 +1,416 @@
+"""fabric-fleetscope unit truth: the FleetDoctor fold (hostile payloads,
+stale-lease decay, worst-of merge), the FleetView metric merge
+(conservation + exposition validity), the router's health rung
+(prefix > health > load > random, HostShedError when the whole fleet
+sheds), and cross-host timeline stitching. The multi-process acceptance
+story lives in tests/test_federation_e2e.py and the ``fleet-doctor-shed``
+faultlab scenario; everything here is in-process and wire-free."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from cyberfabric_core_tpu.modkit.doctor import FleetDoctor
+from cyberfabric_core_tpu.modkit.metrics import MetricsRegistry
+from cyberfabric_core_tpu.runtime.federation import (
+    FederatedServingPool, FederationConfig, FleetView, HostShedError,
+    WorkerRegistry, digest_chain, stitch_timelines)
+
+
+def _payload(state="healthy", reasons=(), objectives=(), trips=None,
+             shed=(), evals=3, terminals=0):
+    return {
+        "metrics": {},
+        "doctor": {"state": state, "state_since": time.time(),
+                   "reasons": list(reasons), "objectives": list(objectives),
+                   "watchdog_trips": dict(trips or {}),
+                   "shed_tenants": list(shed), "evals": evals},
+        "terminals": [{}] * terminals,
+        "ts": time.time(),
+    }
+
+
+# ------------------------------------------------------------- FleetDoctor
+
+def test_on_report_normalizes_a_well_formed_payload():
+    fd = FleetDoctor()
+    row = fd.on_report("h1", _payload(
+        state="degraded", reasons=["slo:itl_p99"], terminals=4,
+        trips={"stream_stall": 2}, shed=["acme"]))
+    assert row["host"] == "h1" and row["state"] == "degraded"
+    assert row["reasons"] == ["slo:itl_p99"]
+    assert row["watchdog_trips"] == {"stream_stall": 2}
+    assert row["shed_tenants"] == ["acme"]
+    assert row["terminals"] == 4 and not row["stale"]
+
+
+@pytest.mark.parametrize("hostile", [
+    None, "garbage", 42, [], {"doctor": "not-a-dict"},
+    {"doctor": {"state": 17, "reasons": 3.5}},
+    {"doctor": {"state": "nonsense-state"}},
+    {"doctor": {"watchdog_trips": {"x": "NaNopolis"}}},
+    {"doctor": {"state_since": "yesterday"}},
+    {"terminals": {"not": "a list"}},
+])
+def test_on_report_hostile_payloads_never_raise(hostile):
+    """Worker payloads are REMOTE input: every malformed shape degrades to
+    an ``unknown`` row (or drops the bad field), never to an exception —
+    the WD01 contract for the heartbeat service path."""
+    fd = FleetDoctor()
+    row = fd.on_report("evil", hostile)
+    assert row["host"] == "evil"
+    assert row["state"] in ("unknown", "healthy")
+    # and the fold keeps working afterwards
+    assert fd.on_report("h2", _payload())["state"] == "healthy"
+
+
+def test_merge_takes_worst_of_fresh_states_and_names_the_host():
+    fd = FleetDoctor()
+    fd.on_report("a", _payload(state="healthy"))
+    fd.on_report("b", _payload(state="degraded", reasons=["slo:itl_p99"]))
+    fd.on_report("c", _payload(state="recovering"))
+    doc = fd.merge()
+    assert doc["state"] == "degraded"
+    assert any("host b degraded: slo:itl_p99" in r for r in doc["reasons"])
+    assert [h["host"] for h in doc["hosts"]] == ["a", "b", "c"]
+
+
+def test_stale_report_decays_out_of_fleet_state():
+    """A stale (lease-expiring) report stays visible with a staleness
+    reason but must never pin the fleet verdict — a silent worker's last
+    gasp is not evidence about NOW."""
+    fd = FleetDoctor()
+    fd.on_report("fresh", _payload(state="healthy"))
+    fd.on_report("silent", _payload(state="shedding",
+                                    reasons=["slo:itl_p99"]), stale=True)
+    doc = fd.merge()
+    assert doc["state"] == "healthy"
+    assert any("silent" in r and "stale" in r for r in doc["reasons"])
+    # and the router's feed skips it entirely
+    assert fd.host_states() == {"fresh": "healthy"}
+
+
+def test_retain_drops_departed_hosts_rows():
+    fd = FleetDoctor()
+    fd.on_report("keep", _payload(state="degraded"))
+    fd.on_report("gone", _payload(state="shedding"))
+    fd.retain(["keep"])
+    assert set(fd.host_states()) == {"keep"}
+    assert fd.merge()["state"] == "degraded"  # "gone" no longer pins it
+
+
+def test_objectives_flatten_per_host():
+    fd = FleetDoctor()
+    fd.on_report("a", _payload(objectives=[
+        {"objective": "itl_p99", "burn_fast": 2.5}]))
+    fd.on_report("b", _payload(objectives=[
+        {"objective": "ttft_p95", "burn_fast": 0.1}]))
+    rows = fd.merge()["objectives"]
+    assert {(r["host"], r["objective"]) for r in rows} == {
+        ("a", "itl_p99"), ("b", "ttft_p95")}
+
+
+# ------------------------------------------------- FleetView metric merge
+
+def _registry_with_two_hosts(lease_ttl_s=5.0):
+    reg = WorkerRegistry(lease_ttl_s=lease_ttl_s)
+    ids = {}
+    for host in ("h0", "h1"):
+        ids[host] = reg.announce({"host": host,
+                                  "endpoint": f"127.0.0.1:{hash(host) % 999}",
+                                  "models": ["m"]})["instance_id"]
+    return reg, ids
+
+
+def _snap(name="llm_tokens_total", value=7.0, labels=None, kind="counter"):
+    return {name: {"type": kind, "help": "t",
+                   "samples": [[dict(labels or {}), value]]}}
+
+
+def test_merge_metric_samples_conserves_every_sample_host_labeled():
+    merged = FleetView.merge_metric_samples({
+        "h0": _snap(value=7.0, labels={"model": "m"}),
+        "h1": _snap(value=3.0, labels={"model": "m"}),
+    })
+    fam = merged["llm_tokens_total"]
+    assert fam["type"] == "counter"
+    # conservation: both samples survive, each under its own host label —
+    # nothing summed away
+    assert sorted((s[0]["host"], s[1]) for s in fam["samples"]) == [
+        ("h0", 7.0), ("h1", 3.0)]
+    assert all(s[0]["model"] == "m" for s in fam["samples"])
+
+
+def test_merge_metric_samples_fleet_host_label_wins():
+    """A worker that labels its own series ``host=...`` cannot spoof
+    another host's identity on the gateway exposition."""
+    merged = FleetView.merge_metric_samples({
+        "real-host": _snap(labels={"host": "spoofed"})})
+    [(labels, _)] = merged["llm_tokens_total"]["samples"]
+    assert labels["host"] == "real-host"
+
+
+def test_merge_metric_samples_hostile_shapes_dropped_not_raised():
+    merged = FleetView.merge_metric_samples({
+        "h0": "not a snapshot",
+        "h1": {"llm_x": "not a family",
+               "llm_ok": {"type": "counter", "help": "",
+                          "samples": [["bad-pair"], [{"a": "b"}, 1.0]]}},
+    })
+    assert "llm_x" not in merged
+    assert len(merged["llm_ok"]["samples"]) == 1
+
+
+def test_render_with_one_header_per_family_and_healthy_rung():
+    reg, ids = _registry_with_two_hosts()
+    view = FleetView(reg)
+    reg.heartbeat(ids["h0"], {"observability": {
+        **_payload(), "metrics": _snap(value=7.0)}})
+    reg.heartbeat(ids["h1"], {"observability": {
+        **_payload(), "metrics": _snap(value=3.0)}})
+    gw = MetricsRegistry()
+    gw.counter("llm_tokens_total", "t").inc(11.0)
+    text = view.render_with(gw)
+    # one HELP/TYPE block per family even though gateway AND both workers
+    # export it (a valid exposition never repeats a header)
+    assert text.count("# TYPE llm_tokens_total ") == 1
+    assert 'llm_tokens_total 11' in text                       # gateway bare
+    assert 'llm_tokens_total{host="h0"} 7' in text             # host-labeled
+    assert 'llm_tokens_total{host="h1"} 3' in text
+    assert 'llm_remote_workers_healthy{host="h0"} 1' in text
+    assert 'llm_remote_workers_healthy{host="h1"} 1' in text
+
+
+def test_render_with_marks_stale_host_unhealthy():
+    reg, ids = _registry_with_two_hosts(lease_ttl_s=1.0)
+    view = FleetView(reg)
+    reg.heartbeat(ids["h0"], {"observability": _payload()})
+    reg.heartbeat(ids["h1"], {"observability": _payload()})
+    # age h1's lease past the ttl without evicting it
+    reg.lookup(ids["h1"]).last_heartbeat = time.time() - 2.0
+    text = view.render_with(MetricsRegistry())
+    assert 'llm_remote_workers_healthy{host="h0"} 1' in text
+    assert 'llm_remote_workers_healthy{host="h1"} 0' in text
+    # and the stale host's series stop rendering (fresh payloads only)
+    snaps = view.metric_snapshots()
+    assert set(snaps) == {"h0"}
+
+
+def test_histogram_wire_shape_renders_buckets_sum_count():
+    reg, ids = _registry_with_two_hosts()
+    view = FleetView(reg)
+    reg.heartbeat(ids["h0"], {"observability": {**_payload(), "metrics": {
+        "llm_itl_ms": {"type": "histogram", "help": "itl", "samples": [
+            [{}, {"buckets": {"5.0": 2, "50.0": 5}, "sum": 61.0,
+                  "count": 5}]]}}}})
+    text = view.render_with(MetricsRegistry())
+    assert 'llm_itl_ms_bucket{host="h0",le="5.0"} 2' in text
+    assert 'llm_itl_ms_bucket{host="h0",le="+Inf"} 5' in text
+    assert 'llm_itl_ms_sum{host="h0"} 61' in text
+    assert 'llm_itl_ms_count{host="h0"} 5' in text
+
+
+def test_fleet_view_report_document_shape():
+    reg, ids = _registry_with_two_hosts()
+    view = FleetView(reg)
+    reg.heartbeat(ids["h0"], {"observability": _payload(state="degraded",
+                                                        reasons=["burn"])})
+    reg.heartbeat(ids["h1"], {"observability": _payload()})
+    doc = view.report()
+    assert doc["federation"] is True and doc["workers"] == 2
+    assert doc["state"] == "degraded" and doc["stale"] == 0
+    assert any("h0 degraded" in r for r in doc["reasons"])
+    by_host = {r["host"]: r for r in doc["hosts"]}
+    assert by_host["h0"]["instance_id"] == ids["h0"]
+    assert by_host["h1"]["lease_age_s"] >= 0.0
+    # the /readyz feed is the same fold, never-raises
+    assert any("h0" in r for r in view.readiness_reasons())
+
+
+# ----------------------------------------------------------- health rung
+
+def _pool(reg, seed=0):
+    return FederatedServingPool(
+        reg, lambda w: None, dict, FederationConfig(seed=seed))
+
+
+def _mark(reg, iid, state, extra_census=None):
+    census = dict(extra_census or {})
+    census["observability"] = _payload(state=state)
+    assert reg.heartbeat(iid, census)
+
+
+def test_route_health_rung_steers_off_degraded_host():
+    reg, ids = _registry_with_two_hosts()
+    pool = _pool(reg)
+    _mark(reg, ids["h0"], "degraded")
+    _mark(reg, ids["h1"], "healthy")
+    for _ in range(6):
+        w, reason = pool.route("m", [])
+        assert w.host == "h1"
+    assert pool.placements["health"] >= 1
+    assert reason in ("health", "load")
+
+
+def test_route_prefix_hint_on_sick_host_loses_to_health():
+    """A prefix hint normally wins the rung — but not when its host is
+    degraded: health sits ABOVE prefix affinity."""
+    reg, ids = _registry_with_two_hosts()
+    pool = _pool(reg)
+    chain = digest_chain("x" * 96)
+    _mark(reg, ids["h0"], "degraded", {"prefix": {"m": [chain]}})
+    _mark(reg, ids["h1"], "healthy")
+    w, reason = pool.route("m", chain)
+    assert w.host == "h1" and reason == "health"
+
+
+def test_route_degraded_only_survivors_stay_routable():
+    """Degraded capacity beats none: when every host is degraded the rung
+    falls back to the full (non-shedding) set instead of failing."""
+    reg, ids = _registry_with_two_hosts()
+    pool = _pool(reg)
+    _mark(reg, ids["h0"], "degraded")
+    _mark(reg, ids["h1"], "degraded")
+    w, _reason = pool.route("m", [])
+    assert w.host in ("h0", "h1")
+
+
+def test_route_all_shedding_raises_host_shed_error():
+    reg, ids = _registry_with_two_hosts()
+    pool = _pool(reg)
+    _mark(reg, ids["h0"], "shedding")
+    _mark(reg, ids["h1"], "shedding")
+    with pytest.raises(HostShedError) as e:
+        pool.route("m", [])
+    assert e.value.retry_after_s > 0
+
+
+def test_route_shedding_plus_degraded_prefers_the_degraded_host():
+    reg, ids = _registry_with_two_hosts()
+    pool = _pool(reg)
+    _mark(reg, ids["h0"], "shedding")
+    _mark(reg, ids["h1"], "degraded")
+    for _ in range(4):
+        w, _reason = pool.route("m", [])
+        assert w.host == "h1"
+
+
+def test_route_without_health_data_is_seed_deterministic():
+    """No observability payloads at all (pre-fleetscope workers): the rung
+    must not perturb the existing seeded prefix/load/random behavior."""
+    def picks(seed):
+        reg, ids = _registry_with_two_hosts()
+        reg.heartbeat(ids["h0"], {"load": 0})
+        reg.heartbeat(ids["h1"], {"load": 0})
+        pool = _pool(reg, seed=seed)
+        return [pool.route("m", [])[0].host for _ in range(8)]
+
+    assert picks(7) == picks(7)
+    assert picks(7) != picks(8) or picks(7) != picks(9)  # seed matters
+
+
+# ------------------------------------------------------ timeline stitching
+
+def test_stitch_orders_cross_host_events_by_wall_clock():
+    t = time.time()
+    gw = {"request_id": "r1", "trace_id": "T", "timeline": [
+        {"event": "enqueued", "ts": t},
+        {"event": "failover", "ts": t + 2.0, "from_host": "a",
+         "to_host": "b", "carried_tokens": 3},
+    ]}
+    segments = {
+        "a": {"state": "finished", "trace_id": "T", "timeline": [
+            {"event": "decode_chunk", "ts": t + 1.0}]},
+        "b": {"state": "finished", "trace_id": "T", "timeline": [
+            {"event": "decode_chunk", "ts": t + 3.0}]},
+    }
+    doc = stitch_timelines(gw, segments)
+    assert doc["stitched"] is True
+    assert doc["origins"] == ["gateway", "a", "b"]
+    assert [e["origin"] for e in doc["timeline"]] == [
+        "gateway", "a", "gateway", "b"]
+    assert doc["segments"]["a"] == {"events": 1, "state": "finished",
+                                    "trace_id": "T"}
+    # the failover reads as one story between the two hosts' tokens
+    events = [e["event"] for e in doc["timeline"]]
+    assert events == ["enqueued", "decode_chunk", "failover", "decode_chunk"]
+
+
+def test_stitch_hostile_segments_degrade_to_gateway_half():
+    gw = {"request_id": "r1", "timeline": [{"event": "enqueued", "ts": 1.0}]}
+    doc = stitch_timelines(gw, {
+        "bad1": "not a record",
+        "bad2": {"timeline": "not a list"},
+        "bad3": {"timeline": [17, {"event": "ok", "ts": "NaNopolis"}]},
+    })
+    assert doc["stitched"] is True
+    # the uncoercible ts sorts to the epoch rather than raising
+    assert [e["event"] for e in doc["timeline"]] == ["ok", "enqueued"]
+    assert doc["segments"]["bad3"]["events"] == 1
+
+
+# -------------------------------------------------- worker census payload
+
+def test_worker_observability_census_shape_and_disable_switch():
+    from cyberfabric_core_tpu.modules.llm_gateway.worker import LocalTpuWorker
+
+    on = LocalTpuWorker({})
+    obs = on.observability_census()
+    assert obs is not None
+    assert set(obs) >= {"metrics", "doctor", "terminals", "ts"}
+    assert obs["doctor"]["state"] in ("healthy", "degraded", "recovering",
+                                     "shedding")
+    # every metrics family in the payload is the llm_* slice
+    assert all(name.startswith("llm_") for name in obs["metrics"])
+    # the fold on the other side accepts its own wire shape
+    assert FleetDoctor().on_report("w", obs)["state"] == obs["doctor"]["state"]
+
+    off = LocalTpuWorker({"observability": {"enabled": False}})
+    assert off.observability_census() is None
+    census = off.federation_census()
+    assert "observability" not in census
+
+
+def test_host_metrics_off_keeps_worker_series_off_the_scrape():
+    # federation.observability.host_metrics: false — the scrape shows only
+    # gateway-owned families (plus the healthy rung); fleet/health folds
+    # still see the same payloads
+    reg, ids = _registry_with_two_hosts()
+    view = FleetView(reg, host_metrics=False)
+    reg.heartbeat(ids["h0"], {"observability": {
+        **_payload(), "metrics": _snap(value=7.0)}})
+    assert view.metric_snapshots() == {}
+    gw = MetricsRegistry()
+    gw.counter("llm_tokens_total", "t").inc(11.0)
+    text = view.render_with(gw)
+    assert 'llm_tokens_total 11' in text
+    assert 'host="h0"} 7' not in text
+    assert view.host_states()  # the health rung is not gated
+
+
+def test_stitch_timeout_bounds_a_hung_host():
+    # a worker that never answers the timeline pull costs stitch_timeout_s,
+    # not a hang: the stitched read degrades to the gateway half
+    import asyncio
+
+    reg, ids = _registry_with_two_hosts()
+
+    class HungObsClient:
+        async def timeline(self, request_id):
+            await asyncio.sleep(60)
+
+    pool = FederatedServingPool(
+        reg, lambda w: None, dict,
+        FederationConfig(stitch_timeout_s=0.05),
+        obs_client_factory=lambda w: HungObsClient())
+
+    async def run():
+        t0 = time.time()
+        seg = await pool.fetch_remote_timeline("h0", "rid-1")
+        return seg, time.time() - t0
+
+    seg, took = asyncio.run(run())
+    assert seg is None
+    assert took < 5.0
